@@ -1,0 +1,59 @@
+"""Accelerator autodetection — TPU first.
+
+TPU-native analogue of the reference's accelerator plugin registry
+(ref: python/ray/_private/accelerators/tpu.py:70 TPUAcceleratorManager), which
+detects chips, sets visibility env vars and registers the pod-level
+``TPU-<version>-<chips>-head`` resource (tpu.py:356-358) used for gang
+scheduling whole slices.  Here detection goes through JAX itself when it is
+already imported (the driver owns the chips), else through TPU env vars.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, Tuple
+
+
+def detect_accelerators() -> Tuple[Dict[str, float], Dict[str, str]]:
+    """Returns (resources, node labels) for the local host."""
+    resources: Dict[str, float] = {}
+    labels: Dict[str, str] = {}
+
+    chips = 0
+    version = ""
+    # Prefer an already-initialized JAX client (never trigger a TPU init here).
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            devices = jax.devices()
+            tpu_devices = [d for d in devices if "tpu" in d.platform.lower() or "axon" in str(getattr(d, "device_kind", "")).lower() or "TPU" in str(d)]
+            chips = len(tpu_devices)
+            if tpu_devices:
+                version = str(getattr(tpu_devices[0], "device_kind", "tpu")).replace(" ", "-").lower()
+        except Exception:
+            chips = 0
+    if chips == 0:
+        env_chips = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS", "")
+        if env_chips:
+            try:
+                chips = 1
+                for part in env_chips.split(","):
+                    chips *= int(part)
+            except ValueError:
+                chips = 0
+        version = os.environ.get("TPU_ACCELERATOR_TYPE", version)
+
+    if chips > 0:
+        resources["TPU"] = float(chips)
+        labels["accelerator-type"] = version or "tpu"
+        # Pod-slice head resource for gang scheduling (ref: tpu.py:356).
+        accel_type = os.environ.get("TPU_ACCELERATOR_TYPE", "")
+        worker_id = os.environ.get("TPU_WORKER_ID", "0")
+        if accel_type and worker_id == "0":
+            resources[f"TPU-{accel_type}-head"] = 1.0
+        slice_name = os.environ.get("TPU_NAME", "")
+        if slice_name:
+            labels["ici-slice"] = slice_name
+
+    return resources, labels

@@ -1,0 +1,103 @@
+"""Runtime configuration flag table.
+
+TPU-native analogue of the reference's ``RAY_CONFIG`` macro table
+(ref: src/ray/common/ray_config_def.h:22 — 220 C++ flags overridable via
+``RAY_<name>`` env vars or a ``_system_config`` dict).  Same contract here:
+every flag has a typed default, can be overridden by ``RAY_TPU_<NAME>`` env
+vars or the ``_system_config`` dict passed to ``ray_tpu.init``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, fields
+from typing import Any, Dict, Optional
+
+_ENV_PREFIX = "RAY_TPU_"
+
+
+@dataclass
+class Config:
+    # --- object store ---
+    #: Objects at or below this size are stored inline in the in-process memory
+    #: store and copied between workers (ref: max_direct_call_object_size).
+    max_inline_object_size: int = 100 * 1024
+    #: Cap on shared-memory object store bytes (0 = autodetect 30% of RAM,
+    #: matching the reference's plasma default).
+    object_store_memory: int = 0
+    #: Directory for spilled objects (object spilling under memory pressure,
+    #: ref: raylet/local_object_manager.h:41).
+    spill_dir: str = "/tmp/ray_tpu_spill"
+    #: Start spilling when the store is this full (ref: object_spilling_threshold).
+    object_spilling_threshold: float = 0.8
+
+    # --- scheduling ---
+    #: Pack-then-spread crossover used by the hybrid policy
+    #: (ref: hybrid_scheduling_policy.h:50 spread_threshold=0.5).
+    scheduler_spread_threshold: float = 0.5
+    #: Top-k random tie-break among candidate nodes (ref: scheduler_top_k_fraction).
+    scheduler_top_k_fraction: float = 0.2
+    #: Max times a task is retried on worker/system failure (per-task override
+    #: via options(max_retries=...)).
+    task_max_retries: int = 3
+
+    # --- workers ---
+    #: Number of pre-started process workers (0 = on demand). Thread workers
+    #: (the TPU-native default execution engine) are always available.
+    prestart_process_workers: int = 0
+    #: Seconds an idle leased process worker is kept before being returned
+    #: (ref: worker lease reuse / idle_worker_killing).
+    idle_worker_timeout_s: float = 60.0
+    #: Hard cap on process workers.
+    max_process_workers: int = 16
+
+    # --- fault tolerance ---
+    #: Period of the control plane's health check of actors/nodes
+    #: (ref: gcs_health_check_manager.h:45).
+    health_check_period_s: float = 1.0
+    #: Actor restart backoff.
+    actor_restart_backoff_s: float = 0.1
+
+    # --- testing / chaos (ref: rpc/rpc_chaos.h:22, RAY_testing_rpc_failure) ---
+    #: "<method>=<probability>" comma list; matching internal operations fail
+    #: with a transient error to exercise retry paths.
+    testing_rpc_failure: str = ""
+    #: Inject this many microseconds of delay into internal event handling
+    #: (ref: RAY_testing_asio_delay_us).
+    testing_delay_us: int = 0
+
+    # --- metrics / events ---
+    metrics_report_interval_s: float = 5.0
+    #: Keep at most this many task events for the state API
+    #: (ref: gcs_task_manager.h task event GC).
+    max_task_events: int = 100_000
+    #: Enable chrome://tracing profile event collection (ref: RAY_PROFILING).
+    profiling_enabled: bool = False
+
+    # --- logging ---
+    log_dir: str = ""
+    log_to_driver: bool = True
+
+    def apply_overrides(self, system_config: Optional[Dict[str, Any]] = None) -> None:
+        for f in fields(self):
+            env = os.environ.get(_ENV_PREFIX + f.name.upper())
+            if env is not None:
+                setattr(self, f.name, _coerce(env, f.type))
+        for key, val in (system_config or {}).items():
+            if not hasattr(self, key):
+                raise ValueError(f"Unknown system config key: {key}")
+            setattr(self, key, val)
+
+
+def _coerce(value: str, typ: Any) -> Any:
+    typ = str(typ)
+    if "bool" in typ:
+        return value.lower() in ("1", "true", "yes")
+    if "int" in typ:
+        return int(value)
+    if "float" in typ:
+        return float(value)
+    return value
+
+
+GLOBAL_CONFIG = Config()

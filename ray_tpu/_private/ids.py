@@ -1,0 +1,107 @@
+"""Unique identifiers for jobs, tasks, actors, objects, nodes and placement groups.
+
+TPU-native analogue of the reference's ID scheme (ref: src/ray/common/id.h:1).
+The reference embeds ownership info (owner task, put-index) inside ObjectIDs so any
+process can locate an object's owner without a directory lookup.  We keep that idea:
+an ObjectID is ``<owner job><random task part><index>`` so the owner is recoverable,
+but we use simple hex strings rather than packed binary — the control plane here is
+in-process/IPC, not cross-datacenter gRPC, so compactness matters less than clarity.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_NIL = "f" * 16
+
+
+class BaseID(str):
+    """IDs are interned hex strings; cheap to hash, compare and msgpack."""
+
+    __slots__ = ()
+
+    @classmethod
+    def from_random(cls) -> "BaseID":
+        return cls(os.urandom(8).hex())
+
+    @classmethod
+    def nil(cls) -> "BaseID":
+        return cls(_NIL)
+
+    def is_nil(self) -> bool:
+        return self == _NIL
+
+    def hex(self) -> str:  # type: ignore[override]
+        return str(self)
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({str(self)})"
+
+
+class JobID(BaseID):
+    __slots__ = ()
+
+
+class NodeID(BaseID):
+    __slots__ = ()
+
+
+class WorkerID(BaseID):
+    __slots__ = ()
+
+
+class ActorID(BaseID):
+    __slots__ = ()
+
+
+class PlacementGroupID(BaseID):
+    __slots__ = ()
+
+
+class TaskID(BaseID):
+    __slots__ = ()
+
+
+class ObjectID(BaseID):
+    """``<task-part>:<index>`` — created by task ``task-part`` as its ``index``-th output.
+
+    Mirrors the reference's ObjectID = TaskID + return-index packing (id.h) which
+    makes lineage reconstruction possible: the creating task is recoverable from
+    the object id alone.
+    """
+
+    __slots__ = ()
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        return cls(f"{task_id}:{index}")
+
+    @classmethod
+    def from_put(cls, put_counter: int, worker_part: str) -> "ObjectID":
+        return cls(f"put-{worker_part}:{put_counter}")
+
+    def task_id(self) -> TaskID:
+        return TaskID(str(self).rsplit(":", 1)[0])
+
+    def return_index(self) -> int:
+        try:
+            return int(str(self).rsplit(":", 1)[1])
+        except (IndexError, ValueError):
+            return 0
+
+
+class _Counter:
+    """Monotonic per-process counter used for put ids and task attempt numbers."""
+
+    def __init__(self) -> None:
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._value += 1
+            return self._value
+
+
+put_counter = _Counter()

@@ -1,0 +1,120 @@
+"""ObjectRef — a distributed future handle with ownership-based reference counting.
+
+TPU-native analogue of the reference's ObjectRef (ref: python/ray/includes/
+object_ref.pxi:36) backed by the owner-side ReferenceCounter
+(ref: src/ray/core_worker/reference_count.h:66).  Each ref release (GC or
+explicit) decrements the owner's count; when the count reaches zero and the
+object is not pinned, the store entry is freed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner", "_released", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner: str = "", _add_ref: bool = True):
+        self.id = ObjectID(object_id)
+        self.owner = owner
+        self._released = False
+        if _add_ref:
+            _refcounter.add(self.id)
+
+    @staticmethod
+    def _deserialize(object_id: str, owner: str) -> "ObjectRef":
+        return ObjectRef(ObjectID(object_id), owner)
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def task_id(self):
+        return self.id.task_id()
+
+    def _release(self) -> None:
+        if not self._released:
+            self._released = True
+            _refcounter.remove(self.id)
+
+    def __del__(self) -> None:
+        try:
+            self._release()
+        except Exception:
+            pass
+
+    def __hash__(self) -> int:
+        return hash(self.id)
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self) -> str:
+        return f"ObjectRef({self.id})"
+
+    # Allow `await ref` inside async actors / drivers.
+    def __await__(self):
+        from ray_tpu._private.runtime import get_runtime
+
+        return get_runtime().get_async(self).__await__()
+
+    def future(self):
+        """A concurrent.futures.Future resolving to the object's value."""
+        from ray_tpu._private.runtime import get_runtime
+
+        return get_runtime().as_future(self)
+
+
+class ReferenceCounter:
+    """Process-local distributed-refcount table (ref: reference_count.h:66).
+
+    Counts local handles per object id.  The store consults ``pinned`` /
+    counts before freeing.  On zero, registered zero-callbacks run (used by
+    the store to free memory and by lineage to unpin specs).
+    """
+
+    def __init__(self) -> None:
+        self._counts: dict = {}
+        self._lock = threading.Lock()
+        self._zero_callback = None
+
+    def set_zero_callback(self, cb) -> None:
+        self._zero_callback = cb
+
+    def add(self, object_id: ObjectID, n: int = 1) -> None:
+        with self._lock:
+            self._counts[object_id] = self._counts.get(object_id, 0) + n
+
+    def remove(self, object_id: ObjectID, n: int = 1) -> None:
+        cb = None
+        with self._lock:
+            count = self._counts.get(object_id, 0) - n
+            if count <= 0:
+                self._counts.pop(object_id, None)
+                cb = self._zero_callback
+            else:
+                self._counts[object_id] = count
+        if cb is not None:
+            cb(object_id)
+
+    def count(self, object_id: ObjectID) -> int:
+        with self._lock:
+            return self._counts.get(object_id, 0)
+
+    def live_ids(self):
+        with self._lock:
+            return list(self._counts)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counts.clear()
+
+
+_refcounter = ReferenceCounter()
+
+
+def global_refcounter() -> ReferenceCounter:
+    return _refcounter

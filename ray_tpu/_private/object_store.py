@@ -1,0 +1,298 @@
+"""In-process + shared-memory object store with spilling.
+
+TPU-native analogue of the reference's two-tier store: the in-process
+CoreWorkerMemoryStore for small/inline objects (ref: src/ray/core_worker/
+store_provider/memory_store/memory_store.h:42) and the per-node plasma
+shared-memory store for large ones (ref: src/ray/object_manager/plasma/
+store.h:55).  Differences, by design:
+
+* Thread workers share the driver's address space, so the primary tier holds
+  the *deserialized* Python value — a zero-copy "plasma" for the common TPU
+  case (jax.Array device buffers must never be pickled between processes
+  anyway; they stay in HBM and move via ICI collectives, not the store).
+* A shared-memory tier (`multiprocessing.shared_memory`) materializes the
+  serialized form on demand when an object crosses a process boundary.
+* Capacity pressure triggers LRU spilling of the serialized form to disk
+  (ref: raylet/local_object_manager.h:41 spilling via IO workers; here an
+  internal thread), restored transparently on access.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectState:
+    PENDING = "PENDING"
+    READY = "READY"
+    SPILLED = "SPILLED"
+    FAILED = "FAILED"
+    FREED = "FREED"
+
+
+class _Entry:
+    __slots__ = (
+        "state", "value", "has_value", "error", "shm", "spill_path",
+        "size", "event", "pinned", "last_access", "owner",
+    )
+
+    def __init__(self) -> None:
+        self.state = ObjectState.PENDING
+        self.value: Any = None
+        self.has_value = False
+        self.error: Optional[BaseException] = None
+        self.shm: Optional[shared_memory.SharedMemory] = None
+        self.spill_path: Optional[str] = None
+        self.size = 0
+        self.event = threading.Event()
+        self.pinned = 0
+        self.last_access = 0.0
+        self.owner = ""
+
+
+class ObjectStore:
+    def __init__(self, capacity_bytes: int = 0) -> None:
+        self._entries: Dict[ObjectID, _Entry] = {}
+        self._lock = threading.RLock()
+        self._bytes_used = 0
+        if capacity_bytes <= 0:
+            try:
+                import psutil
+
+                capacity_bytes = int(psutil.virtual_memory().total * 0.3)
+            except Exception:
+                capacity_bytes = 2 << 30
+        self.capacity_bytes = capacity_bytes
+        os.makedirs(GLOBAL_CONFIG.spill_dir, exist_ok=True)
+        self.stats = {"puts": 0, "gets": 0, "spills": 0, "restores": 0, "freed": 0}
+        self._graveyard: List[shared_memory.SharedMemory] = []
+
+    # ------------------------------------------------------------------ puts
+    def put(self, object_id: ObjectID, value: Any, owner: str = "") -> None:
+        """Store a ready value (thread-tier: no serialization)."""
+        with self._lock:
+            entry = self._entries.setdefault(object_id, _Entry())
+            entry.value = value
+            entry.has_value = True
+            entry.state = ObjectState.READY
+            entry.owner = owner
+            entry.last_access = time.monotonic()
+            self.stats["puts"] += 1
+        entry.event.set()
+
+    def put_serialized(self, object_id: ObjectID, flat: bytes, owner: str = "") -> None:
+        """Store an object already in wire form (arrived from a process worker)."""
+        with self._lock:
+            entry = self._entries.setdefault(object_id, _Entry())
+            self._attach_shm(object_id, entry, flat)
+            entry.state = ObjectState.READY
+            entry.owner = owner
+            self.stats["puts"] += 1
+        entry.event.set()
+
+    def put_error(self, object_id: ObjectID, error: BaseException) -> None:
+        with self._lock:
+            entry = self._entries.setdefault(object_id, _Entry())
+            entry.error = error
+            entry.state = ObjectState.FAILED
+        entry.event.set()
+
+    # ------------------------------------------------------------------ gets
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e is not None and e.state in (ObjectState.READY, ObjectState.SPILLED, ObjectState.FAILED)
+
+    def wait_ready(self, object_id: ObjectID, timeout: Optional[float]) -> bool:
+        entry = self._ensure(object_id)
+        return entry.event.wait(timeout)
+
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
+        """Blocking get of the deserialized value; raises stored errors."""
+        entry = self._ensure(object_id)
+        if not entry.event.wait(timeout):
+            from ray_tpu.exceptions import GetTimeoutError
+
+            raise GetTimeoutError(f"Timed out getting object {object_id}")
+        return self._materialize(object_id, entry)
+
+    def get_error(self, object_id: ObjectID) -> Optional[BaseException]:
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e.error if e else None
+
+    def _materialize(self, object_id: ObjectID, entry: _Entry) -> Any:
+        with self._lock:
+            entry.last_access = time.monotonic()
+            self.stats["gets"] += 1
+            if entry.state == ObjectState.FAILED:
+                raise entry.error  # type: ignore[misc]
+            if entry.state == ObjectState.FREED:
+                from ray_tpu.exceptions import ObjectFreedError
+
+                raise ObjectFreedError(f"Object {object_id} was freed")
+            if entry.has_value:
+                return entry.value
+            if entry.shm is not None:
+                value = serialization.deserialize_flat(memoryview(entry.shm.buf))
+                entry.value, entry.has_value = value, True
+                return value
+            if entry.spill_path is not None:
+                self.stats["restores"] += 1
+                with open(entry.spill_path, "rb") as f:
+                    flat = f.read()
+                value = serialization.deserialize_flat(memoryview(flat))
+                entry.value, entry.has_value = value, True
+                entry.state = ObjectState.READY
+                return value
+            from ray_tpu.exceptions import ObjectLostError
+
+            raise ObjectLostError(f"Object {object_id} has no value")
+
+    def get_serialized(self, object_id: ObjectID, timeout: Optional[float] = None) -> memoryview:
+        """Wire form for shipping to a process worker (shm-backed, zero-copy)."""
+        entry = self._ensure(object_id)
+        if not entry.event.wait(timeout):
+            from ray_tpu.exceptions import GetTimeoutError
+
+            raise GetTimeoutError(f"Timed out getting object {object_id}")
+        with self._lock:
+            if entry.state == ObjectState.FAILED:
+                raise entry.error  # type: ignore[misc]
+            if entry.shm is None and entry.spill_path is None:
+                flat = serialization.serialize(entry.value).to_bytes()
+                self._attach_shm(object_id, entry, flat)
+            if entry.shm is not None:
+                return memoryview(entry.shm.buf)[: entry.size]
+            with open(entry.spill_path, "rb") as f:  # type: ignore[arg-type]
+                return memoryview(f.read())
+
+    def shm_name(self, object_id: ObjectID) -> Optional[str]:
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e.shm.name if e and e.shm is not None else None
+
+    # --------------------------------------------------------------- lifecycle
+    def _ensure(self, object_id: ObjectID) -> _Entry:
+        with self._lock:
+            return self._entries.setdefault(object_id, _Entry())
+
+    def _attach_shm(self, object_id: ObjectID, entry: _Entry, flat: bytes) -> None:
+        size = len(flat)
+        self._maybe_spill(size)
+        try:
+            shm = shared_memory.SharedMemory(create=True, size=max(size, 1))
+        except Exception:
+            # shm exhausted: keep in heap via spill file instead.
+            path = os.path.join(GLOBAL_CONFIG.spill_dir, f"{object_id}.bin".replace(":", "_"))
+            with open(path, "wb") as f:
+                f.write(flat)
+            entry.spill_path = path
+            entry.size = size
+            return
+        shm.buf[:size] = flat
+        entry.shm = shm
+        entry.size = size
+        self._bytes_used += size
+
+    def _maybe_spill(self, incoming: int) -> None:
+        """LRU-spill serialized objects when over threshold (caller holds lock)."""
+        threshold = self.capacity_bytes * GLOBAL_CONFIG.object_spilling_threshold
+        if self._bytes_used + incoming <= threshold:
+            return
+        candidates = sorted(
+            (
+                (e.last_access, oid, e)
+                for oid, e in self._entries.items()
+                if e.shm is not None and not e.pinned
+            ),
+        )
+        for _, oid, entry in candidates:
+            if self._bytes_used + incoming <= threshold:
+                break
+            path = os.path.join(GLOBAL_CONFIG.spill_dir, f"{oid}.bin".replace(":", "_"))
+            with open(path, "wb") as f:
+                f.write(bytes(entry.shm.buf[: entry.size]))
+            self._release_shm(entry)
+            entry.spill_path = path
+            entry.state = ObjectState.SPILLED
+            self.stats["spills"] += 1
+
+    def _release_shm(self, entry: _Entry) -> None:
+        if entry.shm is not None:
+            self._bytes_used -= entry.size
+            try:
+                entry.shm.unlink()
+            except Exception:
+                pass
+            try:
+                entry.shm.close()
+            except BufferError:
+                # Zero-copy views into this segment are still alive (numpy
+                # arrays deserialized out-of-band).  The mapping stays valid
+                # until the views die; park the handle so its __del__ doesn't
+                # raise, and retry at shutdown.
+                self._graveyard.append(entry.shm)
+            except Exception:
+                pass
+            entry.shm = None
+
+    def pin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._ensure(object_id).pinned += 1
+
+    def unpin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e:
+                e.pinned = max(0, e.pinned - 1)
+
+    def free(self, object_id: ObjectID) -> None:
+        """Called when the distributed refcount hits zero."""
+        with self._lock:
+            entry = self._entries.pop(object_id, None)
+            if entry is None:
+                return
+            self._release_shm(entry)
+            if entry.spill_path:
+                try:
+                    os.unlink(entry.spill_path)
+                except OSError:
+                    pass
+            entry.state = ObjectState.FREED
+            entry.value = None
+            self.stats["freed"] += 1
+
+    def evict_value(self, object_id: ObjectID) -> None:
+        """Drop the deserialized copy, keep wire form (tests/memory pressure)."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e and (e.shm is not None or e.spill_path):
+                e.value, e.has_value = None, False
+
+    def shutdown(self) -> None:
+        import gc
+
+        with self._lock:
+            for entry in self._entries.values():
+                self._release_shm(entry)
+            self._entries.clear()
+        gc.collect()
+        for shm in self._graveyard:
+            try:
+                shm.close()
+            except Exception:
+                pass
+        self._graveyard.clear()
+
+    def usage(self) -> Tuple[int, int]:
+        with self._lock:
+            return self._bytes_used, self.capacity_bytes

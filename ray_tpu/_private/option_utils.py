@@ -1,0 +1,64 @@
+"""Validation/resolution of @remote options (ref: python/ray/_private/ray_option_utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+_COMMON_KEYS = {
+    "num_cpus", "num_tpus", "num_gpus", "resources", "scheduling_strategy",
+    "name", "runtime_env", "isolation", "_metadata",
+}
+_TASK_KEYS = _COMMON_KEYS | {"num_returns", "max_retries", "retry_exceptions"}
+_ACTOR_KEYS = _COMMON_KEYS | {
+    "max_restarts", "max_task_retries", "max_concurrency", "lifetime",
+    "namespace", "max_pending_calls", "concurrency_groups",
+}
+
+
+def resolve_task_options(options: Dict[str, Any], is_actor: bool) -> Dict[str, Any]:
+    allowed = _ACTOR_KEYS if is_actor else _TASK_KEYS
+    unknown = set(options) - allowed
+    if unknown:
+        raise ValueError(f"Unknown options {sorted(unknown)}; allowed: {sorted(allowed)}")
+
+    resources: Dict[str, float] = dict(options.get("resources") or {})
+    if "num_cpus" in options and options["num_cpus"] is not None:
+        resources["CPU"] = float(options["num_cpus"])
+    else:
+        # Tasks default to 1 CPU; actors to 0 (they hold placement, not cores)
+        # — matches the reference's defaults.
+        resources.setdefault("CPU", 0.0 if is_actor else 1.0)
+    # num_gpus accepted as an alias for TPU chips to ease porting.
+    chips = options.get("num_tpus", options.get("num_gpus"))
+    if chips is not None:
+        resources["TPU"] = float(chips)
+    if resources.get("CPU") == 0.0:
+        resources.pop("CPU")
+
+    out: Dict[str, Any] = {
+        "resources": resources,
+        "scheduling_strategy": options.get("scheduling_strategy"),
+        "name": options.get("name"),
+        "runtime_env": options.get("runtime_env"),
+        "isolation": options.get("isolation", "thread"),
+    }
+    if out["isolation"] not in ("thread", "process"):
+        raise ValueError("isolation must be 'thread' or 'process'")
+    if is_actor:
+        out["max_restarts"] = int(options.get("max_restarts", 0))
+        out["max_task_retries"] = int(options.get("max_task_retries", 0))
+        out["max_concurrency"] = int(options.get("max_concurrency", 1))
+        out["lifetime"] = options.get("lifetime")
+        out["namespace"] = options.get("namespace")
+        out["concurrency_groups"] = options.get("concurrency_groups")
+    else:
+        nr = options.get("num_returns", 1)
+        if not (isinstance(nr, int) and nr >= 0) and nr not in ("dynamic", "streaming"):
+            raise ValueError(f"Invalid num_returns: {nr}")
+        out["num_returns"] = nr
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        mr = options.get("max_retries")
+        out["max_retries"] = GLOBAL_CONFIG.task_max_retries if mr is None else int(mr)
+        out["retry_exceptions"] = bool(options.get("retry_exceptions", False))
+    return out

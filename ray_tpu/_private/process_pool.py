@@ -1,0 +1,151 @@
+"""Process worker pool for GIL-isolated task execution.
+
+TPU-native analogue of the reference's WorkerPool + worker lease protocol
+(ref: src/ray/raylet/worker_pool.h:216, normal_task_submitter.h:74).  In the
+reference every task runs in a leased worker *process*; here processes are the
+*opt-in* tier (``options(isolation="process")`` or CPU-heavy library paths),
+because on TPU hosts the chips are owned by one JAX client in the driver
+process and compute-bound work releases the GIL inside XLA anyway.
+
+Protocol per worker (spawn ctx; a fork after JAX/TPU init is unsafe):
+  driver -> worker: ("exec", seq, fn_id, fn_bytes|None, flat_args)
+  worker -> driver: ("ok", seq, flat_result) | ("err", seq, flat_exc)
+Functions are cached worker-side by fn_id so hot loops ship only args
+(ref: function table export via GCS KV, _private/function_manager.py).
+Leases are reused: a released worker goes back to the idle pool keyed by
+nothing (runtime-env keying can come with runtime envs).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import GLOBAL_CONFIG
+
+
+def _worker_main(conn) -> None:
+    # Keep workers off the TPU: the driver process owns the chips.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    fn_cache: Dict[str, Any] = {}
+    while True:
+        try:
+            msg = conn.recv_bytes()
+        except (EOFError, OSError):
+            return
+        req = serialization.loads(msg)
+        kind = req[0]
+        if kind == "exec":
+            _, seq, fn_id, fn_bytes, flat_args = req
+            try:
+                if fn_id not in fn_cache:
+                    fn_cache[fn_id] = serialization.loads(fn_bytes)
+                fn = fn_cache[fn_id]
+                args, kwargs = serialization.deserialize_flat(memoryview(flat_args))
+                result = fn(*args, **kwargs)
+                payload = serialization.serialize(result).to_bytes()
+                conn.send_bytes(serialization.dumps(("ok", seq, payload)))
+            except BaseException as e:  # noqa: BLE001 — errors cross the boundary
+                import traceback
+
+                tb = traceback.format_exc()
+                try:
+                    blob = serialization.dumps((e, tb))
+                except Exception:
+                    blob = serialization.dumps((RuntimeError(repr(e)), tb))
+                conn.send_bytes(serialization.dumps(("err", seq, blob)))
+        elif kind == "shutdown":
+            return
+
+
+class _ProcWorker:
+    def __init__(self) -> None:
+        ctx = mp.get_context("spawn")
+        self.conn, child_conn = ctx.Pipe()
+        self.proc = ctx.Process(target=_worker_main, args=(child_conn,), daemon=True)
+        self.proc.start()
+        child_conn.close()
+        self.seq = 0
+        self.sent_fns: set = set()
+        self.last_used = time.monotonic()
+
+    def execute(self, fn_id: str, fn_bytes: bytes, args: tuple, kwargs: dict) -> Any:
+        """Run one task; raises WorkerCrashedError if the process dies."""
+        from ray_tpu.exceptions import TaskError, WorkerCrashedError
+
+        self.seq += 1
+        flat_args = serialization.serialize((args, kwargs)).to_bytes()
+        send_fn = fn_bytes if fn_id not in self.sent_fns else None
+        self.conn.send_bytes(
+            serialization.dumps(("exec", self.seq, fn_id, send_fn, flat_args))
+        )
+        self.sent_fns.add(fn_id)
+        try:
+            reply = serialization.loads(self.conn.recv_bytes())
+        except (EOFError, OSError) as e:
+            raise WorkerCrashedError(f"process worker died: {e}") from e
+        kind, seq, payload = reply
+        self.last_used = time.monotonic()
+        if kind == "ok":
+            return serialization.deserialize_flat(memoryview(payload))
+        exc, tb = serialization.loads(payload)
+        raise TaskError(exc, tb=tb)
+
+    def alive(self) -> bool:
+        return self.proc.is_alive()
+
+    def kill(self) -> None:
+        try:
+            self.proc.terminate()
+        except Exception:
+            pass
+
+
+class ProcessPool:
+    """Idle-pool of reusable spawned workers with an upper bound."""
+
+    def __init__(self) -> None:
+        self._idle: List[_ProcWorker] = []
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def lease(self) -> _ProcWorker:
+        with self._lock:
+            while self._idle:
+                w = self._idle.pop()
+                if w.alive():
+                    return w
+                self._count -= 1
+            self._count += 1
+        return _ProcWorker()
+
+    def release(self, worker: _ProcWorker) -> None:
+        if not worker.alive():
+            with self._lock:
+                self._count -= 1
+            return
+        with self._lock:
+            if self._count <= GLOBAL_CONFIG.max_process_workers:
+                self._idle.append(worker)
+                return
+            self._count -= 1
+        worker.kill()
+
+    def discard(self, worker: _ProcWorker) -> None:
+        with self._lock:
+            self._count -= 1
+        worker.kill()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            workers, self._idle, self._count = self._idle, [], 0
+        for w in workers:
+            try:
+                w.conn.send_bytes(serialization.dumps(("shutdown",)))
+            except Exception:
+                pass
+            w.kill()

@@ -1,0 +1,438 @@
+"""Cluster resource model and scheduling policies.
+
+TPU-native analogue of the reference's distributed scheduler
+(ref: src/ray/raylet/scheduling/cluster_resource_scheduler.h:44 and
+policy/*.h).  The cluster is modeled as a set of (possibly virtual) nodes
+with resource sets; policies pick a node for each resource request:
+
+* ``HybridPolicy``   — pack onto the local/first node until a utilization
+  threshold, then spread; top-k random tie-break
+  (ref: hybrid_scheduling_policy.h:50).
+* ``SpreadPolicy``   — round-robin across feasible nodes
+  (ref: spread_scheduling_policy.h:27).
+* ``NodeAffinityPolicy`` / ``NodeLabelPolicy`` — pin to a node / label match.
+* Placement-group bundle policies PACK / SPREAD / STRICT_PACK / STRICT_SPREAD
+  (ref: bundle_scheduling_policy.h:82-106) with a TPU twist: STRICT_PACK
+  prefers nodes on the same ICI slice (label ``ici-slice``), the analogue of
+  packing along pod ICI axes rather than generic host adjacency.
+
+Execution always happens in this host process (threads / local process pool);
+the virtual-node model is what makes multi-node scheduling *semantics*
+(placement groups, spread, spillback) real and testable on one machine, the
+same way the reference tests them via cluster_utils.Cluster
+(ref: python/ray/cluster_utils.py:135).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import NodeID, PlacementGroupID
+
+Resources = Dict[str, float]
+
+_EPS = 1e-9
+
+
+def res_fits(avail: Resources, req: Resources) -> bool:
+    return all(avail.get(k, 0.0) + _EPS >= v for k, v in req.items())
+
+
+def res_sub(avail: Resources, req: Resources) -> None:
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def res_add(avail: Resources, req: Resources) -> None:
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) + v
+
+
+class Node:
+    def __init__(self, node_id: NodeID, resources: Resources, labels: Optional[Dict[str, str]] = None):
+        self.id = node_id
+        self.total: Resources = dict(resources)
+        self.available: Resources = dict(resources)
+        self.labels = labels or {}
+        self.alive = True
+        self.start_time = time.time()
+
+    def utilization(self) -> float:
+        fracs = [
+            1.0 - self.available.get(k, 0.0) / v
+            for k, v in self.total.items()
+            if v > 0
+        ]
+        return max(fracs) if fracs else 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "NodeID": self.id,
+            "Alive": self.alive,
+            "Resources": dict(self.total),
+            "Available": dict(self.available),
+            "Labels": dict(self.labels),
+        }
+
+
+class SchedulingStrategy:
+    """Base for scheduling strategies attached to tasks/actors via options()
+    (ref: python/ray/util/scheduling_strategies.py)."""
+
+    name = "DEFAULT"
+
+
+class DefaultStrategy(SchedulingStrategy):
+    name = "DEFAULT"
+
+
+class SpreadStrategy(SchedulingStrategy):
+    name = "SPREAD"
+
+
+class NodeAffinitySchedulingStrategy(SchedulingStrategy):
+    name = "NODE_AFFINITY"
+
+    def __init__(self, node_id: str, soft: bool = False):
+        self.node_id = NodeID(node_id)
+        self.soft = soft
+
+
+class NodeLabelSchedulingStrategy(SchedulingStrategy):
+    name = "NODE_LABEL"
+
+    def __init__(self, hard: Optional[Dict[str, str]] = None, soft: Optional[Dict[str, str]] = None):
+        self.hard = hard or {}
+        self.soft = soft or {}
+
+
+class PlacementGroupSchedulingStrategy(SchedulingStrategy):
+    name = "PLACEMENT_GROUP"
+
+    def __init__(self, placement_group, placement_group_bundle_index: int = -1,
+                 placement_group_capture_child_tasks: bool = False):
+        self.placement_group = placement_group
+        self.bundle_index = placement_group_bundle_index
+        self.capture_child_tasks = placement_group_capture_child_tasks
+
+
+class _Bundle:
+    def __init__(self, index: int, resources: Resources):
+        self.index = index
+        self.resources = dict(resources)
+        self.available = dict(resources)
+        self.node_id: Optional[NodeID] = None
+
+
+class PlacementGroupState:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Resources], strategy: str, name: str = ""):
+        self.id = pg_id
+        self.bundles = [_Bundle(i, b) for i, b in enumerate(bundles)]
+        self.strategy = strategy
+        self.name = name
+        self.state = "PENDING"
+        self.ready_event = threading.Event()
+
+
+class ClusterScheduler:
+    """Resource bookkeeping + policy dispatch + wait queue.
+
+    Combines the roles of ClusterResourceManager (cluster view),
+    ClusterTaskManager (grant or queue) and the policy set
+    (ref: cluster_task_manager.h:42).  ``acquire`` either grants a lease
+    immediately or queues the request; ``release`` wakes the queue.
+    """
+
+    def __init__(self) -> None:
+        self._nodes: Dict[NodeID, Node] = {}
+        self._pgs: Dict[PlacementGroupID, PlacementGroupState] = {}
+        self._lock = threading.Condition()
+        self._queue: deque = deque()
+        self._rr_counter = 0
+        self._pg_queue: deque = deque()
+
+    # ------------------------------------------------------------- node admin
+    def add_node(self, resources: Resources, labels: Optional[Dict[str, str]] = None,
+                 node_id: Optional[NodeID] = None) -> NodeID:
+        node_id = node_id or NodeID.from_random()
+        with self._lock:
+            self._nodes[node_id] = Node(node_id, resources, labels)
+            self._retry_pending_pgs_locked()
+            self._lock.notify_all()
+        return node_id
+
+    def remove_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            node = self._nodes.pop(node_id, None)
+            if node:
+                node.alive = False
+
+    def nodes(self) -> List[Node]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    def get_node(self, node_id: NodeID) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def cluster_resources(self) -> Resources:
+        with self._lock:
+            total: Resources = {}
+            for n in self._nodes.values():
+                res_add(total, n.total)
+            return total
+
+    def available_resources(self) -> Resources:
+        with self._lock:
+            total: Resources = {}
+            for n in self._nodes.values():
+                res_add(total, n.available)
+            return total
+
+    # ---------------------------------------------------------------- leasing
+    def acquire(self, request: Resources, strategy: Optional[SchedulingStrategy] = None,
+                timeout: Optional[float] = None) -> Tuple[NodeID, Callable[[], None]]:
+        """Block until resources are granted; returns (node_id, release_fn)."""
+        strategy = strategy or DefaultStrategy()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                node_id = self._try_place_locked(request, strategy)
+                if node_id is not None:
+                    return node_id, self._make_release(node_id, request, strategy)
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"Could not acquire {request} within timeout; "
+                        f"available={self.available_resources()}")
+                if not self._feasible_anywhere_locked(request, strategy):
+                    raise InfeasibleError(
+                        f"Resource request {request} is infeasible on this cluster "
+                        f"(total={self.cluster_resources()})")
+                self._lock.wait(remaining if remaining is not None else 1.0)
+
+    def try_acquire(self, request: Resources, strategy: Optional[SchedulingStrategy] = None):
+        strategy = strategy or DefaultStrategy()
+        with self._lock:
+            node_id = self._try_place_locked(request, strategy)
+            if node_id is None:
+                return None
+            return node_id, self._make_release(node_id, request, strategy)
+
+    def _make_release(self, node_id: NodeID, request: Resources,
+                      strategy: SchedulingStrategy) -> Callable[[], None]:
+        released = threading.Event()
+
+        def release() -> None:
+            if released.is_set():
+                return
+            released.set()
+            with self._lock:
+                if isinstance(strategy, PlacementGroupSchedulingStrategy):
+                    pg = self._pgs.get(strategy.placement_group.id)
+                    if pg is not None:
+                        bundle = self._find_bundle(pg, strategy.bundle_index, request, for_release=True)
+                        if bundle is not None:
+                            res_add(bundle.available, request)
+                else:
+                    node = self._nodes.get(node_id)
+                    if node is not None:
+                        res_add(node.available, request)
+                self._lock.notify_all()
+
+        return release
+
+    def _feasible_anywhere_locked(self, request: Resources, strategy: SchedulingStrategy) -> bool:
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg = self._pgs.get(strategy.placement_group.id)
+            if pg is None or pg.state == "REMOVED":
+                return False
+            bundles = pg.bundles if strategy.bundle_index < 0 else [pg.bundles[strategy.bundle_index]]
+            return any(res_fits(b.resources, request) for b in bundles)
+        return any(res_fits(n.total, request) for n in self._nodes.values() if n.alive)
+
+    # ---------------------------------------------------------------- policies
+    def _try_place_locked(self, request: Resources, strategy: SchedulingStrategy) -> Optional[NodeID]:
+        if isinstance(strategy, PlacementGroupSchedulingStrategy):
+            pg = self._pgs.get(strategy.placement_group.id)
+            if pg is None or not pg.ready_event.is_set():
+                return None
+            bundle = self._find_bundle(pg, strategy.bundle_index, request)
+            if bundle is None:
+                return None
+            res_sub(bundle.available, request)
+            return bundle.node_id
+
+        feasible = [n for n in self._nodes.values() if n.alive and res_fits(n.available, request)]
+        if not feasible:
+            return None
+
+        if isinstance(strategy, NodeAffinitySchedulingStrategy):
+            node = self._nodes.get(strategy.node_id)
+            if node is not None and node.alive and res_fits(node.available, request):
+                res_sub(node.available, request)
+                return node.id
+            if not strategy.soft:
+                return None
+        elif isinstance(strategy, NodeLabelSchedulingStrategy):
+            hard = [n for n in feasible
+                    if all(n.labels.get(k) == v for k, v in strategy.hard.items())]
+            if not hard:
+                return None
+            soft = [n for n in hard
+                    if all(n.labels.get(k) == v for k, v in strategy.soft.items())]
+            feasible = soft or hard
+        elif isinstance(strategy, SpreadStrategy):
+            self._rr_counter += 1
+            node = feasible[self._rr_counter % len(feasible)]
+            res_sub(node.available, request)
+            return node.id
+
+        # Hybrid default: pack below threshold, else least-utilized; top-k tie-break.
+        threshold = GLOBAL_CONFIG.scheduler_spread_threshold
+        below = [n for n in feasible if n.utilization() < threshold]
+        pool = below or feasible
+        pool.sort(key=lambda n: n.utilization())
+        k = max(1, int(len(pool) * GLOBAL_CONFIG.scheduler_top_k_fraction))
+        node = random.choice(pool[:k])
+        res_sub(node.available, request)
+        return node.id
+
+    def _find_bundle(self, pg: PlacementGroupState, index: int, request: Resources,
+                     for_release: bool = False) -> Optional[_Bundle]:
+        if index >= 0:
+            b = pg.bundles[index]
+            if for_release or res_fits(b.available, request):
+                return b
+            return None
+        for b in pg.bundles:
+            if for_release or res_fits(b.available, request):
+                return b
+        return None
+
+    # ------------------------------------------------------- placement groups
+    def create_placement_group(self, pg_id: PlacementGroupID, bundles: List[Resources],
+                               strategy: str, name: str = "") -> PlacementGroupState:
+        pg = PlacementGroupState(pg_id, bundles, strategy, name)
+        with self._lock:
+            self._pgs[pg_id] = pg
+            if not self._try_commit_pg_locked(pg):
+                self._pg_queue.append(pg)
+        return pg
+
+    def _retry_pending_pgs_locked(self) -> None:
+        still_pending = deque()
+        while self._pg_queue:
+            pg = self._pg_queue.popleft()
+            if pg.state == "REMOVED":
+                continue
+            if not self._try_commit_pg_locked(pg):
+                still_pending.append(pg)
+        self._pg_queue = still_pending
+
+    def _try_commit_pg_locked(self, pg: PlacementGroupState) -> bool:
+        """2-phase prepare/commit of all bundles, atomically under the lock
+        (ref: gcs_placement_group_scheduler 2PC; placement_group_resource_manager.h)."""
+        placement = self._plan_bundles_locked(pg)
+        if placement is None:
+            return False
+        for bundle, node in placement:
+            res_sub(node.available, bundle.resources)
+            bundle.node_id = node.id
+            bundle.available = dict(bundle.resources)
+        pg.state = "CREATED"
+        pg.ready_event.set()
+        self._lock.notify_all()
+        return True
+
+    def _plan_bundles_locked(self, pg: PlacementGroupState):
+        nodes = [n for n in self._nodes.values() if n.alive]
+        if not nodes:
+            return None
+        scratch = {n.id: dict(n.available) for n in nodes}
+        placement = []
+        strategy = pg.strategy
+
+        def fit_on(node: Node, bundle: _Bundle) -> bool:
+            if res_fits(scratch[node.id], bundle.resources):
+                res_sub(scratch[node.id], bundle.resources)
+                placement.append((bundle, node))
+                return True
+            return False
+
+        if strategy == "STRICT_PACK":
+            # Prefer ICI-slice locality: try slice-local nodes first, then any
+            # single node (all bundles must land together).
+            ordered = sorted(nodes, key=lambda n: (n.labels.get("ici-slice", ""), -sum(n.available.values())))
+            for node in ordered:
+                placement.clear()
+                for nid in scratch:
+                    scratch[nid] = dict(self._nodes[nid].available)
+                if all(fit_on(node, b) for b in pg.bundles):
+                    return placement
+            return None
+        if strategy == "STRICT_SPREAD":
+            if len(nodes) < len(pg.bundles):
+                return None
+            used = set()
+            for bundle in pg.bundles:
+                cands = [n for n in nodes if n.id not in used]
+                cands.sort(key=lambda n: n.utilization())
+                for node in cands:
+                    if fit_on(node, bundle):
+                        used.add(node.id)
+                        break
+                else:
+                    return None
+            return placement
+        if strategy == "SPREAD":
+            i = 0
+            for bundle in pg.bundles:
+                for attempt in range(len(nodes)):
+                    node = nodes[(i + attempt) % len(nodes)]
+                    if fit_on(node, bundle):
+                        i += attempt + 1
+                        break
+                else:
+                    return None
+            return placement
+        # PACK (default): fill nodes in ICI-slice order.
+        ordered = sorted(nodes, key=lambda n: (n.labels.get("ici-slice", ""), n.utilization()))
+        for bundle in pg.bundles:
+            for node in ordered:
+                if fit_on(node, bundle):
+                    break
+            else:
+                return None
+        return placement
+
+    def remove_placement_group(self, pg_id: PlacementGroupID) -> None:
+        with self._lock:
+            pg = self._pgs.pop(pg_id, None)
+            if pg is None:
+                return
+            if pg.state == "CREATED":
+                for bundle in pg.bundles:
+                    if bundle.node_id is not None:
+                        node = self._nodes.get(bundle.node_id)
+                        if node is not None:
+                            res_add(node.available, bundle.resources)
+            pg.state = "REMOVED"
+            self._lock.notify_all()
+
+    def get_placement_group(self, pg_id: PlacementGroupID) -> Optional[PlacementGroupState]:
+        with self._lock:
+            return self._pgs.get(pg_id)
+
+    def placement_groups(self) -> List[PlacementGroupState]:
+        with self._lock:
+            return list(self._pgs.values())
+
+
+class InfeasibleError(RuntimeError):
+    pass

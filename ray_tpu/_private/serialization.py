@@ -1,0 +1,106 @@
+"""Serialization: cloudpickle + out-of-band zero-copy buffers + ObjectRef capture.
+
+TPU-native analogue of the reference's SerializationContext
+(ref: python/ray/_private/serialization.py:122): pickle protocol 5 with
+out-of-band buffer callbacks so large numpy / jax host arrays are carried as
+raw buffers (zero-copy into the shared-memory store), and ObjectRefs embedded
+in arguments are recorded so the runtime can (a) resolve them before execution
+and (b) keep distributed reference counts correct while they are in flight.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import threading
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+_THREAD_LOCAL = threading.local()
+
+
+class SerializedObject:
+    """Pickled payload plus its out-of-band buffers and captured ObjectRefs."""
+
+    __slots__ = ("data", "buffers", "contained_refs")
+
+    def __init__(self, data: bytes, buffers: List[pickle.PickleBuffer], contained_refs: List[Any]):
+        self.data = data
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    @property
+    def total_bytes(self) -> int:
+        return len(self.data) + sum(b.raw().nbytes for b in self.buffers)
+
+    def to_bytes(self) -> bytes:
+        """Flatten into one buffer (framing: u32 count, u64 sizes, payloads)."""
+        out = io.BytesIO()
+        out.write(len(self.buffers).to_bytes(4, "little"))
+        out.write(len(self.data).to_bytes(8, "little"))
+        for b in self.buffers:
+            out.write(b.raw().nbytes.to_bytes(8, "little"))
+        out.write(self.data)
+        for b in self.buffers:
+            out.write(b.raw())
+        return out.getvalue()
+
+
+def _capture_ref(ref: Any) -> None:
+    refs = getattr(_THREAD_LOCAL, "captured_refs", None)
+    if refs is not None:
+        refs.append(ref)
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    def reducer_override(self, obj: Any):
+        # ObjectRefs serialize as their id + owner; capture for refcounting.
+        from ray_tpu._private.object_ref import ObjectRef
+
+        if isinstance(obj, ObjectRef):
+            _capture_ref(obj)
+            return (ObjectRef._deserialize, (str(obj.id), obj.owner))
+        return super().reducer_override(obj)
+
+
+def serialize(value: Any) -> SerializedObject:
+    buffers: List[pickle.PickleBuffer] = []
+    _THREAD_LOCAL.captured_refs = []
+    try:
+        buf = io.BytesIO()
+        pickler = _Pickler(buf, protocol=5, buffer_callback=buffers.append)
+        pickler.dump(value)
+        return SerializedObject(buf.getvalue(), buffers, list(_THREAD_LOCAL.captured_refs))
+    finally:
+        _THREAD_LOCAL.captured_refs = None
+
+
+def deserialize(data: bytes, buffers: List[Any] = ()) -> Any:
+    return pickle.loads(data, buffers=buffers)
+
+
+def deserialize_flat(flat: memoryview) -> Any:
+    """Inverse of SerializedObject.to_bytes, zero-copy for the buffers."""
+    flat = memoryview(flat)
+    nbuf = int.from_bytes(flat[:4], "little")
+    ndata = int.from_bytes(flat[4:12], "little")
+    sizes = [
+        int.from_bytes(flat[12 + 8 * i : 20 + 8 * i], "little") for i in range(nbuf)
+    ]
+    off = 12 + 8 * nbuf
+    data = flat[off : off + ndata]
+    off += ndata
+    buffers = []
+    for size in sizes:
+        buffers.append(flat[off : off + size])
+        off += size
+    return pickle.loads(data, buffers=buffers)
+
+
+def dumps(value: Any) -> bytes:
+    """One-shot in-band pickle (control messages, function exports)."""
+    return cloudpickle.dumps(value, protocol=5)
+
+
+loads = pickle.loads

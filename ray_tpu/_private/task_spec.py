@@ -1,0 +1,108 @@
+"""Task/actor specifications (ref: src/ray/common/task/task_spec.h, TaskSpecification).
+
+A TaskSpec carries everything needed to execute (and re-execute, for lineage
+reconstruction) a task: the function, resolved-or-pending args, resource
+request, scheduling strategy, retry budget.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, TaskID
+from ray_tpu._private.scheduling import SchedulingStrategy
+
+
+class TaskSpec:
+    __slots__ = (
+        "task_id", "name", "func", "args", "kwargs", "num_returns",
+        "resources", "strategy", "max_retries", "retry_exceptions",
+        "actor_id", "method_name", "isolation", "attempt", "submit_time",
+        "generator", "parent_task_id", "runtime_env",
+    )
+
+    def __init__(
+        self,
+        task_id: TaskID,
+        name: str,
+        func: Any,
+        args: Tuple[Any, ...],
+        kwargs: Dict[str, Any],
+        num_returns: int,
+        resources: Dict[str, float],
+        strategy: Optional[SchedulingStrategy],
+        max_retries: int,
+        retry_exceptions: bool = False,
+        actor_id: Optional[ActorID] = None,
+        method_name: str = "",
+        isolation: str = "thread",
+        generator: bool = False,
+        parent_task_id: Optional[TaskID] = None,
+        runtime_env: Optional[dict] = None,
+    ):
+        self.task_id = task_id
+        self.name = name
+        self.func = func
+        self.args = args
+        self.kwargs = kwargs
+        self.num_returns = num_returns
+        self.resources = resources
+        self.strategy = strategy
+        self.max_retries = max_retries
+        self.retry_exceptions = retry_exceptions
+        self.actor_id = actor_id
+        self.method_name = method_name
+        self.isolation = isolation
+        self.attempt = 0
+        self.submit_time = time.time()
+        self.generator = generator
+        self.parent_task_id = parent_task_id
+        self.runtime_env = runtime_env
+
+    @property
+    def is_actor_task(self) -> bool:
+        return self.actor_id is not None and self.method_name != "__init__"
+
+    def __repr__(self) -> str:
+        return f"TaskSpec({self.name}, id={self.task_id})"
+
+
+class ActorSpec:
+    __slots__ = (
+        "actor_id", "name", "namespace", "cls", "args", "kwargs", "resources",
+        "strategy", "max_restarts", "max_task_retries", "max_concurrency",
+        "isolation", "lifetime", "concurrency_groups",
+    )
+
+    def __init__(
+        self,
+        actor_id: ActorID,
+        name: Optional[str],
+        namespace: str,
+        cls: type,
+        args: Tuple[Any, ...],
+        kwargs: Dict[str, Any],
+        resources: Dict[str, float],
+        strategy: Optional[SchedulingStrategy],
+        max_restarts: int,
+        max_task_retries: int,
+        max_concurrency: int,
+        isolation: str,
+        lifetime: Optional[str],
+        concurrency_groups: Optional[Dict[str, int]] = None,
+    ):
+        self.actor_id = actor_id
+        self.name = name
+        self.namespace = namespace
+        self.cls = cls
+        self.args = args
+        self.kwargs = kwargs
+        self.resources = resources
+        self.strategy = strategy
+        self.max_restarts = max_restarts
+        self.max_task_retries = max_task_retries
+        self.max_concurrency = max_concurrency
+        self.isolation = isolation
+        self.lifetime = lifetime
+        self.concurrency_groups = concurrency_groups or {}

@@ -1,0 +1,48 @@
+"""In-process multi-node cluster simulation for tests
+(ref: python/ray/cluster_utils.py — Cluster:135, add_node:202, remove_node:286).
+
+Nodes here are virtual scheduler nodes: scheduling semantics (spread,
+affinity, placement groups, spillback) are exercised for real while execution
+stays on this host — the same single-box multi-node trick the reference's
+test suite is built on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import ray_tpu
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.runtime import get_runtime
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = False,
+                 head_node_args: Optional[dict] = None):
+        self.head_node_id: Optional[NodeID] = None
+        self._nodes: Dict[NodeID, dict] = {}
+        if initialize_head:
+            args = dict(head_node_args or {})
+            runtime = ray_tpu.init(ignore_reinit_error=True, **args)
+            self.head_node_id = runtime.head_node_id
+            self._nodes[self.head_node_id] = args
+
+    def add_node(self, num_cpus: float = 1, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None) -> NodeID:
+        runtime = get_runtime()
+        node_resources = {"CPU": float(num_cpus)}
+        if num_tpus:
+            node_resources["TPU"] = float(num_tpus)
+        node_resources.update(resources or {})
+        node_id = runtime.scheduler.add_node(node_resources, labels)
+        self._nodes[node_id] = node_resources
+        return node_id
+
+    def remove_node(self, node_id: NodeID) -> None:
+        get_runtime().scheduler.remove_node(node_id)
+        self._nodes.pop(node_id, None)
+
+    def shutdown(self) -> None:
+        ray_tpu.shutdown()
+        self._nodes.clear()

@@ -1,0 +1,82 @@
+"""Public exception types (ref: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception during execution; re-raised at `get`.
+
+    Carries the remote traceback string (ref: RayTaskError in
+    python/ray/exceptions.py) so the user sees where the task failed.
+    """
+
+    def __init__(self, cause: BaseException, task_repr: str = "", tb: str = ""):
+        self.cause = cause
+        self.task_repr = task_repr
+        self.remote_traceback = tb or "".join(
+            traceback.format_exception(type(cause), cause, cause.__traceback__)
+        )
+        super().__init__(f"{task_repr} failed: {cause!r}\nRemote traceback:\n{self.remote_traceback}")
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorError(RayTpuError):
+    pass
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead: creation failed, it was killed, or it crashed past
+    its restart budget (ref: ActorDiedError / gcs_actor_manager.h FSM)."""
+
+    def __init__(self, msg: str = "The actor died", cause: Optional[BaseException] = None):
+        self.cause = cause
+        super().__init__(msg)
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (restarting)."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    """Object value unrecoverable and lineage reconstruction failed
+    (ref: ObjectLostError; object_recovery_manager.h:38)."""
+
+
+class ObjectFreedError(ObjectLostError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    pass
+
+
+class TaskCancelledError(RayTpuError):
+    def __init__(self, task_id: str = ""):
+        super().__init__(f"Task {task_id} was cancelled")
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    pass
+
+
+class OutOfMemoryError(RayTpuError):
+    """Raised when the memory monitor kills a task to avoid host OOM
+    (ref: common/memory_monitor.h:52 + worker killing policies)."""

@@ -1,0 +1,74 @@
+"""@ray_tpu.remote functions (ref: python/ray/remote_function.py:41).
+
+``RemoteFunction._remote`` resolves options, builds a TaskSpec and submits it
+to the runtime (ref: remote_function.py:303 → _raylet.pyx:3688 submit_task).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu._private.ids import TaskID
+from ray_tpu._private.option_utils import resolve_task_options
+from ray_tpu._private.runtime import current_task_context, get_runtime
+from ray_tpu._private.task_spec import TaskSpec
+
+
+class RemoteFunction:
+    def __init__(self, func: Callable, default_options: Optional[Dict[str, Any]] = None):
+        if inspect.isclass(func):
+            raise TypeError("Use @remote on classes via ActorClass (actor.py)")
+        self._function = func
+        self._default_options = default_options or {}
+        self.__name__ = getattr(func, "__name__", "remote_function")
+        self.__doc__ = getattr(func, "__doc__", None)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self.__name__}' cannot be called directly; "
+            f"use {self.__name__}.remote()."
+        )
+
+    def options(self, **options) -> "RemoteFunction":
+        merged = dict(self._default_options)
+        merged.update(options)
+        return RemoteFunction(self._function, merged)
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, **self._default_options)
+
+    def _remote(self, args, kwargs, **options):
+        runtime = get_runtime()
+        opts = resolve_task_options(options, is_actor=False)
+        parent = current_task_context()
+        generator = inspect.isgeneratorfunction(self._function) or opts["num_returns"] in (
+            "dynamic",
+            "streaming",
+        )
+        num_returns = opts["num_returns"]
+        if not isinstance(num_returns, int):
+            num_returns = 1
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            name=opts.get("name") or self.__name__,
+            func=self._function,
+            args=args,
+            kwargs=kwargs,
+            num_returns=num_returns,
+            resources=opts["resources"],
+            strategy=opts["scheduling_strategy"],
+            max_retries=opts["max_retries"],
+            retry_exceptions=opts["retry_exceptions"],
+            isolation=opts["isolation"],
+            generator=generator,
+            parent_task_id=parent.task_id if parent else None,
+            runtime_env=opts.get("runtime_env"),
+        )
+        return runtime.submit_task(spec)
+
+    def bind(self, *args, **kwargs):
+        """DAG-building entry point (ref: dag/dag_node.py); returns a lazy node."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)

@@ -1,0 +1,37 @@
+"""Test fixtures.
+
+Forces an 8-device virtual CPU platform BEFORE jax initializes, so all mesh /
+collective / sharding tests exercise real multi-device SPMD semantics on one
+host (ref test strategy: cluster_utils.Cluster runs multi-node on one box;
+here the analogue is a virtual 8-chip mesh).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_regular():
+    """(ref: python/ray/tests/conftest.py:532 ray_start_regular)"""
+    import ray_tpu
+
+    runtime = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Multi-(virtual-)node cluster fixture (ref: conftest.py:613 ray_start_cluster)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2})
+    yield cluster
+    cluster.shutdown()

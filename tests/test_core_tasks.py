@@ -1,0 +1,201 @@
+"""Core task/object API tests (ref model: python/ray/tests/test_basic.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, TaskCancelledError, TaskError
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put({"a": 1, "b": np.arange(10)})
+    out = ray_tpu.get(ref)
+    assert out["a"] == 1
+    np.testing.assert_array_equal(out["b"], np.arange(10))
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_args(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    x = ray_tpu.put(10)
+    r1 = add.remote(x, 5)
+    r2 = add.remote(r1, r1)
+    assert ray_tpu.get(r2) == 30
+
+
+def test_task_chain_dependencies(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = ray_tpu.put(0)
+    for _ in range(20):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 20
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom")
+
+    with pytest.raises(TaskError) as exc_info:
+        ray_tpu.get(boom.remote())
+    assert "boom" in str(exc_info.value)
+
+
+def test_error_propagates_through_chain(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("inner")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(TaskError):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, pending = ray_tpu.wait([f, s], num_returns=1, timeout=3)
+    assert ready == [f] and pending == [s]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(5)) == 11
+
+
+def test_generator_task(ray_start_regular):
+    @ray_tpu.remote
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    refs = list(gen.remote(4))
+    assert ray_tpu.get(refs) == [0, 1, 4, 9]
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote(num_cpus=2)
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(num_cpus=1).remote()) == 1
+
+
+def test_cancel_pending(ray_start_regular):
+    @ray_tpu.remote
+    def blocker():
+        time.sleep(30)
+
+    @ray_tpu.remote(num_cpus=4)
+    def big():
+        return 1
+
+    blockers = [blocker.remote() for _ in range(4)]
+    ref = big.remote()  # cannot run while blockers hold all CPUs
+    time.sleep(0.1)
+    ray_tpu.cancel(ref)
+    with pytest.raises((TaskCancelledError, TaskError)):
+        ray_tpu.get(ref, timeout=5)
+
+
+def test_large_object_numpy_roundtrip(ray_start_regular):
+    arr = np.random.rand(1000, 1000)
+    ref = ray_tpu.put(arr)
+    np.testing.assert_array_equal(ray_tpu.get(ref), arr)
+
+
+def test_process_isolation_task(ray_start_regular):
+    import os
+
+    @ray_tpu.remote(isolation="process")
+    def worker_pid():
+        return os.getpid()
+
+    pid = ray_tpu.get(worker_pid.remote())
+    assert pid != os.getpid()
+
+
+def test_retry_on_worker_crash(ray_start_regular):
+    import os
+
+    @ray_tpu.remote(isolation="process", max_retries=2)
+    def flaky(path):
+        # Crash the worker process on first attempt only.
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)
+        return "recovered"
+
+    import tempfile
+
+    marker = tempfile.mktemp()
+    try:
+        assert ray_tpu.get(flaky.remote(marker), timeout=60) == "recovered"
+    finally:
+        if os.path.exists(marker):
+            os.unlink(marker)
+
+
+def test_infeasible_fails_fast(ray_start_regular):
+    @ray_tpu.remote(num_cpus=1000)
+    def f():
+        return 1
+
+    with pytest.raises(Exception):
+        ray_tpu.get(f.remote(), timeout=10)
+
+
+def test_cluster_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 4

@@ -1,0 +1,157 @@
+"""Scheduling policy + placement group tests
+(ref model: src/ray/raylet/scheduling/scheduling_policy_test.cc,
+python/ray/tests/test_placement_group.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.scheduling import (
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SpreadStrategy,
+)
+from ray_tpu.util.placement_group import (
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+def test_spread_across_nodes(ray_start_cluster):
+    cluster = ray_start_cluster
+    for _ in range(3):
+        cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return True
+
+    refs = [
+        where.options(scheduling_strategy=SpreadStrategy()).remote() for _ in range(8)
+    ]
+    assert all(ray_tpu.get(refs))
+
+
+def test_node_affinity(ray_start_cluster):
+    cluster = ray_start_cluster
+    target = cluster.add_node(num_cpus=2, resources={"special": 1})
+
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return 1
+
+    ref = f.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(node_id=str(target))
+    ).remote()
+    assert ray_tpu.get(ref) == 1
+
+
+def test_node_labels(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, labels={"zone": "us-central2-b"})
+
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return "labeled"
+
+    ref = f.options(
+        scheduling_strategy=NodeLabelSchedulingStrategy(hard={"zone": "us-central2-b"})
+    ).remote()
+    assert ray_tpu.get(ref) == "labeled"
+
+
+def test_custom_resources(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, resources={"TPU": 4})
+
+    @ray_tpu.remote(num_tpus=2)
+    def tpu_task():
+        return "on tpu node"
+
+    assert ray_tpu.get(tpu_task.remote()) == "on tpu node"
+
+
+def test_pg_pack(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(5)
+    nodes = pg.bundle_node_ids()
+    assert nodes[0] == nodes[1]  # PACK puts bundles together
+
+
+def test_pg_strict_spread(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.wait(5)
+    nodes = pg.bundle_node_ids()
+    assert len(set(nodes)) == 3
+
+
+def test_pg_strict_pack_ici_slice(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=1, labels={"ici-slice": "slice-a"})
+    big = cluster.add_node(num_cpus=8, labels={"ici-slice": "slice-b"})
+    pg = placement_group([{"CPU": 2}] * 3, strategy="STRICT_PACK")
+    assert pg.wait(5)
+    assert set(pg.bundle_node_ids()) == {str(big)}
+
+
+def test_pg_task_scheduling(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4)
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(5)
+
+    @ray_tpu.remote(num_cpus=1)
+    def inside():
+        return "in bundle"
+
+    ref = inside.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0
+        )
+    ).remote()
+    assert ray_tpu.get(ref) == "in bundle"
+
+
+def test_pg_pending_until_node_added(ray_start_cluster):
+    cluster = ray_start_cluster
+    pg = placement_group([{"CPU": 16}], strategy="PACK")
+    assert not pg.wait(0.2)
+    cluster.add_node(num_cpus=16)
+    assert pg.wait(5)
+
+
+def test_pg_remove_releases_resources(ray_start_cluster):
+    cluster = ray_start_cluster
+    node = cluster.add_node(num_cpus=4)
+    pg = placement_group([{"CPU": 4}], strategy="PACK")
+    assert pg.wait(5)
+    avail_before = ray_tpu.available_resources().get("CPU", 0)
+    remove_placement_group(pg)
+    time.sleep(0.1)
+    assert ray_tpu.available_resources().get("CPU", 0) == avail_before + 4
+    assert str(pg.id) not in placement_group_table() or placement_group_table() == {}
+
+
+def test_actor_in_pg(ray_start_cluster):
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=4)
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(5)
+
+    @ray_tpu.remote(num_cpus=1)
+    class Worker:
+        def ping(self):
+            return "pong"
+
+    a = Worker.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(placement_group=pg)
+    ).remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
