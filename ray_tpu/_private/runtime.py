@@ -613,7 +613,13 @@ class Runtime:
         state.node_id, state.release = node_id, release
         try:
             args, kwargs = self._resolve_values(spec.args, spec.kwargs)
-            state.instance = spec.cls(*args, **kwargs)
+            # __init__ runs with an actor-scoped context so code inside it
+            # (e.g. collective rank binding) can see the actor identity.
+            _task_ctx.ctx = TaskContext(TaskID.from_random(), spec.actor_id)
+            try:
+                state.instance = spec.cls(*args, **kwargs)
+            finally:
+                _task_ctx.ctx = None
         except BaseException as e:  # noqa: BLE001
             release()
             state.death_cause = TaskError(e, task_repr=f"{spec.cls.__name__}.__init__")

@@ -1,0 +1,200 @@
+"""ray_tpu.collective — collective communication on XLA/ICI.
+
+API-compatible with the reference's ``ray.util.collective``
+(ref: python/ray/util/collective/collective.py — GroupManager:40,
+init_collective_group:120, allreduce:258, reduce:311, broadcast:373,
+allgather:423, reducescatter:472, send:531, recv:594), with the NCCL/Gloo
+backends replaced by a single "xla" backend whose ops compile to ICI
+collectives (see xla_group.py).  Rank identity comes from the calling
+actor/task's declared rank (passed at init), exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.collective.xla_group import ReduceOp, XLACollectiveGroup
+
+_local = threading.local()
+
+
+class GroupManager:
+    """(ref: collective.py:40 GroupManager)"""
+
+    def __init__(self) -> None:
+        self._groups: Dict[str, XLACollectiveGroup] = {}
+        # Rank bindings per (group, actor_id): an actor's methods may run on
+        # different threads than its __init__, so rank identity hangs off the
+        # actor, with thread-local as the fallback for plain tasks.
+        self._actor_ranks: Dict[Tuple[str, str], int] = {}
+        self._lock = threading.Lock()
+
+    def bind_actor_rank(self, group_name: str, actor_id: str, rank: int) -> None:
+        with self._lock:
+            self._actor_ranks[(group_name, actor_id)] = rank
+
+    def actor_rank(self, group_name: str, actor_id: str) -> Optional[int]:
+        return self._actor_ranks.get((group_name, actor_id))
+
+    def create_group(self, group_name: str, world_size: int,
+                     devices: Optional[List[Any]] = None) -> XLACollectiveGroup:
+        with self._lock:
+            group = self._groups.get(group_name)
+            if group is None:
+                group = XLACollectiveGroup(group_name, world_size, devices)
+                self._groups[group_name] = group
+            elif group.world_size != world_size:
+                raise ValueError(
+                    f"Group '{group_name}' exists with world_size={group.world_size}")
+            return group
+
+    def get_group(self, group_name: str) -> XLACollectiveGroup:
+        group = self._groups.get(group_name)
+        if group is None:
+            raise ValueError(
+                f"Collective group '{group_name}' is not initialized; call "
+                f"init_collective_group() in every participating worker first.")
+        return group
+
+    def destroy_group(self, group_name: str) -> None:
+        with self._lock:
+            group = self._groups.pop(group_name, None)
+            if group is not None:
+                group.destroy()
+            for key in [k for k in self._actor_ranks if k[0] == group_name]:
+                del self._actor_ranks[key]
+
+
+_manager = GroupManager()
+
+
+def _ctx_rank(group_name: str, rank: Optional[int]) -> int:
+    if rank is not None:
+        return rank
+    from ray_tpu._private.runtime import current_task_context
+
+    ctx = current_task_context()
+    if ctx is not None and ctx.actor_id is not None:
+        bound = _manager.actor_rank(group_name, str(ctx.actor_id))
+        if bound is not None:
+            return bound
+    ranks = getattr(_local, "ranks", None)
+    if ranks is None or group_name not in ranks:
+        raise ValueError(
+            "No rank bound for this worker. Actors: call init_collective_group "
+            "in __init__ (binding is per-actor). Plain tasks: init and use the "
+            "collective within the SAME task call, or pass rank= explicitly — "
+            "task-thread bindings do not persist across task invocations.")
+    return ranks[group_name]
+
+
+def init_collective_group(world_size: int, rank: int, backend: str = "xla",
+                          group_name: str = "default") -> None:
+    """Declare this worker a member of the group (ref: collective.py:120).
+
+    Unlike the NCCL backend there is no unique-id rendezvous over an actor
+    store: the xla backend's group is materialized on first use, and the
+    calling thread is bound to ``rank`` for subsequent collective calls.
+    """
+    if backend not in ("xla", "tpu", "ici"):
+        raise ValueError(f"Unsupported backend '{backend}'; the TPU-native backend is 'xla'")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    _manager.create_group(group_name, world_size)
+    from ray_tpu._private.runtime import current_task_context
+
+    ctx = current_task_context()
+    if ctx is not None and ctx.actor_id is not None:
+        _manager.bind_actor_rank(group_name, str(ctx.actor_id), rank)
+    if getattr(_local, "ranks", None) is None:
+        _local.ranks = {}
+    _local.ranks[group_name] = rank
+
+
+def create_collective_group(actors: List[Any], world_size: int, ranks: List[int],
+                            backend: str = "xla", group_name: str = "default") -> None:
+    """Driver-side declaration for a set of actors (ref: collective.py:151).
+
+    Binds each actor's identity to its rank directly in the group manager —
+    no per-actor RPC needed since ranks are control-plane state here.
+    """
+    if len(actors) != len(ranks):
+        raise ValueError("actors and ranks must have the same length")
+    _manager.create_group(group_name, world_size)
+    for actor, rank in zip(actors, ranks):
+        _manager.bind_actor_rank(group_name, str(actor._ray_actor_id), rank)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _manager.destroy_group(group_name)
+
+
+def get_collective_group(group_name: str = "default") -> XLACollectiveGroup:
+    return _manager.get_group(group_name)
+
+
+def allreduce(tensor: Any, group_name: str = "default", op: str = ReduceOp.SUM,
+              rank: Optional[int] = None) -> Any:
+    """(ref: collective.py:258) — lowers to lax.psum over the group mesh."""
+    group = _manager.get_group(group_name)
+    return group.allreduce(_ctx_rank(group_name, rank), tensor, op)
+
+
+def reduce(tensor: Any, dst_rank: int = 0, group_name: str = "default",
+           op: str = ReduceOp.SUM, rank: Optional[int] = None) -> Any:
+    """(ref: collective.py:311) — allreduce then select (ICI allreduce is the
+    native primitive; a rooted reduce saves nothing on a ring)."""
+    group = _manager.get_group(group_name)
+    r = _ctx_rank(group_name, rank)
+    out = group.allreduce(r, tensor, op)
+    return out if r == dst_rank else tensor
+
+
+def broadcast(tensor: Any, src_rank: int = 0, group_name: str = "default",
+              rank: Optional[int] = None) -> Any:
+    """(ref: collective.py:373)"""
+    group = _manager.get_group(group_name)
+    return group.broadcast(_ctx_rank(group_name, rank), tensor, src_rank)
+
+
+def allgather(tensor: Any, group_name: str = "default",
+              rank: Optional[int] = None) -> Any:
+    """(ref: collective.py:423) — returns stacked (world_size, ...) array."""
+    group = _manager.get_group(group_name)
+    return group.allgather(_ctx_rank(group_name, rank), tensor)
+
+
+def reducescatter(tensor: Any, group_name: str = "default", op: str = ReduceOp.SUM,
+                  rank: Optional[int] = None) -> Any:
+    """(ref: collective.py:472) — input dim0 must equal world_size."""
+    group = _manager.get_group(group_name)
+    return group.reducescatter(_ctx_rank(group_name, rank), tensor, op)
+
+
+def send(tensor: Any, dst_rank: int, group_name: str = "default",
+         rank: Optional[int] = None) -> Any:
+    """(ref: collective.py:531) — paired with recv as one ppermute round."""
+    group = _manager.get_group(group_name)
+    r = _ctx_rank(group_name, rank)
+    return group.send_recv(r, tensor, [(r, dst_rank)])
+
+
+def recv(tensor: Any, src_rank: int, group_name: str = "default",
+         rank: Optional[int] = None) -> Any:
+    """(ref: collective.py:594)"""
+    group = _manager.get_group(group_name)
+    r = _ctx_rank(group_name, rank)
+    return group.send_recv(r, tensor, [(src_rank, r)])
+
+
+def barrier(group_name: str = "default", rank: Optional[int] = None) -> None:
+    group = _manager.get_group(group_name)
+    group.barrier(_ctx_rank(group_name, rank))
+
+
+__all__ = [
+    "ReduceOp", "init_collective_group", "create_collective_group",
+    "destroy_collective_group", "get_collective_group", "allreduce", "reduce",
+    "broadcast", "allgather", "reducescatter", "send", "recv", "barrier",
+]

@@ -8,10 +8,21 @@ here the analogue is a virtual 8-chip mesh).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the virtual 8-device CPU platform.  The host env presets
+# JAX_PLATFORMS=axon (real TPU tunnel) and jax is PRELOADED, so its config
+# already captured that env var — override through jax.config, which works
+# as long as no backend has initialized yet (they init lazily).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == 8, (
+    f"tests need the virtual 8-device CPU mesh, got {jax.devices()}"
+)
 
 import pytest
 

@@ -1,0 +1,161 @@
+"""Collective layer tests on the virtual 8-device CPU mesh
+(ref model: python/ray/util/collective tests; semantics mirror
+collective.py allreduce:258 etc.)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import collective as col
+
+
+WORLD = 4
+
+
+@ray_tpu.remote
+class Rank:
+    def __init__(self, rank, world, group="g"):
+        self.rank = rank
+        self.group = group
+        col.init_collective_group(world, rank, backend="xla", group_name=group)
+
+    def allreduce(self, value):
+        out = col.allreduce(np.asarray(value, dtype=np.float32), group_name=self.group)
+        return np.asarray(out)
+
+    def allgather(self, value):
+        return np.asarray(col.allgather(np.asarray(value, np.float32), group_name=self.group))
+
+    def reducescatter(self, mat):
+        return np.asarray(col.reducescatter(np.asarray(mat, np.float32), group_name=self.group))
+
+    def broadcast(self, value, src):
+        return np.asarray(col.broadcast(np.asarray(value, np.float32), src_rank=src, group_name=self.group))
+
+    def sendrecv_ring(self, value):
+        # send to (rank+1) % world; receive from (rank-1) % world
+        group = col.get_collective_group(self.group)
+        perm = [(i, (i + 1) % WORLD) for i in range(WORLD)]
+        return np.asarray(group.send_recv(self.rank, np.asarray(value, np.float32), perm))
+
+    def barrier(self):
+        col.barrier(group_name=self.group)
+        return True
+
+
+@pytest.fixture
+def ranks(ray_start_regular):
+    actors = [Rank.options(max_concurrency=2).remote(i, WORLD) for i in range(WORLD)]
+    # Ensure all initialized.
+    ray_tpu.get([a.barrier.remote() for a in actors])
+    yield actors
+    col.destroy_collective_group("g")
+
+
+def test_allreduce_sum(ranks):
+    refs = [a.allreduce.remote([float(i + 1)] * 8) for i, a in enumerate(ranks)]
+    outs = ray_tpu.get(refs)
+    expected = np.full(8, sum(range(1, WORLD + 1)), np.float32)
+    for out in outs:
+        np.testing.assert_allclose(out, expected)
+
+
+def test_allreduce_repeated_rounds(ranks):
+    for round_i in range(3):
+        refs = [a.allreduce.remote([float(round_i)]) for a in ranks]
+        outs = ray_tpu.get(refs)
+        for out in outs:
+            np.testing.assert_allclose(out, [round_i * WORLD])
+
+
+def test_allgather(ranks):
+    refs = [a.allgather.remote([float(i)] * 4) for i, a in enumerate(ranks)]
+    outs = ray_tpu.get(refs)
+    expected = np.stack([np.full(4, i, np.float32) for i in range(WORLD)])
+    for out in outs:
+        np.testing.assert_allclose(out, expected)
+
+
+def test_reducescatter(ranks):
+    mat = np.arange(WORLD * 3, dtype=np.float32).reshape(WORLD, 3)
+    refs = [a.reducescatter.remote(mat) for a in ranks]
+    outs = ray_tpu.get(refs)
+    for i, out in enumerate(outs):
+        np.testing.assert_allclose(out, mat[i] * WORLD)
+
+
+def test_broadcast(ranks):
+    refs = [a.broadcast.remote([float(i) * 10], 2) for i, a in enumerate(ranks)]
+    outs = ray_tpu.get(refs)
+    for out in outs:
+        np.testing.assert_allclose(out, [20.0])
+
+
+def test_ring_permute(ranks):
+    refs = [a.sendrecv_ring.remote([float(i)]) for i, a in enumerate(ranks)]
+    outs = ray_tpu.get(refs)
+    for i, out in enumerate(outs):
+        np.testing.assert_allclose(out, [float((i - 1) % WORLD)])
+
+
+def test_pairwise_send_recv(ranks):
+    # 2-party exchange inside the 4-rank group must not wait on ranks 2/3.
+    @ray_tpu.remote
+    class P2P:
+        def __init__(self, rank):
+            self.rank = rank
+            col.init_collective_group(WORLD, rank, group_name="p2p")
+
+        def send_to(self, dst, val):
+            return np.asarray(col.send(np.float32(val), dst, group_name="p2p"))
+
+        def recv_from(self, src):
+            return np.asarray(col.recv(np.zeros(2, np.float32), src, group_name="p2p"))
+
+    a = [P2P.remote(i) for i in range(WORLD)]
+    s = a[0].send_to.remote(1, [7.0, 8.0])
+    r = a[1].recv_from.remote(0)
+    np.testing.assert_allclose(ray_tpu.get(r, timeout=30), [7.0, 8.0])
+    ray_tpu.get(s, timeout=30)
+    col.destroy_collective_group("p2p")
+
+
+def test_create_collective_group_from_driver(ray_start_regular):
+    @ray_tpu.remote
+    class Plain:
+        def reduce_val(self, v):
+            return float(np.asarray(col.allreduce(np.float32([v]), group_name="drv"))[0])
+
+    actors = [Plain.options(max_concurrency=2).remote() for _ in range(3)]
+    col.create_collective_group(actors, 3, [0, 1, 2], group_name="drv")
+    outs = ray_tpu.get([a.reduce_val.remote(i + 1) for i, a in enumerate(actors)])
+    assert outs == [6.0, 6.0, 6.0]
+    col.destroy_collective_group("drv")
+
+
+def test_allreduce_product_with_negatives():
+    # PRODUCT must be exact for negative inputs (no exp(psum(log)) NaNs).
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ray_tpu.collective.xla_group import ReduceOp, XLACollectiveGroup
+
+    group = XLACollectiveGroup("prod", 4)
+    vals = [2.0, -3.0, 1.0, -1.0]
+    with ThreadPoolExecutor(4) as pool:
+        futs = [
+            pool.submit(group.allreduce, r, np.float32([vals[r]]), ReduceOp.PRODUCT)
+            for r in range(4)
+        ]
+        outs = [np.asarray(f.result(timeout=60)) for f in futs]
+    for out in outs:
+        np.testing.assert_allclose(out, [6.0])
+
+
+def test_uninitialized_group_errors(ray_start_regular):
+    with pytest.raises(ValueError):
+        col.allreduce(np.ones(2), group_name="nope", rank=0)
+
+
+def test_bad_backend(ray_start_regular):
+    with pytest.raises(ValueError):
+        col.init_collective_group(2, 0, backend="nccl")
