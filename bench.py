@@ -1,0 +1,124 @@
+"""Headline benchmark: GPT-2 (124M) training throughput + MFU on real TPU.
+
+Prints ONE JSON line:
+  {"metric": "gpt2_train_mfu", "value": <MFU %>, "unit": "%", "vs_baseline": ...}
+
+vs_baseline is MFU / 45% — the north-star target from BASELINE.md (the
+reference publishes no TPU/MFU numbers; 45% MFU on v5e is the bar the new
+framework must set).  Extra detail goes to stderr only.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# Peak bf16 FLOP/s per chip by device kind (dense).
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+}
+
+
+def peak_flops_for(device) -> float:
+    kind = str(getattr(device, "device_kind", "")).lower()
+    for name, peak in PEAK_FLOPS.items():
+        if name in kind:
+            return peak
+    print(f"WARNING: unknown device kind {kind!r}; assuming v5e-class 197 TFLOP/s "
+          f"peak — MFU may be inflated on faster chips", file=sys.stderr)
+    return 197e12
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import MeshSpec, batch_sharding, make_mesh
+    from ray_tpu.parallel.train_state import create_sharded_state, jit_train_step
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    print(f"devices: {devices}", file=sys.stderr)
+
+    config = gpt2.GPTConfig()  # 124M, seq 1024, bf16, flash attn, save_attn remat
+    batch_per_chip = 16
+    B = batch_per_chip * n_dev
+
+    spec = MeshSpec(data=n_dev)
+    mesh = make_mesh(spec, devices)
+    optimizer = gpt2.make_optimizer(learning_rate=3e-4)
+    params, opt_state = create_sharded_state(
+        lambda key: gpt2.init_params(config, key),
+        gpt2.logical_axes(config),
+        mesh,
+        jax.random.key(0),
+        optimizer,
+    )
+    step = jit_train_step(gpt2.make_train_step(config, optimizer))
+
+    batch_sh = batch_sharding(mesh)
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        toks = rng.integers(0, config.vocab_size, (B, config.seq_len + 1), dtype=np.int64)
+        t = jnp.asarray(toks, jnp.int32)
+        return (
+            jax.device_put(t[:, :-1], batch_sh),
+            jax.device_put(t[:, 1:], batch_sh),
+        )
+
+    tokens, targets = make_batch()
+
+    # Warmup (compile + 2 steps).  NOTE: sync via float(loss) — on the axon
+    # tunnel platform block_until_ready() returns before execution completes.
+    t0 = time.perf_counter()
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    warm_loss = float(loss)
+    print(f"warmup (incl. compile): {time.perf_counter() - t0:.1f}s "
+          f"loss={warm_loss:.3f}", file=sys.stderr)
+
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_total = n_steps * B * config.seq_len
+    tokens_per_sec = tokens_total / dt
+    flops = gpt2.flops_per_token(config) * tokens_per_sec
+    peak = peak_flops_for(devices[0]) * n_dev
+    mfu = flops / peak
+    tokens_per_sec_chip = tokens_per_sec / n_dev
+
+    print(
+        f"steps={n_steps} batch={B} seq={config.seq_len} time={dt:.2f}s "
+        f"tokens/s={tokens_per_sec:,.0f} tokens/s/chip={tokens_per_sec_chip:,.0f} "
+        f"model_flops/s={flops/1e12:.1f}T peak={peak/1e12:.0f}T MFU={mfu*100:.1f}% "
+        f"loss={final_loss:.3f}",
+        file=sys.stderr,
+    )
+
+    print(json.dumps({
+        "metric": "gpt2_124m_train_mfu",
+        "value": round(mfu * 100, 2),
+        "unit": "%",
+        "vs_baseline": round(mfu / 0.45, 3),
+        "tokens_per_sec_per_chip": round(tokens_per_sec_chip, 1),
+        "n_chips": n_dev,
+    }))
+
+
+if __name__ == "__main__":
+    main()

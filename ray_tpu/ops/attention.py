@@ -1,0 +1,60 @@
+"""Fused causal flash attention for TPU.
+
+Replaces the XLA einsum-softmax-einsum path, whose (B, H, S, S) fp32 score
+tensor is pure HBM traffic (805MB/layer for GPT-2-small at S=1024 — measured
+~10x over compute-bound time on v5e).  Flash attention keeps scores in VMEM
+tiles and never materializes them.
+
+Current implementation wraps jax's public pallas TPU flash kernel with block
+sizes tuned on v5e (defaults were 3.8x slower there: 58.6ms -> 15.3ms fwd for
+GPT-2-small's 12 layers).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import lru_cache
+from typing import Optional
+
+
+@lru_cache(maxsize=None)
+def _block_sizes(seq_len: int, block: int):
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+
+    # The kernel requires block | seq_len: take the largest divisor <= block.
+    b = min(block, seq_len)
+    while seq_len % b != 0:
+        b -= 128 if b > 128 else 1
+        if b < 1:
+            b = seq_len
+            break
+    return BlockSizes(
+        block_q=b, block_k_major=b, block_k=b, block_b=1,
+        block_q_major_dkv=b, block_k_major_dkv=b, block_k_dkv=b, block_q_dkv=b,
+        block_k_major_dq=b, block_k_dq=b, block_q_dq=b,
+    )
+
+
+def flash_attention(q, k, v, causal: bool = True, sm_scale: Optional[float] = None,
+                    block: int = 1024):
+    """q, k, v: (B, S, H, head_dim) — the model's native layout.
+
+    Scaling matches the unfused path: 1/sqrt(head_dim) unless given.
+    """
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as _pallas_flash,
+    )
+
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    # Pallas kernel wants (B, H, S, D).
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _pallas_flash(
+        qt, kt, vt,
+        causal=causal,
+        sm_scale=sm_scale,
+        block_sizes=_block_sizes(q.shape[1], block),
+    )
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,18 @@
+from ray_tpu.parallel.mesh import (
+    AXIS_NAMES,
+    DEFAULT_RULES,
+    MeshSpec,
+    batch_sharding,
+    logical_to_spec,
+    make_mesh,
+    named_sharding,
+    partition,
+    pytree_sharding,
+    shard_pytree,
+)
+
+__all__ = [
+    "AXIS_NAMES", "DEFAULT_RULES", "MeshSpec", "batch_sharding",
+    "logical_to_spec", "make_mesh", "named_sharding", "partition",
+    "pytree_sharding", "shard_pytree",
+]

@@ -1,0 +1,146 @@
+"""Device mesh construction and named parallelism axes.
+
+The reference has no native TP/PP/SP (SURVEY §2.3: delegated to DeepSpeed/HF
+over Ray-provided process groups).  Here parallelism is first-class: a
+``MeshSpec`` names the four standard axes and maps them onto the physical
+device grid; shardings are expressed as PartitionSpecs over these names and
+XLA inserts the collectives (psum for dp/fsdp grad sync, all-gather for fsdp
+params, all-to-all/ppermute for sp) — the scaling-book recipe.
+
+Axes:
+  data   — pure data parallel (gradient psum)
+  fsdp   — data parallel with parameter/optimizer sharding (ZeRO-3 equiv:
+           XLA all-gathers params per layer, reduce-scatters grads)
+  tensor — megatron-style tensor parallel (activations psum)
+  seq    — sequence/context parallel (ring attention / all-to-all)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_NAMES = ("data", "fsdp", "tensor", "seq")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.data * self.fsdp * self.tensor * self.seq
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {"data": self.data, "fsdp": self.fsdp, "tensor": self.tensor, "seq": self.seq}
+
+    @staticmethod
+    def auto(n_devices: int, tensor: int = 1, seq: int = 1, fsdp: Optional[int] = None) -> "MeshSpec":
+        """Fill the data axis with whatever tensor/seq/fsdp don't consume."""
+        inner = tensor * seq * (fsdp or 1)
+        if n_devices % inner != 0:
+            raise ValueError(f"{n_devices} devices not divisible by tensor*seq*fsdp={inner}")
+        if fsdp is None:
+            return MeshSpec(data=n_devices // inner, tensor=tensor, seq=seq)
+        return MeshSpec(data=n_devices // inner, fsdp=fsdp, tensor=tensor, seq=seq)
+
+
+def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
+    """Build a jax Mesh with the canonical axis order (data, fsdp, tensor, seq).
+
+    Device order matters on real hardware: JAX returns devices in
+    topology-aware order, so the innermost axes (tensor, seq) land on
+    ICI-adjacent chips, keeping the chattiest collectives on the shortest
+    links — the analogue of the reference packing PG bundles onto one node.
+    """
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    if spec.size > len(devices):
+        raise ValueError(f"MeshSpec needs {spec.size} devices, have {len(devices)}")
+    grid = np.array(devices[: spec.size]).reshape(spec.data, spec.fsdp, spec.tensor, spec.seq)
+    return jax.sharding.Mesh(grid, AXIS_NAMES)
+
+
+def partition(*axes) -> "jax.sharding.PartitionSpec":  # noqa: F821
+    import jax
+
+    return jax.sharding.PartitionSpec(*axes)
+
+
+def named_sharding(mesh, *axes):
+    import jax
+
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(*axes))
+
+
+def batch_sharding(mesh, rules: Optional[Dict] = None):
+    """Sharding for a (batch, seq) token array — the one true place that
+    encodes batch->(data,fsdp), seq->seq so call sites can't drift."""
+    import jax
+
+    return jax.sharding.NamedSharding(mesh, logical_to_spec(("batch", "seqlen"), rules))
+
+
+# Logical axis rules: model code annotates params with logical axis names and
+# these rules map them to mesh axes (the flax/t5x "logical axes" idea, kept
+# dependency-free).
+DEFAULT_RULES: Dict[str, Optional[Tuple[str, ...]]] = {
+    "vocab": ("tensor",),
+    "embed": ("fsdp",),
+    "heads": ("tensor",),
+    "kv": None,
+    "mlp": ("tensor",),
+    "batch": ("data", "fsdp"),
+    "seqlen": ("seq",),
+    "norm": None,
+}
+
+
+def logical_to_spec(logical_axes: Sequence[Optional[str]],
+                    rules: Optional[Dict] = None):
+    """('vocab','embed') -> PartitionSpec(('tensor',), ('fsdp',))."""
+    import jax
+
+    rules = rules or DEFAULT_RULES
+    spec = []
+    for name in logical_axes:
+        if name is None:
+            spec.append(None)
+            continue
+        mapped = rules.get(name)
+        if mapped is None:
+            spec.append(None)
+        elif len(mapped) == 1:
+            spec.append(mapped[0])
+        else:
+            spec.append(tuple(mapped))
+    return jax.sharding.PartitionSpec(*spec)
+
+
+def shard_pytree(tree, logical_tree, mesh, rules: Optional[Dict] = None):
+    """device_put a parameter pytree according to its logical axis pytree."""
+    import jax
+
+    def place(x, logical):
+        return jax.device_put(x, jax.sharding.NamedSharding(mesh, logical_to_spec(logical, rules)))
+
+    return jax.tree.map(place, tree, logical_tree)
+
+
+def pytree_sharding(logical_tree, mesh, rules: Optional[Dict] = None):
+    """NamedSharding pytree (for jit in_shardings/out_shardings)."""
+    import jax
+
+    def to_sharding(logical):
+        return jax.sharding.NamedSharding(mesh, logical_to_spec(logical, rules))
+
+    return jax.tree.map(to_sharding, logical_tree,
+                        is_leaf=lambda x: isinstance(x, tuple) and all(
+                            isinstance(a, (str, type(None))) for a in x))
