@@ -23,6 +23,10 @@ def resolve_task_options(options: Dict[str, Any], is_actor: bool) -> Dict[str, A
 
     resources: Dict[str, float] = dict(options.get("resources") or {})
     if "num_cpus" in options and options["num_cpus"] is not None:
+        if "CPU" in resources and float(options["num_cpus"]) != resources["CPU"]:
+            raise ValueError(
+                "Specify CPU either via num_cpus or resources={'CPU': ...}, not "
+                "both (they conflict).")
         resources["CPU"] = float(options["num_cpus"])
     else:
         # Tasks default to 1 CPU; actors to 0 (they hold placement, not cores)

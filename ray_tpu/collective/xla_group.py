@@ -264,8 +264,18 @@ class XLACollectiveGroup:
         return results[rank]
 
     def destroy(self) -> None:
+        # Poison in-flight rounds so blocked participants wake immediately
+        # instead of sitting out the 300s rendezvous timeout (matters for
+        # elastic restart: the controller destroys the group on failure).
+        with self._rv_lock:
+            rvs = list(self._rendezvous.values())
+            self._rendezvous.clear()
+        for rv in rvs:
+            if not rv.done.is_set():
+                rv.error = RuntimeError(
+                    f"collective group '{self.group_name}' was destroyed")
+                rv.done.set()
         self._compiled.clear()
-        self._rendezvous.clear()
 
 
 def _lax_reduce(x, op: str, axis_name: str):

@@ -1,0 +1,37 @@
+"""Small MLP classifier — the fashion-MNIST workload (BASELINE config 1;
+ref harness: python/ray/train/examples/pytorch/)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_params(key, sizes: Tuple[int, ...] = (784, 128, 64, 10)) -> List[Dict[str, Any]]:
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for k, d_in, d_out in zip(keys, sizes[:-1], sizes[1:]):
+        params.append({
+            "w": jax.random.normal(k, (d_in, d_out)) * (2.0 / d_in) ** 0.5,
+            "b": jnp.zeros((d_out,)),
+        })
+    return params
+
+
+def forward(params, x):
+    for layer in params[:-1]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    out = params[-1]
+    return x @ out["w"] + out["b"]
+
+
+def loss_fn(params, x, y):
+    logits = forward(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=-1))
+
+
+def accuracy(params, x, y):
+    return jnp.mean(jnp.argmax(forward(params, x), axis=-1) == y)

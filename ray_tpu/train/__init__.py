@@ -1,0 +1,18 @@
+"""ray_tpu.train — distributed training library (ref: python/ray/train)."""
+
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager, load_pytree, save_pytree
+from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.session import (
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
+
+__all__ = [
+    "Checkpoint", "CheckpointManager", "CheckpointConfig", "DataParallelTrainer",
+    "FailureConfig", "JaxTrainer", "Result", "RunConfig", "ScalingConfig",
+    "get_checkpoint", "get_context", "get_dataset_shard", "load_pytree",
+    "report", "save_pytree",
+]

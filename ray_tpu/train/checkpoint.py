@@ -1,0 +1,158 @@
+"""Checkpoints: directory handles + orbax-backed pytree persistence.
+
+(ref: python/ray/train/_checkpoint.py:56 Checkpoint — a directory handle
+uploaded via pyarrow fs; python/ray/train/_internal/checkpoint_manager.py —
+top-K retention).  The TPU-native twist: first-class JAX pytree save/restore
+via orbax, the standard JAX checkpoint library, so sharded arrays round-trip
+without host gathers when meshes match.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class Checkpoint:
+    """A handle to a checkpoint directory (ref: _checkpoint.py:56)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_pytree(cls, tree: Any, path: Optional[str] = None) -> "Checkpoint":
+        """Persist a JAX pytree with orbax."""
+        path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        save_pytree(tree, os.path.join(path, "pytree"))
+        return cls(path)
+
+    def to_pytree(self, template: Optional[Any] = None) -> Any:
+        return load_pytree(os.path.join(self.path, "pytree"), template)
+
+    def as_directory(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield self.path
+
+        return ctx()
+
+    def update_metadata(self, metadata: Dict[str, Any]) -> None:
+        with open(os.path.join(self.path, "metadata.json"), "w") as f:
+            json.dump(metadata, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, "metadata.json")
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def __repr__(self) -> str:
+        return f"Checkpoint({self.path})"
+
+
+def save_pytree(tree: Any, path: str) -> None:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, tree)
+
+
+def load_pytree(path: str, template: Optional[Any] = None) -> Any:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        if template is not None:
+            return ckptr.restore(path, template)
+        return ckptr.restore(path)
+
+
+class CheckpointManager:
+    """Top-K checkpoint retention (ref: _internal/checkpoint_manager.py)."""
+
+    def __init__(self, storage_path: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None, score_order: str = "max"):
+        self.storage_path = storage_path
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._checkpoints: List[Tuple[float, Checkpoint, Dict]] = []
+        self._counter = 0
+        self._lock = threading.Lock()
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint, metrics: Dict[str, Any]) -> Checkpoint:
+        """Move/copy the checkpoint into managed storage and apply retention."""
+        with self._lock:
+            self._counter += 1
+            dest = os.path.join(self.storage_path, f"checkpoint_{self._counter:06d}")
+            if os.path.abspath(checkpoint.path) != dest:
+                if os.path.exists(dest):
+                    shutil.rmtree(dest)
+                src = checkpoint.path
+                is_temp = os.path.basename(src).startswith("ray_tpu_ckpt_") and \
+                    src.startswith(tempfile.gettempdir())
+                if is_temp:
+                    # from_pytree tempdirs are single-use: move, don't leak a
+                    # model-sized copy in /tmp per report.
+                    shutil.move(src, dest)
+                else:
+                    shutil.copytree(src, dest)
+            managed = Checkpoint(dest)
+            managed.update_metadata({"metrics": _json_safe(metrics), "index": self._counter,
+                                     "time": time.time()})
+            if self.score_attribute and self.score_attribute in metrics:
+                score = float(metrics[self.score_attribute])
+            else:
+                score = float(self._counter)  # recency
+            self._checkpoints.append((score, managed, metrics))
+            self._apply_retention()
+            return managed
+
+    def _apply_retention(self) -> None:
+        if self.num_to_keep is None or len(self._checkpoints) <= self.num_to_keep:
+            return
+        reverse = self.score_order == "max"
+        self._checkpoints.sort(key=lambda t: t[0], reverse=reverse)
+        for _, ckpt, _ in self._checkpoints[self.num_to_keep:]:
+            shutil.rmtree(ckpt.path, ignore_errors=True)
+        self._checkpoints = self._checkpoints[: self.num_to_keep]
+
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        with self._lock:
+            if not self._checkpoints:
+                return None
+            reverse = self.score_order == "max"
+            return sorted(self._checkpoints, key=lambda t: t[0], reverse=reverse)[0][1]
+
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        with self._lock:
+            if not self._checkpoints:
+                return None
+            return max(self._checkpoints, key=lambda t: t[1].get_metadata().get("index", 0))[1]
+
+
+def _json_safe(d: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in d.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except TypeError:
+            out[k] = repr(v)
+    return out

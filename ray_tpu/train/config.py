@@ -1,0 +1,60 @@
+"""Train configuration dataclasses
+(ref: python/ray/air/config.py — ScalingConfig, RunConfig, FailureConfig,
+CheckpointConfig; python/ray/train/ re-exports them)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """(ref: air/config.py ScalingConfig)
+
+    num_workers: size of the worker group.  use_tpu pins each worker to
+    chips; on a single host the workers are threads sharing the JAX client
+    and the collective group maps them onto the device mesh.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    #: chips per worker when use_tpu (the reference's GPUs-per-worker analogue)
+    tpus_per_worker: float = 1.0
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        res: Dict[str, float] = {"CPU": 1.0}
+        if self.use_tpu:
+            res = {"TPU": self.tpus_per_worker}
+        return res
+
+
+@dataclass
+class FailureConfig:
+    """(ref: air/config.py FailureConfig) max_failures=-1 retries forever."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """(ref: air/config.py CheckpointConfig) top-K retention."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclass
+class RunConfig:
+    """(ref: air/config.py RunConfig)"""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    verbose: int = 0

@@ -1,0 +1,298 @@
+"""Trainers + the training controller.
+
+Modeled on the reference's Train v2 architecture (ref: python/ray/train/v2/
+_internal/execution/controller.py:73 TrainController — a standalone control
+loop polling a WorkerGroup, with ScalingPolicy/FailurePolicy), rather than
+Train v1's route through a single-trial Tune run (ref: base_trainer.py:608).
+Workers are actors (ref: _internal/worker_group.py:102 WorkerGroup,
+RayTrainWorker:19); on a TPU host they are thread actors sharing the one JAX
+client, and gradient sync happens either through ray_tpu.collective (SPMD
+mode) or inside a pjit'd step the user writes against the mesh (mesh mode).
+
+Elastic recovery (ref: v2 FailurePolicy): a worker failure tears down the
+group, and the whole group restarts from the latest registered checkpoint —
+delivered to workers via train.get_checkpoint().
+
+NOTE on thread workers + JAX: calls into *jitted* functions are thread-safe
+and release the GIL; concurrent *eager* jax ops from many worker threads can
+race inside jax's dispatch on some backends.  Keep per-step math inside jit
+(which you want for performance anyway) — see tests/test_train.py
+test_multi_worker_allreduce_training for the pattern.
+"""
+
+from __future__ import annotations
+
+import queue
+import tempfile
+import threading
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu import collective
+from ray_tpu.exceptions import RayTpuError, TaskError
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.session import TrainContext, TrainSession, clear_session, init_session
+from ray_tpu.util.placement_group import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+
+
+class Result:
+    """(ref: python/ray/train/result.py Result)"""
+
+    def __init__(self, metrics: Optional[Dict[str, Any]], checkpoint: Optional[Checkpoint],
+                 path: str, error: Optional[BaseException] = None,
+                 metrics_history: Optional[List[Dict[str, Any]]] = None):
+        self.metrics = metrics
+        self.checkpoint = checkpoint
+        self.path = path
+        self.error = error
+        self.metrics_history = metrics_history or []
+
+    def __repr__(self) -> str:
+        return f"Result(metrics={self.metrics}, checkpoint={self.checkpoint}, error={self.error})"
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """(ref: _internal/worker_group.py:19 RayTrainWorker)"""
+
+    def __init__(self, rank: int, world_size: int, group_name: str):
+        self.rank = rank
+        self.world_size = world_size
+        collective.init_collective_group(world_size, rank, backend="xla",
+                                         group_name=group_name)
+
+    def run(self, train_loop: Callable, loop_config: Optional[Dict[str, Any]],
+            session: TrainSession) -> str:
+        init_session(session)
+        try:
+            import inspect
+
+            sig = inspect.signature(train_loop)
+            if len(sig.parameters) >= 1:
+                train_loop(loop_config or {})
+            else:
+                train_loop()
+            return "done"
+        except StopIteration:
+            return "stopped"
+        finally:
+            clear_session()
+
+
+class DataParallelTrainer:
+    """(ref: python/ray/train/data_parallel_trainer.py:25)"""
+
+    _collective_counter = 0
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.train_loop = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    # ------------------------------------------------------------------ fit
+    def fit(self) -> Result:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        run_name = self.run_config.name or f"train_{int(time.time())}"
+        storage = self.run_config.storage_path or tempfile.mkdtemp(prefix="ray_tpu_train_")
+        import os
+
+        experiment_path = os.path.join(storage, run_name)
+        ckpt_conf = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            os.path.join(experiment_path, "checkpoints"),
+            num_to_keep=ckpt_conf.num_to_keep,
+            score_attribute=ckpt_conf.checkpoint_score_attribute,
+            score_order=ckpt_conf.checkpoint_score_order,
+        )
+
+        max_failures = self.run_config.failure_config.max_failures
+        failures = 0
+        restore_ckpt = self.resume_from_checkpoint
+        last_error: Optional[BaseException] = None
+        history: List[Dict[str, Any]] = []
+
+        while True:
+            outcome = self._run_attempt(run_name, manager, restore_ckpt, experiment_path)
+            history.extend(outcome["history"])
+            if outcome["status"] == "finished":
+                return Result(
+                    metrics=outcome["last_metrics"],
+                    checkpoint=manager.latest_checkpoint(),
+                    path=experiment_path,
+                    metrics_history=history,
+                )
+            last_error = outcome["error"]
+            failures += 1
+            exhausted = max_failures >= 0 and failures > max_failures
+            # "fatal" = retrying cannot help (e.g. infeasible resources):
+            # return even under max_failures=-1 instead of spinning forever.
+            if exhausted or outcome["status"] == "fatal":
+                return Result(
+                    metrics=outcome["last_metrics"],
+                    checkpoint=manager.latest_checkpoint(),
+                    path=experiment_path,
+                    error=last_error,
+                    metrics_history=history,
+                )
+            time.sleep(min(2.0 ** min(failures, 5) * 0.1, 5.0))  # restart backoff
+            # Elastic restart from the latest checkpoint (ref: v2 controller
+            # RESTARTING state).
+            restore_ckpt = manager.latest_checkpoint() or self.resume_from_checkpoint
+
+    # ---------------------------------------------------------- one attempt
+    def _run_attempt(self, run_name: str, manager: CheckpointManager,
+                     restore_ckpt: Optional[Checkpoint], experiment_path: str) -> Dict:
+        scfg = self.scaling_config
+        world = scfg.num_workers
+        DataParallelTrainer._collective_counter += 1
+        group_name = f"train-{run_name}-{DataParallelTrainer._collective_counter}"
+
+        # Gang-schedule the worker group via a placement group
+        # (ref: backend_executor.py placement group per worker group).
+        bundles = [scfg.worker_resources() for _ in range(world)]
+        # Infeasible-by-construction requests fail immediately, not after the
+        # reservation timeout.
+        from ray_tpu._private.runtime import get_runtime
+        from ray_tpu._private.scheduling import res_fits
+
+        nodes = get_runtime().scheduler.nodes()
+        for bundle in bundles:
+            if not any(res_fits(n.total, bundle) for n in nodes if n.alive):
+                return {"status": "fatal", "last_metrics": None, "history": [],
+                        "error": RuntimeError(
+                            f"Worker bundle {bundle} fits no node in the cluster "
+                            f"(total: {ray_tpu.cluster_resources()})")}
+        pg = placement_group(bundles, strategy=scfg.placement_strategy)
+        try:
+            if not pg.wait(timeout_seconds=60):
+                total = ray_tpu.cluster_resources()
+                return {"status": "failed", "last_metrics": None, "history": [],
+                        "error": RuntimeError(
+                            f"Could not reserve {world}x{scfg.worker_resources()} "
+                            f"for the worker group within 60s (cluster: {total}). "
+                            f"Reduce num_workers/resources_per_worker or add nodes.")}
+            return self._run_with_pg(pg, run_name, group_name, manager, restore_ckpt)
+        finally:
+            collective.destroy_collective_group(group_name)
+            remove_placement_group(pg)
+
+    def _run_with_pg(self, pg, run_name: str, group_name: str,
+                     manager: CheckpointManager, restore_ckpt) -> Dict:
+        scfg = self.scaling_config
+        world = scfg.num_workers
+        dataset_shards = self._split_datasets(world)
+        sessions: List[TrainSession] = []
+        workers = []
+        for rank in range(world):
+            ctx = TrainContext(world_rank=rank, world_size=world, local_rank=rank,
+                               trial_name=run_name, experiment_name=run_name,
+                               group_name=group_name)
+            session = TrainSession(ctx, checkpoint_to_restore=restore_ckpt,
+                                   dataset_shards=dataset_shards[rank])
+            sessions.append(session)
+            workers.append(
+                TrainWorker.options(
+                    resources=scfg.worker_resources(),
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=pg, placement_group_bundle_index=rank),
+                ).remote(rank, world, group_name)
+            )
+
+        refs = [
+            w.run.remote(self.train_loop, self.train_loop_config, s)
+            for w, s in zip(workers, sessions)
+        ]
+
+        history: List[Dict[str, Any]] = []
+        last_metrics: Optional[Dict[str, Any]] = None
+        pending = list(refs)
+        try:
+            while pending:
+                ready, pending = ray_tpu.wait(pending, num_returns=len(pending), timeout=0.05)
+                last_metrics, new_rows = self._drain_sessions(sessions, manager, last_metrics)
+                history.extend(new_rows)
+                for r in ready:
+                    ray_tpu.get(r)  # raise worker errors here
+            # Final drain after workers exit.
+            last_metrics, new_rows = self._drain_sessions(sessions, manager, last_metrics)
+            history.extend(new_rows)
+            return {"status": "finished", "last_metrics": last_metrics,
+                    "history": history, "error": None}
+        except (TaskError, RayTpuError) as e:  # worker failed
+            for s in sessions:
+                s.stop_requested.set()
+            # Wake any worker blocked in a collective rendezvous NOW (the
+            # group destroy in the caller's finally would also do it, but
+            # draining results first needs them unwedged).
+            try:
+                collective.get_collective_group(group_name).destroy()
+            except ValueError:
+                pass
+            for w in workers:
+                ray_tpu.kill(w)
+            # Keep results reported before the crash (checkpoints especially —
+            # the restart resumes from the last one registered).
+            last_metrics, new_rows = self._drain_sessions(sessions, manager, last_metrics)
+            history.extend(new_rows)
+            return {"status": "failed", "last_metrics": last_metrics,
+                    "history": history, "error": e}
+
+    def _drain_sessions(self, sessions: List[TrainSession], manager: CheckpointManager,
+                        last_metrics: Optional[Dict[str, Any]]):
+        history = []
+        for session in sessions:
+            while True:
+                try:
+                    item = session.results.get_nowait()
+                except queue.Empty:
+                    break
+                # Metrics history follows rank 0 (the reference's convention),
+                # but checkpoints from ANY rank are registered — a loop where a
+                # non-zero rank carries the checkpoint must not lose progress.
+                if item["checkpoint"] is not None:
+                    manager.register(item["checkpoint"], item["metrics"])
+                if item["rank"] == 0:
+                    last_metrics = item["metrics"]
+                    history.append(item["metrics"])
+        return last_metrics, history
+
+    def _split_datasets(self, world: int) -> List[Dict[str, Any]]:
+        """Per-rank dataset shards (ref: StreamSplitDataIterator coordinated
+        split for Train ingest, data/_internal/iterator/stream_split_iterator.py:31)."""
+        shards: List[Dict[str, Any]] = [{} for _ in range(world)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "streaming_split"):
+                its = ds.streaming_split(world)
+                for rank in range(world):
+                    shards[rank][name] = its[rank]
+            else:
+                for rank in range(world):
+                    shards[rank][name] = ds
+        return shards
+
+
+class JaxTrainer(DataParallelTrainer):
+    """The TPU trainer (BASELINE north star: `JaxTrainer` pinning workers to
+    TPU processes).  Identical controller; workers join the 'xla' collective
+    group so `ray_tpu.collective.allreduce` inside the loop compiles to psum
+    over ICI, and `use_tpu=True` reserves chips per worker."""
