@@ -1,0 +1,146 @@
+"""ray_tpu.data — streaming datasets (ref: python/ray/data/read_api.py)."""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.data.block import block_from_rows
+from ray_tpu.data.dataset import DataIterator, Dataset
+from ray_tpu.data.plan import ActorPoolStrategy, InputData, Read
+
+DEFAULT_BLOCK_ROWS = 1000
+_builtin_range = range  # captured before the read API shadows the name
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    """(ref: read_api.py:226 range) — column 'id'."""
+    import pyarrow as pa
+
+    if parallelism <= 0:
+        parallelism = max(1, min(8, n // DEFAULT_BLOCK_ROWS or 1))
+    size = (n + parallelism - 1) // parallelism if n else 0
+
+    def make_task(start: int, end: int):
+        def read():
+            return pa.table({"id": np.arange(start, end, dtype=np.int64)})
+
+        return read
+
+    tasks = [make_task(i * size, min((i + 1) * size, n))
+             for i in _builtin_range(parallelism) if i * size < n]
+    if not tasks:
+        tasks = [make_task(0, 0)]
+    return Dataset(Read(tasks))
+
+
+def from_items(items: List[Any]) -> Dataset:
+    """(ref: read_api.py from_items)"""
+    rows = [it if isinstance(it, dict) else {"item": it} for it in items]
+    blocks = []
+    for start in _builtin_range(0, max(len(rows), 1), DEFAULT_BLOCK_ROWS):
+        chunk = rows[start:start + DEFAULT_BLOCK_ROWS]
+        if chunk or not blocks:
+            blocks.append(block_from_rows(chunk))
+    return Dataset(InputData(blocks))
+
+
+def from_numpy(arr: np.ndarray, column: str = "data") -> Dataset:
+    from ray_tpu.data.block import block_from_batch
+
+    return Dataset(InputData([block_from_batch({column: arr})]))
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow as pa
+
+    return Dataset(InputData([pa.Table.from_pandas(df, preserve_index=False)]))
+
+
+def from_arrow(table) -> Dataset:
+    return Dataset(InputData([table]))
+
+
+def _expand_paths(paths, suffix: str) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(_glob.glob(os.path.join(p, f"*{suffix}"))))
+        elif "*" in p:
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            if not os.path.exists(p):
+                raise FileNotFoundError(f"Path does not exist: {p}")
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"No files matched {paths}")
+    return out
+
+
+def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
+    """(ref: read_api.py:602 read_parquet)"""
+    import pyarrow.parquet as pq
+
+    files = _expand_paths(paths, ".parquet")
+
+    def make_task(f: str):
+        def read():
+            return pq.read_table(f, columns=columns)
+
+        return read
+
+    return Dataset(Read([make_task(f) for f in files]))
+
+
+def read_csv(paths) -> Dataset:
+    import pyarrow.csv as pacsv
+
+    files = _expand_paths(paths, ".csv")
+
+    def make_task(f: str):
+        def read():
+            return pacsv.read_csv(f)
+
+        return read
+
+    return Dataset(Read([make_task(f) for f in files]))
+
+
+def read_json(paths) -> Dataset:
+    import pyarrow.json as pajson
+
+    files = _expand_paths(paths, ".json")
+
+    def make_task(f: str):
+        def read():
+            return pajson.read_json(f)
+
+        return read
+
+    return Dataset(Read([make_task(f) for f in files]))
+
+
+def read_numpy(paths) -> Dataset:
+    files = _expand_paths(paths, ".npy")
+
+    def make_task(f: str):
+        def read():
+            from ray_tpu.data.block import block_from_batch
+
+            return block_from_batch({"data": np.load(f)})
+
+        return read
+
+    return Dataset(Read([make_task(f) for f in files]))
+
+
+__all__ = [
+    "ActorPoolStrategy", "DataIterator", "Dataset", "from_arrow", "from_items",
+    "from_numpy", "from_pandas", "range", "read_csv", "read_json", "read_numpy",
+    "read_parquet",
+]

@@ -1,0 +1,150 @@
+"""Blocks — the unit of data movement (ref: python/ray/data/block.py:
+Block = Arrow table; BlockAccessor wraps format-specific access).
+
+Canonical block format is a pyarrow.Table (zero-copy into the object store's
+buffer tier); batches convert to "numpy" (dict of arrays — the TPU-friendly
+form fed to jax), "pandas", or "pyarrow" on demand.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+Batch = Union[Dict[str, np.ndarray], "pa.Table", Any]
+
+
+def block_from_rows(rows: List[Dict[str, Any]]) -> Block:
+    if not rows:
+        return pa.table({})
+    cols: Dict[str, list] = {k: [] for k in rows[0]}
+    for row in rows:
+        for k in cols:
+            cols[k].append(row.get(k))
+    return block_from_batch({k: np.asarray(v) for k, v in cols.items()})
+
+
+def block_from_batch(batch: Batch) -> Block:
+    if isinstance(batch, pa.Table):
+        return batch
+    if hasattr(batch, "to_dict") and type(batch).__module__.startswith("pandas"):
+        return pa.Table.from_pandas(batch, preserve_index=False)
+    if isinstance(batch, dict):
+        arrays, fields = [], []
+        for k, v in batch.items():
+            arr, field = _to_arrow_array(k, v)
+            arrays.append(arr)
+            fields.append(field)
+        return pa.table(arrays, schema=pa.schema(fields))
+    raise TypeError(f"Cannot make a block from {type(batch)}")
+
+
+#: Field metadata key holding the per-row tensor shape for ndim>=3 columns.
+_SHAPE_META = b"ray_tpu.tensor_shape"
+
+
+def _to_arrow_array(name: str, values) -> Tuple[pa.Array, pa.Field]:
+    if isinstance(values, (pa.Array, pa.ChunkedArray)):
+        return values, pa.field(name, values.type)
+    arr = np.asarray(values)
+    if arr.ndim > 1:
+        # Tensor columns: fixed-size-list arrays with the per-row shape in
+        # field metadata so ndim>=3 round-trips (ref: ArrowTensorArray).
+        flat = arr.reshape(len(arr), -1)
+        pa_arr = pa.FixedSizeListArray.from_arrays(pa.array(flat.ravel()), flat.shape[1])
+        meta = {_SHAPE_META: ",".join(map(str, arr.shape[1:])).encode()}
+        return pa_arr, pa.field(name, pa_arr.type, metadata=meta)
+    pa_arr = pa.array(arr)
+    return pa_arr, pa.field(name, pa_arr.type)
+
+
+class BlockAccessor:
+    """(ref: data/block.py BlockAccessor)"""
+
+    def __init__(self, block: Block):
+        self.block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        return self.block.num_rows
+
+    def size_bytes(self) -> int:
+        return self.block.nbytes
+
+    def schema(self):
+        return self.block.schema
+
+    def slice(self, start: int, end: int) -> Block:
+        return self.block.slice(start, end - start)
+
+    def to_batch(self, batch_format: str = "numpy") -> Batch:
+        if batch_format in ("numpy", "default"):
+            return {
+                name: column_to_numpy(self.block, name)
+                for name in self.block.column_names
+            }
+        if batch_format == "pandas":
+            return self.block.to_pandas()
+        if batch_format == "pyarrow":
+            return self.block
+        raise ValueError(f"Unknown batch_format: {batch_format}")
+
+    def iter_rows(self) -> Iterable[Dict[str, Any]]:
+        cols = {name: column_to_numpy(self.block, name)
+                for name in self.block.column_names}
+        for i in range(self.block.num_rows):
+            yield {k: v[i] for k, v in cols.items()}
+
+    def take(self, indices: List[int]) -> Block:
+        return self.block.take(pa.array(indices))
+
+
+def column_to_numpy(block: Block, name: str) -> np.ndarray:
+    col = block.column(name)
+    if isinstance(col.type, pa.FixedSizeListType):
+        combined = col.combine_chunks()
+        flat = combined.values.to_numpy(zero_copy_only=False)
+        field = block.schema.field(name)
+        shape: Tuple[int, ...] = (col.type.list_size,)
+        if field.metadata and _SHAPE_META in field.metadata:
+            shape = tuple(int(s) for s in field.metadata[_SHAPE_META].decode().split(","))
+        return flat.reshape((len(col),) + shape)
+    return col.to_numpy(zero_copy_only=False)
+
+
+def rebatch(block_iter: Iterable[Block], batch_size: Optional[int],
+            batch_format: str = "numpy") -> Iterable[Batch]:
+    """Re-slice a stream of blocks into exact-size batches (shared by
+    Dataset.iter_batches and DataIterator.iter_batches)."""
+    carry: List[Block] = []
+    carry_rows = 0
+    for block in block_iter:
+        if block.num_rows == 0:
+            continue
+        if batch_size is None:
+            yield BlockAccessor(block).to_batch(batch_format)
+            continue
+        carry.append(block)
+        carry_rows += block.num_rows
+        while carry_rows >= batch_size:
+            merged = concat_blocks(carry)
+            acc = BlockAccessor(merged)
+            yield BlockAccessor(acc.slice(0, batch_size)).to_batch(batch_format)
+            rest = acc.slice(batch_size, acc.num_rows())
+            carry = [rest] if rest.num_rows > 0 else []
+            carry_rows = acc.num_rows() - batch_size
+    if carry_rows:
+        yield BlockAccessor(concat_blocks(carry)).to_batch(batch_format)
+
+
+def concat_blocks(blocks: List[Block]) -> Block:
+    blocks = [b for b in blocks if b.num_rows > 0]
+    if not blocks:
+        return pa.table({})
+    return pa.concat_tables(blocks)

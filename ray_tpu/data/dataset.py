@@ -1,0 +1,358 @@
+"""Dataset — lazy, streaming, distributed data (ref: python/ray/data/dataset.py:147).
+
+Transforms append logical ops (plan.py); execution is streaming (executor.py)
+and only happens on iteration/consumption, like the reference.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import executor as ex
+from ray_tpu.data.block import Block, BlockAccessor, concat_blocks
+from ray_tpu.data.plan import (
+    ActorPoolStrategy,
+    Aggregate,
+    ComputeStrategy,
+    Filter,
+    FlatMap,
+    InputData,
+    Limit,
+    LogicalOp,
+    MapBatches,
+    MapRows,
+    RandomShuffle,
+    Read,
+    Repartition,
+    Sort,
+    Union as UnionOp,
+)
+
+
+class Dataset:
+    def __init__(self, op: LogicalOp):
+        self._op = op
+
+    # ------------------------------------------------------------ transforms
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy", compute: Optional[ComputeStrategy] = None,
+                    num_tpus: Optional[float] = None, concurrency: Optional[int] = None,
+                    fn_constructor_args: tuple = (), **_compat) -> "Dataset":
+        """(ref: dataset.py:397 map_batches — the batch-inference path).
+
+        Stateful form: pass a class; it is constructed once per pool actor
+        (TPU-pinned with num_tpus) and called per batch.
+        """
+        fn_constructor = None
+        the_fn = fn
+        if isinstance(fn, type):
+            ctor_args = fn_constructor_args
+
+            def fn_constructor():
+                return fn(*ctor_args)
+
+            def the_fn(batch, state):
+                return state(batch)
+
+            if compute is None:
+                compute = ActorPoolStrategy(
+                    size=concurrency or 1,
+                    resources={"TPU": num_tpus} if num_tpus else {})
+        elif num_tpus or (concurrency and concurrency > 1):
+            compute = compute or ActorPoolStrategy(
+                size=concurrency or 1,
+                resources={"TPU": num_tpus} if num_tpus else {})
+        return Dataset(MapBatches(self._op, the_fn, batch_size=batch_size,
+                                  batch_format=batch_format, compute=compute,
+                                  fn_constructor=fn_constructor))
+
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        return Dataset(MapRows(self._op, fn))
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        return Dataset(Filter(self._op, fn))
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        return Dataset(FlatMap(self._op, fn))
+
+    def add_column(self, name: str, fn: Callable[[Dict], Any]) -> "Dataset":
+        def add(row):
+            row = dict(row)
+            row[name] = fn(row)
+            return row
+
+        return Dataset(MapRows(self._op, add))
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def drop(batch):
+            return {k: v for k, v in batch.items() if k not in cols}
+
+        return Dataset(MapBatches(self._op, drop))
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        def select(batch):
+            return {k: batch[k] for k in cols}
+
+        return Dataset(MapBatches(self._op, select))
+
+    def limit(self, n: int) -> "Dataset":
+        return Dataset(Limit(self._op, n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return Dataset(Repartition(self._op, num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return Dataset(RandomShuffle(self._op, seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return Dataset(Sort(self._op, key, descending))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        return Dataset(UnionOp(self._op, [o._op for o in others]))
+
+    def groupby(self, key: Optional[str]) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # ----------------------------------------------------------- consumption
+    def iter_block_refs(self) -> Iterator[Any]:
+        return ex.execute(self._op)
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy") -> Iterator[Any]:
+        """(ref: iterator.py:94 iter_batches) — streaming, overlaps execution."""
+        from ray_tpu.data.block import rebatch
+
+        blocks = (ray_tpu.get(ref) for ref in self.iter_block_refs())
+        yield from rebatch(blocks, batch_size, batch_format)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for ref in self.iter_block_refs():
+            yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        return list(itertools.islice(self.iter_rows(), n))
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(
+            BlockAccessor(ray_tpu.get(r)).num_rows() for r in self.iter_block_refs())
+
+    def schema(self):
+        for ref in self.iter_block_refs():
+            block = ray_tpu.get(ref)
+            if block.num_rows > 0 or block.schema.names:
+                return block.schema
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s is not None else []
+
+    def materialize(self) -> "Dataset":
+        """(ref: dataset.py materialize) — execute now, pin blocks."""
+        refs = list(self.iter_block_refs())
+        return Dataset(InputData(refs))
+
+    def to_pandas(self):
+        import pandas as pd
+
+        blocks = [ray_tpu.get(r) for r in self.iter_block_refs()]
+        merged = concat_blocks(blocks)
+        return merged.to_pandas()
+
+    def min(self, col: str):
+        return self._simple_agg("min", col)
+
+    def max(self, col: str):
+        return self._simple_agg("max", col)
+
+    def sum(self, col: str):
+        return self._simple_agg("sum", col)
+
+    def mean(self, col: str):
+        return self._simple_agg("mean", col)
+
+    def _simple_agg(self, fn: str, col: str):
+        ds = Dataset(Aggregate(self._op, None, [(col, fn)]))
+        rows = ds.take_all()
+        return rows[0][f"{fn}({col})"]
+
+    # --------------------------------------------------------------- splits
+    def split(self, n: int) -> List["Dataset"]:
+        """Materializing equal split (ref: dataset.py split)."""
+        refs = list(self.iter_block_refs())
+        blocks = [ray_tpu.get(r) for r in refs]
+        merged = concat_blocks(blocks)
+        acc = BlockAccessor(merged)
+        total = acc.num_rows()
+        size = (total + n - 1) // n if total else 0
+        out = []
+        for i in range(n):
+            piece = acc.slice(min(i * size, total), min((i + 1) * size, total)) \
+                if total else merged
+            out.append(Dataset(InputData([ray_tpu.put(piece)])))
+        return out
+
+    def streaming_split(self, n: int, *, equal: bool = True) -> List["DataIterator"]:
+        """Coordinated split for Train ingest (ref: StreamSplitDataIterator,
+        _internal/iterator/stream_split_iterator.py:31): one shared execution,
+        blocks dealt round-robin to n consumers."""
+        coordinator = _SplitCoordinator(self, n, equal=equal)
+        return [DataIterator(coordinator, i) for i in range(n)]
+
+    # ---------------------------------------------------------------- writes
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self.iter_block_refs()):
+            block = ray_tpu.get(ref)
+            if block.num_rows:
+                pq.write_table(block, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def write_csv(self, path: str) -> None:
+        import os
+
+        import pyarrow.csv as pacsv
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self.iter_block_refs()):
+            block = ray_tpu.get(ref)
+            if block.num_rows:
+                pacsv.write_csv(block, os.path.join(path, f"part-{i:05d}.csv"))
+
+    def stats(self) -> str:
+        return f"Dataset(plan={'->'.join(op.name for op in self._op.chain())})"
+
+    def __repr__(self) -> str:
+        return self.stats()
+
+
+class GroupedData:
+    """(ref: data/grouped_data.py)"""
+
+    def __init__(self, ds: Dataset, key: Optional[str]):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, fn: str, col: str) -> Dataset:
+        return Dataset(Aggregate(self._ds._op, self._key, [(col, fn)]))
+
+    def sum(self, col: str) -> Dataset:
+        return self._agg("sum", col)
+
+    def min(self, col: str) -> Dataset:
+        return self._agg("min", col)
+
+    def max(self, col: str) -> Dataset:
+        return self._agg("max", col)
+
+    def mean(self, col: str) -> Dataset:
+        return self._agg("mean", col)
+
+    def count(self) -> Dataset:
+        # Global count (key=None) counts rows of any column.
+        col = self._key if self._key is not None else "*"
+        return self._agg("count", col)
+
+
+class _SplitCoordinator:
+    """Single execution shared by n DataIterators (backpressured queues).
+
+    equal=True deals row-slices so every consumer gets ~1/n of each block —
+    a one-block dataset still feeds all n trainers (the reference's
+    StreamSplitDataIterator guarantees balanced output for Train ingest).
+    """
+
+    def __init__(self, ds: Dataset, n: int, equal: bool = True):
+        self.n = n
+        self.equal = equal
+        # Bounded for backpressure, but deep enough that a consumer lagging a
+        # few blocks behind (consumers are normally concurrent trainer
+        # workers) doesn't stall the shared pump.
+        self.queues: List["queue.Queue"] = [queue.Queue(maxsize=64) for _ in range(n)]
+        self._thread = threading.Thread(target=self._pump, args=(ds,), daemon=True)
+        self._started = False
+        self._lock = threading.Lock()
+
+    def ensure_started(self):
+        with self._lock:
+            if not self._started:
+                self._started = True
+                self._thread.start()
+
+    def _pump(self, ds: Dataset):
+        i = 0
+        error: Optional[BaseException] = None
+        try:
+            for ref in ds.iter_block_refs():
+                if not self.equal:
+                    self.queues[i % self.n].put(ref)
+                    i += 1
+                    continue
+                block = ray_tpu.get(ref)
+                rows = BlockAccessor(block).num_rows()
+                if rows == 0:
+                    continue
+                size = (rows + self.n - 1) // self.n
+                acc = BlockAccessor(block)
+                for c in _builtin_range(self.n):
+                    start = min(c * size, rows)
+                    end = min((c + 1) * size, rows)
+                    if end > start:
+                        # Rotate which consumer gets the (larger) head slice.
+                        target = (c + i) % self.n
+                        self.queues[target].put(ray_tpu.put(acc.slice(start, end)))
+                i += 1
+        except BaseException as e:  # noqa: BLE001 — must reach the consumers
+            error = e
+        finally:
+            # Execution errors propagate to every consumer rather than
+            # silently truncating their streams.
+            for q in self.queues:
+                q.put(error if error is not None else None)
+
+
+_builtin_range = range
+
+
+class DataIterator:
+    """Per-consumer iterator from streaming_split (ref: data/iterator.py:59)."""
+
+    def __init__(self, coordinator: _SplitCoordinator, index: int):
+        self._coord = coordinator
+        self._index = index
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy") -> Iterator[Any]:
+        from ray_tpu.data.block import rebatch
+
+        self._coord.ensure_started()
+        q = self._coord.queues[self._index]
+
+        def block_stream():
+            while True:
+                ref = q.get()
+                if ref is None:
+                    return
+                if isinstance(ref, BaseException):
+                    raise ref
+                yield ray_tpu.get(ref)
+
+        yield from rebatch(block_stream(), batch_size, batch_format)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for batch in self.iter_batches(batch_size=None):
+            n = len(next(iter(batch.values()))) if batch else 0
+            for i in range(n):
+                yield {k: v[i] for k, v in batch.items()}

@@ -1,0 +1,320 @@
+"""Streaming executor: runs the logical op chain over blocks with bounded
+in-flight tasks.
+
+(ref: python/ray/data/_internal/execution/streaming_executor.py:48 and
+streaming_executor_state.py — an operator-DAG scheduling loop under resource
+budgets with backpressure; task-pool and actor-pool map operators in
+execution/operators/).  Structure kept: per-op transforms become tasks (or
+actor calls for stateful compute) on the core runtime; blocks stream through
+with a bounded number outstanding (backpressure), and outputs are yielded as
+they finish — iteration overlaps with execution.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import block as block_mod
+from ray_tpu.data.block import Block, BlockAccessor, block_from_batch, block_from_rows, concat_blocks
+from ray_tpu.data.plan import (
+    AbstractMap,
+    Aggregate,
+    Filter,
+    FlatMap,
+    InputData,
+    Limit,
+    LogicalOp,
+    MapBatches,
+    MapRows,
+    RandomShuffle,
+    Read,
+    Repartition,
+    Sort,
+    Union,
+    fuse_maps,
+)
+
+#: Max map tasks in flight per operator (backpressure; ref:
+#: backpressure_policy/concurrency_cap_backpressure_policy.py).
+MAX_IN_FLIGHT = 8
+
+
+def make_block_transform(op: AbstractMap) -> Callable[[Block], Block]:
+    """Build the pure block->block function for a map-family logical op."""
+    if getattr(op, "_pre_transformed", False):
+        return op.fn
+    if isinstance(op, MapBatches):
+        batch_size = op.batch_size
+        batch_format = op.batch_format
+        fn = op.fn
+
+        def map_batches(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            if n == 0:
+                return block
+            size = batch_size or n
+            outs = []
+            for start in range(0, n, size):
+                piece = BlockAccessor(acc.slice(start, min(start + size, n)))
+                out = fn(piece.to_batch(batch_format))
+                outs.append(block_from_batch(out))
+            return concat_blocks(outs)
+
+        return map_batches
+    if isinstance(op, Filter):
+        fn = op.fn
+
+        def filter_block(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            keep = [i for i, row in enumerate(acc.iter_rows()) if fn(row)]
+            return acc.take(keep) if len(keep) < acc.num_rows() else block
+
+        return filter_block
+    if isinstance(op, FlatMap):
+        fn = op.fn
+
+        def flat_map(block: Block) -> Block:
+            rows = []
+            for row in BlockAccessor(block).iter_rows():
+                rows.extend(fn(row))
+            return block_from_rows(rows)
+
+        return flat_map
+    if isinstance(op, MapRows):
+        fn = op.fn
+
+        def map_rows(block: Block) -> Block:
+            return block_from_rows([fn(row) for row in BlockAccessor(block).iter_rows()])
+
+        return map_rows
+    if isinstance(op, AbstractMap):
+        return op.fn
+    raise TypeError(f"not a map op: {op}")
+
+
+class _ActorPool:
+    """Stateful map execution on a pool of actors (ref:
+    actor_pool_map_operator.py — the TPU batch-inference path: actors hold
+    the model; blocks round-robin across them)."""
+
+    def __init__(self, op: AbstractMap):
+        transform = make_block_transform(op)
+        fn_constructor = op.fn_constructor
+
+        @ray_tpu.remote
+        class MapWorker:
+            def __init__(self):
+                self.state = fn_constructor() if fn_constructor is not None else None
+
+            def apply(self, block, transform=transform):
+                if self.state is not None:
+                    return transform_with_state(block, self.state)
+                return transform(block)
+
+        def transform_with_state(block, state):
+            # fn is (batch, state) when a constructor is given.
+            acc = BlockAccessor(block)
+            n = acc.num_rows()
+            if n == 0:
+                return block
+            size = getattr(op, "batch_size", None) or n
+            fmt = getattr(op, "batch_format", "numpy")
+            outs = []
+            for start in range(0, n, size):
+                piece = BlockAccessor(acc.slice(start, min(start + size, n)))
+                outs.append(block_from_batch(op.fn(piece.to_batch(fmt), state)))
+            return concat_blocks(outs)
+
+        res = dict(op.compute.resources)
+        self.actors = [
+            MapWorker.options(resources=res or None, num_cpus=None if res else 1).remote()
+            for _ in range(op.compute.pool_size)
+        ]
+        self._rr = 0
+
+    def submit(self, block_ref):
+        actor = self.actors[self._rr % len(self.actors)]
+        self._rr += 1
+        return actor.apply.remote(block_ref)
+
+    def shutdown(self):
+        for a in self.actors:
+            ray_tpu.kill(a)
+
+
+def execute(op: LogicalOp) -> Iterator[Any]:
+    """Yield block ObjectRefs for the plan rooted at `op`, streaming."""
+    ops = fuse_maps(op.chain())
+    stream: Iterator[Any] = _source_stream(ops[0])
+    for logical in ops[1:]:
+        stream = _apply_op(stream, logical)
+    return stream
+
+
+def _source_stream(src: LogicalOp) -> Iterator[Any]:
+    if isinstance(src, InputData):
+        for b in src.blocks:
+            yield b if isinstance(b, ray_tpu.ObjectRef) else ray_tpu.put(b)
+        return
+    if isinstance(src, Read):
+        @ray_tpu.remote
+        def do_read(task):
+            return task()
+
+        pending: List[Any] = []
+        tasks = list(src.read_tasks)
+        i = 0
+        while i < len(tasks) or pending:
+            while i < len(tasks) and len(pending) < MAX_IN_FLIGHT:
+                pending.append(do_read.remote(tasks[i]))
+                i += 1
+            ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=10.0)
+            for r in ready:
+                yield r
+        return
+    raise TypeError(f"Unknown source op: {src}")
+
+
+def _apply_op(stream: Iterator[Any], op: LogicalOp) -> Iterator[Any]:
+    if isinstance(op, AbstractMap):
+        if op.compute.kind == "actors":
+            return _map_stream_actors(stream, op)
+        return _map_stream_tasks(stream, op)
+    if isinstance(op, Limit):
+        return _limit_stream(stream, op.limit)
+    if isinstance(op, (Repartition, RandomShuffle, Sort, Aggregate)):
+        return _all_to_all(stream, op)
+    if isinstance(op, Union):
+        def union_stream():
+            yield from stream
+            for other in op.others:
+                yield from execute(other)
+
+        return union_stream()
+    raise TypeError(f"Unknown op: {op}")
+
+
+def _map_stream_tasks(stream: Iterator[Any], op: AbstractMap) -> Iterator[Any]:
+    transform = make_block_transform(op)
+
+    @ray_tpu.remote
+    def apply(block):
+        return transform(block)
+
+    pending: List[Any] = []
+    done = False
+    while not done or pending:
+        while not done and len(pending) < MAX_IN_FLIGHT:
+            try:
+                block_ref = next(stream)
+            except StopIteration:
+                done = True
+                break
+            pending.append(apply.remote(block_ref))
+        if pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=30.0)
+            for r in ready:
+                yield r
+
+
+def _map_stream_actors(stream: Iterator[Any], op: AbstractMap) -> Iterator[Any]:
+    pool = _ActorPool(op)
+    try:
+        pending: List[Any] = []
+        done = False
+        while not done or pending:
+            while not done and len(pending) < max(MAX_IN_FLIGHT, op.compute.pool_size):
+                try:
+                    block_ref = next(stream)
+                except StopIteration:
+                    done = True
+                    break
+                pending.append(pool.submit(block_ref))
+            if pending:
+                ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=60.0)
+                for r in ready:
+                    yield r
+    finally:
+        pool.shutdown()
+
+
+def _limit_stream(stream: Iterator[Any], limit: int) -> Iterator[Any]:
+    seen = 0
+    for ref in stream:
+        if seen >= limit:
+            return
+        block = ray_tpu.get(ref)
+        n = BlockAccessor(block).num_rows()
+        if seen + n <= limit:
+            seen += n
+            yield ref
+        else:
+            yield ray_tpu.put(BlockAccessor(block).slice(0, limit - seen))
+            seen = limit
+            return
+
+
+def _all_to_all(stream: Iterator[Any], op: LogicalOp) -> Iterator[Any]:
+    """Materializing ops (ref: planner/exchange/ shuffle)."""
+    blocks = [ray_tpu.get(r) for r in stream]
+    combined = concat_blocks(blocks)
+    acc = BlockAccessor(combined)
+    n = acc.num_rows()
+
+    if isinstance(op, Sort):
+        import pyarrow.compute as pc
+
+        idx = pc.sort_indices(
+            combined,
+            sort_keys=[(op.key, "descending" if op.descending else "ascending")])
+        combined = combined.take(idx)
+        yield ray_tpu.put(combined)
+        return
+    if isinstance(op, RandomShuffle):
+        rng = np.random.default_rng(op.seed)
+        perm = rng.permutation(n)
+        yield ray_tpu.put(acc.take(list(map(int, perm))))
+        return
+    if isinstance(op, Repartition):
+        k = max(1, op.num_blocks)
+        size = max(1, (n + k - 1) // k)
+        for start in range(0, n, size):
+            yield ray_tpu.put(acc.slice(start, min(start + size, n)))
+        return
+    if isinstance(op, Aggregate):
+        yield ray_tpu.put(_aggregate(combined, op))
+        return
+    raise TypeError(op)
+
+
+def _aggregate(block: Block, op: Aggregate) -> Block:
+    import pyarrow as pa
+
+    acc = BlockAccessor(block)
+    if op.key is None:
+        row: Dict[str, Any] = {}
+        for col, fn in op.aggs:
+            if col == "*":  # global row count
+                row[f"{fn}({col})"] = acc.num_rows()
+                continue
+            vals = block_mod.column_to_numpy(block, col)
+            row[f"{fn}({col})"] = _agg_fn(fn)(vals)
+        return block_from_rows([row])
+    tbl = block.group_by(op.key).aggregate([(c, _arrow_agg(f)) for c, f in op.aggs])
+    return tbl
+
+
+def _agg_fn(name: str):
+    return {"sum": np.sum, "min": np.min, "max": np.max, "mean": np.mean,
+            "count": len, "std": np.std}[name]
+
+
+def _arrow_agg(name: str) -> str:
+    return {"sum": "sum", "min": "min", "max": "max", "mean": "mean",
+            "count": "count", "std": "stddev"}[name]
