@@ -20,7 +20,14 @@ Batch = Union[Dict[str, np.ndarray], "pa.Table", Any]
 def block_from_rows(rows: List[Dict[str, Any]]) -> Block:
     if not rows:
         return pa.table({})
-    cols: Dict[str, list] = {k: [] for k in rows[0]}
+    # Schema = union of keys across ALL rows (first-seen order); rows missing
+    # a column contribute nulls.  Deriving it from rows[0] alone silently
+    # drops late-appearing columns.
+    cols: Dict[str, list] = {}
+    for row in rows:
+        for k in row:
+            if k not in cols:
+                cols[k] = []
     for row in rows:
         for k in cols:
             cols[k].append(row.get(k))

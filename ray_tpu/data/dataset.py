@@ -52,6 +52,11 @@ class Dataset:
         fn_constructor = None
         the_fn = fn
         if isinstance(fn, type):
+            if compute is not None and not isinstance(compute, ActorPoolStrategy):
+                raise ValueError(
+                    "map_batches with a callable class requires an actor pool "
+                    "(stateful fn); pass compute=ActorPoolStrategy(...) or omit "
+                    "compute (ref: dataset.py map_batches compute validation)")
             ctor_args = fn_constructor_args
 
             def fn_constructor():
@@ -267,37 +272,54 @@ class GroupedData:
 
 
 class _SplitCoordinator:
-    """Single execution shared by n DataIterators (backpressured queues).
+    """Shared execution behind n DataIterators (ref: StreamSplitDataIterator's
+    coordinator actor, _internal/iterator/stream_split_iterator.py:31).
+
+    One pump thread *per epoch*: each round of ``iter_batches`` calls across
+    the n consumers re-executes the plan, so multi-epoch training loops work
+    (the reference's DataIterator re-executes per epoch too).  Queues hold
+    object *refs* (data lives in the object store) and are unbounded so a
+    consumer that drains late — or not at all — can never wedge the pump and
+    starve its peers.
 
     equal=True deals row-slices so every consumer gets ~1/n of each block —
-    a one-block dataset still feeds all n trainers (the reference's
-    StreamSplitDataIterator guarantees balanced output for Train ingest).
+    a one-block dataset still feeds all n trainers (the reference guarantees
+    balanced output for Train ingest).
     """
 
     def __init__(self, ds: Dataset, n: int, equal: bool = True):
+        self.ds = ds
         self.n = n
         self.equal = equal
-        # Bounded for backpressure, but deep enough that a consumer lagging a
-        # few blocks behind (consumers are normally concurrent trainer
-        # workers) doesn't stall the shared pump.
-        self.queues: List["queue.Queue"] = [queue.Queue(maxsize=64) for _ in range(n)]
-        self._thread = threading.Thread(target=self._pump, args=(ds,), daemon=True)
-        self._started = False
         self._lock = threading.Lock()
+        self._epochs: Dict[int, dict] = {}
 
-    def ensure_started(self):
+    def queue_for(self, index: int, epoch: int) -> "queue.SimpleQueue":
         with self._lock:
-            if not self._started:
-                self._started = True
-                self._thread.start()
+            state = self._epochs.get(epoch)
+            if state is None:
+                queues = [queue.SimpleQueue() for _ in _builtin_range(self.n)]
+                state = {"queues": queues, "done": 0}
+                self._epochs[epoch] = state
+                threading.Thread(target=self._pump, args=(queues,), daemon=True,
+                                 name=f"split-pump-e{epoch}").start()
+            return state["queues"][index]
 
-    def _pump(self, ds: Dataset):
+    def finished(self, index: int, epoch: int) -> None:
+        with self._lock:
+            state = self._epochs.get(epoch)
+            if state is not None:
+                state["done"] += 1
+                if state["done"] >= self.n:
+                    del self._epochs[epoch]
+
+    def _pump(self, queues: List["queue.SimpleQueue"]):
         i = 0
         error: Optional[BaseException] = None
         try:
-            for ref in ds.iter_block_refs():
+            for ref in self.ds.iter_block_refs():
                 if not self.equal:
-                    self.queues[i % self.n].put(ref)
+                    queues[i % self.n].put(ref)
                     i += 1
                     continue
                 block = ray_tpu.get(ref)
@@ -312,14 +334,14 @@ class _SplitCoordinator:
                     if end > start:
                         # Rotate which consumer gets the (larger) head slice.
                         target = (c + i) % self.n
-                        self.queues[target].put(ray_tpu.put(acc.slice(start, end)))
+                        queues[target].put(ray_tpu.put(acc.slice(start, end)))
                 i += 1
         except BaseException as e:  # noqa: BLE001 — must reach the consumers
             error = e
         finally:
             # Execution errors propagate to every consumer rather than
             # silently truncating their streams.
-            for q in self.queues:
+            for q in queues:
                 q.put(error if error is not None else None)
 
 
@@ -327,27 +349,36 @@ _builtin_range = range
 
 
 class DataIterator:
-    """Per-consumer iterator from streaming_split (ref: data/iterator.py:59)."""
+    """Per-consumer iterator from streaming_split (ref: data/iterator.py:59).
+
+    Re-iterable: each ``iter_batches`` call consumes one fresh epoch of the
+    shared execution.
+    """
 
     def __init__(self, coordinator: _SplitCoordinator, index: int):
         self._coord = coordinator
         self._index = index
+        self._epoch = 0
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      batch_format: str = "numpy") -> Iterator[Any]:
         from ray_tpu.data.block import rebatch
 
-        self._coord.ensure_started()
-        q = self._coord.queues[self._index]
+        epoch = self._epoch
+        self._epoch += 1
+        q = self._coord.queue_for(self._index, epoch)
 
         def block_stream():
-            while True:
-                ref = q.get()
-                if ref is None:
-                    return
-                if isinstance(ref, BaseException):
-                    raise ref
-                yield ray_tpu.get(ref)
+            try:
+                while True:
+                    ref = q.get()
+                    if ref is None:
+                        return
+                    if isinstance(ref, BaseException):
+                        raise ref
+                    yield ray_tpu.get(ref)
+            finally:
+                self._coord.finished(self._index, epoch)
 
         yield from rebatch(block_stream(), batch_size, batch_format)
 

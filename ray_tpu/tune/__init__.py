@@ -1,0 +1,78 @@
+"""ray_tpu.tune — hyperparameter tuning (ref: python/ray/tune/).
+
+Surface: Tuner/TuneConfig/run, search-space constructors, searchers,
+ASHA/PBT/median-stopping schedulers, Trainable class + function APIs, and a
+``tune.report`` that shares the Train session plumbing (in the reference both
+route through ray.train's session since 2.x).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.train import checkpoint as _ckpt
+from ray_tpu.train.session import get_session
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    HyperOptStyleSearcher,
+    RandomSearch,
+    Searcher,
+)
+from ray_tpu.tune.search_space import (
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    qloguniform,
+    qrandint,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trainable import Trainable, with_parameters
+from ray_tpu.tune.trial import Trial
+from ray_tpu.tune.tuner import TuneConfig, Tuner, run
+
+Checkpoint = _ckpt.Checkpoint
+
+__all__ = [
+    "Tuner", "TuneConfig", "run", "Trainable", "with_parameters", "report",
+    "get_checkpoint", "Searcher", "BasicVariantGenerator", "RandomSearch",
+    "ConcurrencyLimiter", "HyperOptStyleSearcher", "TrialScheduler",
+    "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining", "ResultGrid", "Trial", "Checkpoint",
+    "uniform", "quniform", "loguniform", "qloguniform", "randn", "randint",
+    "qrandint", "lograndint", "choice", "sample_from", "grid_search",
+]
+
+
+def report(metrics: Optional[Dict[str, Any]] = None,
+           checkpoint: Optional[Checkpoint] = None, **kwargs: Any) -> None:
+    """Report metrics (+ optional checkpoint) from a function trainable.
+
+    Accepts both the modern ``tune.report({"loss": x})`` and the legacy
+    kwargs form ``tune.report(loss=x)`` (ref: tune's report in
+    train/_internal/session.py:672 and legacy tune/trainable/session.py).
+    """
+    merged = dict(metrics or {})
+    merged.update(kwargs)
+    session = get_session()
+    if session is None:
+        raise RuntimeError("tune.report() called outside a Tune trial")
+    session.report(merged, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    session = get_session()
+    return session.checkpoint_to_restore if session else None

@@ -1,0 +1,157 @@
+"""Tuner — the experiment entry point (ref: python/ray/tune/tuner.py:44
+Tuner, fit:344; tune/tune.py run for the legacy API)."""
+
+from __future__ import annotations
+
+import inspect
+import json
+import os
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Union
+
+import ray_tpu
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune.result_grid import ResultGrid
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.trainable import Trainable, wrap_function
+from ray_tpu.tune.tune_controller import TuneController
+
+
+@dataclass
+class TuneConfig:
+    """(ref: tune/tune_config.py TuneConfig)"""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    time_budget_s: Optional[float] = None
+    trial_resources: Dict[str, float] = field(default_factory=lambda: {"CPU": 1.0})
+
+
+class Tuner:
+    """(ref: tuner.py:44)"""
+
+    def __init__(
+        self,
+        trainable: Union[Callable, type],
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        tc = self.tune_config
+        name = self.run_config.name or f"tune_{int(time.time())}"
+        storage = self.run_config.storage_path or tempfile.mkdtemp(prefix="ray_tpu_tune_")
+        experiment_path = os.path.join(storage, name)
+        os.makedirs(experiment_path, exist_ok=True)
+
+        trainable_cls = self._as_trainable_cls(self.trainable)
+        # The trial actor is a lightweight controller; a Trainer inside a
+        # trial reserves its own worker placement group (ref: Tune trial for a
+        # Trainer requests the trainer's PG, workers request the rest).
+        resources = dict(tc.trial_resources)
+        searcher = tc.search_alg or BasicVariantGenerator(
+            self.param_space, num_samples=tc.num_samples)
+        searcher.set_search_properties(tc.metric, tc.mode, self.param_space)
+
+        controller = TuneController(
+            trainable_cls=trainable_cls,
+            searcher=searcher,
+            scheduler=tc.scheduler or FIFOScheduler(),
+            experiment_path=experiment_path,
+            experiment_name=name,
+            metric=tc.metric,
+            mode=tc.mode,
+            num_samples_hint=tc.num_samples,
+            max_concurrent_trials=tc.max_concurrent_trials,
+            max_failures=self.run_config.failure_config.max_failures,
+            trial_resources=resources,
+            time_budget_s=tc.time_budget_s,
+        )
+        trials = controller.run()
+        self._save_experiment_state(experiment_path, trials)
+        return ResultGrid(trials, tc.metric, tc.mode)
+
+    @staticmethod
+    def _as_trainable_cls(trainable) -> type:
+        if inspect.isclass(trainable) and issubclass(trainable, Trainable):
+            return trainable
+        if callable(trainable):
+            return wrap_function(trainable)
+        raise TypeError(f"Not a trainable: {trainable!r}")
+
+    def _save_experiment_state(self, experiment_path: str, trials) -> None:
+        """Experiment snapshot for post-hoc analysis
+        (ref: tune/execution/experiment_state.py checkpoints)."""
+        state = {
+            "timestamp": time.time(),
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "status": t.status,
+                    "config": _json_safe(t.config),
+                    "last_result": _json_safe(t.last_result or {}),
+                    "logdir": t.logdir,
+                    "checkpoint": t.checkpoint_path,
+                    "error": repr(t.error) if t.error else None,
+                }
+                for t in trials
+            ],
+        }
+        with open(os.path.join(experiment_path, "experiment_state.json"), "w") as f:
+            json.dump(state, f, indent=1)
+
+
+def run(trainable, *, config: Optional[Dict[str, Any]] = None,
+        metric: Optional[str] = None, mode: str = "max", num_samples: int = 1,
+        stop: Optional[Dict[str, Any]] = None, search_alg=None, scheduler=None,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        max_concurrent_trials: Optional[int] = None,
+        time_budget_s: Optional[float] = None,
+        storage_path: Optional[str] = None, name: Optional[str] = None,
+        max_failures: int = 0, verbose: int = 0) -> ResultGrid:
+    """Legacy entry point (ref: tune/tune.py run)."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    name = name or f"tune_{int(time.time())}"
+    storage = storage_path or tempfile.mkdtemp(prefix="ray_tpu_tune_")
+    experiment_path = os.path.join(storage, name)
+    os.makedirs(experiment_path, exist_ok=True)
+    trainable_cls = Tuner._as_trainable_cls(trainable)
+    searcher = search_alg or BasicVariantGenerator(config or {}, num_samples=num_samples)
+    searcher.set_search_properties(metric, mode, config or {})
+    controller = TuneController(
+        trainable_cls=trainable_cls, searcher=searcher,
+        scheduler=scheduler or FIFOScheduler(),
+        experiment_path=experiment_path, experiment_name=name,
+        metric=metric, mode=mode, stop=stop,
+        max_concurrent_trials=max_concurrent_trials, max_failures=max_failures,
+        trial_resources=resources_per_trial or {"CPU": 1.0},
+        time_budget_s=time_budget_s)
+    trials = controller.run()
+    return ResultGrid(trials, metric, mode)
+
+
+def _json_safe(d: Dict[str, Any]) -> Dict[str, Any]:
+    out = {}
+    for k, v in (d or {}).items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except TypeError:
+            out[k] = repr(v)
+    return out

@@ -174,3 +174,42 @@ def test_dataset_with_train_ingest(ray_start_regular):
                         datasets={"train": ds}).fit()
     assert result.error is None
     assert result.metrics["count"] > 0
+
+
+# ------------------------- regression tests (round-1 code review findings) ---
+
+def test_streaming_split_multi_epoch(ray_start_regular):
+    """A DataIterator must be re-iterable: one epoch per iter_batches call."""
+    its = data.range(32).streaming_split(2)
+    for epoch in range(3):
+        n0 = sum(len(b["id"]) for b in its[0].iter_batches(batch_size=4))
+        n1 = sum(len(b["id"]) for b in its[1].iter_batches(batch_size=4))
+        assert n0 + n1 == 32, f"epoch {epoch} lost rows"
+
+
+def test_streaming_split_sequential_consumption(ray_start_regular):
+    """Draining consumer 0 fully before touching consumer 1 must not deadlock
+    (regression: bounded shared-pump queues wedged on the undrained peer)."""
+    its = data.range(2000).repartition(200).streaming_split(2)
+    n0 = sum(len(b["id"]) for b in its[0].iter_batches(batch_size=100))
+    n1 = sum(len(b["id"]) for b in its[1].iter_batches(batch_size=100))
+    assert n0 == n1 == 1000
+
+
+def test_from_items_heterogeneous_keys(ray_start_regular):
+    """Late-appearing columns must not be dropped (union schema + nulls)."""
+    rows = data.from_items([{"a": 1}, {"a": 2, "b": 3}]).take_all()
+    assert rows[1]["b"] == 3
+    missing = rows[0]["b"]
+    assert missing is None or (isinstance(missing, float) and np.isnan(missing))
+
+
+def test_map_batches_class_requires_actor_pool(ray_start_regular):
+    from ray_tpu.data.plan import ComputeStrategy
+
+    class Doubler:
+        def __call__(self, batch):
+            return {"id": batch["id"] * 2}
+
+    with pytest.raises(ValueError, match="actor pool"):
+        data.range(8).map_batches(Doubler, compute=ComputeStrategy())

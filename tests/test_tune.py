@@ -1,0 +1,176 @@
+"""Tune tests (model: python/ray/tune/tests/ — test_tune_restore.py,
+test_trial_scheduler.py, test_searchers.py patterns)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import ASHAScheduler, PopulationBasedTraining
+from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter
+from ray_tpu.tune.search_space import expand_grid, resolve
+
+
+# ---------------------------------------------------------------- search space
+
+def test_grid_expansion():
+    space = {"a": tune.grid_search([1, 2, 3]), "b": tune.grid_search(["x", "y"]),
+             "c": 7}
+    variants = expand_grid(space)
+    assert len(variants) == 6
+    assert all(v["c"] == 7 for v in variants)
+
+
+def test_domain_sampling():
+    import random
+
+    rng = random.Random(0)
+    space = {"lr": tune.loguniform(1e-5, 1e-1), "bs": tune.choice([16, 32]),
+             "n": tune.randint(1, 10)}
+    cfg = resolve(space, rng)
+    assert 1e-5 <= cfg["lr"] <= 1e-1
+    assert cfg["bs"] in (16, 32)
+    assert 1 <= cfg["n"] <= 10
+
+
+def test_basic_variant_counts():
+    gen = BasicVariantGenerator({"a": tune.grid_search([1, 2])}, num_samples=3)
+    configs = []
+    while True:
+        c = gen.suggest(f"t{len(configs)}")
+        if c is None:
+            break
+        configs.append(c)
+    assert len(configs) == 6
+
+
+def test_concurrency_limiter_backpressure():
+    gen = ConcurrencyLimiter(BasicVariantGenerator({"a": 1}, num_samples=5),
+                             max_concurrent=2)
+    c1 = gen.suggest("t1")
+    c2 = gen.suggest("t2")
+    assert isinstance(c1, dict) and isinstance(c2, dict)
+    assert gen.suggest("t3") == "PENDING"
+    gen.on_trial_complete("t1", {"score": 1})
+    assert isinstance(gen.suggest("t3"), dict)
+
+
+# ---------------------------------------------------------------- end-to-end
+
+def _objective(config):
+    score = -((config["x"] - 3.0) ** 2)
+    for i in range(3):
+        tune.report({"score": score + i * 0.01})
+
+
+def test_tuner_function_api(ray_start_regular):
+    tuner = tune.Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([0.0, 1.0, 3.0, 5.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    best = grid.get_best_result()
+    assert best.metrics["config"]["x"] == 3.0
+    assert grid.num_errors == 0
+
+
+def test_tuner_kwargs_report_and_stop(ray_start_regular):
+    def fn(config):
+        for i in range(100):
+            tune.report(value=i)
+
+    grid = tune.run(fn, config={}, metric="value", mode="max",
+                    stop={"value": 5}, num_samples=1)
+    best = grid.get_best_result()
+    assert best.metrics["value"] == 5  # stopped at the bound, not 99
+
+
+class _Quad(tune.Trainable):
+    def setup(self, config):
+        self.x = config["x"]
+        self.state = 0
+
+    def step(self):
+        self.state += 1
+        return {"score": -(self.x - 2.0) ** 2, "state": self.state}
+
+    def save_checkpoint(self, d):
+        return {"state": self.state}
+
+    def load_checkpoint(self, data, d):
+        self.state = data["state"]
+
+
+def test_tuner_class_api(ray_start_regular):
+    grid = tune.run(_Quad, config={"x": tune.grid_search([0.0, 2.0])},
+                    metric="score", mode="max", stop={"training_iteration": 4})
+    best = grid.get_best_result()
+    assert best.metrics["config"]["x"] == 2.0
+    assert best.metrics["training_iteration"] == 4
+
+
+def test_trial_errors_surface(ray_start_regular):
+    def bad(config):
+        raise ValueError("boom")
+
+    grid = tune.run(bad, config={}, metric="m", mode="max", num_samples=2)
+    assert grid.num_errors == 2
+    assert all("boom" in repr(e) for e in grid.errors)
+
+
+def test_asha_stops_bad_trials(ray_start_regular):
+    def fn(config):
+        for i in range(20):
+            tune.report({"score": config["quality"] * (i + 1)})
+
+    sched = ASHAScheduler(time_attr="training_iteration", max_t=20,
+                          grace_period=2, reduction_factor=2)
+    grid = tune.run(fn, config={"quality": tune.grid_search([0.1, 0.2, 1.0, 2.0])},
+                    metric="score", mode="max", scheduler=sched,
+                    max_concurrent_trials=4)
+    results = {r.metrics["config"]["quality"]: r.metrics["training_iteration"]
+               for r in grid}
+    # The best trial must run to completion; at least one poor one cut early.
+    assert results[2.0] == 20
+    assert min(results.values()) < 20
+
+
+def test_pbt_exploits(ray_start_regular):
+    def fn(config):
+        lr = config["lr"]
+        score = 0.0
+        ckpt = tune.get_checkpoint()
+        if ckpt:
+            score = float(open(os.path.join(ckpt.path, "s.txt")).read())
+        for _ in range(12):
+            score += lr  # higher lr learns faster in this toy
+            import tempfile
+
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "s.txt"), "w") as f:
+                f.write(str(score))
+            tune.report({"score": score},
+                        checkpoint=tune.Checkpoint.from_directory(d))
+
+    sched = PopulationBasedTraining(
+        time_attr="training_iteration", perturbation_interval=3,
+        hyperparam_mutations={"lr": tune.uniform(0.1, 10.0)}, seed=0)
+    grid = tune.run(fn, config={"lr": tune.grid_search([0.1, 0.2, 5.0, 8.0])},
+                    metric="score", mode="max", scheduler=sched,
+                    max_concurrent_trials=4)
+    assert grid.num_errors == 0
+    # Every trial finished its 12 reports (clones included).
+    assert grid.num_terminated == 4
+
+
+def test_experiment_state_written(ray_start_regular, tmp_path):
+    from ray_tpu.train.config import RunConfig
+
+    tuner = tune.Tuner(_objective, param_space={"x": 1.0},
+                       tune_config=tune.TuneConfig(metric="score", mode="max"),
+                       run_config=RunConfig(name="exp", storage_path=str(tmp_path)))
+    tuner.fit()
+    assert (tmp_path / "exp" / "experiment_state.json").exists()
