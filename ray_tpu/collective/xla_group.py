@@ -161,7 +161,7 @@ class XLACollectiveGroup:
             key = ("allreduce", op, inputs[0].shape, str(inputs[0].dtype))
 
             def build():
-                from jax.experimental.shard_map import shard_map
+                from jax import shard_map
                 from jax.sharding import PartitionSpec as P
 
                 def body(x):

@@ -1,0 +1,24 @@
+"""ray_tpu.rl — reinforcement learning library (new-API-stack shape).
+
+Counterpart of the reference's RLlib (ref: rllib/ — Algorithm on Tune's
+Trainable, EnvRunnerGroup sampling, LearnerGroup updates), with the neural
+path pure-JAX: RLModules are param pytrees + jitted forwards, learner updates
+are single jitted steps, multi-learner gradient sync is a compiled ICI
+allreduce instead of torch DDP.
+"""
+
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.core.learner import JaxLearner
+from ray_tpu.rl.core.learner_group import LearnerGroup
+from ray_tpu.rl.core.rl_module import (Columns, DefaultActorCritic,
+                                       DefaultQModule, RLModule, RLModuleSpec)
+from ray_tpu.rl.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rl.env.env_runner_group import EnvRunnerGroup
+from ray_tpu.rl.env.episode import SingleAgentEpisode
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "JaxLearner", "LearnerGroup", "Columns",
+    "DefaultActorCritic", "DefaultQModule", "RLModule", "RLModuleSpec",
+    "SingleAgentEnvRunner", "EnvRunnerGroup", "SingleAgentEpisode",
+]

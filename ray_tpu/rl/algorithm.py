@@ -1,0 +1,148 @@
+"""Algorithm — the RL control loop, a Tune Trainable.
+
+(ref: rllib/algorithms/algorithm.py:227 Algorithm(Trainable) — step:973 calls
+training_step:1780; sampling via EnvRunnerGroup fan-out, learning via
+LearnerGroup, weight sync back to runners; save/restore through the
+Checkpointable contract.)
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.connectors import ConnectorPipeline, batch_episodes, strip_internal
+from ray_tpu.rl.core.learner_group import LearnerGroup
+from ray_tpu.rl.env.env_runner_group import EnvRunnerGroup
+from ray_tpu.rl.utils.metrics import MetricsLogger
+from ray_tpu.tune.trainable import Trainable
+
+ENV_RUNNER_RESULTS = "env_runners"
+LEARNER_RESULTS = "learners"
+EPISODE_RETURN_MEAN = "episode_return_mean"
+NUM_ENV_STEPS_SAMPLED_LIFETIME = "num_env_steps_sampled_lifetime"
+
+
+class Algorithm(Trainable):
+    """Base algorithm; subclasses bind a learner class + connector pipeline."""
+
+    learner_class: type = None
+    config_class = AlgorithmConfig
+
+    # -------------------------------------------------------------- setup
+    def setup(self, config: Dict[str, Any]) -> None:
+        if isinstance(config, AlgorithmConfig):
+            cfg = config
+        else:
+            cfg = getattr(type(self), "config_class")()
+            base = config.pop("_base_config", None)
+            if base is not None:
+                cfg = base.copy()
+            cfg.update_from_dict(config)
+        self.algo_config = cfg
+        self.module_spec = cfg.module_spec()
+        self.metrics = MetricsLogger()
+        self.env_runner_group = EnvRunnerGroup(
+            env=cfg.env, env_config=cfg.env_config,
+            module_spec=self.module_spec,
+            num_env_runners=cfg.num_env_runners,
+            num_envs_per_env_runner=cfg.num_envs_per_env_runner,
+            rollout_fragment_length=cfg.rollout_fragment_length,
+            explore=cfg.explore, seed=cfg.seed)
+        self.learner_group = LearnerGroup(
+            learner_class=type(self).learner_class, config=cfg,
+            module_spec=self.module_spec, num_learners=cfg.num_learners,
+            seed=cfg.seed)
+        self.learner_connector = self.build_learner_connector()
+        self._lifetime_steps = 0
+        # Initial weight alignment: runners start from learner params.
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def build_learner_connector(self) -> ConnectorPipeline:
+        return ConnectorPipeline([batch_episodes])
+
+    # --------------------------------------------------------------- step
+    def step(self) -> Dict[str, Any]:
+        t0 = time.time()
+        result = self.training_step()
+        for runner_metrics in self.env_runner_group.get_metrics():
+            if runner_metrics.get("num_episodes", 0) > 0:
+                self.metrics.log_dict(runner_metrics, key=ENV_RUNNER_RESULTS,
+                                      window=20)
+        env_results = self.metrics.reduce(ENV_RUNNER_RESULTS)
+        result.setdefault(ENV_RUNNER_RESULTS, {}).update(env_results)
+        result[NUM_ENV_STEPS_SAMPLED_LIFETIME] = self._lifetime_steps
+        # Flat convenience mirror used by Tune metric= strings.
+        if EPISODE_RETURN_MEAN in env_results:
+            result[EPISODE_RETURN_MEAN] = env_results[EPISODE_RETURN_MEAN]
+        result["time_this_iter_s"] = time.time() - t0
+        cfg = self.algo_config
+        if cfg.evaluation_interval and self.iteration % cfg.evaluation_interval == 0:
+            result["evaluation"] = self.evaluate()
+        return result
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # ---------------------------------------------------------- evaluation
+    def evaluate(self) -> Dict[str, Any]:
+        """Greedy-policy evaluation episodes (ref: algorithm.py evaluate())."""
+        cfg = self.algo_config
+        episodes = []
+        if self.env_runner_group._local_runner is not None:
+            episodes = self.env_runner_group._local_runner.sample(
+                num_episodes=cfg.evaluation_duration, explore=False)
+        else:
+            import ray_tpu
+
+            runners = self.env_runner_group.runners
+            per = max(1, cfg.evaluation_duration // len(runners))
+            for chunk in ray_tpu.get([r.sample.remote(num_episodes=per,
+                                                      explore=False)
+                                      for r in runners]):
+                episodes.extend(chunk)
+        returns = [ep.total_return for ep in episodes if ep.is_done]
+        if not returns:
+            return {}
+        return {ENV_RUNNER_RESULTS: {
+            EPISODE_RETURN_MEAN: float(np.mean(returns)),
+            "num_episodes": len(returns),
+        }}
+
+    # -------------------------------------------------------- checkpointing
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict]:
+        state = {
+            "learner": self.learner_group.get_state(),
+            "lifetime_steps": self._lifetime_steps,
+        }
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        return None
+
+    def load_checkpoint(self, data, checkpoint_dir: str) -> None:
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_state(state["learner"])
+        self._lifetime_steps = state.get("lifetime_steps", 0)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def cleanup(self) -> None:
+        self.env_runner_group.stop()
+        self.learner_group.stop()
+
+    # ------------------------------------------------------------- helpers
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def _sample_batch(self, random_actions: bool = False):
+        cfg = self.algo_config
+        episodes = self.env_runner_group.sample(
+            num_timesteps=cfg.train_batch_size, random_actions=random_actions)
+        self._lifetime_steps += sum(len(ep) for ep in episodes)
+        return episodes

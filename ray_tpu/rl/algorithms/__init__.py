@@ -1,0 +1,6 @@
+from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, DQNLearner
+from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig, IMPALALearner
+from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig, PPOLearner
+
+__all__ = ["PPO", "PPOConfig", "PPOLearner", "DQN", "DQNConfig", "DQNLearner",
+           "IMPALA", "IMPALAConfig", "IMPALALearner"]

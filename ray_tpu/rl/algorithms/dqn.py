@@ -1,0 +1,130 @@
+"""DQN — double-Q with target network and (prioritized) replay.
+
+(ref: rllib/algorithms/dqn/dqn.py DQNConfig/DQN; loss in
+rllib/algorithms/dqn/torch/dqn_torch_learner.py — double-Q TD target,
+Huber loss; target net sync every target_network_update_freq steps.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.connectors import episodes_to_transitions
+from ray_tpu.rl.core.learner import JaxLearner
+from ray_tpu.rl.core.rl_module import Columns, DefaultQModule
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DQN)
+        self.module_class = DefaultQModule
+        self.lr = 5e-4
+        self.train_batch_size = 32
+        self.num_epochs = 1
+        self.minibatch_size = None
+        self.rollout_fragment_length = 4
+        self.replay_buffer_capacity = 50_000
+        self.prioritized_replay = False
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.target_network_update_freq = 500  # in learner update steps
+        self.n_step = 1
+        self.double_q = True
+        self.epsilon = [(0, 1.0), (10000, 0.05)]  # piecewise-linear schedule
+        self.tau = 1.0  # 1.0 = hard target sync
+
+
+class DQNLearner(JaxLearner):
+    def compute_loss(self, params, batch: Dict[str, Any], key) -> Tuple[Any, Dict]:
+        cfg = self.config
+        q_all = self.module.forward_train(params, batch[Columns.OBS])["q_values"]
+        q_taken = jnp.take_along_axis(
+            q_all, batch[Columns.ACTIONS][..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+
+        q_next_target = self.module.forward_target(params, batch[Columns.NEXT_OBS])
+        if cfg.double_q:
+            # Online net picks the argmax; target net evaluates it.
+            q_next_online = self.module.forward_train(
+                params, batch[Columns.NEXT_OBS])["q_values"]
+            best = jnp.argmax(q_next_online, axis=-1)
+            q_next = jnp.take_along_axis(q_next_target, best[..., None], axis=-1)[..., 0]
+        else:
+            q_next = jnp.max(q_next_target, axis=-1)
+        q_next = jax.lax.stop_gradient(q_next)
+        target = (batch[Columns.REWARDS]
+                  + (cfg.gamma ** cfg.n_step) * (1.0 - batch[Columns.TERMINATEDS])
+                  * q_next)
+        # The target net must not receive gradients through its pytree copy.
+        td = q_taken - jax.lax.stop_gradient(target)
+        huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2, jnp.abs(td) - 0.5)
+        weights = batch.get(Columns.WEIGHTS)
+        loss = jnp.mean(huber * weights) if weights is not None else jnp.mean(huber)
+        return loss, {"td_error_mean": jnp.mean(jnp.abs(td)),
+                      "q_mean": jnp.mean(q_taken)}
+
+    def after_update(self, metrics: Dict[str, Any]) -> None:
+        cfg = self.config
+        if self._steps % max(1, cfg.target_network_update_freq) == 0:
+            tau = cfg.tau
+            self.params = dict(self.params)
+            if tau >= 1.0:
+                self.params["target_q"] = jax.tree.map(jnp.copy, self.params["q"])
+            else:
+                self.params["target_q"] = jax.tree.map(
+                    lambda t, o: (1 - tau) * t + tau * o,
+                    self.params["target_q"], self.params["q"])
+
+
+class DQN(Algorithm):
+    learner_class = DQNLearner
+    config_class = DQNConfig
+
+    def setup(self, config) -> None:
+        super().setup(config)
+        cfg = self.algo_config
+        from ray_tpu.rl.utils.replay_buffers import (PrioritizedReplayBuffer,
+                                                     ReplayBuffer)
+
+        self.replay = (PrioritizedReplayBuffer(cfg.replay_buffer_capacity,
+                                               seed=cfg.seed)
+                       if cfg.prioritized_replay
+                       else ReplayBuffer(cfg.replay_buffer_capacity,
+                                         seed=cfg.seed))
+
+    def _epsilon(self) -> float:
+        sched = self.algo_config.epsilon
+        t = self._lifetime_steps
+        (t0, e0), (t1, e1) = sched[0], sched[-1]
+        if t <= t0:
+            return e0
+        if t >= t1:
+            return e1
+        return e0 + (e1 - e0) * (t - t0) / (t1 - t0)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        warmup = (self._lifetime_steps
+                  < cfg.num_steps_sampled_before_learning_starts)
+        # Epsilon-greedy: with prob eps sample random actions for the whole
+        # fragment (fragments are short — 4 steps default).
+        explore_random = warmup or (np.random.random() < self._epsilon())
+        episodes = self.env_runner_group.sample(
+            num_timesteps=max(cfg.rollout_fragment_length,
+                              cfg.train_batch_size if warmup else 0)
+            or cfg.rollout_fragment_length,
+            random_actions=explore_random)
+        self._lifetime_steps += sum(len(ep) for ep in episodes)
+        self.replay.add(episodes_to_transitions(episodes))
+        if warmup or len(self.replay) < cfg.train_batch_size:
+            return {"learners": {}, "epsilon": self._epsilon()}
+        batch = self.replay.sample(cfg.train_batch_size)
+        learner_results = self.learner_group.update_from_batch(batch)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return {"learners": learner_results, "epsilon": self._epsilon(),
+                "replay_size": len(self.replay)}

@@ -1,0 +1,197 @@
+"""IMPALA — async actor-learner with V-trace off-policy correction.
+
+(ref: rllib/algorithms/impala/impala.py:135-197 — async sample fan-out with
+in-flight request tracking + AggregatorActors; V-trace loss in
+rllib/algorithms/impala/torch/impala_torch_learner.py, vtrace math in
+rllib/algorithms/impala/torch/vtrace_torch.py; Espeholt et al. 2018.)
+
+The env runners sample continuously (one in-flight request each); the driver
+drains whichever finish first (`wait`), aggregates fragments into train
+batches, and updates the learner while the next samples are already running —
+behavior-policy logps ride along for the V-trace correction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.core.learner import JaxLearner
+from ray_tpu.rl.core.rl_module import Columns
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or IMPALA)
+        self.lr = 5e-4
+        self.vtrace_clip_rho_threshold = 1.0
+        self.vtrace_clip_pg_rho_threshold = 1.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.rollout_fragment_length = 50
+        self.train_batch_size = 500
+        self.num_epochs = 1
+        self.minibatch_size = None
+        self.broadcast_interval = 1  # weight sync every N updates
+        self.max_requests_in_flight_per_env_runner = 2
+
+
+def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
+           discounts, clip_rho: float = 1.0, clip_pg_rho: float = 1.0):
+    """V-trace targets over one trajectory (T,) — lax.scan from the tail
+    (ref: vtrace_torch.py multi_from_logits, single-agent form)."""
+    rhos = jnp.exp(target_logp - behavior_logp)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    cs = jnp.minimum(1.0, rhos)
+    values_next = jnp.concatenate([values[1:], bootstrap_value[None]])
+    deltas = clipped_rhos * (rewards + discounts * values_next - values)
+
+    def backward(acc, t):
+        acc = deltas[t] + discounts[t] * cs[t] * acc
+        return acc, acc
+
+    T = rewards.shape[0]
+    _, vs_minus_v = jax.lax.scan(backward, jnp.zeros(()), jnp.arange(T - 1, -1, -1))
+    vs_minus_v = vs_minus_v[::-1]
+    vs = values + vs_minus_v
+    vs_next = jnp.concatenate([vs[1:], bootstrap_value[None]])
+    pg_rhos = jnp.minimum(clip_pg_rho, rhos)
+    pg_advantages = pg_rhos * (rewards + discounts * vs_next - values)
+    return vs, pg_advantages
+
+
+class IMPALALearner(JaxLearner):
+    def compute_loss(self, params, batch: Dict[str, Any], key) -> Tuple[Any, Dict]:
+        cfg = self.config
+        out = self.module.forward_train(params, batch[Columns.OBS])
+        dist = self.module.action_dist
+        inputs = out[Columns.ACTION_DIST_INPUTS]
+        target_logp = dist.logp(inputs, batch[Columns.ACTIONS])
+        values = out[Columns.VF_PREDS]
+
+        # vmapped over the fragment axis: batch comes in as (B, T, ...).
+        vs, pg_adv = jax.vmap(
+            lambda blp, tlp, r, v, bv, d: vtrace(
+                blp, tlp, r, v, bv, d,
+                cfg.vtrace_clip_rho_threshold,
+                cfg.vtrace_clip_pg_rho_threshold)
+        )(batch[Columns.ACTION_LOGP], target_logp, batch[Columns.REWARDS],
+          values, batch["bootstrap_value"], batch["discounts"])
+
+        vs = jax.lax.stop_gradient(vs)
+        pg_adv = jax.lax.stop_gradient(pg_adv)
+        policy_loss = -jnp.mean(target_logp * pg_adv)
+        value_loss = 0.5 * jnp.mean(jnp.square(values - vs))
+        entropy = jnp.mean(dist.entropy(inputs))
+        total = (policy_loss + cfg.vf_loss_coeff * value_loss
+                 - cfg.entropy_coeff * entropy)
+        return total, {"policy_loss": policy_loss, "vf_loss": value_loss,
+                       "entropy": entropy}
+
+
+class IMPALA(Algorithm):
+    learner_class = IMPALALearner
+    config_class = IMPALAConfig
+
+    def setup(self, config) -> None:
+        super().setup(config)
+        self._inflight: Dict[Any, Any] = {}  # ref -> runner
+        self._updates = 0
+
+    def _batch_from_episodes(self, episodes) -> Dict[str, np.ndarray]:
+        """Pad fragments to (B, T) for the vmapped V-trace."""
+        cfg = self.algo_config
+        T = cfg.rollout_fragment_length
+        cols: Dict[str, List] = {k: [] for k in
+                                 (Columns.OBS, Columns.ACTIONS, Columns.REWARDS,
+                                  Columns.ACTION_LOGP, "discounts",
+                                  "bootstrap_obs", "bootstrap_terminated")}
+        for ep in episodes:
+            arr = ep.to_numpy()
+            t = len(ep)
+            if t == 0:
+                continue
+            pad = T - t if t < T else 0
+
+            def padded(x, value=0.0):
+                x = x[:T]
+                if pad:
+                    x = np.concatenate([x, np.full((pad, *x.shape[1:]), value,
+                                                   x.dtype)])
+                return x
+
+            cols[Columns.OBS].append(padded(arr["obs"][:-1]))
+            cols[Columns.ACTIONS].append(padded(arr["actions"]))
+            cols[Columns.REWARDS].append(padded(arr["rewards"]))
+            cols[Columns.ACTION_LOGP].append(padded(arr[Columns.ACTION_LOGP]))
+            disc = np.full(min(t, T), self.algo_config.gamma, np.float32)
+            if ep.is_terminated and t <= T:
+                disc[t - 1] = 0.0
+            cols["discounts"].append(padded(disc) if pad else disc)
+            cols["bootstrap_obs"].append(arr["obs"][min(t, T)])
+            cols["bootstrap_terminated"].append(
+                1.0 if (ep.is_terminated and t <= T) else 0.0)
+        batch = {k: np.stack(v).astype(np.float32) if k != Columns.ACTIONS
+                 else np.stack(v)
+                 for k, v in cols.items()}
+        return batch
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        runners = self.env_runner_group.runners
+        if not runners:
+            # Synchronous fallback (num_env_runners=0): plain on-policy step.
+            episodes = self._sample_batch()
+            return {"learners": self._learn(episodes)}
+
+        # Keep every runner saturated with in-flight sample requests.
+        per = max(cfg.rollout_fragment_length,
+                  cfg.train_batch_size // len(runners))
+        for r in runners:
+            inflight_for_r = sum(1 for v in self._inflight.values() if v is r)
+            while inflight_for_r < cfg.max_requests_in_flight_per_env_runner:
+                self._inflight[r.sample.remote(num_timesteps=per)] = r
+                inflight_for_r += 1
+
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                timeout=30.0)
+        episodes = []
+        for ref in ready:
+            self._inflight.pop(ref, None)
+            episodes.extend(ray_tpu.get(ref))
+        self._lifetime_steps += sum(len(ep) for ep in episodes)
+        return {"learners": self._learn(episodes),
+                "num_inflight_requests": len(self._inflight)}
+
+    def _learn(self, episodes) -> Dict[str, Any]:
+        cfg = self.algo_config
+        episodes = [ep for ep in episodes if len(ep) > 0]
+        if not episodes:
+            return {}
+        batch = self._batch_from_episodes(episodes)
+        # Bootstrap values from current params (host-side, jitted).
+        if self.learner_group._local is not None:
+            learner = self.learner_group._local
+            params = learner.params
+            module = learner.module
+        else:
+            params = self.learner_group.get_weights()
+            module = self.module_spec.build()
+        if not hasattr(self, "_vf_fn"):
+            self._vf_fn = jax.jit(
+                lambda p, o: module.forward_train(p, o)[Columns.VF_PREDS])
+        bv = np.asarray(self._vf_fn(params, batch.pop("bootstrap_obs")))
+        batch["bootstrap_value"] = (bv * (1.0 - batch.pop("bootstrap_terminated"))
+                                    ).astype(np.float32)
+        results = self.learner_group.update_from_batch(
+            batch, num_epochs=cfg.num_epochs)
+        self._updates += 1
+        if self._updates % cfg.broadcast_interval == 0:
+            self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return results

@@ -1,0 +1,124 @@
+"""PPO — Proximal Policy Optimization, new-API-stack shape.
+
+(ref: rllib/algorithms/ppo/ppo.py PPOConfig/PPO; loss in
+rllib/algorithms/ppo/torch/ppo_torch_learner.py — clipped surrogate +
+clipped value loss + entropy bonus; north-star workload
+tuned_examples/ppo/cartpole_ppo.py reaching default_reward=450.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.connectors import (ConnectorPipeline, GeneralAdvantageEstimation,
+                                   batch_episodes, strip_internal)
+from ray_tpu.rl.core.learner import JaxLearner
+from ray_tpu.rl.core.rl_module import Columns
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or PPO)
+        self.lr = 3e-4
+        self.clip_param = 0.3
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 1.0
+        self.entropy_coeff = 0.0
+        self.kl_coeff = 0.2
+        self.kl_target = 0.01
+        self.use_gae = True
+        self.lambda_ = 0.95
+        self.num_epochs = 6
+        self.minibatch_size = 128
+        self.train_batch_size = 4000
+        self.normalize_advantages = True
+
+
+class PPOLearner(JaxLearner):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+
+        def vf(params, obs):
+            return self.module.forward_train(params, obs)[Columns.VF_PREDS]
+
+        self.vf_fn = jax.jit(vf)
+
+    def compute_loss(self, params, batch: Dict[str, Any], key) -> Tuple[Any, Dict]:
+        cfg = self.config
+        out = self.module.forward_train(params, batch[Columns.OBS])
+        dist = self.module.action_dist
+        inputs = out[Columns.ACTION_DIST_INPUTS]
+        logp = dist.logp(inputs, batch[Columns.ACTIONS])
+        logp_ratio = jnp.exp(logp - batch[Columns.ACTION_LOGP])
+        advantages = batch[Columns.ADVANTAGES]
+
+        surrogate = jnp.minimum(
+            advantages * logp_ratio,
+            advantages * jnp.clip(logp_ratio, 1 - cfg.clip_param,
+                                  1 + cfg.clip_param))
+        policy_loss = -jnp.mean(surrogate)
+
+        vf_preds = out[Columns.VF_PREDS]
+        vf_targets = batch[Columns.VALUE_TARGETS]
+        vf_loss = jnp.square(vf_preds - vf_targets)
+        vf_loss_clipped = jnp.clip(vf_loss, 0, cfg.vf_clip_param)
+        value_loss = jnp.mean(vf_loss_clipped)
+
+        entropy = jnp.mean(dist.entropy(inputs))
+        # Approx KL(old || new) for monitoring (ref: ppo_torch_learner.py
+        # mean_kl_loss); the clip objective does the trust-region work.
+        kl = jnp.mean(batch[Columns.ACTION_LOGP] - logp)
+
+        total = (policy_loss + cfg.vf_loss_coeff * value_loss
+                 - cfg.entropy_coeff * entropy)
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": value_loss,
+            "entropy": entropy,
+            "mean_kl": kl,
+        }
+
+
+class PPO(Algorithm):
+    learner_class = PPOLearner
+    config_class = PPOConfig
+
+    def build_learner_connector(self) -> ConnectorPipeline:
+        cfg = self.algo_config
+        return ConnectorPipeline([
+            batch_episodes,
+            GeneralAdvantageEstimation(
+                gamma=cfg.gamma, lambda_=cfg.lambda_,
+                normalize_advantages=cfg.normalize_advantages),
+            strip_internal,
+        ])
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        episodes = self._sample_batch()
+        # GAE uses current learner params; local learner exposes vf_fn
+        # directly, remote groups bootstrap with learner-0 params through the
+        # same jitted fn built on the driver's module copy.
+        if self.learner_group._local is not None:
+            vf_fn = self.learner_group._local.vf_fn
+            params = self.learner_group._local.params
+        else:
+            if not hasattr(self, "_driver_vf"):
+                module = self.module_spec.build()
+
+                def vf(params, obs):
+                    return module.forward_train(params, obs)[Columns.VF_PREDS]
+
+                self._driver_vf = jax.jit(vf)
+            vf_fn = self._driver_vf
+            params = self.learner_group.get_weights()
+        batch = self.learner_connector({}, episodes, params=params, vf_fn=vf_fn)
+        learner_results = self.learner_group.update_from_batch(
+            batch, num_epochs=cfg.num_epochs, minibatch_size=cfg.minibatch_size)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return {"learners": learner_results}

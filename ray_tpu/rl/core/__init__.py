@@ -1,0 +1,7 @@
+from ray_tpu.rl.core.learner import JaxLearner
+from ray_tpu.rl.core.learner_group import LearnerGroup
+from ray_tpu.rl.core.rl_module import (Columns, DefaultActorCritic,
+                                       DefaultQModule, RLModule, RLModuleSpec)
+
+__all__ = ["JaxLearner", "LearnerGroup", "Columns", "DefaultActorCritic",
+           "DefaultQModule", "RLModule", "RLModuleSpec"]

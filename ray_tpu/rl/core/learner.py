@@ -1,0 +1,156 @@
+"""JaxLearner — jitted gradient updates with ICI gradient sync.
+
+(ref: rllib/core/learner/learner.py:109 Learner — compute_gradients:461,
+apply_gradients:604, update_from_batch:967; torch version torch_learner.py:62
+wraps the module in DDP `TorchDDPRLModule:409` for NCCL allreduce.)
+
+TPU-native redesign: the whole minibatch update (loss, grad, optimizer) is ONE
+jitted function; multi-learner gradient sync is an `allreduce` on the raveled
+gradient vector through the XLA collective group (compiled psum over ICI) —
+the mirror of TorchLearner's DDP hook, but visible and compiled.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.core.rl_module import Columns, RLModuleSpec
+
+
+class JaxLearner:
+    """Base learner; algorithms override ``compute_loss``."""
+
+    def __init__(self, *, config, module_spec: RLModuleSpec, rank: int = 0,
+                 world_size: int = 1, group_name: Optional[str] = None,
+                 seed: int = 0):
+        self.config = config
+        self.module = module_spec.build()
+        self.rank = rank
+        self.world_size = world_size
+        self.group_name = group_name
+        self._key = jax.random.key(seed * 31 + rank)
+        self.params = self.module.init_params(jax.random.key(seed))
+        self.optimizer = self.configure_optimizer()
+        self.opt_state = self.optimizer.init(self.params)
+        self._update_fn = None
+        self._steps = 0
+        if world_size > 1 and group_name:
+            from ray_tpu import collective
+
+            collective.init_collective_group(world_size, rank, group_name=group_name)
+
+    # ------------------------------------------------------------------
+    def configure_optimizer(self) -> optax.GradientTransformation:
+        cfg = self.config
+        clip = getattr(cfg, "grad_clip", None)
+        parts = []
+        if clip:
+            parts.append(optax.clip_by_global_norm(clip))
+        parts.append(optax.adam(getattr(cfg, "lr", 3e-4)))
+        return optax.chain(*parts)
+
+    def compute_loss(self, params, batch: Dict[str, Any], key) -> Tuple[Any, Dict]:
+        """Return (scalar loss, metrics dict). Pure — will be jitted+grad'd."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def _build_update(self):
+        def step(params, opt_state, batch, key):
+            (loss, metrics), grads = jax.value_and_grad(
+                self.compute_loss, has_aux=True)(params, batch, key)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, metrics
+
+        def step_synced(params, opt_state, batch, key):
+            # Gradients-only sync: compute local grads jitted, allreduce the
+            # raveled vector across the learner group, apply jitted.
+            (loss, metrics), grads = self._grad_fn(params, batch, key)
+            flat, unravel = jax.flatten_util.ravel_pytree(grads)
+            from ray_tpu import collective
+
+            flat = collective.allreduce(flat, group_name=self.group_name,
+                                        rank=self.rank) / self.world_size
+            grads = unravel(flat)
+            params, opt_state, gnorm = self._apply_fn(params, opt_state, grads)
+            metrics["total_loss"] = loss
+            metrics["grad_norm"] = gnorm
+            return params, opt_state, metrics
+
+        if self.world_size <= 1 or not self.group_name:
+            self._update_fn = jax.jit(step, donate_argnums=(0, 1))
+        else:
+            self._grad_fn = jax.jit(
+                jax.value_and_grad(self.compute_loss, has_aux=True))
+
+            def apply(params, opt_state, grads):
+                updates, opt_state = self.optimizer.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), opt_state,
+                        optax.global_norm(grads))
+
+            self._apply_fn = jax.jit(apply, donate_argnums=(0, 1))
+            self._update_fn = step_synced
+
+    # ------------------------------------------------------------------
+    def update_from_batch(self, batch: Dict[str, np.ndarray],
+                          *, num_epochs: int = 1,
+                          minibatch_size: Optional[int] = None) -> Dict[str, Any]:
+        """SGD over the batch (ref: learner.py:967 update_from_batch —
+        num_epochs/minibatch_size shuffled passes)."""
+        if self._update_fn is None:
+            self._build_update()
+        n = len(next(iter(batch.values())))
+        minibatch_size = minibatch_size or n
+        all_metrics: List[Dict[str, Any]] = []
+        for _ in range(num_epochs):
+            # Same permutation on every learner rank (synced collective
+            # schedule requires identical minibatch counts).
+            perm = np.random.default_rng(self._steps).permutation(n)
+            for start in range(0, n, minibatch_size):
+                idx = perm[start:start + minibatch_size]
+                mb = {k: v[idx] for k, v in batch.items()}
+                self._key, sub = jax.random.split(self._key)
+                self.params, self.opt_state, metrics = self._update_fn(
+                    self.params, self.opt_state, mb, sub)
+                all_metrics.append(metrics)
+            self._steps += 1
+        out = {k: float(np.mean([jax.device_get(m[k]) for m in all_metrics]))
+               for k in all_metrics[0]}
+        self.after_update(out)
+        return out
+
+    def after_update(self, metrics: Dict[str, Any]) -> None:
+        """Hook (e.g. DQN target-net sync)."""
+
+    # ------------------------------------------------------------------
+    def get_weights(self):
+        return self.params
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self.params, "opt_state": self.opt_state,
+                "steps": self._steps}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        # Copy on receipt: in-process actors share object-store values by
+        # reference, and this learner's jitted update DONATES its param/opt
+        # buffers — adopting another actor's live arrays would let a later
+        # update delete buffers someone else still holds.
+        self.params = _copy_tree(state["params"])
+        self.opt_state = _copy_tree(state["opt_state"])
+        self._steps = state.get("steps", 0)
+
+    def ping(self) -> str:
+        return "pong"
+
+
+def _copy_tree(tree):
+    return jax.tree.map(lambda x: jnp.array(x, copy=True)
+                        if hasattr(x, "dtype") else x, tree)

@@ -1,0 +1,253 @@
+"""RLModule — the neural-network component of the RL stack, pure-JAX.
+
+Counterpart of the reference's new-API-stack RLModule
+(ref: rllib/core/rl_module/rl_module.py:260 — forward_inference /
+forward_exploration / forward_train over a framework-specific network),
+redesigned functionally for TPU: a module holds only *static* architecture
+config; parameters are a plain pytree created by ``init_params`` and threaded
+explicitly through pure ``forward_*`` functions, so the learner can jit/grad
+them and shard them over a mesh without framework adapters.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Any  # pytree
+
+
+class Columns:
+    """Batch column names (ref: rllib/core/columns.py Columns)."""
+
+    OBS = "obs"
+    ACTIONS = "actions"
+    REWARDS = "rewards"
+    TERMINATEDS = "terminateds"
+    TRUNCATEDS = "truncateds"
+    ACTION_LOGP = "action_logp"
+    ACTION_DIST_INPUTS = "action_dist_inputs"
+    VF_PREDS = "vf_preds"
+    ADVANTAGES = "advantages"
+    VALUE_TARGETS = "value_targets"
+    NEXT_OBS = "next_obs"
+    EPS_ID = "eps_id"
+    WEIGHTS = "weights"  # importance weights (prioritized replay)
+
+
+# --------------------------------------------------------------------------
+# Action distributions (ref: rllib/models/distributions.py Distribution API)
+# --------------------------------------------------------------------------
+
+
+class Categorical:
+    """Discrete distribution over logits."""
+
+    @staticmethod
+    def sample(key, logits):
+        return jax.random.categorical(key, logits, axis=-1)
+
+    @staticmethod
+    def logp(logits, actions):
+        logp_all = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.take_along_axis(
+            logp_all, actions[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+
+    @staticmethod
+    def entropy(logits):
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    @staticmethod
+    def deterministic(logits):
+        return jnp.argmax(logits, axis=-1)
+
+
+class DiagGaussian:
+    """Continuous distribution; dist inputs = concat(mean, log_std)."""
+
+    @staticmethod
+    def _split(inputs):
+        mean, log_std = jnp.split(inputs, 2, axis=-1)
+        return mean, jnp.clip(log_std, -20.0, 2.0)
+
+    @staticmethod
+    def sample(key, inputs):
+        mean, log_std = DiagGaussian._split(inputs)
+        return mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
+
+    @staticmethod
+    def logp(inputs, actions):
+        mean, log_std = DiagGaussian._split(inputs)
+        var = jnp.exp(2 * log_std)
+        return jnp.sum(
+            -0.5 * ((actions - mean) ** 2 / var + 2 * log_std + jnp.log(2 * jnp.pi)),
+            axis=-1,
+        )
+
+    @staticmethod
+    def entropy(inputs):
+        _, log_std = DiagGaussian._split(inputs)
+        return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+    @staticmethod
+    def deterministic(inputs):
+        mean, _ = DiagGaussian._split(inputs)
+        return mean
+
+
+# --------------------------------------------------------------------------
+# Module base + default actor-critic
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RLModuleSpec:
+    """(ref: rllib/core/rl_module/rl_module.py:69 RLModuleSpec) — carries the
+    module class + ctor config so env runners and learners build identical
+    networks from one spec."""
+
+    module_class: type
+    observation_dim: int
+    action_dim: int
+    discrete: bool = True
+    model_config: Dict[str, Any] = field(default_factory=dict)
+
+    def build(self) -> "RLModule":
+        return self.module_class(
+            observation_dim=self.observation_dim,
+            action_dim=self.action_dim,
+            discrete=self.discrete,
+            **self.model_config,
+        )
+
+
+class RLModule:
+    """Base module: static config + pure param functions."""
+
+    def __init__(self, observation_dim: int, action_dim: int, discrete: bool = True,
+                 **model_config: Any):
+        self.observation_dim = observation_dim
+        self.action_dim = action_dim
+        self.discrete = discrete
+        self.model_config = model_config
+
+    # -- to implement
+    def init_params(self, key) -> Params:
+        raise NotImplementedError
+
+    def forward_train(self, params: Params, obs) -> Dict[str, Any]:
+        """Full outputs for the loss (dist inputs + value preds)."""
+        raise NotImplementedError
+
+    # -- defaults derived from forward_train
+    def forward_inference(self, params: Params, obs) -> Dict[str, Any]:
+        return self.forward_train(params, obs)
+
+    def forward_exploration(self, params: Params, obs) -> Dict[str, Any]:
+        return self.forward_train(params, obs)
+
+    @property
+    def action_dist(self):
+        return Categorical if self.discrete else DiagGaussian
+
+    @property
+    def dist_input_dim(self) -> int:
+        return self.action_dim if self.discrete else 2 * self.action_dim
+
+
+def _mlp_init(key, sizes: Sequence[int], out_dim: int, in_dim: int,
+              out_scale: float = 0.01) -> Dict[str, Any]:
+    """Orthogonal-initialized MLP params (tanh torso + linear head)."""
+    dims = [in_dim, *sizes]
+    layers = []
+    orth = jax.nn.initializers.orthogonal
+    for i in range(len(dims) - 1):
+        key, sub = jax.random.split(key)
+        layers.append({
+            "w": orth(scale=float(np.sqrt(2.0)))(sub, (dims[i], dims[i + 1]), jnp.float32),
+            "b": jnp.zeros((dims[i + 1],), jnp.float32),
+        })
+    key, sub = jax.random.split(key)
+    head = {
+        "w": orth(scale=out_scale)(sub, (dims[-1], out_dim), jnp.float32),
+        "b": jnp.zeros((out_dim,), jnp.float32),
+    }
+    return {"layers": layers, "head": head}
+
+
+def _mlp_apply(params: Dict[str, Any], x):
+    for layer in params["layers"]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    return x @ params["head"]["w"] + params["head"]["b"]
+
+
+class DefaultActorCritic(RLModule):
+    """Separate policy/value MLPs — the default small-obs module
+    (ref: rllib/core/rl_module/default_model_config.py DefaultModelConfig,
+    fcnet_hiddens=[256,256]; PPO's default torso)."""
+
+    def __init__(self, observation_dim, action_dim, discrete=True,
+                 hiddens: Sequence[int] = (64, 64), **kw):
+        super().__init__(observation_dim, action_dim, discrete,
+                         hiddens=tuple(hiddens), **kw)
+        self.hiddens = tuple(hiddens)
+
+    def init_params(self, key) -> Params:
+        k_pi, k_vf = jax.random.split(key)
+        return {
+            "pi": _mlp_init(k_pi, self.hiddens, self.dist_input_dim,
+                            self.observation_dim, out_scale=0.01),
+            "vf": _mlp_init(k_vf, self.hiddens, 1, self.observation_dim,
+                            out_scale=1.0),
+        }
+
+    def forward_train(self, params, obs) -> Dict[str, Any]:
+        obs = jnp.asarray(obs, jnp.float32)
+        return {
+            Columns.ACTION_DIST_INPUTS: _mlp_apply(params["pi"], obs),
+            Columns.VF_PREDS: _mlp_apply(params["vf"], obs)[..., 0],
+        }
+
+    def forward_exploration(self, params, obs) -> Dict[str, Any]:
+        obs = jnp.asarray(obs, jnp.float32)
+        return {Columns.ACTION_DIST_INPUTS: _mlp_apply(params["pi"], obs)}
+
+    forward_inference = forward_exploration
+
+
+class DefaultQModule(RLModule):
+    """Q-network module for DQN (ref: rllib/algorithms/dqn/default_dqn_rl_module.py).
+
+    Params hold both the online and target networks; the learner updates the
+    target copy on its own schedule.
+    """
+
+    def __init__(self, observation_dim, action_dim, discrete=True,
+                 hiddens: Sequence[int] = (64, 64), **kw):
+        assert discrete, "DQN requires a discrete action space"
+        super().__init__(observation_dim, action_dim, discrete,
+                         hiddens=tuple(hiddens), **kw)
+        self.hiddens = tuple(hiddens)
+
+    def init_params(self, key) -> Params:
+        q = _mlp_init(key, self.hiddens, self.action_dim, self.observation_dim,
+                      out_scale=0.01)
+        return {"q": q, "target_q": jax.tree.map(jnp.copy, q)}
+
+    def forward_train(self, params, obs) -> Dict[str, Any]:
+        obs = jnp.asarray(obs, jnp.float32)
+        q = _mlp_apply(params["q"], obs)
+        return {"q_values": q, Columns.ACTION_DIST_INPUTS: q}
+
+    def forward_target(self, params, obs):
+        obs = jnp.asarray(obs, jnp.float32)
+        return _mlp_apply(params["target_q"], obs)
+
+    forward_inference = forward_train
+    forward_exploration = forward_train

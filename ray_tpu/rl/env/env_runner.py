@@ -1,0 +1,206 @@
+"""SingleAgentEnvRunner — vectorized env sampling with RLModule inference.
+
+(ref: rllib/env/single_agent_env_runner.py:64 SingleAgentEnvRunner —
+gymnasium vector env step loop driving RLModule.forward_exploration;
+sample(num_timesteps | num_episodes), get_state/set_state weight sync.)
+
+TPU-native redesign: the policy forward over all envs' observations is ONE
+jitted batched call (obs stacked host-side, categorical sampling inside the
+jit via a threaded PRNG key), so per-step device work is a single dispatch
+regardless of num_envs.  Envs are stepped with immediate-reset semantics
+(no gymnasium autoreset edge cases in the batch).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl.core.rl_module import Columns, RLModuleSpec
+from ray_tpu.rl.env.episode import SingleAgentEpisode
+
+
+def _make_env(env: Union[str, Callable], env_config: Dict[str, Any]):
+    if callable(env):
+        return env(env_config)
+    import gymnasium as gym
+
+    return gym.make(env, **env_config)
+
+
+def env_spaces(env: Union[str, Callable], env_config: Dict[str, Any]):
+    """(obs_dim, action_dim, discrete) probed from one throwaway env."""
+    e = _make_env(env, env_config)
+    try:
+        import gymnasium as gym
+
+        obs_dim = int(np.prod(e.observation_space.shape))
+        if isinstance(e.action_space, gym.spaces.Discrete):
+            return obs_dim, int(e.action_space.n), True
+        return obs_dim, int(np.prod(e.action_space.shape)), False
+    finally:
+        e.close()
+
+
+class SingleAgentEnvRunner:
+    """Runs num_envs envs; one jitted policy call per vector step."""
+
+    def __init__(self, *, env: Union[str, Callable],
+                 env_config: Optional[Dict[str, Any]] = None,
+                 module_spec: RLModuleSpec,
+                 num_envs: int = 1,
+                 rollout_fragment_length: int = 200,
+                 explore: bool = True,
+                 seed: int = 0,
+                 worker_index: int = 0):
+        self.env_config = dict(env_config or {})
+        self.num_envs = num_envs
+        self.rollout_fragment_length = rollout_fragment_length
+        self.explore = explore
+        self.worker_index = worker_index
+        self.module = module_spec.build()
+        self._params = self.module.init_params(
+            jax.random.key(seed * 1000 + worker_index))
+        self._key = jax.random.key(seed * 7919 + worker_index + 1)
+        self._weights_seq = 0
+
+        self.envs = [_make_env(env, self.env_config) for _ in range(num_envs)]
+        self.episodes: List[SingleAgentEpisode] = []
+        self._done_episode_returns: List[float] = []
+        self._done_episode_lens: List[int] = []
+        for i, e in enumerate(self.envs):
+            obs, _ = e.reset(seed=seed * 100003 + worker_index * 1000 + i)
+            ep = SingleAgentEpisode()
+            ep.add_env_reset(np.asarray(obs, np.float32).ravel())
+            self.episodes.append(ep)
+
+        dist = self.module.action_dist
+
+        @jax.jit
+        def _explore_step(params, key, obs):
+            out = self.module.forward_exploration(params, obs)
+            inputs = out[Columns.ACTION_DIST_INPUTS]
+            key, sub = jax.random.split(key)
+            actions = dist.sample(sub, inputs)
+            logp = dist.logp(inputs, actions)
+            return key, actions, logp
+
+        @jax.jit
+        def _greedy_step(params, obs):
+            out = self.module.forward_inference(params, obs)
+            inputs = out[Columns.ACTION_DIST_INPUTS]
+            actions = dist.deterministic(inputs)
+            return actions, dist.logp(inputs, actions)
+
+        self._explore_step = _explore_step
+        self._greedy_step = _greedy_step
+
+    # ------------------------------------------------------------------
+    def sample(self, *, num_timesteps: Optional[int] = None,
+               num_episodes: Optional[int] = None,
+               random_actions: bool = False,
+               explore: Optional[bool] = None) -> List[SingleAgentEpisode]:
+        """Collect fragments totalling num_timesteps (across the vector), or
+        num_episodes full episodes (ref: single_agent_env_runner.py sample())."""
+        if num_timesteps is None and num_episodes is None:
+            num_timesteps = self.rollout_fragment_length * self.num_envs
+        explore = self.explore if explore is None else explore
+
+        out: List[SingleAgentEpisode] = []
+        steps = 0
+        episodes_done = 0
+        while True:
+            obs = np.stack([ep.observations[-1] for ep in self.episodes])
+            if random_actions:
+                actions, logps = self._random_actions(obs)
+            elif explore:
+                self._key, a, lp = self._explore_step(self._params, self._key, obs)
+                actions, logps = np.asarray(a), np.asarray(lp)
+            else:
+                a, lp = self._greedy_step(self._params, obs)
+                actions, logps = np.asarray(a), np.asarray(lp)
+
+            for i, env in enumerate(self.envs):
+                act = actions[i]
+                if self.module.discrete:
+                    act = int(act)
+                next_obs, reward, terminated, truncated, _ = env.step(act)
+                ep = self.episodes[i]
+                ep.add_env_step(
+                    np.asarray(next_obs, np.float32).ravel(), actions[i], reward,
+                    terminated=terminated, truncated=truncated,
+                    extra={Columns.ACTION_LOGP: float(logps[i])},
+                )
+                steps += 1
+                if ep.is_done:
+                    episodes_done += 1
+                    self._done_episode_returns.append(ep.total_return)
+                    self._done_episode_lens.append(ep.total_len)
+                    out.append(ep)
+                    reset_obs, _ = env.reset()
+                    new_ep = SingleAgentEpisode()
+                    new_ep.add_env_reset(np.asarray(reset_obs, np.float32).ravel())
+                    self.episodes[i] = new_ep
+            if num_episodes is not None:
+                if episodes_done >= num_episodes:
+                    break
+            elif steps >= num_timesteps:
+                break
+
+        if num_episodes is None:
+            # Hand off in-progress fragments too (PPO-style fixed batch).
+            for i, ep in enumerate(self.episodes):
+                if len(ep) > 0:
+                    out.append(ep)
+                    self.episodes[i] = ep.cut()
+        return out
+
+    def _random_actions(self, obs):
+        n = len(self.envs)
+        if self.module.discrete:
+            acts = np.array([e.action_space.sample() for e in self.envs])
+            logps = np.full((n,), -np.log(self.module.action_dim), np.float32)
+        else:
+            acts = np.stack([e.action_space.sample() for e in self.envs])
+            logps = np.zeros((n,), np.float32)
+        return acts, logps
+
+    # ------------------------------------------------------------------
+    def get_metrics(self) -> Dict[str, Any]:
+        """Drain per-episode stats (ref: env runner metrics via MetricsLogger)."""
+        returns, lens = self._done_episode_returns, self._done_episode_lens
+        self._done_episode_returns, self._done_episode_lens = [], []
+        if not returns:
+            return {"num_episodes": 0}
+        return {
+            "num_episodes": len(returns),
+            "episode_return_mean": float(np.mean(returns)),
+            "episode_return_max": float(np.max(returns)),
+            "episode_return_min": float(np.min(returns)),
+            "episode_len_mean": float(np.mean(lens)),
+        }
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self._params, "weights_seq": self._weights_seq}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        if "params" in state:
+            # Copy on receipt: the learner's jitted update donates its param
+            # buffers, so holding its live arrays across a weight sync would
+            # leave this runner with deleted buffers (real on TPU; CPU's
+            # donation no-op masks it).
+            self._params = jax.tree.map(
+                lambda x: jnp.array(x, copy=True) if hasattr(x, "dtype") else x,
+                state["params"])
+        self._weights_seq = state.get("weights_seq", self._weights_seq + 1)
+
+    def ping(self) -> str:
+        return "pong"
+
+    def stop(self) -> None:
+        for e in self.envs:
+            e.close()

@@ -1,0 +1,101 @@
+"""EnvRunnerGroup — fan-out over remote env-runner actors.
+
+(ref: rllib/env/env_runner_group.py:71 EnvRunnerGroup — manages N remote
+EnvRunner actors + an optional local one; foreach_env_runner fan-out,
+sync_weights, restart of failed runners.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.rl.env.env_runner import SingleAgentEnvRunner
+
+
+class EnvRunnerGroup:
+    def __init__(self, *, env, env_config, module_spec, num_env_runners: int,
+                 num_envs_per_env_runner: int, rollout_fragment_length: int,
+                 explore: bool = True, seed: int = 0):
+        self._runner_kwargs = dict(
+            env=env, env_config=env_config, module_spec=module_spec,
+            num_envs=num_envs_per_env_runner,
+            rollout_fragment_length=rollout_fragment_length,
+            explore=explore, seed=seed,
+        )
+        self.num_env_runners = num_env_runners
+        self._local_runner: Optional[SingleAgentEnvRunner] = None
+        self._remote_runners: List[Any] = []
+        if num_env_runners == 0:
+            self._local_runner = SingleAgentEnvRunner(worker_index=0,
+                                                      **self._runner_kwargs)
+        else:
+            cls = ray_tpu.remote(SingleAgentEnvRunner)
+            self._remote_runners = [
+                cls.remote(worker_index=i + 1, **self._runner_kwargs)
+                for i in range(num_env_runners)
+            ]
+
+    # ------------------------------------------------------------------
+    def sample(self, *, num_timesteps: Optional[int] = None,
+               num_episodes: Optional[int] = None,
+               random_actions: bool = False) -> List:
+        """Synchronous fan-out sample (ref: algorithm.py:1814
+        synchronous_parallel_sample)."""
+        if self._local_runner is not None:
+            return self._local_runner.sample(
+                num_timesteps=num_timesteps, num_episodes=num_episodes,
+                random_actions=random_actions)
+        n = len(self._remote_runners)
+        per_ts = None if num_timesteps is None else max(1, num_timesteps // n)
+        per_eps = None if num_episodes is None else max(1, num_episodes // n)
+        refs = [
+            r.sample.remote(num_timesteps=per_ts, num_episodes=per_eps,
+                            random_actions=random_actions)
+            for r in self._remote_runners
+        ]
+        episodes: List = []
+        for chunk in ray_tpu.get(refs):
+            episodes.extend(chunk)
+        return episodes
+
+    def async_sample_refs(self, *, num_timesteps: int) -> List:
+        """One in-flight sample ref per runner (IMPALA-style async path)."""
+        assert self._remote_runners, "async sampling needs remote env runners"
+        per = max(1, num_timesteps // len(self._remote_runners))
+        return [r.sample.remote(num_timesteps=per) for r in self._remote_runners]
+
+    # ------------------------------------------------------------------
+    def sync_weights(self, params: Any) -> None:
+        """Push learner params to every runner (ref: env_runner_group.py
+        sync_weights)."""
+        if self._local_runner is not None:
+            self._local_runner.set_state({"params": params})
+            return
+        ray_tpu.get([r.set_state.remote({"params": params})
+                     for r in self._remote_runners])
+
+    def foreach_env_runner(self, fn_name: str, *args, **kwargs) -> List[Any]:
+        if self._local_runner is not None:
+            return [getattr(self._local_runner, fn_name)(*args, **kwargs)]
+        return ray_tpu.get([
+            getattr(r, fn_name).remote(*args, **kwargs)
+            for r in self._remote_runners
+        ])
+
+    def get_metrics(self) -> List[Dict[str, Any]]:
+        return self.foreach_env_runner("get_metrics")
+
+    @property
+    def runners(self) -> List[Any]:
+        return self._remote_runners
+
+    def stop(self) -> None:
+        if self._local_runner is not None:
+            self._local_runner.stop()
+        for r in self._remote_runners:
+            try:
+                ray_tpu.get(r.stop.remote(), timeout=2.0)
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -1,0 +1,4 @@
+from ray_tpu.rl.utils.metrics import MetricsLogger
+from ray_tpu.rl.utils.replay_buffers import PrioritizedReplayBuffer, ReplayBuffer
+
+__all__ = ["MetricsLogger", "ReplayBuffer", "PrioritizedReplayBuffer"]

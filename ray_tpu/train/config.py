@@ -57,4 +57,7 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    #: trial stop criteria, e.g. {"training_iteration": 10} (ref: air
+    #: RunConfig.stop)
+    stop: Optional[Dict[str, Any]] = None
     verbose: int = 0

@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 from ray_tpu.train import checkpoint as _ckpt
+from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig
 from ray_tpu.train.session import get_session
 from ray_tpu.tune.result_grid import ResultGrid
 from ray_tpu.tune.schedulers import (
@@ -52,6 +53,7 @@ __all__ = [
     "ConcurrencyLimiter", "HyperOptStyleSearcher", "TrialScheduler",
     "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
     "PopulationBasedTraining", "ResultGrid", "Trial", "Checkpoint",
+    "RunConfig", "FailureConfig", "CheckpointConfig",
     "uniform", "quniform", "loguniform", "qloguniform", "randn", "randint",
     "qrandint", "lograndint", "choice", "sample_from", "grid_search",
 ]

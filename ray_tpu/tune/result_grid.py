@@ -45,7 +45,9 @@ class ResultGrid:
         mode = mode or self._mode
         if metric is None:
             raise ValueError("Pass metric= or set TuneConfig(metric=...)")
-        scored = [(t.best_metric(metric, mode), t) for t in self._trials]
+        # Rank by each trial's LAST report (ref: ResultGrid scope="last"
+        # default) so the ranking agrees with the Result.metrics returned.
+        scored = [((t.last_result or {}).get(metric), t) for t in self._trials]
         scored = [(s, t) for s, t in scored if s is not None]
         if not scored:
             raise RuntimeError("No trial reported the metric "

@@ -42,16 +42,19 @@ class Integer(Domain):
         self.lower, self.upper, self.log, self.q = lower, upper, log, q
 
     def sample(self, rng: random.Random) -> int:
+        # Upper bound is EXCLUSIVE on both paths (ref: tune randint/lograndint
+        # contract), so e.g. lograndint(0, len(xs)) is a safe index.
+        hi = self.upper - 1 if self.upper > self.lower else self.lower
         if self.log:
             import math
 
             v = int(round(math.exp(rng.uniform(math.log(max(self.lower, 1)),
-                                               math.log(self.upper)))))
+                                               math.log(max(hi, 1))))))
         else:
-            v = rng.randint(self.lower, self.upper - 1 if self.upper > self.lower else self.lower)
+            v = rng.randint(self.lower, hi)
         if self.q > 1:
             v = int(round(v / self.q) * self.q)
-        return min(max(v, self.lower), self.upper)
+        return min(max(v, self.lower), hi)
 
 
 class Categorical(Domain):

@@ -70,21 +70,25 @@ class Trainable:
                                 f"checkpoint_{self.iteration:06d}")
         os.makedirs(ckpt_dir, exist_ok=True)
         data = self.save_checkpoint(ckpt_dir)
-        if data is not None:
-            import json
+        import pickle
 
-            with open(os.path.join(ckpt_dir, "trainable_state.json"), "w") as f:
-                json.dump(data, f, default=repr)
+        # Pickle (not JSON) so arbitrary checkpoint values round-trip
+        # faithfully; iteration rides along so a restored trial resumes its
+        # training_iteration clock (ref: Trainable persists _iteration).
+        with open(os.path.join(ckpt_dir, "trainable_state.pkl"), "wb") as f:
+            pickle.dump({"data": data, "iteration": self.iteration}, f)
         return ckpt_dir
 
     def restore(self, checkpoint_path: str) -> None:
         data = None
-        state_file = os.path.join(checkpoint_path, "trainable_state.json")
+        state_file = os.path.join(checkpoint_path, "trainable_state.pkl")
         if os.path.exists(state_file):
-            import json
+            import pickle
 
-            with open(state_file) as f:
-                data = json.load(f)
+            with open(state_file, "rb") as f:
+                state = pickle.load(f)
+            data = state["data"]
+            self.iteration = state.get("iteration", self.iteration)
         self.load_checkpoint(data, checkpoint_path)
 
     def stop(self) -> None:

@@ -18,8 +18,8 @@ class Trial:
 
     def __init__(self, config: Dict[str, Any], experiment_path: str,
                  trial_resources: Optional[Dict[str, float]] = None,
-                 experiment_name: str = ""):
-        self.trial_id = uuid.uuid4().hex[:8]
+                 experiment_name: str = "", trial_id: Optional[str] = None):
+        self.trial_id = trial_id or uuid.uuid4().hex[:8]
         self.config = config
         self.status = Trial.PENDING
         self.resources = trial_resources or {"CPU": 1.0}

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import time
+import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
@@ -64,7 +65,6 @@ class TuneController:
         experiment_name: str = "tune",
         metric: Optional[str] = None,
         mode: str = "max",
-        num_samples_hint: int = 1,
         stop: Optional[Dict[str, Any]] = None,
         max_concurrent_trials: Optional[int] = None,
         max_failures: int = 0,
@@ -111,10 +111,8 @@ class TuneController:
             self._maybe_start_trials()
             live = [t for t in self.trials if t.status == Trial.RUNNING]
             if not live:
-                if self._searcher_done and not any(
-                        t.status in (Trial.PENDING, Trial.PAUSED) for t in self.trials):
-                    break
-                if not any(t.status in (Trial.PENDING, Trial.PAUSED) for t in self.trials):
+                if not any(t.status in (Trial.PENDING, Trial.PAUSED)
+                           for t in self.trials):
                     break
                 time.sleep(0.01)
                 continue
@@ -135,19 +133,18 @@ class TuneController:
                          if t.status in (Trial.PENDING, Trial.RUNNING, Trial.PAUSED))
             if active >= self._max_concurrent * 2:
                 return
-            tentative_id = f"t{len(self.trials)}"
-            cfg = self.searcher.suggest(tentative_id)
+            # The trial id is fixed BEFORE suggest() so searchers that key
+            # per-trial state by the suggested id see the same id in every
+            # later on_trial_result/on_trial_complete call.
+            trial_id = uuid.uuid4().hex[:8]
+            cfg = self.searcher.suggest(trial_id)
             if cfg is None or cfg == FINISHED:
                 self._searcher_done = True
                 return
             if cfg == "PENDING":  # ConcurrencyLimiter backpressure
                 return
             trial = Trial(cfg, self.experiment_path, dict(self.trial_resources),
-                          self.experiment_name)
-            # searcher tracked the tentative id; remap to the real one
-            if hasattr(self.searcher, "_live"):
-                self.searcher._live.discard(tentative_id)
-                self.searcher._live.add(trial.trial_id)
+                          self.experiment_name, trial_id=trial_id)
             self.trials.append(trial)
             self.scheduler.on_trial_add(trial)
             for cb in self.callbacks:
@@ -233,7 +230,9 @@ class TuneController:
     def _on_trial_error(self, trial: Trial, error: BaseException) -> None:
         trial.num_failures += 1
         self._teardown_actor(trial)
-        if trial.num_failures <= self.max_failures:
+        # max_failures < 0 means retry forever (FailureConfig contract;
+        # matches train/trainer.py's handling of the same config).
+        if self.max_failures < 0 or trial.num_failures <= self.max_failures:
             # retry from last checkpoint (ref: trial FSM retry w/ restore)
             trial.status = Trial.PENDING
             return

@@ -76,7 +76,7 @@ class Tuner:
             experiment_name=name,
             metric=tc.metric,
             mode=tc.mode,
-            num_samples_hint=tc.num_samples,
+            stop=self.run_config.stop,
             max_concurrent_trials=tc.max_concurrent_trials,
             max_failures=self.run_config.failure_config.max_failures,
             trial_resources=resources,
