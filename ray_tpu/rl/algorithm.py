@@ -91,21 +91,24 @@ class Algorithm(Trainable):
 
     # ---------------------------------------------------------- evaluation
     def evaluate(self) -> Dict[str, Any]:
-        """Greedy-policy evaluation episodes (ref: algorithm.py evaluate())."""
+        """Greedy-policy evaluation on a DEDICATED runner (ref: algorithm.py
+        evaluate() on eval_env_runner_group).  Training runners must not be
+        touched: eval steps would extend their in-progress episodes and feed
+        greedy actions (with wrong behavior logps) into the next train batch.
+        """
         cfg = self.algo_config
-        episodes = []
-        if self.env_runner_group._local_runner is not None:
-            episodes = self.env_runner_group._local_runner.sample(
-                num_episodes=cfg.evaluation_duration, explore=False)
-        else:
-            import ray_tpu
+        if not hasattr(self, "_eval_runner"):
+            from ray_tpu.rl.env.env_runner import SingleAgentEnvRunner
 
-            runners = self.env_runner_group.runners
-            per = max(1, cfg.evaluation_duration // len(runners))
-            for chunk in ray_tpu.get([r.sample.remote(num_episodes=per,
-                                                      explore=False)
-                                      for r in runners]):
-                episodes.extend(chunk)
+            self._eval_runner = SingleAgentEnvRunner(
+                env=cfg.env, env_config=cfg.env_config,
+                module_spec=self.module_spec,
+                num_envs=cfg.num_envs_per_env_runner,
+                rollout_fragment_length=cfg.rollout_fragment_length,
+                explore=False, seed=cfg.seed + 10_000, worker_index=999)
+        self._eval_runner.set_state({"params": self.learner_group.get_weights()})
+        episodes = self._eval_runner.sample(
+            num_episodes=cfg.evaluation_duration, explore=False)
         returns = [ep.total_return for ep in episodes if ep.is_done]
         if not returns:
             return {}
@@ -135,6 +138,8 @@ class Algorithm(Trainable):
     def cleanup(self) -> None:
         self.env_runner_group.stop()
         self.learner_group.stop()
+        if hasattr(self, "_eval_runner"):
+            self._eval_runner.stop()
 
     # ------------------------------------------------------------- helpers
     def get_weights(self):

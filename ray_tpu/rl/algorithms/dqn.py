@@ -68,6 +68,37 @@ class DQNLearner(JaxLearner):
         return loss, {"td_error_mean": jnp.mean(jnp.abs(td)),
                       "q_mean": jnp.mean(q_taken)}
 
+    def compute_td_errors(self, batch: Dict[str, Any]) -> Any:
+        """Per-sample |TD| for prioritized-replay updates (ref: PER priority
+        refresh after each train batch)."""
+        if not hasattr(self, "_td_fn"):
+            cfg = self.config
+
+            def td(params, batch):
+                q_all = self.module.forward_train(params,
+                                                  batch[Columns.OBS])["q_values"]
+                q_taken = jnp.take_along_axis(
+                    q_all, batch[Columns.ACTIONS][..., None].astype(jnp.int32),
+                    axis=-1)[..., 0]
+                q_next_t = self.module.forward_target(params,
+                                                      batch[Columns.NEXT_OBS])
+                if cfg.double_q:
+                    q_next_o = self.module.forward_train(
+                        params, batch[Columns.NEXT_OBS])["q_values"]
+                    best = jnp.argmax(q_next_o, axis=-1)
+                    q_next = jnp.take_along_axis(q_next_t, best[..., None],
+                                                 axis=-1)[..., 0]
+                else:
+                    q_next = jnp.max(q_next_t, axis=-1)
+                target = (batch[Columns.REWARDS]
+                          + (cfg.gamma ** cfg.n_step)
+                          * (1.0 - batch[Columns.TERMINATEDS]) * q_next)
+                return jnp.abs(q_taken - target)
+
+            self._td_fn = jax.jit(td)
+        batch = {k: v for k, v in batch.items() if k != Columns.WEIGHTS}
+        return np.asarray(self._td_fn(self.params, batch))
+
     def after_update(self, metrics: Dict[str, Any]) -> None:
         cfg = self.config
         if self._steps % max(1, cfg.target_network_update_freq) == 0:
@@ -98,14 +129,15 @@ class DQN(Algorithm):
                                          seed=cfg.seed))
 
     def _epsilon(self) -> float:
+        """Piecewise-linear interpolation across ALL schedule breakpoints."""
         sched = self.algo_config.epsilon
         t = self._lifetime_steps
-        (t0, e0), (t1, e1) = sched[0], sched[-1]
-        if t <= t0:
-            return e0
-        if t >= t1:
-            return e1
-        return e0 + (e1 - e0) * (t - t0) / (t1 - t0)
+        if t <= sched[0][0]:
+            return sched[0][1]
+        for (t0, e0), (t1, e1) in zip(sched, sched[1:]):
+            if t <= t1:
+                return e0 + (e1 - e0) * (t - t0) / max(1, t1 - t0)
+        return sched[-1][1]
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.algo_config
@@ -125,6 +157,10 @@ class DQN(Algorithm):
             return {"learners": {}, "epsilon": self._epsilon()}
         batch = self.replay.sample(cfg.train_batch_size)
         learner_results = self.learner_group.update_from_batch(batch)
+        if cfg.prioritized_replay:
+            td = self.learner_group.foreach_learner(
+                "compute_td_errors", batch)[0]
+            self.replay.update_priorities(td)
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
         return {"learners": learner_results, "epsilon": self._epsilon(),
                 "replay_size": len(self.replay)}

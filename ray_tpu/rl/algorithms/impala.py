@@ -43,14 +43,18 @@ class IMPALAConfig(AlgorithmConfig):
 
 
 def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
-           discounts, clip_rho: float = 1.0, clip_pg_rho: float = 1.0):
+           discounts, clip_rho: float = 1.0, clip_pg_rho: float = 1.0,
+           mask=None):
     """V-trace targets over one trajectory (T,) — lax.scan from the tail
-    (ref: vtrace_torch.py multi_from_logits, single-agent form)."""
+    (ref: vtrace_torch.py multi_from_logits, single-agent form).  ``mask``
+    zeroes padded steps' deltas so they can't perturb real steps."""
     rhos = jnp.exp(target_logp - behavior_logp)
     clipped_rhos = jnp.minimum(clip_rho, rhos)
     cs = jnp.minimum(1.0, rhos)
     values_next = jnp.concatenate([values[1:], bootstrap_value[None]])
     deltas = clipped_rhos * (rewards + discounts * values_next - values)
+    if mask is not None:
+        deltas = deltas * mask
 
     def backward(acc, t):
         acc = deltas[t] + discounts[t] * cs[t] * acc
@@ -74,21 +78,25 @@ class IMPALALearner(JaxLearner):
         inputs = out[Columns.ACTION_DIST_INPUTS]
         target_logp = dist.logp(inputs, batch[Columns.ACTIONS])
         values = out[Columns.VF_PREDS]
+        mask = batch["mask"]
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
 
         # vmapped over the fragment axis: batch comes in as (B, T, ...).
+        # Padded steps have discount 0 AND masked deltas, so nothing leaks
+        # backward through the scan into real steps.
         vs, pg_adv = jax.vmap(
-            lambda blp, tlp, r, v, bv, d: vtrace(
+            lambda blp, tlp, r, v, bv, d, m: vtrace(
                 blp, tlp, r, v, bv, d,
                 cfg.vtrace_clip_rho_threshold,
-                cfg.vtrace_clip_pg_rho_threshold)
+                cfg.vtrace_clip_pg_rho_threshold, mask=m)
         )(batch[Columns.ACTION_LOGP], target_logp, batch[Columns.REWARDS],
-          values, batch["bootstrap_value"], batch["discounts"])
+          values, batch["bootstrap_value"], batch["discounts"], mask)
 
         vs = jax.lax.stop_gradient(vs)
         pg_adv = jax.lax.stop_gradient(pg_adv)
-        policy_loss = -jnp.mean(target_logp * pg_adv)
-        value_loss = 0.5 * jnp.mean(jnp.square(values - vs))
-        entropy = jnp.mean(dist.entropy(inputs))
+        policy_loss = -jnp.sum(target_logp * pg_adv * mask) / denom
+        value_loss = 0.5 * jnp.sum(jnp.square(values - vs) * mask) / denom
+        entropy = jnp.sum(dist.entropy(inputs) * mask) / denom
         total = (policy_loss + cfg.vf_loss_coeff * value_loss
                  - cfg.entropy_coeff * entropy)
         return total, {"policy_loss": policy_loss, "vf_loss": value_loss,
@@ -105,38 +113,49 @@ class IMPALA(Algorithm):
         self._updates = 0
 
     def _batch_from_episodes(self, episodes) -> Dict[str, np.ndarray]:
-        """Pad fragments to (B, T) for the vmapped V-trace."""
+        """Chunk fragments into (B, T) rows for the vmapped V-trace.
+
+        Fragments longer than T are SPLIT into multiple rows (never
+        discarded); short rows are zero-padded and masked out of the loss.
+        """
         cfg = self.algo_config
         T = cfg.rollout_fragment_length
         cols: Dict[str, List] = {k: [] for k in
                                  (Columns.OBS, Columns.ACTIONS, Columns.REWARDS,
-                                  Columns.ACTION_LOGP, "discounts",
+                                  Columns.ACTION_LOGP, "discounts", "mask",
                                   "bootstrap_obs", "bootstrap_terminated")}
         for ep in episodes:
             arr = ep.to_numpy()
             t = len(ep)
-            if t == 0:
-                continue
-            pad = T - t if t < T else 0
+            for start in range(0, t, T):
+                end = min(start + T, t)
+                n = end - start
+                pad = T - n
 
-            def padded(x, value=0.0):
-                x = x[:T]
+                def padded(x, value=0.0):
+                    x = x[start:end]
+                    if pad:
+                        x = np.concatenate([x, np.full((pad, *x.shape[1:]),
+                                                       value, x.dtype)])
+                    return x
+
+                cols[Columns.OBS].append(padded(arr["obs"][:-1]))
+                cols[Columns.ACTIONS].append(padded(arr["actions"]))
+                cols[Columns.REWARDS].append(padded(arr["rewards"]))
+                cols[Columns.ACTION_LOGP].append(padded(arr[Columns.ACTION_LOGP]))
+                terminal_chunk = ep.is_terminated and end == t
+                disc = np.full(n, cfg.gamma, np.float32)
+                if terminal_chunk:
+                    disc[-1] = 0.0
                 if pad:
-                    x = np.concatenate([x, np.full((pad, *x.shape[1:]), value,
-                                                   x.dtype)])
-                return x
-
-            cols[Columns.OBS].append(padded(arr["obs"][:-1]))
-            cols[Columns.ACTIONS].append(padded(arr["actions"]))
-            cols[Columns.REWARDS].append(padded(arr["rewards"]))
-            cols[Columns.ACTION_LOGP].append(padded(arr[Columns.ACTION_LOGP]))
-            disc = np.full(min(t, T), self.algo_config.gamma, np.float32)
-            if ep.is_terminated and t <= T:
-                disc[t - 1] = 0.0
-            cols["discounts"].append(padded(disc) if pad else disc)
-            cols["bootstrap_obs"].append(arr["obs"][min(t, T)])
-            cols["bootstrap_terminated"].append(
-                1.0 if (ep.is_terminated and t <= T) else 0.0)
+                    disc = np.concatenate([disc, np.zeros(pad, np.float32)])
+                cols["discounts"].append(disc)
+                mask = np.concatenate([np.ones(n, np.float32),
+                                       np.zeros(pad, np.float32)])
+                cols["mask"].append(mask)
+                cols["bootstrap_obs"].append(arr["obs"][end])
+                cols["bootstrap_terminated"].append(
+                    1.0 if terminal_chunk else 0.0)
         batch = {k: np.stack(v).astype(np.float32) if k != Columns.ACTIONS
                  else np.stack(v)
                  for k, v in cols.items()}

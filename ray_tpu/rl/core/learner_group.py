@@ -54,7 +54,10 @@ class LearnerGroup:
                           if minibatch_size else None)
         refs = []
         for r, learner in enumerate(self._remote):
-            sl = slice(r * shard, (r + 1) * shard if r < world - 1 else n)
+            # EQUAL shards (up to world-1 remainder rows dropped): every rank
+            # must run the identical number of minibatches or the gradient
+            # allreduce deadlocks on the odd one out.
+            sl = slice(r * shard, (r + 1) * shard)
             sub = {k: v[sl] for k, v in batch.items()}
             refs.append(learner.update_from_batch.remote(
                 sub, num_epochs=num_epochs, minibatch_size=per_learner_mb))

@@ -47,13 +47,20 @@ class EnvRunnerGroup:
                 num_timesteps=num_timesteps, num_episodes=num_episodes,
                 random_actions=random_actions)
         n = len(self._remote_runners)
-        per_ts = None if num_timesteps is None else max(1, num_timesteps // n)
-        per_eps = None if num_episodes is None else max(1, num_episodes // n)
-        refs = [
-            r.sample.remote(num_timesteps=per_ts, num_episodes=per_eps,
-                            random_actions=random_actions)
-            for r in self._remote_runners
-        ]
+        refs = []
+        for i, r in enumerate(self._remote_runners):
+            # Spread the remainder over the first runners so the totals add
+            # up to exactly num_timesteps / num_episodes.
+            per_ts = per_eps = None
+            if num_timesteps is not None:
+                per_ts = num_timesteps // n + (1 if i < num_timesteps % n else 0)
+            if num_episodes is not None:
+                per_eps = num_episodes // n + (1 if i < num_episodes % n else 0)
+            if per_ts == 0 or per_eps == 0:
+                continue
+            refs.append(r.sample.remote(num_timesteps=per_ts,
+                                        num_episodes=per_eps,
+                                        random_actions=random_actions))
         episodes: List = []
         for chunk in ray_tpu.get(refs):
             episodes.extend(chunk)

@@ -1,0 +1,25 @@
+"""ray_tpu.serve — model serving library.
+
+Counterpart of the reference's Ray Serve (ref: python/ray/serve/ — controller
+reconciling deployment/replica state, pow-2 queue-aware routing, HTTP ingress,
+handle composition), with replicas as async actors suited to hosting JAX
+models: a replica pins its jitted program once and serves concurrent
+requests from one event loop.
+"""
+
+from ray_tpu.serve.api import (Application, Deployment, delete, deployment,
+                               get_app_handle, get_deployment_handle, run,
+                               shutdown, start, status)
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from ray_tpu.serve.context import get_multiplexed_model_id
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import multiplexed
+from ray_tpu.serve.proxy import Request
+
+__all__ = [
+    "Application", "Deployment", "deployment", "run", "start", "shutdown",
+    "delete", "status", "get_app_handle", "get_deployment_handle",
+    "AutoscalingConfig", "DeploymentConfig", "HTTPOptions",
+    "DeploymentHandle", "DeploymentResponse", "Request", "multiplexed",
+    "get_multiplexed_model_id",
+]

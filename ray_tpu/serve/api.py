@@ -1,0 +1,236 @@
+"""Serve public API — @deployment, bind/run, handles.
+
+(ref: python/ray/serve/api.py — serve.deployment decorator, serve.run;
+app graph built via .bind() (build_app.py) with nested deployments turned
+into DeploymentHandles at deploy time.)
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from ray_tpu.serve.handle import DeploymentHandle
+
+_CONTROLLER_NAME = "SERVE_CONTROLLER"
+_lock = threading.Lock()
+_state: Dict[str, Any] = {"controller": None, "proxy": None}
+
+
+# ---------------------------------------------------------------- deployment
+class Deployment:
+    """The decorated, not-yet-bound deployment (ref: serve/deployment.py
+    Deployment)."""
+
+    def __init__(self, func_or_class: Any, name: str, config: DeploymentConfig):
+        self.func_or_class = func_or_class
+        self.name = name
+        self.config = config
+
+    def options(self, *, name: Optional[str] = None,
+                num_replicas: Optional[Union[int, str]] = None,
+                max_ongoing_requests: Optional[int] = None,
+                user_config: Optional[Any] = None,
+                autoscaling_config: Optional[Union[AutoscalingConfig, Dict]] = None,
+                ray_actor_options: Optional[Dict] = None) -> "Deployment":
+        import copy
+
+        cfg = copy.deepcopy(self.config)
+        if num_replicas is not None and num_replicas != "auto":
+            cfg.num_replicas = num_replicas
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if user_config is not None:
+            cfg.user_config = user_config
+        if autoscaling_config is not None:
+            if isinstance(autoscaling_config, dict):
+                autoscaling_config = AutoscalingConfig(**autoscaling_config)
+            cfg.autoscaling_config = autoscaling_config
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = dict(ray_actor_options)
+        return Deployment(self.func_or_class, name or self.name, cfg)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def __call__(self, *a, **kw):
+        raise RuntimeError(
+            "Deployments cannot be called directly; use .bind() + serve.run, "
+            "then handle.remote() (ref: serve deployment calling contract)")
+
+
+@dataclass
+class Application:
+    """A bound (sub)graph of deployments (ref: serve Application /
+    build_app.py BuiltApplication)."""
+
+    deployment: Deployment
+    args: tuple = ()
+    kwargs: Dict[str, Any] = field(default_factory=dict)
+
+
+def deployment(_func_or_class: Optional[Any] = None, *,
+               name: Optional[str] = None,
+               num_replicas: Union[int, str, None] = None,
+               max_ongoing_requests: int = 5,
+               user_config: Optional[Any] = None,
+               autoscaling_config: Optional[Union[AutoscalingConfig, Dict]] = None,
+               ray_actor_options: Optional[Dict] = None,
+               health_check_period_s: float = 10.0) -> Any:
+    """@serve.deployment (ref: serve/api.py:deployment)."""
+
+    def decorate(obj):
+        if isinstance(autoscaling_config, dict):
+            asc = AutoscalingConfig(**autoscaling_config)
+        else:
+            asc = autoscaling_config
+        cfg = DeploymentConfig(
+            num_replicas=(num_replicas if isinstance(num_replicas, int) else 1),
+            max_ongoing_requests=max_ongoing_requests,
+            user_config=user_config,
+            autoscaling_config=asc,
+            health_check_period_s=health_check_period_s,
+            ray_actor_options=dict(ray_actor_options or {}))
+        return Deployment(obj, name or obj.__name__, cfg)
+
+    if _func_or_class is not None:
+        return decorate(_func_or_class)
+    return decorate
+
+
+# ------------------------------------------------------------------ runtime
+def _get_controller():
+    with _lock:
+        if _state["controller"] is None:
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            try:
+                _state["controller"] = ray_tpu.get_actor(_CONTROLLER_NAME)
+            except Exception:
+                from ray_tpu.serve.controller import ServeController
+
+                # High max_concurrency: parked long-poll listens from every
+                # router/proxy share this actor's loop and must not serialize
+                # behind each other (ref: controller.py — async controller).
+                _state["controller"] = (
+                    ray_tpu.remote(ServeController)
+                    .options(name=_CONTROLLER_NAME, lifetime="detached",
+                             max_concurrency=1000)
+                    .remote())
+        return _state["controller"]
+
+
+def start(http_options: Optional[Union[HTTPOptions, Dict]] = None,
+          detached: bool = True) -> None:
+    """Start the Serve instance: controller + HTTP proxy
+    (ref: serve/api.py start — proxy comes up with default HTTPOptions
+    unless overridden)."""
+    controller = _get_controller()
+    if _state["proxy"] is None:
+        if isinstance(http_options, dict):
+            http_options = HTTPOptions(**http_options)
+        from ray_tpu.serve.proxy import HTTPProxy
+
+        _state["proxy"] = HTTPProxy(controller, http_options or HTTPOptions())
+        _state["proxy"].start()
+
+
+def _build_app(app: Application, app_name: str) -> tuple:
+    """Flatten the bind graph into deployment descriptors; nested
+    Applications become DeploymentHandles (ref: build_app.py build_app)."""
+    deployments: Dict[str, Dict[str, Any]] = {}
+
+    def visit(node: Application) -> DeploymentHandle:
+        dep = node.deployment
+
+        def convert(v):
+            return visit(v) if isinstance(v, Application) else v
+
+        args = tuple(convert(a) for a in node.args)
+        kwargs = {k: convert(v) for k, v in node.kwargs.items()}
+        existing = deployments.get(dep.name)
+        desc = {"name": dep.name, "deployment_def": dep.func_or_class,
+                "init_args": args, "init_kwargs": kwargs, "config": dep.config}
+        if existing is None:
+            deployments[dep.name] = desc
+        return DeploymentHandle(dep.name, app_name)
+
+    ingress_handle = visit(app)
+    return list(deployments.values()), app.deployment.name, ingress_handle
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/", blocking: bool = False,
+        _local_testing_mode: bool = False) -> DeploymentHandle:
+    """Deploy an application and wait for it to be ready
+    (ref: serve/api.py run / _run)."""
+    controller = _get_controller()
+    descs, ingress_name, handle = _build_app(app, name)
+    ray_tpu.get(controller.deploy_application.remote(
+        name, route_prefix, ingress_name, descs))
+    _wait_for_application(name, timeout_s=60.0)
+    if blocking:  # pragma: no cover - interactive mode
+        import time as _t
+
+        while True:
+            _t.sleep(1)
+    return handle
+
+
+def _wait_for_application(app_name: str, timeout_s: float) -> None:
+    import time
+
+    controller = _get_controller()
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        status = ray_tpu.get(controller.get_deployment_status.remote())
+        app_deps = {k: v for k, v in status.items()
+                    if k.startswith(f"{app_name}#")}
+        if app_deps and all(v["status"] == "HEALTHY" for v in app_deps.values()):
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"Application {app_name!r} not healthy in {timeout_s}s")
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    """(ref: serve/api.py get_app_handle)"""
+    controller = _get_controller()
+    app = ray_tpu.get(controller.get_app_config.remote(name))
+    if app is None:
+        raise ValueError(f"Application {name!r} does not exist")
+    return DeploymentHandle(app["ingress"], name)
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def status() -> Dict[str, Any]:
+    controller = _get_controller()
+    return ray_tpu.get(controller.get_deployment_status.remote())
+
+
+def delete(name: str, _blocking: bool = True) -> None:
+    controller = _get_controller()
+    ray_tpu.get(controller.delete_application.remote(name))
+
+
+def shutdown() -> None:
+    """(ref: serve/api.py shutdown)"""
+    with _lock:
+        controller = _state["controller"]
+        proxy = _state.pop("proxy", None)
+        _state["controller"] = None
+        _state["proxy"] = None
+    if proxy is not None:
+        proxy.stop()
+    if controller is not None:
+        try:
+            ray_tpu.get(controller.graceful_shutdown.remote(), timeout=15.0)
+            ray_tpu.kill(controller)
+        except Exception:
+            pass

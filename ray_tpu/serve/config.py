@@ -1,0 +1,56 @@
+"""Serve configuration schemas.
+
+(ref: python/ray/serve/config.py — AutoscalingConfig, HTTPOptions;
+python/ray/serve/_private/config.py DeploymentConfig/ReplicaConfig.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class AutoscalingConfig:
+    """(ref: serve/config.py AutoscalingConfig — request-based policy driven
+    by handle-reported queue metrics)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 3.0
+    downscale_delay_s: float = 30.0
+    metrics_interval_s: float = 1.0
+    initial_replicas: Optional[int] = None
+
+
+@dataclass
+class DeploymentConfig:
+    """(ref: serve/_private/config.py DeploymentConfig)."""
+
+    num_replicas: int = 1
+    max_ongoing_requests: int = 5
+    user_config: Optional[Any] = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 10.0
+    health_check_timeout_s: float = 30.0
+    graceful_shutdown_timeout_s: float = 5.0
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class HTTPOptions:
+    """(ref: serve/config.py HTTPOptions)."""
+
+    host: str = "127.0.0.1"
+    port: int = 8000
+
+
+@dataclass
+class ReplicaConfig:
+    """What a replica actor needs to construct the user callable
+    (ref: _private/config.py ReplicaConfig — serialized def + args)."""
+
+    deployment_def: Any = None
+    init_args: tuple = ()
+    init_kwargs: Dict[str, Any] = field(default_factory=dict)

@@ -1,0 +1,192 @@
+"""ServeController — the Serve control plane actor.
+
+(ref: python/ray/serve/_private/controller.py:84 ServeController — async
+actor reconciling application/deployment state every tick, broadcasting
+replica membership via LongPollHost, running autoscaling off replica queue
+metrics (autoscaling_state.py); the request path never touches it.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.serve.config import DeploymentConfig
+from ray_tpu.serve.deployment_state import DeploymentInfo, DeploymentStateManager
+from ray_tpu.serve.long_poll import LongPollHost
+
+CONTROL_LOOP_INTERVAL_S = 0.05
+
+
+class ServeController:
+    def __init__(self) -> None:
+        self._manager = DeploymentStateManager()
+        self._long_poll = LongPollHost()
+        self._apps: Dict[str, Dict[str, Any]] = {}  # app -> {route_prefix, deployments, ingress}
+        self._replica_sets: Dict[str, List[Dict[str, Any]]] = {}
+        self._autoscale_state: Dict[str, Dict[str, float]] = {}
+        #: dep_id -> router_id -> (total_inflight, ts); handle-reported
+        #: (ref: autoscaling_state.py — queue metrics come from handles)
+        self._handle_metrics: Dict[str, Dict[str, tuple]] = {}
+        self._loop_task: Optional[asyncio.Task] = None
+        self._shutdown = False
+
+    async def _ensure_loop(self) -> None:
+        if self._loop_task is None:
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self.run_control_loop())
+
+    # ------------------------------------------------------------ app deploy
+    async def deploy_application(self, app_name: str, route_prefix: Optional[str],
+                                 ingress_name: str,
+                                 deployments: List[Dict[str, Any]]) -> None:
+        """(ref: controller.py deploy_application / application_state.py)"""
+        await self._ensure_loop()
+        new_names = {d["name"] for d in deployments}
+        old = self._apps.get(app_name)
+        if old:
+            for name in old["deployments"]:
+                if name not in new_names:
+                    self._manager.delete(f"{app_name}#{name}")
+        for d in deployments:
+            info = DeploymentInfo(
+                name=d["name"], app_name=app_name,
+                deployment_def=d["deployment_def"],
+                init_args=tuple(d.get("init_args", ())),
+                init_kwargs=dict(d.get("init_kwargs", {})),
+                config=d.get("config") or DeploymentConfig(),
+                route_prefix=route_prefix)
+            self._manager.deploy(info)
+        self._apps[app_name] = {
+            "route_prefix": route_prefix,
+            "deployments": sorted(new_names),
+            "ingress": ingress_name,
+        }
+        self._broadcast_routes()
+
+    async def delete_application(self, app_name: str) -> None:
+        app = self._apps.pop(app_name, None)
+        if not app:
+            return
+        for name in app["deployments"]:
+            self._manager.delete(f"{app_name}#{name}")
+        self._broadcast_routes()
+
+    def _broadcast_routes(self) -> None:
+        routes = {
+            app["route_prefix"]: {"app_name": name, "ingress": app["ingress"]}
+            for name, app in self._apps.items()
+            if app["route_prefix"]
+        }
+        self._long_poll.notify_changed({"route_table": routes})
+
+    # ---------------------------------------------------------- control loop
+    async def run_control_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                updates = self._manager.reconcile()
+                if updates:
+                    self._replica_sets.update(updates)
+                    self._long_poll.notify_changed({
+                        f"replicas::{dep_id}": replicas
+                        for dep_id, replicas in updates.items()
+                    })
+                await self._autoscale_tick()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            await asyncio.sleep(CONTROL_LOOP_INTERVAL_S)
+
+    def record_handle_metrics(self, deployment_id: str, router_id: str,
+                              total_inflight: int) -> None:
+        """Handle-side queue report (ref: autoscaling_state.py
+        record_request_metrics_for_handle)."""
+        self._handle_metrics.setdefault(deployment_id, {})[router_id] = (
+            int(total_inflight), time.time())
+
+    async def _autoscale_tick(self) -> None:
+        """Queue-based autoscaling off handle-reported metrics (ref:
+        autoscaling_state.py — average ongoing requests per RUNNING replica
+        vs target_ongoing_requests, with up/downscale delays)."""
+        now = time.time()
+        for dep_id, state in self._manager.deployments.items():
+            cfg = state.info.config.autoscaling_config
+            if cfg is None:
+                continue
+            st = self._autoscale_state.setdefault(
+                dep_id, {"last_check": 0.0, "above_since": -1.0,
+                         "below_since": -1.0})
+            if now - st["last_check"] < cfg.metrics_interval_s:
+                continue
+            st["last_check"] = now
+            num_running = state.num_running()
+            if num_running == 0:
+                continue
+            reports = self._handle_metrics.get(dep_id, {})
+            fresh = [n for n, ts in reports.values() if now - ts < 2.0]
+            if not fresh:
+                continue
+            avg = sum(fresh) / num_running
+            target = state.target_num
+            if avg > cfg.target_ongoing_requests and target < cfg.max_replicas:
+                if st["above_since"] < 0:
+                    st["above_since"] = now
+                if now - st["above_since"] >= cfg.upscale_delay_s:
+                    desired = max(target + 1, int(
+                        num_running * avg / cfg.target_ongoing_requests))
+                    state.set_target_num(min(desired, cfg.max_replicas))
+                    st["above_since"] = -1.0
+            else:
+                st["above_since"] = -1.0
+            if avg < cfg.target_ongoing_requests / 2 and target > cfg.min_replicas:
+                if st["below_since"] < 0:
+                    st["below_since"] = now
+                if now - st["below_since"] >= cfg.downscale_delay_s:
+                    state.set_target_num(max(target - 1, cfg.min_replicas))
+                    st["below_since"] = -1.0
+            else:
+                st["below_since"] = -1.0
+
+    # --------------------------------------------------------------- queries
+    async def listen_for_change(self, keys_to_snapshot_ids: Dict[str, int],
+                                timeout_s: float = 30.0):
+        await self._ensure_loop()
+        return await self._long_poll.listen_for_change(keys_to_snapshot_ids,
+                                                       timeout_s)
+
+    def get_app_config(self, app_name: str) -> Optional[Dict[str, Any]]:
+        return self._apps.get(app_name)
+
+    def list_applications(self) -> List[str]:
+        return sorted(self._apps)
+
+    def get_deployment_status(self) -> Dict[str, Dict[str, Any]]:
+        """(ref: serve.status() — DeploymentStatus per deployment)"""
+        out = {}
+        for dep_id, state in self._manager.deployments.items():
+            running = state.num_running()
+            out[dep_id] = {
+                "target_num_replicas": state.target_num,
+                "running_replicas": running,
+                "status": ("HEALTHY" if running >= state.target_num
+                           else "UPDATING"),
+            }
+        return out
+
+    async def graceful_shutdown(self) -> None:
+        self._shutdown = True
+        for app in list(self._apps):
+            await self.delete_application(app)
+        # Drain replica teardown.
+        deadline = time.time() + 10
+        while self._manager.deployments and time.time() < deadline:
+            updates = self._manager.reconcile()
+            if updates:
+                self._long_poll.notify_changed({
+                    f"replicas::{dep_id}": replicas
+                    for dep_id, replicas in updates.items()
+                })
+            await asyncio.sleep(0.02)

@@ -1,0 +1,235 @@
+"""Deployment & replica state machines + reconciler.
+
+(ref: python/ray/serve/_private/deployment_state.py — DeploymentState:1248
+replica FSM with STARTING/RUNNING/STOPPING sets, rolling updates on version
+change; DeploymentStateManager:2339 reconciles every control-loop tick.)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve.config import DeploymentConfig
+from ray_tpu.serve.replica import ReplicaActor
+
+
+@dataclass
+class DeploymentInfo:
+    name: str
+    app_name: str
+    deployment_def: Any
+    init_args: tuple = ()
+    init_kwargs: Dict[str, Any] = field(default_factory=dict)
+    config: DeploymentConfig = field(default_factory=DeploymentConfig)
+    route_prefix: Optional[str] = None
+
+    @property
+    def id(self) -> str:
+        return f"{self.app_name}#{self.name}"
+
+    def version(self) -> str:
+        """Code+config identity driving rolling updates (ref:
+        deployment_state DeploymentVersion)."""
+        h = hashlib.sha256()
+        h.update(getattr(self.deployment_def, "__qualname__", str(self.deployment_def)).encode())
+        try:
+            h.update(pickle.dumps((self.init_args, self.init_kwargs,
+                                   self.config.user_config)))
+        except Exception:
+            h.update(repr((self.init_args, self.init_kwargs,
+                           self.config.user_config)).encode())
+        return h.hexdigest()[:16]
+
+
+class ReplicaState:
+    STARTING = "STARTING"
+    RUNNING = "RUNNING"
+    STOPPING = "STOPPING"
+
+
+class ReplicaWrapper:
+    """One replica actor + its FSM state (ref: deployment_state.py
+    DeploymentReplica)."""
+
+    def __init__(self, info: DeploymentInfo):
+        self.replica_id = f"{info.name}#{uuid.uuid4().hex[:6]}"
+        self.version = info.version()
+        self.state = ReplicaState.STARTING
+        self.started_at = time.time()
+        opts = dict(info.config.ray_actor_options)
+        opts.setdefault("max_concurrency", max(1, info.config.max_ongoing_requests))
+        self.actor = ray_tpu.remote(ReplicaActor).options(**opts).remote(
+            info.name, self.replica_id, info.deployment_def,
+            info.init_args, dict(info.init_kwargs),
+            user_config=info.config.user_config)
+        self._ready_ref = self.actor.initialize_and_get_metadata.remote()
+        self._stop_ref = None
+
+    def check_ready(self) -> Optional[bool]:
+        """True ready / False failed / None still starting."""
+        ready, _ = ray_tpu.wait([self._ready_ref], num_returns=1, timeout=0)
+        if not ready:
+            return None
+        try:
+            ray_tpu.get(self._ready_ref)
+            return True
+        except Exception:
+            return False
+
+    def begin_stop(self) -> None:
+        self.state = ReplicaState.STOPPING
+        self._stop_ref = self.actor.prepare_for_shutdown.remote()
+
+    def check_stopped(self) -> bool:
+        if self._stop_ref is None:
+            return True
+        done, _ = ray_tpu.wait([self._stop_ref], num_returns=1, timeout=0)
+        if done or time.time() - self.started_at > 60:
+            try:
+                ray_tpu.kill(self.actor)
+            except Exception:
+                pass
+            return True
+        return False
+
+
+class DeploymentState:
+    """Reconciles actual replicas toward the target (ref:
+    deployment_state.py DeploymentState.update())."""
+
+    def __init__(self, info: DeploymentInfo):
+        self.info = info
+        self.target_num = (info.config.autoscaling_config.initial_replicas
+                           or info.config.autoscaling_config.min_replicas
+                           if info.config.autoscaling_config
+                           else info.config.num_replicas)
+        self.replicas: List[ReplicaWrapper] = []
+        self.deleting = False
+        self._changed = True
+
+    # ------------------------------------------------------------- targets
+    def set_target(self, info: DeploymentInfo) -> None:
+        old_version = self.info.version()
+        autoscaling = info.config.autoscaling_config
+        if autoscaling:
+            self.target_num = min(max(self.target_num,
+                                      autoscaling.min_replicas),
+                                  autoscaling.max_replicas)
+        else:
+            self.target_num = info.config.num_replicas
+        self.info = info
+        if info.version() != old_version:
+            self._changed = True
+
+    def set_target_num(self, n: int) -> None:
+        """Autoscaler entry point."""
+        if n != self.target_num:
+            self.target_num = n
+            self._changed = True
+
+    def delete(self) -> None:
+        self.deleting = True
+        self.target_num = 0
+
+    # ------------------------------------------------------------ reconcile
+    def reconcile(self) -> bool:
+        """One tick; returns True if the running-replica set changed."""
+        changed = False
+        target_version = self.info.version()
+
+        # STARTING → RUNNING / failed
+        for r in list(self.replicas):
+            if r.state == ReplicaState.STARTING:
+                ready = r.check_ready()
+                if ready is True:
+                    r.state = ReplicaState.RUNNING
+                    changed = True
+                elif ready is False:
+                    self.replicas.remove(r)  # failed start; next tick re-adds
+
+        # STOPPING → gone
+        for r in list(self.replicas):
+            if r.state == ReplicaState.STOPPING and r.check_stopped():
+                self.replicas.remove(r)
+
+        live = [r for r in self.replicas if r.state != ReplicaState.STOPPING]
+
+        # Rolling update: stop one outdated replica per tick once a same-or-
+        # newer replacement is running (ref: deployment_state rolling update
+        # with max surge).
+        outdated = [r for r in live if r.version != target_version]
+        if outdated:
+            current = [r for r in live if r.version == target_version]
+            if len(current) < self.target_num and \
+                    len(live) <= self.target_num:
+                self.replicas.append(ReplicaWrapper(self.info))
+            running_current = [r for r in current
+                               if r.state == ReplicaState.RUNNING]
+            if running_current or self.target_num == 0:
+                victim = outdated[0]
+                victim.begin_stop()
+                changed = True
+            return changed or bool(outdated)
+
+        # Scale up/down to target.
+        if len(live) < self.target_num:
+            for _ in range(self.target_num - len(live)):
+                self.replicas.append(ReplicaWrapper(self.info))
+        elif len(live) > self.target_num:
+            # Prefer stopping replicas that are still starting.
+            victims = sorted(live, key=lambda r: r.state == ReplicaState.RUNNING)
+            for r in victims[: len(live) - self.target_num]:
+                r.begin_stop()
+                changed = True
+        return changed
+
+    # -------------------------------------------------------------- queries
+    def running_replicas(self) -> List[Dict[str, Any]]:
+        return [{"replica_id": r.replica_id, "actor": r.actor,
+                 "max_ongoing_requests": self.info.config.max_ongoing_requests}
+                for r in self.replicas if r.state == ReplicaState.RUNNING]
+
+    @property
+    def is_deleted(self) -> bool:
+        return self.deleting and not self.replicas
+
+    def num_running(self) -> int:
+        return sum(1 for r in self.replicas if r.state == ReplicaState.RUNNING)
+
+
+class DeploymentStateManager:
+    """(ref: deployment_state.py:2339 DeploymentStateManager)"""
+
+    def __init__(self) -> None:
+        self.deployments: Dict[str, DeploymentState] = {}
+
+    def deploy(self, info: DeploymentInfo) -> None:
+        state = self.deployments.get(info.id)
+        if state is None:
+            self.deployments[info.id] = DeploymentState(info)
+        else:
+            state.deleting = False
+            state.set_target(info)
+
+    def delete(self, deployment_id: str) -> None:
+        if deployment_id in self.deployments:
+            self.deployments[deployment_id].delete()
+
+    def reconcile(self) -> Dict[str, List[Dict[str, Any]]]:
+        """Tick all deployments; return {deployment_id: running_replicas}
+        for those whose replica membership changed."""
+        updates: Dict[str, List[Dict[str, Any]]] = {}
+        for dep_id, state in list(self.deployments.items()):
+            if state.reconcile() or state._changed:
+                updates[dep_id] = state.running_replicas()
+                state._changed = False
+            if state.is_deleted:
+                del self.deployments[dep_id]
+                updates[dep_id] = []
+        return updates

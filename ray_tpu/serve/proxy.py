@@ -1,0 +1,150 @@
+"""HTTP ingress proxy.
+
+(ref: python/ray/serve/_private/proxy.py — ProxyActor:1142 runs uvicorn;
+HTTPProxy:763 matches the route table (long-poll refreshed) and forwards to
+the app's ingress deployment via a handle; here aiohttp replaces uvicorn.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.serve.config import HTTPOptions
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.long_poll import LongPollClient
+
+
+class Request:
+    """Minimal request object handed to user callables (ref: Serve passes
+    starlette.requests.Request; same duck-typed surface for the basics)."""
+
+    def __init__(self, method: str, path: str, query_params: Dict[str, str],
+                 headers: Dict[str, str], body: bytes):
+        self.method = method
+        self.path = path
+        self.query_params = query_params
+        self.headers = headers
+        self._body = body
+
+    async def body(self) -> bytes:
+        return self._body
+
+    async def json(self) -> Any:
+        return json.loads(self._body or b"null")
+
+    def __repr__(self) -> str:
+        return f"Request({self.method} {self.path})"
+
+
+class HTTPProxy:
+    """aiohttp server thread routing HTTP → ingress deployment handles."""
+
+    def __init__(self, controller_handle, options: HTTPOptions):
+        self._controller = controller_handle
+        self._options = options
+        self._route_table: Dict[str, Dict[str, str]] = {}
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._long_poll: Optional[LongPollClient] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._runner = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        self._long_poll = LongPollClient(
+            self._controller, {"route_table": self._update_routes})
+        self._thread = threading.Thread(target=self._serve_thread, daemon=True,
+                                        name="serve-http-proxy")
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError("HTTP proxy failed to start")
+
+    def _update_routes(self, table: Dict[str, Dict[str, str]]) -> None:
+        self._route_table = dict(table or {})
+
+    def _serve_thread(self) -> None:
+        asyncio.run(self._serve())
+
+    async def _serve(self) -> None:
+        from aiohttp import web
+
+        self._loop = asyncio.get_running_loop()
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._options.host, self._options.port)
+        await site.start()
+        # Resolve the actual port (supports port=0 for an ephemeral port).
+        server = getattr(site, "_server", None)
+        if server and getattr(server, "sockets", None):
+            self._options.port = server.sockets[0].getsockname()[1]
+        self._started.set()
+        while self._started.is_set():
+            await asyncio.sleep(0.1)
+        await self._runner.cleanup()
+
+    def stop(self) -> None:
+        self._started.clear()
+        if self._long_poll:
+            self._long_poll.stop()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    @property
+    def address(self) -> str:
+        return f"http://{self._options.host}:{self._options.port}"
+
+    # -------------------------------------------------------------- request
+    def _match_route(self, path: str):
+        """Longest-prefix route match (ref: proxy_router.py
+        LongestPrefixRouter.match_route)."""
+        best = None
+        for prefix, target in self._route_table.items():
+            norm = prefix.rstrip("/") or ""
+            if path == norm or path.startswith(norm + "/") or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, target)
+        return best
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        match = self._match_route(request.path)
+        if match is None:
+            return web.Response(
+                status=404,
+                text=f"No application at {request.path}. "
+                     f"Routes: {sorted(self._route_table)}")
+        prefix, target = match
+        app_name, ingress = target["app_name"], target["ingress"]
+        handle = self._handles.get(app_name)
+        if handle is None:
+            handle = self._handles[app_name] = DeploymentHandle(
+                ingress, app_name, self._controller)
+        body = await request.read()
+        req = Request(request.method, request.path,
+                      dict(request.query), dict(request.headers), body)
+        try:
+            response = handle.remote(req)
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: response.result(timeout_s=60.0))
+        except Exception as e:  # noqa: BLE001
+            return web.Response(status=500, text=f"Internal error: {e!r}")
+        return self._to_http_response(result)
+
+    @staticmethod
+    def _to_http_response(result: Any):
+        from aiohttp import web
+
+        if isinstance(result, web.Response):
+            return result
+        if isinstance(result, bytes):
+            return web.Response(body=result)
+        if isinstance(result, str):
+            return web.Response(text=result)
+        return web.json_response(result)

@@ -1,0 +1,121 @@
+"""Replica — the actor hosting one copy of a deployment's user callable.
+
+(ref: python/ray/serve/_private/replica.py — Replica:750 actor +
+UserCallableWrapper:1017 which invokes the user's sync/async
+callable/generator; queue length reported for the pow-2 router.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+
+class UserCallableWrapper:
+    """Builds and invokes the user callable (ref: replica.py:1017)."""
+
+    def __init__(self, deployment_def: Any, init_args: tuple,
+                 init_kwargs: Dict[str, Any]):
+        self._is_class = inspect.isclass(deployment_def)
+        if self._is_class:
+            self._callable = deployment_def(*init_args, **init_kwargs)
+        else:
+            self._callable = deployment_def
+
+    async def call(self, method_name: str, args: tuple, kwargs: dict) -> Any:
+        if self._is_class:
+            if method_name == "__call__":
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+        else:
+            target = self._callable
+        result = target(*args, **kwargs)
+        if inspect.isawaitable(result):
+            result = await result
+        if inspect.isgenerator(result):  # unary endpoint: drain to a list
+            result = list(result)
+        return result
+
+    async def call_reconfigure(self, user_config: Any) -> None:
+        if self._is_class and hasattr(self._callable, "reconfigure"):
+            out = self._callable.reconfigure(user_config)
+            if inspect.isawaitable(out):
+                await out
+
+    async def call_health_check(self) -> None:
+        if self._is_class and hasattr(self._callable, "check_health"):
+            out = self._callable.check_health()
+            if inspect.isawaitable(out):
+                await out
+
+
+class ReplicaActor:
+    """Async actor; concurrent requests bounded by the deployment's
+    max_ongoing_requests via the actor's max_concurrency (ref: replica.py
+    Replica — asyncio user code event loop)."""
+
+    def __init__(self, deployment_name: str, replica_id: str,
+                 deployment_def: Any, init_args: tuple,
+                 init_kwargs: Dict[str, Any],
+                 user_config: Any = None):
+        self.deployment_name = deployment_name
+        self.replica_id = replica_id
+        self._wrapper = UserCallableWrapper(deployment_def, init_args,
+                                            init_kwargs or {})
+        self._num_ongoing = 0
+        self._num_processed = 0
+        self._user_config = user_config
+        self._multiplexed_model_ids: list = []
+
+    async def initialize_and_get_metadata(self) -> Dict[str, Any]:
+        if self._user_config is not None:
+            await self._wrapper.call_reconfigure(self._user_config)
+        return {"replica_id": self.replica_id}
+
+    # ------------------------------------------------------------- requests
+    async def handle_request(self, method_name: str, *args, **kwargs) -> Any:
+        self._num_ongoing += 1
+        try:
+            from ray_tpu.serve import context as serve_context
+
+            serve_context._set_internal_replica_context(
+                deployment=self.deployment_name, replica_id=self.replica_id)
+            return await self._wrapper.call(method_name, args, kwargs)
+        finally:
+            self._num_ongoing -= 1
+            self._num_processed += 1
+
+    # ------------------------------------------------------------ control
+    def get_num_ongoing_requests(self) -> int:
+        """(ref: replica_scheduler queue-len probe RPC)"""
+        return self._num_ongoing
+
+    def get_metadata(self) -> Dict[str, Any]:
+        return {
+            "replica_id": self.replica_id,
+            "deployment": self.deployment_name,
+            "num_ongoing_requests": self._num_ongoing,
+            "num_processed_requests": self._num_processed,
+            "multiplexed_model_ids": list(self._multiplexed_model_ids),
+        }
+
+    def record_multiplexed_model_ids(self, model_ids: list) -> None:
+        self._multiplexed_model_ids = list(model_ids)
+
+    async def reconfigure(self, user_config: Any) -> None:
+        self._user_config = user_config
+        await self._wrapper.call_reconfigure(user_config)
+
+    async def check_health(self) -> bool:
+        await self._wrapper.call_health_check()
+        return True
+
+    async def prepare_for_shutdown(self) -> None:
+        """Drain: wait for in-flight requests (ref: replica graceful
+        shutdown loop)."""
+        deadline = time.time() + 5.0
+        while self._num_ongoing > 0 and time.time() < deadline:
+            await asyncio.sleep(0.02)

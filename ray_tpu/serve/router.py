@@ -1,0 +1,159 @@
+"""Router — picks a replica per request, pow-2 queue-aware.
+
+(ref: python/ray/serve/_private/router.py — Router:321/AsyncioRouter:340;
+replica choice in replica_scheduler/pow_2_scheduler.py
+PowerOfTwoChoicesReplicaScheduler:52 — sample two replicas, compare queue
+lengths, pick the shorter; queue metrics are HANDLE-reported to the
+controller for autoscaling (autoscaling_state.py), never probed from
+replicas — a saturated replica couldn't answer the probe anyway.)
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class PowerOfTwoChoicesReplicaScheduler:
+    """Locally-observed queue lengths: +1 on dispatch, -1 on completion.
+
+    The local view is exact for a single router and approximate across many
+    routers — the same trade the reference makes with its cached queue
+    lengths (pow_2_scheduler queue-len cache).
+    """
+
+    def __init__(self) -> None:
+        self._replicas: List[Dict[str, Any]] = []
+        self._inflight: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def update_replicas(self, replicas: List[Dict[str, Any]]) -> None:
+        with self._lock:
+            self._replicas = list(replicas)
+            live = {r["replica_id"] for r in self._replicas}
+            self._inflight = {rid: n for rid, n in self._inflight.items()
+                              if rid in live}
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self._replicas)
+
+    def total_inflight(self) -> int:
+        with self._lock:
+            return sum(self._inflight.values())
+
+    def on_request_sent(self, replica_id: str) -> None:
+        with self._lock:
+            self._inflight[replica_id] = self._inflight.get(replica_id, 0) + 1
+
+    def on_request_done(self, replica_id: str) -> None:
+        with self._lock:
+            if replica_id in self._inflight:
+                self._inflight[replica_id] = max(0, self._inflight[replica_id] - 1)
+
+    def choose_replica(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            replicas = list(self._replicas)
+            if not replicas:
+                return None
+            if len(replicas) == 1:
+                return replicas[0]
+            a, b = random.sample(replicas, 2)
+            qa = self._inflight.get(a["replica_id"], 0)
+            qb = self._inflight.get(b["replica_id"], 0)
+            return a if qa <= qb else b
+
+    def drop_replica(self, replica_id: str) -> bool:
+        """Remove a replica observed dead; True if any remain."""
+        with self._lock:
+            self._replicas = [r for r in self._replicas
+                              if r["replica_id"] != replica_id]
+            return bool(self._replicas)
+
+
+METRICS_PUSH_INTERVAL_S = 0.25
+
+
+class Router:
+    """Driver/proxy-side request router for one deployment (ref:
+    router.py Router — long-poll refreshed replica set; queue metrics pushed
+    to the controller for autoscaling)."""
+
+    def __init__(self, controller_handle, deployment_id: str):
+        self.deployment_id = deployment_id
+        self.router_id = uuid.uuid4().hex[:8]
+        self._controller = controller_handle
+        self._scheduler = PowerOfTwoChoicesReplicaScheduler()
+        self._replicas_populated = threading.Event()
+        from ray_tpu.serve.long_poll import LongPollClient
+
+        self._long_poll = LongPollClient(
+            controller_handle,
+            {f"replicas::{deployment_id}": self._update_replicas},
+        )
+        self._stopped = threading.Event()
+        self._metrics_thread = threading.Thread(
+            target=self._push_metrics_loop, daemon=True,
+            name=f"serve-router-metrics-{deployment_id}")
+        self._metrics_thread.start()
+
+    def _update_replicas(self, replicas: List[Dict[str, Any]]) -> None:
+        self._scheduler.update_replicas(replicas or [])
+        if replicas:
+            self._replicas_populated.set()
+        else:
+            self._replicas_populated.clear()
+
+    def _push_metrics_loop(self) -> None:
+        """Handle-side queue metric reporting (ref: autoscaling_state.py —
+        RUNNING replicas' queue lengths come from handles, pushed on the
+        metrics interval)."""
+        while not self._stopped.wait(METRICS_PUSH_INTERVAL_S):
+            try:
+                self._controller.record_handle_metrics.remote(
+                    self.deployment_id, self.router_id,
+                    self._scheduler.total_inflight())
+            except Exception:
+                pass
+
+    def assign_request(self, method_name: str, *args, **kwargs):
+        """Pick a replica and dispatch; returns the ObjectRef
+        (ref: Router.assign_request).  Replicas that turn out dead at
+        dispatch (rolling update raced the long-poll) are dropped locally
+        and the request re-assigned."""
+        from ray_tpu.exceptions import ActorDiedError
+
+        deadline = time.time() + 30.0
+        while True:
+            replica = self._scheduler.choose_replica()
+            if replica is None:
+                if not self._replicas_populated.wait(
+                        timeout=max(0.0, deadline - time.time())):
+                    raise TimeoutError(
+                        f"No running replicas for {self.deployment_id} after 30s")
+                continue
+            rid = replica["replica_id"]
+            try:
+                ref = replica["actor"].handle_request.remote(
+                    method_name, *args, **kwargs)
+            except ActorDiedError:
+                if not self._scheduler.drop_replica(rid):
+                    self._replicas_populated.clear()
+                if time.time() > deadline:
+                    raise
+                continue
+            break
+        self._scheduler.on_request_sent(rid)
+        # Decrement the local queue estimate when the reply lands.
+        from ray_tpu._private import runtime as _rt
+
+        fut = _rt.get_runtime().as_future(ref)
+        fut.add_done_callback(lambda _f: self._scheduler.on_request_done(rid))
+        return ref
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._long_poll.stop()

@@ -178,7 +178,72 @@ def test_dqn_cartpole_smoke(rt):
     algo.stop()
 
 
+# ---------------------------------------------------------------- DQN + PER
+def test_dqn_prioritized_replay_updates_priorities(rt):
+    config = (DQNConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0, rollout_fragment_length=8)
+              .training(train_batch_size=16, prioritized_replay=True,
+                        replay_buffer_capacity=500,
+                        num_steps_sampled_before_learning_starts=32,
+                        target_network_update_freq=5)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    for _ in range(8):
+        result = algo.train()
+    prios = algo.replay._priorities[:len(algo.replay)]
+    # priorities must have been refreshed away from the uniform initial 1.0
+    assert len(set(np.round(prios[prios > 0], 6))) > 1, prios[:20]
+    algo.stop()
+
+
+def test_dqn_epsilon_piecewise():
+    cfg = DQNConfig().environment("CartPole-v1")
+    cfg.epsilon = [(0, 1.0), (100, 0.5), (1000, 0.1)]
+    from ray_tpu.rl.algorithms.dqn import DQN
+
+    algo = object.__new__(DQN)
+    algo.algo_config = cfg
+    algo._lifetime_steps = 0
+    assert DQN._epsilon(algo) == 1.0
+    algo._lifetime_steps = 50
+    assert abs(DQN._epsilon(algo) - 0.75) < 1e-6
+    algo._lifetime_steps = 100
+    assert abs(DQN._epsilon(algo) - 0.5) < 1e-6
+    algo._lifetime_steps = 550
+    assert abs(DQN._epsilon(algo) - 0.3) < 1e-6
+    algo._lifetime_steps = 5000
+    assert abs(DQN._epsilon(algo) - 0.1) < 1e-6
+
+
 # ---------------------------------------------------------------- IMPALA
+def test_impala_batch_chunks_and_masks():
+    """Long fragments split into T-rows; padding masked, not discarded."""
+    from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig
+
+    cfg = IMPALAConfig().environment("CartPole-v1")
+    cfg.rollout_fragment_length = 10
+    algo = object.__new__(IMPALA)
+    algo.algo_config = cfg
+    ep = SingleAgentEpisode()
+    ep.add_env_reset(np.zeros(4, np.float32))
+    for i in range(23):  # 23 steps -> rows of 10, 10, 3
+        ep.add_env_step(np.full(4, i + 1, np.float32), 1, 1.0,
+                        terminated=(i == 22),
+                        extra={Columns.ACTION_LOGP: -0.5})
+    batch = IMPALA._batch_from_episodes(algo, [ep])
+    assert batch[Columns.OBS].shape == (3, 10, 4)
+    np.testing.assert_array_equal(batch["mask"][0], np.ones(10))
+    np.testing.assert_array_equal(batch["mask"][2],
+                                  [1, 1, 1, 0, 0, 0, 0, 0, 0, 0])
+    # terminal chunk: discount 0 at the last real step, bootstrap terminated
+    assert batch["discounts"][2][2] == 0.0
+    assert batch["bootstrap_terminated"][2] == 1.0
+    assert batch["bootstrap_terminated"][0] == 0.0
+    # no steps were discarded
+    assert int(batch["mask"].sum()) == 23
+
+
 def test_impala_cartpole_async(rt):
     config = (IMPALAConfig()
               .environment("CartPole-v1")
