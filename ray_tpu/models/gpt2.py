@@ -126,10 +126,24 @@ def _layernorm(x, scale, bias, eps=1e-5):
 
 
 def _attention(q, k, v, config: GPTConfig):
-    """Causal multi-head attention.  q,k,v: (B, S, H, hd)."""
+    """Causal multi-head attention.  q,k,v: (B, S, H, hd).
+
+    "ring"/"ulysses" are the context-parallel paths (ops/ring_attention.py):
+    attention runs seq-sharded over the mesh's `seq` axis — callers install
+    the mesh via jax.set_mesh (parallel/train_state.py jit_train_step(mesh=)).
+    """
     impl = config.attn_impl
-    if impl not in ("auto", "xla", "pallas"):
-        raise ValueError(f"Unknown attn_impl: {impl!r} (use auto|xla|pallas)")
+    if impl not in ("auto", "xla", "pallas", "ring", "ulysses"):
+        raise ValueError(
+            f"Unknown attn_impl: {impl!r} (use auto|xla|pallas|ring|ulysses)")
+    if impl == "ring":
+        from ray_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, causal=True)
+    if impl == "ulysses":
+        from ray_tpu.ops.ring_attention import ulysses_attention
+
+        return ulysses_attention(q, k, v, causal=True)
     if impl == "pallas" or (impl == "auto" and jax.default_backend() == "tpu"):
         try:
             from ray_tpu.ops.attention import flash_attention

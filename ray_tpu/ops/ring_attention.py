@@ -1,0 +1,157 @@
+"""Context parallelism: ring attention + Ulysses all-to-all attention.
+
+The reference has NO native sequence/context parallelism (SURVEY §2.3/§5 —
+delegated to DeepSpeed/HF over Ray-provided process groups).  Here it is
+native and TPU-shaped:
+
+- **Ring attention** (Liu et al. 2023): K/V chunks rotate around the `seq`
+  mesh axis via `lax.ppermute` (riding the ICI ring) while each device
+  accumulates its queries' attention with a streaming log-sum-exp — memory
+  per device is O(S/world), and the rotation overlaps with the block matmuls.
+- **Ulysses** (Jacobs et al. 2023): `lax.all_to_all` reshards
+  (seq-sharded, all heads) -> (full seq, head-sharded), runs ordinary
+  causal attention per head shard (flash-compatible), and reshards back.
+  Cheaper than the ring when heads % world == 0 and S fits per-device.
+
+Both are pure jnp/lax bodies meant for `shard_map`, so they are reverse-mode
+differentiable (scan + ppermute transpose) and compile to one XLA program.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30  # strictly-finite mask value: -inf breaks the streaming max
+
+
+# ---------------------------------------------------------------- ring local
+def ring_attention_local(q, k, v, *, axis_name: str = "seq",
+                         causal: bool = True,
+                         sm_scale: Optional[float] = None):
+    """Body for shard_map: q/k/v are (B, S_local, H, D) sequence shards.
+
+    Streaming-softmax accumulation over `world` rotation steps; the k/v
+    chunk held at step s originated on rank (idx - s) mod world, which
+    fixes the global positions for causal masking.
+    """
+    world = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    qpos = idx * S + jnp.arange(S)
+
+    m0 = jnp.full((B, H, S), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, S), jnp.float32)
+    o0 = jnp.zeros((B, S, H, D), jnp.float32)
+    # Mark the carry init as device-varying: the scan body's outputs vary
+    # over the mesh (they mix in ppermuted k/v), and shard_map's vma check
+    # requires carry-in types to match carry-out.
+    if hasattr(lax, "pcast"):
+        mesh_axes = tuple(jax.typeof(q).vma) if hasattr(jax, "typeof") else ()
+        if mesh_axes:
+            m0, l0, o0 = (lax.pcast(x, mesh_axes, to="varying")
+                          for x in (m0, l0, o0))
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    def step(carry, s):
+        k_cur, v_cur, m, l, o = carry
+        src_chunk = (idx - s) % world
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = src_chunk * S + jnp.arange(S)
+            mask = kpos[None, :] <= qpos[:, None]  # (Sq, Sk)
+            scores = jnp.where(mask, scores, _NEG)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        p = jnp.exp(scores - m_new[..., None])
+        if causal:
+            # exp(_NEG - _NEG) == 1 on fully-masked rows: zero them by hand.
+            p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_cur.dtype), v_cur,
+                        preferred_element_type=jnp.float32)
+        o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, m_new, l_new, o_new), None
+
+    (_, _, m, l, o), _ = lax.scan(step, (k, v, m0, l0, o0),
+                                  jnp.arange(world))
+    out = o / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+# ------------------------------------------------------------- ulysses local
+def ulysses_attention_local(q, k, v, *, axis_name: str = "seq",
+                            causal: bool = True,
+                            sm_scale: Optional[float] = None,
+                            attn_fn=None):
+    """Body for shard_map: all_to_all (B, S/w, H, D) -> (B, S, H/w, D),
+    full-sequence attention per head shard, then the inverse reshard."""
+    world = lax.axis_size(axis_name)
+    H = q.shape[2]
+    if H % world != 0:
+        raise ValueError(f"Ulysses needs heads ({H}) % seq axis ({world}) == 0")
+    if world > 1:
+        q, k, v = (lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True) for x in (q, k, v))
+    if attn_fn is None:
+        attn_fn = partial(_xla_attention, causal=causal, sm_scale=sm_scale)
+    out = attn_fn(q, k, v)
+    if world > 1:
+        out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                             tiled=True)
+    return out
+
+
+def _xla_attention(q, k, v, causal: bool = True,
+                   sm_scale: Optional[float] = None):
+    """Plain einsum-softmax-einsum causal attention (fp32 softmax)."""
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        S, K = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((S, K), bool))
+        scores = jnp.where(mask, scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+# ------------------------------------------------------------ shard_map APIs
+def _specs(axis_name: str, batch_axes):
+    P = jax.sharding.PartitionSpec
+    return P(batch_axes, axis_name, "tensor", None)
+
+
+def ring_attention(q, k, v, *, mesh=None, axis_name: str = "seq",
+                   causal: bool = True, sm_scale: Optional[float] = None,
+                   batch_axes=("data", "fsdp")):
+    """Context-parallel causal attention over seq-sharded (B, S, H, D).
+
+    With mesh=None the ambient mesh (jax.set_mesh / enclosing shard_map)
+    is used, so model code stays mesh-agnostic.
+    """
+    spec = _specs(axis_name, batch_axes)
+    fn = partial(ring_attention_local, axis_name=axis_name, causal=causal,
+                 sm_scale=sm_scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
+def ulysses_attention(q, k, v, *, mesh=None, axis_name: str = "seq",
+                      causal: bool = True, sm_scale: Optional[float] = None,
+                      attn_fn=None, batch_axes=("data", "fsdp")):
+    """Ulysses sequence parallelism over seq-sharded (B, S, H, D)."""
+    spec = _specs(axis_name, batch_axes)
+    fn = partial(ulysses_attention_local, axis_name=axis_name, causal=causal,
+                 sm_scale=sm_scale, attn_fn=attn_fn)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)

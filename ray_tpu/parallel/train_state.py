@@ -39,8 +39,21 @@ def create_sharded_state(
     return params, opt_state
 
 
-def jit_train_step(step_fn, donate_state: bool = True):
+def jit_train_step(step_fn, donate_state: bool = True, mesh=None):
     """jit with donated (params, opt_state) so updates reuse their buffers —
-    the HBM discipline that makes big models fit."""
+    the HBM discipline that makes big models fit.
+
+    Pass ``mesh`` when the model uses context-parallel attention
+    (attn_impl="ring"/"ulysses"): those ops shard_map over the AMBIENT mesh,
+    which this wrapper installs around trace/execute via jax.set_mesh.
+    """
     donate = (0, 1) if donate_state else ()
-    return jax.jit(step_fn, donate_argnums=donate)
+    jitted = jax.jit(step_fn, donate_argnums=donate)
+    if mesh is None:
+        return jitted
+
+    def call(*args, **kwargs):
+        with jax.set_mesh(mesh):
+            return jitted(*args, **kwargs)
+
+    return call

@@ -107,6 +107,8 @@ class Algorithm(Trainable):
                 rollout_fragment_length=cfg.rollout_fragment_length,
                 explore=False, seed=cfg.seed + 10_000, worker_index=999)
         self._eval_runner.set_state({"params": self.learner_group.get_weights()})
+        # Fresh episodes every round: a trajectory must not span two policies.
+        self._eval_runner.reset()
         episodes = self._eval_runner.sample(
             num_episodes=cfg.evaluation_duration, explore=False)
         returns = [ep.total_return for ep in episodes if ep.is_done]

@@ -144,13 +144,14 @@ class DQN(Algorithm):
         warmup = (self._lifetime_steps
                   < cfg.num_steps_sampled_before_learning_starts)
         # Epsilon-greedy: with prob eps sample random actions for the whole
-        # fragment (fragments are short — 4 steps default).
+        # fragment (fragments are short — 4 steps default); the other arm is
+        # GREEDY argmax over Q (explore=False), not Boltzmann sampling.
         explore_random = warmup or (np.random.random() < self._epsilon())
         episodes = self.env_runner_group.sample(
             num_timesteps=max(cfg.rollout_fragment_length,
                               cfg.train_batch_size if warmup else 0)
             or cfg.rollout_fragment_length,
-            random_actions=explore_random)
+            random_actions=explore_random, explore=False)
         self._lifetime_steps += sum(len(ep) for ep in episodes)
         self.replay.add(episodes_to_transitions(episodes))
         if warmup or len(self.replay) < cfg.train_batch_size:

@@ -12,6 +12,7 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ray_tpu.rl.algorithm import Algorithm
 from ray_tpu.rl.config import AlgorithmConfig
@@ -70,12 +71,15 @@ class PPOLearner(JaxLearner):
         value_loss = jnp.mean(vf_loss_clipped)
 
         entropy = jnp.mean(dist.entropy(inputs))
-        # Approx KL(old || new) for monitoring (ref: ppo_torch_learner.py
-        # mean_kl_loss); the clip objective does the trust-region work.
+        # Approx KL(old || new) (ref: ppo_torch_learner.py mean_kl_loss);
+        # penalized with the ADAPTIVE kl coefficient the algorithm threads
+        # through the batch (a 0-d array, so adapting it doesn't recompile).
         kl = jnp.mean(batch[Columns.ACTION_LOGP] - logp)
+        kl_coeff = batch.get("kl_coeff", jnp.float32(0.0))
 
         total = (policy_loss + cfg.vf_loss_coeff * value_loss
-                 - cfg.entropy_coeff * entropy)
+                 - cfg.entropy_coeff * entropy
+                 + kl_coeff * jnp.maximum(kl, 0.0))
         return total, {
             "policy_loss": policy_loss,
             "vf_loss": value_loss,
@@ -118,7 +122,19 @@ class PPO(Algorithm):
             vf_fn = self._driver_vf
             params = self.learner_group.get_weights()
         batch = self.learner_connector({}, episodes, params=params, vf_fn=vf_fn)
+        if not hasattr(self, "_kl_coeff"):
+            self._kl_coeff = float(cfg.kl_coeff)
+        batch["kl_coeff"] = np.float32(self._kl_coeff)
         learner_results = self.learner_group.update_from_batch(
             batch, num_epochs=cfg.num_epochs, minibatch_size=cfg.minibatch_size)
+        # Adaptive KL coefficient (ref: ppo.py after_train_step — double
+        # when kl overshoots 2x target, halve when under 0.5x).
+        kl = learner_results.get("mean_kl")
+        if kl is not None and cfg.kl_coeff > 0:
+            if kl > 2.0 * cfg.kl_target:
+                self._kl_coeff *= 1.5
+            elif kl < 0.5 * cfg.kl_target:
+                self._kl_coeff *= 0.5
+            learner_results["curr_kl_coeff"] = self._kl_coeff
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
         return {"learners": learner_results}

@@ -107,7 +107,11 @@ class JaxLearner:
         num_epochs/minibatch_size shuffled passes)."""
         if self._update_fn is None:
             self._build_update()
-        n = len(next(iter(batch.values())))
+        # Scalars (0-d) ride along whole — e.g. PPO's adaptive kl_coeff —
+        # while row arrays are minibatch-sliced.
+        rows = {k: v for k, v in batch.items() if np.ndim(v) > 0}
+        scalars = {k: v for k, v in batch.items() if np.ndim(v) == 0}
+        n = len(next(iter(rows.values())))
         minibatch_size = minibatch_size or n
         all_metrics: List[Dict[str, Any]] = []
         for _ in range(num_epochs):
@@ -116,7 +120,8 @@ class JaxLearner:
             perm = np.random.default_rng(self._steps).permutation(n)
             for start in range(0, n, minibatch_size):
                 idx = perm[start:start + minibatch_size]
-                mb = {k: v[idx] for k, v in batch.items()}
+                mb = {k: v[idx] for k, v in rows.items()}
+                mb.update(scalars)
                 self._key, sub = jax.random.split(self._key)
                 self.params, self.opt_state, metrics = self._update_fn(
                     self.params, self.opt_state, mb, sub)

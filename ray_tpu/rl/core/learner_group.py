@@ -45,7 +45,9 @@ class LearnerGroup:
         if self._local is not None:
             return self._local.update_from_batch(
                 batch, num_epochs=num_epochs, minibatch_size=minibatch_size)
-        n = len(next(iter(batch.values())))
+        rows = {k: v for k, v in batch.items() if np.ndim(v) > 0}
+        scalars = {k: v for k, v in batch.items() if np.ndim(v) == 0}
+        n = len(next(iter(rows.values())))
         world = len(self._remote)
         shard = n // world
         if shard == 0:
@@ -58,7 +60,8 @@ class LearnerGroup:
             # must run the identical number of minibatches or the gradient
             # allreduce deadlocks on the odd one out.
             sl = slice(r * shard, (r + 1) * shard)
-            sub = {k: v[sl] for k, v in batch.items()}
+            sub = {k: v[sl] for k, v in rows.items()}
+            sub.update(scalars)
             refs.append(learner.update_from_batch.remote(
                 sub, num_epochs=num_epochs, minibatch_size=per_learner_mb))
         results = ray_tpu.get(refs)

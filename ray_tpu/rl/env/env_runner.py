@@ -184,6 +184,16 @@ class SingleAgentEnvRunner:
             "episode_len_mean": float(np.mean(lens)),
         }
 
+    def reset(self) -> None:
+        """Reset all envs and discard in-progress episodes (used between
+        evaluation rounds so no trajectory spans two policies)."""
+        for i, env in enumerate(self.envs):
+            obs, _ = env.reset()
+            ep = SingleAgentEpisode()
+            ep.add_env_reset(np.asarray(obs, np.float32).ravel())
+            self.episodes[i] = ep
+        self._done_episode_returns, self._done_episode_lens = [], []
+
     def get_state(self) -> Dict[str, Any]:
         return {"params": self._params, "weights_seq": self._weights_seq}
 

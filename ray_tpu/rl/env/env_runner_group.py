@@ -39,13 +39,14 @@ class EnvRunnerGroup:
     # ------------------------------------------------------------------
     def sample(self, *, num_timesteps: Optional[int] = None,
                num_episodes: Optional[int] = None,
-               random_actions: bool = False) -> List:
+               random_actions: bool = False,
+               explore: Optional[bool] = None) -> List:
         """Synchronous fan-out sample (ref: algorithm.py:1814
         synchronous_parallel_sample)."""
         if self._local_runner is not None:
             return self._local_runner.sample(
                 num_timesteps=num_timesteps, num_episodes=num_episodes,
-                random_actions=random_actions)
+                random_actions=random_actions, explore=explore)
         n = len(self._remote_runners)
         refs = []
         for i, r in enumerate(self._remote_runners):
@@ -60,7 +61,8 @@ class EnvRunnerGroup:
                 continue
             refs.append(r.sample.remote(num_timesteps=per_ts,
                                         num_episodes=per_eps,
-                                        random_actions=random_actions))
+                                        random_actions=random_actions,
+                                        explore=explore))
         episodes: List = []
         for chunk in ray_tpu.get(refs):
             episodes.extend(chunk)

@@ -22,10 +22,14 @@ _model_id: contextvars.ContextVar = contextvars.ContextVar(
 class ReplicaContext:
     deployment: str
     replica_id: str
+    #: the hosting ReplicaActor (for model-id recording etc.); not part of
+    #: the public surface
+    _replica: Optional[object] = None
 
 
-def _set_internal_replica_context(deployment: str, replica_id: str) -> None:
-    _replica_ctx.set(ReplicaContext(deployment, replica_id))
+def _set_internal_replica_context(deployment: str, replica_id: str,
+                                  replica: Optional[object] = None) -> None:
+    _replica_ctx.set(ReplicaContext(deployment, replica_id, replica))
 
 
 def get_internal_replica_context() -> Optional[ReplicaContext]:

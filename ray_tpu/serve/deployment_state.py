@@ -84,13 +84,16 @@ class ReplicaWrapper:
 
     def begin_stop(self) -> None:
         self.state = ReplicaState.STOPPING
+        self.stopping_since = time.time()
         self._stop_ref = self.actor.prepare_for_shutdown.remote()
 
     def check_stopped(self) -> bool:
         if self._stop_ref is None:
             return True
         done, _ = ray_tpu.wait([self._stop_ref], num_returns=1, timeout=0)
-        if done or time.time() - self.started_at > 60:
+        # Hard-kill deadline counts from when stopping BEGAN, not creation —
+        # else any replica older than the deadline loses its graceful drain.
+        if done or time.time() - self.stopping_since > 60:
             try:
                 ray_tpu.kill(self.actor)
             except Exception:

@@ -81,10 +81,14 @@ class DeploymentHandle:
             return self._router
 
     def options(self, *, method_name: Optional[str] = None) -> "DeploymentHandle":
+        # Materialize the router BEFORE sharing: if the child built it, the
+        # parent's _router would stay None and a duplicate Router (extra
+        # long-poll + metrics threads, split queue accounting) would follow.
+        self._get_router()
         h = DeploymentHandle(self.deployment_name, self.app_name,
                              self._controller,
                              method_name or self._method_name)
-        h._router = self._router  # share the router + its long-poll client
+        h._router = self._router
         h._router_lock = self._router_lock
         return h
 

@@ -77,6 +77,7 @@ class LongPollClient:
 
     def _loop(self) -> None:
         import ray_tpu
+        from ray_tpu.exceptions import ActorDiedError
 
         while not self._stopped.is_set():
             try:
@@ -84,6 +85,10 @@ class LongPollClient:
                     self._controller.listen_for_change.remote(
                         dict(self._snapshot_ids), 1.0),
                     timeout=10.0)
+            except ActorDiedError:
+                # Controller is gone (serve.shutdown) — no point retrying.
+                self._stopped.set()
+                return
             except Exception:
                 if self._stopped.is_set():
                     return

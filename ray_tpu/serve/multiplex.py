@@ -2,8 +2,9 @@
 
 (ref: python/ray/serve/multiplex.py _ModelMultiplexWrapper — per-replica
 LRU of loaded models keyed by model id, load via the user's @serve.multiplexed
-function, evict least-recently-used above max_num_models_per_replica;
-routing prefers replicas that already hold the model.)
+function, evict least-recently-used above max_num_models_per_replica.
+Loaded ids are recorded in replica metadata; warm-replica routing preference
+is future work — requests currently route queue-aware only.)
 """
 
 from __future__ import annotations
@@ -47,20 +48,15 @@ class _ModelMultiplexWrapper:
             return model
 
     def _push_model_ids(self) -> None:
-        """Report loaded ids so the router can prefer warm replicas
-        (ref: multiplex.py _push_multiplexed_replica_info)."""
+        """Record loaded ids on the hosting replica's metadata
+        (ref: multiplex.py _push_multiplexed_replica_info — the reference
+        additionally feeds these into router preference; here they surface
+        through ReplicaActor.get_metadata for observability)."""
         from ray_tpu.serve import context as serve_context
-        from ray_tpu._private import runtime as _rt
 
         ctx = serve_context.get_internal_replica_context()
-        if ctx is None:
-            return
-        # Record on the hosting replica actor via the runtime registry (the
-        # reference pushes to the controller; here the replica metadata is
-        # polled straight off the actor).
-        runtime = _rt.runtime_or_none()
-        if runtime is None:
-            return
+        if ctx is not None and ctx._replica is not None:
+            ctx._replica.record_multiplexed_model_ids(list(self._models))
 
 
 def multiplexed(_func: Optional[Callable] = None, *,

@@ -82,7 +82,8 @@ class ReplicaActor:
             from ray_tpu.serve import context as serve_context
 
             serve_context._set_internal_replica_context(
-                deployment=self.deployment_name, replica_id=self.replica_id)
+                deployment=self.deployment_name, replica_id=self.replica_id,
+                replica=self)
             return await self._wrapper.call(method_name, args, kwargs)
         finally:
             self._num_ongoing -= 1

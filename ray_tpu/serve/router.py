@@ -111,11 +111,16 @@ class Router:
         """Handle-side queue metric reporting (ref: autoscaling_state.py —
         RUNNING replicas' queue lengths come from handles, pushed on the
         metrics interval)."""
+        from ray_tpu.exceptions import ActorDiedError
+
         while not self._stopped.wait(METRICS_PUSH_INTERVAL_S):
             try:
                 self._controller.record_handle_metrics.remote(
                     self.deployment_id, self.router_id,
                     self._scheduler.total_inflight())
+            except ActorDiedError:
+                self._stopped.set()  # controller gone: stop reporting
+                return
             except Exception:
                 pass
 
