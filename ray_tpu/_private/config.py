@@ -30,6 +30,9 @@ class Config:
     spill_dir: str = "/tmp/ray_tpu_spill"
     #: Start spilling when the store is this full (ref: object_spilling_threshold).
     object_spilling_threshold: float = 0.8
+    #: Args/results larger than this ride the native shared-memory arena to
+    #: process workers instead of the pipe (zero-copy handoff).
+    plasma_handoff_threshold: int = 128 * 1024
 
     # --- scheduling ---
     #: Pack-then-spread crossover used by the hybrid policy

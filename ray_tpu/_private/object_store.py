@@ -10,11 +10,13 @@ store.h:55).  Differences, by design:
   the *deserialized* Python value — a zero-copy "plasma" for the common TPU
   case (jax.Array device buffers must never be pickled between processes
   anyway; they stay in HBM and move via ICI collectives, not the store).
-* A shared-memory tier (`multiprocessing.shared_memory`) materializes the
-  serialized form on demand when an object crosses a process boundary.
+* The serialized tier is the native C++ arena (``ray_tpu/native/src/
+  plasma.cc`` — mmap'd shared memory, boundary-tag allocator, LRU eviction),
+  shared zero-copy with process-tier workers.  If the native library cannot
+  build, `multiprocessing.shared_memory` is the fallback.
 * Capacity pressure triggers LRU spilling of the serialized form to disk
-  (ref: raylet/local_object_manager.h:41 spilling via IO workers; here an
-  internal thread), restored transparently on access.
+  (ref: raylet/local_object_manager.h:41 spilling via IO workers), restored
+  transparently on access.
 """
 
 from __future__ import annotations
@@ -40,8 +42,8 @@ class ObjectState:
 
 class _Entry:
     __slots__ = (
-        "state", "value", "has_value", "error", "shm", "spill_path",
-        "size", "event", "pinned", "last_access", "owner",
+        "state", "value", "has_value", "error", "shm", "in_plasma", "exported",
+        "spill_path", "size", "event", "pinned", "last_access", "owner",
     )
 
     def __init__(self) -> None:
@@ -50,12 +52,27 @@ class _Entry:
         self.has_value = False
         self.error: Optional[BaseException] = None
         self.shm: Optional[shared_memory.SharedMemory] = None
+        self.in_plasma = False
+        self.exported = False  # zero-copy views into the arena were handed out
         self.spill_path: Optional[str] = None
         self.size = 0
         self.event = threading.Event()
         self.pinned = 0
         self.last_access = 0.0
         self.owner = ""
+
+
+def _try_plasma(capacity_bytes: int):
+    """Build + create the native arena; None if the toolchain is missing."""
+    try:
+        from ray_tpu.native.plasma import PlasmaClient, default_arena_path
+
+        path = default_arena_path(f"{os.getpid()}_{threading.get_native_id()}")
+        if os.path.exists(path):
+            os.unlink(path)
+        return PlasmaClient(path, capacity=capacity_bytes, create=True)
+    except Exception:
+        return None
 
 
 class ObjectStore:
@@ -74,6 +91,13 @@ class ObjectStore:
         os.makedirs(GLOBAL_CONFIG.spill_dir, exist_ok=True)
         self.stats = {"puts": 0, "gets": 0, "spills": 0, "restores": 0, "freed": 0}
         self._graveyard: List[shared_memory.SharedMemory] = []
+        self._plasma_graveyard: List[ObjectID] = []
+        self.plasma = _try_plasma(capacity_bytes)
+
+    @property
+    def arena_path(self) -> Optional[str]:
+        """Path process workers attach to for zero-copy arg/result handoff."""
+        return self.plasma.path if self.plasma is not None else None
 
     # ------------------------------------------------------------------ puts
     def put(self, object_id: ObjectID, value: Any, owner: str = "") -> None:
@@ -92,7 +116,7 @@ class ObjectStore:
         """Store an object already in wire form (arrived from a process worker)."""
         with self._lock:
             entry = self._entries.setdefault(object_id, _Entry())
-            self._attach_shm(object_id, entry, flat)
+            self._attach_serialized(object_id, entry, flat)
             entry.state = ObjectState.READY
             entry.owner = owner
             self.stats["puts"] += 1
@@ -129,6 +153,26 @@ class ObjectStore:
             e = self._entries.get(object_id)
             return e.error if e else None
 
+    def _serialized_view(self, object_id: ObjectID, entry: _Entry,
+                         export: bool = False) -> Optional[memoryview]:
+        """Wire-form view (zero-copy when in the arena). Caller holds lock.
+
+        ``export=True`` marks the entry as aliased by long-lived zero-copy
+        consumers (deserialized numpy views), pinning it against spilling;
+        plain views are only valid until the next operation that may spill."""
+        if entry.in_plasma and self.plasma is not None:
+            view = self.plasma.get(object_id, timeout=0)
+            if view is not None:
+                # The store's own ref from create() pins the object; the extra
+                # get() ref is returned immediately — the entry keeps it live.
+                self.plasma.release(object_id)
+                if export:
+                    entry.exported = True
+                return view[: entry.size]
+        if entry.shm is not None:
+            return memoryview(entry.shm.buf)[: entry.size]
+        return None
+
     def _materialize(self, object_id: ObjectID, entry: _Entry) -> Any:
         with self._lock:
             entry.last_access = time.monotonic()
@@ -141,8 +185,9 @@ class ObjectStore:
                 raise ObjectFreedError(f"Object {object_id} was freed")
             if entry.has_value:
                 return entry.value
-            if entry.shm is not None:
-                value = serialization.deserialize_flat(memoryview(entry.shm.buf))
+            view = self._serialized_view(object_id, entry, export=True)
+            if view is not None:
+                value = serialization.deserialize_flat(view)
                 entry.value, entry.has_value = value, True
                 return value
             if entry.spill_path is not None:
@@ -158,7 +203,7 @@ class ObjectStore:
             raise ObjectLostError(f"Object {object_id} has no value")
 
     def get_serialized(self, object_id: ObjectID, timeout: Optional[float] = None) -> memoryview:
-        """Wire form for shipping to a process worker (shm-backed, zero-copy)."""
+        """Wire form for shipping to a process worker (arena-backed, zero-copy)."""
         entry = self._ensure(object_id)
         if not entry.event.wait(timeout):
             from ray_tpu.exceptions import GetTimeoutError
@@ -167,11 +212,13 @@ class ObjectStore:
         with self._lock:
             if entry.state == ObjectState.FAILED:
                 raise entry.error  # type: ignore[misc]
-            if entry.shm is None and entry.spill_path is None:
+            view = self._serialized_view(object_id, entry)
+            if view is None and entry.spill_path is None:
                 flat = serialization.serialize(entry.value).to_bytes()
-                self._attach_shm(object_id, entry, flat)
-            if entry.shm is not None:
-                return memoryview(entry.shm.buf)[: entry.size]
+                self._attach_serialized(object_id, entry, flat)
+                view = self._serialized_view(object_id, entry)
+            if view is not None:
+                return view
             with open(entry.spill_path, "rb") as f:  # type: ignore[arg-type]
                 return memoryview(f.read())
 
@@ -185,26 +232,53 @@ class ObjectStore:
         with self._lock:
             return self._entries.setdefault(object_id, _Entry())
 
-    def _attach_shm(self, object_id: ObjectID, entry: _Entry, flat: bytes) -> None:
+    def _attach_serialized(self, object_id: ObjectID, entry: _Entry, flat: bytes) -> None:
         size = len(flat)
         self._maybe_spill(size)
-        try:
-            shm = shared_memory.SharedMemory(create=True, size=max(size, 1))
-        except Exception:
-            # shm exhausted: keep in heap via spill file instead.
-            path = os.path.join(GLOBAL_CONFIG.spill_dir, f"{object_id}.bin".replace(":", "_"))
-            with open(path, "wb") as f:
-                f.write(flat)
-            entry.spill_path = path
-            entry.size = size
-            return
-        shm.buf[:size] = flat
-        entry.shm = shm
+        if self.plasma is not None:
+            try:
+                from ray_tpu.native.plasma import PlasmaObjectExists
+
+                try:
+                    buf = self.plasma.create(object_id, max(size, 1))
+                    buf[:size] = flat
+                    buf.release()
+                    self.plasma.seal(object_id)
+                    self._bytes_used += size
+                except PlasmaObjectExists:
+                    # Already resident (duplicate delivery, e.g. a task retry);
+                    # the first create's accounting and ref stand.
+                    if not entry.in_plasma:
+                        self._bytes_used += size
+                entry.in_plasma = True
+                entry.size = size
+                return
+            except MemoryError:
+                pass  # arena full even after eviction: spill to disk below
+        else:
+            try:
+                shm = shared_memory.SharedMemory(create=True, size=max(size, 1))
+                shm.buf[:size] = flat
+                entry.shm = shm
+                entry.size = size
+                self._bytes_used += size
+                return
+            except Exception:
+                pass
+        # Last resort: keep wire form on disk.
+        path = os.path.join(GLOBAL_CONFIG.spill_dir, f"{object_id}.bin".replace(":", "_"))
+        with open(path, "wb") as f:
+            f.write(flat)
+        entry.spill_path = path
         entry.size = size
-        self._bytes_used += size
 
     def _maybe_spill(self, incoming: int) -> None:
-        """LRU-spill serialized objects when over threshold (caller holds lock)."""
+        """LRU-spill serialized objects when over threshold (caller holds lock).
+
+        Plasma-resident entries with exported zero-copy views are skipped: the
+        arena recycles memory on delete, so spilling them would invalidate
+        live numpy views (the reference pins such objects in plasma the same
+        way, via client refcounts)."""
         threshold = self.capacity_bytes * GLOBAL_CONFIG.object_spilling_threshold
         if self._bytes_used + incoming <= threshold:
             return
@@ -212,21 +286,41 @@ class ObjectStore:
             (
                 (e.last_access, oid, e)
                 for oid, e in self._entries.items()
-                if e.shm is not None and not e.pinned
+                if not e.pinned
+                and ((e.shm is not None) or (e.in_plasma and not e.exported))
             ),
         )
         for _, oid, entry in candidates:
             if self._bytes_used + incoming <= threshold:
                 break
+            view = self._serialized_view(oid, entry)
+            if view is None:
+                continue
             path = os.path.join(GLOBAL_CONFIG.spill_dir, f"{oid}.bin".replace(":", "_"))
             with open(path, "wb") as f:
-                f.write(bytes(entry.shm.buf[: entry.size]))
-            self._release_shm(entry)
+                f.write(bytes(view))
+            self._release_serialized(oid, entry)
             entry.spill_path = path
             entry.state = ObjectState.SPILLED
             self.stats["spills"] += 1
 
-    def _release_shm(self, entry: _Entry) -> None:
+    def _release_serialized(self, object_id: ObjectID, entry: _Entry) -> None:
+        if entry.in_plasma and self.plasma is not None:
+            self._bytes_used -= entry.size
+            if entry.exported:
+                # Zero-copy numpy views into the arena are (or may be) still
+                # alive in user code: deleting would let the allocator recycle
+                # the block under them.  Keep the creator ref so neither
+                # delete nor LRU eviction can touch it; reclaimed only when
+                # the arena is unlinked at shutdown (the plasma analogue of
+                # the shm graveyard below).
+                self._plasma_graveyard.append(object_id)
+            else:
+                self.plasma.release(object_id)  # drop creator ref
+                self.plasma.delete(object_id)
+            entry.in_plasma = False
+            entry.exported = False
+            return
         if entry.shm is not None:
             self._bytes_used -= entry.size
             try:
@@ -261,7 +355,7 @@ class ObjectStore:
             entry = self._entries.pop(object_id, None)
             if entry is None:
                 return
-            self._release_shm(entry)
+            self._release_serialized(object_id, entry)
             if entry.spill_path:
                 try:
                     os.unlink(entry.spill_path)
@@ -275,15 +369,16 @@ class ObjectStore:
         """Drop the deserialized copy, keep wire form (tests/memory pressure)."""
         with self._lock:
             e = self._entries.get(object_id)
-            if e and (e.shm is not None or e.spill_path):
+            if e and (e.in_plasma or e.shm is not None or e.spill_path):
                 e.value, e.has_value = None, False
 
     def shutdown(self) -> None:
         import gc
 
         with self._lock:
-            for entry in self._entries.values():
-                self._release_shm(entry)
+            for oid, entry in list(self._entries.items()):
+                if entry.shm is not None:
+                    self._release_serialized(oid, entry)
             self._entries.clear()
         gc.collect()
         for shm in self._graveyard:
@@ -292,6 +387,10 @@ class ObjectStore:
             except Exception:
                 pass
         self._graveyard.clear()
+        self._plasma_graveyard.clear()
+        if self.plasma is not None:
+            self.plasma.close(unlink=True)
+            self.plasma = None
 
     def usage(self) -> Tuple[int, int]:
         with self._lock:

@@ -8,8 +8,13 @@ because on TPU hosts the chips are owned by one JAX client in the driver
 process and compute-bound work releases the GIL inside XLA anyway.
 
 Protocol per worker (spawn ctx; a fork after JAX/TPU init is unsafe):
-  driver -> worker: ("exec", seq, fn_id, fn_bytes|None, flat_args)
-  worker -> driver: ("ok", seq, flat_result) | ("err", seq, flat_exc)
+  driver -> worker: ("exec", seq, fn_id, fn_bytes|None, args_spec)
+  worker -> driver: ("ok", seq, result_spec) | ("err", seq, flat_exc)
+where a spec is ("inline", bytes) or ("plasma", key) — payloads above
+``plasma_handoff_threshold`` travel through the native shared-memory arena
+(ray_tpu/native/src/plasma.cc) zero-copy instead of the pipe, the analogue of
+the reference passing ObjectIDs + plasma fds rather than bytes
+(ref: plasma/client.h, fling.cc).
 Functions are cached worker-side by fn_id so hot loops ship only args
 (ref: function table export via GCS KV, _private/function_manager.py).
 Leases are reused: a released worker goes back to the idle pool keyed by
@@ -28,10 +33,61 @@ from ray_tpu._private import serialization
 from ray_tpu._private.config import GLOBAL_CONFIG
 
 
-def _worker_main(conn) -> None:
+def _attach_arena(path: Optional[str]):
+    if not path:
+        return None
+    try:
+        from ray_tpu.native.plasma import PlasmaClient
+
+        return PlasmaClient(path, create=False)
+    except Exception:
+        return None
+
+
+def _spec_put(arena, key_hint: str, payload: bytes):
+    """Choose the transport for one payload."""
+    if arena is not None and len(payload) > GLOBAL_CONFIG.plasma_handoff_threshold:
+        try:
+            arena.put_bytes(key_hint, payload)
+            return ("plasma", key_hint)
+        except (MemoryError, ValueError):
+            pass  # arena full or key collision: the pipe always works
+    return ("inline", payload)
+
+
+def _spec_take(arena, spec) -> bytes:
+    """Fetch and consume one payload (plasma objects are freed here)."""
+    kind, val = spec
+    if kind == "inline":
+        return val
+    if arena is None:
+        raise RuntimeError(
+            f"peer sent plasma handoff {val} but this side has no arena client")
+    data = arena.get_bytes(val, timeout=30)
+    if data is None:
+        raise RuntimeError(f"plasma handoff object {val} missing")
+    arena.release(val)  # creator's ref
+    arena.delete(val)
+    return data
+
+
+def _spec_cleanup(arena, spec) -> None:
+    """Best-effort free of an unconsumed plasma handoff (idempotent: no-op if
+    the peer already consumed it via _spec_take)."""
+    if arena is None or spec[0] != "plasma":
+        return
+    try:
+        arena.release(spec[1])
+        arena.delete(spec[1])
+    except Exception:
+        pass
+
+
+def _worker_main(conn, arena_path: Optional[str]) -> None:
     # Keep workers off the TPU: the driver process owns the chips.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     fn_cache: Dict[str, Any] = {}
+    arena = _attach_arena(arena_path)
     while True:
         try:
             msg = conn.recv_bytes()
@@ -40,15 +96,17 @@ def _worker_main(conn) -> None:
         req = serialization.loads(msg)
         kind = req[0]
         if kind == "exec":
-            _, seq, fn_id, fn_bytes, flat_args = req
+            _, seq, fn_id, fn_bytes, args_spec = req
             try:
                 if fn_id not in fn_cache:
                     fn_cache[fn_id] = serialization.loads(fn_bytes)
                 fn = fn_cache[fn_id]
+                flat_args = _spec_take(arena, args_spec)
                 args, kwargs = serialization.deserialize_flat(memoryview(flat_args))
                 result = fn(*args, **kwargs)
                 payload = serialization.serialize(result).to_bytes()
-                conn.send_bytes(serialization.dumps(("ok", seq, payload)))
+                spec = _spec_put(arena, f"res:{os.getpid()}:{seq}", payload)
+                conn.send_bytes(serialization.dumps(("ok", seq, spec)))
             except BaseException as e:  # noqa: BLE001 — errors cross the boundary
                 import traceback
 
@@ -62,13 +120,26 @@ def _worker_main(conn) -> None:
             return
 
 
+_HANDOFF_COUNTER = 0
+_HANDOFF_LOCK = threading.Lock()
+
+
+def _next_handoff_key(prefix: str) -> str:
+    global _HANDOFF_COUNTER
+    with _HANDOFF_LOCK:
+        _HANDOFF_COUNTER += 1
+        return f"{prefix}:{os.getpid()}:{_HANDOFF_COUNTER}"
+
+
 class _ProcWorker:
-    def __init__(self) -> None:
+    def __init__(self, arena_path: Optional[str] = None, arena=None) -> None:
         ctx = mp.get_context("spawn")
         self.conn, child_conn = ctx.Pipe()
-        self.proc = ctx.Process(target=_worker_main, args=(child_conn,), daemon=True)
+        self.proc = ctx.Process(
+            target=_worker_main, args=(child_conn, arena_path), daemon=True)
         self.proc.start()
         child_conn.close()
+        self._arena = arena  # the pool's shared driver-side client
         self.seq = 0
         self.sent_fns: set = set()
         self.last_used = time.monotonic()
@@ -78,20 +149,27 @@ class _ProcWorker:
         from ray_tpu.exceptions import TaskError, WorkerCrashedError
 
         self.seq += 1
+        arena = self._arena
         flat_args = serialization.serialize((args, kwargs)).to_bytes()
+        args_spec = _spec_put(arena, _next_handoff_key("args"), flat_args)
         send_fn = fn_bytes if fn_id not in self.sent_fns else None
         self.conn.send_bytes(
-            serialization.dumps(("exec", self.seq, fn_id, send_fn, flat_args))
+            serialization.dumps(("exec", self.seq, fn_id, send_fn, args_spec))
         )
         self.sent_fns.add(fn_id)
         try:
             reply = serialization.loads(self.conn.recv_bytes())
         except (EOFError, OSError) as e:
+            # Worker died before consuming the args — reclaim them.
+            _spec_cleanup(arena, args_spec)
             raise WorkerCrashedError(f"process worker died: {e}") from e
         kind, seq, payload = reply
         self.last_used = time.monotonic()
         if kind == "ok":
-            return serialization.deserialize_flat(memoryview(payload))
+            # The worker reached the result, so it consumed the args spec.
+            return serialization.deserialize_flat(memoryview(_spec_take(arena, payload)))
+        # Error may have struck before the worker consumed the args.
+        _spec_cleanup(arena, args_spec)
         exc, tb = serialization.loads(payload)
         raise TaskError(exc, tb=tb)
 
@@ -108,10 +186,15 @@ class _ProcWorker:
 class ProcessPool:
     """Idle-pool of reusable spawned workers with an upper bound."""
 
-    def __init__(self) -> None:
+    def __init__(self, arena_path: Optional[str] = None, arena=None) -> None:
         self._idle: List[_ProcWorker] = []
         self._lock = threading.Lock()
         self._count = 0
+        self.arena_path = arena_path
+        # One shared driver-side arena client for all workers (one mmap + fd
+        # per process, as plasma.py documents) — normally the ObjectStore's
+        # own client, passed in by the runtime.
+        self._arena = arena if arena is not None else _attach_arena(arena_path)
 
     def lease(self) -> _ProcWorker:
         with self._lock:
@@ -121,7 +204,7 @@ class ProcessPool:
                     return w
                 self._count -= 1
             self._count += 1
-        return _ProcWorker()
+        return _ProcWorker(self.arena_path, self._arena)
 
     def release(self, worker: _ProcWorker) -> None:
         if not worker.alive():

@@ -174,7 +174,7 @@ class Runtime:
 
         self.store = ObjectStore(self.config.object_store_memory)
         self.scheduler = ClusterScheduler()
-        self.process_pool = ProcessPool()
+        self.process_pool = ProcessPool(self.store.arena_path, self.store.plasma)
         self.refcounter = global_refcounter()
         self.refcounter.set_zero_callback(self._on_zero_refs)
 

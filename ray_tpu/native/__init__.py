@@ -1,0 +1,4 @@
+"""Native C++ components (built on demand, cached in _build/).
+
+- plasma: shared-memory object store arena (src/plasma.cc)
+"""

@@ -1,0 +1,62 @@
+"""Builds the native C++ components into shared libraries.
+
+Compilation happens on first import (g++ -O2 -shared), keyed by a content
+hash of the sources so edits trigger rebuilds; the cached .so lives in
+``ray_tpu/native/_build/``.  A CMakeLists.txt is provided for standalone
+builds, but the in-tree path deliberately needs nothing beyond g++ so the
+framework works in hermetic environments.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src")
+_BUILD = os.path.join(_DIR, "_build")
+_LOCK = threading.Lock()
+
+
+def _source_hash(sources) -> str:
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def build_library(name: str, sources, extra_flags=()) -> str:
+    """Compile `sources` (paths relative to src/) into lib<name>-<hash>.so and
+    return its path. No-op when the cached artifact is current."""
+    srcs = [os.path.join(_SRC, s) for s in sources]
+    tag = _source_hash(srcs)
+    out = os.path.join(_BUILD, f"lib{name}-{tag}.so")
+    if os.path.exists(out):
+        return out
+    with _LOCK:
+        if os.path.exists(out):
+            return out
+        os.makedirs(_BUILD, exist_ok=True)
+        tmp = out + f".tmp{os.getpid()}"
+        cmd = [
+            "g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC",
+            "-Wall", "-Werror", "-pthread",
+            *extra_flags, "-o", tmp, *srcs,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, out)
+        # Drop stale builds of the same library.
+        for f in os.listdir(_BUILD):
+            if f.startswith(f"lib{name}-") and f != os.path.basename(out):
+                try:
+                    os.unlink(os.path.join(_BUILD, f))
+                except OSError:
+                    pass
+    return out
+
+
+def plasma_library() -> str:
+    return build_library("tpuplasma", ["plasma.cc"])

@@ -1,0 +1,192 @@
+"""ctypes binding for the native shared-memory object store.
+
+The C++ side (src/plasma.cc) owns allocation, the object table, locking, and
+LRU eviction; this binding adds the Python-facing niceties: ids are hashed to
+the fixed 20-byte wire form, payloads are exposed as zero-copy memoryviews
+over one long-lived mmap of the arena, and `put_bytes`/`get_bytes` compose
+create+seal / get for the common case.
+
+Equivalent of the reference's plasma client (ref: src/ray/object_manager/
+plasma/client.h) minus the socket protocol — clients here attach the arena
+file directly (see plasma.cc header comment for why).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import mmap
+import os
+from typing import Optional, Tuple
+
+from ray_tpu.native.build import plasma_library
+
+ID_LEN = 20
+
+
+class PlasmaOOMError(MemoryError):
+    """Create failed even after LRU eviction — caller should spill to disk."""
+
+
+class PlasmaObjectExists(ValueError):
+    pass
+
+
+def _lib() -> ctypes.CDLL:
+    lib = ctypes.CDLL(plasma_library())
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    lib.tps_connect.restype = ctypes.c_void_p
+    lib.tps_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int]
+    lib.tps_disconnect.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p]
+    lib.tps_create.restype = ctypes.c_int
+    lib.tps_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64, u64p]
+    lib.tps_seal.restype = ctypes.c_int
+    lib.tps_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tps_unseal.restype = ctypes.c_int
+    lib.tps_unseal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tps_get.restype = ctypes.c_int
+    lib.tps_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, u64p, u64p]
+    lib.tps_release.restype = ctypes.c_int
+    lib.tps_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tps_delete.restype = ctypes.c_int
+    lib.tps_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tps_contains.restype = ctypes.c_int
+    lib.tps_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tps_evict.restype = ctypes.c_uint64
+    lib.tps_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.tps_usage.argtypes = [ctypes.c_void_p, u64p, u64p, u64p]
+    lib.tps_refcount.restype = ctypes.c_int64
+    lib.tps_refcount.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    return lib
+
+
+_LIB: Optional[ctypes.CDLL] = None
+
+
+def _get_lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        _LIB = _lib()
+    return _LIB
+
+
+def object_key(object_id) -> bytes:
+    """20-byte wire id from any hashable id (ObjectID, str, bytes)."""
+    if isinstance(object_id, bytes) and len(object_id) == ID_LEN:
+        return object_id
+    raw = object_id if isinstance(object_id, bytes) else str(object_id).encode()
+    return hashlib.sha1(raw).digest()
+
+
+def default_arena_path(session_name: str) -> str:
+    root = "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
+    return os.path.join(root, f"tpu_plasma_{session_name}")
+
+
+class PlasmaClient:
+    """One per process. The creating process passes create=True and owns the
+    arena file's lifetime; workers attach with create=False."""
+
+    def __init__(self, path: str, capacity: int = 0, *, create: bool,
+                 max_entries: int = 1 << 16) -> None:
+        self._lib = _get_lib()
+        self.path = path
+        self._owner = create
+        if create and capacity <= 0:
+            capacity = 1 << 30
+        self._h = self._lib.tps_connect(path.encode(), capacity, max_entries, int(create))
+        if not self._h:
+            raise OSError(f"plasma connect failed (path={path}, create={create})")
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+        self._fd = os.open(path, os.O_RDWR)
+        self._map = mmap.mmap(self._fd, size)
+        self._view = memoryview(self._map)
+
+    # ------------------------------------------------------------- lifecycle
+    def create(self, object_id, size: int) -> memoryview:
+        """Allocate a writable buffer; write into it, then seal()."""
+        off = ctypes.c_uint64()
+        rc = self._lib.tps_create(self._h, object_key(object_id), size, ctypes.byref(off))
+        if rc == -1:
+            raise PlasmaObjectExists(f"{object_id} already in store")
+        if rc == -2:
+            raise PlasmaOOMError(f"no space for {size} bytes (after eviction)")
+        if rc == -3:
+            raise PlasmaOOMError("object table full")
+        return self._view[off.value : off.value + size]
+
+    def seal(self, object_id) -> None:
+        if self._lib.tps_seal(self._h, object_key(object_id)) != 0:
+            raise ValueError(f"seal failed for {object_id}")
+
+    def unseal(self, object_id) -> None:
+        """Reopen for in-place mutation (compiled-graph channels)."""
+        if self._lib.tps_unseal(self._h, object_key(object_id)) != 0:
+            raise ValueError(f"unseal failed for {object_id}")
+
+    def get(self, object_id, timeout: Optional[float] = None) -> Optional[memoryview]:
+        """Zero-copy view of a sealed object; increments its refcount.
+        None on timeout. timeout=None blocks forever; 0 polls."""
+        off, size = ctypes.c_uint64(), ctypes.c_uint64()
+        tmo = -1 if timeout is None else max(0, int(timeout * 1000))
+        rc = self._lib.tps_get(self._h, object_key(object_id), tmo,
+                               ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        return self._view[off.value : off.value + size.value]
+
+    def release(self, object_id) -> None:
+        self._lib.tps_release(self._h, object_key(object_id))
+
+    def delete(self, object_id) -> bool:
+        return self._lib.tps_delete(self._h, object_key(object_id)) == 0
+
+    def contains(self, object_id) -> bool:
+        return bool(self._lib.tps_contains(self._h, object_key(object_id)))
+
+    def refcount(self, object_id) -> int:
+        return int(self._lib.tps_refcount(self._h, object_key(object_id)))
+
+    def evict(self, nbytes: int) -> int:
+        return int(self._lib.tps_evict(self._h, nbytes))
+
+    def usage(self) -> Tuple[int, int, int]:
+        used, cap, objs = ctypes.c_uint64(), ctypes.c_uint64(), ctypes.c_uint64()
+        self._lib.tps_usage(self._h, ctypes.byref(used), ctypes.byref(cap), ctypes.byref(objs))
+        return used.value, cap.value, objs.value
+
+    # ------------------------------------------------------------ composites
+    def put_bytes(self, object_id, data) -> None:
+        buf = self.create(object_id, len(data))
+        buf[:] = data
+        self.seal(object_id)
+
+    def get_bytes(self, object_id, timeout: Optional[float] = None) -> Optional[bytes]:
+        view = self.get(object_id, timeout)
+        if view is None:
+            return None
+        try:
+            return bytes(view)
+        finally:
+            view.release()
+            self.release(object_id)
+
+    def close(self, unlink: bool = False) -> None:
+        if self._h:
+            try:
+                self._view.release()
+                self._map.close()
+                os.close(self._fd)
+            except (BufferError, OSError):
+                pass  # zero-copy views still alive; mapping stays until GC
+            self._lib.tps_disconnect(
+                self._h, int(unlink and self._owner), self.path.encode())
+            self._h = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass

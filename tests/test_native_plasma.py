@@ -1,0 +1,192 @@
+"""Native C++ shared-memory store tests.
+
+Covers the plasma-tier contract the reference exercises in
+src/ray/object_manager/plasma tests + object_lifecycle_manager: create/seal/
+get lifecycle, refcounting, LRU eviction, allocator reuse/coalescing, blocking
+get across processes, and crash-robust locking.
+"""
+
+import multiprocessing as mp
+import os
+
+import pytest
+
+from ray_tpu.native.plasma import (
+    PlasmaClient,
+    PlasmaObjectExists,
+    PlasmaOOMError,
+)
+
+
+@pytest.fixture
+def store(tmp_path):
+    path = str(tmp_path / "arena")
+    c = PlasmaClient(path, capacity=8 << 20, create=True, max_entries=512)
+    yield c
+    c.close(unlink=True)
+
+
+def test_put_get_roundtrip(store):
+    store.put_bytes("a", b"hello world")
+    assert store.contains("a")
+    assert store.get_bytes("a") == b"hello world"
+
+
+def test_zero_copy_view_and_refcount(store):
+    store.put_bytes("a", b"x" * 1000)
+    assert store.refcount("a") == 1  # creator's ref
+    v = store.get("a")
+    assert store.refcount("a") == 2
+    assert bytes(v[:3]) == b"xxx"
+    v.release()
+    store.release("a")
+    assert store.refcount("a") == 1
+
+
+def test_create_seal_visibility(store):
+    buf = store.create("a", 4)
+    # unsealed objects are invisible to get()
+    assert store.get("a", timeout=0) is None
+    assert not store.contains("a")
+    buf[:] = b"abcd"
+    store.seal("a")
+    assert store.get_bytes("a") == b"abcd"
+
+
+def test_duplicate_create_rejected(store):
+    store.put_bytes("a", b"1")
+    with pytest.raises(PlasmaObjectExists):
+        store.create("a", 1)
+
+
+def test_delete_and_reuse(store):
+    store.put_bytes("a", b"z" * 100)
+    store.release("a")  # drop creator ref
+    assert store.delete("a")
+    assert not store.contains("a")
+    used, _, objs = store.usage()
+    assert used == 0 and objs == 0
+    # space is reusable
+    store.put_bytes("a", b"y" * 100)
+    assert store.get_bytes("a") == b"y" * 100
+
+
+def test_delete_refuses_referenced(store):
+    store.put_bytes("a", b"z")
+    assert not store.delete("a")  # creator ref still held
+    store.release("a")
+    assert store.delete("a")
+
+
+def test_lru_eviction_on_pressure(store):
+    # Fill most of the 8 MiB heap with released 1 MiB objects, then create
+    # another: LRU objects must be evicted to make room.
+    n = 6
+    for i in range(n):
+        store.put_bytes(f"obj{i}", b"b" * (1 << 20))
+        store.release(f"obj{i}")
+    store.put_bytes("big", b"c" * (3 << 20))  # forces eviction of oldest
+    assert store.contains("big")
+    assert not store.contains("obj0")  # oldest went first
+    assert store.contains(f"obj{n-1}") or store.contains(f"obj{n-2}")
+
+
+def test_pinned_objects_survive_eviction(store):
+    store.put_bytes("pinned", b"p" * (1 << 20))  # creator ref held = pinned
+    for i in range(8):
+        store.put_bytes(f"f{i}", b"b" * (1 << 20))
+        store.release(f"f{i}")
+    assert store.contains("pinned")
+    assert store.get_bytes("pinned") == b"p" * (1 << 20)
+
+
+def test_oom_when_nothing_evictable(store):
+    store.put_bytes("a", b"b" * (4 << 20))  # pinned by creator ref
+    with pytest.raises(PlasmaOOMError):
+        store.create("b", 6 << 20)
+
+
+def test_allocator_coalescing(store):
+    # free two adjacent blocks then allocate their combined size
+    store.put_bytes("a", b"1" * (2 << 20))
+    store.put_bytes("b", b"2" * (2 << 20))
+    store.put_bytes("c", b"3" * (2 << 20))
+    for k in ("a", "b"):
+        store.release(k)
+        store.delete(k)
+    store.put_bytes("d", b"4" * (3 << 20))  # needs a+b coalesced
+    assert store.get_bytes("d") == b"4" * (3 << 20)
+    assert store.get_bytes("c") == b"3" * (2 << 20)
+
+
+def test_unseal_mutation_channel_pattern(store):
+    # compiled-graph channel: writer creates once, retains the view, and
+    # cycles seal -> (reader gets) -> unseal -> overwrite -> seal.
+    buf = store.create("ch", 4)
+    buf[:] = b"aaaa"
+    store.seal("ch")
+    assert store.get_bytes("ch") == b"aaaa"
+    store.unseal("ch")
+    assert store.get("ch", timeout=0) is None  # invisible while mutating
+    buf[:] = b"bbbb"
+    store.seal("ch")
+    assert store.get_bytes("ch") == b"bbbb"
+
+
+def test_usage_accounting(store):
+    used0, cap, objs0 = store.usage()
+    assert used0 == 0 and objs0 == 0 and cap > 0
+    store.put_bytes("a", b"x" * 1234)
+    used, _, objs = store.usage()
+    assert used == 1234 and objs == 1
+
+
+def _child_attach(path, q):
+    c = PlasmaClient(path, create=False)
+    data = c.get_bytes("from_parent", timeout=10)
+    c.put_bytes("from_child", (data or b"") + b"/child")
+    q.put("done")
+    c.close()
+
+
+def test_cross_process_attach(store):
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    p = ctx.Process(target=_child_attach, args=(store.path, q))
+    p.start()
+    # seal AFTER the child starts so its get() exercises the blocking path
+    store.put_bytes("from_parent", b"parent")
+    assert q.get(timeout=30) == "done"
+    p.join(timeout=10)
+    assert store.get_bytes("from_child", timeout=10) == b"parent/child"
+
+
+def _child_crash_holding_data(path):
+    c = PlasmaClient(path, create=False)
+    c.get("from_parent", timeout=10)  # holds a ref
+    os._exit(1)  # die without releasing
+
+
+def test_store_survives_client_crash(store):
+    store.put_bytes("from_parent", b"parent")
+    ctx = mp.get_context("spawn")
+    p = ctx.Process(target=_child_crash_holding_data, args=(store.path,))
+    p.start()
+    p.join(timeout=30)
+    # store still fully functional after an unclean client death
+    store.put_bytes("after", b"ok")
+    assert store.get_bytes("after") == b"ok"
+
+
+def test_many_small_objects(store):
+    for i in range(300):
+        store.put_bytes(f"k{i}", f"v{i}".encode())
+    for i in range(300):
+        assert store.get_bytes(f"k{i}") == f"v{i}".encode()
+    # free all, table slots (tombstones) must be reusable
+    for i in range(300):
+        store.release(f"k{i}")
+        assert store.delete(f"k{i}")
+    for i in range(300):
+        store.put_bytes(f"k{i}", b"again")
+    assert store.usage()[2] == 300

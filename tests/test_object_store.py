@@ -74,3 +74,22 @@ def test_lineage_reconstruction(ray_start_regular):
     runtime.store.free(ref.id)
     # get() should reconstruct via lineage resubmission.
     np.testing.assert_array_equal(ray_tpu.get(ref, timeout=30), np.arange(100))
+
+
+def test_zero_copy_view_survives_free(ray_start_regular):
+    """A numpy array returned by get() aliases the arena; freeing the ref must
+    not recycle its memory under it (plasma graveyard pins the block)."""
+    runtime = get_runtime()
+    store = runtime.store
+    arr = np.random.rand(200_000)  # big enough for the serialized tier
+    expected = arr.copy()
+    ref = ray_tpu.put(arr)
+    store.get_serialized(ref.id)   # force wire form into the arena
+    store.evict_value(ref.id)      # drop the in-process copy
+    out = ray_tpu.get(ref)         # zero-copy deserialize from the arena
+    del ref
+    gc.collect()                   # distributed refcount -> 0 -> store.free()
+    # allocate a bunch of new objects that would reuse a recycled block
+    for i in range(5):
+        ray_tpu.put(np.full(200_000, float(i)))
+    np.testing.assert_array_equal(out, expected)
