@@ -1,0 +1,35 @@
+"""ray_tpu.dag — lazy DAGs and compiled graphs (ref: python/ray/dag/).
+
+Build with ``.bind()``, run interpreted with ``.execute()``, or lower onto
+fixed actors with typed channels via ``.experimental_compile()`` — the TP/PP
+dataplane substrate (ref: dag/compiled_dag_node.py, experimental/channel/).
+"""
+
+from ray_tpu.dag.channel import (
+    Channel,
+    ChannelClosed,
+    ChannelTimeout,
+    DeviceChannel,
+    IntraProcessChannel,
+    SharedMemoryChannel,
+)
+from ray_tpu.dag.collective_node import allreduce
+from ray_tpu.dag.compiled_dag import CompiledDAG, CompiledDAGRef
+from ray_tpu.dag.dag_node import (
+    ActorMethodNode,
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "DAGNode", "InputNode", "InputAttributeNode", "FunctionNode", "ClassNode",
+    "ClassMethodNode", "ActorMethodNode", "MultiOutputNode",
+    "CompiledDAG", "CompiledDAGRef", "allreduce",
+    "Channel", "IntraProcessChannel", "DeviceChannel", "SharedMemoryChannel",
+    "ChannelClosed", "ChannelTimeout",
+]

@@ -1,0 +1,73 @@
+"""Collective nodes for compiled graphs (ref: python/ray/dag/collective_node.py
+_CollectiveOperation:19, CollectiveOutputNode:133;
+python/ray/experimental/collective/allreduce.py).
+
+``allreduce.bind([n1, ..., nK])`` inserts an allreduce across K same-shaped
+per-actor outputs and yields K nodes, one per participant, so each actor's
+downstream ops see the reduced value.  In the reference this lowers to an
+NCCL group call scheduled into each actor's op list; here the reduction is
+performed on the channel fabric by a zero-resource reducer actor (gather →
+jax.tree psum-style sum → fan out).  On a real pod the reduced tensors are
+jax arrays, so the adds ride XLA; cross-chip movement is the DeviceChannel
+transfer (ICI), keeping the reference's semantics without a runtime
+collective library.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ray_tpu.dag.dag_node import ClassMethodNode, DAGNode
+
+
+def _tree_binop(a, b, op: Callable):
+    try:
+        import jax
+
+        return jax.tree_util.tree_map(op, a, b)
+    except Exception:
+        return op(a, b)
+
+
+class _ReducerActor:
+    """Gathers K shards, reduces, returns the result K times."""
+
+    def reduce(self, *shards, _op: str = "sum"):
+        import operator
+
+        binop = {"sum": operator.add, "max": max, "min": min}[_op]
+        acc = shards[0]
+        for s in shards[1:]:
+            acc = _tree_binop(acc, s, binop)
+        return acc
+
+
+class _SelectNode(ClassMethodNode):
+    """Identity node on the participant's actor selecting the reduced value
+    back onto that actor (keeps per-actor placement of downstream ops)."""
+
+
+class AllReduceWrapper:
+    """``from ray_tpu.dag.collective_node import allreduce; allreduce.bind(nodes)``"""
+
+    def bind(self, nodes: List[DAGNode], op: str = "sum") -> List[DAGNode]:
+        if not nodes:
+            raise ValueError("allreduce.bind requires at least one node")
+        for n in nodes:
+            if not isinstance(n, ClassMethodNode):
+                raise ValueError("allreduce participants must be actor-method nodes")
+        import ray_tpu
+
+        @ray_tpu.remote
+        class _Reducer(_ReducerActor):
+            pass
+
+        reducer = _Reducer.remote()
+        reduced = ClassMethodNode(reducer, "reduce", tuple(nodes), {"_op": op})
+        # K references to the one reduced node (mirrors CollectiveOutputNode's
+        # K outputs): each participant's downstream binds it and gets its own
+        # fan-out channel at compile time.
+        return [reduced for _ in nodes]
+
+
+allreduce = AllReduceWrapper()

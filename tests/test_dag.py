@@ -1,0 +1,295 @@
+"""Compiled graphs / DAG tests (ref: python/ray/dag/tests/,
+dag/tests/experimental/test_accelerated_dag.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import (
+    Channel,
+    ChannelClosed,
+    DeviceChannel,
+    InputNode,
+    MultiOutputNode,
+    allreduce,
+)
+
+
+@pytest.fixture
+def rt():
+    runtime = ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------- channels
+
+
+def test_channel_roundtrip():
+    ch = Channel(maxsize=2)
+    ch.write(1)
+    ch.write(2)
+    assert ch.read() == 1
+    assert ch.read() == 2
+    ch.close()
+    with pytest.raises(ChannelClosed):
+        ch.read()
+
+
+def test_device_channel_places_on_device():
+    import jax
+
+    dev = jax.devices()[3]
+    ch = DeviceChannel(device=dev, maxsize=1)
+    ch.write({"x": jax.numpy.ones((4,)), "y": 7})
+    out = ch.read()
+    assert out["y"] == 7
+    assert out["x"].devices() == {dev}
+
+
+# ------------------------------------------------------- interpreted DAGs
+
+
+def test_function_dag_interpreted(rt):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    with InputNode() as inp:
+        dag = mul.bind(add.bind(inp, 1), 10)
+    assert ray_tpu.get(dag.execute(4)) == 50
+
+
+def test_diamond_dedup(rt):
+    calls = []
+
+    @ray_tpu.remote
+    class Counter:
+        def bump(self, x):
+            calls.append(x)
+            return x + 1
+
+    c = Counter.remote()
+    with InputNode() as inp:
+        mid = c.bump.bind(inp)
+
+        @ray_tpu.remote
+        def pair(a, b):
+            return (a, b)
+
+        dag = pair.bind(mid, mid)
+    assert ray_tpu.get(dag.execute(1)) == (2, 2)
+    assert len(calls) == 1  # diamond evaluated once
+
+
+def test_class_node_lazy_actor(rt):
+    @ray_tpu.remote
+    class Adder:
+        def __init__(self, base):
+            self.base = base
+
+        def add(self, x):
+            return self.base + x
+
+    node = Adder.bind(100)
+    with InputNode() as inp:
+        dag = node.add.bind(inp)
+    assert ray_tpu.get(dag.execute(5)) == 105
+    assert ray_tpu.get(dag.execute(7)) == 107  # same actor reused
+
+
+# ---------------------------------------------------------- compiled DAGs
+
+
+def test_compiled_single_actor(rt):
+    @ray_tpu.remote
+    class Worker:
+        def double(self, x):
+            return 2 * x
+
+    w = Worker.remote()
+    with InputNode() as inp:
+        dag = w.double.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        refs = [compiled.execute(i) for i in range(10)]
+        assert [r.get(timeout=10) for r in refs] == [2 * i for i in range(10)]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_pipeline_two_actors(rt):
+    """A 2-stage pipeline: the PP shape (ref: test_accelerated_dag.py)."""
+
+    @ray_tpu.remote
+    class Stage:
+        def __init__(self, k):
+            self.k = k
+
+        def apply(self, x):
+            return x + self.k
+
+    s1, s2 = Stage.remote(1), Stage.remote(10)
+    with InputNode() as inp:
+        dag = s2.apply.bind(s1.apply.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        refs = [compiled.execute(i) for i in range(20)]
+        assert [r.get(timeout=10) for r in refs] == [i + 11 for i in range(20)]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_multi_output_and_input_attrs(rt):
+    @ray_tpu.remote
+    class W:
+        def f(self, a, b):
+            return a - b
+
+        def g(self, a):
+            return a * 3
+
+    w1, w2 = W.remote(), W.remote()
+    with InputNode() as inp:
+        dag = MultiOutputNode([w1.f.bind(inp[0], inp[1]), w2.g.bind(inp[0])])
+    compiled = dag.experimental_compile()
+    try:
+        ref = compiled.execute(9, 4)
+        assert ref.get(timeout=10) == [5, 27]
+        ref2 = compiled.execute(2, 1)
+        assert ref2.get(timeout=10) == [1, 6]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_error_propagation(rt):
+    @ray_tpu.remote
+    class W:
+        def boom(self, x):
+            if x < 0:
+                raise ValueError("negative")
+            return x
+
+        def double(self, x):
+            return 2 * x
+
+    w1, w2 = W.remote(), W.remote()
+    with InputNode() as inp:
+        dag = w2.double.bind(w1.boom.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(3).get(timeout=10) == 6
+        with pytest.raises(ValueError, match="negative"):
+            compiled.execute(-1).get(timeout=10)
+        # The pipeline survives an error.
+        assert compiled.execute(5).get(timeout=10) == 10
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_actor_usable_after_teardown(rt):
+    @ray_tpu.remote
+    class W:
+        def f(self, x):
+            return x + 1
+
+    w = W.remote()
+    with InputNode() as inp:
+        compiled = w.f.bind(inp).experimental_compile()
+    assert compiled.execute(1).get(timeout=10) == 2
+    compiled.teardown()
+    # The resident loop released the actor's mailbox thread.
+    assert ray_tpu.get(w.f.remote(41), timeout=10) == 42
+
+
+def test_compiled_device_channel_tensor_transport(rt):
+    import jax
+
+    dev = jax.devices()[5]
+
+    @ray_tpu.remote
+    class Producer:
+        def make(self, n):
+            return jax.numpy.arange(n, dtype=jax.numpy.float32)
+
+    @ray_tpu.remote
+    class Consumer:
+        def where(self, x):
+            return (float(x.sum()), list(x.devices()))
+
+    p, c = Producer.remote(), Consumer.remote()
+    with InputNode() as inp:
+        dag = c.where.bind(p.make.bind(inp).with_tensor_transport(device=dev))
+    compiled = dag.experimental_compile()
+    try:
+        total, devices = compiled.execute(4).get(timeout=10)
+        assert total == 6.0
+        assert devices == [dev]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_allreduce(rt):
+    @ray_tpu.remote
+    class Shard:
+        def __init__(self, val):
+            self.val = val
+
+        def grad(self, x):
+            return np.full((4,), self.val + x, np.float32)
+
+        def norm(self, g):
+            return float(np.linalg.norm(g))
+
+    shards = [Shard.remote(i) for i in range(4)]
+    with InputNode() as inp:
+        grads = [s.grad.bind(inp) for s in shards]
+        reduced = allreduce.bind(grads)
+        dag = MultiOutputNode([s.norm.bind(r) for s, r in zip(shards, reduced)])
+    compiled = dag.experimental_compile()
+    try:
+        out = compiled.execute(1).get(timeout=10)
+        # sum over shards of (i + 1) = 1+2+3+4 = 10 in each slot; norm = 10*2
+        assert out == [pytest.approx(20.0)] * 4
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_rejects_function_nodes(rt):
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    with InputNode() as inp:
+        dag = f.bind(inp)
+    with pytest.raises(ValueError, match="actor method"):
+        dag.experimental_compile()
+
+
+def test_shared_memory_channel(rt):
+    plasma = getattr(rt.store, "plasma", None)
+    if plasma is None:
+        pytest.skip("native plasma arena not available")
+    import threading
+
+    from ray_tpu.dag import SharedMemoryChannel
+
+    writer = SharedMemoryChannel(plasma, "test_shm_ch", maxsize=4)
+    # reader side: same arena, independent cursor (as in a separate process)
+    reader = SharedMemoryChannel(plasma, "test_shm_ch", maxsize=4)
+    got = []
+
+    def consume():
+        for _ in range(8):
+            got.append(reader.read(timeout=10)["i"])
+
+    t = threading.Thread(target=consume)
+    t.start()
+    for i in range(8):  # more than maxsize: exercises writer backpressure
+        writer.write({"i": i, "blob": b"x" * 1000}, timeout=10)
+    t.join(timeout=10)
+    assert got == list(range(8))
