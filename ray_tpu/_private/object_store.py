@@ -25,7 +25,7 @@ import os
 import threading
 import time
 from multiprocessing import shared_memory
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import serialization
 from ray_tpu._private.config import GLOBAL_CONFIG
@@ -91,7 +91,7 @@ class ObjectStore:
         os.makedirs(GLOBAL_CONFIG.spill_dir, exist_ok=True)
         self.stats = {"puts": 0, "gets": 0, "spills": 0, "restores": 0, "freed": 0}
         self._graveyard: List[shared_memory.SharedMemory] = []
-        self._plasma_graveyard: List[ObjectID] = []
+        self._plasma_graveyard: Set[ObjectID] = set()
         self.plasma = _try_plasma(capacity_bytes)
 
     @property
@@ -245,14 +245,23 @@ class ObjectStore:
                     buf.release()
                     self.plasma.seal(object_id)
                     self._bytes_used += size
+                    entry.in_plasma = True
+                    entry.size = size
+                    return
                 except PlasmaObjectExists:
-                    # Already resident (duplicate delivery, e.g. a task retry);
-                    # the first create's accounting and ref stand.
-                    if not entry.in_plasma:
-                        self._bytes_used += size
-                entry.in_plasma = True
-                entry.size = size
-                return
+                    if object_id not in self._plasma_graveyard:
+                        # Duplicate delivery of the same bytes (task retry);
+                        # the first create's accounting and ref stand.
+                        if not entry.in_plasma:
+                            self._bytes_used += size
+                        entry.in_plasma = True
+                        entry.size = size
+                        return
+                    # A freed-but-still-mapped (graveyarded) object holds this
+                    # key: its bytes are STALE for a re-created ObjectID
+                    # (lineage reconstruction after free).  Aliasing it would
+                    # serve old data and un-pin live views; keep the new
+                    # incarnation out of the arena instead (disk below).
             except MemoryError:
                 pass  # arena full even after eviction: spill to disk below
         else:
@@ -299,6 +308,11 @@ class ObjectStore:
             path = os.path.join(GLOBAL_CONFIG.spill_dir, f"{oid}.bin".replace(":", "_"))
             with open(path, "wb") as f:
                 f.write(bytes(view))
+            # Drop the view BEFORE releasing: a live memoryview into the shm
+            # segment makes shm.close() raise BufferError, parking the
+            # segment in the graveyard and reclaiming nothing.
+            view.release()
+            del view
             self._release_serialized(oid, entry)
             entry.spill_path = path
             entry.state = ObjectState.SPILLED
@@ -314,7 +328,7 @@ class ObjectStore:
                 # delete nor LRU eviction can touch it; reclaimed only when
                 # the arena is unlinked at shutdown (the plasma analogue of
                 # the shm graveyard below).
-                self._plasma_graveyard.append(object_id)
+                self._plasma_graveyard.add(object_id)
             else:
                 self.plasma.release(object_id)  # drop creator ref
                 self.plasma.delete(object_id)

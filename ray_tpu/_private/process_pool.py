@@ -133,11 +133,25 @@ def _next_handoff_key(prefix: str) -> str:
 
 class _ProcWorker:
     def __init__(self, arena_path: Optional[str] = None, arena=None) -> None:
+        import sys
+
         ctx = mp.get_context("spawn")
         self.conn, child_conn = ctx.Pipe()
         self.proc = ctx.Process(
             target=_worker_main, args=(child_conn, arena_path), daemon=True)
-        self.proc.start()
+        # Drivers run from a pipe/heredoc have __main__.__file__ == "<stdin>";
+        # spawn's prepare step would try to re-execute that path in the child
+        # and crash it.  Mask the pseudo-file for the duration of start().
+        main_mod = sys.modules.get("__main__")
+        main_file = getattr(main_mod, "__file__", None)
+        masked = main_file is not None and str(main_file).startswith("<")
+        if masked:
+            del main_mod.__file__
+        try:
+            self.proc.start()
+        finally:
+            if masked:
+                main_mod.__file__ = main_file
         child_conn.close()
         self._arena = arena  # the pool's shared driver-side client
         self.seq = 0
@@ -160,8 +174,13 @@ class _ProcWorker:
         try:
             reply = serialization.loads(self.conn.recv_bytes())
         except (EOFError, OSError) as e:
-            # Worker died before consuming the args — reclaim them.
+            # Worker died. Reclaim the args if unconsumed, and the result
+            # object if the worker got far enough to produce one before
+            # dying (its key is derivable: worker pid + this seq) — a
+            # sealed-but-unreported result would otherwise pin arena memory
+            # forever (refcount 1 blocks LRU eviction).
             _spec_cleanup(arena, args_spec)
+            _spec_cleanup(arena, ("plasma", f"res:{self.proc.pid}:{self.seq}"))
             raise WorkerCrashedError(f"process worker died: {e}") from e
         kind, seq, payload = reply
         self.last_used = time.monotonic()

@@ -42,11 +42,6 @@ class _ReducerActor:
         return acc
 
 
-class _SelectNode(ClassMethodNode):
-    """Identity node on the participant's actor selecting the reduced value
-    back onto that actor (keeps per-actor placement of downstream ops)."""
-
-
 class AllReduceWrapper:
     """``from ray_tpu.dag.collective_node import allreduce; allreduce.bind(nodes)``"""
 

@@ -143,8 +143,9 @@ class CompiledDAGRef:
     def get(self, timeout: Optional[float] = None):
         if self._consumed:
             raise ValueError("CompiledDAGRef.get() may only be called once")
+        value = self._dag._fetch(self._seq, timeout)  # timeout leaves it gettable
         self._consumed = True
-        return self._dag._fetch(self._seq, timeout)
+        return value
 
     def __repr__(self):
         return f"CompiledDAGRef(seq={self._seq})"
@@ -154,10 +155,16 @@ class CompiledDAG:
     def __init__(self, output_node: DAGNode, max_buffered: int = 16):
         self._output_node = output_node
         self._max_buffered = max_buffered
-        self._lock = threading.Lock()
+        # Separate submit/fetch locks: execute() may block on a full input
+        # channel, and only get() drains the pipeline — one shared lock would
+        # deadlock the driver (submit blocked on write, fetch blocked on the
+        # lock).  Matches the reference's split of execute vs result buffer.
+        self._submit_lock = threading.Lock()
+        self._fetch_lock = threading.Lock()
         self._seq = 0
         self._read_seq = 0
         self._results: Dict[int, Any] = {}
+        self._staged: List[List[Any]] = []  # per-output-channel partial reads
         self._input_channels: List[Channel] = []
         self._output_channels: List[Channel] = []
         self._all_channels: List[Channel] = []
@@ -244,6 +251,7 @@ class CompiledDAG:
             self._output_channels.append(ch)
 
         self._is_multi_output = isinstance(out_node, MultiOutputNode)
+        self._staged = [[] for _ in self._output_channels]
 
         # Group ops per actor in global topo order and start resident loops.
         runtime = get_runtime()
@@ -294,9 +302,18 @@ class CompiledDAG:
     # -- execution ---------------------------------------------------------
 
     def execute(self, *args, **kwargs) -> CompiledDAGRef:
-        with self._lock:
+        with self._submit_lock:
             if self._torn_down:
                 raise ValueError("Compiled DAG was torn down")
+            # Unconsumed-results cap: past this point the pipeline's buffers
+            # are full and an un-drained execute would block forever (ref:
+            # compiled_dag_node.py max buffered results guard).
+            if self._seq - self._read_seq >= 2 * self._max_buffered:
+                raise ValueError(
+                    f"{self._seq - self._read_seq} executions in flight and "
+                    f"none consumed; call .get() on earlier CompiledDAGRefs "
+                    f"(buffer limit {2 * self._max_buffered})"
+                )
             payload = (args, kwargs)
             for ch in self._input_channels:
                 ch.write(payload)
@@ -305,9 +322,15 @@ class CompiledDAG:
             return CompiledDAGRef(self, seq)
 
     def _fetch(self, seq: int, timeout: Optional[float]):
-        with self._lock:
+        with self._fetch_lock:
             while seq not in self._results:
-                outs = [ch.read(timeout=timeout) for ch in self._output_channels]
+                # Stage per-channel reads so a timeout mid-row leaves already
+                # read elements buffered, not dropped — otherwise the output
+                # channels desync permanently.
+                for idx, ch in enumerate(self._output_channels):
+                    if len(self._staged[idx]) == 0:
+                        self._staged[idx].append(ch.read(timeout=timeout))
+                outs = [buf.pop(0) for buf in self._staged]
                 value = outs if self._is_multi_output else outs[0]
                 self._results[self._read_seq] = value
                 self._read_seq += 1
@@ -319,7 +342,7 @@ class CompiledDAG:
         return value
 
     def teardown(self) -> None:
-        with self._lock:
+        with self._fetch_lock:
             if self._torn_down:
                 return
             self._torn_down = True

@@ -104,11 +104,18 @@ class PlasmaClient:
         self._map = mmap.mmap(self._fd, size)
         self._view = memoryview(self._map)
 
+
+    def _handle(self):
+        h = self._h
+        if not h:
+            raise ConnectionError("plasma client is closed")
+        return h
+
     # ------------------------------------------------------------- lifecycle
     def create(self, object_id, size: int) -> memoryview:
         """Allocate a writable buffer; write into it, then seal()."""
         off = ctypes.c_uint64()
-        rc = self._lib.tps_create(self._h, object_key(object_id), size, ctypes.byref(off))
+        rc = self._lib.tps_create(self._handle(), object_key(object_id), size, ctypes.byref(off))
         if rc == -1:
             raise PlasmaObjectExists(f"{object_id} already in store")
         if rc == -2:
@@ -118,12 +125,12 @@ class PlasmaClient:
         return self._view[off.value : off.value + size]
 
     def seal(self, object_id) -> None:
-        if self._lib.tps_seal(self._h, object_key(object_id)) != 0:
+        if self._lib.tps_seal(self._handle(), object_key(object_id)) != 0:
             raise ValueError(f"seal failed for {object_id}")
 
     def unseal(self, object_id) -> None:
         """Reopen for in-place mutation (compiled-graph channels)."""
-        if self._lib.tps_unseal(self._h, object_key(object_id)) != 0:
+        if self._lib.tps_unseal(self._handle(), object_key(object_id)) != 0:
             raise ValueError(f"unseal failed for {object_id}")
 
     def get(self, object_id, timeout: Optional[float] = None) -> Optional[memoryview]:
@@ -131,30 +138,30 @@ class PlasmaClient:
         None on timeout. timeout=None blocks forever; 0 polls."""
         off, size = ctypes.c_uint64(), ctypes.c_uint64()
         tmo = -1 if timeout is None else max(0, int(timeout * 1000))
-        rc = self._lib.tps_get(self._h, object_key(object_id), tmo,
+        rc = self._lib.tps_get(self._handle(), object_key(object_id), tmo,
                                ctypes.byref(off), ctypes.byref(size))
         if rc != 0:
             return None
         return self._view[off.value : off.value + size.value]
 
     def release(self, object_id) -> None:
-        self._lib.tps_release(self._h, object_key(object_id))
+        self._lib.tps_release(self._handle(), object_key(object_id))
 
     def delete(self, object_id) -> bool:
-        return self._lib.tps_delete(self._h, object_key(object_id)) == 0
+        return self._lib.tps_delete(self._handle(), object_key(object_id)) == 0
 
     def contains(self, object_id) -> bool:
-        return bool(self._lib.tps_contains(self._h, object_key(object_id)))
+        return bool(self._lib.tps_contains(self._handle(), object_key(object_id)))
 
     def refcount(self, object_id) -> int:
-        return int(self._lib.tps_refcount(self._h, object_key(object_id)))
+        return int(self._lib.tps_refcount(self._handle(), object_key(object_id)))
 
     def evict(self, nbytes: int) -> int:
-        return int(self._lib.tps_evict(self._h, nbytes))
+        return int(self._lib.tps_evict(self._handle(), nbytes))
 
     def usage(self) -> Tuple[int, int, int]:
         used, cap, objs = ctypes.c_uint64(), ctypes.c_uint64(), ctypes.c_uint64()
-        self._lib.tps_usage(self._h, ctypes.byref(used), ctypes.byref(cap), ctypes.byref(objs))
+        self._lib.tps_usage(self._handle(), ctypes.byref(used), ctypes.byref(cap), ctypes.byref(objs))
         return used.value, cap.value, objs.value
 
     # ------------------------------------------------------------ composites

@@ -47,7 +47,7 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x54505553544f5245ULL;  // "TPUSTORE"
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 2;
 constexpr uint32_t kIdLen = 20;
 constexpr uint32_t kBlockMagic = 0xb10cb10c;
 constexpr uint64_t kAlign = 64;  // cacheline; also keeps numpy views aligned
@@ -56,11 +56,14 @@ constexpr uint64_t kAlign = 64;  // cacheline; also keeps numpy views aligned
 
 struct ObjectEntry {
   uint8_t id[kIdLen];
-  uint8_t state;  // 0 empty, 1 created, 2 sealed, 3 tombstone
+  uint8_t state;   // 0 empty, 1 created, 2 sealed, 3 tombstone
+  uint8_t in_lru;  // member of the evictable LRU list
   uint32_t refcount;
   uint64_t offset;  // data offset from arena base
   uint64_t size;
   uint64_t lru_tick;
+  uint32_t lru_next;  // entry index + 1; 0 = none
+  uint32_t lru_prev;
 };
 
 enum EntryState : uint8_t { kEmpty = 0, kCreated = 1, kSealed = 2, kTomb = 3 };
@@ -76,6 +79,8 @@ struct Header {
   uint64_t num_objects;
   uint64_t lru_clock;
   uint64_t free_head;  // offset of first free block, 0 = none
+  uint32_t lru_head;   // evictable (sealed, refcount==0) entries, LRU first;
+  uint32_t lru_tail;   // entry index + 1, 0 = none
   pthread_mutex_t mutex;
   pthread_cond_t cond;
 };
@@ -211,6 +216,36 @@ void heap_free(Client* c, uint64_t block_off) {
   if (after) block_at(c, after)->prev_size = b->size;
 }
 
+// ------------------------------------------------------------------ LRU list
+// Intrusive doubly-linked list of evictable entries (sealed, refcount==0),
+// head = least recent (ref: plasma/eviction_policy.h).  O(1) maintenance on
+// seal/get/release beats a full table scan per eviction victim.
+
+inline ObjectEntry* entry_at(Client* c, uint32_t idx1) {
+  return idx1 ? &c->table[idx1 - 1] : nullptr;
+}
+
+void lru_push_mru(Client* c, ObjectEntry* e) {
+  if (e->in_lru) return;
+  e->in_lru = 1;
+  uint32_t me = (uint32_t)(e - c->table) + 1;
+  e->lru_prev = c->hdr->lru_tail;
+  e->lru_next = 0;
+  if (c->hdr->lru_tail) entry_at(c, c->hdr->lru_tail)->lru_next = me;
+  c->hdr->lru_tail = me;
+  if (!c->hdr->lru_head) c->hdr->lru_head = me;
+}
+
+void lru_remove(Client* c, ObjectEntry* e) {
+  if (!e->in_lru) return;
+  e->in_lru = 0;
+  if (e->lru_prev) entry_at(c, e->lru_prev)->lru_next = e->lru_next;
+  else c->hdr->lru_head = e->lru_next;
+  if (e->lru_next) entry_at(c, e->lru_next)->lru_prev = e->lru_prev;
+  else c->hdr->lru_tail = e->lru_prev;
+  e->lru_next = e->lru_prev = 0;
+}
+
 // -------------------------------------------------------------- object table
 
 uint64_t id_hash(const uint8_t* id) {
@@ -251,6 +286,7 @@ ObjectEntry* table_find(Client* c, const uint8_t* id, bool want_insert) {
 }
 
 void entry_delete(Client* c, ObjectEntry* e) {
+  lru_remove(c, e);
   heap_free(c, e->offset - sizeof(BlockHeader));
   c->hdr->bytes_in_use -= e->size;
   c->hdr->num_objects -= 1;
@@ -263,17 +299,10 @@ void entry_delete(Client* c, ObjectEntry* e) {
 // freed (ref: plasma/eviction_policy.h LRU). Caller holds lock.
 uint64_t evict_locked(Client* c, uint64_t want) {
   uint64_t freed = 0;
-  while (freed < want) {
-    ObjectEntry* victim = nullptr;
-    for (uint32_t i = 0; i < c->hdr->max_entries; ++i) {
-      ObjectEntry* e = &c->table[i];
-      if (e->state == kSealed && e->refcount == 0 &&
-          (!victim || e->lru_tick < victim->lru_tick))
-        victim = e;
-    }
-    if (!victim) break;
+  while (freed < want && c->hdr->lru_head) {
+    ObjectEntry* victim = entry_at(c, c->hdr->lru_head);
     freed += victim->size;
-    entry_delete(c, victim);
+    entry_delete(c, victim);  // removes from the list
   }
   return freed;
 }
@@ -403,6 +432,8 @@ int tps_create(void* h, const uint8_t* id, uint64_t size, uint64_t* out_off) {
     return -3;
   }
   e->state = kCreated;
+  e->in_lru = 0;
+  e->lru_next = e->lru_prev = 0;
   e->refcount = 1;  // creator's reference
   e->offset = payload_off(block);
   e->size = size;
@@ -424,6 +455,7 @@ int tps_seal(void* h, const uint8_t* id) {
     return -1;
   }
   e->state = kSealed;
+  if (e->refcount == 0) lru_push_mru(c, e);
   pthread_cond_broadcast(&c->hdr->cond);
   unlock(c);
   return 0;
@@ -439,6 +471,10 @@ int tps_unseal(void* h, const uint8_t* id) {
   if (!e || e->state != kSealed) {
     unlock(c);
     return -1;
+  }
+  if (e->refcount != 1) {  // enforce sole ownership: no readers' live views
+    unlock(c);
+    return -2;
   }
   e->state = kCreated;
   unlock(c);
@@ -465,6 +501,7 @@ int tps_get(void* h, const uint8_t* id, int64_t timeout_ms, uint64_t* out_off,
   for (;;) {
     ObjectEntry* e = table_find(c, id, false);
     if (e && e->state == kSealed) {
+      if (e->refcount == 0) lru_remove(c, e);  // no longer evictable
       e->refcount += 1;
       e->lru_tick = ++c->hdr->lru_clock;
       *out_off = e->offset;
@@ -496,7 +533,10 @@ int tps_release(void* h, const uint8_t* id) {
     unlock(c);
     return -1;
   }
-  if (e->refcount > 0) e->refcount -= 1;
+  if (e->refcount > 0) {
+    e->refcount -= 1;
+    if (e->refcount == 0 && e->state == kSealed) lru_push_mru(c, e);
+  }
   unlock(c);
   return 0;
 }

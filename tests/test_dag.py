@@ -293,3 +293,52 @@ def test_shared_memory_channel(rt):
         writer.write({"i": i, "blob": b"x" * 1000}, timeout=10)
     t.join(timeout=10)
     assert got == list(range(8))
+
+
+def test_compiled_inflight_cap_raises_not_deadlocks(rt):
+    @ray_tpu.remote
+    class W:
+        def f(self, x):
+            return x
+
+    w = W.remote()
+    with InputNode() as inp:
+        compiled = w.f.bind(inp).experimental_compile()
+    try:
+        with pytest.raises(ValueError, match="in flight"):
+            for i in range(200):  # never consume: must raise, not hang
+                compiled.execute(i)
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_multi_output_timeout_no_desync(rt):
+    import time as _t
+
+    @ray_tpu.remote
+    class Fast:
+        def f(self, x):
+            return ("fast", x)
+
+    @ray_tpu.remote
+    class Slow:
+        def f(self, x):
+            _t.sleep(0.5)
+            return ("slow", x)
+
+    fast, slow = Fast.remote(), Slow.remote()
+    with InputNode() as inp:
+        dag = MultiOutputNode([fast.f.bind(inp), slow.f.bind(inp)])
+    compiled = dag.experimental_compile()
+    try:
+        ref0 = compiled.execute(0)
+        from ray_tpu.dag import ChannelTimeout
+
+        with pytest.raises(ChannelTimeout):
+            ref0.get(timeout=0.05)  # fast branch already read, slow times out
+        # Retry after timeout must return the CORRECT, aligned row.
+        assert ref0.get(timeout=10) == [("fast", 0), ("slow", 0)]
+        ref1 = compiled.execute(1)
+        assert ref1.get(timeout=10) == [("fast", 1), ("slow", 1)]
+    finally:
+        compiled.teardown()

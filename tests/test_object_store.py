@@ -93,3 +93,33 @@ def test_zero_copy_view_survives_free(ray_start_regular):
     for i in range(5):
         ray_tpu.put(np.full(200_000, float(i)))
     np.testing.assert_array_equal(out, expected)
+
+
+def test_free_then_reput_same_id_serves_new_value(ray_start_regular):
+    """A freed-but-view-pinned (graveyarded) arena object must not alias a
+    re-created ObjectID: the new incarnation's bytes win (lineage
+    reconstruction after free)."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.ids import ObjectID
+
+    rt = ray_start_regular
+    store = rt.store
+    oid = ObjectID.from_random()
+    store.put(oid, np.arange(4, dtype=np.float64))
+    old_view = store.get_serialized(oid)  # force wire form into the arena
+    arr = store.get(oid)
+    # Export a zero-copy view so free() graveyards instead of deleting.
+    _ = store._serialized_view(oid, store._entries[oid], export=True)
+    store.free(oid)
+    # Re-create the same ObjectID with DIFFERENT bytes.
+    store.put(oid, np.arange(8, dtype=np.float64) * 3)
+    out = store.get(oid)
+    assert out.shape == (8,)
+    assert float(out[1]) == 3.0
+    # And the wire form round-trips the NEW value, not the stale arena bytes.
+    view2 = store.get_serialized(oid)
+    from ray_tpu._private import serialization
+
+    assert serialization.deserialize_flat(memoryview(bytes(view2))).shape == (8,)
