@@ -35,6 +35,11 @@ class GPTConfig:
     #: worth HBM); "full" rematerializes everything.
     remat_policy: str = "save_attn"
     attn_impl: str = "auto"  # auto | xla | pallas
+    #: Pipeline stages over the mesh's `pipe` axis (parallel/pipeline.py);
+    #: 1 = no pipelining. n_layer % pp_stages must be 0.
+    pp_stages: int = 1
+    #: GPipe microbatches; 0 = pp_stages (minimum). Must divide batch.
+    pp_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -83,23 +88,26 @@ def init_params(config: GPTConfig, key) -> Dict[str, Any]:
 
 
 def logical_axes(config: GPTConfig) -> Dict[str, Any]:
-    """Logical-axis pytree matching init_params (leading None = layer axis)."""
+    """Logical-axis pytree matching init_params.  The leading stacked-layer
+    axis is "layers": sharded over `pipe` when pipelining (each stage holds
+    its contiguous slice of layers), unsharded otherwise (pipe=1)."""
+    L = "layers"
     return {
         "wte": ("vocab", "embed"),
         "wpe": (None, "embed"),
         "blocks": {
-            "ln1_scale": (None, "norm"),
-            "ln1_bias": (None, "norm"),
-            "qkv_w": (None, "embed", "heads"),
-            "qkv_b": (None, "heads"),
-            "out_w": (None, "heads", "embed"),
-            "out_b": (None, "norm"),
-            "ln2_scale": (None, "norm"),
-            "ln2_bias": (None, "norm"),
-            "mlp_in_w": (None, "embed", "mlp"),
-            "mlp_in_b": (None, "mlp"),
-            "mlp_out_w": (None, "mlp", "embed"),
-            "mlp_out_b": (None, "norm"),
+            "ln1_scale": (L, "norm"),
+            "ln1_bias": (L, "norm"),
+            "qkv_w": (L, "embed", "heads"),
+            "qkv_b": (L, "heads"),
+            "out_w": (L, "heads", "embed"),
+            "out_b": (L, "norm"),
+            "ln2_scale": (L, "norm"),
+            "ln2_bias": (L, "norm"),
+            "mlp_in_w": (L, "embed", "mlp"),
+            "mlp_in_b": (L, "mlp"),
+            "mlp_out_w": (L, "mlp", "embed"),
+            "mlp_out_b": (L, "norm"),
         },
         "lnf_scale": ("norm",),
         "lnf_bias": ("norm",),
@@ -208,7 +216,32 @@ def forward(params: Dict[str, Any], tokens, config: GPTConfig):
     def scan_body(carry, blk):
         return block_fn(carry, blk), None
 
-    x, _ = lax.scan(scan_body, x, params["blocks"])
+    if config.pp_stages > 1:
+        # GPipe over the `pipe` mesh axis: each stage scans its local slice
+        # of the stacked blocks (leading "layers" axis is pipe-sharded).
+        from ray_tpu.parallel.pipeline import pipeline_apply
+
+        if config.n_layer % config.pp_stages:
+            raise ValueError(
+                f"n_layer {config.n_layer} % pp_stages {config.pp_stages} != 0")
+        # The mesh is authoritative for the stage count: a mismatched config
+        # would silently run a different schedule than requested.
+        amesh = jax.sharding.get_abstract_mesh()
+        if amesh is not None and "pipe" in getattr(amesh, "shape", {}) \
+                and amesh.shape["pipe"] not in (1, config.pp_stages):
+            raise ValueError(
+                f"config.pp_stages={config.pp_stages} but mesh pipe axis is "
+                f"{amesh.shape['pipe']}")
+
+        def stage_fn(local_blocks, h):
+            h, _ = lax.scan(scan_body, h, local_blocks)
+            return h
+
+        x = pipeline_apply(
+            stage_fn, params["blocks"], x,
+            n_microbatches=config.pp_microbatches or config.pp_stages)
+    else:
+        x, _ = lax.scan(scan_body, x, params["blocks"])
     x = _layernorm(x, params["lnf_scale"], params["lnf_bias"]).astype(dt)
     # Tied LM head; logits accumulate in fp32 for a stable loss.
     logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(dt),

@@ -1,0 +1,255 @@
+"""Mixture-of-Experts transformer with native expert parallelism.
+
+The reference has NO native MoE/expert parallelism (SURVEY §2.3 — EP only via
+integrated frameworks on Ray-provided process groups).  Here it is native and
+TPU-shaped, the GShard recipe: top-k token-choice routing with a fixed expert
+capacity, dispatch/combine expressed as einsums against a one-hot dispatch
+tensor — everything is dense, static-shaped, and MXU-friendly, and when the
+leading expert axis of the expert weights is sharded over the `expert` mesh
+axis XLA lowers the dispatch einsums to all_to_all over ICI.  No
+data-dependent shapes anywhere: over-capacity tokens are dropped (their
+combine weight is zero), exactly as in GShard/Switch.
+
+Reuses the GPT-2 attention block (models/gpt2.py); only the MLP is replaced
+by the MoE layer.  An auxiliary load-balance loss (Switch §2.2 form:
+E * sum_e f_e * p_e) keeps routing uniform.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models import gpt2
+from ray_tpu.models.gpt2 import _attention, _layernorm
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 32768
+    n_layer: int = 8
+    n_head: int = 8
+    d_model: int = 512
+    seq_len: int = 1024
+    n_experts: int = 8
+    expert_mlp: int = 1024  # per-expert hidden width
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attn_impl: str = "auto"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    def capacity(self, n_tokens: int) -> int:
+        """Per-expert token capacity C, padded to a multiple of 8 for tiling."""
+        c = int(math.ceil(self.capacity_factor * self.top_k * n_tokens
+                          / self.n_experts))
+        return max(8, -(-c // 8) * 8)
+
+    @staticmethod
+    def tiny() -> "MoEConfig":
+        return MoEConfig(vocab_size=1024, n_layer=2, n_head=4, d_model=128,
+                         seq_len=64, n_experts=4, expert_mlp=256)
+
+    def _attn_view(self) -> gpt2.GPTConfig:
+        """GPTConfig view so the attention kernel selection is shared."""
+        return gpt2.GPTConfig(
+            vocab_size=self.vocab_size, n_layer=self.n_layer,
+            n_head=self.n_head, d_model=self.d_model, seq_len=self.seq_len,
+            dtype=self.dtype, attn_impl=self.attn_impl)
+
+
+def init_params(config: MoEConfig, key) -> Dict[str, Any]:
+    D, L, V, S = config.d_model, config.n_layer, config.vocab_size, config.seq_len
+    E, F = config.n_experts, config.expert_mlp
+    std = 0.02
+    resid_std = std / math.sqrt(2 * L)
+    ks = jax.random.split(key, 8)
+
+    def norm(key, shape, s):
+        return jax.random.normal(key, shape, jnp.float32) * s
+
+    return {
+        "wte": norm(ks[0], (V, D), std),
+        "wpe": norm(ks[1], (S, D), std / 2),
+        "blocks": {
+            "ln1_scale": jnp.ones((L, D)),
+            "ln1_bias": jnp.zeros((L, D)),
+            "qkv_w": norm(ks[2], (L, D, 3 * D), std),
+            "qkv_b": jnp.zeros((L, 3 * D)),
+            "out_w": norm(ks[3], (L, D, D), resid_std),
+            "out_b": jnp.zeros((L, D)),
+            "ln2_scale": jnp.ones((L, D)),
+            "ln2_bias": jnp.zeros((L, D)),
+            "router_w": norm(ks[4], (L, D, E), std),
+            "expert_in_w": norm(ks[5], (L, E, D, F), std),
+            "expert_in_b": jnp.zeros((L, E, F)),
+            "expert_out_w": norm(ks[6], (L, E, F, D), resid_std),
+            "expert_out_b": jnp.zeros((L, E, D)),
+        },
+        "lnf_scale": jnp.ones((D,)),
+        "lnf_bias": jnp.zeros((D,)),
+    }
+
+
+def logical_axes(config: MoEConfig) -> Dict[str, Any]:
+    La = "layers"
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": {
+            "ln1_scale": (La, "norm"),
+            "ln1_bias": (La, "norm"),
+            "qkv_w": (La, "embed", "heads"),
+            "qkv_b": (La, "heads"),
+            "out_w": (La, "heads", "embed"),
+            "out_b": (La, "norm"),
+            "ln2_scale": (La, "norm"),
+            "ln2_bias": (La, "norm"),
+            "router_w": (La, "embed", None),
+            "expert_in_w": (La, "expert", "embed", "mlp"),
+            "expert_in_b": (La, "expert", "mlp"),
+            "expert_out_w": (La, "expert", "mlp", "embed"),
+            "expert_out_b": (La, "expert", "norm"),
+        },
+        "lnf_scale": ("norm",),
+        "lnf_bias": ("norm",),
+    }
+
+
+def num_params(config: MoEConfig) -> int:
+    D, L, V, S = config.d_model, config.n_layer, config.vocab_size, config.seq_len
+    E, F = config.n_experts, config.expert_mlp
+    attn = 4 * D + 3 * D * D + 3 * D + D * D + D
+    moe = D * E + E * D * F + E * F + E * F * D + E * D
+    return V * D + S * D + L * (attn + moe) + 2 * D
+
+
+def _route(x32, router_w, config: MoEConfig):
+    """Top-k token-choice routing.  x32: (N, D) fp32 tokens.
+
+    Returns (dispatch (N, E, C) one-hot*bool, combine (N, E, C) weights,
+    aux load-balance loss).  All static shapes.
+    """
+    N = x32.shape[0]
+    E, K = config.n_experts, config.top_k
+    C = config.capacity(N)
+
+    logits = x32 @ router_w  # (N, E)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # Switch-style aux loss: E * sum_e (token fraction)_e * (mean prob)_e,
+    # computed on the top-1 assignment.
+    top1 = jnp.argmax(probs, axis=-1)
+    frac = jnp.mean(jax.nn.one_hot(top1, E, dtype=jnp.float32), axis=0)
+    aux = E * jnp.sum(frac * jnp.mean(probs, axis=0))
+
+    dispatch = jnp.zeros((N, E, C), jnp.float32)
+    combine = jnp.zeros((N, E, C), jnp.float32)
+    # Running per-expert fill count; carried across the k selections so the
+    # 2nd choice lands after all 1st choices of the same expert.
+    fill = jnp.zeros((E,), jnp.int32)
+    masked = probs
+    for _ in range(K):
+        choice = jnp.argmax(masked, axis=-1)              # (N,)
+        gate = jnp.take_along_axis(masked, choice[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)   # (N, E)
+        # Position of each token within its chosen expert's buffer.
+        pos_in = jnp.cumsum(onehot, axis=0) - onehot + fill[None, :]
+        pos = jnp.sum(pos_in * onehot, axis=-1)           # (N,)
+        keep = pos < C
+        oh_pos = jax.nn.one_hot(pos, C, dtype=jnp.float32) * keep[:, None]
+        d = onehot.astype(jnp.float32)[:, :, None] * oh_pos[:, None, :]
+        dispatch = dispatch + d
+        combine = combine + gate[:, None, None] * d
+        fill = fill + jnp.sum(onehot * keep[:, None], axis=0)
+        masked = masked * (1.0 - onehot.astype(probs.dtype))
+    # Renormalize combine weights over the kept choices per token.
+    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    return dispatch, combine, aux
+
+
+def _moe_mlp(x, blk, config: MoEConfig):
+    """MoE feed-forward.  x: (B, S, D) -> (B, S, D), plus aux loss."""
+    B, S, D = x.shape
+    dt = config.dtype
+    x32 = x.reshape(B * S, D).astype(jnp.float32)
+    dispatch, combine, aux = _route(x32, blk["router_w"], config)
+
+    # Dispatch: (N,E,C) x (N,D) -> (E,C,D); sharded over `expert` this is the
+    # all_to_all that sends tokens to their expert's devices.
+    xe = jnp.einsum("nec,nd->ecd", dispatch.astype(dt), x.reshape(B * S, D))
+    h = jnp.einsum("ecd,edf->ecf", xe, blk["expert_in_w"].astype(dt))
+    h = jax.nn.gelu(h + blk["expert_in_b"].astype(dt)[:, None, :])
+    ye = jnp.einsum("ecf,efd->ecd", h, blk["expert_out_w"].astype(dt))
+    ye = ye + blk["expert_out_b"].astype(dt)[:, None, :]
+    y = jnp.einsum("nec,ecd->nd", combine.astype(dt), ye)
+    return y.reshape(B, S, D), aux
+
+
+def _block(x, blk, config: MoEConfig):
+    B, S, D = x.shape
+    H, hd = config.n_head, config.head_dim
+    dt = config.dtype
+
+    h = _layernorm(x, blk["ln1_scale"], blk["ln1_bias"]).astype(dt)
+    qkv = h @ blk["qkv_w"].astype(dt) + blk["qkv_b"].astype(dt)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    attn = _attention(q.reshape(B, S, H, hd), k.reshape(B, S, H, hd),
+                      v.reshape(B, S, H, hd), config._attn_view())
+    x = x + attn.reshape(B, S, D) @ blk["out_w"].astype(dt) + blk["out_b"].astype(dt)
+
+    h = _layernorm(x, blk["ln2_scale"], blk["ln2_bias"]).astype(dt)
+    y, aux = _moe_mlp(h, blk, config)
+    return x + y, aux
+
+
+def forward(params: Dict[str, Any], tokens, config: MoEConfig):
+    """tokens (B, S) int32 -> (logits (B, S, V) fp32, total aux loss)."""
+    B, S = tokens.shape
+    dt = config.dtype
+    x = params["wte"][tokens].astype(dt) + params["wpe"][:S].astype(dt)
+
+    block_fn = partial(_block, config=config)
+    if config.remat:
+        block_fn = jax.checkpoint(block_fn)
+
+    def scan_body(carry, blk):
+        x, aux = block_fn(carry, blk)
+        return x, aux
+
+    x, auxes = lax.scan(scan_body, x, params["blocks"])
+    x = _layernorm(x, params["lnf_scale"], params["lnf_bias"]).astype(dt)
+    logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(dt),
+                        preferred_element_type=jnp.float32)
+    return logits, jnp.sum(auxes)
+
+
+def loss_fn(params, tokens, targets, config: MoEConfig):
+    logits, aux = forward(params, tokens, config)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt) + config.aux_loss_weight * aux
+
+
+def make_train_step(config: MoEConfig, optimizer):
+    def step(params, opt_state, tokens, targets):
+        import optax
+
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets, config)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

@@ -10,9 +10,10 @@ from ray_tpu.parallel.mesh import (
     pytree_sharding,
     shard_pytree,
 )
+from ray_tpu.parallel.pipeline import pipeline_apply
 
 __all__ = [
     "AXIS_NAMES", "DEFAULT_RULES", "MeshSpec", "batch_sharding",
     "logical_to_spec", "make_mesh", "named_sharding", "partition",
-    "pytree_sharding", "shard_pytree",
+    "pipeline_apply", "pytree_sharding", "shard_pytree",
 ]

@@ -2,15 +2,19 @@
 
 The reference has no native TP/PP/SP (SURVEY §2.3: delegated to DeepSpeed/HF
 over Ray-provided process groups).  Here parallelism is first-class: a
-``MeshSpec`` names the four standard axes and maps them onto the physical
+``MeshSpec`` names the six standard axes and maps them onto the physical
 device grid; shardings are expressed as PartitionSpecs over these names and
 XLA inserts the collectives (psum for dp/fsdp grad sync, all-gather for fsdp
 params, all-to-all/ppermute for sp) — the scaling-book recipe.
 
-Axes:
+Axes (outermost → innermost = slowest → fastest links):
+  pipe   — pipeline parallel (GPipe microbatch schedule, parallel/pipeline.py;
+           stage handoffs are point-to-point ppermutes, so this axis tolerates
+           the slowest links — put it across DCN on multi-slice)
   data   — pure data parallel (gradient psum)
   fsdp   — data parallel with parameter/optimizer sharding (ZeRO-3 equiv:
            XLA all-gathers params per layer, reduce-scatters grads)
+  expert — expert parallel for MoE layers (token dispatch = all_to_all)
   tensor — megatron-style tensor parallel (activations psum)
   seq    — sequence/context parallel (ring attention / all-to-all)
 """
@@ -23,7 +27,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-AXIS_NAMES = ("data", "fsdp", "tensor", "seq")
+AXIS_NAMES = ("pipe", "data", "fsdp", "expert", "tensor", "seq")
 
 
 @dataclass(frozen=True)
@@ -32,27 +36,35 @@ class MeshSpec:
     fsdp: int = 1
     tensor: int = 1
     seq: int = 1
+    pipe: int = 1
+    expert: int = 1
 
     @property
     def size(self) -> int:
-        return self.data * self.fsdp * self.tensor * self.seq
+        return (self.pipe * self.data * self.fsdp * self.expert
+                * self.tensor * self.seq)
 
     def axis_sizes(self) -> Dict[str, int]:
-        return {"data": self.data, "fsdp": self.fsdp, "tensor": self.tensor, "seq": self.seq}
+        return {"pipe": self.pipe, "data": self.data, "fsdp": self.fsdp,
+                "expert": self.expert, "tensor": self.tensor, "seq": self.seq}
 
     @staticmethod
-    def auto(n_devices: int, tensor: int = 1, seq: int = 1, fsdp: Optional[int] = None) -> "MeshSpec":
-        """Fill the data axis with whatever tensor/seq/fsdp don't consume."""
-        inner = tensor * seq * (fsdp or 1)
+    def auto(n_devices: int, tensor: int = 1, seq: int = 1,
+             fsdp: Optional[int] = None, pipe: int = 1,
+             expert: int = 1) -> "MeshSpec":
+        """Fill the data axis with whatever the other axes don't consume."""
+        inner = tensor * seq * (fsdp or 1) * pipe * expert
         if n_devices % inner != 0:
-            raise ValueError(f"{n_devices} devices not divisible by tensor*seq*fsdp={inner}")
-        if fsdp is None:
-            return MeshSpec(data=n_devices // inner, tensor=tensor, seq=seq)
-        return MeshSpec(data=n_devices // inner, fsdp=fsdp, tensor=tensor, seq=seq)
+            raise ValueError(
+                f"{n_devices} devices not divisible by "
+                f"pipe*expert*tensor*seq*fsdp={inner}")
+        return MeshSpec(data=n_devices // inner, fsdp=fsdp or 1,
+                        tensor=tensor, seq=seq, pipe=pipe, expert=expert)
 
 
 def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
-    """Build a jax Mesh with the canonical axis order (data, fsdp, tensor, seq).
+    """Build a jax Mesh with the canonical axis order
+    (pipe, data, fsdp, expert, tensor, seq).
 
     Device order matters on real hardware: JAX returns devices in
     topology-aware order, so the innermost axes (tensor, seq) land on
@@ -64,7 +76,8 @@ def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None):
     devices = list(devices if devices is not None else jax.devices())
     if spec.size > len(devices):
         raise ValueError(f"MeshSpec needs {spec.size} devices, have {len(devices)}")
-    grid = np.array(devices[: spec.size]).reshape(spec.data, spec.fsdp, spec.tensor, spec.seq)
+    grid = np.array(devices[: spec.size]).reshape(
+        spec.pipe, spec.data, spec.fsdp, spec.expert, spec.tensor, spec.seq)
     return jax.sharding.Mesh(grid, AXIS_NAMES)
 
 
@@ -100,6 +113,11 @@ DEFAULT_RULES: Dict[str, Optional[Tuple[str, ...]]] = {
     "batch": ("data", "fsdp"),
     "seqlen": ("seq",),
     "norm": None,
+    # Leading stacked-layer axis of a pipelined block stack: sharding it over
+    # `pipe` gives each stage its slice of layers (parallel/pipeline.py).
+    "layers": ("pipe",),
+    # Leading expert axis of MoE expert weights (models/moe.py).
+    "expert": ("expert",),
 }
 
 

@@ -74,7 +74,7 @@ def test_sharded_train_step_dp_tp():
         mesh, jax.random.key(0), optimizer)
     # Params actually sharded: qkv_w split over fsdp (embed) and tensor (heads).
     qkv_sharding = params["blocks"]["qkv_w"].sharding
-    assert qkv_sharding.spec == logical_to_spec((None, "embed", "heads"))
+    assert qkv_sharding.spec == logical_to_spec(("layers", "embed", "heads"))
     step = jit_train_step(gpt2.make_train_step(config, optimizer))
     sh = batch_sharding(mesh)
     rng = np.random.default_rng(0)
