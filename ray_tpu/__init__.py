@@ -143,9 +143,18 @@ def available_resources() -> Dict[str, float]:
     return _ensure_init().scheduler.available_resources()
 
 
-def timeline() -> list:
-    """Chrome-tracing-style task events (ref: _private/state.py:960 ray.timeline)."""
+def timeline(filename: Optional[str] = None) -> list:
+    """Task timeline (ref: _private/state.py:960 ray.timeline).
+
+    With no filename: the raw task-event dicts.  With a filename: writes
+    chrome://tracing JSON (load at chrome://tracing / ui.perfetto.dev) and
+    returns the chrome-trace event list.
+    """
     runtime = _ensure_init()
+    if filename is not None:
+        from ray_tpu._private import profiling
+
+        return profiling.dump_timeline(filename)
     with runtime._events_lock:
         return list(runtime.task_events)
 

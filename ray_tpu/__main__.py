@@ -1,0 +1,127 @@
+"""CLI: `python -m ray_tpu <command>`.
+
+Counterpart of the reference's `ray` CLI surface that applies to the
+single-runtime model (ref: python/ray/scripts/scripts.py `ray status`,
+util/state/state_cli.py `ray list/summary`, _private/state.py timeline).
+Cluster lifecycle commands (`ray up/start`) belong to the autoscaler layer.
+
+Note: each invocation starts a fresh runtime in this process, so the
+list/summary commands are mainly useful inside a driver (via
+`ray_tpu.util.state`) or against a script run with `python -m ray_tpu run`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _init(args):
+    import ray_tpu
+
+    ray_tpu.init(ignore_reinit_error=True)
+    return ray_tpu
+
+
+def cmd_status(args) -> int:
+    rt = _init(args)
+    total = rt.cluster_resources()
+    avail = rt.available_resources()
+    print("======== Cluster status ========")
+    print("Resources")
+    print("---------------------------------------------------------------")
+    print("Usage:")
+    for name in sorted(total):
+        used = total[name] - avail.get(name, 0.0)
+        print(f" {used:g}/{total[name]:g} {name}")
+    return 0
+
+
+def cmd_list(args) -> int:
+    _init(args)
+    from ray_tpu.util import state
+
+    fns = {
+        "tasks": state.list_tasks, "actors": state.list_actors,
+        "objects": state.list_objects, "nodes": state.list_nodes,
+        "placement-groups": state.list_placement_groups,
+    }
+    rows = fns[args.entity](limit=args.limit)
+    print(json.dumps(rows, indent=2, default=str))
+    return 0
+
+
+def cmd_summary(args) -> int:
+    _init(args)
+    from ray_tpu.util import state
+
+    fns = {"tasks": state.summarize_tasks, "actors": state.summarize_actors,
+           "objects": state.summarize_objects}
+    print(json.dumps(fns[args.entity](), indent=2, default=str))
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    import ray_tpu
+
+    ray_tpu.init(ignore_reinit_error=True)
+    ray_tpu.timeline(args.output)
+    print(f"wrote {args.output}")
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    """Start a runtime and print the Prometheus scrape output once."""
+    _init(args)
+    from ray_tpu._private.metrics_agent import sample_runtime
+    from ray_tpu._private.runtime import get_runtime
+    from ray_tpu.util import metrics
+
+    sample_runtime(get_runtime())
+    print(metrics.registry().prometheus_text())
+    return 0
+
+
+def cmd_run(args) -> int:
+    """Run a driver script with ray_tpu importable (ref: `ray job submit`'s
+    local path; full job manager lives in ray_tpu.job)."""
+    import runpy
+
+    sys.argv = [args.script] + args.script_args
+    runpy.run_path(args.script, run_name="__main__")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("status", help="cluster resource usage")
+
+    lp = sub.add_parser("list", help="list entities (state API)")
+    lp.add_argument("entity", choices=["tasks", "actors", "objects", "nodes",
+                                       "placement-groups"])
+    lp.add_argument("--limit", type=int, default=100)
+
+    sp = sub.add_parser("summary", help="summarize entities")
+    sp.add_argument("entity", choices=["tasks", "actors", "objects"])
+
+    tp = sub.add_parser("timeline", help="export chrome-tracing timeline")
+    tp.add_argument("--output", "-o", default="timeline.json")
+
+    sub.add_parser("metrics", help="print Prometheus metrics once")
+
+    rp = sub.add_parser("run", help="run a driver script")
+    rp.add_argument("script")
+    rp.add_argument("script_args", nargs=argparse.REMAINDER)
+
+    args = p.parse_args(argv)
+    return {
+        "status": cmd_status, "list": cmd_list, "summary": cmd_summary,
+        "timeline": cmd_timeline, "metrics": cmd_metrics, "run": cmd_run,
+    }[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""Per-node metrics agent: runtime gauges + /metrics Prometheus endpoint.
+
+Counterpart of the reference's `MetricsAgent` (ref: _private/metrics_agent.py:483
++ _private/prometheus_exporter.py): samples the runtime's internal state into
+gauges (the role of the C++ `stats/metric_defs.cc` core metrics) and serves
+the whole registry — internal + user metrics (util/metrics.py) — over HTTP in
+Prometheus text format.  One agent per runtime, started on demand.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ray_tpu.util import metrics as um
+
+_INTERNAL: Optional[dict] = None
+_LOCK = threading.Lock()
+
+
+def _internal_gauges() -> dict:
+    global _INTERNAL
+    with _LOCK:
+        if _INTERNAL is None:
+            _INTERNAL = {
+                "tasks_finished": um.Counter(
+                    "ray_tpu_tasks_finished_total", "tasks finished OK"),
+                "tasks_failed": um.Counter(
+                    "ray_tpu_tasks_failed_total", "tasks failed"),
+                "object_store_bytes": um.Gauge(
+                    "ray_tpu_object_store_bytes", "bytes in the object store"),
+                "object_store_capacity": um.Gauge(
+                    "ray_tpu_object_store_capacity_bytes", "store capacity"),
+                "objects": um.Gauge(
+                    "ray_tpu_objects", "objects tracked", ("state",)),
+                "actors": um.Gauge(
+                    "ray_tpu_actors", "actors by state", ("state",)),
+                "pending_tasks": um.Gauge(
+                    "ray_tpu_pending_tasks", "tasks waiting for dispatch"),
+                "nodes": um.Gauge("ray_tpu_nodes", "cluster nodes"),
+            }
+        return _INTERNAL
+
+
+def record_task_finished(ok: bool) -> None:
+    g = _internal_gauges()
+    (g["tasks_finished"] if ok else g["tasks_failed"]).inc()
+
+
+def sample_runtime(runtime) -> None:
+    """Refresh the internal gauges from live runtime state."""
+    g = _internal_gauges()
+    used, cap = runtime.store.usage()
+    g["object_store_bytes"].set(used)
+    g["object_store_capacity"].set(cap)
+    by_state: dict = {}
+    for info in runtime.store.object_summaries():
+        by_state[info["state"]] = by_state.get(info["state"], 0) + 1
+    g["objects"].clear()  # states whose count dropped to 0 must not linger
+    for state, n in by_state.items():
+        g["objects"].set(n, {"state": state})
+    actor_states: dict = {}
+    for a in runtime.list_actor_states():
+        actor_states[a["state"]] = actor_states.get(a["state"], 0) + 1
+    g["actors"].clear()
+    for state, n in actor_states.items():
+        g["actors"].set(n, {"state": state})
+    g["pending_tasks"].set(len(runtime._inflight))
+    g["nodes"].set(len(runtime.scheduler.nodes()))
+
+
+class MetricsAgent:
+    """HTTP scrape endpoint (GET /metrics) over the process registry."""
+
+    def __init__(self, runtime, port: int = 0):
+        self._runtime = runtime
+
+        agent = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                try:
+                    sample_runtime(agent._runtime)
+                    body = um.registry().prometheus_text().encode()
+                except Exception as e:  # scrape must never kill the server
+                    self.send_error(500, str(e))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="ray_tpu_metrics_agent",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

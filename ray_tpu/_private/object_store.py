@@ -409,3 +409,15 @@ class ObjectStore:
     def usage(self) -> Tuple[int, int]:
         with self._lock:
             return self._bytes_used, self.capacity_bytes
+
+    def object_summaries(self) -> List[dict]:
+        """Per-object view for the state API / metrics agent
+        (ref: `ray list objects`, util/state/api.py)."""
+        with self._lock:
+            return [
+                {"object_id": str(oid), "state": e.state, "size": e.size,
+                 "pinned": e.pinned, "owner": e.owner,
+                 "in_plasma": e.in_plasma,
+                 "spilled": e.spill_path is not None}
+                for oid, e in self._entries.items()
+            ]

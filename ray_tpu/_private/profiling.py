@@ -1,0 +1,95 @@
+"""Task profiling + chrome-tracing timeline export.
+
+Counterpart of the reference's profile events and `ray.timeline()`
+(ref: _private/profiling.py profile():84 and _private/state.py timeline():960,
+C++ buffer core_worker/profile_event.h): `profile("name")` emits paired
+span events into the runtime's task-event log, and `chrome_trace()` folds
+the whole log into chrome://tracing "X" (complete) events — load the JSON at
+chrome://tracing or ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+
+@contextmanager
+def profile(event_name: str, extra_data: Optional[dict] = None):
+    """User-defined span, attributed to the current task if inside one
+    (ref: _private/profiling.py:84)."""
+    from ray_tpu._private import runtime as _rt
+
+    rt = _rt.get_runtime()
+    ctx = _rt.current_task_context()
+    task_id = ctx.task_id if ctx else _rt_driver_id(rt)
+    start = time.time()
+    rt._emit_event(task_id, event_name, "PROFILE_BEGIN", **(extra_data or {}))
+    try:
+        yield
+    finally:
+        rt._emit_event(task_id, event_name, "PROFILE_END", begin=start)
+
+
+def _rt_driver_id(rt):
+    return rt.job_id
+
+
+def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
+    """Fold the task-event log into chrome-tracing events.
+
+    Execution spans: RUNNING→FINISHED/FAILED pairs per task attempt.
+    Profile spans: PROFILE_BEGIN/PROFILE_END pairs.  Instant events for
+    submits/retries.
+    """
+    if events is None:
+        from ray_tpu._private import runtime as _rt
+
+        rt = _rt.get_runtime()
+        with rt._events_lock:
+            events = list(rt.task_events)
+
+    out: List[dict] = []
+    running: Dict[str, dict] = {}
+    profiling: Dict[tuple, dict] = {}
+    for ev in events:
+        state = ev.get("state", "")
+        tid = ev["task_id"]
+        us = ev["time"] * 1e6
+        if state == "RUNNING":
+            running[tid] = ev
+        elif state in ("FINISHED", "FAILED") and tid in running:
+            beg = running.pop(tid)
+            out.append({
+                "ph": "X", "cat": "task", "name": ev.get("name", tid),
+                "pid": ev.get("node_id", "node"), "tid": tid,
+                "ts": beg["time"] * 1e6, "dur": us - beg["time"] * 1e6,
+                "args": {"task_id": tid, "state": state},
+                "cname": ("thread_state_running" if state == "FINISHED"
+                          else "terrible"),
+            })
+        elif state == "PROFILE_BEGIN":
+            profiling[(tid, ev.get("name"))] = ev
+        elif state == "PROFILE_END":
+            beg = profiling.pop((tid, ev.get("name")), None)
+            beg_ts = (beg["time"] if beg else ev.get("begin", ev["time"])) * 1e6
+            out.append({
+                "ph": "X", "cat": "profile", "name": ev.get("name", ""),
+                "pid": "profile", "tid": tid,
+                "ts": beg_ts, "dur": us - beg_ts,
+            })
+        elif state in ("SUBMITTED_TO_WORKER", "RETRYING", "RESUBMITTED"):
+            out.append({
+                "ph": "i", "cat": "sched", "name": f"{ev.get('name','')}:{state}",
+                "pid": ev.get("node_id", "node"), "tid": tid, "ts": us, "s": "t",
+            })
+    return out
+
+
+def dump_timeline(filename: str, events: Optional[List[dict]] = None) -> List[dict]:
+    trace = chrome_trace(events)
+    with open(filename, "w") as f:
+        json.dump(trace, f)
+    return trace

@@ -234,6 +234,10 @@ class Runtime:
                 {"task_id": str(task_id), "name": name, "state": state,
                  "time": time.time(), **extra}
             )
+        if state in ("FINISHED", "FAILED"):
+            from ray_tpu._private import metrics_agent
+
+            metrics_agent.record_task_finished(state == "FINISHED")
 
     # ------------------------------------------------------------------- puts
     def put(self, value: Any, _owner: str = "driver") -> ObjectRef:
@@ -333,6 +337,16 @@ class Runtime:
 
     # ---------------------------------------------------------------- submits
     def submit_task(self, spec: TaskSpec) -> Any:
+        from ray_tpu.util import tracing
+
+        if tracing.is_tracing_enabled():
+            with tracing.span(f"submit::{spec.name}",
+                              attributes={"task_id": str(spec.task_id)}):
+                tracing.inject_task_spec(spec)
+                return self._submit_task_inner(spec)
+        return self._submit_task_inner(spec)
+
+    def _submit_task_inner(self, spec: TaskSpec) -> Any:
         refs = [
             ObjectRef(ObjectID.for_task_return(spec.task_id, i), owner=self.worker_id)
             for i in range(spec.num_returns)
@@ -459,15 +473,18 @@ class Runtime:
         self._running[spec.task_id] = ctx
         _task_ctx.ctx = ctx
         self._emit_event(spec.task_id, spec.name, "RUNNING")
+        from ray_tpu.util import tracing
+
         try:
-            args, kwargs = self._resolve_args(spec)
-            if spec.isolation == "process":
-                result = self._run_in_process(spec, args, kwargs)
-            elif spec.generator:
-                self._run_generator(spec, args, kwargs)
-                result = None
-            else:
-                result = spec.func(*args, **kwargs)
+            with tracing.task_execute_span(spec):
+                args, kwargs = self._resolve_args(spec)
+                if spec.isolation == "process":
+                    result = self._run_in_process(spec, args, kwargs)
+                elif spec.generator:
+                    self._run_generator(spec, args, kwargs)
+                    result = None
+                else:
+                    result = spec.func(*args, **kwargs)
             if spec.task_id in self._cancelled:
                 raise TaskCancelledError(str(spec.task_id))
             if not spec.generator:
@@ -691,18 +708,21 @@ class Runtime:
         self._running[spec.task_id] = ctx
         _task_ctx.ctx = ctx
         self._emit_event(spec.task_id, spec.name, "RUNNING")
+        from ray_tpu.util import tracing
+
         try:
-            args, kwargs = self._resolve_args(spec)
-            method = getattr(state.instance, spec.method_name)
-            if spec.generator:
-                saved, spec.func = spec.func, method
-                try:
-                    self._run_generator(spec, args, kwargs)
-                finally:
-                    spec.func = saved
-                result = None
-            else:
-                result = method(*args, **kwargs)
+            with tracing.task_execute_span(spec):
+                args, kwargs = self._resolve_args(spec)
+                method = getattr(state.instance, spec.method_name)
+                if spec.generator:
+                    saved, spec.func = spec.func, method
+                    try:
+                        self._run_generator(spec, args, kwargs)
+                    finally:
+                        spec.func = saved
+                    result = None
+                else:
+                    result = method(*args, **kwargs)
             if not spec.generator:
                 self._store_results(spec, result)
             self._emit_event(spec.task_id, spec.name, "FINISHED")
@@ -732,6 +752,17 @@ class Runtime:
             self._fail_task(spec, TaskError(e, task_repr=spec.name), retry=False)
 
     def submit_actor_task(self, actor_id: ActorID, spec: TaskSpec) -> Any:
+        from ray_tpu.util import tracing
+
+        if tracing.is_tracing_enabled():
+            with tracing.span(f"submit::{spec.name}",
+                              attributes={"task_id": str(spec.task_id),
+                                          "actor_id": str(actor_id)}):
+                tracing.inject_task_spec(spec)
+                return self._submit_actor_task_inner(actor_id, spec)
+        return self._submit_actor_task_inner(actor_id, spec)
+
+    def _submit_actor_task_inner(self, actor_id: ActorID, spec: TaskSpec) -> Any:
         state = self._actors.get(actor_id)
         if state is None:
             raise ActorDiedError(f"Unknown actor {actor_id}")

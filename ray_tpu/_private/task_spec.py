@@ -19,7 +19,7 @@ class TaskSpec:
         "task_id", "name", "func", "args", "kwargs", "num_returns",
         "resources", "strategy", "max_retries", "retry_exceptions",
         "actor_id", "method_name", "isolation", "attempt", "submit_time",
-        "generator", "parent_task_id", "runtime_env",
+        "generator", "parent_task_id", "runtime_env", "trace_ctx",
     )
 
     def __init__(
@@ -59,6 +59,9 @@ class TaskSpec:
         self.generator = generator
         self.parent_task_id = parent_task_id
         self.runtime_env = runtime_env
+        #: Submitter's tracing context (util/tracing.py), propagated to the
+        #: execute-side span like the reference's TaskSpec-carried OTel ctx.
+        self.trace_ctx: Optional[dict] = None
 
     @property
     def is_actor_task(self) -> bool:

@@ -70,11 +70,11 @@ def _pipeline_local(stage_fn: Callable[[Any, Any], Any],
 
     (state, out_buf), _ = lax.scan(tick, (state, out_buf), jnp.arange(ticks))
     # Only the last stage holds real outputs; psum over the open chain
-    # replicates them to every stage (zeros elsewhere).  fp32 for the psum:
-    # XLA CPU's AllReducePromotion pass miscompiles bf16 all-reduces inside
-    # partial-manual regions (checkfail "Invalid binary opcode copy").
+    # replicates them to every stage (zeros elsewhere; the sum is exact in
+    # any dtype since exactly one term is nonzero).  On CPU the carry is
+    # already fp32 (see pipeline_apply's carry_fp32 workaround).
     out_buf = jnp.where(stage == n_stages - 1, out_buf, jnp.zeros_like(out_buf))
-    return lax.psum(out_buf.astype(jnp.float32), axis_name).astype(x_mb.dtype)
+    return lax.psum(out_buf, axis_name)
 
 
 def pipeline_apply(stage_fn: Callable[[Any, Any], Any],

@@ -1,0 +1,232 @@
+"""Application metrics API: Counter / Gauge / Histogram.
+
+TPU-native counterpart of the reference's `ray.util.metrics`
+(ref: python/ray/util/metrics.py — Counter:137, Histogram:187, Gauge:262):
+the same three metric types with tag support, backed by a process-local
+registry that the metrics agent (_private/metrics_agent.py) exports in
+Prometheus text exposition format — replacing the reference's
+OpenCensus-proto → agent → Prometheus pipeline with a direct scrape
+endpoint (no sidecar protos needed in a single-runtime process model).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+TagMap = Dict[str, str]
+_key = Tuple[Tuple[str, str], ...]
+
+
+def _tag_key(tags: Optional[TagMap]) -> _key:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    """Base: name, help text, declared tag keys, default tags."""
+
+    _type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name or any(c in name for c in " \n"):
+            raise ValueError(f"invalid metric name: {name!r}")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: TagMap = {}
+        self._lock = threading.Lock()
+        _REGISTRY.register(self)
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def info(self) -> dict:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys, "type": self._type,
+                "default_tags": dict(self._default_tags)}
+
+    def set_default_tags(self, tags: TagMap) -> "Metric":
+        self._check_tags(tags)
+        self._default_tags = dict(tags)
+        return self
+
+    def _check_tags(self, tags: Optional[TagMap]) -> TagMap:
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        for k in merged:
+            if k not in self._tag_keys:
+                raise ValueError(
+                    f"tag {k!r} not in declared tag_keys {self._tag_keys}")
+        return merged
+
+    # Subclasses: samples() -> [(suffix, tags, value)]
+    def samples(self) -> List[Tuple[str, TagMap, float]]:
+        raise NotImplementedError
+
+
+class Counter(Metric):
+    """Monotonically increasing count (ref: util/metrics.py:137)."""
+
+    _type = "counter"
+
+    def __init__(self, name, description="", tag_keys=None):
+        self._values: Dict[_key, float] = {}
+        super().__init__(name, description, tag_keys)
+
+    def inc(self, value: float = 1.0, tags: Optional[TagMap] = None) -> None:
+        if value <= 0:
+            raise ValueError("Counter.inc requires value > 0")
+        merged = self._check_tags(tags)
+        k = _tag_key(merged)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def samples(self):
+        with self._lock:
+            return [("", dict(k), v) for k, v in self._values.items()]
+
+
+class Gauge(Metric):
+    """Point-in-time value (ref: util/metrics.py:262)."""
+
+    _type = "gauge"
+
+    def __init__(self, name, description="", tag_keys=None):
+        self._values: Dict[_key, float] = {}
+        super().__init__(name, description, tag_keys)
+
+    def set(self, value: float, tags: Optional[TagMap] = None) -> None:
+        merged = self._check_tags(tags)
+        with self._lock:
+            self._values[_tag_key(merged)] = float(value)
+
+    def clear(self) -> None:
+        """Drop all tagged series (for samplers that rebuild state counts —
+        without this, a series whose population drops to 0 would report its
+        stale last value forever)."""
+        with self._lock:
+            self._values.clear()
+
+    def samples(self):
+        with self._lock:
+            return [("", dict(k), v) for k, v in self._values.items()]
+
+
+DEFAULT_BOUNDARIES = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
+
+
+class Histogram(Metric):
+    """Bucketed distribution (ref: util/metrics.py:187)."""
+
+    _type = "histogram"
+
+    def __init__(self, name, description="", boundaries=None, tag_keys=None):
+        bounds = tuple(boundaries if boundaries is not None else DEFAULT_BOUNDARIES)
+        if any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])) or not bounds:
+            raise ValueError(f"boundaries must be sorted/non-empty: {bounds}")
+        self.boundaries = bounds
+        self._counts: Dict[_key, List[int]] = {}
+        self._sums: Dict[_key, float] = {}
+        self._totals: Dict[_key, int] = {}
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: Optional[TagMap] = None) -> None:
+        merged = self._check_tags(tags)
+        k = _tag_key(merged)
+        with self._lock:
+            counts = self._counts.setdefault(k, [0] * (len(self.boundaries) + 1))
+            i = 0
+            while i < len(self.boundaries) and value > self.boundaries[i]:
+                i += 1
+            counts[i] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._totals[k] = self._totals.get(k, 0) + 1
+
+    def samples(self):
+        out = []
+        with self._lock:
+            for k, counts in self._counts.items():
+                tags = dict(k)
+                cum = 0
+                for b, c in zip(self.boundaries, counts):
+                    cum += c
+                    out.append(("_bucket", {**tags, "le": repr(float(b))}, cum))
+                out.append(("_bucket", {**tags, "le": "+Inf"}, self._totals[k]))
+                out.append(("_sum", tags, self._sums[k]))
+                out.append(("_count", tags, self._totals[k]))
+        return out
+
+
+class MetricsRegistry:
+    """Process-local registry; the agent scrapes it.
+
+    Same-name metrics from independent call sites are legal (the reference
+    aggregates them through OpenCensus): all instances are kept and their
+    samples merged at scrape time — summed for counters/histograms,
+    last-writer-wins for gauges — so no instance's data is silently lost.
+    """
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, List[Metric]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: Metric) -> None:
+        with self._lock:
+            group = self._metrics.setdefault(metric.name, [])
+            if group and type(group[0]) is not type(metric):
+                raise ValueError(
+                    f"metric {metric.name!r} already registered with type "
+                    f"{group[0]._type}")
+            group.append(metric)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    def collect(self) -> List[List[Metric]]:
+        with self._lock:
+            return [list(g) for g in self._metrics.values()]
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format (what /metrics serves)."""
+        lines: List[str] = []
+        for group in self.collect():
+            lead = group[0]
+            lines.append(f"# HELP {lead.name} {lead._description}")
+            lines.append(f"# TYPE {lead.name} {lead._type}")
+            merged: Dict[Tuple[str, _key], float] = {}
+            for m in group:
+                for suffix, tags, value in m.samples():
+                    k = (suffix, _tag_key(tags))
+                    if lead._type == "gauge":
+                        merged[k] = value
+                    else:
+                        merged[k] = merged.get(k, 0.0) + value
+            for (suffix, tag_items), value in merged.items():
+                if tag_items:
+                    body = ",".join(
+                        f'{k}="{_escape(v)}"' for k, v in tag_items)
+                    lines.append(
+                        f"{lead.name}{suffix}{{{body}}} {_fmt(value)}")
+                else:
+                    lines.append(f"{lead.name}{suffix} {_fmt(value)}")
+        return "\n".join(lines) + "\n"
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _fmt(v: float) -> str:
+    return repr(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY

@@ -1,0 +1,165 @@
+"""State API: list/get/summarize cluster entities.
+
+Counterpart of the reference's `ray.util.state` (ref: python/ray/util/state/
+api.py + dashboard/modules/state/state_head.py:47): `ray list
+tasks/actors/objects/nodes/placement-groups` and `ray summary`, fed by the
+task-event store the runtime keeps (the role of the C++ `GcsTaskManager`,
+gcs_task_manager.h:86).  Single-runtime model: reads go straight to the
+runtime's in-process tables instead of over gRPC to the GCS.
+"""
+
+from __future__ import annotations
+
+from collections import Counter as _Counter
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+Filter = Tuple[str, str, Any]  # (key, "="|"!=", value)
+
+
+def _runtime():
+    from ray_tpu._private import runtime as _rt
+
+    rt = _rt.runtime_or_none()
+    if rt is None:
+        raise RuntimeError("ray_tpu is not initialized; call ray_tpu.init()")
+    return rt
+
+
+def _apply_filters(rows: List[dict], filters: Optional[Sequence[Filter]],
+                   limit: int) -> List[dict]:
+    if filters:
+        for key, op, value in filters:
+            if op not in ("=", "!="):
+                raise ValueError(f"unsupported filter op {op!r} (use = or !=)")
+            rows = [r for r in rows
+                    if (str(r.get(key)) == str(value)) == (op == "=")]
+    return rows[:limit]
+
+
+# ------------------------------------------------------------------- tasks
+def _task_table() -> List[dict]:
+    """Fold the event log into one row per task attempt (latest state wins)."""
+    rt = _runtime()
+    with rt._events_lock:
+        events = list(rt.task_events)
+    rows: Dict[str, dict] = {}
+    for ev in events:
+        if ev.get("state", "").startswith("PROFILE"):
+            continue
+        tid = ev["task_id"]
+        row = rows.setdefault(tid, {
+            "task_id": tid, "name": ev.get("name", ""), "state": "",
+            "start_time": None, "end_time": None, "error_type": "",
+            "node_id": "", "actor_id": "",
+        })
+        row["state"] = ev["state"]
+        for k in ("node_id", "actor_id"):
+            if ev.get(k):
+                row[k] = str(ev[k])
+        if ev.get("error"):
+            row["error_type"] = str(ev["error"])
+        if ev["state"] == "RUNNING":
+            row["start_time"] = ev["time"]
+        if ev["state"] in ("FINISHED", "FAILED"):
+            row["end_time"] = ev["time"]
+    return list(rows.values())
+
+
+def list_tasks(filters: Optional[Sequence[Filter]] = None,
+               limit: int = 10_000) -> List[dict]:
+    return _apply_filters(_task_table(), filters, limit)
+
+
+def get_task(task_id: str) -> Optional[dict]:
+    for row in _task_table():
+        if row["task_id"] == str(task_id):
+            return row
+    return None
+
+
+def summarize_tasks() -> dict:
+    """Counts by (name, state) — `ray summary tasks`."""
+    by_func: Dict[str, _Counter] = {}
+    total = 0
+    for row in _task_table():
+        by_func.setdefault(row["name"], _Counter())[row["state"]] += 1
+        total += 1
+    return {"total": total,
+            "by_func": {k: dict(v) for k, v in sorted(by_func.items())}}
+
+
+# ------------------------------------------------------------------- actors
+def list_actors(filters: Optional[Sequence[Filter]] = None,
+                limit: int = 10_000) -> List[dict]:
+    return _apply_filters(_runtime().list_actor_states(), filters, limit)
+
+
+def get_actor(actor_id: str) -> Optional[dict]:
+    for row in _runtime().list_actor_states():
+        if row["actor_id"] == str(actor_id):
+            return row
+    return None
+
+
+def summarize_actors() -> dict:
+    by_class: Dict[str, _Counter] = {}
+    rows = _runtime().list_actor_states()
+    for row in rows:
+        by_class.setdefault(row["class_name"], _Counter())[row["state"]] += 1
+    return {"total": len(rows),
+            "by_class": {k: dict(v) for k, v in sorted(by_class.items())}}
+
+
+# ------------------------------------------------------------------ objects
+def list_objects(filters: Optional[Sequence[Filter]] = None,
+                 limit: int = 10_000) -> List[dict]:
+    return _apply_filters(_runtime().store.object_summaries(), filters, limit)
+
+
+def summarize_objects() -> dict:
+    rows = _runtime().store.object_summaries()
+    by_state: _Counter = _Counter()
+    total_bytes = 0
+    for row in rows:
+        by_state[row["state"]] += 1
+        total_bytes += row["size"]
+    return {"total": len(rows), "total_bytes": total_bytes,
+            "by_state": dict(by_state)}
+
+
+# -------------------------------------------------------------------- nodes
+def list_nodes(filters: Optional[Sequence[Filter]] = None,
+               limit: int = 10_000) -> List[dict]:
+    rows = []
+    for node in _runtime().scheduler.nodes():
+        snap = node.snapshot()
+        rows.append({
+            "node_id": str(snap["NodeID"]), "alive": snap["Alive"],
+            "resources": snap["Resources"], "available": snap["Available"],
+            "labels": snap["Labels"],
+        })
+    return _apply_filters(rows, filters, limit)
+
+
+# --------------------------------------------------------- placement groups
+def list_placement_groups(filters: Optional[Sequence[Filter]] = None,
+                          limit: int = 10_000) -> List[dict]:
+    rt = _runtime()
+    rows = []
+    with rt.scheduler._lock:
+        pgs = list(rt.scheduler._pgs.values())
+    for pg in pgs:
+        rows.append({
+            "placement_group_id": str(pg.id), "name": pg.name,
+            "state": pg.state, "strategy": pg.strategy,
+            "bundles": [dict(b.resources) for b in pg.bundles],
+        })
+    return _apply_filters(rows, filters, limit)
+
+
+__all__ = [
+    "list_tasks", "get_task", "summarize_tasks",
+    "list_actors", "get_actor", "summarize_actors",
+    "list_objects", "summarize_objects",
+    "list_nodes", "list_placement_groups",
+]

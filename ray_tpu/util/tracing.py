@@ -1,0 +1,121 @@
+"""Distributed tracing: spans around task submit/execute.
+
+Counterpart of the reference's OpenTelemetry integration
+(ref: util/tracing/tracing_helper.py — _OpenTelemetryProxy:34,
+_is_tracing_enabled:92): opt-in via `enable_tracing()`; when on, every task
+submission opens a submit span and every execution opens an execute span
+parented on the submitter's span — the trace context rides inside the
+TaskSpec exactly like the reference propagates it in its TaskSpec proto.
+Spans go to a pluggable exporter (default: in-memory buffer; any callable
+taking a span dict works, e.g. one that forwards to an OTLP client).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, List, Optional
+
+_enabled = False
+_exporter: Optional[Callable[[dict], None]] = None
+_buffer: List[dict] = []
+_buffer_lock = threading.Lock()
+_current_span: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_span", default=None)
+
+
+def is_tracing_enabled() -> bool:
+    """(ref: tracing_helper.py:92)."""
+    return _enabled
+
+
+def enable_tracing(exporter: Optional[Callable[[dict], None]] = None) -> None:
+    global _enabled, _exporter
+    _enabled = True
+    _exporter = exporter
+
+
+def disable_tracing() -> None:
+    global _enabled, _exporter
+    _enabled = False
+    _exporter = None
+
+
+def exported_spans() -> List[dict]:
+    """Spans captured by the default in-memory exporter."""
+    with _buffer_lock:
+        return list(_buffer)
+
+
+def clear_spans() -> None:
+    with _buffer_lock:
+        _buffer.clear()
+
+
+def _export(span: dict) -> None:
+    if _exporter is not None:
+        _exporter(span)
+    else:
+        with _buffer_lock:
+            _buffer.append(span)
+
+
+def current_context() -> Optional[dict]:
+    """{"trace_id", "span_id"} of the active span, for propagation."""
+    span = _current_span.get()
+    if span is None:
+        return None
+    return {"trace_id": span["trace_id"], "span_id": span["span_id"]}
+
+
+@contextmanager
+def span(name: str, parent: Optional[dict] = None,
+         attributes: Optional[Dict[str, Any]] = None):
+    """Open a span; nests under the active span unless `parent` is given."""
+    if not _enabled:
+        yield None
+        return
+    parent = parent if parent is not None else current_context()
+    s = {
+        "name": name,
+        "trace_id": (parent or {}).get("trace_id") or uuid.uuid4().hex,
+        "span_id": uuid.uuid4().hex[:16],
+        "parent_id": (parent or {}).get("span_id"),
+        "start": time.time(),
+        "end": None,
+        "attributes": dict(attributes or {}),
+        "status": "OK",
+    }
+    token = _current_span.set(s)
+    try:
+        yield s
+    except BaseException as e:
+        s["status"] = f"ERROR: {type(e).__name__}"
+        raise
+    finally:
+        s["end"] = time.time()
+        _current_span.reset(token)
+        _export(s)
+
+
+def inject_task_spec(spec) -> None:
+    """Called at submit time: stamp the submitter's context onto the spec."""
+    if _enabled:
+        spec.trace_ctx = current_context()
+
+
+@contextmanager
+def task_execute_span(spec):
+    """Execute-side span parented on the submit-side context in the spec
+    (the reference wraps the worker's task execution the same way)."""
+    if not _enabled:
+        yield None
+        return
+    with span(f"task::{spec.name}",
+              parent=getattr(spec, "trace_ctx", None),
+              attributes={"task_id": str(spec.task_id),
+                          "attempt": spec.attempt}) as s:
+        yield s

@@ -1,0 +1,196 @@
+"""Observability: metrics, metrics agent, state API, timeline, tracing.
+
+Mirrors the reference's test strategy for these subsystems
+(ref: python/ray/tests/test_metrics_agent.py, test_state_api.py,
+util/tracing tests): drive real tasks/actors through the runtime and
+assert on what the observability surfaces report.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as um
+from ray_tpu.util import state as st
+from ray_tpu.util import tracing
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------------ metrics
+def test_counter_gauge_histogram_and_prometheus_text():
+    c = um.Counter("test_requests_total", "requests", ("route",))
+    c.inc(2, {"route": "/a"})
+    c.inc(1, {"route": "/b"})
+    g = um.Gauge("test_temperature", "degrees")
+    g.set(21.5)
+    h = um.Histogram("test_latency", "seconds", boundaries=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    text = um.registry().prometheus_text()
+    assert 'test_requests_total{route="/a"} 2' in text
+    assert "# TYPE test_requests_total counter" in text
+    assert "test_temperature 21.5" in text
+    assert 'test_latency_bucket{le="0.1"} 1' in text
+    assert 'test_latency_bucket{le="1.0"} 2' in text
+    assert 'test_latency_bucket{le="+Inf"} 3' in text
+    assert "test_latency_count 3" in text
+
+
+def test_metric_tag_validation():
+    c = um.Counter("test_tagged", "x", ("k",))
+    with pytest.raises(ValueError):
+        c.inc(1, {"unknown": "v"})
+    with pytest.raises(ValueError):
+        c.inc(0)
+    c.set_default_tags({"k": "default"})
+    c.inc(1)
+    assert any(t.get("k") == "default" for _, t, _ in c.samples())
+
+
+def test_metrics_agent_http_scrape(ray_init):
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    assert ray_tpu.get(work.remote(1)) == 2
+
+    from ray_tpu._private.metrics_agent import MetricsAgent
+    from ray_tpu._private.runtime import get_runtime
+
+    agent = MetricsAgent(get_runtime())
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{agent.port}/metrics", timeout=5).read().decode()
+        assert "ray_tpu_tasks_finished_total" in body
+        assert "ray_tpu_object_store_bytes" in body
+        assert "ray_tpu_nodes 1" in body
+    finally:
+        agent.stop()
+
+
+# ---------------------------------------------------------------- state API
+def test_state_api_tasks_actors_objects_nodes(ray_init):
+    @ray_tpu.remote
+    def ok():
+        return 1
+
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("nope")
+
+    ray_tpu.get(ok.remote())
+    with pytest.raises(Exception):
+        ray_tpu.get(boom.remote())
+
+    tasks = st.list_tasks()
+    by_name = {t["name"]: t for t in tasks}
+    assert by_name["ok"]["state"] == "FINISHED"
+    assert by_name["boom"]["state"] == "FAILED"
+    assert "ValueError" in by_name["boom"]["error_type"]
+
+    # filters
+    failed = st.list_tasks(filters=[("state", "=", "FAILED")])
+    assert {t["name"] for t in failed} == {"boom"}
+    summ = st.summarize_tasks()
+    assert summ["by_func"]["ok"]["FINISHED"] == 1
+
+    @ray_tpu.remote
+    class Holder:
+        def ping(self):
+            return "pong"
+
+    h = Holder.remote()
+    assert ray_tpu.get(h.ping.remote()) == "pong"
+    actors = st.list_actors()
+    assert any(a["class_name"] == "Holder" and a["state"] == "ALIVE"
+               for a in actors)
+    assert st.summarize_actors()["by_class"]["Holder"]["ALIVE"] == 1
+
+    ref = ray_tpu.put(b"x" * 1024)
+    objs = st.list_objects()
+    assert any(o["object_id"] == str(ref.id) for o in objs)
+    assert st.summarize_objects()["total"] >= 1
+
+    nodes = st.list_nodes()
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    assert nodes[0]["resources"]["CPU"] == 4.0
+
+
+def test_state_api_placement_groups(ray_init):
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    ray_tpu.get(pg.ready())
+    rows = st.list_placement_groups()
+    assert any(r["placement_group_id"] == str(pg.id)
+               and r["state"] == "CREATED" for r in rows)
+
+
+# ----------------------------------------------------------------- timeline
+def test_timeline_chrome_export(ray_init, tmp_path):
+    from ray_tpu._private.profiling import profile
+
+    @ray_tpu.remote
+    def traced():
+        with profile("inner_work", {"step": 1}):
+            time.sleep(0.01)
+        return 1
+
+    ray_tpu.get(traced.remote())
+    out = tmp_path / "timeline.json"
+    events = ray_tpu.timeline(str(out))
+    data = json.loads(out.read_text())
+    assert data == events
+    cats = {e["cat"] for e in data}
+    assert "task" in cats and "profile" in cats
+    span = next(e for e in data if e["cat"] == "profile")
+    assert span["name"] == "inner_work" and span["dur"] >= 10_000 * 0.5
+
+
+# ------------------------------------------------------------------ tracing
+def test_tracing_spans_parented_across_submit(ray_init):
+    tracing.clear_spans()
+    tracing.enable_tracing()
+    try:
+        @ray_tpu.remote
+        def child():
+            return 1
+
+        with tracing.span("driver_root"):
+            ref = child.remote()
+        assert ray_tpu.get(ref) == 1
+        # give the async execute span a beat to export
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            names = {s["name"] for s in tracing.exported_spans()}
+            if {"driver_root", "submit::child", "task::child"} <= names:
+                break
+            time.sleep(0.01)
+        spans = {s["name"]: s for s in tracing.exported_spans()}
+        assert {"driver_root", "submit::child", "task::child"} <= set(spans)
+        root = spans["driver_root"]
+        submit = spans["submit::child"]
+        execute = spans["task::child"]
+        assert submit["parent_id"] == root["span_id"]
+        assert execute["parent_id"] == submit["span_id"]
+        assert execute["trace_id"] == root["trace_id"]
+    finally:
+        tracing.disable_tracing()
+
+
+def test_tracing_disabled_is_noop(ray_init):
+    tracing.clear_spans()
+    with tracing.span("nothing") as s:
+        assert s is None
+    assert tracing.exported_spans() == []
