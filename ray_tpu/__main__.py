@@ -83,6 +83,38 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_job(args) -> int:
+    """`ray job submit/status/logs/list/stop` equivalents (ref:
+    dashboard/modules/job/cli.py).  Jobs live for the manager's process
+    lifetime, so `submit --wait` is the useful CLI mode; long-lived managers
+    belong in a driver via ray_tpu.job.job_manager()."""
+    from ray_tpu.job import job_manager
+
+    jm = job_manager()
+    if args.job_cmd == "submit":
+        import shlex
+
+        parts = list(args.entrypoint)
+        if parts and parts[0] == "--":  # REMAINDER keeps the separator
+            parts = parts[1:]
+        job_id = jm.submit_job(shlex.join(parts),
+                               submission_id=args.submission_id)
+        print(f"submitted {job_id}")
+        if args.wait:
+            for chunk in jm.tail_job_logs(job_id):
+                sys.stdout.write(chunk)
+            status = jm.get_job_status(job_id)
+            print(f"job {job_id}: {status}")
+            return 0 if status == "SUCCEEDED" else 1
+        return 0
+    if args.job_cmd == "list":
+        print(json.dumps([j.to_dict() for j in jm.list_jobs()], indent=2))
+        return 0
+    print("status/logs/stop need a long-lived manager; use the Python API",
+          file=sys.stderr)
+    return 1
+
+
 def cmd_run(args) -> int:
     """Run a driver script with ray_tpu importable (ref: `ray job submit`'s
     local path; full job manager lives in ray_tpu.job)."""
@@ -112,6 +144,15 @@ def main(argv=None) -> int:
 
     sub.add_parser("metrics", help="print Prometheus metrics once")
 
+    jp = sub.add_parser("job", help="job submission")
+    jsub = jp.add_subparsers(dest="job_cmd", required=True)
+    jsp = jsub.add_parser("submit")
+    jsp.add_argument("--submission-id", default=None)
+    jsp.add_argument("--wait", action="store_true",
+                     help="stream logs and wait for completion")
+    jsp.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    jsub.add_parser("list")
+
     rp = sub.add_parser("run", help="run a driver script")
     rp.add_argument("script")
     rp.add_argument("script_args", nargs=argparse.REMAINDER)
@@ -119,7 +160,8 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     return {
         "status": cmd_status, "list": cmd_list, "summary": cmd_summary,
-        "timeline": cmd_timeline, "metrics": cmd_metrics, "run": cmd_run,
+        "timeline": cmd_timeline, "metrics": cmd_metrics, "job": cmd_job,
+        "run": cmd_run,
     }[args.cmd](args)
 
 

@@ -81,6 +81,11 @@ class Config:
     log_dir: str = ""
     log_to_driver: bool = True
 
+    # --- session ---
+    #: Session-scoped scratch dir (runtime-env cache, job logs; the role of
+    #: the reference's /tmp/ray/session_* tree).
+    session_dir: str = "/tmp/ray_tpu_session"
+
     def apply_overrides(self, system_config: Optional[Dict[str, Any]] = None) -> None:
         for f in fields(self):
             env = os.environ.get(_ENV_PREFIX + f.name.upper())

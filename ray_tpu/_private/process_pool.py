@@ -95,7 +95,19 @@ def _worker_main(conn, arena_path: Optional[str]) -> None:
             return
         req = serialization.loads(msg)
         kind = req[0]
-        if kind == "exec":
+        if kind == "setup_env":
+            # Applied once per worker; the pool keys leases by env hash so a
+            # worker only ever hosts one runtime env (ref: worker_pool.h
+            # runtime-env-keyed caching).
+            try:
+                from ray_tpu._private.runtime_env import apply_in_worker
+
+                apply_in_worker(req[1])
+                conn.send_bytes(serialization.dumps(("ok", 0, None)))
+            except BaseException as e:  # noqa: BLE001
+                conn.send_bytes(serialization.dumps(
+                    ("err", 0, serialization.dumps((e, repr(e))))))
+        elif kind == "exec":
             _, seq, fn_id, fn_bytes, args_spec = req
             try:
                 if fn_id not in fn_cache:
@@ -132,8 +144,11 @@ def _next_handoff_key(prefix: str) -> str:
 
 
 class _ProcWorker:
-    def __init__(self, arena_path: Optional[str] = None, arena=None) -> None:
+    def __init__(self, arena_path: Optional[str] = None, arena=None,
+                 env_key: str = "", env_payload: Optional[dict] = None) -> None:
         import sys
+
+        self.env_key = env_key
 
         ctx = mp.get_context("spawn")
         self.conn, child_conn = ctx.Pipe()
@@ -157,6 +172,16 @@ class _ProcWorker:
         self.seq = 0
         self.sent_fns: set = set()
         self.last_used = time.monotonic()
+        if env_payload is not None:
+            from ray_tpu.exceptions import TaskError
+
+            self.conn.send_bytes(
+                serialization.dumps(("setup_env", env_payload)))
+            kind, _, payload = serialization.loads(self.conn.recv_bytes())
+            if kind == "err":
+                exc, tb = serialization.loads(payload)
+                self.kill()
+                raise TaskError(exc, tb=tb)
 
     def execute(self, fn_id: str, fn_bytes: bytes, args: tuple, kwargs: dict) -> Any:
         """Run one task; raises WorkerCrashedError if the process dies."""
@@ -206,7 +231,9 @@ class ProcessPool:
     """Idle-pool of reusable spawned workers with an upper bound."""
 
     def __init__(self, arena_path: Optional[str] = None, arena=None) -> None:
-        self._idle: List[_ProcWorker] = []
+        #: Idle workers keyed by runtime-env hash ("" = no env) — the
+        #: reference's runtime-env-keyed WorkerPool cache (worker_pool.h:216).
+        self._idle: Dict[str, List[_ProcWorker]] = {}
         self._lock = threading.Lock()
         self._count = 0
         self.arena_path = arena_path
@@ -215,15 +242,23 @@ class ProcessPool:
         # own client, passed in by the runtime.
         self._arena = arena if arena is not None else _attach_arena(arena_path)
 
-    def lease(self) -> _ProcWorker:
+    def lease(self, env_key: str = "",
+              env_payload: Optional[dict] = None) -> _ProcWorker:
         with self._lock:
-            while self._idle:
-                w = self._idle.pop()
+            pool = self._idle.get(env_key, [])
+            while pool:
+                w = pool.pop()
                 if w.alive():
                     return w
                 self._count -= 1
             self._count += 1
-        return _ProcWorker(self.arena_path, self._arena)
+        try:
+            return _ProcWorker(self.arena_path, self._arena,
+                               env_key=env_key, env_payload=env_payload)
+        except BaseException:
+            with self._lock:
+                self._count -= 1
+            raise
 
     def release(self, worker: _ProcWorker) -> None:
         if not worker.alive():
@@ -232,7 +267,7 @@ class ProcessPool:
             return
         with self._lock:
             if self._count <= GLOBAL_CONFIG.max_process_workers:
-                self._idle.append(worker)
+                self._idle.setdefault(worker.env_key, []).append(worker)
                 return
             self._count -= 1
         worker.kill()
@@ -244,7 +279,8 @@ class ProcessPool:
 
     def shutdown(self) -> None:
         with self._lock:
-            workers, self._idle, self._count = self._idle, [], 0
+            pools, self._idle, self._count = self._idle, {}, 0
+        workers = [w for pool in pools.values() for w in pool]
         for w in workers:
             try:
                 w.conn.send_bytes(serialization.dumps(("shutdown",)))

@@ -430,16 +430,20 @@ class Runtime:
 
     def _try_dispatch(self, spec: TaskSpec) -> bool:
         if spec.task_id in self._cancelled:
+            self.scheduler.clear_task_demand(spec.task_id)
             self._fail_task(spec, TaskCancelledError(str(spec.task_id)), retry=False)
             return True
         lease = self.scheduler.try_acquire(spec.resources, spec.strategy)
         if lease is None:
-            # Infeasible requests fail fast instead of hanging forever.
+            # Infeasible requests fail fast instead of hanging forever —
+            # unless an autoscaler is running, which may add capacity.
             from ray_tpu._private.scheduling import DefaultStrategy
 
             strategy = spec.strategy or DefaultStrategy()
             with self.scheduler._lock:
                 feasible = self.scheduler._feasible_anywhere_locked(spec.resources, strategy)
+            # (feasibility counts launchable autoscaler node types, so this
+            # is a genuine never-fits even with autoscaling on.)
             if not feasible and not isinstance(strategy, PlacementGroupSchedulingStrategy):
                 from ray_tpu._private.scheduling import InfeasibleError
 
@@ -452,7 +456,10 @@ class Runtime:
                     retry=False,
                 )
                 return True
+            # Blocked: visible to the autoscaler as unmet demand.
+            self.scheduler.report_task_demand(spec.task_id, spec.resources)
             return False
+        self.scheduler.clear_task_demand(spec.task_id)
         node_id, release = lease
         self._emit_event(spec.task_id, spec.name, "SUBMITTED_TO_WORKER", node_id=str(node_id))
         self._exec_pool.submit(self._execute_task, spec, node_id, release)
@@ -478,7 +485,10 @@ class Runtime:
         try:
             with tracing.task_execute_span(spec):
                 args, kwargs = self._resolve_args(spec)
-                if spec.isolation == "process":
+                if spec.isolation == "process" or spec.runtime_env:
+                    # A runtime env implies the process tier: envs are
+                    # per-worker-process state (ref: worker_pool.h env-keyed
+                    # workers); thread-tier tasks share the driver process.
                     result = self._run_in_process(spec, args, kwargs)
                 elif spec.generator:
                     self._run_generator(spec, args, kwargs)
@@ -509,7 +519,14 @@ class Runtime:
         fn = spec.func
         fn_id = getattr(fn, "__qualname__", "fn") + ":" + str(id(fn))
         fn_bytes = serialization.dumps(fn)
-        worker = self.process_pool.lease()
+        env_key, env_payload = "", None
+        if spec.runtime_env:
+            from ray_tpu._private.runtime_env import RuntimeEnv, payload_key
+
+            env = RuntimeEnv.normalize(spec.runtime_env)
+            env_payload = env.stage()
+            env_key = payload_key(env_payload)
+        worker = self.process_pool.lease(env_key, env_payload)
         try:
             result = worker.execute(fn_id, fn_bytes, args, kwargs)
         except (TaskError, WorkerCrashedError):

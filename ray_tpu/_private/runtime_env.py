@@ -1,0 +1,156 @@
+"""Runtime environments: per-task/actor execution environments.
+
+Counterpart of the reference's runtime-env subsystem (ref:
+_private/runtime_env/ — plugin.py, working_dir.py, py_modules.py, pip.py;
+raylet AgentManager asks a Python agent to materialize envs, worker_pool.h
+caches workers keyed by the env).  Single-host model: envs are materialized
+into a session-local cache directory (the URI-cache role, uri_cache.py) and
+applied inside *process-tier* workers — a task carrying a runtime_env is
+automatically routed to the process pool, whose leases are keyed by the env
+hash exactly like the reference's runtime-env-keyed worker caching.
+
+Supported fields (this image is offline — installer plugins are gated):
+  env_vars:    {str: str} exported in the worker
+  working_dir: local directory staged into the cache and chdir'd into
+  py_modules:  list of local module/package paths prepended to sys.path
+  pip/conda/uv: rejected with a clear error (no network in this image)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+_CACHE_LOCK = threading.Lock()
+
+
+def _cache_root() -> str:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    root = os.path.join(GLOBAL_CONFIG.session_dir, "runtime_envs")
+    os.makedirs(root, exist_ok=True)
+    return root
+
+
+class RuntimeEnv(dict):
+    """Validated runtime-env dict (ref: ray.runtime_env.RuntimeEnv)."""
+
+    _ALLOWED = {"env_vars", "working_dir", "py_modules", "pip", "conda",
+                "uv", "config"}
+    _GATED = ("pip", "conda", "uv")
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        for k, v in kwargs.items():
+            if v is not None:
+                self[k] = v
+        self.validate()
+
+    @classmethod
+    def normalize(cls, obj) -> Optional["RuntimeEnv"]:
+        if obj is None:
+            return None
+        if isinstance(obj, RuntimeEnv):
+            obj.validate()
+            return obj
+        if isinstance(obj, dict):
+            return cls(**obj)
+        raise TypeError(f"runtime_env must be a dict, got {type(obj)}")
+
+    def validate(self) -> None:
+        unknown = set(self) - self._ALLOWED
+        if unknown:
+            raise ValueError(f"unknown runtime_env fields: {sorted(unknown)}")
+        for gated in self._GATED:
+            if self.get(gated):
+                raise RuntimeError(
+                    f"runtime_env[{gated!r}] needs package installation, "
+                    "which is unavailable in this offline image; pre-bake "
+                    "dependencies or use py_modules/working_dir")
+        ev = self.get("env_vars", {})
+        if not isinstance(ev, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in ev.items()):
+            raise ValueError("env_vars must be Dict[str, str]")
+        wd = self.get("working_dir")
+        if wd is not None and not os.path.isdir(wd):
+            raise ValueError(f"working_dir {wd!r} is not a directory")
+        for p in self.get("py_modules", ()):
+            if not os.path.exists(p):
+                raise ValueError(f"py_modules path {p!r} does not exist")
+
+    def env_key(self) -> str:
+        """Stable hash of the declared env (worker-pool lease key; prefer
+        payload_key(stage()) which also captures working_dir content)."""
+        return hashlib.sha1(
+            json.dumps(self, sort_keys=True).encode()).hexdigest()[:16]
+
+    # ------------------------------------------------------------- staging
+    def stage(self) -> dict:
+        """Materialize (driver side): copy working_dir into the session cache
+        once per content key; return the payload shipped to workers."""
+        payload: Dict[str, Any] = {"env_vars": dict(self.get("env_vars", {}))}
+        wd = self.get("working_dir")
+        if wd:
+            payload["working_dir"] = _stage_dir(os.path.abspath(wd))
+        mods = [os.path.abspath(p) for p in self.get("py_modules", ())]
+        if mods:
+            payload["py_modules"] = mods
+        return payload
+
+
+def _dir_fingerprint(src: str) -> str:
+    """Content fingerprint: every file's relpath+mtime+size.  (Directory
+    mtime alone misses in-place edits to contained files.)"""
+    h = hashlib.sha1(src.encode())
+    for root, dirs, files in os.walk(src):
+        dirs[:] = sorted(d for d in dirs if d not in (".git", "__pycache__"))
+        for name in sorted(files):
+            p = os.path.join(root, name)
+            try:
+                stat = os.stat(p)
+            except OSError:
+                continue
+            h.update(f"{os.path.relpath(p, src)}:{stat.st_mtime_ns}:"
+                     f"{stat.st_size};".encode())
+    return h.hexdigest()[:16]
+
+
+def _stage_dir(src: str) -> str:
+    """Copy `src` into the cache keyed by content (URI cache equivalent —
+    repeated leases reuse the staged copy; edits re-stage)."""
+    stamp = _dir_fingerprint(src)
+    dst = os.path.join(_cache_root(), stamp)
+    with _CACHE_LOCK:
+        if not os.path.isdir(dst):
+            tmp = dst + ".tmp"
+            shutil.copytree(src, tmp,
+                            ignore=shutil.ignore_patterns(".git", "__pycache__"))
+            os.replace(tmp, dst)
+    return dst
+
+
+def payload_key(payload: dict) -> str:
+    """Lease key from the *staged* payload: the working_dir path in it is
+    content-stamped, so editing files yields a fresh key (and worker)."""
+    return hashlib.sha1(
+        json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def apply_in_worker(payload: dict) -> None:
+    """Apply a staged env inside a (process-tier) worker."""
+    for k, v in payload.get("env_vars", {}).items():
+        os.environ[k] = v
+    for p in reversed(payload.get("py_modules", [])):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    wd = payload.get("working_dir")
+    if wd:
+        if wd not in sys.path:
+            sys.path.insert(0, wd)
+        os.chdir(wd)

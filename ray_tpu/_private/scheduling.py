@@ -61,6 +61,8 @@ class Node:
         self.labels = labels or {}
         self.alive = True
         self.start_time = time.time()
+        #: Last time a lease touched this node (autoscaler idle detection).
+        self.last_busy = time.time()
 
     def utilization(self) -> float:
         fracs = [
@@ -155,6 +157,14 @@ class ClusterScheduler:
         self._queue: deque = deque()
         self._rr_counter = 0
         self._pg_queue: deque = deque()
+        #: Requests currently blocked in acquire() (autoscaler demand signal).
+        self._pending_demand: Dict[object, Resources] = {}
+        #: Set by the autoscaler: resource shapes of launchable node types.
+        #: Feasibility then means "fits an existing node OR a launchable
+        #: type" — requests no type can satisfy still fail fast instead of
+        #: hanging on a scale-up that can never come.
+        self.autoscaling_enabled = False
+        self.autoscaler_node_shapes: List[Resources] = []
 
     # ------------------------------------------------------------- node admin
     def add_node(self, resources: Resources, labels: Optional[Dict[str, str]] = None,
@@ -200,21 +210,30 @@ class ClusterScheduler:
         """Block until resources are granted; returns (node_id, release_fn)."""
         strategy = strategy or DefaultStrategy()
         deadline = None if timeout is None else time.monotonic() + timeout
+        demand_key = object()
         with self._lock:
-            while True:
-                node_id = self._try_place_locked(request, strategy)
-                if node_id is not None:
-                    return node_id, self._make_release(node_id, request, strategy)
-                remaining = None if deadline is None else deadline - time.monotonic()
-                if remaining is not None and remaining <= 0:
-                    raise TimeoutError(
-                        f"Could not acquire {request} within timeout; "
-                        f"available={self.available_resources()}")
-                if not self._feasible_anywhere_locked(request, strategy):
-                    raise InfeasibleError(
-                        f"Resource request {request} is infeasible on this cluster "
-                        f"(total={self.cluster_resources()})")
-                self._lock.wait(remaining if remaining is not None else 1.0)
+            try:
+                while True:
+                    node_id = self._try_place_locked(request, strategy)
+                    if node_id is not None:
+                        self._touch_locked(node_id)
+                        return node_id, self._make_release(node_id, request, strategy)
+                    # Visible to the autoscaler as unmet demand.
+                    self._pending_demand[demand_key] = dict(request)
+                    remaining = None if deadline is None else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        raise TimeoutError(
+                            f"Could not acquire {request} within timeout; "
+                            f"available={self.available_resources()}")
+                    if not self._feasible_anywhere_locked(request, strategy):
+                        # (feasibility already counts launchable autoscaler
+                        # node types — this is a genuine never-fits.)
+                        raise InfeasibleError(
+                            f"Resource request {request} is infeasible on this cluster "
+                            f"(total={self.cluster_resources()})")
+                    self._lock.wait(remaining if remaining is not None else 1.0)
+            finally:
+                self._pending_demand.pop(demand_key, None)
 
     def try_acquire(self, request: Resources, strategy: Optional[SchedulingStrategy] = None):
         strategy = strategy or DefaultStrategy()
@@ -222,7 +241,13 @@ class ClusterScheduler:
             node_id = self._try_place_locked(request, strategy)
             if node_id is None:
                 return None
+            self._touch_locked(node_id)
             return node_id, self._make_release(node_id, request, strategy)
+
+    def _touch_locked(self, node_id: NodeID) -> None:
+        node = self._nodes.get(node_id)
+        if node is not None:
+            node.last_busy = time.time()
 
     def _make_release(self, node_id: NodeID, request: Resources,
                       strategy: SchedulingStrategy) -> Callable[[], None]:
@@ -243,9 +268,33 @@ class ClusterScheduler:
                     node = self._nodes.get(node_id)
                     if node is not None:
                         res_add(node.available, request)
+                        node.last_busy = time.time()
                 self._lock.notify_all()
 
         return release
+
+    # ------------------------------------------------------- autoscaler view
+    def report_task_demand(self, key, request: Resources) -> None:
+        """Register a resource shape that couldn't be placed (the runtime's
+        dispatcher calls this for blocked tasks; blocking acquire() callers
+        register themselves)."""
+        with self._lock:
+            self._pending_demand[key] = dict(request)
+
+    def clear_task_demand(self, key) -> None:
+        with self._lock:
+            self._pending_demand.pop(key, None)
+
+    def pending_demand(self) -> List[Resources]:
+        """Resource shapes currently blocked waiting for capacity."""
+        with self._lock:
+            return [dict(r) for r in self._pending_demand.values()]
+
+    def pending_pg_demand(self) -> List[List[Resources]]:
+        """Bundle lists of placement groups waiting for resources."""
+        with self._lock:
+            return [[dict(b.resources) for b in pg.bundles]
+                    for pg in self._pg_queue]
 
     def _feasible_anywhere_locked(self, request: Resources, strategy: SchedulingStrategy) -> bool:
         if isinstance(strategy, PlacementGroupSchedulingStrategy):
@@ -254,7 +303,11 @@ class ClusterScheduler:
                 return False
             bundles = pg.bundles if strategy.bundle_index < 0 else [pg.bundles[strategy.bundle_index]]
             return any(res_fits(b.resources, request) for b in bundles)
-        return any(res_fits(n.total, request) for n in self._nodes.values() if n.alive)
+        if any(res_fits(n.total, request) for n in self._nodes.values() if n.alive):
+            return True
+        # A node the autoscaler could launch also counts as feasible.
+        return self.autoscaling_enabled and any(
+            res_fits(shape, request) for shape in self.autoscaler_node_shapes)
 
     # ---------------------------------------------------------------- policies
     def _try_place_locked(self, request: Resources, strategy: SchedulingStrategy) -> Optional[NodeID]:

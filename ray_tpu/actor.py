@@ -126,6 +126,13 @@ class ActorClass:
     def _remote(self, args, kwargs, **options) -> ActorHandle:
         runtime = get_runtime()
         opts = resolve_task_options(options, is_actor=True)
+        if opts.get("runtime_env"):
+            # Actors execute on in-driver threads this round; a runtime env
+            # needs a dedicated worker process. Loud beats silently dropping.
+            raise NotImplementedError(
+                "runtime_env on actors is not supported yet (actors run "
+                "in-process); use it on tasks, or isolate the actor's work "
+                "in tasks with options(runtime_env=...)")
         actor_id = ActorID.from_random()
         spec = ActorSpec(
             actor_id=actor_id,

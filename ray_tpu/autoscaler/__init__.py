@@ -1,0 +1,16 @@
+from ray_tpu.autoscaler.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    Monitor,
+    NodeTypeConfig,
+)
+from ray_tpu.autoscaler.node_provider import (
+    FakeNodeProvider,
+    NodeProvider,
+    TPUPodProvider,
+)
+
+__all__ = [
+    "Autoscaler", "AutoscalerConfig", "Monitor", "NodeTypeConfig",
+    "NodeProvider", "FakeNodeProvider", "TPUPodProvider",
+]

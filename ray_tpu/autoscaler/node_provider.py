@@ -1,0 +1,107 @@
+"""Node providers: the pluggable cloud interface of the autoscaler.
+
+Counterpart of the reference's `NodeProvider` plugin family (ref:
+python/ray/autoscaler/node_provider.py + _private/fake_multi_node/
+node_provider.py): the reconciler talks to this interface only, so cloud
+specifics (GCE TPU pods, fake in-process nodes for tests) stay behind it.
+
+TPU twist: `TPUPodProvider` allocates whole ICI slices — a "node" is a TPU
+host with its chips, labeled with its slice so the scheduler's slice-affinity
+packing (scheduling.py ici-slice label) keeps collective-heavy work on one
+ICI domain, the analogue of the reference's `TPU-<ver>-<chips>-head` resource
+(_private/accelerators/tpu.py:356).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Minimal surface the reconciler needs (ref: node_provider.py)."""
+
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+
+class FakeNodeProvider(NodeProvider):
+    """Adds/removes virtual scheduler nodes in the running runtime — the
+    in-process analogue of the reference's fake multi-node provider, which is
+    how autoscaler logic is tested without a cloud."""
+
+    def __init__(self, launch_delay_s: float = 0.0):
+        self._nodes: Dict[str, object] = {}  # provider id -> scheduler NodeID
+        self._lock = threading.Lock()
+        self.launch_delay_s = launch_delay_s
+
+    def create_node(self, node_type, resources, labels) -> str:
+        from ray_tpu._private.runtime import get_runtime
+
+        if self.launch_delay_s:
+            time.sleep(self.launch_delay_s)
+        node_id = get_runtime().scheduler.add_node(
+            dict(resources), {**labels, "node-type": node_type})
+        pid = f"fake-{uuid.uuid4().hex[:8]}"
+        with self._lock:
+            self._nodes[pid] = node_id
+        return pid
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        from ray_tpu._private.runtime import get_runtime
+
+        with self._lock:
+            node_id = self._nodes.pop(provider_node_id, None)
+        if node_id is not None:
+            get_runtime().scheduler.remove_node(node_id)
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def scheduler_node_id(self, provider_node_id: str):
+        with self._lock:
+            return self._nodes.get(provider_node_id)
+
+
+class TPUPodProvider(FakeNodeProvider):
+    """Slice-aware provider: every `hosts_per_slice` nodes created for a TPU
+    node type share an ici-slice label, so STRICT_PACK placement groups land
+    whole slices (the reference models this with TPU-pod head resources)."""
+
+    def __init__(self, accelerator: str = "v5e", chips_per_host: int = 4,
+                 hosts_per_slice: int = 4, launch_delay_s: float = 0.0):
+        super().__init__(launch_delay_s)
+        self.accelerator = accelerator
+        self.chips_per_host = chips_per_host
+        self.hosts_per_slice = hosts_per_slice
+        self._slice_counter = 0
+        self._in_slice = 0
+
+    def create_node(self, node_type, resources, labels) -> str:
+        with self._lock:
+            if self._in_slice >= self.hosts_per_slice:
+                self._slice_counter += 1
+                self._in_slice = 0
+            slice_name = f"{self.accelerator}-slice-{self._slice_counter}"
+            first_in_slice = self._in_slice == 0
+            self._in_slice += 1
+        res = {**resources, "TPU": float(self.chips_per_host)}
+        if first_in_slice:
+            # Pod-head resource: one per slice, the scheduling anchor for
+            # "give me the whole slice" (ref: tpu.py:356-358).
+            size = self.chips_per_host * self.hosts_per_slice
+            res[f"TPU-{self.accelerator}-{size}-head"] = 1.0
+        return super().create_node(
+            node_type, res,
+            {**labels, "ici-slice": slice_name,
+             "accelerator-type": f"tpu-{self.accelerator}"})

@@ -1,0 +1,3 @@
+from ray_tpu.job.job_manager import JobInfo, JobManager, JobStatus, job_manager
+
+__all__ = ["JobManager", "JobInfo", "JobStatus", "job_manager"]

@@ -19,9 +19,14 @@ import uuid
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, List, Optional
 
+from collections import deque
+
 _enabled = False
 _exporter: Optional[Callable[[dict], None]] = None
-_buffer: List[dict] = []
+#: Default exporter: bounded ring buffer (2 spans/task would otherwise grow
+#: without limit in a long-running driver).
+_BUFFER_MAX = 100_000
+_buffer: "deque" = deque(maxlen=_BUFFER_MAX)
 _buffer_lock = threading.Lock()
 _current_span: contextvars.ContextVar = contextvars.ContextVar(
     "ray_tpu_span", default=None)
