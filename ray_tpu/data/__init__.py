@@ -70,9 +70,11 @@ def _expand_paths(paths, suffix: str) -> List[str]:
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            out.extend(sorted(_glob.glob(os.path.join(p, f"*{suffix}"))))
+            out.extend(sorted(
+                f for f in _glob.glob(os.path.join(p, f"*{suffix}"))
+                if os.path.isfile(f)))
         elif "*" in p:
-            out.extend(sorted(_glob.glob(p)))
+            out.extend(sorted(f for f in _glob.glob(p) if os.path.isfile(f)))
         else:
             if not os.path.exists(p):
                 raise FileNotFoundError(f"Path does not exist: {p}")
@@ -139,8 +141,100 @@ def read_numpy(paths) -> Dataset:
     return Dataset(Read([make_task(f) for f in files]))
 
 
+def read_text(paths) -> Dataset:
+    """One row per line, column 'text' (ref: read_api.py read_text)."""
+    files = _expand_paths(paths, ".txt")
+
+    def make_task(f: str):
+        def read():
+            import pyarrow as pa
+
+            with open(f, "r", errors="replace") as fh:
+                lines = [ln.rstrip("\n") for ln in fh]
+            # Explicit type: an empty file would otherwise infer a
+            # null-typed column whose schema can't concat with real blocks.
+            return pa.table({"text": pa.array(lines, pa.string())})
+
+        return read
+
+    return Dataset(Read([make_task(f) for f in files]))
+
+
+def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
+    """Column 'bytes' (+ 'path') (ref: read_api.py read_binary_files)."""
+    files = _expand_paths(paths, "")
+
+    def make_task(f: str):
+        def read():
+            import pyarrow as pa
+
+            with open(f, "rb") as fh:
+                data = fh.read()
+            cols = {"bytes": pa.array([data], pa.binary())}
+            if include_paths:
+                cols["path"] = pa.array([f])
+            return pa.table(cols)
+
+        return read
+
+    return Dataset(Read([make_task(f) for f in files]))
+
+
+def read_images(paths, *, size: Optional[tuple] = None,
+                mode: str = "RGB",
+                include_paths: bool = False) -> Dataset:
+    """Column 'image' as HWC uint8 arrays (ref: read_api.py:781 read_images).
+
+    All images are decoded to a single uniform (H, W, C): `mode` (default
+    RGB) fixes C; `size=(H, W)` fixes H/W — when omitted, the first file's
+    size is the target and other files are resized to it.  Uniformity is
+    required for blocks to share a schema (fixed-size tensors batch
+    cleanly onto the TPU anyway)."""
+    exts = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
+    if isinstance(paths, str) and os.path.isdir(paths):
+        files: List[str] = []
+        for ext in exts:
+            files.extend(sorted(
+                f for f in _glob.glob(os.path.join(paths, f"*{ext}"))
+                if os.path.isfile(f)))
+        if not files:
+            raise FileNotFoundError(f"No images under {paths}")
+    else:
+        files = _expand_paths(paths, "")
+
+    if size is None:
+        from PIL import Image
+
+        with Image.open(files[0]) as probe:
+            size = (probe.height, probe.width)
+
+    def make_task(f: str):
+        def read():
+            from PIL import Image
+
+            from ray_tpu.data.block import block_from_batch
+
+            img = Image.open(f).convert(mode)
+            if (img.height, img.width) != size:
+                img = img.resize((size[1], size[0]))
+            arr = np.asarray(img)
+            if arr.ndim == 2:  # single-channel modes ("L"): keep HWC
+                arr = arr[..., None]
+            batch = {"image": arr[None, ...]}
+            if include_paths:
+                batch["path"] = np.asarray([f])
+            return block_from_batch(batch)
+
+        return read
+
+    return Dataset(Read([make_task(f) for f in files]))
+
+
 __all__ = [
     "ActorPoolStrategy", "DataIterator", "Dataset", "from_arrow", "from_items",
-    "from_numpy", "from_pandas", "range", "read_csv", "read_json", "read_numpy",
-    "read_parquet",
+    "from_numpy", "from_pandas", "preprocessors", "range", "read_binary_files",
+    "read_csv", "read_images", "read_json", "read_numpy", "read_parquet",
+    "read_text",
 ]
+
+from ray_tpu.data import preprocessors  # noqa: E402  (public submodule)

@@ -29,6 +29,7 @@ from ray_tpu.data.plan import (
     MapRows,
     RandomShuffle,
     Read,
+    Zip,
     Repartition,
     Sort,
     Union as UnionOp,
@@ -121,6 +122,13 @@ class Dataset:
     def union(self, *others: "Dataset") -> "Dataset":
         return Dataset(UnionOp(self._op, [o._op for o in others]))
 
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-aligned column concat (ref: dataset.py Dataset.zip).  Lazy:
+        the right side materializes at execution time, the left streams
+        through keeping its block boundaries.  Duplicate column names from
+        `other` get a unique "_N" suffix, as in the reference."""
+        return Dataset(Zip(self._op, other._op))
+
     def groupby(self, key: Optional[str]) -> "GroupedData":
         return GroupedData(self, key)
 
@@ -135,6 +143,24 @@ class Dataset:
 
         blocks = (ray_tpu.get(ref) for ref in self.iter_block_refs())
         yield from rebatch(blocks, batch_size, batch_format)
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, device: str = "cpu") -> Iterator[Any]:
+        """(ref: iterator.py iter_torch_batches) — dict of torch tensors."""
+        import torch
+
+        def to_tensor(k, v):
+            if getattr(v, "dtype", None) is None or v.dtype.kind not in "biufc":
+                return v  # non-numeric (strings/objects) stay numpy
+            dt = dtypes.get(k) if isinstance(dtypes, dict) else dtypes
+            if dt is None and v.dtype.kind == "u" and v.dtype.itemsize > 1:
+                # torch has no uint16/32/64: upcast to a signed type.
+                v = v.astype(np.int64)
+            return torch.as_tensor(v, dtype=dt).to(device)
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy"):
+            yield {k: to_tensor(k, v) for k, v in batch.items()}
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for ref in self.iter_block_refs():

@@ -33,6 +33,7 @@ from ray_tpu.data.plan import (
     MapRows,
     RandomShuffle,
     Read,
+    Zip,
     Repartition,
     Sort,
     Union,
@@ -197,7 +198,48 @@ def _apply_op(stream: Iterator[Any], op: LogicalOp) -> Iterator[Any]:
                 yield from execute(other)
 
         return union_stream()
+    if isinstance(op, Zip):
+        return _zip_stream(stream, op)
     raise TypeError(f"Unknown op: {op}")
+
+
+def _unique_column_name(name: str, taken) -> str:
+    if name not in taken:
+        return name
+    i = 1
+    while f"{name}_{i}" in taken:
+        i += 1
+    return f"{name}_{i}"
+
+
+def _zip_stream(stream: Iterator[Any], op: "Zip") -> Iterator[Any]:
+    """Materialize the right side, slice it along the left's block
+    boundaries (runs at consumption time — the plan stays lazy)."""
+    import pyarrow as pa
+
+    right_blocks = [ray_tpu.get(r) for r in execute(op.other)]
+    right = pa.concat_tables(right_blocks) if right_blocks else pa.table({})
+    offset = 0
+    for ref in stream:
+        left = ray_tpu.get(ref)
+        n = left.num_rows
+        if offset + n > right.num_rows:
+            raise ValueError(
+                f"zip requires equal row counts; right side has only "
+                f"{right.num_rows} rows")
+        rslice = right.slice(offset, n)
+        offset += n
+        taken = set(left.column_names)
+        combined = left
+        for name in rslice.column_names:
+            out = _unique_column_name(name, taken)
+            taken.add(out)
+            combined = combined.append_column(out, rslice[name])
+        yield ray_tpu.put(combined)
+    if offset != right.num_rows:
+        raise ValueError(
+            f"zip requires equal row counts: left has {offset}, right has "
+            f"{right.num_rows}")
 
 
 def _map_stream_tasks(stream: Iterator[Any], op: AbstractMap) -> Iterator[Any]:

@@ -137,6 +137,16 @@ class Union(LogicalOp):
         self.others = others
 
 
+class Zip(LogicalOp):
+    """Row-aligned column concat with another plan (ref: logical/operators/
+    zip_operator.py).  The right side materializes at execution; the left
+    streams through, keeping its block boundaries."""
+
+    def __init__(self, input_op, other: LogicalOp):
+        super().__init__(input_op)
+        self.other = other
+
+
 class Aggregate(LogicalOp):
     name = "Aggregate"
 

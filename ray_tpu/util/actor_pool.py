@@ -1,0 +1,102 @@
+"""ActorPool: load-balance tasks over a fixed set of actors
+(ref: python/ray/util/actor_pool.py ActorPool)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle: List[Any] = list(actors)
+        if not self._idle:
+            raise ValueError("ActorPool needs at least one actor")
+        self._future_to_actor: dict = {}
+        self._pending: List[tuple] = []  # (fn, value) waiting for an actor
+        self._unordered_results: List[Any] = []
+
+    # ------------------------------------------------------------ map APIs
+    def map(self, fn: Callable[[Any, Any], Any], values: Iterable[Any]):
+        """Ordered results; `fn(actor, value)` returns an ObjectRef."""
+        refs = []
+        values = list(values)
+        submitted = 0
+        # Prime every idle actor, then pipeline: wait for the oldest ref
+        # before submitting the next value to its actor.
+        inflight: List[tuple] = []  # (ref, actor)
+        for v in values:
+            if self._idle:
+                actor = self._idle.pop()
+                inflight.append((fn(actor, v), actor))
+                submitted += 1
+            else:
+                break
+        next_i = submitted
+        results = []
+        while inflight:
+            ref, actor = inflight.pop(0)
+            results.append(ray_tpu.get(ref))
+            if next_i < len(values):
+                inflight.append((fn(actor, values[next_i]), actor))
+                next_i += 1
+            else:
+                self._idle.append(actor)
+        return iter(results)
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]):
+        """Results in completion order."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # ------------------------------------------------------- submit/get APIs
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        if self._idle:
+            actor = self._idle.pop()
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (fn, actor)
+        else:
+            self._pending.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor or self._pending
+                    or self._unordered_results)
+
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        if self._unordered_results:
+            return self._unordered_results.pop(0)
+        if not self._future_to_actor:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        fn, actor = self._future_to_actor.pop(ref)
+        result = ray_tpu.get(ref)
+        if self._pending:
+            next_fn, value = self._pending.pop(0)
+            new_ref = next_fn(actor, value)
+            self._future_to_actor[new_ref] = (next_fn, actor)
+        else:
+            self._idle.append(actor)
+        return result
+
+    def push(self, actor: Any) -> None:
+        """Add an actor to the pool (ref: ActorPool.push)."""
+        if self._pending:
+            fn, value = self._pending.pop(0)
+            ref = fn(actor, value)
+            self._future_to_actor[ref] = (fn, actor)
+        else:
+            self._idle.append(actor)
+
+    def pop_idle(self) -> Optional[Any]:
+        return self._idle.pop() if self._idle else None
+
+    def has_free(self) -> bool:
+        return bool(self._idle)

@@ -1,0 +1,15 @@
+"""Public scheduling strategies (ref: python/ray/util/scheduling_strategies.py)."""
+
+from ray_tpu._private.scheduling import (
+    DefaultStrategy,
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    SpreadStrategy,
+)
+
+__all__ = [
+    "DefaultStrategy", "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy", "PlacementGroupSchedulingStrategy",
+    "SpreadStrategy",
+]

@@ -1,0 +1,165 @@
+"""Data extras: zip, file datasources, torch iteration, preprocessors.
+
+(ref test model: python/ray/data/tests/ — test_zip.py, test_image.py,
+test_preprocessors/)"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rtd
+from ray_tpu.data.preprocessors import (Chain, Concatenator, LabelEncoder,
+                                        MinMaxScaler, OneHotEncoder,
+                                        SimpleImputer, StandardScaler)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_zip_aligns_rows_and_renames_dupes():
+    a = rtd.range(6)
+    b = rtd.range(6).map_batches(lambda x: {"id": x["id"] * 10, "y": x["id"]})
+    z = a.zip(b)
+    rows = z.take_all()
+    assert set(rows[0]) == {"id", "id_1", "y"}
+    assert [r["id_1"] for r in rows] == [r["id"] * 10 for r in rows]
+    with pytest.raises(ValueError):
+        rtd.range(3).zip(rtd.range(4)).take_all()
+
+
+def test_zip_is_lazy_and_rename_avoids_collisions():
+    # Laziness: building the plan must not execute either side.
+    calls = {"n": 0}
+
+    def tracked(batch):
+        calls["n"] += 1
+        return batch
+
+    z = rtd.range(4).map_batches(tracked).zip(rtd.range(4))
+    assert calls["n"] == 0  # nothing ran at plan-build time
+    z.take_all()
+    assert calls["n"] > 0
+
+    # left already has id and id_1 -> right's id becomes id_2, not a dupe.
+    left = rtd.range(4).map_batches(lambda b: {"id": b["id"],
+                                               "id_1": b["id"] + 100})
+    rows = left.zip(rtd.range(4)).take_all()
+    assert set(rows[0]) == {"id", "id_1", "id_2"}
+
+
+def test_read_text_and_binary(tmp_path):
+    (tmp_path / "a.txt").write_text("one\ntwo\n")
+    (tmp_path / "b.txt").write_text("three\n")
+    ds = rtd.read_text(str(tmp_path))
+    assert sorted(r["text"] for r in ds.take_all()) == ["one", "three", "two"]
+
+    raw = tmp_path / "blob.bin"
+    raw.write_bytes(b"\x00\x01payload")
+    ds = rtd.read_binary_files(str(raw), include_paths=True)
+    row = ds.take_all()[0]
+    assert row["bytes"] == b"\x00\x01payload" and row["path"].endswith("blob.bin")
+
+
+def test_read_images(tmp_path):
+    from PIL import Image
+
+    for i, color in enumerate([(255, 0, 0), (0, 255, 0)]):
+        Image.new("RGB", (8, 6), color).save(tmp_path / f"img{i}.png")
+    ds = rtd.read_images(str(tmp_path), size=(3, 4), mode="RGB",
+                         include_paths=True)
+    rows = ds.take_all()
+    assert len(rows) == 2
+    img = np.asarray(rows[0]["image"])
+    assert img.shape == (3, 4, 3) and img.dtype == np.uint8
+
+
+def test_read_images_mixed_sizes_and_modes_are_uniformed(tmp_path):
+    from PIL import Image
+
+    Image.new("RGB", (8, 6), (1, 2, 3)).save(tmp_path / "a.png")
+    Image.new("L", (4, 4), 7).save(tmp_path / "b.png")  # different size+mode
+    (tmp_path / "sub").mkdir()  # subdirectory must be ignored
+    batches = list(rtd.read_images(str(tmp_path)).iter_batches(batch_size=2))
+    imgs = batches[0]["image"]
+    assert imgs.shape == (2, 6, 8, 3)  # first file's size, RGB everywhere
+
+
+def test_read_text_empty_file_schema(tmp_path):
+    (tmp_path / "full.txt").write_text("x\n")
+    (tmp_path / "empty.txt").write_text("")
+    rows = rtd.read_text(str(tmp_path)).zip(
+        rtd.from_items([{"n": 1}])).take_all()
+    assert rows[0]["text"] == "x"
+
+
+def test_iter_torch_batches_uint16():
+    import torch
+
+    ds = rtd.from_numpy(np.arange(6, dtype=np.uint16), column="u")
+    out = list(ds.iter_torch_batches(batch_size=6))[0]["u"]
+    assert out.dtype == torch.int64 and out.tolist() == [0, 1, 2, 3, 4, 5]
+
+
+def test_iter_torch_batches():
+    import torch
+
+    ds = rtd.range(10).map_batches(lambda b: {"id": b["id"],
+                                              "x": b["id"] * 0.5})
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    assert isinstance(batches[0]["x"], torch.Tensor)
+    total = torch.cat([b["id"] for b in batches])
+    assert total.shape == (10,)
+
+
+def test_standard_and_minmax_scalers():
+    ds = rtd.from_items([{"a": float(i), "b": float(i * 2)} for i in range(8)])
+    sc = StandardScaler(["a"]).fit(ds)
+    out = np.concatenate([b["a"] for b in
+                          sc.transform(ds).iter_batches(batch_format="numpy")])
+    assert abs(out.mean()) < 1e-9 and abs(out.std() - 1.0) < 1e-6
+
+    mm = MinMaxScaler(["b"]).fit(ds)
+    out = np.concatenate([b["b"] for b in
+                          mm.transform(ds).iter_batches(batch_format="numpy")])
+    assert out.min() == 0.0 and out.max() == 1.0
+
+
+def test_label_and_onehot_encoders():
+    ds = rtd.from_items([{"cls": c, "v": 1.0} for c in
+                         ["cat", "dog", "cat", "bird"]])
+    le = LabelEncoder("cls").fit(ds)
+    assert le.classes_ == ["bird", "cat", "dog"]
+    rows = le.transform(ds).take_all()
+    assert [r["cls"] for r in rows] == [1, 2, 1, 0]
+
+    oh = OneHotEncoder(["cls"]).fit(ds)
+    row = oh.transform(ds).take_all()[0]
+    assert row["cls_cat"] == 1 and row["cls_dog"] == 0 and row["cls_bird"] == 0
+
+
+def test_imputer_concatenator_chain():
+    ds = rtd.from_items([
+        {"a": 1.0, "b": 2.0}, {"a": float("nan"), "b": 4.0},
+        {"a": 3.0, "b": float("nan")}])
+    chain = Chain(
+        SimpleImputer(["a", "b"]),
+        Concatenator(["a", "b"], output_column_name="features"))
+    chain.fit(ds)
+    out = chain.transform(ds).take_all()
+    feats = np.stack([r["features"] for r in out])
+    assert feats.shape == (3, 2) and not np.isnan(feats).any()
+    assert feats[1, 0] == pytest.approx(2.0)  # mean of [1, 3]
+
+    # transform_batch serving path matches the dataset path
+    direct = chain.transform_batch({"a": np.asarray([float("nan")]),
+                                    "b": np.asarray([4.0])})
+    assert direct["features"][0, 0] == pytest.approx(2.0)
+
+
+def test_unfit_preprocessor_raises():
+    with pytest.raises(RuntimeError):
+        StandardScaler(["a"]).transform(rtd.range(3))

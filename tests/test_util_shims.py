@@ -1,0 +1,89 @@
+"""util ecosystem shims: ActorPool, Queue (ref: python/ray/tests/
+test_actor_pool.py, test_queue.py)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray_init():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+def test_actor_pool_map_ordered():
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4, 5]))
+    assert out == [2, 4, 6, 8, 10]
+
+
+def test_actor_pool_map_unordered_and_submit():
+    pool = ActorPool([Doubler.remote() for _ in range(3)])
+    out = sorted(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                    list(range(7))))
+    assert out == [0, 2, 4, 6, 8, 10, 12]
+    # submit/get_next_unordered with more work than actors (pending queue)
+    pool.submit(lambda a, v: a.double.remote(v), 10)
+    pool.submit(lambda a, v: a.double.remote(v), 20)
+    got = {pool.get_next_unordered(timeout=10),
+           pool.get_next_unordered(timeout=10)}
+    assert got == {20, 40}
+    assert not pool.has_next()
+
+
+def test_queue_fifo_and_batches():
+    q = Queue()
+    q.put(1)
+    q.put_nowait_batch([2, 3, 4])
+    assert q.qsize() == 4
+    assert [q.get() for _ in range(2)] == [1, 2]
+    assert q.get_nowait_batch(5) == [3, 4]
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+    q.shutdown()
+
+
+def test_queue_maxsize_blocking():
+    q = Queue(maxsize=1)
+    q.put("a")
+    with pytest.raises(Full):
+        q.put_nowait("b")
+
+    def consumer():
+        time.sleep(0.2)
+        assert q.get() == "a"
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    q.put("b", timeout=5)  # unblocks once the consumer drains "a"
+    t.join()
+    assert q.get() == "b"
+    q.shutdown()
+
+
+def test_queue_shared_across_tasks():
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    ray_tpu.get(producer.remote(q, 5))
+    assert sorted(q.get() for _ in range(5)) == [0, 1, 2, 3, 4]
+    q.shutdown()
