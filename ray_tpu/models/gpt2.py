@@ -34,12 +34,24 @@ class GPTConfig:
     #: measured best on v5e (recomputing attention in bwd is the one thing
     #: worth HBM); "full" rematerializes everything.
     remat_policy: str = "save_attn"
-    attn_impl: str = "auto"  # auto | xla | pallas
+    attn_impl: str = "auto"  # auto | xla | pallas | splash | ring | ulysses
     #: Pipeline stages over the mesh's `pipe` axis (parallel/pipeline.py);
     #: 1 = no pipelining. n_layer % pp_stages must be 0.
     pp_stages: int = 1
     #: GPipe microbatches; 0 = pp_stages (minimum). Must divide batch.
     pp_microbatches: int = 0
+    #: Sequence-chunked LM-head loss: compute logits + cross-entropy in
+    #: seq chunks of this size under jax.checkpoint, so the fp32 (B, S, V)
+    #: logits tensor (3.3 GB for GPT-2-small at B=16) never hits HBM in
+    #: either pass.  0 = single unchunked einsum.
+    loss_chunk: int = 0
+    #: lax.scan unroll factor over the stacked layers: >1 widens XLA's
+    #: scheduling window so HBM-bound elementwise ops overlap matmuls
+    #: across layer boundaries.
+    scan_unroll: int = 1
+    #: Splash-attention kernel tile sizes.
+    attn_block_q: int = 512
+    attn_block_kv: int = 512
 
     @property
     def head_dim(self) -> int:
@@ -141,9 +153,23 @@ def _attention(q, k, v, config: GPTConfig):
     the mesh via jax.set_mesh (parallel/train_state.py jit_train_step(mesh=)).
     """
     impl = config.attn_impl
-    if impl not in ("auto", "xla", "pallas", "ring", "ulysses"):
+    if impl not in ("auto", "xla", "pallas", "splash", "ring", "ulysses"):
         raise ValueError(
-            f"Unknown attn_impl: {impl!r} (use auto|xla|pallas|ring|ulysses)")
+            f"Unknown attn_impl: {impl!r} "
+            "(use auto|xla|pallas|splash|ring|ulysses)")
+    if impl == "splash" or (impl == "auto" and jax.default_backend() == "tpu"):
+        try:
+            from ray_tpu.ops.attention import splash_attention
+
+            return splash_attention(q, k, v, causal=True,
+                                    block_q=config.attn_block_q,
+                                    block_kv=config.attn_block_kv)
+        except Exception as e:  # noqa: BLE001 — fall through to flash/xla
+            if impl == "splash":
+                raise
+            import warnings
+
+            warnings.warn(f"splash attention unavailable ({e}); falling back")
     if impl == "ring":
         from ray_tpu.ops.ring_attention import ring_attention
 
@@ -182,7 +208,9 @@ def _block(x, blk, config: GPTConfig):
     dt = config.dtype
 
     h = _layernorm(x, blk["ln1_scale"], blk["ln1_bias"]).astype(dt)
+    h = checkpoint_name(h, "ln1_out")
     qkv = h @ blk["qkv_w"].astype(dt) + blk["qkv_b"].astype(dt)
+    qkv = checkpoint_name(qkv, "qkv")
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, S, H, hd)
     k = k.reshape(B, S, H, hd)
@@ -192,26 +220,41 @@ def _block(x, blk, config: GPTConfig):
     x = x + attn @ blk["out_w"].astype(dt) + blk["out_b"].astype(dt)
 
     h = _layernorm(x, blk["ln2_scale"], blk["ln2_bias"]).astype(dt)
+    h = checkpoint_name(h, "ln2_out")
     h = jax.nn.gelu(h @ blk["mlp_in_w"].astype(dt) + blk["mlp_in_b"].astype(dt))
+    h = checkpoint_name(h, "mlp_act")
     x = x + h @ blk["mlp_out_w"].astype(dt) + blk["mlp_out_b"].astype(dt)
     return x
 
 
-def forward(params: Dict[str, Any], tokens, config: GPTConfig):
-    """tokens (B, S) int32 -> logits (B, S, V) fp32."""
+def forward_hidden(params: Dict[str, Any], tokens, config: GPTConfig):
+    """tokens (B, S) int32 -> final-layernormed hidden states (B, S, D)."""
     B, S = tokens.shape
     dt = config.dtype
     x = params["wte"][tokens].astype(dt) + params["wpe"][:S].astype(dt)
 
     block_fn = partial(_block, config=config)
     if config.remat:
-        if config.remat_policy == "save_attn":
-            block_fn = jax.checkpoint(
-                block_fn,
-                policy=jax.checkpoint_policies.save_only_these_names("attn_out"),
-            )
-        else:
-            block_fn = jax.checkpoint(block_fn)
+        policies = {
+            "save_attn": lambda: jax.checkpoint_policies.save_only_these_names(
+                "attn_out"),
+            # Save every matmul input/output across the boundary: bwd then
+            # recomputes only elementwise ops (layernorm/gelu/adds).  ~3 GB
+            # of saved activations at B=16 — the compiler-friendly stand-in
+            # for remat=False (which crashes the TPU compiler helper).
+            "save_matmuls": lambda: jax.checkpoint_policies.save_only_these_names(
+                "ln1_out", "qkv", "attn_out", "ln2_out", "mlp_act"),
+            "dots": lambda: jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "everything": lambda: jax.checkpoint_policies.everything_saveable,
+            "full": lambda: None,
+        }
+        if config.remat_policy not in policies:
+            raise ValueError(
+                f"unknown remat_policy {config.remat_policy!r} "
+                f"(use {sorted(policies)})")
+        policy = policies[config.remat_policy]()
+        block_fn = (jax.checkpoint(block_fn, policy=policy) if policy is not None
+                    else jax.checkpoint(block_fn))
 
     def scan_body(carry, blk):
         return block_fn(carry, blk), None
@@ -241,21 +284,56 @@ def forward(params: Dict[str, Any], tokens, config: GPTConfig):
             stage_fn, params["blocks"], x,
             n_microbatches=config.pp_microbatches or config.pp_stages)
     else:
-        x, _ = lax.scan(scan_body, x, params["blocks"])
+        x, _ = lax.scan(scan_body, x, params["blocks"],
+                        unroll=config.scan_unroll)
     x = _layernorm(x, params["lnf_scale"], params["lnf_bias"]).astype(dt)
+    return x
+
+
+def forward(params: Dict[str, Any], tokens, config: GPTConfig):
+    """tokens (B, S) int32 -> logits (B, S, V) fp32."""
+    x = forward_hidden(params, tokens, config)
     # Tied LM head; logits accumulate in fp32 for a stable loss.
-    logits = jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(dt),
-                        preferred_element_type=jnp.float32)
-    return logits
+    return jnp.einsum("bsd,vd->bsv", x, params["wte"].astype(config.dtype),
+                      preferred_element_type=jnp.float32)
 
 
 def loss_fn(params, tokens, targets, config: GPTConfig):
-    logits = forward(params, tokens, config)
-    # lse - target_logit (not log_softmax) keeps the fp32 (B,S,V) traffic to
-    # one reduction pass — measured ~2 MFU points on v5e.
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    tgt_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - tgt_logit)
+    x = forward_hidden(params, tokens, config)
+    wte = params["wte"].astype(config.dtype)
+    B, S, D = x.shape
+    C = config.loss_chunk
+    if not C or C >= S:
+        logits = jnp.einsum("bsd,vd->bsv", x, wte,
+                            preferred_element_type=jnp.float32)
+        # lse - target_logit (not log_softmax) keeps the fp32 (B,S,V) traffic
+        # to one reduction pass — measured ~2 MFU points on v5e.
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(lse - tgt_logit)
+
+    # Chunked head: per-chunk logits live only in VMEM-scale tiles; bwd
+    # recomputes them under jax.checkpoint, so peak HBM holds (B, C, V)
+    # instead of (B, S, V) in both passes.
+    if S % C:
+        raise ValueError(f"loss_chunk {C} must divide seq_len {S}")
+    n = S // C
+    xs = x.reshape(B, n, C, D).swapaxes(0, 1)      # (n, B, C, D)
+    ts = targets.reshape(B, n, C).swapaxes(0, 1)   # (n, B, C)
+
+    @jax.checkpoint
+    def chunk_loss(x_c, t_c):
+        logits = jnp.einsum("bsd,vd->bsv", x_c, wte,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+        return jnp.sum(lse - tgt)
+
+    def body(acc, xt):
+        return acc + chunk_loss(*xt), None
+
+    total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts))
+    return total / (B * S)
 
 
 def make_optimizer(learning_rate=3e-4, weight_decay=0.1, b1=0.9, b2=0.95,

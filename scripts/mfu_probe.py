@@ -1,0 +1,157 @@
+"""Component-level timing on the real chip: where do the 203ms/step go?
+
+Times attention (impl x block), LM head, trunk fwd, full fwd, fwd+bwd,
+optimizer — each vs its roofline — and full-step remat-policy variants.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+PEAK = 197e12     # v5e bf16 dense
+HBM_BW = 819e9    # v5e HBM GB/s
+
+
+def timeit(fn, *args, n=20, warmup=3):
+    """fn is wrapped to reduce its output to ONE scalar on device — syncing
+    via a full-tensor host read would time the axon tunnel, not the chip."""
+    import jax
+    import jax.numpy as jnp
+
+    scalar_fn = jax.jit(lambda *a: jax.tree.reduce(
+        lambda acc, x: acc + jnp.sum(x).astype(jnp.float32), fn(*a),
+        jnp.zeros((), jnp.float32)))
+    for _ in range(warmup):
+        out = scalar_fn(*args)
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = scalar_fn(*args)
+    float(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2
+
+    B, S, H, hd, D, V = 16, 1024, 12, 64, 768, 50304
+    L = 12
+    key = jax.random.key(0)
+
+    # ---------------- attention: impl x block ----------------
+    q = jax.random.normal(key, (B, S, H, hd), jnp.bfloat16)
+    k = jax.random.normal(key, (B, S, H, hd), jnp.bfloat16)
+    v = jax.random.normal(key, (B, S, H, hd), jnp.bfloat16)
+    attn_flops = 4 * B * S * S * H * hd * 0.5  # causal halves the work
+    print(f"attention (B{B} S{S} H{H} hd{hd}), causal roofline "
+          f"{attn_flops/PEAK*1e3:.2f}ms fwd:", flush=True)
+
+    from ray_tpu.ops.attention import flash_attention
+
+    for tag, fn in [
+        ("xla", lambda q, k, v: gpt2._attention(q, k, v, gpt2.GPTConfig(attn_impl="xla"))),
+        ("flash b256", partial(flash_attention, block=256)),
+        ("flash b512", partial(flash_attention, block=512)),
+        ("flash b1024", partial(flash_attention, block=1024)),
+    ]:
+        try:
+            dt = timeit(fn, q, k, v)
+            grad_fn = jax.grad(lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(), argnums=(0, 1, 2))
+            dtg = timeit(grad_fn, q, k, v)
+            print(f"  {tag:12s} fwd {dt*1e3:7.2f}ms  fwd+bwd {dtg*1e3:7.2f}ms", flush=True)
+        except Exception as e:
+            print(f"  {tag:12s} FAILED {type(e).__name__}: {str(e)[:100]}", flush=True)
+
+    # ---------------- LM head ----------------
+    x = jax.random.normal(key, (B, S, D), jnp.bfloat16)
+    wte = jax.random.normal(key, (V, D), jnp.bfloat16)
+    tgt = jnp.zeros((B, S), jnp.int32)
+    head_flops = 2 * B * S * D * V
+    head_bytes = B * S * V * 4
+    print(f"\nLM head roofline: matmul {head_flops/PEAK*1e3:.2f}ms, "
+          f"fp32 logits write {head_bytes/HBM_BW*1e3:.2f}ms", flush=True)
+
+    def head_loss(x, wte, tgt):
+        logits = jnp.einsum("bsd,vd->bsv", x, wte, preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        t = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+        return jnp.mean(lse - t)
+
+    print(f"  head loss fwd      {timeit(head_loss, x, wte, tgt)*1e3:7.2f}ms", flush=True)
+    print(f"  head loss fwd+bwd  {timeit(jax.grad(head_loss, argnums=(0, 1)), x, wte, tgt)*1e3:7.2f}ms", flush=True)
+
+    def head_loss_chunk(x, wte, tgt, C=256):
+        n = S // C
+        xs = x.reshape(B, n, C, D).swapaxes(0, 1)
+        ts = tgt.reshape(B, n, C).swapaxes(0, 1)
+
+        @jax.checkpoint
+        def cl(x_c, t_c):
+            logits = jnp.einsum("bsd,vd->bsv", x_c, wte, preferred_element_type=jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            t = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+            return jnp.sum(lse - t)
+
+        import jax.lax as lax
+        total, _ = lax.scan(lambda a, xt: (a + cl(*xt), None), jnp.zeros((), jnp.float32), (xs, ts))
+        return total / (B * S)
+
+    print(f"  chunk256 fwd       {timeit(head_loss_chunk, x, wte, tgt)*1e3:7.2f}ms", flush=True)
+    print(f"  chunk256 fwd+bwd   {timeit(jax.grad(head_loss_chunk, argnums=(0, 1)), x, wte, tgt)*1e3:7.2f}ms", flush=True)
+
+    # ---------------- trunk fwd / full step breakdown ----------------
+    config = gpt2.GPTConfig()
+    params = gpt2.init_params(config, key)
+    toks = jnp.zeros((B, S), jnp.int32)
+    tgts = jnp.zeros((B, S), jnp.int32)
+
+    trunk_flops = 2 * (gpt2.num_params(config) - V * D) * B * S + attn_flops * L
+    print(f"\ntrunk fwd roofline {trunk_flops/PEAK*1e3:.2f}ms", flush=True)
+    print(f"  trunk fwd          {timeit(lambda p, t: gpt2.forward_hidden(p, t, config), params, toks)*1e3:7.2f}ms", flush=True)
+    print(f"  loss fwd           {timeit(lambda p, t, g: gpt2.loss_fn(p, t, g, config), params, toks, tgts)*1e3:7.2f}ms", flush=True)
+    print(f"  loss fwd+bwd       {timeit(jax.grad(lambda p, t, g: gpt2.loss_fn(p, t, g, config)), params, toks, tgts)*1e3:7.2f}ms", flush=True)
+
+    # ---------------- remat policies, full step ----------------
+    print("\nfull train step by remat policy:", flush=True)
+    import dataclasses
+
+    import optax
+    for tag, kw in [
+        ("save_attn (r1)", dict()),
+        ("save_attn chunk256", dict(loss_chunk=256)),
+        ("dots_saveable", dict(remat_policy="dots")),
+        ("everything_saveable", dict(remat_policy="everything")),
+    ]:
+        try:
+            c = dataclasses.replace(config, **kw)
+            opt = gpt2.make_optimizer()
+            p2 = gpt2.init_params(c, key)
+            o2 = opt.init(p2)
+            step = jax.jit(gpt2.make_train_step(c, opt), donate_argnums=(0, 1))
+            for _ in range(3):
+                p2, o2, loss = step(p2, o2, toks, tgts)
+            float(loss)
+            t0 = time.perf_counter()
+            n = 10
+            for _ in range(n):
+                p2, o2, loss = step(p2, o2, toks, tgts)
+            float(loss)
+            dt = (time.perf_counter() - t0) / n
+            mfu = gpt2.flops_per_token(c) * B * S / dt / PEAK
+            print(f"  {tag:22s} {dt*1e3:7.1f}ms  MFU {mfu*100:5.1f}%", flush=True)
+        except Exception as e:
+            print(f"  {tag:22s} FAILED {type(e).__name__}: {str(e)[:90]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""In-model attention impl comparison on the real chip: full loss fwd+bwd
+and full train step per attn_impl, plus splash block sweep standalone."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+PEAK = 197e12
+
+
+def timeit(fn, *args, n=20, warmup=3):
+    import jax
+    import jax.numpy as jnp
+
+    scalar_fn = jax.jit(lambda *a: jax.tree.reduce(
+        lambda acc, x: acc + jnp.sum(x).astype(jnp.float32), fn(*a),
+        jnp.zeros((), jnp.float32)))
+    for _ in range(warmup):
+        out = scalar_fn(*args)
+    float(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = scalar_fn(*args)
+    float(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.ops.attention import splash_attention
+
+    B, S, H, hd = 16, 1024, 12, 64
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (B, S, H, hd), jnp.bfloat16)
+    k = jax.random.normal(key, (B, S, H, hd), jnp.bfloat16)
+    v = jax.random.normal(key, (B, S, H, hd), jnp.bfloat16)
+
+    print("splash standalone (roofline fwd 0.13ms):", flush=True)
+    for bq, bkv, fused in [(512, 512, True), (512, 512, False),
+                           (1024, 1024, True), (256, 256, True),
+                           (1024, 512, True), (2048, 2048, True)]:
+        tag = f"splash q{bq} kv{bkv}{' fused' if fused else ''}"
+        try:
+            fn = partial(splash_attention, block_q=bq, block_kv=bkv, fused_bwd=fused)
+            dt = timeit(fn, q, k, v)
+            g = jax.grad(lambda q, k, v: fn(q, k, v).astype(jnp.float32).sum(),
+                         argnums=(0, 1, 2))
+            dtg = timeit(g, q, k, v)
+            print(f"  {tag:28s} fwd {dt*1e3:6.2f}ms  fwd+bwd {dtg*1e3:6.2f}ms", flush=True)
+        except Exception as e:
+            print(f"  {tag:28s} FAILED {type(e).__name__}: {str(e)[:90]}", flush=True)
+
+    config = gpt2.GPTConfig()
+    toks = jnp.zeros((B, S), jnp.int32)
+    tgts = jnp.zeros((B, S), jnp.int32)
+
+    print("\nfull step by attn impl (B16):", flush=True)
+    for tag, kw in [
+        ("pallas flash (r1)", dict(attn_impl="pallas")),
+        ("splash", dict(attn_impl="splash")),
+        ("splash dots", dict(attn_impl="splash", remat_policy="dots")),
+        ("splash chunk256", dict(attn_impl="splash", loss_chunk=256)),
+        ("xla", dict(attn_impl="xla")),
+    ]:
+        try:
+            c = dataclasses.replace(config, **kw)
+            opt = gpt2.make_optimizer()
+            p2 = gpt2.init_params(c, key)
+            o2 = opt.init(p2)
+            step = jax.jit(gpt2.make_train_step(c, opt), donate_argnums=(0, 1))
+            for _ in range(3):
+                p2, o2, loss = step(p2, o2, toks, tgts)
+            float(loss)
+            t0 = time.perf_counter()
+            n = 10
+            for _ in range(n):
+                p2, o2, loss = step(p2, o2, toks, tgts)
+            float(loss)
+            dt = (time.perf_counter() - t0) / n
+            mfu = gpt2.flops_per_token(c) * B * S / dt / PEAK
+            print(f"  {tag:22s} {dt*1e3:7.1f}ms  MFU {mfu*100:5.1f}%", flush=True)
+        except Exception as e:
+            print(f"  {tag:22s} FAILED {type(e).__name__}: {str(e)[:90]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Round 3: splash variants x remat x batch, full train step only."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+PEAK = 197e12
+
+
+def step_time(config, batch_per_chip, n=10):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2
+
+    B = batch_per_chip
+    key = jax.random.key(0)
+    toks = jnp.zeros((B, config.seq_len), jnp.int32)
+    tgts = jnp.zeros((B, config.seq_len), jnp.int32)
+    opt = gpt2.make_optimizer()
+    p2 = gpt2.init_params(config, key)
+    o2 = opt.init(p2)
+    step = jax.jit(gpt2.make_train_step(config, opt), donate_argnums=(0, 1))
+    for _ in range(3):
+        p2, o2, loss = step(p2, o2, toks, tgts)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        p2, o2, loss = step(p2, o2, toks, tgts)
+    float(loss)
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    from ray_tpu.models import gpt2
+
+    base = gpt2.GPTConfig(attn_impl="splash")
+    for tag, kw, b in [
+        ("b16 base (rerun)", dict(), 16),
+        ("b16 unroll2", dict(scan_unroll=2), 16),
+        ("b16 unroll4", dict(scan_unroll=4), 16),
+        ("b16 q1024 kv1024", dict(attn_block_q=1024, attn_block_kv=1024), 16),
+        ("b16 q1024 kv512", dict(attn_block_q=1024), 16),
+        ("b16 q512 kv1024", dict(attn_block_kv=1024), 16),
+        ("b16 unroll2 q1024kv1024", dict(scan_unroll=2, attn_block_q=1024, attn_block_kv=1024), 16),
+    ]:
+        try:
+            c = dataclasses.replace(base, **kw)
+            dt = step_time(c, b)
+            mfu = gpt2.flops_per_token(c) * b * c.seq_len / dt / PEAK
+            print(f"  {tag:24s} {dt*1e3:7.1f}ms  MFU {mfu*100:5.1f}%", flush=True)
+        except Exception as e:
+            print(f"  {tag:24s} FAILED {type(e).__name__}: {str(e)[:90]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""MFU sweep on the real chip: remat x batch x loss_chunk x attn block.
+
+Prints one line per config:  <tag>  ms/step  tokens/s  MFU%
+Run: python scripts/mfu_sweep.py [quick]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+# NOTE: do NOT use PYTHONPATH for this — setting it breaks the axon TPU
+# plugin's registration on this image.  sys.path works fine.
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def run_config(tag, config, batch_per_chip, n_steps=8):
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import MeshSpec, batch_sharding, make_mesh
+    from ray_tpu.parallel.train_state import create_sharded_state, jit_train_step
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    B = batch_per_chip * n_dev
+    mesh = make_mesh(MeshSpec(data=n_dev), devices)
+    optimizer = gpt2.make_optimizer(learning_rate=3e-4)
+    try:
+        params, opt_state = create_sharded_state(
+            lambda key: gpt2.init_params(config, key),
+            gpt2.logical_axes(config), mesh, jax.random.key(0), optimizer)
+        step = jit_train_step(gpt2.make_train_step(config, optimizer))
+
+        batch_sh = batch_sharding(mesh)
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, config.vocab_size, (B, config.seq_len + 1), dtype=np.int64)
+        t = jnp.asarray(toks, jnp.int32)
+        tokens = jax.device_put(t[:, :-1], batch_sh)
+        targets = jax.device_put(t[:, 1:], batch_sh)
+
+        t0 = time.perf_counter()
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, tokens, targets)
+        warm_loss = float(loss)
+        compile_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            params, opt_state, loss = step(params, opt_state, tokens, targets)
+        final_loss = float(loss)
+        dt = time.perf_counter() - t0
+    except Exception as e:
+        print(f"{tag:55s}  FAILED: {type(e).__name__}: {str(e)[:120]}", flush=True)
+        return None
+
+    tokens_per_sec = n_steps * B * config.seq_len / dt
+    flops = gpt2.flops_per_token(config) * tokens_per_sec
+    peak = 197e12 * n_dev  # v5e
+    mfu = flops / peak
+    ms = dt / n_steps * 1e3
+    print(f"{tag:55s}  {ms:8.1f} ms  {tokens_per_sec:9,.0f} tok/s  "
+          f"MFU {mfu*100:5.1f}%  (compile+warm {compile_s:.0f}s, loss {final_loss:.3f})",
+          flush=True)
+    return mfu
+
+
+def main():
+    from ray_tpu.models import gpt2
+
+    quick = "quick" in sys.argv[1:]
+    results = {}
+
+    def cfg(**kw):
+        return gpt2.GPTConfig(**kw)
+
+    grid = [
+        # (tag, config, batch_per_chip)
+        ("baseline r1: save_attn b16", cfg(), 16),
+        ("no-remat b16", cfg(remat=False), 16),
+        ("no-remat b16 chunk128", cfg(remat=False, loss_chunk=128), 16),
+        ("no-remat b16 chunk256", cfg(remat=False, loss_chunk=256), 16),
+        ("save_attn b16 chunk256", cfg(loss_chunk=256), 16),
+        ("no-remat b32", cfg(remat=False), 32),
+        ("no-remat b32 chunk256", cfg(remat=False, loss_chunk=256), 32),
+        ("no-remat b32 chunk128", cfg(remat=False, loss_chunk=128), 32),
+        ("save_attn b32 chunk256", cfg(loss_chunk=256), 32),
+        ("no-remat b64 chunk256", cfg(remat=False, loss_chunk=256), 64),
+        ("save_attn b64 chunk256", cfg(loss_chunk=256), 64),
+    ]
+    if quick:
+        grid = grid[:4]
+    for tag, c, b in grid:
+        results[tag] = run_config(tag, c, b)
+
+    best = max((m, t) for t, m in results.items() if m is not None)
+    print(f"\nBEST: {best[1]}  MFU {best[0]*100:.1f}%", flush=True)
+
+
+if __name__ == "__main__":
+    main()
