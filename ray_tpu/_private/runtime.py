@@ -447,6 +447,9 @@ class Runtime:
             if not feasible and not isinstance(strategy, PlacementGroupSchedulingStrategy):
                 from ray_tpu._private.scheduling import InfeasibleError
 
+                # Drop any demand reported on an earlier blocked pass, or a
+                # running autoscaler keeps launching nodes for a dead task.
+                self.scheduler.clear_task_demand(spec.task_id)
                 self._fail_task(
                     spec,
                     InfeasibleError(

@@ -101,7 +101,23 @@ class RuntimeEnv(dict):
         mods = [os.path.abspath(p) for p in self.get("py_modules", ())]
         if mods:
             payload["py_modules"] = mods
+            # Content-fingerprint each module like working_dir, so editing a
+            # module produces a new lease key instead of silently reusing a
+            # cached worker that already imported the stale code.
+            payload["py_modules_fingerprint"] = [
+                _dir_fingerprint(p) if os.path.isdir(p) else _file_fingerprint(p)
+                for p in mods
+            ]
         return payload
+
+
+def _file_fingerprint(path: str) -> str:
+    try:
+        stat = os.stat(path)
+        tail = f"{stat.st_mtime_ns}:{stat.st_size}"
+    except OSError:
+        tail = "missing"
+    return hashlib.sha1(f"{path}:{tail}".encode()).hexdigest()[:16]
 
 
 def _dir_fingerprint(src: str) -> str:

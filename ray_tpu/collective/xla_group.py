@@ -87,7 +87,16 @@ class XLACollectiveGroup:
         if world_size > len(all_devices):
             # Fewer physical devices than ranks (e.g. 1 real TPU chip, 8-rank
             # group in tests): place multiple ranks per device.  Collectives
-            # remain correct; bandwidth realism needs real chips.
+            # remain correct but run HOST-SIDE — none of the compiled ICI
+            # path is exercised.  Loud, because silently degrading here made
+            # 1-chip test hosts "pass" without testing the real programs.
+            import warnings
+
+            warnings.warn(
+                f"collective group '{group_name}': world_size {world_size} > "
+                f"{len(all_devices)} devices — no mesh; ops run host-side, "
+                f"the compiled ICI path is NOT exercised",
+                RuntimeWarning, stacklevel=2)
             self.devices = [all_devices[i % len(all_devices)] for i in range(world_size)]
             self._oversubscribed = True
         else:
@@ -176,24 +185,52 @@ class XLACollectiveGroup:
                 )
 
             fn = self._get_compiled(key, build)
-            stacked = jax.device_put(
-                jnp.stack(inputs),
-                jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("ranks")),
-            )
-            out = fn(stacked)
+            out = fn(self._mesh_put(jnp.stack(inputs)))
             return [out[i] for i in range(self.world_size)]
 
         results = rv.contribute(rank, array, run, on_timeout=self._on_rv_timeout)
         return results[rank]
 
+    def _mesh_put(self, stacked):
+        import jax
+
+        return jax.device_put(
+            stacked,
+            jax.sharding.NamedSharding(
+                self.mesh(), jax.sharding.PartitionSpec("ranks")))
+
     def allgather(self, rank: int, array: Any) -> Any:
+        import jax
         import jax.numpy as jnp
 
         array = jnp.asarray(array)
         rv = self._rendezvous_for("allgather")
 
         def run(slots: Dict[int, Any]) -> List[Any]:
-            out = jnp.stack([slots[r] for r in range(self.world_size)])
+            inputs = [slots[r] for r in range(self.world_size)]
+            mesh = self.mesh()
+            if mesh is None:
+                out = jnp.stack(inputs)
+                return [out] * self.world_size
+            key = ("allgather", inputs[0].shape, str(inputs[0].dtype))
+
+            def build():
+                from jax import lax, shard_map
+                from jax.sharding import PartitionSpec as P
+
+                def body(x):
+                    # x: (1, *shape) per-rank block; gather the full stack —
+                    # identical on every rank, so the output is replicated.
+                    return lax.all_gather(x, "ranks", axis=0, tiled=True)
+
+                # check_vma=False: the gather output is replicated by
+                # construction, which the static VMA check cannot infer.
+                return jax.jit(shard_map(
+                    body, mesh=mesh, in_specs=P("ranks"), out_specs=P(),
+                    check_vma=False))
+
+            fn = self._get_compiled(key, build)
+            out = fn(self._mesh_put(jnp.stack(inputs)))
             return [out] * self.world_size
 
         results = rv.contribute(rank, array, run, on_timeout=self._on_rv_timeout)
@@ -201,6 +238,7 @@ class XLACollectiveGroup:
 
     def reducescatter(self, rank: int, array: Any, op: str = ReduceOp.SUM) -> Any:
         """Each rank contributes shape (world, ...); receives its reduced shard."""
+        import jax
         import jax.numpy as jnp
 
         array = jnp.asarray(array)
@@ -211,21 +249,72 @@ class XLACollectiveGroup:
         rv = self._rendezvous_for(f"reducescatter-{op}")
 
         def run(slots: Dict[int, Any]) -> List[Any]:
-            stacked = jnp.stack([slots[r] for r in range(self.world_size)])
-            reduced = _host_reduce(stacked, op)  # (world, ...)
-            return [reduced[i] for i in range(self.world_size)]
+            inputs = [slots[r] for r in range(self.world_size)]
+            mesh = self.mesh()
+            if mesh is None or op == ReduceOp.PRODUCT:
+                stacked = jnp.stack(inputs)
+                reduced = _host_reduce(stacked, op)  # (world, ...)
+                return [reduced[i] for i in range(self.world_size)]
+            key = ("reducescatter", op, inputs[0].shape, str(inputs[0].dtype))
+
+            def build():
+                from jax import lax, shard_map
+                from jax.sharding import PartitionSpec as P
+
+                def body(x):
+                    # x: (1, world, *shape) — this rank's full contribution.
+                    y = x[0]
+                    if op == ReduceOp.SUM:
+                        return lax.psum_scatter(
+                            y, "ranks", scatter_dimension=0, tiled=True)
+                    # No pmax/pmin-scatter primitive: reduce then keep our row.
+                    reduced = _lax_reduce(y, op, "ranks")
+                    idx = lax.axis_index("ranks")
+                    return lax.dynamic_slice_in_dim(reduced, idx, 1, axis=0)
+
+                return jax.jit(shard_map(
+                    body, mesh=mesh, in_specs=P("ranks"), out_specs=P("ranks")))
+
+            fn = self._get_compiled(key, build)
+            out = fn(self._mesh_put(jnp.stack(inputs)))  # (world, *shape)
+            return [out[i] for i in range(self.world_size)]
 
         results = rv.contribute(rank, array, run, on_timeout=self._on_rv_timeout)
         return results[rank]
 
     def broadcast(self, rank: int, array: Any, src_rank: int = 0) -> Any:
+        import jax
         import jax.numpy as jnp
 
         array = jnp.asarray(array)
         rv = self._rendezvous_for(f"broadcast-{src_rank}")
 
         def run(slots: Dict[int, Any]) -> List[Any]:
-            return [slots[src_rank]] * self.world_size
+            mesh = self.mesh()
+            if mesh is None:
+                return [slots[src_rank]] * self.world_size
+            inputs = [slots[r] for r in range(self.world_size)]
+            key = ("broadcast", src_rank, inputs[0].shape, str(inputs[0].dtype))
+
+            def build():
+                from jax import lax, shard_map
+                from jax.sharding import PartitionSpec as P
+
+                def body(x):
+                    # Mask all but src's block, then psum — the select+psum
+                    # lowering of broadcast (one ICI reduction, replicated out).
+                    idx = lax.axis_index("ranks")
+                    contrib = jnp.where(idx == src_rank, x, jnp.zeros_like(x))
+                    return lax.psum(contrib, "ranks")
+
+                # check_vma=False: psum output is replicated by construction.
+                return jax.jit(shard_map(
+                    body, mesh=mesh, in_specs=P("ranks"), out_specs=P(),
+                    check_vma=False))
+
+            fn = self._get_compiled(key, build)
+            out = fn(self._mesh_put(jnp.stack(inputs)))  # (1, *shape) replicated
+            return [out[0]] * self.world_size
 
         results = rv.contribute(rank, array, run, on_timeout=self._on_rv_timeout)
         return results[rank]
@@ -253,11 +342,36 @@ class XLACollectiveGroup:
         rv = self._rendezvous_for(f"sendrecv-{tuple(perm)}", n_participants=len(participants))
 
         def run(slots: Dict[int, Any]) -> Dict[int, Any]:
+            import jax
+
             template = next(iter(slots.values()))
-            out = {r: jnp.zeros_like(template) for r in participants}
-            for src, dst in perm:
-                out[dst] = slots[src]
-            return out
+            mesh = self.mesh()
+            if mesh is None:
+                out = {r: jnp.zeros_like(template) for r in participants}
+                for src, dst in perm:
+                    out[dst] = slots[src]
+                return out
+            # Non-participants contribute zeros; ppermute's non-receivers get
+            # zeros back, matching the host-path semantics.
+            inputs = [slots.get(r, jnp.zeros_like(template))
+                      for r in range(self.world_size)]
+            key = ("sendrecv", tuple(perm), template.shape, str(template.dtype))
+
+            def build():
+                from jax import lax, shard_map
+                from jax.sharding import PartitionSpec as P
+
+                def body(x):
+                    # The promised single collective-permute program: blocks
+                    # move src->dst along the ring in one compiled op.
+                    return lax.ppermute(x, "ranks", perm)
+
+                return jax.jit(shard_map(
+                    body, mesh=mesh, in_specs=P("ranks"), out_specs=P("ranks")))
+
+            fn = self._get_compiled(key, build)
+            out = fn(self._mesh_put(jnp.stack(inputs)))
+            return {r: out[r] for r in participants}
 
         results = rv.contribute(rank, array, run, participants=participants,
                                 on_timeout=self._on_rv_timeout)

@@ -9,41 +9,30 @@ import ray_tpu
 
 
 class ActorPool:
+    """Tasks are dispatched to idle actors; excess submissions queue and
+    drain as actors free up.  Results come back ordered (``get_next``/
+    ``map``) or in completion order (``get_next_unordered``/
+    ``map_unordered``)."""
+
     def __init__(self, actors: Iterable[Any]):
         self._idle: List[Any] = list(actors)
         if not self._idle:
             raise ValueError("ActorPool needs at least one actor")
-        self._future_to_actor: dict = {}
-        self._pending: List[tuple] = []  # (fn, value) waiting for an actor
-        self._unordered_results: List[Any] = []
+        self._future_to_actor: dict = {}   # ref -> (index, actor)
+        self._index_to_future: dict = {}   # submission index -> ref
+        self._pending: List[tuple] = []    # (fn, value) waiting for an actor
+        self._next_task_index = 0
+        self._next_return_index = 0
 
     # ------------------------------------------------------------ map APIs
     def map(self, fn: Callable[[Any, Any], Any], values: Iterable[Any]):
-        """Ordered results; `fn(actor, value)` returns an ObjectRef."""
-        refs = []
-        values = list(values)
-        submitted = 0
-        # Prime every idle actor, then pipeline: wait for the oldest ref
-        # before submitting the next value to its actor.
-        inflight: List[tuple] = []  # (ref, actor)
+        """Lazily yield ordered results; `fn(actor, value)` returns an
+        ObjectRef.  Results stream as the pipeline drains — nothing is
+        eagerly ray_tpu.get()'d up front."""
         for v in values:
-            if self._idle:
-                actor = self._idle.pop()
-                inflight.append((fn(actor, v), actor))
-                submitted += 1
-            else:
-                break
-        next_i = submitted
-        results = []
-        while inflight:
-            ref, actor = inflight.pop(0)
-            results.append(ray_tpu.get(ref))
-            if next_i < len(values):
-                inflight.append((fn(actor, values[next_i]), actor))
-                next_i += 1
-            else:
-                self._idle.append(actor)
-        return iter(results)
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
 
     def map_unordered(self, fn: Callable[[Any, Any], Any],
                       values: Iterable[Any]):
@@ -56,44 +45,74 @@ class ActorPool:
     # ------------------------------------------------------- submit/get APIs
     def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
         if self._idle:
-            actor = self._idle.pop()
-            ref = fn(actor, value)
-            self._future_to_actor[ref] = (fn, actor)
+            self._dispatch(fn, self._idle.pop(), value)
         else:
             self._pending.append((fn, value))
 
+    def _dispatch(self, fn, actor, value) -> None:
+        ref = fn(actor, value)
+        i = self._next_task_index
+        self._next_task_index += 1
+        self._future_to_actor[ref] = (i, actor)
+        self._index_to_future[i] = ref
+
+    def _free(self, actor) -> None:
+        if self._pending:
+            fn, value = self._pending.pop(0)
+            self._dispatch(fn, actor, value)
+        else:
+            self._idle.append(actor)
+
     def has_next(self) -> bool:
-        return bool(self._future_to_actor or self._pending
-                    or self._unordered_results)
+        return bool(self._index_to_future or self._pending)
+
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Next result in submission order (ref: ActorPool.get_next)."""
+        # Skip indices already consumed by get_next_unordered.
+        while (self._next_return_index < self._next_task_index
+               and self._next_return_index not in self._index_to_future):
+            self._next_return_index += 1
+        if self._next_return_index not in self._index_to_future:
+            if self._pending:
+                # Tasks queued but nothing in flight and no idle actor to
+                # dispatch to (actors were pop_idle()'d away) — deadlock,
+                # not end-of-stream.
+                raise RuntimeError(
+                    f"{len(self._pending)} submitted task(s) can never run: "
+                    "the pool has no in-flight work and no idle actors")
+            raise StopIteration("no pending results")
+        ref = self._index_to_future[self._next_return_index]
+        ready, _ = ray_tpu.wait([ref], num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next timed out")
+        del self._index_to_future[self._next_return_index]
+        self._next_return_index += 1
+        _, actor = self._future_to_actor.pop(ref)
+        result = ray_tpu.get(ref)
+        self._free(actor)
+        return result
 
     def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
-        if self._unordered_results:
-            return self._unordered_results.pop(0)
         if not self._future_to_actor:
+            if self._pending:
+                raise RuntimeError(
+                    f"{len(self._pending)} submitted task(s) can never run: "
+                    "the pool has no in-flight work and no idle actors")
             raise StopIteration("no pending results")
         ready, _ = ray_tpu.wait(list(self._future_to_actor),
                                 num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("get_next_unordered timed out")
         ref = ready[0]
-        fn, actor = self._future_to_actor.pop(ref)
+        i, actor = self._future_to_actor.pop(ref)
+        del self._index_to_future[i]
         result = ray_tpu.get(ref)
-        if self._pending:
-            next_fn, value = self._pending.pop(0)
-            new_ref = next_fn(actor, value)
-            self._future_to_actor[new_ref] = (next_fn, actor)
-        else:
-            self._idle.append(actor)
+        self._free(actor)
         return result
 
     def push(self, actor: Any) -> None:
         """Add an actor to the pool (ref: ActorPool.push)."""
-        if self._pending:
-            fn, value = self._pending.pop(0)
-            ref = fn(actor, value)
-            self._future_to_actor[ref] = (fn, actor)
-        else:
-            self._idle.append(actor)
+        self._free(actor)
 
     def pop_idle(self) -> Optional[Any]:
         return self._idle.pop() if self._idle else None
