@@ -1,0 +1,184 @@
+"""Worker-side runtime proxy: the full ray_tpu API from inside a process
+worker.
+
+TPU-native analogue of the reference's nested-task support: every worker
+process embeds a core worker that can submit tasks back to the cluster
+(ref: src/ray/core_worker/core_worker.h:166 — task submission from any
+worker; python/ray/util/client/ — the proxy pattern).  Here the child
+process installs a ``ClientRuntime`` as its global runtime; API calls
+(`remote`/`get`/`put`/`wait`/actor ops) become request/response messages
+over a dedicated backchannel pipe to the driver, which executes them
+against the real Runtime.
+
+One request is in flight per worker at a time (child-side lock); the driver
+services each worker's backchannel on its own daemon thread, so a child
+blocking in ``get`` never wedges the driver's dispatcher.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional, Sequence
+
+from ray_tpu._private import serialization
+
+
+class ClientRuntime:
+    """Installed as the global runtime inside process workers."""
+
+    def __init__(self, conn, worker_id: str = "", namespace: str = "default"):
+        self._conn = conn
+        self._lock = threading.Lock()
+        self.worker_id = worker_id or "proc-worker"
+        self.namespace = namespace
+
+    # ------------------------------------------------------------- transport
+    def _call(self, kind: str, *payload) -> Any:
+        req = serialization.dumps((kind, payload))
+        with self._lock:
+            self._conn.send_bytes(req)
+            status, blob = serialization.loads(self._conn.recv_bytes())
+        if status == "err":
+            exc, tb = serialization.loads(blob)
+            raise exc
+        return serialization.deserialize_flat(memoryview(blob))
+
+    # ------------------------------------------------------------ public API
+    def submit_task(self, spec) -> Any:
+        if spec.generator:
+            raise NotImplementedError(
+                "streaming-generator tasks cannot be submitted from inside a "
+                "process worker yet; submit from the driver")
+        return self._call("submit_task", serialization.dumps(spec))
+
+    def submit_actor_task(self, actor_id, spec) -> Any:
+        if spec.generator:
+            raise NotImplementedError(
+                "streaming-generator actor tasks cannot be submitted from "
+                "inside a process worker yet")
+        return self._call("submit_actor_task", actor_id,
+                          serialization.dumps(spec))
+
+    def create_actor(self, spec) -> None:
+        return self._call("create_actor", serialization.dumps(spec))
+
+    def put(self, value: Any, _owner: str = "") -> Any:
+        return self._call("put", serialization.dumps(value))
+
+    def get(self, refs: Any, timeout: Optional[float] = None) -> Any:
+        return self._call("get", serialization.dumps(refs), timeout)
+
+    def wait(self, refs: Sequence, num_returns: int = 1,
+             timeout: Optional[float] = None, fetch_local: bool = True):
+        refs = list(refs)
+        ready_idx, rest_idx = self._call(
+            "wait", serialization.dumps(refs), num_returns, timeout)
+        return [refs[i] for i in ready_idx], [refs[i] for i in rest_idx]
+
+    def kill_actor(self, actor_id, no_restart: bool = True) -> None:
+        return self._call("kill_actor", actor_id, no_restart)
+
+    def cancel(self, ref, force: bool = False) -> None:
+        return self._call("cancel", serialization.dumps(ref), force)
+
+    def get_named_actor(self, name: str, namespace: Optional[str] = None):
+        return self._call("get_named_actor", name, namespace)
+
+    def get_actor_state(self, actor_id):
+        # Worker-side callers (ray_tpu.get_actor) need .spec.cls and
+        # .spec.max_task_retries plus .state — return a lightweight shim.
+        cls, max_task_retries, state_name = self._call("actor_info", actor_id)
+
+        class _Spec:
+            pass
+
+        class _State:
+            pass
+
+        spec = _Spec()
+        spec.cls = cls
+        spec.max_task_retries = max_task_retries
+        shim = _State()
+        shim.spec = spec
+        shim.state = state_name
+        return shim
+
+    def shutdown(self) -> None:  # the driver owns lifecycle
+        pass
+
+
+def serve_backchannel(conn, describe: str = "") -> None:
+    """Driver-side loop: service one worker's nested-API requests.
+
+    Runs on a daemon thread per worker; exits when the worker's pipe closes.
+    """
+    from ray_tpu._private.runtime import runtime_or_none
+
+    # Refs handed to the child are BORROWED: the driver must keep them alive
+    # or the refcounter frees results the moment the reply tuple is GC'd
+    # (ref: reference_count.h borrower protocol — here the borrow lives until
+    # the worker disconnects, which clears this dict).
+    borrowed: dict = {}
+    while True:
+        try:
+            msg = conn.recv_bytes()
+        except (EOFError, OSError):
+            return
+        try:
+            kind, payload = serialization.loads(msg)
+            runtime = runtime_or_none()
+            if runtime is None:
+                raise RuntimeError(
+                    "driver runtime is gone; nested call cannot be served")
+            result = _handle(runtime, kind, payload)
+            sobj = serialization.serialize(result)
+            for r in sobj.contained_refs:
+                borrowed[r.id] = r
+            reply = ("ok", sobj.to_bytes())
+        except BaseException as e:  # noqa: BLE001 — errors cross the boundary
+            import traceback
+
+            tb = traceback.format_exc()
+            try:
+                blob = serialization.dumps((e, tb))
+            except Exception:
+                blob = serialization.dumps((RuntimeError(repr(e)), tb))
+            reply = ("err", blob)
+        try:
+            conn.send_bytes(serialization.dumps(reply))
+        except (EOFError, OSError, BrokenPipeError):
+            return
+
+
+def _handle(runtime, kind: str, payload: tuple) -> Any:
+    if kind == "submit_task":
+        return runtime.submit_task(serialization.loads(payload[0]))
+    if kind == "submit_actor_task":
+        return runtime.submit_actor_task(payload[0],
+                                         serialization.loads(payload[1]))
+    if kind == "create_actor":
+        return runtime.create_actor(serialization.loads(payload[0]))
+    if kind == "put":
+        return runtime.put(serialization.loads(payload[0]))
+    if kind == "get":
+        return runtime.get(serialization.loads(payload[0]), timeout=payload[1])
+    if kind == "wait":
+        refs = serialization.loads(payload[0])
+        ready, rest = runtime.wait(refs, num_returns=payload[1],
+                                   timeout=payload[2])
+        ready_ids = {r.id for r in ready}
+        ready_idx = [i for i, r in enumerate(refs) if r.id in ready_ids]
+        rest_idx = [i for i, r in enumerate(refs) if r.id not in ready_ids]
+        return ready_idx, rest_idx
+    if kind == "kill_actor":
+        return runtime.kill_actor(payload[0], no_restart=payload[1])
+    if kind == "cancel":
+        return runtime.cancel(serialization.loads(payload[0]), force=payload[1])
+    if kind == "get_named_actor":
+        return runtime.get_named_actor(payload[0], payload[1])
+    if kind == "actor_info":
+        state = runtime.get_actor_state(payload[0])
+        if state is None:
+            raise ValueError(f"unknown actor {payload[0]}")
+        return state.spec.cls, state.spec.max_task_retries, state.state
+    raise ValueError(f"unknown nested-API request: {kind!r}")

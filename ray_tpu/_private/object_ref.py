@@ -29,6 +29,15 @@ class ObjectRef:
     def _deserialize(object_id: str, owner: str) -> "ObjectRef":
         return ObjectRef(ObjectID(object_id), owner)
 
+    def __reduce__(self):
+        # EVERY pickle path must reconstruct through _deserialize (which
+        # registers a refcount): the default slot-state protocol would build
+        # a clone that never add()s but whose __del__ remove()s — each trip
+        # through plain pickle would leak a negative count and free live
+        # objects.  (serialization._Pickler additionally captures the ref
+        # for borrow tracking via reducer_override.)
+        return (ObjectRef._deserialize, (str(self.id), self.owner))
+
     def hex(self) -> str:
         return self.id.hex()
 

@@ -83,11 +83,53 @@ def _spec_cleanup(arena, spec) -> None:
         pass
 
 
-def _worker_main(conn, arena_path: Optional[str]) -> None:
+def _actor_task_context(actor_id):
+    """Worker-side actor-scoped context manager so exit_actor() and
+    get_runtime_context() work inside process-isolated actor methods."""
+    from contextlib import contextmanager
+
+    @contextmanager
+    def cm():
+        from ray_tpu._private.ids import TaskID
+        from ray_tpu._private.runtime import TaskContext, _task_ctx
+
+        _task_ctx.ctx = TaskContext(TaskID.from_random(), actor_id)
+        try:
+            yield
+        finally:
+            _task_ctx.ctx = None
+
+    return cm()
+
+
+def _worker_main(conn, arena_path: Optional[str], back_conn=None) -> None:
     # Keep workers off the TPU: the driver process owns the chips.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     fn_cache: Dict[str, Any] = {}
+    actor_instance: List[Any] = [None]  # box: set by actor_new
     arena = _attach_arena(arena_path)
+    if back_conn is not None:
+        # Nested-API support: install the proxy runtime so user code in this
+        # worker can call ray_tpu.remote/get/put/wait (client_runtime.py).
+        from ray_tpu._private.client_runtime import ClientRuntime
+        from ray_tpu._private.runtime import install_runtime
+
+        install_runtime(ClientRuntime(
+            back_conn, worker_id=f"proc-worker-{os.getpid()}"))
+
+    def reply_ok(seq, payload):
+        conn.send_bytes(serialization.dumps(("ok", seq, payload)))
+
+    def reply_err(seq, e):
+        import traceback
+
+        tb = traceback.format_exc()
+        try:
+            blob = serialization.dumps((e, tb))
+        except Exception:
+            blob = serialization.dumps((RuntimeError(repr(e)), tb))
+        conn.send_bytes(serialization.dumps(("err", seq, blob)))
+
     while True:
         try:
             msg = conn.recv_bytes()
@@ -103,10 +145,9 @@ def _worker_main(conn, arena_path: Optional[str]) -> None:
                 from ray_tpu._private.runtime_env import apply_in_worker
 
                 apply_in_worker(req[1])
-                conn.send_bytes(serialization.dumps(("ok", 0, None)))
+                reply_ok(0, None)
             except BaseException as e:  # noqa: BLE001
-                conn.send_bytes(serialization.dumps(
-                    ("err", 0, serialization.dumps((e, repr(e))))))
+                reply_err(0, e)
         elif kind == "exec":
             _, seq, fn_id, fn_bytes, args_spec = req
             try:
@@ -117,17 +158,41 @@ def _worker_main(conn, arena_path: Optional[str]) -> None:
                 args, kwargs = serialization.deserialize_flat(memoryview(flat_args))
                 result = fn(*args, **kwargs)
                 payload = serialization.serialize(result).to_bytes()
-                spec = _spec_put(arena, f"res:{os.getpid()}:{seq}", payload)
-                conn.send_bytes(serialization.dumps(("ok", seq, spec)))
+                reply_ok(seq, _spec_put(arena, f"res:{os.getpid()}:{seq}", payload))
             except BaseException as e:  # noqa: BLE001 — errors cross the boundary
-                import traceback
-
-                tb = traceback.format_exc()
-                try:
-                    blob = serialization.dumps((e, tb))
-                except Exception:
-                    blob = serialization.dumps((RuntimeError(repr(e)), tb))
-                conn.send_bytes(serialization.dumps(("err", seq, blob)))
+                reply_err(seq, e)
+        elif kind == "actor_new":
+            # This worker becomes a dedicated actor host: instantiate the
+            # class and hold it for the worker's lifetime (ref: the reference
+            # runs every actor in its own worker process by default).
+            _, seq, cls_bytes, actor_id, args_spec = req
+            try:
+                cls = serialization.loads(cls_bytes)
+                flat_args = _spec_take(arena, args_spec)
+                args, kwargs = serialization.deserialize_flat(memoryview(flat_args))
+                with _actor_task_context(actor_id):
+                    actor_instance[0] = cls(*args, **kwargs)
+                actor_instance.append(actor_id)
+                reply_ok(seq, None)
+            except BaseException as e:  # noqa: BLE001
+                reply_err(seq, e)
+        elif kind == "actor_call":
+            _, seq, method_name, args_spec = req
+            try:
+                if actor_instance[0] is None:
+                    raise RuntimeError("actor_call before actor_new")
+                method = getattr(actor_instance[0], method_name)
+                flat_args = _spec_take(arena, args_spec)
+                args, kwargs = serialization.deserialize_flat(memoryview(flat_args))
+                # Run under an actor-scoped task context so exit_actor() and
+                # get_runtime_context() work inside the method; _ActorExit
+                # crosses back via reply_err and is unwrapped driver-side.
+                with _actor_task_context(actor_instance[1]):
+                    result = method(*args, **kwargs)
+                payload = serialization.serialize(result).to_bytes()
+                reply_ok(seq, _spec_put(arena, f"res:{os.getpid()}:{seq}", payload))
+            except BaseException as e:  # noqa: BLE001
+                reply_err(seq, e)
         elif kind == "shutdown":
             return
 
@@ -152,8 +217,13 @@ class _ProcWorker:
 
         ctx = mp.get_context("spawn")
         self.conn, child_conn = ctx.Pipe()
+        # Second pipe: the worker-initiated nested-API backchannel, serviced
+        # by a dedicated driver thread (client_runtime.serve_backchannel) so
+        # a child blocking in get() is independent of this request pipe.
+        back_parent, back_child = ctx.Pipe()
         self.proc = ctx.Process(
-            target=_worker_main, args=(child_conn, arena_path), daemon=True)
+            target=_worker_main, args=(child_conn, arena_path, back_child),
+            daemon=True)
         # Drivers run from a pipe/heredoc have __main__.__file__ == "<stdin>";
         # spawn's prepare step would try to re-execute that path in the child
         # and crash it.  Mask the pseudo-file for the duration of start().
@@ -168,10 +238,23 @@ class _ProcWorker:
             if masked:
                 main_mod.__file__ = main_file
         child_conn.close()
+        back_child.close()
+        from ray_tpu._private.client_runtime import serve_backchannel
+
+        self._back_thread = threading.Thread(
+            target=serve_backchannel, args=(back_parent,),
+            name=f"backchannel-{self.proc.pid}", daemon=True)
+        self._back_thread.start()
         self._arena = arena  # the pool's shared driver-side client
+        import itertools
+
+        self._seq_counter = itertools.count(1)  # GIL-atomic next()
         self.seq = 0
         self.sent_fns: set = set()
         self.last_used = time.monotonic()
+        # One request in flight per worker: actor mailboxes may run with
+        # max_concurrency > 1 but the pipe protocol is strictly serial.
+        self._req_lock = threading.Lock()
         if env_payload is not None:
             from ray_tpu.exceptions import TaskError
 
@@ -183,21 +266,27 @@ class _ProcWorker:
                 self.kill()
                 raise TaskError(exc, tb=tb)
 
-    def execute(self, fn_id: str, fn_bytes: bytes, args: tuple, kwargs: dict) -> Any:
-        """Run one task; raises WorkerCrashedError if the process dies."""
+    def _roundtrip(self, kind: str, header_rest: tuple, args: tuple,
+                   kwargs: dict, has_result: bool = True) -> Any:
+        """Ship one request ((kind, seq, *header_rest) + serialized args),
+        await the reply.  The seq is allocated here so the crash-path
+        cleanup below always names THIS request's result key, not another
+        thread's (the request itself is serialized by _req_lock).
+
+        Raises WorkerCrashedError if the process dies, TaskError on a
+        worker-side exception."""
         from ray_tpu.exceptions import TaskError, WorkerCrashedError
 
-        self.seq += 1
         arena = self._arena
+        seq = next(self._seq_counter)  # GIL-atomic
+        self.seq = seq
         flat_args = serialization.serialize((args, kwargs)).to_bytes()
         args_spec = _spec_put(arena, _next_handoff_key("args"), flat_args)
-        send_fn = fn_bytes if fn_id not in self.sent_fns else None
-        self.conn.send_bytes(
-            serialization.dumps(("exec", self.seq, fn_id, send_fn, args_spec))
-        )
-        self.sent_fns.add(fn_id)
+        header = (kind, seq) + header_rest
         try:
-            reply = serialization.loads(self.conn.recv_bytes())
+            with self._req_lock:
+                self.conn.send_bytes(serialization.dumps(header + (args_spec,)))
+                reply = serialization.loads(self.conn.recv_bytes())
         except (EOFError, OSError) as e:
             # Worker died. Reclaim the args if unconsumed, and the result
             # object if the worker got far enough to produce one before
@@ -205,17 +294,42 @@ class _ProcWorker:
             # sealed-but-unreported result would otherwise pin arena memory
             # forever (refcount 1 blocks LRU eviction).
             _spec_cleanup(arena, args_spec)
-            _spec_cleanup(arena, ("plasma", f"res:{self.proc.pid}:{self.seq}"))
+            _spec_cleanup(arena, ("plasma", f"res:{self.proc.pid}:{seq}"))
             raise WorkerCrashedError(f"process worker died: {e}") from e
-        kind, seq, payload = reply
+        rkind, _seq, payload = reply
         self.last_used = time.monotonic()
-        if kind == "ok":
+        if rkind == "ok":
             # The worker reached the result, so it consumed the args spec.
-            return serialization.deserialize_flat(memoryview(_spec_take(arena, payload)))
+            if not has_result or payload is None:
+                return None
+            return serialization.deserialize_flat(
+                memoryview(_spec_take(arena, payload)))
         # Error may have struck before the worker consumed the args.
         _spec_cleanup(arena, args_spec)
         exc, tb = serialization.loads(payload)
+        from ray_tpu._private.runtime import _ActorExit
+
+        if isinstance(exc, _ActorExit):
+            # exit_actor() inside a process actor: re-raise unwrapped so the
+            # runtime's actor FSM sees it (runtime.py _execute_actor_task).
+            raise exc
         raise TaskError(exc, tb=tb)
+
+    def execute(self, fn_id: str, fn_bytes: bytes, args: tuple, kwargs: dict) -> Any:
+        """Run one task; raises WorkerCrashedError if the process dies."""
+        send_fn = fn_bytes if fn_id not in self.sent_fns else None
+        self.sent_fns.add(fn_id)
+        return self._roundtrip("exec", (fn_id, send_fn), args, kwargs)
+
+    def actor_new(self, cls_bytes: bytes, actor_id: str, args: tuple,
+                  kwargs: dict) -> None:
+        """Instantiate an actor in this worker (dedicates the worker)."""
+        self._roundtrip("actor_new", (cls_bytes, actor_id), args, kwargs,
+                        has_result=False)
+
+    def actor_call(self, method_name: str, args: tuple, kwargs: dict) -> Any:
+        """Invoke a method on the worker-resident actor instance."""
+        return self._roundtrip("actor_call", (method_name,), args, kwargs)
 
     def alive(self) -> bool:
         return self.proc.is_alive()

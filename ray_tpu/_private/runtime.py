@@ -151,7 +151,9 @@ class _ActorState:
             for m in dir(spec.cls)
             if not m.startswith("__") or m == "__call__"
         )
-        self.proc_worker = None  # process-isolated actors (later rounds)
+        #: Dedicated process worker hosting the instance when
+        #: isolation="process" or a runtime_env is set (see _start_actor).
+        self.proc_worker = None
 
 
 class Runtime:
@@ -648,15 +650,42 @@ class Runtime:
             state.ready_event.set()
             return
         state.node_id, state.release = node_id, release
+        use_process = spec.isolation == "process" or bool(
+            getattr(spec, "runtime_env", None))
         try:
             args, kwargs = self._resolve_values(spec.args, spec.kwargs)
-            # __init__ runs with an actor-scoped context so code inside it
-            # (e.g. collective rank binding) can see the actor identity.
-            _task_ctx.ctx = TaskContext(TaskID.from_random(), spec.actor_id)
-            try:
-                state.instance = spec.cls(*args, **kwargs)
-            finally:
-                _task_ctx.ctx = None
+            if use_process:
+                if state.is_async:
+                    raise ValueError(
+                        "async actors cannot use isolation='process'")
+                # Dedicated worker process hosting the instance (the
+                # reference's default: one worker process per actor —
+                # gcs_actor_scheduler.h leases a worker for creation).
+                env_key, env_payload = "", None
+                if spec.runtime_env:
+                    from ray_tpu._private.runtime_env import (
+                        RuntimeEnv, payload_key)
+
+                    env = RuntimeEnv.normalize(spec.runtime_env)
+                    env_payload = env.stage()
+                    env_key = payload_key(env_payload)
+                worker = self.process_pool.lease(env_key, env_payload)
+                try:
+                    worker.actor_new(serialization.dumps(spec.cls),
+                                     spec.actor_id, args, kwargs)
+                except BaseException:
+                    self.process_pool.discard(worker)
+                    raise
+                state.proc_worker = worker
+            else:
+                # __init__ runs with an actor-scoped context so code inside
+                # it (e.g. collective rank binding) can see the actor
+                # identity.
+                _task_ctx.ctx = TaskContext(TaskID.from_random(), spec.actor_id)
+                try:
+                    state.instance = spec.cls(*args, **kwargs)
+                finally:
+                    _task_ctx.ctx = None
         except BaseException as e:  # noqa: BLE001
             release()
             state.death_cause = TaskError(e, task_repr=f"{spec.cls.__name__}.__init__")
@@ -694,7 +723,11 @@ class Runtime:
             if item is None:
                 return
             spec: TaskSpec = item
-            if state.state == _ActorState.DEAD:
+            if state.state == _ActorState.RESTARTING:
+                # Wait out the restart instead of calling into a torn-down
+                # instance (ready_event is set on ALIVE or DEAD).
+                state.ready_event.wait(timeout=300)
+            if state.state != _ActorState.ALIVE:
                 self._fail_task(spec, ActorDiedError(cause=state.death_cause), retry=False)
                 continue
             self._execute_actor_task(state, spec)
@@ -713,7 +746,10 @@ class Runtime:
                 item = await loop.run_in_executor(None, state.mailbox.get)
                 if item is None:
                     return
-                if state.state == _ActorState.DEAD:
+                if state.state == _ActorState.RESTARTING:
+                    await loop.run_in_executor(
+                        None, state.ready_event.wait, 300)
+                if state.state != _ActorState.ALIVE:
                     self._fail_task(item, ActorDiedError(cause=state.death_cause), retry=False)
                     continue
                 loop.create_task(run_one(item))
@@ -730,11 +766,19 @@ class Runtime:
         self._emit_event(spec.task_id, spec.name, "RUNNING")
         from ray_tpu.util import tracing
 
+        worker = state.proc_worker
         try:
             with tracing.task_execute_span(spec):
                 args, kwargs = self._resolve_args(spec)
-                method = getattr(state.instance, spec.method_name)
-                if spec.generator:
+                if worker is not None:
+                    if spec.generator:
+                        raise NotImplementedError(
+                            "generator methods are not supported on "
+                            "process-isolated actors yet")
+                    result = worker.actor_call(
+                        spec.method_name, args, kwargs)
+                elif spec.generator:
+                    method = getattr(state.instance, spec.method_name)
                     saved, spec.func = spec.func, method
                     try:
                         self._run_generator(spec, args, kwargs)
@@ -742,6 +786,7 @@ class Runtime:
                         spec.func = saved
                     result = None
                 else:
+                    method = getattr(state.instance, spec.method_name)
                     result = method(*args, **kwargs)
             if not spec.generator:
                 self._store_results(spec, result)
@@ -749,6 +794,19 @@ class Runtime:
         except _ActorExit as e:
             self._store_results(spec, None)
             self._kill_actor_state(state, ActorDiedError("exit_actor() was called"), no_restart=True)
+        except WorkerCrashedError as e:
+            # The actor's host process died: fail this call and run the
+            # restart FSM (ref: gcs_actor_manager.h actor restart on worker
+            # death; max_restarts honored by _kill_actor_state).  Only the
+            # thread whose crash matches the CURRENT worker triggers the
+            # restart — with max_concurrency > 1, later threads observing the
+            # same crash must not discard the freshly restarted worker and
+            # burn an extra restart.
+            self._fail_task(spec, ActorDiedError(cause=e), retry=False)
+            if state.proc_worker is worker:
+                self._kill_actor_state(
+                    state, ActorDiedError(f"actor worker process died: {e}"),
+                    no_restart=False)
         except BaseException as e:  # noqa: BLE001
             self._fail_task(spec, TaskError(e, task_repr=spec.name), retry=False)
         finally:
@@ -821,6 +879,9 @@ class Runtime:
                 state.release()
                 state.release = None
             state.instance = None
+            if state.proc_worker is not None:
+                self.process_pool.discard(state.proc_worker)
+                state.proc_worker = None
             if can_restart:
                 state.state = _ActorState.RESTARTING
                 state.num_restarts += 1
@@ -877,6 +938,9 @@ class Runtime:
             actors = list(self._actors.values())
         for state in actors:
             state.state = _ActorState.DEAD
+            if state.proc_worker is not None:
+                state.proc_worker.kill()
+                state.proc_worker = None
             for _ in state.threads or [None]:
                 state.mailbox.put(None)
         self.process_pool.shutdown()
@@ -897,6 +961,14 @@ def get_runtime() -> Runtime:
 
 def runtime_or_none() -> Optional[Runtime]:
     return _runtime
+
+
+def install_runtime(rt) -> None:
+    """Install a runtime implementation (process workers install their
+    ClientRuntime proxy here so the full API works in the child)."""
+    global _runtime
+    with _runtime_lock:
+        _runtime = rt
 
 
 def init_runtime(**kwargs) -> Runtime:

@@ -75,7 +75,7 @@ class ActorSpec:
     __slots__ = (
         "actor_id", "name", "namespace", "cls", "args", "kwargs", "resources",
         "strategy", "max_restarts", "max_task_retries", "max_concurrency",
-        "isolation", "lifetime", "concurrency_groups",
+        "isolation", "lifetime", "concurrency_groups", "runtime_env",
     )
 
     def __init__(
@@ -94,6 +94,7 @@ class ActorSpec:
         isolation: str,
         lifetime: Optional[str],
         concurrency_groups: Optional[Dict[str, int]] = None,
+        runtime_env: Optional[dict] = None,
     ):
         self.actor_id = actor_id
         self.name = name
@@ -109,3 +110,4 @@ class ActorSpec:
         self.isolation = isolation
         self.lifetime = lifetime
         self.concurrency_groups = concurrency_groups or {}
+        self.runtime_env = runtime_env

@@ -126,13 +126,18 @@ class ActorClass:
     def _remote(self, args, kwargs, **options) -> ActorHandle:
         runtime = get_runtime()
         opts = resolve_task_options(options, is_actor=True)
-        if opts.get("runtime_env"):
-            # Actors execute on in-driver threads this round; a runtime env
-            # needs a dedicated worker process. Loud beats silently dropping.
-            raise NotImplementedError(
-                "runtime_env on actors is not supported yet (actors run "
-                "in-process); use it on tasks, or isolate the actor's work "
-                "in tasks with options(runtime_env=...)")
+        if opts["isolation"] == "process" or opts.get("runtime_env"):
+            has_async = any(
+                inspect.iscoroutinefunction(getattr(self._cls, m, None))
+                for m in dir(self._cls)
+                if not m.startswith("__") or m == "__call__")
+            if has_async:
+                # Fail at creation, not as an opaque ActorDiedError on the
+                # first method call from the background start thread.
+                raise ValueError(
+                    "async actors cannot use isolation='process' or a "
+                    "runtime_env (the dedicated worker runs methods "
+                    "synchronously)")
         actor_id = ActorID.from_random()
         spec = ActorSpec(
             actor_id=actor_id,
@@ -149,6 +154,7 @@ class ActorClass:
             isolation=opts["isolation"],
             lifetime=opts["lifetime"],
             concurrency_groups=opts.get("concurrency_groups"),
+            runtime_env=opts.get("runtime_env"),
         )
         runtime.create_actor(spec)
         return ActorHandle(actor_id, self._cls, opts["max_task_retries"])

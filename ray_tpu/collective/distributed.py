@@ -1,0 +1,107 @@
+"""Multi-process / multi-host bootstrap — the DCN tier.
+
+The reference spans hosts with NCCL/Gloo process groups bootstrapped through
+a named-actor rendezvous (ref: python/ray/util/collective/collective_group/
+nccl_collective_group.py:40-120 group init; gloo_util.py redis rendezvous).
+The TPU-native equivalent is JAX's multi-controller runtime:
+``jax.distributed.initialize`` joins this process to a coordinator, after
+which ``jax.devices()`` is the GLOBAL device set — meshes built over it span
+hosts, and every collective a jitted program contains (psum/all_gather/...)
+rides ICI within a slice and DCN across slices, scheduled by XLA.
+
+There is no per-op rendezvous in this tier: all processes run the same SPMD
+program (multi-controller), which is the idiomatic JAX scale-out — the
+dynamic rank-call API (xla_group.py) remains for intra-process groups.
+
+Env-driven bootstrap (``auto_initialize``) for trainer workers:
+  RAY_TPU_COORDINATOR   host:port of process 0
+  RAY_TPU_NUM_PROCESSES world size
+  RAY_TPU_PROCESS_ID    this process's rank
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids: Optional[List[int]] = None) -> None:
+    """Join the multi-process runtime.  Must run before any jax backend use.
+
+    On TPU pods the three arguments are inferred from the metadata server
+    (jax.distributed's native path); on CPU/test clusters pass them
+    explicitly."""
+    global _initialized
+    import jax
+
+    if _initialized:
+        return
+    kwargs: dict = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = local_device_ids
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+
+
+def auto_initialize() -> bool:
+    """Initialize from RAY_TPU_COORDINATOR/... env vars if present (the
+    trainer backend's on_start hook calls this on every worker)."""
+    addr = os.environ.get("RAY_TPU_COORDINATOR")
+    if not addr:
+        return False
+    initialize(
+        coordinator_address=addr,
+        num_processes=int(os.environ["RAY_TPU_NUM_PROCESSES"]),
+        process_id=int(os.environ["RAY_TPU_PROCESS_ID"]),
+    )
+    return True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        import jax
+
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def local_batch_to_global(mesh, local_batch: Any, axis: str = "data"):
+    """Assemble a process-local batch shard into a global sharded array.
+
+    Each process feeds its slice of the global batch; the result behaves as
+    one (global_batch, ...) array sharded over ``axis`` (the multi-host
+    input pipeline primitive — ref: the reference's per-worker DataLoader
+    feeding DDP ranks)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sharding = NamedSharding(mesh, PartitionSpec(axis))
+    return jax.make_array_from_process_local_data(sharding, local_batch)

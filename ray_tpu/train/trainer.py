@@ -65,6 +65,12 @@ class TrainWorker:
     def __init__(self, rank: int, world_size: int, group_name: str):
         self.rank = rank
         self.world_size = world_size
+        # Multi-host: join the jax.distributed cluster when the operator set
+        # RAY_TPU_COORDINATOR/... on the worker env (the DCN-tier bootstrap;
+        # ref: train/torch/config.py:66 _setup_torch_process_group).
+        from ray_tpu.collective import distributed
+
+        distributed.auto_initialize()
         collective.init_collective_group(world_size, rank, backend="xla",
                                          group_name=group_name)
 

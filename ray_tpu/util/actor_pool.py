@@ -88,9 +88,10 @@ class ActorPool:
         del self._index_to_future[self._next_return_index]
         self._next_return_index += 1
         _, actor = self._future_to_actor.pop(ref)
-        result = ray_tpu.get(ref)
+        # Free BEFORE get: a raising task must still return its actor to the
+        # pool (ref: Ray's ActorPool does the same).
         self._free(actor)
-        return result
+        return ray_tpu.get(ref)
 
     def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
         if not self._future_to_actor:
@@ -106,9 +107,8 @@ class ActorPool:
         ref = ready[0]
         i, actor = self._future_to_actor.pop(ref)
         del self._index_to_future[i]
-        result = ray_tpu.get(ref)
         self._free(actor)
-        return result
+        return ray_tpu.get(ref)
 
     def push(self, actor: Any) -> None:
         """Add an actor to the pool (ref: ActorPool.push)."""

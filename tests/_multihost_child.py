@@ -1,0 +1,65 @@
+"""Child process for the multi-host (DCN-tier) test: joins a 2-process
+jax.distributed cluster and runs one SPMD train step over the global mesh.
+
+Run with env: COORD, NPROC, RANK, CHILD_DEVICES.  Prints one line:
+  RESULT <rank> <process_count> <global_device_count> <loss>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", int(os.environ.get("CHILD_DEVICES", "2")))
+# Cross-process CPU collectives ride gloo (the CPU stand-in for the DCN tier).
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np  # noqa: E402
+
+from ray_tpu.collective import distributed as dist  # noqa: E402
+
+
+def main() -> None:
+    dist.initialize(
+        coordinator_address=os.environ["COORD"],
+        num_processes=int(os.environ["NPROC"]),
+        process_id=int(os.environ["RANK"]),
+    )
+    assert jax.process_count() == int(os.environ["NPROC"])
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import MeshSpec, make_mesh
+    from ray_tpu.parallel.train_state import create_sharded_state, jit_train_step
+
+    devices = jax.devices()  # GLOBAL devices across both processes
+    spec = MeshSpec(data=len(devices))
+    mesh = make_mesh(spec, devices)
+    config = gpt2.GPTConfig.tiny()
+    opt = gpt2.make_optimizer(learning_rate=1e-3)
+    params, opt_state = create_sharded_state(
+        lambda k: gpt2.init_params(config, k),
+        gpt2.logical_axes(config), mesh, jax.random.key(0), opt)
+    step = jit_train_step(gpt2.make_train_step(config, opt))
+
+    # Each process feeds its own shard of the global batch (deterministic by
+    # rank so the driver test can recompute the same global batch locally).
+    n_local = len(jax.local_devices())
+    rng = np.random.default_rng(dist.process_index())
+    local = rng.integers(0, config.vocab_size,
+                         (n_local, config.seq_len + 1)).astype(np.int32)
+    tokens = dist.local_batch_to_global(mesh, local[:, :-1])
+    targets = dist.local_batch_to_global(mesh, local[:, 1:])
+
+    params, opt_state, loss = step(params, opt_state, tokens, targets)
+    # fully-replicated scalar: identical on every process iff the gradient
+    # psum actually crossed the process boundary.
+    print(f"RESULT {dist.process_index()} {jax.process_count()} "
+          f"{len(devices)} {float(loss):.6f}", flush=True)
+    dist.shutdown()
+
+
+if __name__ == "__main__":
+    main()
