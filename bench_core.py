@@ -1,0 +1,194 @@
+"""Core-runtime microbenchmarks, mirroring the reference's harness
+(ref: python/ray/_private/ray_perf.py:93; published numbers in
+release/perf_metrics/microbenchmark.json, reproduced in BASELINE.md).
+
+Prints one JSON line per metric plus a summary object; writes
+BENCH_CORE.json next to this file.
+
+Usage: python bench_core.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+import ray_tpu
+
+# Reference numbers (m4.16xlarge-class, BASELINE.md) for vs_baseline ratios.
+REFERENCE = {
+    "single_client_tasks_sync": 1010,
+    "single_client_tasks_async": 7963,
+    "1_1_actor_calls_sync": 2072,
+    "1_1_actor_calls_async": 8399,
+    "n_n_actor_calls_async": 27628,
+    "1_1_async_actor_calls_async": 4594,
+    "single_client_put_calls": 4953,
+    "single_client_get_calls": 10642,
+    "single_client_put_gigabytes": 17.0,
+    "placement_group_create_removal": 759,
+}
+
+
+def timeit(name, fn, multiplier=1, duration=2.0):
+    """Run fn repeatedly for ~duration seconds; report ops/s."""
+    # warmup
+    fn()
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < duration:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    rate = count * multiplier / dt
+    ref = REFERENCE.get(name)
+    entry = {
+        "metric": name,
+        "value": round(rate, 1),
+        "unit": "GiB/s" if "gigabytes" in name else "ops/s",
+        "vs_baseline": round(rate / ref, 3) if ref else None,
+    }
+    print(json.dumps(entry), flush=True)
+    return entry
+
+
+@ray_tpu.remote
+def _noop():
+    return None
+
+
+@ray_tpu.remote
+def _noop_arg(x):
+    return None
+
+
+@ray_tpu.remote
+class _Actor:
+    def noop(self):
+        return None
+
+
+@ray_tpu.remote
+class _AsyncActor:
+    async def noop(self):
+        return None
+
+
+def main() -> None:
+    quick = "--quick" in sys.argv[1:]
+    duration = 0.5 if quick else 2.0
+    ray_tpu.init(num_cpus=16)
+    results = []
+
+    batch = 100
+
+    def tasks_sync():
+        ray_tpu.get(_noop.remote())
+
+    results.append(timeit("single_client_tasks_sync", tasks_sync,
+                          duration=duration))
+
+    def tasks_async():
+        ray_tpu.get([_noop.remote() for _ in range(batch)])
+
+    results.append(timeit("single_client_tasks_async", tasks_async,
+                          multiplier=batch, duration=duration))
+
+    a = _Actor.remote()
+    ray_tpu.get(a.noop.remote())
+
+    def actor_sync():
+        ray_tpu.get(a.noop.remote())
+
+    results.append(timeit("1_1_actor_calls_sync", actor_sync,
+                          duration=duration))
+
+    def actor_async():
+        ray_tpu.get([a.noop.remote() for _ in range(batch)])
+
+    results.append(timeit("1_1_actor_calls_async", actor_async,
+                          multiplier=batch, duration=duration))
+
+    n = 4
+    actors = [_Actor.remote() for _ in range(n)]
+    ray_tpu.get([x.noop.remote() for x in actors])
+
+    def nn_actor_async():
+        refs = []
+        for x in actors:
+            refs.extend(x.noop.remote() for _ in range(batch // n))
+        ray_tpu.get(refs)
+
+    results.append(timeit("n_n_actor_calls_async", nn_actor_async,
+                          multiplier=batch, duration=duration))
+
+    aa = _AsyncActor.remote()
+    ray_tpu.get(aa.noop.remote())
+
+    def async_actor_async():
+        ray_tpu.get([aa.noop.remote() for _ in range(batch)])
+
+    results.append(timeit("1_1_async_actor_calls_async", async_actor_async,
+                          multiplier=batch, duration=duration))
+
+    small = np.zeros(8, np.float64)
+
+    def put_calls():
+        for _ in range(10):
+            ray_tpu.put(small)
+
+    results.append(timeit("single_client_put_calls", put_calls,
+                          multiplier=10, duration=duration))
+
+    ref = ray_tpu.put(small)
+
+    def get_calls():
+        for _ in range(10):
+            ray_tpu.get(ref)
+
+    results.append(timeit("single_client_get_calls", get_calls,
+                          multiplier=10, duration=duration))
+
+    big = np.zeros(64 * 1024 * 1024, np.uint8)  # 64 MiB
+
+    def put_gb():
+        r = ray_tpu.put(big)
+        del r
+
+    results.append(timeit("single_client_put_gigabytes", put_gb,
+                          multiplier=64 / 1024, duration=duration))
+
+    from ray_tpu.util.placement_group import placement_group, remove_placement_group
+
+    def pg_cycle():
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        pg.wait(timeout_seconds=5)
+        remove_placement_group(pg)
+
+    results.append(timeit("placement_group_create_removal", pg_cycle,
+                          duration=duration))
+
+    ray_tpu.shutdown()
+
+    summary = {
+        "metric": "core_microbench_geomean_vs_baseline",
+        "value": round(float(np.exp(np.mean([
+            np.log(r["vs_baseline"]) for r in results if r["vs_baseline"]
+        ]))), 3),
+        "unit": "x",
+        "results": {r["metric"]: r["value"] for r in results},
+    }
+    print(json.dumps(summary), flush=True)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_CORE.json"), "w") as f:
+        json.dump({"results": results, "summary": summary}, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

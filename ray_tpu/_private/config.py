@@ -18,6 +18,10 @@ _ENV_PREFIX = "RAY_TPU_"
 
 @dataclass
 class Config:
+    #: Bumped by apply_overrides so config-derived caches invalidate.
+    #: (Not an operator knob; skipped by the env-var scan.)
+    generation: int = 0
+
     # --- object store ---
     #: Objects at or below this size are stored inline in the in-process memory
     #: store and copied between workers (ref: max_direct_call_object_size).
@@ -88,6 +92,8 @@ class Config:
 
     def apply_overrides(self, system_config: Optional[Dict[str, Any]] = None) -> None:
         for f in fields(self):
+            if f.name == "generation":
+                continue
             env = os.environ.get(_ENV_PREFIX + f.name.upper())
             if env is not None:
                 setattr(self, f.name, _coerce(env, f.type))
@@ -95,6 +101,9 @@ class Config:
             if not hasattr(self, key):
                 raise ValueError(f"Unknown system config key: {key}")
             setattr(self, key, val)
+        # Bump so caches keyed on config contents (e.g. RemoteFunction's
+        # resolved options) invalidate.
+        self.generation += 1
 
 
 def _coerce(value: str, typ: Any) -> Any:

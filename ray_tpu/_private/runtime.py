@@ -67,6 +67,9 @@ from ray_tpu.exceptions import (
 _runtime_lock = threading.Lock()
 _runtime: Optional["Runtime"] = None
 
+#: Dispatcher wake token: retry the blocked list (see _notify_resources_freed).
+_RETRY_BLOCKED = object()
+
 _task_ctx = threading.local()
 
 
@@ -156,6 +159,90 @@ class _ActorState:
         self.proc_worker = None
 
 
+class _LeanExecPool:
+    """Futures-free task executor: SimpleQueue dispatch to daemon threads,
+    spawning a new thread only when none is idle (bounded).  Replaces
+    ThreadPoolExecutor on the task hot path — its per-submit Future +
+    semaphore + thread-adjust machinery cost ~75us/task (bench_core
+    single_client_tasks_async); every call site ignores the result anyway."""
+
+    def __init__(self, max_threads: int = 512, name: str = "worker"):
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._max = max_threads
+        self._name = name
+        #: Workers parked in q.get() whose NEXT wake-up has not been claimed
+        #: by a submit.  Every queued item holds exactly one claim (an idle
+        #: permit or a freshly spawned thread), so no item can be stranded —
+        #: a plain "is anyone idle" read could leave one behind when two
+        #: submits race, deadlocking nested tasks.
+        self._idle = 0
+        self._nthreads = 0
+        self._threads: List[threading.Thread] = []
+        self._stopped = False
+        self._lock = threading.Lock()
+
+    def submit(self, fn, *args, **kwargs) -> None:
+        with self._lock:
+            if self._stopped:
+                return
+            if self._idle > 0:
+                self._idle -= 1  # claim a parked worker's next wake
+            elif self._nthreads < self._max:
+                self._nthreads += 1
+                t = threading.Thread(
+                    target=self._run,
+                    name=f"{self._name}-{self._nthreads}",
+                    daemon=True,
+                )
+                self._threads.append(t)
+                t.start()
+            # else: at capacity — an active worker will claim it via the
+            # idle+1 it posts after finishing its current item.
+        self._q.put((fn, args, kwargs))
+
+    def _run(self) -> None:
+        # A new thread's first wake is pre-claimed by the submit that
+        # spawned it, so it parks WITHOUT posting an idle permit.
+        while True:
+            item = self._q.get()
+            if item is None:
+                with self._lock:
+                    self._nthreads -= 1
+                return
+            fn, args, kwargs = item
+            try:
+                fn(*args, **kwargs)
+            except BaseException:  # noqa: BLE001 — never kill the pool thread
+                import traceback
+
+                traceback.print_exc()
+            with self._lock:
+                if self._stopped:
+                    self._nthreads -= 1
+                    return
+                self._idle += 1
+
+    def shutdown(self, wait: bool = False, cancel_futures: bool = False) -> None:
+        with self._lock:
+            self._stopped = True
+            n = self._nthreads
+            self._idle = 0
+            threads = list(self._threads)
+        if cancel_futures:
+            # Drop queued-but-undispatched work so nothing runs against a
+            # torn-down runtime after this returns.
+            try:
+                while True:
+                    self._q.get_nowait()
+            except queue.Empty:
+                pass
+        for _ in range(n):
+            self._q.put(None)
+        if wait:
+            for t in threads:
+                t.join(timeout=5)
+
+
 class Runtime:
     """Singleton per process; created by ray_tpu.init()."""
 
@@ -219,10 +306,13 @@ class Runtime:
 
         # Execution pool for the thread tier; resource accounting does the
         # real concurrency limiting, this is just a thread cache.
-        self._exec_pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=512, thread_name_prefix="ray_tpu_worker"
+        self._exec_pool = _LeanExecPool(
+            max_threads=512, name="ray_tpu_worker"
         )
         self._dispatcher_stop = threading.Event()
+        self._blocked_count = 0
+        self._retry_pending = False
+        self.scheduler.on_release = self._notify_resources_freed
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, name="ray_tpu_dispatcher", daemon=True
         )
@@ -414,21 +504,43 @@ class Runtime:
         self._enqueue_after_deps(spec)
 
     # -------------------------------------------------------------- dispatch
+    def _notify_resources_freed(self) -> None:
+        """Scheduler release hook: wake the dispatcher to retry blocked tasks.
+
+        Coalesced — at most one retry token is in the queue at a time, so a
+        burst of releases costs one blocked-list scan, not one per release
+        (the old retry-on-every-queue-event design degraded O(blocked x
+        events): 16.7 _try_dispatch calls per task in bench_core)."""
+        if self._blocked_count and not self._retry_pending:
+            self._retry_pending = True
+            self._ready.put(_RETRY_BLOCKED)
+
     def _dispatch_loop(self) -> None:
         blocked: List[TaskSpec] = []
-        while not self._dispatcher_stop.is_set():
-            # Retry blocked tasks first (resources may have freed).
+
+        def retry_blocked() -> None:
             for spec in list(blocked):
                 if self._try_dispatch(spec):
                     blocked.remove(spec)
+            self._blocked_count = len(blocked)
+
+        while not self._dispatcher_stop.is_set():
             try:
-                spec = self._ready.get(timeout=0.02 if blocked else 0.2)
+                spec = self._ready.get(timeout=0.2)
             except queue.Empty:
+                # Safety net for release notifications racing the flag.
+                if blocked:
+                    retry_blocked()
                 continue
             if spec is None:
                 break
+            if spec is _RETRY_BLOCKED:
+                self._retry_pending = False
+                retry_blocked()
+                continue
             if not self._try_dispatch(spec):
                 blocked.append(spec)
+                self._blocked_count = len(blocked)
 
     def _try_dispatch(self, spec: TaskSpec) -> bool:
         if spec.task_id in self._cancelled:

@@ -154,6 +154,11 @@ class ClusterScheduler:
         self._nodes: Dict[NodeID, Node] = {}
         self._pgs: Dict[PlacementGroupID, PlacementGroupState] = {}
         self._lock = threading.Condition()
+        #: Called (outside the lock) after every capacity-adding event —
+        #: lease release, add_node, PG commit/removal — so the dispatcher
+        #: retries blocked tasks exactly when capacity appears instead of
+        #: polling.
+        self.on_release: Optional[Callable[[], None]] = None
         self._queue: deque = deque()
         self._rr_counter = 0
         self._pg_queue: deque = deque()
@@ -166,6 +171,11 @@ class ClusterScheduler:
         self.autoscaling_enabled = False
         self.autoscaler_node_shapes: List[Resources] = []
 
+    def _fire_on_release(self) -> None:
+        cb = self.on_release
+        if cb is not None:
+            cb()
+
     # ------------------------------------------------------------- node admin
     def add_node(self, resources: Resources, labels: Optional[Dict[str, str]] = None,
                  node_id: Optional[NodeID] = None) -> NodeID:
@@ -174,6 +184,7 @@ class ClusterScheduler:
             self._nodes[node_id] = Node(node_id, resources, labels)
             self._retry_pending_pgs_locked()
             self._lock.notify_all()
+        self._fire_on_release()
         return node_id
 
     def remove_node(self, node_id: NodeID) -> None:
@@ -270,6 +281,7 @@ class ClusterScheduler:
                         res_add(node.available, request)
                         node.last_busy = time.time()
                 self._lock.notify_all()
+            self._fire_on_release()
 
         return release
 
@@ -401,6 +413,7 @@ class ClusterScheduler:
         pg.state = "CREATED"
         pg.ready_event.set()
         self._lock.notify_all()
+        self._fire_on_release()
         return True
 
     def _plan_bundles_locked(self, pg: PlacementGroupState):
@@ -477,6 +490,7 @@ class ClusterScheduler:
                             res_add(node.available, bundle.resources)
             pg.state = "REMOVED"
             self._lock.notify_all()
+        self._fire_on_release()
 
     def get_placement_group(self, pg_id: PlacementGroupID) -> Optional[PlacementGroupState]:
         with self._lock:

@@ -21,6 +21,13 @@ class RemoteFunction:
             raise TypeError("Use @remote on classes via ActorClass (actor.py)")
         self._function = func
         self._default_options = default_options or {}
+        # Options are static per RemoteFunction instance (options() returns a
+        # new one) — resolved once per config generation, not per .remote()
+        # call (task hot path).  Lazy, NOT at decoration time: module-level
+        # @remote runs before init() applies _system_config overrides, and
+        # resolve_task_options reads GLOBAL_CONFIG defaults.
+        self._resolved_opts = None
+        self._resolved_gen = -1
         self.__name__ = getattr(func, "__name__", "remote_function")
         self.__doc__ = getattr(func, "__doc__", None)
 
@@ -36,11 +43,20 @@ class RemoteFunction:
         return RemoteFunction(self._function, merged)
 
     def remote(self, *args, **kwargs):
-        return self._remote(args, kwargs, **self._default_options)
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        if self._resolved_gen != GLOBAL_CONFIG.generation:
+            self._resolved_opts = resolve_task_options(
+                self._default_options, is_actor=False)
+            self._resolved_gen = GLOBAL_CONFIG.generation
+        return self._remote_resolved(args, kwargs, self._resolved_opts)
 
     def _remote(self, args, kwargs, **options):
+        return self._remote_resolved(
+            args, kwargs, resolve_task_options(options, is_actor=False))
+
+    def _remote_resolved(self, args, kwargs, opts):
         runtime = get_runtime()
-        opts = resolve_task_options(options, is_actor=False)
         parent = current_task_context()
         generator = inspect.isgeneratorfunction(self._function) or opts["num_returns"] in (
             "dynamic",
