@@ -1,0 +1,106 @@
+"""Fault injection for chaos testing.
+
+TPU-native analogue of the reference's RPC chaos layer
+(ref: src/ray/rpc/rpc_chaos.h:22 RpcFailure driven by RAY_testing_rpc_failure,
+ray_config_def.h:850-857 RAY_testing_asio_delay_us): internal operations
+consult the injector at named failure points and probabilistically raise a
+transient ``InjectedFailure`` (subclass of WorkerCrashedError, so the
+runtime's retry machinery treats it as a system fault, not an app error) or
+sleep an injected delay.
+
+Enable via config (env RAY_TPU_TESTING_RPC_FAILURE or _system_config):
+    testing_rpc_failure = "execute=0.3,process_exec=0.5:4,store_put=0.1"
+Each entry is <point>=<probability>[:<max_failures>]; max_failures caps how
+many times the point fires (unbounded if omitted).  Delays:
+    testing_delay_us = 500   # every point sleeps 500us before evaluating
+
+Deterministic across runs for a fixed RAY_TPU_TESTING_CHAOS_SEED.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ray_tpu.exceptions import WorkerCrashedError
+
+
+class InjectedFailure(WorkerCrashedError):
+    """Raised by a chaos failure point (transient, retryable)."""
+
+
+class FaultInjector:
+    def __init__(self, spec: str, delay_us: int = 0, seed: Optional[int] = None):
+        #: point -> (probability, remaining_budget or None)
+        self._points: Dict[str, Tuple[float, Optional[int]]] = {}
+        self._lock = threading.Lock()
+        self._delay_us = delay_us
+        if seed is None:
+            seed = int(os.environ.get("RAY_TPU_TESTING_CHAOS_SEED", "0")) or None
+        self._rng = random.Random(seed)
+        for entry in filter(None, (e.strip() for e in spec.split(","))):
+            point, _, rest = entry.partition("=")
+            prob_s, _, budget_s = rest.partition(":")
+            self._points[point.strip()] = (
+                float(prob_s), int(budget_s) if budget_s else None)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self._points) or self._delay_us > 0
+
+    def fires(self, point: str) -> bool:
+        """Evaluate a failure point (consumes budget when it fires)."""
+        if self._delay_us:
+            time.sleep(self._delay_us / 1e6)
+        entry = self._points.get(point)
+        if entry is None:
+            return False
+        prob, budget = entry
+        with self._lock:
+            prob, budget = self._points.get(point, (0.0, 0))
+            if budget is not None and budget <= 0:
+                return False
+            if self._rng.random() >= prob:
+                return False
+            if budget is not None:
+                self._points[point] = (prob, budget - 1)
+            return True
+
+    def check(self, point: str) -> None:
+        """Raise InjectedFailure if the point fires."""
+        if self.fires(point):
+            raise InjectedFailure(f"chaos: injected failure at '{point}'")
+
+
+_injector: Optional[FaultInjector] = None
+_injector_lock = threading.Lock()
+
+
+def get_injector() -> FaultInjector:
+    """The process-wide injector, built from GLOBAL_CONFIG on first use
+    (rebuilt by reset_injector() after config changes)."""
+    global _injector
+    if _injector is None:
+        with _injector_lock:
+            if _injector is None:
+                from ray_tpu._private.config import GLOBAL_CONFIG
+
+                _injector = FaultInjector(GLOBAL_CONFIG.testing_rpc_failure,
+                                          GLOBAL_CONFIG.testing_delay_us)
+    return _injector
+
+
+def reset_injector() -> None:
+    global _injector
+    with _injector_lock:
+        _injector = None
+
+
+def check(point: str) -> None:
+    """Module-level convenience: no-op unless chaos is configured."""
+    inj = get_injector()
+    if inj.enabled:
+        inj.check(point)

@@ -184,7 +184,9 @@ class _LeanExecPool:
     def submit(self, fn, *args, **kwargs) -> None:
         with self._lock:
             if self._stopped:
-                return
+                # Loud, like ThreadPoolExecutor: silently dropping would leak
+                # the caller's already-acquired lease and hang its waiters.
+                raise RuntimeError("cannot submit after shutdown")
             if self._idle > 0:
                 self._idle -= 1  # claim a parked worker's next wake
             elif self._nthreads < self._max:
@@ -257,6 +259,12 @@ class Runtime:
     ):
         GLOBAL_CONFIG.apply_overrides(_system_config)
         self.config: Config = GLOBAL_CONFIG
+        # Chaos layer (ref: rpc_chaos.h RpcFailure): rebuild from the fresh
+        # config; hot paths skip the hooks entirely when disabled.
+        from ray_tpu._private import fault_injection
+
+        fault_injection.reset_injector()
+        self._chaos = fault_injection.get_injector().enabled
         self.job_id = JobID.from_random()
         self.worker_id = WorkerID.from_random()
         self.namespace = namespace
@@ -579,7 +587,12 @@ class Runtime:
         self.scheduler.clear_task_demand(spec.task_id)
         node_id, release = lease
         self._emit_event(spec.task_id, spec.name, "SUBMITTED_TO_WORKER", node_id=str(node_id))
-        self._exec_pool.submit(self._execute_task, spec, node_id, release)
+        try:
+            self._exec_pool.submit(self._execute_task, spec, node_id, release)
+        except RuntimeError:
+            release()
+            self._fail_task(spec, WorkerCrashedError("runtime is shutting down"),
+                            retry=False)
         return True
 
     # -------------------------------------------------------------- execution
@@ -601,6 +614,10 @@ class Runtime:
 
         try:
             with tracing.task_execute_span(spec):
+                if self._chaos:
+                    from ray_tpu._private import fault_injection
+
+                    fault_injection.check("execute")
                 args, kwargs = self._resolve_args(spec)
                 if spec.isolation == "process" or spec.runtime_env:
                     # A runtime env implies the process tier: envs are
@@ -633,6 +650,10 @@ class Runtime:
         return args, kwargs
 
     def _run_in_process(self, spec: TaskSpec, args, kwargs):
+        if self._chaos:
+            from ray_tpu._private import fault_injection
+
+            fault_injection.check("process_exec")
         fn = spec.func
         fn_id = getattr(fn, "__qualname__", "fn") + ":" + str(id(fn))
         fn_bytes = serialization.dumps(fn)
@@ -750,7 +771,12 @@ class Runtime:
                         raise ValueError(f"Actor name '{spec.name}' already taken")
                 self._named_actors[key] = spec.actor_id
             self._actors[spec.actor_id] = state
-        self._exec_pool.submit(self._start_actor, state, first=True)
+        try:
+            self._exec_pool.submit(self._start_actor, state, first=True)
+        except RuntimeError:
+            state.death_cause = ActorDiedError("runtime is shutting down")
+            state.state = _ActorState.DEAD
+            state.ready_event.set()
 
     def _start_actor(self, state: _ActorState, first: bool) -> None:
         spec = state.spec
@@ -998,7 +1024,12 @@ class Runtime:
                 state.state = _ActorState.RESTARTING
                 state.num_restarts += 1
                 state.ready_event.clear()
-                self._exec_pool.submit(self._start_actor, state, first=False)
+                try:
+                    self._exec_pool.submit(self._start_actor, state, first=False)
+                except RuntimeError:
+                    state.death_cause = ActorDiedError("runtime is shutting down")
+                    state.state = _ActorState.DEAD
+                    state.ready_event.set()
             else:
                 state.state = _ActorState.DEAD
                 state.death_cause = cause
