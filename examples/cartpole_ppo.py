@@ -9,7 +9,11 @@ Run: python examples/cartpole_ppo.py [--stop-reward 450] [--as-test]
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main() -> int:

@@ -291,7 +291,9 @@ class Runtime:
 
         # Task bookkeeping.
         self._lineage: Dict[ObjectID, TaskSpec] = {}
-        self._lineage_lock = threading.Lock()
+        # RLock: a lineage pop can GC an ObjectRef whose zero-callback
+        # re-enters _on_zero_refs on this same thread.
+        self._lineage_lock = threading.RLock()
         self._pending_deps: Dict[TaskID, Tuple[TaskSpec, set]] = {}
         self._obj_waiters: Dict[ObjectID, List[TaskID]] = {}
         self._deps_lock = threading.Lock()

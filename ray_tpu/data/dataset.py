@@ -9,7 +9,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
-from typing import Any, Callable, Dict, Iterator, List, Optional, Union
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -43,12 +43,15 @@ class Dataset:
     # ------------------------------------------------------------ transforms
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     batch_format: str = "numpy", compute: Optional[ComputeStrategy] = None,
-                    num_tpus: Optional[float] = None, concurrency: Optional[int] = None,
+                    num_tpus: Optional[float] = None,
+                    concurrency: Union[int, Tuple[int, int], None] = None,
                     fn_constructor_args: tuple = (), **_compat) -> "Dataset":
         """(ref: dataset.py:397 map_batches — the batch-inference path).
 
         Stateful form: pass a class; it is constructed once per pool actor
-        (TPU-pinned with num_tpus) and called per batch.
+        (TPU-pinned with num_tpus) and called per batch.  ``concurrency``
+        takes an int (fixed pool) or a ``(min, max)`` tuple (the pool
+        autoscales between the bounds while the op is backlogged).
         """
         fn_constructor = None
         the_fn = fn
@@ -67,13 +70,10 @@ class Dataset:
                 return state(batch)
 
             if compute is None:
-                compute = ActorPoolStrategy(
-                    size=concurrency or 1,
-                    resources={"TPU": num_tpus} if num_tpus else {})
-        elif num_tpus or (concurrency and concurrency > 1):
-            compute = compute or ActorPoolStrategy(
-                size=concurrency or 1,
-                resources={"TPU": num_tpus} if num_tpus else {})
+                compute = _pool_strategy(concurrency, num_tpus)
+        elif num_tpus or (isinstance(concurrency, tuple)
+                          or (concurrency and concurrency > 1)):
+            compute = compute or _pool_strategy(concurrency, num_tpus)
         return Dataset(MapBatches(self._op, the_fn, batch_size=batch_size,
                                   batch_format=batch_format, compute=compute,
                                   fn_constructor=fn_constructor))
@@ -413,3 +413,13 @@ class DataIterator:
             n = len(next(iter(batch.values()))) if batch else 0
             for i in range(n):
                 yield {k: v[i] for k, v in batch.items()}
+
+
+def _pool_strategy(concurrency, num_tpus):
+    """concurrency int -> fixed pool; (min, max) tuple -> autoscaling pool
+    (ref: dataset.py map_batches concurrency semantics)."""
+    res = {"TPU": num_tpus} if num_tpus else {}
+    if isinstance(concurrency, tuple):
+        lo, hi = concurrency
+        return ActorPoolStrategy(min_size=lo, max_size=hi, resources=res)
+    return ActorPoolStrategy(size=concurrency or 1, resources=res)

@@ -45,6 +45,69 @@ from ray_tpu.data.plan import (
 MAX_IN_FLIGHT = 8
 
 
+class ResourceBudget:
+    """Per-op in-flight budget: a task cap AND a bytes cap
+    (ref: execution/resource_manager.py + backpressure_policy/ — operators
+    may not hold more than their share of object-store memory in flight).
+
+    Block sizes are learned from completed blocks (EMA), so the byte cap
+    tightens as soon as real sizes are observed; until then the task cap
+    alone applies.  The whole pipeline is pull-based, so a slow consumer
+    stops new launches at the next cap check — memory stays bounded at
+    cap * avg_block regardless of consumer speed."""
+
+    def __init__(self, task_cap: int = MAX_IN_FLIGHT,
+                 mem_fraction: float = 0.25):
+        self._task_cap = max(1, task_cap)
+        store_cap = 0
+        try:
+            from ray_tpu._private.runtime import runtime_or_none
+
+            runtime = runtime_or_none()
+            if runtime is not None:
+                store_cap = runtime.store.capacity_bytes
+        except Exception:
+            pass
+        if not store_cap:
+            from ray_tpu._private.config import GLOBAL_CONFIG
+
+            store_cap = GLOBAL_CONFIG.object_store_memory or (1 << 30)
+        self._mem_budget = max(64 << 20, int(store_cap * mem_fraction))
+        self._avg_block: float = 0.0
+
+    def observe_ref(self, ref) -> None:
+        """Learn block size from the store's recorded entry size — no
+        driver-side get: fetching every block just to measure it would
+        defeat the pass-by-reference stream (and restore spilled blocks)."""
+        try:
+            from ray_tpu._private.runtime import runtime_or_none
+
+            runtime = runtime_or_none()
+            entry = runtime.store._entries.get(ref.id) if runtime else None
+            nbytes = entry.size if entry is not None else 0
+        except Exception:
+            return
+        if nbytes:
+            self._observe_bytes(nbytes)
+
+    def observe_block(self, block) -> None:
+        try:
+            nbytes = BlockAccessor(block).size_bytes()
+        except Exception:
+            return
+        self._observe_bytes(nbytes)
+
+    def _observe_bytes(self, nbytes: float) -> None:
+        self._avg_block = (0.7 * self._avg_block + 0.3 * nbytes
+                           if self._avg_block else float(nbytes))
+
+    def cap(self) -> int:
+        if self._avg_block > 0:
+            by_mem = int(self._mem_budget // self._avg_block)
+            return max(1, min(self._task_cap, by_mem))
+        return self._task_cap
+
+
 def make_block_transform(op: AbstractMap) -> Callable[[Block], Block]:
     """Build the pure block->block function for a map-family logical op."""
     if getattr(op, "_pre_transformed", False):
@@ -133,11 +196,41 @@ class _ActorPool:
             return concat_blocks(outs)
 
         res = dict(op.compute.resources)
-        self.actors = [
-            MapWorker.options(resources=res or None, num_cpus=None if res else 1).remote()
-            for _ in range(op.compute.pool_size)
-        ]
+        self._actor_req = dict(res) if res else {"CPU": 1.0}
+        self._mk_actor = lambda: MapWorker.options(
+            resources=res or None, num_cpus=None if res else 1).remote()
+        self.max_size = max(op.compute.max_size, op.compute.pool_size)
+        self.actors = [self._mk_actor() for _ in range(op.compute.pool_size)]
         self._rr = 0
+
+    def size(self) -> int:
+        return len(self.actors)
+
+    def maybe_scale_up(self) -> bool:
+        if len(self.actors) >= self.max_size:
+            return False
+        # One scale-up in flight at a time: actor leases are acquired
+        # asynchronously, so available_resources() does not yet reflect an
+        # actor we just appended — stacking scale-ups on that stale reading
+        # could take the last CPU anyway.
+        from ray_tpu._private.runtime import get_runtime
+
+        runtime = get_runtime()
+        for a in self.actors:
+            state = runtime.get_actor_state(a._ray_actor_id)
+            if state is not None and state.state == "PENDING_CREATION":
+                return False
+        # Never scale into the last CPU: actors hold their lease for life,
+        # and a pool that absorbs every slot starves the upstream read/map
+        # TASKS forever — deadlock by oversubscription (ref:
+        # resource_manager.py reserves budgets per operator).
+        avail = ray_tpu.available_resources()
+        for key, need in self._actor_req.items():
+            headroom = 1.0 if key == "CPU" else 0.0
+            if avail.get(key, 0.0) < need + headroom:
+                return False
+        self.actors.append(self._mk_actor())
+        return True
 
     def submit(self, block_ref):
         actor = self.actors[self._rr % len(self.actors)]
@@ -249,10 +342,11 @@ def _map_stream_tasks(stream: Iterator[Any], op: AbstractMap) -> Iterator[Any]:
     def apply(block):
         return transform(block)
 
+    budget = ResourceBudget()
     pending: List[Any] = []
     done = False
     while not done or pending:
-        while not done and len(pending) < MAX_IN_FLIGHT:
+        while not done and len(pending) < budget.cap():
             try:
                 block_ref = next(stream)
             except StopIteration:
@@ -262,25 +356,34 @@ def _map_stream_tasks(stream: Iterator[Any], op: AbstractMap) -> Iterator[Any]:
         if pending:
             ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=30.0)
             for r in ready:
+                budget.observe_ref(r)
                 yield r
 
 
 def _map_stream_actors(stream: Iterator[Any], op: AbstractMap) -> Iterator[Any]:
     pool = _ActorPool(op)
+    budget = ResourceBudget(task_cap=max(MAX_IN_FLIGHT, op.compute.max_size))
     try:
         pending: List[Any] = []
         done = False
         while not done or pending:
-            while not done and len(pending) < max(MAX_IN_FLIGHT, op.compute.pool_size):
+            cap = min(budget.cap(), 2 * pool.size())
+            while not done and len(pending) < cap:
                 try:
                     block_ref = next(stream)
                 except StopIteration:
                     done = True
                     break
                 pending.append(pool.submit(block_ref))
+            if not done and len(pending) >= 2 * pool.size():
+                # Backlogged at current capacity: autoscale up to max_size
+                # (ref: actor-pool autoscaling in data/_internal/execution/
+                # autoscaler/).
+                pool.maybe_scale_up()
             if pending:
                 ready, pending = ray_tpu.wait(pending, num_returns=1, timeout=60.0)
                 for r in ready:
+                    budget.observe_ref(r)
                     yield r
     finally:
         pool.shutdown()

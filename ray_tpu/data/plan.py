@@ -54,11 +54,26 @@ class ComputeStrategy:
     kind: str = "tasks"
     pool_size: int = 1
     resources: Dict[str, float] = field(default_factory=dict)
+    #: Autoscaling ceiling for actor pools (ref: data/_internal/execution/
+    #: autoscaler/ actor-pool autoscaling): the executor grows the pool from
+    #: pool_size up to max_size while the op is backlogged.
+    max_size: int = 1
 
 
 class ActorPoolStrategy(ComputeStrategy):
-    def __init__(self, size: int = 1, resources: Optional[Dict[str, float]] = None):
-        super().__init__(kind="actors", pool_size=size, resources=resources or {})
+    def __init__(self, size: Optional[int] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 min_size: int = 1, max_size: Optional[int] = None):
+        if size is not None:
+            min_size = max_size = size
+        if min_size < 1:
+            raise ValueError(f"min_size must be >= 1, got {min_size}")
+        if max_size is not None and max_size < min_size:
+            raise ValueError(
+                f"max_size ({max_size}) must be >= min_size ({min_size})")
+        super().__init__(kind="actors", pool_size=min_size,
+                         resources=resources or {},
+                         max_size=max(max_size or min_size, min_size))
 
 
 class AbstractMap(LogicalOp):

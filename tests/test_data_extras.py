@@ -163,3 +163,98 @@ def test_imputer_concatenator_chain():
 def test_unfit_preprocessor_raises():
     with pytest.raises(RuntimeError):
         StandardScaler(["a"]).transform(rtd.range(3))
+
+
+# ---------------------------------------------------------------------------
+# Resource model (VERDICT r1 next-step #9): backpressure bounds memory under
+# a slow consumer; actor pools autoscale under backlog.
+# ---------------------------------------------------------------------------
+
+def test_backpressure_slow_consumer_bounds_in_flight(ray_start_regular):
+    """A slow consumer must bound live map tasks: the pull-based executor
+    launches new tasks only inside next(), capped by ResourceBudget."""
+    import threading
+
+    from ray_tpu import data as rdata
+
+    live = []
+    peak = [0]
+    lock = threading.Lock()
+
+    def tracked(batch):
+        with lock:
+            live.append(1)
+            peak[0] = max(peak[0], len(live))
+        import time as _t
+
+        _t.sleep(0.01)
+        with lock:
+            live.pop()
+        return batch
+
+    ds = rdata.range(200, parallelism=40).map_batches(tracked)
+    it = iter(ds.iter_batches(batch_size=5))
+    next(it)
+    import time as _t
+
+    _t.sleep(0.5)  # consumer stalls; producers must not run ahead unbounded
+    for _ in it:
+        pass
+    from ray_tpu.data.executor import MAX_IN_FLIGHT
+
+    assert peak[0] <= MAX_IN_FLIGHT + 1, peak[0]
+
+
+def test_resource_budget_tightens_with_block_size():
+    from ray_tpu.data.executor import ResourceBudget
+
+    b = ResourceBudget(task_cap=8)
+    assert b.cap() == 8  # no observations yet: task cap alone
+    import pyarrow as pa
+
+    big = pa.table({"x": list(range(200_000))})  # ~1.6 MB
+    for _ in range(5):
+        b.observe_block(big)
+    assert 1 <= b.cap() <= 8
+    b2 = ResourceBudget(task_cap=1000, mem_fraction=1e-6)
+    b2.observe_block(big)
+    assert b2.cap() == max(1, int((64 << 20) // big.nbytes))
+
+
+def test_actor_pool_autoscales_under_backlog(ray_start_regular):
+    """(min,max) concurrency grows the pool while backlogged."""
+    import os
+
+    from ray_tpu import data as rdata
+
+    class SlowModel:
+        def __init__(self):
+            import uuid
+
+            self.ident = uuid.uuid4().hex
+
+        def __call__(self, batch):
+            import time as _t
+
+            _t.sleep(0.05)
+            batch["y"] = batch["id"] * 2
+            batch["actor"] = [self.ident] * len(batch["id"])
+            return batch
+
+    ds = rdata.range(64, parallelism=16).map_batches(
+        SlowModel, concurrency=(1, 4), batch_size=4)
+    out = ds.take_all()
+    assert len(out) == 64
+    assert all(r["y"] == 2 * r["id"] for r in out)
+    # The pool actually grew: more than one actor identity served batches.
+    assert len({r["actor"] for r in out}) >= 2, {r["actor"] for r in out}
+
+
+def test_map_batches_tuple_concurrency_builds_autoscaling_pool():
+    from ray_tpu import data as rdata
+    from ray_tpu.data.plan import ActorPoolStrategy
+
+    ds = rdata.range(10).map_batches(lambda b: b, concurrency=(2, 5))
+    op = ds._op
+    assert isinstance(op.compute, ActorPoolStrategy)
+    assert op.compute.pool_size == 2 and op.compute.max_size == 5
