@@ -115,6 +115,46 @@ def cmd_job(args) -> int:
     return 1
 
 
+def cmd_logs(args) -> int:
+    """Tail per-job log files straight from disk (ref:
+    _private/log_monitor.py:103 tailing session logs + `ray job logs`).
+    Works without a live job manager: logs outlive the driver."""
+    import os
+    import time
+
+    from ray_tpu.job.job_manager import default_log_root
+
+    log_root = default_log_root()
+    if not args.job_id:
+        if not os.path.isdir(log_root):
+            print(f"no job logs under {log_root}")
+            return 1
+        for name in sorted(os.listdir(log_root)):
+            if name.endswith(".log"):
+                path = os.path.join(log_root, name)
+                print(f"{name[:-4]}  {os.path.getsize(path):>10} bytes  {path}")
+        return 0
+    path = os.path.join(log_root, f"{args.job_id}.log")
+    if not os.path.exists(path):
+        print(f"no log file for job {args.job_id} ({path})", file=sys.stderr)
+        return 1
+    with open(path, "rb") as f:
+        sys.stdout.write(f.read().decode(errors="replace"))
+        sys.stdout.flush()
+        if not args.follow:
+            return 0
+        try:
+            while True:
+                chunk = f.read()
+                if chunk:
+                    sys.stdout.write(chunk.decode(errors="replace"))
+                    sys.stdout.flush()
+                else:
+                    time.sleep(0.25)
+        except KeyboardInterrupt:
+            return 0
+
+
 def cmd_run(args) -> int:
     """Run a driver script with ray_tpu importable (ref: `ray job submit`'s
     local path; full job manager lives in ray_tpu.job)."""
@@ -144,6 +184,10 @@ def main(argv=None) -> int:
 
     sub.add_parser("metrics", help="print Prometheus metrics once")
 
+    lg = sub.add_parser("logs", help="print/tail a job's log file")
+    lg.add_argument("job_id", nargs="?", help="job id (omit to list logs)")
+    lg.add_argument("--follow", "-f", action="store_true")
+
     jp = sub.add_parser("job", help="job submission")
     jsub = jp.add_subparsers(dest="job_cmd", required=True)
     jsp = jsub.add_parser("submit")
@@ -161,7 +205,7 @@ def main(argv=None) -> int:
     return {
         "status": cmd_status, "list": cmd_list, "summary": cmd_summary,
         "timeline": cmd_timeline, "metrics": cmd_metrics, "job": cmd_job,
-        "run": cmd_run,
+        "logs": cmd_logs, "run": cmd_run,
     }[args.cmd](args)
 
 

@@ -79,22 +79,41 @@ class MetricsAgent:
         agent = self
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802
-                if self.path.rstrip("/") not in ("", "/metrics"):
-                    self.send_error(404)
-                    return
-                try:
-                    sample_runtime(agent._runtime)
-                    body = um.registry().prometheus_text().encode()
-                except Exception as e:  # scrape must never kill the server
-                    self.send_error(500, str(e))
-                    return
+            def _send(self, body: bytes, ctype: str) -> None:
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                """Routes: /metrics (Prometheus), /api/* (state API JSON —
+                the REST aggregation tier, ref: dashboard/head.py:65 +
+                modules/state/state_head.py:47), / (HTML status page)."""
+                import json as _json
+
+                path = self.path.split("?")[0].rstrip("/")
+                try:
+                    if path == "/metrics":
+                        sample_runtime(agent._runtime)
+                        self._send(um.registry().prometheus_text().encode(),
+                                   "text/plain; version=0.0.4; charset=utf-8")
+                        return
+                    if path.startswith("/api"):
+                        payload = _api_payload(agent._runtime, path)
+                        if payload is None:
+                            self.send_error(404)
+                            return
+                        self._send(_json.dumps(payload, default=str).encode(),
+                                   "application/json")
+                        return
+                    if path == "":
+                        self._send(_status_page(agent._runtime).encode(),
+                                   "text/html; charset=utf-8")
+                        return
+                    self.send_error(404)
+                except Exception as e:  # a scrape must never kill the server
+                    self.send_error(500, str(e))
 
             def log_message(self, *a):  # quiet
                 pass
@@ -109,3 +128,74 @@ class MetricsAgent:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+
+
+def _api_payload(runtime, path: str):
+    """REST views over the state API (ref: dashboard state_head.py:47 — the
+    same rows `ray list ...` prints, as JSON over HTTP)."""
+    from ray_tpu.util import state as state_api
+
+    if path in ("/api", "/api/cluster"):
+        return {
+            "cluster_resources": runtime.scheduler.cluster_resources(),
+            "available_resources": runtime.scheduler.available_resources(),
+            "nodes": len(runtime.scheduler.nodes()),
+            "tasks": state_api.summarize_tasks(),
+            "actors": state_api.summarize_actors(),
+        }
+    listings = {
+        "/api/tasks": state_api.list_tasks,
+        "/api/actors": state_api.list_actors,
+        "/api/objects": state_api.list_objects,
+        "/api/nodes": state_api.list_nodes,
+        "/api/placement_groups": state_api.list_placement_groups,
+    }
+    fn = listings.get(path)
+    if fn is not None:
+        return fn()
+    if path == "/api/jobs":
+        mgr = getattr(runtime, "_job_manager", None)
+        if mgr is None:
+            return []
+        return [dict(job_id=j.job_id, status=j.status,
+                     entrypoint=j.entrypoint, log_path=j.log_path)
+                for j in mgr.list_jobs()]
+    return None
+
+
+def _status_page(runtime) -> str:
+    """Minimal live HTML status page (the dashboard UI floor).  Every
+    interpolated value is escaped — actor/task NAMES are user input."""
+    import html as _html
+
+    from ray_tpu.util import state as state_api
+
+    def esc(v) -> str:
+        return _html.escape(str(v))
+
+    def table(rows, cols):
+        if not rows:
+            return "<p><i>none</i></p>"
+        head = "".join(f"<th>{esc(c)}</th>" for c in cols)
+        body = "".join(
+            "<tr>" + "".join(f"<td>{esc(r.get(c, ''))}</td>" for c in cols)
+            + "</tr>"
+            for r in rows[:100])
+        return f"<table border=1 cellpadding=4><tr>{head}</tr>{body}</table>"
+
+    nodes = state_api.list_nodes()
+    actors = state_api.list_actors()
+    tasks = state_api.list_tasks()[-50:]
+    res = esc(runtime.scheduler.cluster_resources())
+    avail = esc(runtime.scheduler.available_resources())
+    return f"""<!doctype html><html><head><title>ray_tpu status</title>
+<meta http-equiv="refresh" content="5"></head><body>
+<h2>ray_tpu cluster</h2>
+<p>resources: {res} &nbsp; available: {avail}</p>
+<h3>nodes ({len(nodes)})</h3>{table(nodes, ["node_id", "alive", "resources"])}
+<h3>actors ({len(actors)})</h3>
+{table(actors, ["actor_id", "class_name", "state", "name", "num_restarts"])}
+<h3>recent tasks</h3>
+{table(tasks, ["task_id", "name", "state", "attempt"])}
+<p><a href="/metrics">/metrics</a> &middot; <a href="/api/cluster">/api/cluster</a></p>
+</body></html>"""

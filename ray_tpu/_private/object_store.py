@@ -130,6 +130,12 @@ class ObjectStore:
         entry.event.set()
 
     # ------------------------------------------------------------------ gets
+    def size_of(self, object_id: ObjectID) -> int:
+        """Recorded byte size of a stored object (0 if unknown/absent)."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e.size if e is not None else 0
+
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
             e = self._entries.get(object_id)

@@ -83,8 +83,7 @@ class ResourceBudget:
             from ray_tpu._private.runtime import runtime_or_none
 
             runtime = runtime_or_none()
-            entry = runtime.store._entries.get(ref.id) if runtime else None
-            nbytes = entry.size if entry is not None else 0
+            nbytes = runtime.store.size_of(ref.id) if runtime else 0
         except Exception:
             return
         if nbytes:
@@ -375,7 +374,7 @@ def _map_stream_actors(stream: Iterator[Any], op: AbstractMap) -> Iterator[Any]:
                     done = True
                     break
                 pending.append(pool.submit(block_ref))
-            if not done and len(pending) >= 2 * pool.size():
+            if not done and pending and len(pending) >= cap:
                 # Backlogged at current capacity: autoscale up to max_size
                 # (ref: actor-pool autoscaling in data/_internal/execution/
                 # autoscaler/).

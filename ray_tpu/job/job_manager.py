@@ -55,8 +55,7 @@ class JobManager:
         self._jobs: Dict[str, JobInfo] = {}
         self._procs: Dict[str, subprocess.Popen] = {}
         self._lock = threading.Lock()
-        self._log_root = log_root or os.path.join(
-            GLOBAL_CONFIG.session_dir, "job_logs")
+        self._log_root = log_root or default_log_root()
         os.makedirs(self._log_root, exist_ok=True)
 
     # ---------------------------------------------------------------- submit
@@ -225,3 +224,10 @@ def job_manager() -> JobManager:
         if _MANAGER is None:
             _MANAGER = JobManager()
         return _MANAGER
+
+
+def default_log_root() -> str:
+    """The on-disk job-log directory (shared with the `ray_tpu logs` CLI)."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return os.path.join(GLOBAL_CONFIG.session_dir, "job_logs")

@@ -194,3 +194,79 @@ def test_tracing_disabled_is_noop(ray_init):
     with tracing.span("nothing") as s:
         assert s is None
     assert tracing.exported_spans() == []
+
+
+# ---------------------------------------------------------------------------
+# REST aggregation + HTML status + `ray_tpu logs` (VERDICT r1 next-step #10).
+# ---------------------------------------------------------------------------
+
+def test_http_state_api_endpoints(ray_start_regular):
+    import json
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu._private.metrics_agent import MetricsAgent
+    from ray_tpu._private.runtime import get_runtime
+
+    @ray_tpu.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    a = Pinger.options(name="obs-pinger").remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+
+    agent = MetricsAgent(get_runtime())
+    try:
+        base = f"http://127.0.0.1:{agent.port}"
+
+        cluster = json.load(urllib.request.urlopen(f"{base}/api/cluster"))
+        assert cluster["nodes"] >= 1
+        assert "CPU" in cluster["cluster_resources"]
+
+        actors = json.load(urllib.request.urlopen(f"{base}/api/actors"))
+        assert any(r.get("name") == "obs-pinger" for r in actors)
+
+        tasks = json.load(urllib.request.urlopen(f"{base}/api/tasks"))
+        assert any("ping" in str(r.get("name", "")) for r in tasks)
+
+        nodes = json.load(urllib.request.urlopen(f"{base}/api/nodes"))
+        assert len(nodes) >= 1
+
+        html = urllib.request.urlopen(base).read().decode()
+        assert "ray_tpu cluster" in html and "obs-pinger" in html
+
+        metrics = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "ray_tpu_nodes" in metrics
+
+        import urllib.error
+
+        try:
+            urllib.request.urlopen(f"{base}/api/nope")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        agent.stop()
+
+
+def test_cli_logs_lists_and_prints(tmp_path, capsys, monkeypatch):
+    import os
+
+    from ray_tpu.__main__ import main
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    monkeypatch.setattr(GLOBAL_CONFIG, "session_dir", str(tmp_path))
+    log_root = tmp_path / "job_logs"
+    log_root.mkdir()
+    (log_root / "raytpu-job-abc.log").write_text("hello from the job\n")
+
+    assert main(["logs"]) == 0
+    out = capsys.readouterr().out
+    assert "raytpu-job-abc" in out
+
+    assert main(["logs", "raytpu-job-abc"]) == 0
+    out = capsys.readouterr().out
+    assert "hello from the job" in out
+
+    assert main(["logs", "missing-job"]) == 1
