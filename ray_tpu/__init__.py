@@ -46,14 +46,26 @@ def init(
 ):
     """Start the runtime (ref: worker.py:1275 ray.init).
 
-    ``address`` is accepted for API compatibility; this round supports the
-    single-host multi-controller topology (multi-host arrives via
-    jax.distributed in the collective layer, not via remote drivers).
+    ``address="ray://host:port"`` connects this process as a REMOTE DRIVER
+    to a cluster serving `ray_tpu.util.client.ClientServer` — the full
+    task/actor/object API proxies over TCP (ref: util/client ray:// mode).
+    Any other address (or None) starts the local runtime.
     """
     if _rt.runtime_or_none() is not None:
+        if address and address.startswith("ray://"):
+            # Returning the LOCAL runtime here would silently run "remote"
+            # work locally — always loud.
+            raise RuntimeError(
+                f"ray_tpu.init(address={address!r}) requested a remote "
+                "cluster but a runtime is already active in this process; "
+                "call ray_tpu.shutdown() first")
         if ignore_reinit_error:
             return _rt.get_runtime()
         raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True")
+    if address and address.startswith("ray://"):
+        from ray_tpu.util.client import connect
+
+        return connect(address)
     return _rt.init_runtime(
         num_cpus=num_cpus,
         num_tpus=num_tpus,

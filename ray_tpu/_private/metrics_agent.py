@@ -154,7 +154,9 @@ def _api_payload(runtime, path: str):
     if fn is not None:
         return fn()
     if path == "/api/jobs":
-        mgr = getattr(runtime, "_job_manager", None)
+        from ray_tpu.job import job_manager as jm_mod
+
+        mgr = jm_mod._MANAGER  # peek, never create on a GET
         if mgr is None:
             return []
         return [dict(job_id=j.job_id, status=j.status,

@@ -240,27 +240,29 @@ class Dataset:
         return [DataIterator(coordinator, i) for i in range(n)]
 
     # ---------------------------------------------------------------- writes
-    def write_parquet(self, path: str) -> None:
+    def _write_blocks(self, path: str, ext: str, write_one) -> None:
+        """Distributed write: each block is written BY A TASK, in parallel,
+        without materializing on the driver (ref: logical write operators in
+        _internal/logical/operators/write_operator.py)."""
         import os
 
-        import pyarrow.parquet as pq
-
         os.makedirs(path, exist_ok=True)
+        write_task = ray_tpu.remote(write_one)
+        refs = []
         for i, ref in enumerate(self.iter_block_refs()):
-            block = ray_tpu.get(ref)
-            if block.num_rows:
-                pq.write_table(block, os.path.join(path, f"part-{i:05d}.parquet"))
+            out = os.path.join(path, f"part-{i:05d}.{ext}")
+            refs.append(write_task.remote(ref, out))
+        ray_tpu.get(refs)
+
+    def write_parquet(self, path: str) -> None:
+        self._write_blocks(path, "parquet", _write_block_parquet)
 
     def write_csv(self, path: str) -> None:
-        import os
+        self._write_blocks(path, "csv", _write_block_csv)
 
-        import pyarrow.csv as pacsv
-
-        os.makedirs(path, exist_ok=True)
-        for i, ref in enumerate(self.iter_block_refs()):
-            block = ray_tpu.get(ref)
-            if block.num_rows:
-                pacsv.write_csv(block, os.path.join(path, f"part-{i:05d}.csv"))
+    def write_json(self, path: str) -> None:
+        """Newline-delimited JSON, one file per block (ref: write_json)."""
+        self._write_blocks(path, "json", _write_block_json)
 
     def stats(self) -> str:
         return f"Dataset(plan={'->'.join(op.name for op in self._op.chain())})"
@@ -423,3 +425,26 @@ def _pool_strategy(concurrency, num_tpus):
         lo, hi = concurrency
         return ActorPoolStrategy(min_size=lo, max_size=hi, resources=res)
     return ActorPoolStrategy(size=concurrency or 1, resources=res)
+
+
+def _write_block_parquet(block, out_path):
+    import pyarrow.parquet as pq
+
+    if block.num_rows:
+        pq.write_table(block, out_path)
+
+
+def _write_block_csv(block, out_path):
+    import pyarrow.csv as pacsv
+
+    if block.num_rows:
+        pacsv.write_csv(block, out_path)
+
+
+def _write_block_json(block, out_path):
+    import json as _json
+
+    if block.num_rows:
+        with open(out_path, "w") as f:
+            for row in block.to_pylist():
+                f.write(_json.dumps(row, default=str) + "\n")

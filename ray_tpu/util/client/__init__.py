@@ -1,0 +1,113 @@
+"""Ray Client equivalent: a remote-driver mode over TCP.
+
+(ref: python/ray/util/client/ — server/server.py RayletServicer:96 converts
+client RPCs into real calls; proto ray_client.proto.)  Here the server
+reuses the nested-API request handler that already powers process-worker
+backchannels (_private/client_runtime._handle): each TCP connection is one
+remote driver, served by its own thread with borrowed-ref tracking, and the
+client side installs the same ClientRuntime proxy over a socket transport —
+so `ray_tpu.init(address="ray://host:port")` gives the full task/actor/
+object API against a cluster running elsewhere.
+
+Wire framing: u32 little-endian length prefix per message, same
+serialization as the in-process pipes.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Optional, Tuple
+
+
+class _SocketConn:
+    """Pipe-shaped adapter (send_bytes/recv_bytes) over a TCP socket."""
+
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._rfile = sock.makefile("rb")
+
+    def send_bytes(self, data: bytes) -> None:
+        self._sock.sendall(struct.pack("<I", len(data)) + data)
+
+    def recv_bytes(self) -> bytes:
+        header = self._rfile.read(4)
+        if len(header) < 4:
+            raise EOFError("client connection closed")
+        (n,) = struct.unpack("<I", header)
+        data = self._rfile.read(n)
+        if len(data) < n:
+            raise EOFError("client connection closed mid-message")
+        return data
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        finally:
+            self._sock.close()
+
+
+class ClientServer:
+    """Accepts remote drivers; one serve thread per connection
+    (ref: server/server.py:96 — the server side of ray://)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from ray_tpu._private.runtime import get_runtime
+
+        get_runtime()  # fail fast if no runtime to serve
+        self._listener = socket.create_server((host, port))
+        self.host, self.port = self._listener.getsockname()[:2]
+        self.address = f"ray://{self.host}:{self.port}"
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="ray_tpu_client_server", daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        from ray_tpu._private.client_runtime import serve_backchannel
+
+        while not self._stop.is_set():
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                return
+            conn = _SocketConn(sock)
+            threading.Thread(
+                target=serve_backchannel, args=(conn,),
+                name=f"ray_tpu_client_conn_{addr[1]}", daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def connect(address: str):
+    """Connect this process to a remote cluster; installs a ClientRuntime
+    so the whole ray_tpu API proxies over the wire (client side of ray://)."""
+    from ray_tpu._private.client_runtime import ClientRuntime
+    from ray_tpu._private.runtime import install_runtime
+
+    host, port = parse_address(address)
+    sock = socket.create_connection((host, port), timeout=30)
+    sock.settimeout(None)
+    conn = _SocketConn(sock)
+    runtime = ClientRuntime(conn, worker_id=f"ray-client-{sock.getsockname()[1]}")
+    runtime._client_conn = conn  # keep for disconnect
+    install_runtime(runtime)
+    return runtime
+
+
+def parse_address(address: str) -> Tuple[str, int]:
+    if not address.startswith("ray://"):
+        raise ValueError(f"client address must look like ray://host:port, "
+                         f"got {address!r}")
+    hostport = address[len("ray://"):]
+    host, _, port_s = hostport.rpartition(":")
+    if not host or not port_s.isdigit():
+        raise ValueError(f"client address must look like ray://host:port, "
+                         f"got {address!r}")
+    return host, int(port_s)

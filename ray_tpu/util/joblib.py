@@ -1,0 +1,56 @@
+"""joblib backend over ray_tpu (ref: python/ray/util/joblib/ —
+register_ray + RayBackend): `register_ray()` then
+`with joblib.parallel_backend("ray_tpu"): ...` runs scikit-learn-style
+Parallel() workloads as cluster tasks."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import ray_tpu
+
+
+def register_ray() -> None:
+    """Register the 'ray_tpu' joblib parallel backend."""
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)
+
+
+try:  # joblib is in the image; keep the module importable without it anyway
+    from joblib._parallel_backends import (
+        AutoBatchingMixin as _AutoBatchingMixin,
+        ParallelBackendBase as _ParallelBackendBase,
+        PoolManagerMixin as _PoolManagerMixin,
+    )
+except Exception:  # pragma: no cover
+    _AutoBatchingMixin = _ParallelBackendBase = _PoolManagerMixin = object  # type: ignore[assignment,misc]
+
+
+class RayTpuBackend(_PoolManagerMixin, _AutoBatchingMixin,
+                    _ParallelBackendBase):  # type: ignore[valid-type,misc]
+    """Each joblib batch becomes one cluster task, dispatched through the
+    multiprocessing Pool shim (ref: util/joblib RayBackend, which wraps
+    ray.util.multiprocessing.Pool the same way)."""
+
+    supports_timeout = True
+    uses_threads = False
+    supports_sharedmem = False
+
+    def effective_n_jobs(self, n_jobs: Optional[int]) -> int:
+        if n_jobs == 1:
+            return 1
+        cpus = max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+        if n_jobs is None or n_jobs == -1:
+            return cpus
+        return min(n_jobs, cpus) if n_jobs > 0 else cpus
+
+    def configure(self, n_jobs: int = 1, parallel=None, prefer=None,
+                  require=None, **memmapping_args) -> int:
+        from ray_tpu.util.multiprocessing import Pool
+
+        ray_tpu.init(ignore_reinit_error=True)
+        n_jobs = self.effective_n_jobs(n_jobs)
+        self.parallel = parallel
+        self._pool = Pool(processes=n_jobs)
+        return n_jobs

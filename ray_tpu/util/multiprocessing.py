@@ -1,0 +1,195 @@
+"""multiprocessing.Pool API over ray_tpu tasks
+(ref: python/ray/util/multiprocessing/pool.py — drop-in Pool whose work
+items run as cluster tasks instead of local forked processes)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs: List[Any], single: bool,
+                 callback: Optional[Callable] = None,
+                 error_callback: Optional[Callable] = None):
+        self._refs = refs
+        self._single = single
+        self._callback = callback
+        self._error_callback = error_callback
+        self._fired = False
+
+    def get(self, timeout: Optional[float] = None):
+        try:
+            vals = ray_tpu.get(self._refs, timeout=timeout)
+        except Exception as e:
+            if self._error_callback is not None and not self._fired:
+                self._fired = True
+                self._error_callback(e)
+            raise
+        value = vals[0] if self._single else vals
+        if self._callback is not None and not self._fired:
+            self._fired = True
+            self._callback(value)
+        return value
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")  # multiprocessing contract
+        try:
+            ray_tpu.get(self._refs, timeout=0)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Cluster-backed process pool.  ``processes`` bounds concurrent chunks
+    (defaults to cluster CPUs); tasks inherit the usual scheduling."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()):
+        ray_tpu.init(ignore_reinit_error=True)
+        if processes is None:
+            cpus = ray_tpu.cluster_resources().get("CPU", 1)
+            processes = max(1, int(cpus))
+        self._processes = processes
+        self._initializer = initializer
+        self._initargs = initargs
+        self._closed = False
+
+        import threading
+
+        init = initializer
+        iargs = initargs
+        init_lock = threading.Lock()  # thread-tier workers share the process
+        init_done = [False]
+
+        @ray_tpu.remote
+        def run_chunk(fn, chunk, star):
+            if init is not None:
+                with init_lock:  # once-guard: no check-then-set race
+                    if not init_done[0]:
+                        init(*iargs)
+                        init_done[0] = True
+            if star:
+                return [fn(*a) for a in chunk]
+            return [fn(a) for a in chunk]
+
+        self._run_chunk = run_chunk
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        """Lazy chunking — never materializes the full iterable (matters for
+        imap over large/endless streams)."""
+        if chunksize is None:
+            # Without len() we cannot derive the multiprocessing heuristic;
+            # a modest fixed chunk keeps tasks coarse enough.
+            chunksize = 8
+        it = iter(iterable)
+        while True:
+            chunk = list(itertools.islice(it, chunksize))
+            if not chunk:
+                return
+            yield chunk
+
+    # ------------------------------------------------------------- map APIs
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn, iterable, chunksize: Optional[int] = None,
+                  _star: bool = False):
+        self._check_open()
+        refs = [self._run_chunk.remote(fn, c, _star)
+                for c in self._chunks(iterable, chunksize)]
+        return _ChunkedResult(refs)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple],
+                chunksize: Optional[int] = None) -> List[Any]:
+        # Items star-unpack ONLY here; map passes each item as one argument
+        # even when it is a tuple (the multiprocessing contract).
+        return self.map_async(fn, [tuple(a) for a in iterable], chunksize,
+                              _star=True).get()
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        """Lazily yields in order with a window of chunks in flight — real
+        pipelining, unlike submit-then-wait per chunk."""
+        self._check_open()
+        window = max(2, self._processes)
+        pending: List[Any] = []
+        chunks = self._chunks(iterable, chunksize)
+        done = False
+        while not done or pending:
+            while not done and len(pending) < window:
+                try:
+                    chunk = next(chunks)
+                except StopIteration:
+                    done = True
+                    break
+                pending.append(self._run_chunk.remote(fn, chunk, False))
+            if pending:
+                for v in ray_tpu.get(pending.pop(0)):
+                    yield v
+
+    imap_unordered = imap  # chunk-granular ordering is close enough here
+
+    # ------------------------------------------------------------ apply APIs
+    def apply(self, fn: Callable, args: tuple = (), kwds: Optional[dict] = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: Optional[dict] = None,
+                    callback: Optional[Callable] = None,
+                    error_callback: Optional[Callable] = None):
+        self._check_open()
+        kwds = kwds or {}
+
+        @ray_tpu.remote
+        def run_one():
+            return fn(*args, **kwds)
+
+        return AsyncResult([run_one.remote()], single=True,
+                           callback=callback, error_callback=error_callback)
+
+    # ------------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("Pool is still running")
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _ChunkedResult(AsyncResult):
+    def __init__(self, refs: List[Any]):
+        super().__init__(refs, single=False)
+
+    def get(self, timeout: Optional[float] = None):
+        out: List[Any] = []
+        for chunk in ray_tpu.get(self._refs, timeout=timeout):
+            out.extend(chunk)
+        return out

@@ -87,3 +87,62 @@ def test_queue_shared_across_tasks():
     ray_tpu.get(producer.remote(q, 5))
     assert sorted(q.get() for _ in range(5)) == [0, 1, 2, 3, 4]
     q.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# multiprocessing.Pool + joblib shims (ref: python/ray/util/multiprocessing,
+# util/joblib) and distributed Dataset writes.
+# ---------------------------------------------------------------------------
+
+def _sq(x):
+    return x * x
+
+
+def _addt(a, b):
+    return a + b
+
+
+def test_multiprocessing_pool(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as pool:
+        assert pool.map(_sq, range(10)) == [x * x for x in range(10)]
+        assert pool.starmap(_addt, [(1, 2), (3, 4)]) == [3, 7]
+        assert list(pool.imap(_sq, [2, 3])) == [4, 9]
+        r = pool.apply_async(_addt, (5, 6))
+        assert r.get(timeout=30) == 11
+        assert pool.apply(_sq, (9,)) == 81
+    with pytest.raises(ValueError):
+        pool.map(_sq, [1])  # closed
+
+
+def test_joblib_backend(ray_start_regular):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(8))
+    assert out == [i * i for i in range(8)]
+
+
+def test_dataset_write_json_and_parquet(ray_start_regular, tmp_path):
+    import json
+    import os
+
+    from ray_tpu import data as rdata
+
+    ds = rdata.range(20, parallelism=4)
+    jdir = str(tmp_path / "j")
+    ds.write_json(jdir)
+    rows = []
+    for name in sorted(os.listdir(jdir)):
+        with open(os.path.join(jdir, name)) as f:
+            rows.extend(json.loads(line) for line in f)
+    assert sorted(r["id"] for r in rows) == list(range(20))
+
+    pdir = str(tmp_path / "p")
+    ds.write_parquet(pdir)
+    back = rdata.read_parquet(pdir)
+    assert sorted(r["id"] for r in back.take_all()) == list(range(20))
