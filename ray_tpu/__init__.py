@@ -144,15 +144,15 @@ def method(**options):
 
 
 def nodes():
-    return [n.snapshot() for n in _ensure_init().scheduler.nodes()]
+    return _ensure_init().nodes()
 
 
 def cluster_resources() -> Dict[str, float]:
-    return _ensure_init().scheduler.cluster_resources()
+    return _ensure_init().cluster_resources()
 
 
 def available_resources() -> Dict[str, float]:
-    return _ensure_init().scheduler.available_resources()
+    return _ensure_init().available_resources()
 
 
 def timeline(filename: Optional[str] = None) -> list:
@@ -167,8 +167,7 @@ def timeline(filename: Optional[str] = None) -> list:
         from ray_tpu._private import profiling
 
         return profiling.dump_timeline(filename)
-    with runtime._events_lock:
-        return list(runtime.task_events)
+    return runtime.list_task_events()
 
 
 class _RuntimeContext:

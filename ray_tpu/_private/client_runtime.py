@@ -84,6 +84,18 @@ class ClientRuntime:
     def get_named_actor(self, name: str, namespace: Optional[str] = None):
         return self._call("get_named_actor", name, namespace)
 
+    def cluster_resources(self):
+        return self._call("cluster_resources")
+
+    def available_resources(self):
+        return self._call("available_resources")
+
+    def nodes(self):
+        return self._call("nodes")
+
+    def list_task_events(self):
+        return self._call("list_task_events")
+
     def get_actor_state(self, actor_id):
         # Worker-side callers (ray_tpu.get_actor) need .spec.cls and
         # .spec.max_task_retries plus .state — return a lightweight shim.
@@ -103,8 +115,17 @@ class ClientRuntime:
         shim.state = state_name
         return shim
 
-    def shutdown(self) -> None:  # the driver owns lifecycle
-        pass
+    def shutdown(self) -> None:
+        """ray:// drivers close their TCP transport, ending the server's
+        per-connection serve thread and releasing the refs it borrowed on
+        this driver's behalf.  Process workers (pipe backchannel) must NOT
+        close: the driver owns that lifecycle, and a user task calling
+        ray_tpu.shutdown() inside a pooled worker would wedge the worker."""
+        if getattr(self, "_client_conn", None) is not None:
+            try:
+                self._client_conn.close()
+            except Exception:
+                pass
 
 
 def serve_backchannel(conn, describe: str = "") -> None:
@@ -176,6 +197,14 @@ def _handle(runtime, kind: str, payload: tuple) -> Any:
         return runtime.cancel(serialization.loads(payload[0]), force=payload[1])
     if kind == "get_named_actor":
         return runtime.get_named_actor(payload[0], payload[1])
+    if kind == "cluster_resources":
+        return runtime.cluster_resources()
+    if kind == "available_resources":
+        return runtime.available_resources()
+    if kind == "nodes":
+        return runtime.nodes()
+    if kind == "list_task_events":
+        return runtime.list_task_events()
     if kind == "actor_info":
         state = runtime.get_actor_state(payload[0])
         if state is None:

@@ -38,6 +38,29 @@ class Config:
     #: process workers instead of the pipe (zero-copy handoff).
     plasma_handoff_threshold: int = 128 * 1024
 
+    # --- object transfer (node-to-node plane, ref: object_manager.h:117) ---
+    #: Start the TCP object server at init so ObjectRefs leaving this process
+    #: carry a routable owner address (ownership-based directory).
+    enable_object_transfer: bool = False
+    #: Interface the object server binds ("127.0.0.1" keeps it host-local;
+    #: set to the host's DCN address for multi-host clusters).
+    object_transfer_host: str = "127.0.0.1"
+    #: Payload slice size for chunked sends (ref: object_manager_chunk_size).
+    object_transfer_chunk_bytes: int = 1 << 20
+    #: Bound on total in-flight pull payload bytes (ref: pull_manager.h:52
+    #: memory-bounded pull requests).
+    max_inflight_pull_bytes: int = 256 << 20
+    #: Socket/connect timeout per pull request, and the default bound for
+    #: fire-and-forget dependency pulls.
+    object_transfer_pull_timeout_s: float = 30.0
+    #: How long the owner-side server waits for a PENDING object to seal
+    #: before answering ST_PENDING (the borrower then retries, so gets with
+    #: no deadline wait indefinitely for long-running producers).
+    object_transfer_serve_wait_s: float = 1.0
+    #: Transient-failure retries for fire-and-forget dependency pulls before
+    #: the waiting task is failed with ObjectTransferError.
+    object_transfer_pull_retries: int = 3
+
     # --- scheduling ---
     #: Pack-then-spread crossover used by the hybrid policy
     #: (ref: hybrid_scheduling_policy.h:50 spread_threshold=0.5).

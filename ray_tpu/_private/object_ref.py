@@ -16,18 +16,46 @@ from ray_tpu._private.ids import ObjectID
 
 
 class ObjectRef:
-    __slots__ = ("id", "owner", "_released", "__weakref__")
+    __slots__ = ("id", "owner", "owner_addr", "_released", "__weakref__")
 
-    def __init__(self, object_id: ObjectID, owner: str = "", _add_ref: bool = True):
+    def __init__(self, object_id: ObjectID, owner: str = "",
+                 owner_addr: str = "", _add_ref: bool = True):
         self.id = ObjectID(object_id)
         self.owner = owner
+        self.owner_addr = owner_addr
         self._released = False
         if _add_ref:
             _refcounter.add(self.id)
 
     @staticmethod
-    def _deserialize(object_id: str, owner: str) -> "ObjectRef":
-        return ObjectRef(ObjectID(object_id), owner)
+    def _deserialize(object_id: str, owner: str, owner_addr: str = "") -> "ObjectRef":
+        return ObjectRef(ObjectID(object_id), owner, owner_addr)
+
+    def _routable_owner_addr(self) -> str:
+        """Owner address to embed when this ref crosses a process boundary.
+
+        A ref minted in this process (empty ``owner_addr``) is stamped with
+        the local object server's address when one is running AND this
+        process actually owns the object (holds or is producing it), making
+        it the routable owner (ownership-based directory — ref:
+        ownership_based_object_directory.h).  Refs that arrived from
+        elsewhere keep their original owner address; a mere forwarder that
+        never held the value must not claim ownership.
+        """
+        if self.owner_addr:
+            return self.owner_addr
+        from ray_tpu._private.object_transfer import local_server_addr
+
+        addr = local_server_addr()
+        if not addr:
+            return ""
+        from ray_tpu._private.runtime import runtime_or_none
+
+        rt = runtime_or_none()
+        owns = getattr(rt, "owns_object", None)
+        if owns is None or not owns(self.id):
+            return ""
+        return addr
 
     def __reduce__(self):
         # EVERY pickle path must reconstruct through _deserialize (which
@@ -36,7 +64,8 @@ class ObjectRef:
         # through plain pickle would leak a negative count and free live
         # objects.  (serialization._Pickler additionally captures the ref
         # for borrow tracking via reducer_override.)
-        return (ObjectRef._deserialize, (str(self.id), self.owner))
+        return (ObjectRef._deserialize,
+                (str(self.id), self.owner, self._routable_owner_addr()))
 
     def hex(self) -> str:
         return self.id.hex()

@@ -141,6 +141,12 @@ class ObjectStore:
             e = self._entries.get(object_id)
             return e is not None and e.state in (ObjectState.READY, ObjectState.SPILLED, ObjectState.FAILED)
 
+    def state_of(self, object_id: ObjectID) -> Optional[str]:
+        """Entry state without creating an entry (None = never seen)."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e.state if e is not None else None
+
     def wait_ready(self, object_id: ObjectID, timeout: Optional[float]) -> bool:
         entry = self._ensure(object_id)
         return entry.event.wait(timeout)

@@ -1,0 +1,484 @@
+"""Node-to-node object plane: ownership directory + chunked pull/push transfer.
+
+TPU-native analogue of the reference's object manager (ref:
+src/ray/object_manager/object_manager.h:117).  Each runtime ("node") can run
+an **object server**: a TCP service that serves the serialized wire form of
+objects in its local store, in chunks (the role of the reference's chunked
+gRPC transfer, object_manager.proto).  Remote fetches go through a
+**PullManager** (ref: src/ray/object_manager/pull_manager.h:52): concurrent
+pulls of the same object are deduplicated, total in-flight bytes are bounded,
+and completed pulls land in the local store's serialized tier, waking any
+task/get/wait blocked on the object.  A **push** path (ref:
+src/ray/object_manager/push_manager.h:30) proactively sends an object to a
+peer using the same chunk frames in the opposite direction.
+
+The directory is **ownership-based** (ref: src/ray/object_manager/
+ownership_based_object_directory.h): there is no central location service.
+An ``ObjectRef`` that crosses a process boundary while its owner's object
+server is running carries the owner's ``host:port`` in ``owner_addr``; the
+owner holds the primary copy (restoring it from spill if needed), so
+locating an object is just reading its ref — the same trick the reference
+plays by embedding ownership in the object id.
+
+Lifetime note: a pulled copy is a *cache* on the borrowing node, freed by
+that node's local refcounter; the owner keeps the primary copy alive for as
+long as its own refs (or pins) exist.
+
+Wire protocol (all integers little-endian):
+
+    request  := op:u8  id_len:u16  id:bytes
+                [PUSH only: owner_len:u16 owner:bytes size:u64 payload:bytes]
+    PULL resp     := status:u8  [ok: size:u64 payload:bytes]
+    CONTAINS resp := status:u8   (0 = present)
+    PUSH resp     := status:u8
+    FREE resp     := status:u8   (drop a cached copy; no-op if absent)
+
+Payloads stream in ``object_transfer_chunk_bytes`` slices; there is no
+per-chunk framing because TCP already provides ordered delivery — the size
+header tells the receiver exactly how many bytes to expect.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.exceptions import ObjectLostError
+
+OP_PULL = 1
+OP_CONTAINS = 2
+OP_PUSH = 3
+OP_FREE = 4
+
+ST_OK = 0
+ST_NOT_FOUND = 1
+ST_ERROR = 2
+#: The owner knows the object (entry pending / producing task in flight) but
+#: it is not ready yet — the borrower should keep waiting, NOT declare loss.
+ST_PENDING = 3
+
+# Address of this process's running object server ("" = not running).  Module
+# level so ObjectRef.__reduce__ can stamp refs without importing the runtime.
+_LOCAL_ADDR = ""
+_LOCAL_ADDR_LOCK = threading.Lock()
+
+
+def local_server_addr() -> str:
+    return _LOCAL_ADDR
+
+
+def _set_local_addr(addr: str) -> None:
+    global _LOCAL_ADDR
+    with _LOCAL_ADDR_LOCK:
+        _LOCAL_ADDR = addr
+
+
+class ObjectTransferError(ObjectLostError):
+    """A remote pull failed (owner unreachable or object unknown there)."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed mid-frame")
+        got += r
+    return bytes(buf)
+
+
+def _recv_into(sock: socket.socket, total: int) -> bytearray:
+    buf = bytearray(total)
+    view = memoryview(buf)
+    got = 0
+    while got < total:
+        r = sock.recv_into(view[got:], min(total - got, 1 << 20))
+        if r == 0:
+            raise ConnectionError("peer closed mid-payload")
+        got += r
+    return buf
+
+
+def _send_payload(sock: socket.socket, payload) -> None:
+    chunk = max(64 * 1024, GLOBAL_CONFIG.object_transfer_chunk_bytes)
+    view = memoryview(payload)
+    for off in range(0, len(view), chunk):
+        sock.sendall(view[off:off + chunk])
+
+
+class ObjectTransferServer:
+    """Per-node TCP object service over the local object store.
+
+    ``store_provider`` returns the live ObjectStore (re-read per request so a
+    runtime restart mid-session doesn't serve a stale store); ``on_received``
+    is invoked after a PUSH lands so the runtime can wake dependent tasks.
+    """
+
+    def __init__(self, store_provider: Callable[[], object],
+                 on_received: Optional[Callable[[ObjectID], None]] = None,
+                 is_pending: Optional[Callable[[ObjectID], bool]] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._store_provider = store_provider
+        self._on_received = on_received
+        self._is_pending = is_pending
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.host, self.port = self._sock.getsockname()
+        self.addr = f"{self.host}:{self.port}"
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="objxfer-accept", daemon=True)
+        self._accept_thread.start()
+        _set_local_addr(self.addr)
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                # Transient per-connection errors (ECONNABORTED from a client
+                # resetting mid-handshake) must not kill the listener; only a
+                # stop() or a closed socket ends the loop.
+                if self._stop.is_set() or self._sock.fileno() < 0:
+                    return
+                continue
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name="objxfer-conn", daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while not self._stop.is_set():
+                head = conn.recv(1)
+                if not head:
+                    return
+                op = head[0]
+                (id_len,) = struct.unpack("<H", _recv_exact(conn, 2))
+                oid = ObjectID(_recv_exact(conn, id_len).decode())
+                if op == OP_PULL:
+                    self._handle_pull(conn, oid)
+                elif op == OP_CONTAINS:
+                    store = self._store_provider()
+                    ok = store is not None and store.contains(oid)
+                    conn.sendall(bytes([ST_OK if ok else ST_NOT_FOUND]))
+                elif op == OP_PUSH:
+                    self._handle_push(conn, oid)
+                elif op == OP_FREE:
+                    store = self._store_provider()
+                    if store is not None:
+                        store.free(oid)
+                    conn.sendall(bytes([ST_OK]))
+                else:
+                    conn.sendall(bytes([ST_ERROR]))
+                    return
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_pull(self, conn: socket.socket, oid: ObjectID) -> None:
+        store = self._store_provider()
+        if store is None:
+            conn.sendall(bytes([ST_NOT_FOUND]))
+            return
+        state = store.state_of(oid)
+        known = state is not None or (
+            self._is_pending is not None and self._is_pending(oid))
+        if not known:
+            # The owner has never seen this object and nothing is producing
+            # it: answer immediately — this is genuine loss, and waiting
+            # would just stall the borrower.
+            conn.sendall(bytes([ST_NOT_FOUND]))
+            return
+        try:
+            # Wait a bounded slice for a pending object to seal (the owner
+            # may still be computing it); the borrower retries on ST_PENDING
+            # so a long-running producer never turns into a false NOT_FOUND.
+            view = store.get_serialized(
+                oid, timeout=GLOBAL_CONFIG.object_transfer_serve_wait_s)
+            # Copy before sending: serialized views are only stable until the
+            # next store operation that may spill (see ObjectStore docstring).
+            payload = bytes(view)
+        except Exception:
+            still_coming = store.state_of(oid) in (None, "PENDING") and known
+            conn.sendall(bytes([ST_PENDING if still_coming else ST_NOT_FOUND]))
+            return
+        conn.sendall(bytes([ST_OK]) + struct.pack("<Q", len(payload)))
+        _send_payload(conn, payload)
+
+    def _handle_push(self, conn: socket.socket, oid: ObjectID) -> None:
+        (owner_len,) = struct.unpack("<H", _recv_exact(conn, 2))
+        owner = _recv_exact(conn, owner_len).decode() if owner_len else ""
+        (size,) = struct.unpack("<Q", _recv_exact(conn, 8))
+        payload = _recv_into(conn, size)
+        store = self._store_provider()
+        if store is None:
+            conn.sendall(bytes([ST_ERROR]))
+            return
+        if not store.contains(oid):
+            store.put_serialized(oid, bytes(payload), owner=owner)
+            if self._on_received is not None:
+                self._on_received(oid)
+        conn.sendall(bytes([ST_OK]))
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if local_server_addr() == self.addr:
+            _set_local_addr("")
+
+
+def _request_sock(addr: str, timeout: float) -> socket.socket:
+    host, port = addr.rsplit(":", 1)
+    sock = socket.create_connection((host, int(port)), timeout=timeout)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def _req_header(op: int, oid: ObjectID) -> bytes:
+    idb = str(oid).encode()
+    return bytes([op]) + struct.pack("<H", len(idb)) + idb
+
+
+class PullManager:
+    """Client side of the transfer plane (ref: pull_manager.h:52).
+
+    Deduplicates concurrent pulls of the same object, bounds total in-flight
+    payload bytes (`max_inflight_pull_bytes`), and lands completed pulls in
+    the local store's serialized tier via ``on_complete``.
+    """
+
+    def __init__(self, store, on_complete: Optional[Callable[[ObjectID], None]] = None,
+                 on_failure: Optional[Callable[[ObjectID, str], None]] = None,
+                 is_live: Optional[Callable[[ObjectID], bool]] = None):
+        self._store = store
+        self._on_complete = on_complete
+        self._on_failure = on_failure
+        self._is_live = is_live
+        self._lock = threading.Lock()
+        self._inflight: Dict[ObjectID, threading.Event] = {}
+        self._errors: Dict[ObjectID, str] = {}
+        self._inflight_bytes = 0
+        self._bytes_cv = threading.Condition(self._lock)
+        self.stats = {"pulls": 0, "pull_bytes": 0, "dedup_hits": 0, "failures": 0}
+
+    # ------------------------------------------------------------------ async
+    def request(self, oid: ObjectID, addr: str) -> None:
+        """Fire-and-forget pull; completion wakes store waiters, terminal
+        failure (after retries) reports through ``on_failure`` so tasks
+        blocked on the dependency fail instead of hanging forever."""
+        with self._lock:
+            if self._store.contains(oid) or oid in self._inflight:
+                self.stats["dedup_hits"] += 1
+                return
+            ev = threading.Event()
+            self._inflight[oid] = ev
+        threading.Thread(target=self._pull_into_store, args=(oid, addr, ev),
+                         kwargs={"retries": GLOBAL_CONFIG.object_transfer_pull_retries,
+                                 "report_failure": True},
+                         name="objxfer-pull", daemon=True).start()
+
+    # --------------------------------------------------------------- blocking
+    def pull_blocking(self, oid: ObjectID, addr: str,
+                      timeout: Optional[float] = None) -> None:
+        """Pull (or join an in-flight pull) and wait for it to land.
+
+        ``timeout=None`` waits indefinitely (matching local get semantics —
+        the owner answers ST_PENDING while a producer is still running, and
+        we keep retrying); ``timeout<=0`` is an immediate-deadline probe.
+        """
+        if timeout is not None and timeout <= 0:
+            if self._store.contains(oid):
+                return
+            from ray_tpu.exceptions import GetTimeoutError
+
+            raise GetTimeoutError(f"object {oid} not local and timeout<=0")
+        wait_s = timeout
+        with self._lock:
+            if self._store.contains(oid):
+                return
+            ev = self._inflight.get(oid)
+            if ev is None:
+                ev = threading.Event()
+                self._inflight[oid] = ev
+                starter = True
+            else:
+                self.stats["dedup_hits"] += 1
+                starter = False
+        if starter:
+            self._pull_into_store(oid, addr, ev, timeout=wait_s)
+        else:
+            if not ev.wait(wait_s):
+                from ray_tpu.exceptions import GetTimeoutError
+
+                raise GetTimeoutError(
+                    f"timed out waiting for in-flight pull of {oid}")
+        if not self._store.contains(oid):
+            # Read without popping: several callers may be joined on the same
+            # failed pull and each must observe the error.  A mere timeout is
+            # GetTimeoutError (retryable, matching local get semantics), not
+            # object loss.
+            with self._lock:
+                entry = self._errors.get(oid)
+            timed_out, err = entry if entry else (False, None)
+            if timed_out:
+                from ray_tpu.exceptions import GetTimeoutError
+
+                raise GetTimeoutError(err)
+            raise ObjectTransferError(
+                err or f"pull of {oid} from {addr} did not land")
+
+    def _pull_into_store(self, oid: ObjectID, addr: str, ev: threading.Event,
+                         timeout: Optional[float] = None, retries: int = 0,
+                         report_failure: bool = False) -> None:
+        try:
+            attempt = 0
+            while True:
+                try:
+                    payload = self._fetch(oid, addr, timeout)
+                    break
+                except Exception:
+                    attempt += 1
+                    if attempt > retries:
+                        raise
+                    import time
+
+                    time.sleep(min(1.0, 0.1 * (2 ** attempt)))
+            if self._is_live is not None and not self._is_live(oid):
+                # Every local ref died while the pull was in flight: landing
+                # the payload now would park unreclaimable bytes in the store
+                # (the zero-refcount callback already fired).  Drop it.
+                return
+            if not self._store.contains(oid):
+                self._store.put_serialized(oid, payload)
+            with self._lock:
+                self.stats["pulls"] += 1
+                self.stats["pull_bytes"] += len(payload)
+                self._errors.pop(oid, None)
+            if self._on_complete is not None:
+                self._on_complete(oid)
+        except Exception as e:  # noqa: BLE001 — recorded, surfaced to waiters
+            timed_out = isinstance(e, (socket.timeout, TimeoutError))
+            msg = f"pull of {oid} from {addr} failed: {e!r}"
+            with self._lock:
+                self.stats["failures"] += 1
+                if len(self._errors) > 4096:  # bounded error memory
+                    self._errors.pop(next(iter(self._errors)))
+                self._errors[oid] = (timed_out, msg)
+            if report_failure and self._on_failure is not None:
+                # Dependency pulls already retried; even a timeout is
+                # terminal for the parked task at this point.
+                self._on_failure(oid, msg)
+        finally:
+            with self._lock:
+                self._inflight.pop(oid, None)
+            ev.set()
+
+    def _fetch(self, oid: ObjectID, addr: str,
+               timeout: Optional[float] = None) -> bytes:
+        """One logical pull; retries while the owner answers ST_PENDING.
+
+        ``timeout=None`` = no deadline (the per-request socket timeout still
+        bounds each round trip, so a dead owner raises promptly).
+        """
+        import time
+
+        sock_timeout = GLOBAL_CONFIG.object_transfer_pull_timeout_s
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"pull of {oid} from {addr} timed out")
+                sock_timeout = min(
+                    GLOBAL_CONFIG.object_transfer_pull_timeout_s,
+                    max(remaining, 0.05))
+            sock = _request_sock(addr, sock_timeout)
+            try:
+                sock.sendall(_req_header(OP_PULL, oid))
+                status = _recv_exact(sock, 1)[0]
+                if status == ST_PENDING:
+                    # Producer still running on the owner — keep waiting.
+                    time.sleep(0.05)
+                    continue
+                if status != ST_OK:
+                    raise ObjectTransferError(
+                        f"owner at {addr} has no object {oid} (status={status})")
+                (size,) = struct.unpack("<Q", _recv_exact(sock, 8))
+                self._acquire_budget(size, sock_timeout)
+                try:
+                    return bytes(_recv_into(sock, size))
+                finally:
+                    self._release_budget(size)
+            finally:
+                sock.close()
+
+    def _acquire_budget(self, size: int, timeout: float) -> None:
+        cap = GLOBAL_CONFIG.max_inflight_pull_bytes
+        with self._bytes_cv:
+            # A single object larger than the cap is admitted alone rather
+            # than deadlocking (the reference's pull manager makes the same
+            # at-least-one-request progress guarantee).
+            while self._inflight_bytes > 0 and self._inflight_bytes + size > cap:
+                if not self._bytes_cv.wait(timeout):
+                    raise ObjectTransferError(
+                        f"pull budget ({cap} bytes) not available within {timeout}s")
+            self._inflight_bytes += size
+
+    def _release_budget(self, size: int) -> None:
+        with self._bytes_cv:
+            self._inflight_bytes -= size
+            self._bytes_cv.notify_all()
+
+
+# ------------------------------------------------------------------- one-shots
+def contains(addr: str, oid: ObjectID, timeout: float = 5.0) -> bool:
+    sock = _request_sock(addr, timeout)
+    try:
+        sock.sendall(_req_header(OP_CONTAINS, oid))
+        return _recv_exact(sock, 1)[0] == ST_OK
+    finally:
+        sock.close()
+
+
+def push(store, oid: ObjectID, addr: str, owner: str = "",
+         timeout: Optional[float] = None) -> None:
+    """Proactively send a local object to a peer (ref: push_manager.h:30)."""
+    timeout = timeout if timeout is not None \
+        else GLOBAL_CONFIG.object_transfer_pull_timeout_s
+    payload = bytes(store.get_serialized(oid, timeout=timeout))
+    sock = _request_sock(addr, timeout)
+    try:
+        ob = owner.encode()
+        sock.sendall(_req_header(OP_PUSH, oid) + struct.pack("<H", len(ob)) + ob
+                     + struct.pack("<Q", len(payload)))
+        _send_payload(sock, payload)
+        status = _recv_exact(sock, 1)[0]
+        if status != ST_OK:
+            raise ObjectTransferError(f"push of {oid} to {addr} rejected ({status})")
+    finally:
+        sock.close()
+
+
+def free_remote(addr: str, oid: ObjectID, timeout: float = 5.0) -> None:
+    """Ask a peer to drop its copy of an object (cache invalidation)."""
+    sock = _request_sock(addr, timeout)
+    try:
+        sock.sendall(_req_header(OP_FREE, oid))
+        _recv_exact(sock, 1)
+    finally:
+        sock.close()

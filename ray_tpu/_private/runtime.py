@@ -275,6 +275,14 @@ class Runtime:
         self.refcounter = global_refcounter()
         self.refcounter.set_zero_callback(self._on_zero_refs)
 
+        # Node-to-node object plane (ref: object_manager.h:117) — opt-in: the
+        # server makes refs leaving this process carry a routable owner
+        # address; the pull manager fetches remote-owned refs on demand.
+        self.object_server = None
+        self._pull_mgr = None
+        if self.config.enable_object_transfer:
+            self.start_object_server()
+
         # Head node resources.
         from ray_tpu._private.accelerators import detect_accelerators
 
@@ -349,6 +357,82 @@ class Runtime:
         self.store.put(object_id, value, owner=_owner)
         return ObjectRef(object_id, owner=_owner)
 
+    # --------------------------------------------------- cluster introspection
+    # Uniform surface shared with ClientRuntime so the public API never has
+    # to reach into `.scheduler` / private state (ray:// proxies these).
+    def cluster_resources(self) -> Dict[str, float]:
+        return self.scheduler.cluster_resources()
+
+    def available_resources(self) -> Dict[str, float]:
+        return self.scheduler.available_resources()
+
+    def nodes(self) -> List[dict]:
+        return [n.snapshot() for n in self.scheduler.nodes()]
+
+    def list_task_events(self) -> List[dict]:
+        with self._events_lock:
+            return list(self.task_events)
+
+    # --------------------------------------------------------- object plane
+    def start_object_server(self) -> str:
+        """Start (idempotently) the node object server; returns host:port."""
+        from ray_tpu._private import object_transfer
+
+        if self.object_server is None:
+            self.object_server = object_transfer.ObjectTransferServer(
+                lambda: self.store, on_received=self._on_object_ready,
+                is_pending=self._object_is_pending,
+                host=self.config.object_transfer_host)
+        self._pull_manager()  # pulls and serves share a lifetime
+        return self.object_server.addr
+
+    def _object_is_pending(self, object_id: ObjectID) -> bool:
+        """Owner-side directory answer: is something still producing this
+        object (so a remote pull should wait instead of declaring loss)?"""
+        task_id = object_id.task_id()
+        if task_id in self._inflight:
+            return True
+        with self._lineage_lock:
+            return object_id in self._lineage
+
+    def owns_object(self, object_id: ObjectID) -> bool:
+        """Is this process the object's owner (holder or producer)?  Used to
+        decide whether refs leaving here may claim our server address —
+        forwarding someone else's ref must not claim ownership."""
+        return self.store.state_of(object_id) is not None \
+            or self._object_is_pending(object_id)
+
+    def _pull_manager(self):
+        from ray_tpu._private import object_transfer
+
+        if self._pull_mgr is None:
+            self._pull_mgr = object_transfer.PullManager(
+                self.store, on_complete=self._on_object_ready,
+                on_failure=self._on_pull_failed,
+                is_live=lambda oid: self.refcounter.count(oid) > 0)
+        return self._pull_mgr
+
+    def _on_pull_failed(self, object_id: ObjectID, msg: str) -> None:
+        """Terminal failure of a dependency pull: poison the store entry so
+        tasks parked on it dispatch, observe the error while resolving args,
+        and fail instead of hanging (the object may still be re-created by
+        lineage or a later successful pull overwriting nothing — the entry is
+        already FAILED and get() raises)."""
+        from ray_tpu._private.object_transfer import ObjectTransferError
+
+        if not self.store.contains(object_id):
+            self.store.put_error(object_id, ObjectTransferError(msg))
+            self._on_object_ready(object_id)
+
+    def _remote_owner_addr(self, ref: ObjectRef) -> str:
+        """The address to pull a ref from, or "" if it is locally owned."""
+        addr = getattr(ref, "owner_addr", "")
+        if not addr:
+            return ""
+        if self.object_server is not None and addr == self.object_server.addr:
+            return ""
+        return addr
+
     # ------------------------------------------------------------------- gets
     def get(self, refs: Any, timeout: Optional[float] = None) -> Any:
         single = isinstance(refs, ObjectRef)
@@ -373,8 +457,14 @@ class Runtime:
 
     def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
         if not self.store.contains(ref.id):
+            addr = self._remote_owner_addr(ref)
+            if addr:
+                # Remote-owned: fetch the primary copy from the owner's
+                # object server (ref: pull_manager.h:52).  Raises
+                # ObjectTransferError (an ObjectLostError) on failure.
+                self._pull_manager().pull_blocking(ref.id, addr, timeout)
             task_id = ref.id.task_id()
-            if task_id not in self._inflight:
+            if task_id not in self._inflight and not addr:
                 # Not in flight and no value: the object was lost (evicted,
                 # freed, or its producing worker died) — reconstruct from
                 # lineage (ref: object_recovery_manager.h:38).
@@ -413,6 +503,11 @@ class Runtime:
         refs = list(refs)
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds number of refs")
+        if fetch_local:
+            for r in refs:
+                addr = self._remote_owner_addr(r)
+                if addr and not self.store.contains(r.id):
+                    self._pull_manager().request(r.id, addr)
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[ObjectRef] = []
         pending = list(refs)
@@ -468,11 +563,16 @@ class Runtime:
         return refs[0] if spec.num_returns == 1 else refs
 
     def _enqueue_after_deps(self, spec: TaskSpec) -> None:
-        deps = {
-            a.id
-            for a in list(spec.args) + list(spec.kwargs.values())
-            if isinstance(a, ObjectRef) and not self.store.contains(a.id)
-        }
+        deps = set()
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if isinstance(a, ObjectRef) and not self.store.contains(a.id):
+                deps.add(a.id)
+                addr = self._remote_owner_addr(a)
+                if addr:
+                    # Remote-owned dependency: start pulling now so the task
+                    # unblocks when the transfer lands (the reference's
+                    # DependencyManager subscribes+pulls args the same way).
+                    self._pull_manager().request(a.id, addr)
         if not deps:
             self._ready.put(spec)
             return
@@ -1090,6 +1190,9 @@ class Runtime:
                 state.mailbox.put(None)
         self.process_pool.shutdown()
         self._exec_pool.shutdown(wait=False, cancel_futures=True)
+        if self.object_server is not None:
+            self.object_server.stop()
+            self.object_server = None
         self.store.shutdown()
         self.refcounter.clear()
 

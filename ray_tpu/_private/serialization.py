@@ -60,7 +60,8 @@ class _Pickler(cloudpickle.CloudPickler):
 
         if isinstance(obj, ObjectRef):
             _capture_ref(obj)
-            return (ObjectRef._deserialize, (str(obj.id), obj.owner))
+            return (ObjectRef._deserialize,
+                    (str(obj.id), obj.owner, obj._routable_owner_addr()))
         return super().reducer_override(obj)
 
 

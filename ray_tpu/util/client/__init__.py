@@ -71,7 +71,10 @@ class ClientServer:
             try:
                 sock, addr = self._listener.accept()
             except OSError:
-                return
+                # A client aborting mid-handshake must not kill the listener.
+                if self._stop.is_set() or self._listener.fileno() < 0:
+                    return
+                continue
             conn = _SocketConn(sock)
             threading.Thread(
                 target=serve_backchannel, args=(conn,),

@@ -24,7 +24,14 @@ try:  # joblib is in the image; keep the module importable without it anyway
         PoolManagerMixin as _PoolManagerMixin,
     )
 except Exception:  # pragma: no cover
-    _AutoBatchingMixin = _ParallelBackendBase = _PoolManagerMixin = object  # type: ignore[assignment,misc]
+    _ParallelBackendBase = object  # type: ignore[assignment,misc]
+
+    class _AutoBatchingMixin:  # type: ignore[no-redef]
+        """Distinct placeholder bases — aliasing all three to ``object``
+        would raise 'duplicate base class' at class creation."""
+
+    class _PoolManagerMixin:  # type: ignore[no-redef]
+        pass
 
 
 class RayTpuBackend(_PoolManagerMixin, _AutoBatchingMixin,

@@ -5,9 +5,46 @@ items run as cluster tasks instead of local forked processes)."""
 from __future__ import annotations
 
 import itertools
+import threading
+import uuid
 from typing import Any, Callable, Iterable, List, Optional
 
 import ray_tpu
+
+# Per-executing-process once-guard for pool initializers.  Module-level so
+# the remote chunk function below pickles by reference (a closure over a
+# threading.Lock is unpicklable and would break process/client-mode pools).
+_INIT_LOCK = threading.Lock()
+_INITIALIZED_POOLS: set = set()
+
+
+def _run_chunk_impl(pool_id, init, iargs, fn, chunk, star):
+    if init is not None:
+        with _INIT_LOCK:  # once-guard per pool per process, no races
+            if pool_id not in _INITIALIZED_POOLS:
+                init(*iargs)
+                _INITIALIZED_POOLS.add(pool_id)
+    if star:
+        return [fn(*a) for a in chunk]
+    return [fn(a) for a in chunk]
+
+
+# Wrapped separately (not via decorator) so `_run_chunk_impl` stays reachable
+# under its own module attribute: cloudpickle then serializes it BY REFERENCE;
+# a decorator would shadow the name and force by-value pickling, dragging the
+# module-global lock above into the payload (unpicklable).
+_run_chunk = ray_tpu.remote(_run_chunk_impl)
+
+
+def _default_processes() -> int:
+    """Cluster CPU count, degrading gracefully in ray:// client mode where
+    the proxy runtime has no local scheduler view."""
+    try:
+        return max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+    except Exception:
+        import os
+
+        return max(1, os.cpu_count() or 1)
 
 
 class AsyncResult:
@@ -21,8 +58,12 @@ class AsyncResult:
         self._fired = False
 
     def get(self, timeout: Optional[float] = None):
+        from ray_tpu.exceptions import GetTimeoutError
+
         try:
             vals = ray_tpu.get(self._refs, timeout=timeout)
+        except GetTimeoutError:
+            raise  # a timeout is not a task failure: callbacks stay unfired
         except Exception as e:
             if self._error_callback is not None and not self._fired:
                 self._fired = True
@@ -61,36 +102,20 @@ class Pool:
                  initargs: tuple = ()):
         ray_tpu.init(ignore_reinit_error=True)
         if processes is None:
-            cpus = ray_tpu.cluster_resources().get("CPU", 1)
-            processes = max(1, int(cpus))
+            processes = _default_processes()
         self._processes = processes
         self._initializer = initializer
         self._initargs = initargs
         self._closed = False
-
-        import threading
-
-        init = initializer
-        iargs = initargs
-        init_lock = threading.Lock()  # thread-tier workers share the process
-        init_done = [False]
-
-        @ray_tpu.remote
-        def run_chunk(fn, chunk, star):
-            if init is not None:
-                with init_lock:  # once-guard: no check-then-set race
-                    if not init_done[0]:
-                        init(*iargs)
-                        init_done[0] = True
-            if star:
-                return [fn(*a) for a in chunk]
-            return [fn(a) for a in chunk]
-
-        self._run_chunk = run_chunk
+        self._pool_id = uuid.uuid4().hex
 
     def _check_open(self) -> None:
         if self._closed:
             raise ValueError("Pool not running")
+
+    def _submit_chunk(self, fn, chunk, star):
+        return _run_chunk.remote(self._pool_id, self._initializer,
+                                 self._initargs, fn, chunk, star)
 
     def _chunks(self, iterable: Iterable, chunksize: Optional[int]):
         """Lazy chunking — never materializes the full iterable (matters for
@@ -112,18 +137,28 @@ class Pool:
         return self.map_async(fn, iterable, chunksize).get()
 
     def map_async(self, fn, iterable, chunksize: Optional[int] = None,
+                  callback: Optional[Callable] = None,
+                  error_callback: Optional[Callable] = None,
                   _star: bool = False):
         self._check_open()
-        refs = [self._run_chunk.remote(fn, c, _star)
+        refs = [self._submit_chunk(fn, c, _star)
                 for c in self._chunks(iterable, chunksize)]
-        return _ChunkedResult(refs)
+        return _ChunkedResult(refs, callback=callback,
+                              error_callback=error_callback)
 
     def starmap(self, fn: Callable, iterable: Iterable[tuple],
                 chunksize: Optional[int] = None) -> List[Any]:
         # Items star-unpack ONLY here; map passes each item as one argument
         # even when it is a tuple (the multiprocessing contract).
+        return self.starmap_async(fn, iterable, chunksize).get()
+
+    def starmap_async(self, fn: Callable, iterable: Iterable[tuple],
+                      chunksize: Optional[int] = None,
+                      callback: Optional[Callable] = None,
+                      error_callback: Optional[Callable] = None):
         return self.map_async(fn, [tuple(a) for a in iterable], chunksize,
-                              _star=True).get()
+                              callback=callback, error_callback=error_callback,
+                              _star=True)
 
     def imap(self, fn: Callable, iterable: Iterable,
              chunksize: Optional[int] = None):
@@ -141,7 +176,7 @@ class Pool:
                 except StopIteration:
                     done = True
                     break
-                pending.append(self._run_chunk.remote(fn, chunk, False))
+                pending.append(self._submit_chunk(fn, chunk, False))
             if pending:
                 for v in ray_tpu.get(pending.pop(0)):
                     yield v
@@ -185,11 +220,28 @@ class Pool:
 
 
 class _ChunkedResult(AsyncResult):
-    def __init__(self, refs: List[Any]):
-        super().__init__(refs, single=False)
+    def __init__(self, refs: List[Any],
+                 callback: Optional[Callable] = None,
+                 error_callback: Optional[Callable] = None):
+        super().__init__(refs, single=False, callback=callback,
+                         error_callback=error_callback)
 
     def get(self, timeout: Optional[float] = None):
+        from ray_tpu.exceptions import GetTimeoutError
+
+        try:
+            chunks = ray_tpu.get(self._refs, timeout=timeout)
+        except GetTimeoutError:
+            raise  # a timeout is not a task failure: callbacks stay unfired
+        except Exception as e:
+            if self._error_callback is not None and not self._fired:
+                self._fired = True
+                self._error_callback(e)
+            raise
         out: List[Any] = []
-        for chunk in ray_tpu.get(self._refs, timeout=timeout):
+        for chunk in chunks:
             out.extend(chunk)
+        if self._callback is not None and not self._fired:
+            self._fired = True
+            self._callback(out)
         return out

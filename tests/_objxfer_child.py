@@ -1,0 +1,74 @@
+"""Child process for object-transfer tests: the "owner node".
+
+Starts a runtime with the object server enabled, creates objects (a small
+value, a large numpy array, a task return, and a spilled object), prints
+their pickled refs + the server address as one base64 line, then stays alive
+serving pulls until stdin closes.
+"""
+
+import base64
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import ray_tpu  # noqa: E402
+from ray_tpu._private import serialization  # noqa: E402
+
+
+@ray_tpu.remote
+def produce(n):
+    return np.full(n, 7, dtype=np.int32)
+
+
+@ray_tpu.remote
+def slow_produce(delay_s):
+    import time
+
+    time.sleep(delay_s)
+    return "slow-done"
+
+
+def main() -> None:
+    ray_tpu.init(_system_config={
+        "enable_object_transfer": True,
+        # Small store so the big object can be force-spilled below.
+        "object_store_memory": 64 << 20,
+    })
+    from ray_tpu._private.runtime import get_runtime
+
+    rt = get_runtime()
+    addr = rt.object_server.addr
+
+    small_ref = ray_tpu.put({"kind": "small", "payload": list(range(32))})
+    big = np.arange(6_000_000, dtype=np.float64)  # ~48 MB
+    big_ref = ray_tpu.put(big)
+    task_ref = produce.remote(1000)
+
+    # Force the big object into wire form, then spill it: pulls must restore
+    # from disk transparently.
+    rt.store.get_serialized(big_ref.id)
+    spill_ref = ray_tpu.put(np.ones(2_000_000))  # ~16 MB
+    rt.store.get_serialized(spill_ref.id)
+    rt.store.evict_value(spill_ref.id)
+
+    # Still computing when the parent pulls it: the owner answers ST_PENDING
+    # (longer than object_transfer_serve_wait_s) until the task finishes.
+    slow_ref = slow_produce.remote(4.0)
+
+    blob = serialization.dumps(
+        {"addr": addr, "small": small_ref, "big": big_ref,
+         "task": task_ref, "spill": spill_ref, "slow": slow_ref,
+         "big_sum": float(big.sum())})
+    print("REFS " + base64.b64encode(blob).decode(), flush=True)
+
+    sys.stdin.read()  # parent closes stdin when done
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

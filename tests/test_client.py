@@ -71,6 +71,34 @@ print("ACTOR", ray_tpu.get([c.incr.remote() for _ in range(3)])[-1])
     server.stop()
 
 
+def test_pool_and_joblib_over_client_mode(ray_start_regular):
+    """multiprocessing.Pool + cluster_resources from a ray:// remote driver:
+    the chunk function must pickle (no lock-captured closures) and resource
+    queries must proxy through the ClientRuntime."""
+    from ray_tpu.util.client import ClientServer
+
+    server = ClientServer()
+    script = f"""
+import sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+import ray_tpu
+ray_tpu.init(address={server.address!r})
+print("CPUS", int(ray_tpu.cluster_resources().get("CPU", 0)) > 0)
+from ray_tpu.util.multiprocessing import Pool
+with Pool(initializer=lambda tag: None, initargs=("t",)) as p:
+    print("POOL", sum(p.map(lambda x: x * 2, range(10))))
+"""
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run([sys.executable, "-c", script], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stderr[-3000:]
+    assert "CPUS True" in p.stdout
+    assert "POOL 90" in p.stdout
+    server.stop()
+
+
 def test_bad_client_address():
     from ray_tpu.util.client import parse_address
 
