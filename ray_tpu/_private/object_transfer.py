@@ -60,6 +60,9 @@ ST_ERROR = 2
 #: The owner knows the object (entry pending / producing task in flight) but
 #: it is not ready yet — the borrower should keep waiting, NOT declare loss.
 ST_PENDING = 3
+#: The producing task FAILED on the owner; payload carries the pickled
+#: exception so the borrower re-raises the original error, not ObjectLost.
+ST_FAILED = 4
 
 # Address of this process's running object server ("" = not running).  Module
 # level so ObjectRef.__reduce__ can stamp refs without importing the runtime.
@@ -79,6 +82,16 @@ def _set_local_addr(addr: str) -> None:
 
 class ObjectTransferError(ObjectLostError):
     """A remote pull failed (owner unreachable or object unknown there)."""
+
+
+class _RemoteTaskFailed(Exception):
+    """Internal carrier: the owner reported the producing task FAILED; the
+    wrapped original error is landed in the local store and re-raised by
+    the getter (never surfaced directly)."""
+
+    def __init__(self, error: BaseException):
+        super().__init__(repr(error))
+        self.error = error
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -146,9 +159,14 @@ class ObjectTransferServer:
             except OSError:
                 # Transient per-connection errors (ECONNABORTED from a client
                 # resetting mid-handshake) must not kill the listener; only a
-                # stop() or a closed socket ends the loop.
+                # stop() or a closed socket ends the loop.  The short sleep
+                # stops persistent errors (EMFILE under fd exhaustion) from
+                # busy-spinning a core.
                 if self._stop.is_set() or self._sock.fileno() < 0:
                     return
+                import time
+
+                time.sleep(0.02)
                 continue
             threading.Thread(target=self._serve_conn, args=(conn,),
                              name="objxfer-conn", daemon=True).start()
@@ -201,6 +219,9 @@ class ObjectTransferServer:
             # would just stall the borrower.
             conn.sendall(bytes([ST_NOT_FOUND]))
             return
+        if state == "FAILED":
+            self._send_failed(conn, store, oid)
+            return
         try:
             # Wait a bounded slice for a pending object to seal (the owner
             # may still be computing it); the borrower retries on ST_PENDING
@@ -211,10 +232,27 @@ class ObjectTransferServer:
             # next store operation that may spill (see ObjectStore docstring).
             payload = bytes(view)
         except Exception:
-            still_coming = store.state_of(oid) in (None, "PENDING") and known
+            state_now = store.state_of(oid)
+            if state_now == "FAILED":
+                # The producer failed while we were waiting for it.
+                self._send_failed(conn, store, oid)
+                return
+            still_coming = state_now in (None, "PENDING") and known
             conn.sendall(bytes([ST_PENDING if still_coming else ST_NOT_FOUND]))
             return
         conn.sendall(bytes([ST_OK]) + struct.pack("<Q", len(payload)))
+        _send_payload(conn, payload)
+
+    @staticmethod
+    def _send_failed(conn: socket.socket, store, oid: ObjectID) -> None:
+        from ray_tpu._private import serialization
+
+        err = store.get_error(oid) or RuntimeError(f"object {oid} failed")
+        try:
+            payload = serialization.dumps(err)
+        except Exception:
+            payload = serialization.dumps(RuntimeError(repr(err)))
+        conn.sendall(bytes([ST_FAILED]) + struct.pack("<Q", len(payload)))
         _send_payload(conn, payload)
 
     def _handle_push(self, conn: socket.socket, oid: ObjectID) -> None:
@@ -351,6 +389,15 @@ class PullManager:
                 try:
                     payload = self._fetch(oid, addr, timeout)
                     break
+                except _RemoteTaskFailed as rf:
+                    # The producing task failed on the owner: land the
+                    # ORIGINAL error locally so getters re-raise it (parity
+                    # with local task-failure semantics).
+                    if not self._store.contains(oid):
+                        self._store.put_error(oid, rf.error)
+                    if self._on_complete is not None:
+                        self._on_complete(oid)
+                    return
                 except Exception:
                     attempt += 1
                     if attempt > retries:
@@ -415,6 +462,12 @@ class PullManager:
                     # Producer still running on the owner — keep waiting.
                     time.sleep(0.05)
                     continue
+                if status == ST_FAILED:
+                    (size,) = struct.unpack("<Q", _recv_exact(sock, 8))
+                    from ray_tpu._private import serialization
+
+                    err = serialization.loads(bytes(_recv_into(sock, size)))
+                    raise _RemoteTaskFailed(err)
                 if status != ST_OK:
                     raise ObjectTransferError(
                         f"owner at {addr} has no object {oid} (status={status})")

@@ -25,6 +25,13 @@ class TaskError(RayTpuError):
         )
         super().__init__(f"{task_repr} failed: {cause!r}\nRemote traceback:\n{self.remote_traceback}")
 
+    def __reduce__(self):
+        # The default exception protocol would re-call __init__ with the
+        # formatted MESSAGE as `cause` (a str), exploding on unpickle —
+        # reconstruct from the real fields so errors survive crossing
+        # process/node boundaries.
+        return (TaskError, (self.cause, self.task_repr, self.remote_traceback))
+
 
 class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""

@@ -13,12 +13,20 @@ from ray_tpu.rl.core.learner import JaxLearner
 from ray_tpu.rl.core.learner_group import LearnerGroup
 from ray_tpu.rl.core.rl_module import (Columns, DefaultActorCritic,
                                        DefaultQModule, RLModule, RLModuleSpec)
+from ray_tpu.rl.core.multi_rl_module import MultiRLModule, MultiRLModuleSpec
 from ray_tpu.rl.env.env_runner import SingleAgentEnvRunner
 from ray_tpu.rl.env.env_runner_group import EnvRunnerGroup
 from ray_tpu.rl.env.episode import SingleAgentEpisode
+from ray_tpu.rl.env.multi_agent_env import MultiAgentCartPole, MultiAgentEnv
+from ray_tpu.rl.env.multi_agent_env_runner import MultiAgentEnvRunner
+from ray_tpu.rl.env.multi_agent_episode import MultiAgentEpisode
+from ray_tpu.rl.offline import OfflineData, record_episodes
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "JaxLearner", "LearnerGroup", "Columns",
     "DefaultActorCritic", "DefaultQModule", "RLModule", "RLModuleSpec",
     "SingleAgentEnvRunner", "EnvRunnerGroup", "SingleAgentEpisode",
+    "MultiAgentEnv", "MultiAgentCartPole", "MultiAgentEnvRunner",
+    "MultiAgentEpisode", "MultiRLModule", "MultiRLModuleSpec",
+    "OfflineData", "record_episodes",
 ]

@@ -1,6 +1,11 @@
+from ray_tpu.rl.algorithms.bc import BC, BCConfig, BCLearner
+from ray_tpu.rl.algorithms.cql import CQL, CQLConfig, CQLLearner
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, DQNLearner
 from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig, IMPALALearner
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig, PPOLearner
+from ray_tpu.rl.algorithms.sac import SAC, SACConfig, SACLearner
 
 __all__ = ["PPO", "PPOConfig", "PPOLearner", "DQN", "DQNConfig", "DQNLearner",
-           "IMPALA", "IMPALAConfig", "IMPALALearner"]
+           "IMPALA", "IMPALAConfig", "IMPALALearner",
+           "SAC", "SACConfig", "SACLearner", "BC", "BCConfig", "BCLearner",
+           "CQL", "CQLConfig", "CQLLearner"]

@@ -1,0 +1,104 @@
+"""CQL — conservative Q-learning (offline SAC variant).
+
+(ref: rllib/algorithms/cql/cql.py CQLConfig/CQL; loss in
+rllib/algorithms/cql/torch/cql_torch_learner.py — SAC losses plus the
+CQL(H) regularizer: logsumexp of Q over random + policy actions minus the
+dataset Q, weighted by min_q_weight.)
+
+Built on SACLearner's jitted update via the ``critic_penalty`` hook, so the
+conservative term compiles into the same single update step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rl.algorithms.sac import SAC, SACConfig, SACLearner
+from ray_tpu.rl.core.rl_module import Columns
+from ray_tpu.rl.offline import OfflineData
+
+
+class CQLConfig(SACConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or CQL)
+        self.min_q_weight = 5.0
+        self.num_penalty_actions = 4  # random + policy samples each
+        # offline input (same contract as BCConfig.offline_data)
+        self.input_ = None
+        self.input_format = "parquet"
+        self.updates_per_iteration = 20
+        self.num_steps_sampled_before_learning_starts = 0
+
+    def offline_data(self, *, input_=None, input_format=None,
+                     updates_per_iteration=None) -> "CQLConfig":
+        if input_ is not None:
+            self.input_ = input_
+        if input_format is not None:
+            self.input_format = input_format
+        if updates_per_iteration is not None:
+            self.updates_per_iteration = updates_per_iteration
+        return self
+
+
+class CQLLearner(SACLearner):
+    def critic_penalty(self, q1p, q2p, obs, actions, dist_inputs, key):
+        """CQL(H): E_s[logsumexp_a Q(s,a)] - E_(s,a)~D[Q(s,a)], both critics.
+
+        Out-of-distribution actions = uniform random over the action range
+        plus fresh policy samples (importance-corrected as in the paper's
+        implementation)."""
+        cfg = self.config
+        module = self.module
+        dist = module.action_dist
+        n = cfg.num_penalty_actions
+        B = obs.shape[0]
+        act_dim = module.action_dim
+        scale = getattr(module, "action_scale", 1.0)
+        k_rand, k_pi = jax.random.split(key)
+
+        rand_acts = jax.random.uniform(
+            k_rand, (n, B, act_dim), minval=-scale, maxval=scale)
+        rand_logp = jnp.full((n, B), -act_dim * jnp.log(2.0 * scale))
+        pi_keys = jax.random.split(k_pi, n)
+        pi_samples = [dist.sample_with_logp(k, dist_inputs) for k in pi_keys]
+        pi_acts = jnp.stack([a for a, _ in pi_samples])
+        pi_logp = jnp.stack([lp for _, lp in pi_samples])
+
+        all_acts = jnp.concatenate([rand_acts, pi_acts])          # (2n, B, A)
+        all_logp = jnp.concatenate([rand_logp, pi_logp])          # (2n, B)
+
+        def penalty_for(qp):
+            q = jax.vmap(lambda a: module.q_values(qp, obs, a))(all_acts)
+            # Importance correction: logsumexp over proposals q - logp.
+            ood = jax.nn.logsumexp(q - jax.lax.stop_gradient(all_logp), axis=0)
+            data_q = module.q_values(qp, obs, actions)
+            return jnp.mean(ood) - jnp.mean(data_q)
+
+        return cfg.min_q_weight * (penalty_for(q1p) + penalty_for(q2p))
+
+
+class CQL(SAC):
+    """Offline: replay buffer replaced by the recorded dataset."""
+
+    learner_class = CQLLearner
+    config_class = CQLConfig
+
+    def setup(self, config) -> None:
+        super().setup(config)
+        cfg = self.algo_config
+        assert cfg.input_ is not None, \
+            "offline algorithms need .offline_data(input_=...)"
+        self.offline = OfflineData(cfg.input_, format=cfg.input_format,
+                                   seed=cfg.seed)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        results: Dict[str, Any] = {}
+        for _ in range(max(1, cfg.updates_per_iteration)):
+            batch = self.offline.sample(cfg.train_batch_size)
+            results = self.learner_group.update_from_batch(batch)
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return {"learners": results, "dataset_size": self.offline.size}

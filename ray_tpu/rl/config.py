@@ -38,6 +38,11 @@ class AlgorithmConfig:
         self.module_class: type = DefaultActorCritic
         # learners
         self.num_learners = 0
+        # multi-agent (ref: algorithm_config.py multi_agent(policies=...,
+        # policy_mapping_fn=...))
+        self.policies: Optional[Dict[str, Optional[RLModuleSpec]]] = None
+        self.policy_mapping_fn: Callable[[str], str] = \
+            lambda agent_id: "default_policy"
         # debug / misc
         self.seed = 0
         self.evaluation_interval: Optional[int] = None
@@ -85,6 +90,22 @@ class AlgorithmConfig:
             self.model = dict(model_config)
         return self
 
+    def multi_agent(self, *, policies: Optional[Dict[str, Any]] = None,
+                    policy_mapping_fn: Optional[Callable[[str], str]] = None
+                    ) -> "AlgorithmConfig":
+        """Declare per-policy modules + the agent→policy routing
+        (ref: algorithm_config.py:multi_agent).  ``policies`` maps policy id
+        to an RLModuleSpec, or None to derive the spec from the env's
+        per-agent spaces."""
+        if policies is not None:
+            self.policies = dict(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    def is_multi_agent(self) -> bool:
+        return bool(self.policies)
+
     def evaluation(self, *, evaluation_interval: Optional[int] = None,
                    evaluation_duration: Optional[int] = None) -> "AlgorithmConfig":
         if evaluation_interval is not None:
@@ -106,6 +127,43 @@ class AlgorithmConfig:
         return RLModuleSpec(module_class=self.module_class,
                             observation_dim=obs_dim, action_dim=act_dim,
                             discrete=discrete, model_config=dict(self.model))
+
+    def multi_module_spec(self):
+        """Per-policy module specs, deriving unspecified ones from the env's
+        per-agent spaces (ref: MultiRLModuleSpec construction in
+        algorithm_config.get_multi_rl_module_spec)."""
+        import numpy as np
+
+        from ray_tpu.rl.core.multi_rl_module import MultiRLModuleSpec
+
+        assert self.is_multi_agent()
+        env = self.env(self.env_config) if callable(self.env) else self.env
+        try:
+            import gymnasium as gym
+
+            specs: Dict[str, RLModuleSpec] = {}
+            for pid, spec in self.policies.items():
+                if spec is not None:
+                    specs[pid] = spec
+                    continue
+                agent = next(
+                    (a for a in env.possible_agents
+                     if self.policy_mapping_fn(a) == pid), None)
+                assert agent is not None, \
+                    f"no agent maps to policy {pid!r}; pass an explicit spec"
+                ospace = env.observation_spaces[agent]
+                aspace = env.action_spaces[agent]
+                discrete = isinstance(aspace, gym.spaces.Discrete)
+                specs[pid] = RLModuleSpec(
+                    module_class=self.module_class,
+                    observation_dim=int(np.prod(ospace.shape)),
+                    action_dim=(int(aspace.n) if discrete
+                                else int(np.prod(aspace.shape))),
+                    discrete=discrete, model_config=dict(self.model))
+            return MultiRLModuleSpec(specs)
+        finally:
+            if callable(self.env):
+                env.close()
 
     def build_algo(self):
         assert self.algo_class is not None, "config has no algo_class bound"

@@ -1,0 +1,48 @@
+"""MultiRLModule — a container of per-policy RLModules.
+
+(ref: rllib/core/rl_module/multi_rl_module.py MultiRLModule — maps module
+ids to RLModules; MultiRLModuleSpec builds the container so env runners and
+learners construct identical per-policy networks.)
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict
+
+import jax
+
+from ray_tpu.rl.core.rl_module import RLModule, RLModuleSpec
+
+
+@dataclass(frozen=True)
+class MultiRLModuleSpec:
+    module_specs: Dict[str, RLModuleSpec] = field(default_factory=dict)
+
+    def build(self) -> "MultiRLModule":
+        return MultiRLModule(
+            {mid: spec.build() for mid, spec in self.module_specs.items()})
+
+
+class MultiRLModule:
+    """Dict of module_id → RLModule; params are a dict of per-module pytrees."""
+
+    def __init__(self, modules: Dict[str, RLModule]):
+        self._modules = dict(modules)
+
+    def __getitem__(self, module_id: str) -> RLModule:
+        return self._modules[module_id]
+
+    def __contains__(self, module_id: str) -> bool:
+        return module_id in self._modules
+
+    def keys(self):
+        return self._modules.keys()
+
+    def items(self):
+        return self._modules.items()
+
+    def init_params(self, key) -> Dict[str, Any]:
+        keys = jax.random.split(key, len(self._modules))
+        return {mid: m.init_params(k)
+                for (mid, m), k in zip(sorted(self._modules.items()), keys)}

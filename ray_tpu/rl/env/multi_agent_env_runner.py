@@ -1,0 +1,198 @@
+"""MultiAgentEnvRunner — samples a MultiAgentEnv with per-module inference.
+
+(ref: rllib/env/multi_agent_env_runner.py MultiAgentEnvRunner — steps the
+env with a MultiRLModule, routing each agent's observation through its
+mapped module via the policy_mapping_fn.)
+
+TPU-native shape: agents are grouped by module each step, so device work is
+one jitted batched forward PER MODULE per step (not per agent).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl.core.multi_rl_module import MultiRLModuleSpec
+from ray_tpu.rl.core.rl_module import Columns
+from ray_tpu.rl.env.multi_agent_episode import MultiAgentEpisode
+
+
+class MultiAgentEnvRunner:
+    def __init__(self, *, env: Union[type, Callable],
+                 env_config: Optional[Dict[str, Any]] = None,
+                 module_spec: MultiRLModuleSpec,
+                 policy_mapping_fn: Callable[[str], str],
+                 rollout_fragment_length: int = 200,
+                 explore: bool = True,
+                 seed: int = 0,
+                 worker_index: int = 0):
+        self.env = env(env_config or {}) if callable(env) else env
+        self.module = module_spec.build()
+        self.policy_mapping_fn = policy_mapping_fn
+        self.rollout_fragment_length = rollout_fragment_length
+        self.explore = explore
+        self._params = self.module.init_params(
+            jax.random.key(seed * 1000 + worker_index))
+        self._key = jax.random.key(seed * 7919 + worker_index + 1)
+        self._seed = seed
+        self._episode: Optional[MultiAgentEpisode] = None
+        self._obs: Dict[str, Any] = {}
+        self._done_returns: List[float] = []
+        self._done_lens: List[int] = []
+
+        # One jitted explore/greedy step per module.
+        self._explore_steps: Dict[str, Any] = {}
+        self._greedy_steps: Dict[str, Any] = {}
+        for mid in self.module.keys():
+            mod = self.module[mid]
+            dist = mod.action_dist
+
+            def make(mod=mod, dist=dist):
+                @jax.jit
+                def _explore(params, key, obs):
+                    out = mod.forward_exploration(params, obs)
+                    inputs = out[Columns.ACTION_DIST_INPUTS]
+                    key, sub = jax.random.split(key)
+                    actions = dist.sample(sub, inputs)
+                    return key, actions, dist.logp(inputs, actions)
+
+                @jax.jit
+                def _greedy(params, obs):
+                    out = mod.forward_inference(params, obs)
+                    inputs = out[Columns.ACTION_DIST_INPUTS]
+                    actions = dist.deterministic(inputs)
+                    return actions, dist.logp(inputs, actions)
+
+                return _explore, _greedy
+
+            self._explore_steps[mid], self._greedy_steps[mid] = make()
+        self._reset_env(seed)
+
+    # ------------------------------------------------------------------
+    def _reset_env(self, seed: Optional[int] = None) -> None:
+        obs, _ = self.env.reset(seed=seed)
+        mapping = {a: self.policy_mapping_fn(a) for a in obs}
+        self._episode = MultiAgentEpisode(agent_to_module=mapping)
+        self._episode.add_env_reset(obs)
+        self._obs = obs
+
+    def sample(self, *, num_timesteps: Optional[int] = None,
+               num_episodes: Optional[int] = None,
+               random_actions: bool = False,
+               explore: Optional[bool] = None) -> List[MultiAgentEpisode]:
+        explore = self.explore if explore is None else explore
+        if num_timesteps is None and num_episodes is None:
+            num_timesteps = self.rollout_fragment_length
+        out: List[MultiAgentEpisode] = []
+        env_steps = 0
+        episodes_done = 0
+        while True:
+            actions, extras = self._compute_actions(
+                self._obs, random_actions, explore)
+            obs, rewards, terms, truncs, _ = self.env.step(actions)
+            self._episode.add_env_step(
+                obs, actions, rewards, terminateds=terms, truncateds=truncs,
+                extras=extras)
+            env_steps += 1
+            # Late joiners need a module assignment before they first act.
+            for a in obs:
+                if a not in self._episode.agent_to_module:
+                    self._episode.agent_to_module[a] = self.policy_mapping_fn(a)
+            # Next step acts only for agents still alive with an observation.
+            self._obs = {a: o for a, o in obs.items()
+                         if not (terms.get(a) or truncs.get(a))}
+            if self._episode.is_done or not self._obs:
+                episodes_done += 1
+                self._done_returns.append(self._episode.total_return)
+                self._done_lens.append(len(self._episode))
+                out.append(self._episode)
+                self._reset_env()
+            if num_episodes is not None:
+                if episodes_done >= num_episodes:
+                    break
+            elif env_steps >= num_timesteps:
+                break
+        if num_episodes is None and len(self._episode) > 0:
+            # Hand off the in-progress fragment; continue from the last obs.
+            out.append(self._episode)
+            cut = MultiAgentEpisode(
+                agent_to_module=dict(self._episode.agent_to_module))
+            for agent, ep in self._episode.agent_episodes.items():
+                if not ep.is_done:
+                    cut.agent_episodes[agent] = ep.cut()
+            self._episode = cut
+        return out
+
+    def _compute_actions(self, obs: Dict[str, Any], random_actions: bool,
+                         explore: bool):
+        actions: Dict[str, Any] = {}
+        extras: Dict[str, Dict[str, Any]] = {}
+        if not obs:
+            return actions, extras
+        if random_actions:
+            for a in obs:
+                actions[a] = self.env.action_spaces[a].sample()
+                extras[a] = {Columns.ACTION_LOGP: 0.0}
+            return actions, extras
+        # Group agents by module: one batched jitted call per module.
+        by_module: Dict[str, List[str]] = {}
+        for a in obs:
+            by_module.setdefault(
+                self._episode.agent_to_module.get(
+                    a, self.policy_mapping_fn(a)), []).append(a)
+        for mid, agents in by_module.items():
+            batch = np.stack([np.asarray(obs[a], np.float32).ravel()
+                              for a in agents])
+            params = self._params[mid]
+            if explore:
+                self._key, acts, logps = self._explore_steps[mid](
+                    params, self._key, batch)
+            else:
+                acts, logps = self._greedy_steps[mid](params, batch)
+            acts, logps = np.asarray(acts), np.asarray(logps)
+            mod = self.module[mid]
+            for i, a in enumerate(agents):
+                actions[a] = int(acts[i]) if mod.discrete else acts[i]
+                extras[a] = {Columns.ACTION_LOGP: float(logps[i])}
+        return actions, extras
+
+    # ------------------------------------------------------------------
+    def get_metrics(self) -> Dict[str, Any]:
+        returns, lens = self._done_returns, self._done_lens
+        self._done_returns, self._done_lens = [], []
+        if not returns:
+            return {"num_episodes": 0}
+        return {
+            "num_episodes": len(returns),
+            "episode_return_mean": float(np.mean(returns)),
+            "episode_return_max": float(np.max(returns)),
+            "episode_return_min": float(np.min(returns)),
+            "episode_len_mean": float(np.mean(lens)),
+        }
+
+    def reset(self) -> None:
+        self._reset_env()
+        self._done_returns, self._done_lens = [], []
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"params": self._params}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        if "params" in state:
+            # Copy on receipt (learner updates donate their buffers).
+            new = {}
+            for mid, p in state["params"].items():
+                new[mid] = jax.tree.map(
+                    lambda x: jnp.array(x, copy=True)
+                    if hasattr(x, "dtype") else x, p)
+            self._params.update(new)
+
+    def ping(self) -> str:
+        return "pong"
+
+    def stop(self) -> None:
+        self.env.close()

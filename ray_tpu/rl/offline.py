@@ -1,0 +1,93 @@
+"""Offline RL data path — record experience, read it back as train batches.
+
+(ref: rllib/offline/ — offline_data.py OfflineData reads Ray Data datasets
+of episodes/transitions and feeds learner batches; output writers in
+rllib/offline/output_writer.py record env-runner experience.)
+
+TPU-native shape: transitions are flat numpy columns (the learner's native
+batch format), stored via ray_tpu.data (parquet/json), and sampled as
+uniform minibatches host-side — device work stays in the learner's jitted
+update.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Union
+
+import numpy as np
+
+from ray_tpu.rl.connectors import episodes_to_transitions
+from ray_tpu.rl.core.rl_module import Columns
+
+
+def record_episodes(episodes, path: str, *, format: str = "parquet") -> str:
+    """Write episodes as flat transition rows (offline training input).
+
+    (ref: rllib/offline/output_writer.py / `config.output` recording.)
+    """
+    import pandas as pd
+
+    import ray_tpu.data as rdata
+
+    cols = episodes_to_transitions(episodes)
+    n = len(cols[Columns.OBS])
+    rows: Dict[str, Any] = {}
+    for k, v in cols.items():
+        if v.ndim > 1:
+            # Arrow-friendly: multi-dim columns become lists per row.
+            rows[k] = [v[i].tolist() for i in range(n)]
+        else:
+            rows[k] = v.tolist()
+    df = pd.DataFrame(rows)
+    ds = rdata.from_pandas(df)
+    os.makedirs(path, exist_ok=True)
+    if format == "parquet":
+        ds.write_parquet(path)
+    elif format == "json":
+        ds.write_json(path)
+    else:
+        raise ValueError(f"unsupported offline format: {format}")
+    return path
+
+
+class OfflineData:
+    """Uniformly samples learner batches from a recorded dataset
+    (ref: rllib/offline/offline_data.py OfflineData / OfflinePreLearner).
+
+    Accepts a path (parquet/json dir), a ray_tpu.data Dataset, or an
+    in-memory column dict.  Materializes to numpy columns once — offline
+    datasets for control tasks fit host memory; larger corpora can pass a
+    Dataset and stream via ``iter_batches`` instead.
+    """
+
+    def __init__(self, source: Union[str, Dict[str, np.ndarray], Any],
+                 *, format: str = "parquet", seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        if isinstance(source, dict):
+            self.columns = {k: np.asarray(v) for k, v in source.items()}
+        else:
+            if isinstance(source, str):
+                import ray_tpu.data as rdata
+
+                ds = (rdata.read_parquet(source) if format == "parquet"
+                      else rdata.read_json(source))
+            else:
+                ds = source
+            rows = ds.take_all()
+            if not rows:
+                raise ValueError("offline dataset is empty")
+            keys = rows[0].keys()
+            self.columns = {
+                k: np.asarray([r[k] for r in rows]) for k in keys}
+        for k in (Columns.OBS, Columns.ACTIONS):
+            if k not in self.columns:
+                raise ValueError(f"offline data missing column {k!r}")
+        self.columns = {k: np.asarray(v, np.float32)
+                        if np.asarray(v).dtype == np.float64 else np.asarray(v)
+                        for k, v in self.columns.items()}
+        self.size = len(self.columns[Columns.OBS])
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.integers(0, self.size, batch_size)
+        return {k: v[idx] for k, v in self.columns.items()}

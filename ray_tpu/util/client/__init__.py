@@ -71,9 +71,13 @@ class ClientServer:
             try:
                 sock, addr = self._listener.accept()
             except OSError:
-                # A client aborting mid-handshake must not kill the listener.
+                # A client aborting mid-handshake must not kill the listener;
+                # sleep so persistent errors (fd exhaustion) don't busy-spin.
                 if self._stop.is_set() or self._listener.fileno() < 0:
                     return
+                import time
+
+                time.sleep(0.02)
                 continue
             conn = _SocketConn(sock)
             threading.Thread(

@@ -33,6 +33,11 @@ def slow_produce(delay_s):
     return "slow-done"
 
 
+@ray_tpu.remote(max_retries=0)
+def fail_produce():
+    raise ValueError("intentional producer failure")
+
+
 def main() -> None:
     ray_tpu.init(_system_config={
         "enable_object_transfer": True,
@@ -59,11 +64,12 @@ def main() -> None:
     # Still computing when the parent pulls it: the owner answers ST_PENDING
     # (longer than object_transfer_serve_wait_s) until the task finishes.
     slow_ref = slow_produce.remote(4.0)
+    fail_ref = fail_produce.remote()
 
     blob = serialization.dumps(
         {"addr": addr, "small": small_ref, "big": big_ref,
          "task": task_ref, "spill": spill_ref, "slow": slow_ref,
-         "big_sum": float(big.sum())})
+         "fail": fail_ref, "big_sum": float(big.sum())})
     print("REFS " + base64.b64encode(blob).decode(), flush=True)
 
     sys.stdin.read()  # parent closes stdin when done

@@ -135,6 +135,15 @@ def test_pull_waits_for_slow_producer(owner_node, borrower):
     assert ray_tpu.get(owner_node["slow"], timeout=60) == "slow-done"
 
 
+def test_remote_task_failure_propagates_original_error(owner_node, borrower):
+    # The producing task raised ValueError on the owner node; a cross-node
+    # get must surface THAT error (task-failure parity), not ObjectLost.
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(owner_node["fail"], timeout=30)
+    assert "intentional producer failure" in str(ei.value)
+    assert not isinstance(ei.value, ObjectLostError)
+
+
 def test_pull_unknown_object_raises(owner_node, borrower):
     ghost = ObjectRef(ObjectID.from_random(), owner="ghost",
                       owner_addr=owner_node["addr"])

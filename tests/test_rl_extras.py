@@ -1,0 +1,189 @@
+"""SAC (continuous control) + offline RL (BC/CQL) tests.
+
+(ref: rllib/algorithms/sac/tests/test_sac.py, rllib/algorithms/bc/tests/,
+rllib/algorithms/cql/tests/ — compile-and-learn smoke tests with small
+budgets; BC additionally checks imitation fidelity against the behavior
+policy, the reference's pass criterion for offline learning tests.)
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl.algorithms import (BC, BCConfig, CQL, CQLConfig, SAC,
+                                   SACConfig)
+from ray_tpu.rl.core.rl_module import Columns
+from ray_tpu.rl.env.episode import SingleAgentEpisode
+from ray_tpu.rl.offline import OfflineData, record_episodes
+
+
+@pytest.fixture(autouse=True)
+def _runtime():
+    ray_tpu.init(ignore_reinit_error=True)
+    yield
+
+
+# ---------------------------------------------------------------------- SAC
+def test_sac_pendulum_runs_and_is_finite():
+    config = (
+        SACConfig()
+        .environment("Pendulum-v1")
+        .env_runners(num_envs_per_env_runner=1, rollout_fragment_length=32)
+        .training(train_batch_size=64,
+                  num_steps_sampled_before_learning_starts=128,
+                  replay_buffer_capacity=10_000)
+        .rl_module(model_config={"hiddens": (32, 32), "action_scale": 2.0})
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    result = {}
+    for _ in range(6):
+        result = algo.train()
+    learners = result["learners"]
+    assert {"critic_loss", "actor_loss", "alpha_loss", "alpha"} <= set(learners)
+    for k, v in learners.items():
+        assert np.isfinite(v), (k, v)
+    assert learners["alpha"] > 0.0
+    assert result["replay_size"] > 128
+    algo.stop()
+
+
+def test_sac_squashed_actions_respect_scale():
+    from ray_tpu.rl.algorithms.sac import SquashedGaussian
+    import jax
+
+    dist = SquashedGaussian(scale=2.0)
+    inputs = np.random.randn(64, 2).astype(np.float32) * 3
+    acts = np.asarray(dist.sample(jax.random.key(0), inputs))
+    assert np.all(np.abs(acts) <= 2.0)
+    # logp of its own samples is finite.
+    logp = np.asarray(dist.logp(inputs, acts))
+    assert np.all(np.isfinite(logp))
+    det = np.asarray(dist.deterministic(inputs))
+    assert np.all(np.abs(det) <= 2.0)
+
+
+# ----------------------------------------------------------------- offline
+def _expert_action(obs) -> int:
+    """Decent scripted CartPole policy: push toward the pole's lean."""
+    return int(obs[2] + obs[3] > 0)
+
+
+def _record_cartpole_expert(tmp_path, n_steps=2000, fmt="parquet") -> str:
+    import gymnasium as gym
+
+    env = gym.make("CartPole-v1")
+    episodes, steps = [], 0
+    while steps < n_steps:
+        obs, _ = env.reset(seed=steps)
+        ep = SingleAgentEpisode()
+        ep.add_env_reset(np.asarray(obs, np.float32))
+        done = False
+        while not done:
+            act = _expert_action(obs)
+            obs, reward, term, trunc, _ = env.step(act)
+            ep.add_env_step(np.asarray(obs, np.float32), act, reward,
+                            terminated=term, truncated=trunc)
+            steps += 1
+            done = term or trunc
+        episodes.append(ep)
+    env.close()
+    path = str(tmp_path / f"cartpole_expert_{fmt}")
+    return record_episodes(episodes, path, format=fmt)
+
+
+def test_record_and_read_roundtrip(tmp_path):
+    path = _record_cartpole_expert(tmp_path, n_steps=300)
+    data = OfflineData(path, seed=0)
+    assert data.size >= 300
+    batch = data.sample(32)
+    assert batch[Columns.OBS].shape == (32, 4)
+    assert batch[Columns.NEXT_OBS].shape == (32, 4)
+    assert set(batch) >= {Columns.OBS, Columns.ACTIONS, Columns.REWARDS,
+                          Columns.NEXT_OBS, Columns.TERMINATEDS}
+
+
+def test_bc_imitates_expert(tmp_path):
+    path = _record_cartpole_expert(tmp_path, n_steps=2000)
+    config = (
+        BCConfig()
+        .environment("CartPole-v1")
+        .offline_data(input_=path, updates_per_iteration=40)
+        .training(train_batch_size=256, lr=3e-3)
+        .rl_module(model_config={"hiddens": (32, 32)})
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    for _ in range(5):
+        result = algo.train()
+    assert result["learners"]["bc_logp"] > -0.35  # near-deterministic match
+
+    # Imitation fidelity: greedy policy agrees with the expert on fresh states.
+    import jax
+
+    from ray_tpu.rl.core.rl_module import Columns as C
+
+    module = algo.module_spec.build()
+    params = algo.get_weights()
+    rng = np.random.default_rng(0)
+    obs = rng.uniform(-1, 1, size=(512, 4)).astype(np.float32)
+    out = module.forward_inference(params, obs)
+    greedy = np.asarray(module.action_dist.deterministic(
+        out[C.ACTION_DIST_INPUTS]))
+    expert = np.array([_expert_action(o) for o in obs])
+    agreement = float((greedy == expert).mean())
+    assert agreement > 0.9, agreement
+
+    # And it actually drives the env: greedy eval beats random (~20).
+    eval_result = algo.evaluate()
+    ret = eval_result["env_runners"]["episode_return_mean"]
+    assert ret > 100, ret
+    algo.stop()
+
+
+def _record_pendulum_random(tmp_path, n_steps=600) -> str:
+    import gymnasium as gym
+
+    env = gym.make("Pendulum-v1")
+    episodes, steps = [], 0
+    rng = np.random.default_rng(0)
+    while steps < n_steps:
+        obs, _ = env.reset(seed=steps)
+        ep = SingleAgentEpisode()
+        ep.add_env_reset(np.asarray(obs, np.float32))
+        done = False
+        while not done and steps < n_steps + 200:
+            act = rng.uniform(-2, 2, size=(1,)).astype(np.float32)
+            obs, reward, term, trunc, _ = env.step(act)
+            ep.add_env_step(np.asarray(obs, np.float32), act, reward,
+                            terminated=term, truncated=trunc)
+            steps += 1
+            done = term or trunc
+        episodes.append(ep)
+    env.close()
+    path = str(tmp_path / "pendulum_random")
+    return record_episodes(episodes, path)
+
+
+def test_cql_offline_runs_and_penalty_is_conservative(tmp_path):
+    path = _record_pendulum_random(tmp_path)
+    config = (
+        CQLConfig()
+        .environment("Pendulum-v1")
+        .offline_data(input_=path, updates_per_iteration=15)
+        .training(train_batch_size=64, min_q_weight=5.0)
+        .rl_module(model_config={"hiddens": (32, 32), "action_scale": 2.0})
+        .debugging(seed=0)
+    )
+    algo = config.build_algo()
+    for _ in range(3):
+        result = algo.train()
+    learners = result["learners"]
+    for k, v in learners.items():
+        assert np.isfinite(v), (k, v)
+    # The conservative penalty must actually bite: critic loss exceeds the
+    # plain TD term a SAC run would have (we just check it is present and
+    # the update ran on the offline data without env interaction).
+    assert result["dataset_size"] >= 600
+    assert learners["critic_loss"] != 0.0
+    algo.stop()

@@ -1,0 +1,114 @@
+"""Multi-agent RL tests: MultiAgentEnv API, per-module routing, independent
+PPO learning on MultiAgentCartPole.
+
+(ref: rllib/env/tests/test_multi_agent_env_runner.py and the reference's
+multi-agent CartPole tuned examples — two policies via policy_mapping_fn,
+each learning its own CartPole.)
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl import MultiAgentCartPole, MultiAgentEnvRunner
+from ray_tpu.rl.algorithms import PPOConfig
+from ray_tpu.rl.core.rl_module import Columns
+
+
+@pytest.fixture(autouse=True)
+def _runtime():
+    ray_tpu.init(ignore_reinit_error=True)
+    yield
+
+
+def _two_policy_config():
+    return (
+        PPOConfig()
+        .environment(MultiAgentCartPole, env_config={"num_agents": 2})
+        .multi_agent(
+            policies={"p0": None, "p1": None},
+            policy_mapping_fn=lambda aid: f"p{int(aid.split('_')[1]) % 2}")
+        .env_runners(rollout_fragment_length=64)
+        .training(train_batch_size=512, minibatch_size=128, num_epochs=4,
+                  lr=1e-3, entropy_coeff=0.01)
+        .rl_module(model_config={"hiddens": (32, 32)})
+        .debugging(seed=0)
+    )
+
+
+def test_multi_agent_env_contract():
+    env = MultiAgentCartPole({"num_agents": 3})
+    obs, infos = env.reset(seed=0)
+    assert set(obs) == {"agent_0", "agent_1", "agent_2"}
+    actions = {a: env.action_spaces[a].sample() for a in obs}
+    obs2, rewards, terms, truncs, _ = env.step(actions)
+    assert "__all__" in terms and "__all__" in truncs
+    assert all(rewards[a] == 1.0 for a in actions)
+    env.close()
+
+
+def test_multi_agent_env_runner_routes_by_policy():
+    cfg = _two_policy_config()
+    runner = MultiAgentEnvRunner(
+        env=MultiAgentCartPole, env_config={"num_agents": 2},
+        module_spec=cfg.multi_module_spec(),
+        policy_mapping_fn=cfg.policy_mapping_fn,
+        rollout_fragment_length=32, seed=0)
+    episodes = runner.sample(num_timesteps=32)
+    assert episodes
+    by_module = {}
+    for ma_ep in episodes:
+        for mid, eps in ma_ep.episodes_by_module().items():
+            by_module.setdefault(mid, []).extend(eps)
+    assert set(by_module) == {"p0", "p1"}
+    for eps in by_module.values():
+        for ep in eps:
+            arr = ep.to_numpy()
+            assert len(arr["actions"]) == len(ep)
+            assert Columns.ACTION_LOGP in arr
+    runner.stop()
+
+
+def test_multi_agent_ppo_learns_both_policies():
+    algo = _two_policy_config().build_algo()
+    best = 0.0
+    for _ in range(12):
+        result = algo.train()
+        ret = result.get("episode_return_mean")
+        if ret == ret and ret is not None:
+            best = max(best, ret)
+    learners = result["learners"]
+    assert set(learners) == {"p0", "p1"}
+    for mid, res in learners.items():
+        assert np.isfinite(res["total_loss"]), (mid, res)
+    # Two independent CartPoles: summed return should exceed the random
+    # baseline (~2x20=40) with a little learning.
+    assert best > 60, best
+
+    # Policies are genuinely independent parameter sets.
+    w = algo.get_weights()
+    p0 = np.asarray(w["p0"]["pi"]["head"]["w"])
+    p1 = np.asarray(w["p1"]["pi"]["head"]["w"])
+    assert not np.allclose(p0, p1)
+    algo.stop()
+
+
+def test_multi_agent_checkpoint_roundtrip(tmp_path):
+    algo = _two_policy_config().build_algo()
+    algo.train()
+    ckpt = str(tmp_path / "ma_ckpt")
+    import os
+
+    os.makedirs(ckpt, exist_ok=True)
+    algo.save_checkpoint(ckpt)
+    w_before = algo.get_weights()
+
+    algo2 = _two_policy_config().build_algo()
+    algo2.load_checkpoint(None, ckpt)
+    w_after = algo2.get_weights()
+    for pid in ("p0", "p1"):
+        np.testing.assert_allclose(
+            np.asarray(w_before[pid]["pi"]["head"]["w"]),
+            np.asarray(w_after[pid]["pi"]["head"]["w"]))
+    algo.stop()
+    algo2.stop()
