@@ -231,10 +231,11 @@ def read_images(paths, *, size: Optional[tuple] = None,
 
 
 __all__ = [
-    "ActorPoolStrategy", "DataIterator", "Dataset", "from_arrow", "from_items",
-    "from_numpy", "from_pandas", "preprocessors", "range", "read_binary_files",
-    "read_csv", "read_images", "read_json", "read_numpy", "read_parquet",
-    "read_text",
+    "ActorPoolStrategy", "DataIterator", "Dataset", "aggregate", "from_arrow",
+    "from_items", "from_numpy", "from_pandas", "preprocessors", "range",
+    "read_binary_files", "read_csv", "read_images", "read_json", "read_numpy",
+    "read_parquet", "read_text",
 ]
 
+from ray_tpu.data import aggregate  # noqa: E402  (public submodule)
 from ray_tpu.data import preprocessors  # noqa: E402  (public submodule)

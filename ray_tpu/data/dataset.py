@@ -211,6 +211,29 @@ class Dataset:
     def mean(self, col: str):
         return self._simple_agg("mean", col)
 
+    def std(self, col: str, ddof: int = 1):
+        """Sample standard deviation (ref: dataset.py:2415 Dataset.std)."""
+        from ray_tpu.data.aggregate import Std
+
+        return self.aggregate(Std(col, ddof=ddof))
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of a column (ref: dataset.py:2154 unique) —
+        computed distributed via a grouped count, keys collected."""
+        ds = self.select_columns([column]).groupby(column).count()
+        return sorted(r[column] for r in ds.take_all())
+
+    def aggregate(self, *aggs) -> Any:
+        """Global aggregation (ref: dataset.py:2198 aggregate(*AggregateFn)).
+
+        One spec returns its scalar; several return a dict keyed by each
+        spec's output name."""
+        ds = Dataset(Aggregate(self._op, None, list(aggs)))
+        row = ds.take_all()[0]
+        if len(aggs) == 1:
+            return next(iter(row.values()))
+        return row
+
     def _simple_agg(self, fn: str, col: str):
         ds = Dataset(Aggregate(self._op, None, [(col, fn)]))
         rows = ds.take_all()
@@ -297,6 +320,24 @@ class GroupedData:
         # Global count (key=None) counts rows of any column.
         col = self._key if self._key is not None else "*"
         return self._agg("count", col)
+
+    def std(self, col: str, ddof: int = 1) -> Dataset:
+        from ray_tpu.data.aggregate import Std
+
+        return self.aggregate(Std(col, ddof=ddof))
+
+    def aggregate(self, *aggs) -> Dataset:
+        """Multiple aggregations in one pass
+        (ref: grouped_data.py:48 aggregate(*AggregateFn))."""
+        return Dataset(Aggregate(self._ds._op, self._key, list(aggs)))
+
+    def map_groups(self, fn: Callable, *, batch_format: str = "numpy") -> Dataset:
+        """Apply ``fn`` to each group's batch; results concatenate into a new
+        dataset (ref: grouped_data.py:93 map_groups)."""
+        from ray_tpu.data.plan import MapGroups
+
+        return Dataset(MapGroups(self._ds._op, self._key, fn,
+                                 batch_format=batch_format))
 
 
 class _SplitCoordinator:

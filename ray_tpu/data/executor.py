@@ -30,6 +30,7 @@ from ray_tpu.data.plan import (
     Limit,
     LogicalOp,
     MapBatches,
+    MapGroups,
     MapRows,
     RandomShuffle,
     Read,
@@ -281,7 +282,7 @@ def _apply_op(stream: Iterator[Any], op: LogicalOp) -> Iterator[Any]:
         return _map_stream_tasks(stream, op)
     if isinstance(op, Limit):
         return _limit_stream(stream, op.limit)
-    if isinstance(op, (Repartition, RandomShuffle, Sort, Aggregate)):
+    if isinstance(op, (Repartition, RandomShuffle, Sort, Aggregate, MapGroups)):
         return _all_to_all(stream, op)
     if isinstance(op, Union):
         def union_stream():
@@ -434,31 +435,108 @@ def _all_to_all(stream: Iterator[Any], op: LogicalOp) -> Iterator[Any]:
     if isinstance(op, Aggregate):
         yield ray_tpu.put(_aggregate(combined, op))
         return
+    if isinstance(op, MapGroups):
+        yield ray_tpu.put(_map_groups(combined, op))
+        return
     raise TypeError(op)
 
 
-def _aggregate(block: Block, op: Aggregate) -> Block:
-    import pyarrow as pa
+def _normalize_agg(agg) -> tuple:
+    """(col, fn, spec) from a legacy tuple or an AggregateFn instance."""
+    from ray_tpu.data.aggregate import AggregateFn
 
+    if isinstance(agg, AggregateFn):
+        return agg.on if agg.on is not None else "*", agg.fn_name, agg
+    col, fn = agg
+    return col, fn, None
+
+
+def _aggregate(block: Block, op: Aggregate) -> Block:
     acc = BlockAccessor(block)
     if op.key is None:
         row: Dict[str, Any] = {}
-        for col, fn in op.aggs:
-            if col == "*":  # global row count
-                row[f"{fn}({col})"] = acc.num_rows()
+        for agg in op.aggs:
+            col, fn, spec = _normalize_agg(agg)
+            name = spec.output_name if spec is not None else f"{fn}({col})"
+            if col == "*" or fn == "count":  # row/value count
+                row[name] = acc.num_rows() if col == "*" \
+                    else len(block_mod.column_to_numpy(block, col))
                 continue
             vals = block_mod.column_to_numpy(block, col)
-            row[f"{fn}({col})"] = _agg_fn(fn)(vals)
+            row[name] = _agg_fn(fn, spec)(vals)
         return block_from_rows([row])
-    tbl = block.group_by(op.key).aggregate([(c, _arrow_agg(f)) for c, f in op.aggs])
+    arrow_aggs = []
+    renames: Dict[str, str] = {}
+    for agg in op.aggs:
+        col, fn, spec = _normalize_agg(agg)
+        if col == "*":
+            col = op.key
+            fn = "count"
+        arrow_spec = _arrow_agg(col, fn, spec)
+        arrow_aggs.append(arrow_spec)
+        if spec is not None and spec.alias_name:
+            # Arrow names outputs "<col>_<kernel>"; honor the spec's alias.
+            renames[f"{col}_{arrow_spec[1]}"] = spec.alias_name
+    tbl = block.group_by(op.key).aggregate(arrow_aggs)
+    if renames:
+        tbl = tbl.rename_columns(
+            [renames.get(c, c) for c in tbl.column_names])
     return tbl
 
 
-def _agg_fn(name: str):
+def _agg_fn(name: str, spec=None):
+    if name == "std":
+        ddof = getattr(spec, "ddof", 1)
+        return lambda v: np.std(v, ddof=ddof)
+    if name == "quantile":
+        q = getattr(spec, "q", 0.5)
+        return lambda v: np.quantile(v, q)
+    if name == "unique":
+        return lambda v: sorted(set(np.asarray(v).tolist()))
     return {"sum": np.sum, "min": np.min, "max": np.max, "mean": np.mean,
-            "count": len, "std": np.std}[name]
+            "count": len}[name]
 
 
-def _arrow_agg(name: str) -> str:
-    return {"sum": "sum", "min": "min", "max": "max", "mean": "mean",
-            "count": "count", "std": "stddev"}[name]
+def _arrow_agg(col: str, name: str, spec=None) -> tuple:
+    """(column, arrow-kernel[, options]) for TableGroupBy.aggregate."""
+    import pyarrow.compute as pc
+
+    if name == "std":
+        return (col, "stddev",
+                pc.VarianceOptions(ddof=getattr(spec, "ddof", 1)))
+    if name in ("quantile", "unique"):
+        raise NotImplementedError(
+            f"{name} is a global aggregation; arrow's group_by has no exact "
+            f"kernel for it (ref: the reference sorts per group instead — "
+            f"use map_groups for per-group custom reductions)")
+    kernel = {"sum": "sum", "min": "min", "max": "max", "mean": "mean",
+              "count": "count"}[name]
+    return (col, kernel)
+
+
+def _map_groups(block: Block, op: MapGroups) -> Block:
+    """Sort by key, slice group boundaries, apply the UDF per group batch,
+    concat results (ref: grouped_data.py:93 map_groups)."""
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    if n == 0:
+        return block
+    if op.key is None:
+        groups = [block]
+    else:
+        keys = block_mod.column_to_numpy(block, op.key)
+        order = np.argsort(keys, kind="stable")
+        sorted_block = acc.take(list(map(int, order)))
+        sorted_keys = keys[order]
+        boundaries = [0] + [
+            i for i in range(1, n) if sorted_keys[i] != sorted_keys[i - 1]
+        ] + [n]
+        sacc = BlockAccessor(sorted_block)
+        groups = [sacc.slice(boundaries[i], boundaries[i + 1])
+                  for i in range(len(boundaries) - 1)]
+    out_blocks = []
+    for g in groups:
+        batch = BlockAccessor(g).to_batch(op.batch_format)
+        result = op.fn(batch)
+        out_blocks.append(block_from_batch(result))
+    return concat_blocks(out_blocks)

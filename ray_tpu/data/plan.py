@@ -165,10 +165,25 @@ class Zip(LogicalOp):
 class Aggregate(LogicalOp):
     name = "Aggregate"
 
-    def __init__(self, input_op, key: Optional[str], aggs: List[Tuple[str, str]]):
+    def __init__(self, input_op, key: Optional[str], aggs: List[Any]):
         super().__init__(input_op)
         self.key = key
-        self.aggs = aggs  # [(column, fn name)]
+        #: mixed list of (column, fn-name) tuples and data.aggregate
+        #: AggregateFn specs; the executor normalizes.
+        self.aggs = aggs
+
+
+class MapGroups(LogicalOp):
+    """Apply a UDF per group (ref: grouped_data.py:93 map_groups — sorts by
+    key, slices group boundaries, maps each group batch)."""
+
+    name = "MapGroups"
+
+    def __init__(self, input_op, key: Optional[str], fn, batch_format: str = "numpy"):
+        super().__init__(input_op)
+        self.key = key
+        self.fn = fn
+        self.batch_format = batch_format
 
 
 def fuse_maps(ops: List[LogicalOp]) -> List[LogicalOp]:

@@ -154,6 +154,13 @@ class SACLearner(JaxLearner):
 
     def __init__(self, **kw):
         super().__init__(**kw)
+        if self.world_size > 1:
+            # The three-optimizer step below has no gradient-allreduce hook;
+            # installing it silently would let multi-learner SAC/CQL diverge
+            # per-rank (each updating on its own shard) — fail fast instead.
+            raise NotImplementedError(
+                "SAC/CQL multi-learner gradient sync is not implemented; "
+                "use num_learners<=1 (PPO/DQN/IMPALA support learner groups)")
         cfg = self.config
         self._target_entropy = (
             -float(self.module.action_dim)

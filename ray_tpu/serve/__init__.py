@@ -10,7 +10,8 @@ requests from one event loop.
 from ray_tpu.serve.api import (Application, Deployment, delete, deployment,
                                get_app_handle, get_deployment_handle, run,
                                shutdown, start, status)
-from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from ray_tpu.serve.config import (AutoscalingConfig, DeploymentConfig,
+                                  GRPCOptions, HTTPOptions)
 from ray_tpu.serve.context import get_multiplexed_model_id
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import multiplexed
@@ -19,7 +20,7 @@ from ray_tpu.serve.proxy import Request
 __all__ = [
     "Application", "Deployment", "deployment", "run", "start", "shutdown",
     "delete", "status", "get_app_handle", "get_deployment_handle",
-    "AutoscalingConfig", "DeploymentConfig", "HTTPOptions",
+    "AutoscalingConfig", "DeploymentConfig", "GRPCOptions", "HTTPOptions",
     "DeploymentHandle", "DeploymentResponse", "Request", "multiplexed",
     "get_multiplexed_model_id",
 ]

@@ -124,10 +124,10 @@ def _get_controller():
 
 
 def start(http_options: Optional[Union[HTTPOptions, Dict]] = None,
-          detached: bool = True) -> None:
-    """Start the Serve instance: controller + HTTP proxy
-    (ref: serve/api.py start — proxy comes up with default HTTPOptions
-    unless overridden)."""
+          detached: bool = True, *,
+          grpc_options: Optional[Union["GRPCOptions", Dict]] = None) -> None:
+    """Start the Serve instance: controller + HTTP proxy (+ gRPC ingress
+    when grpc_options is given) (ref: serve/api.py start)."""
     controller = _get_controller()
     if _state["proxy"] is None:
         if isinstance(http_options, dict):
@@ -136,6 +136,14 @@ def start(http_options: Optional[Union[HTTPOptions, Dict]] = None,
 
         _state["proxy"] = HTTPProxy(controller, http_options or HTTPOptions())
         _state["proxy"].start()
+    if grpc_options is not None and _state.get("grpc_proxy") is None:
+        from ray_tpu.serve.config import GRPCOptions
+        from ray_tpu.serve.grpc_proxy import GRPCProxy
+
+        if isinstance(grpc_options, dict):
+            grpc_options = GRPCOptions(**grpc_options)
+        _state["grpc_proxy"] = GRPCProxy(controller, grpc_options)
+        _state["grpc_proxy"].start()
 
 
 def _build_app(app: Application, app_name: str) -> tuple:
@@ -224,10 +232,13 @@ def shutdown() -> None:
     with _lock:
         controller = _state["controller"]
         proxy = _state.pop("proxy", None)
+        grpc_proxy = _state.pop("grpc_proxy", None)
         _state["controller"] = None
         _state["proxy"] = None
     if proxy is not None:
         proxy.stop()
+    if grpc_proxy is not None:
+        grpc_proxy.stop()
     if controller is not None:
         try:
             ray_tpu.get(controller.graceful_shutdown.remote(), timeout=15.0)

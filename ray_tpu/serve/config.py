@@ -47,6 +47,16 @@ class HTTPOptions:
 
 
 @dataclass
+class GRPCOptions:
+    """(ref: serve/config.py gRPCOptions — port + servicer functions; the
+    generic-handler proxy needs no compiled servicers)."""
+
+    host: str = "127.0.0.1"
+    port: int = 9000
+    max_concurrency: int = 32
+
+
+@dataclass
 class ReplicaConfig:
     """What a replica actor needs to construct the user callable
     (ref: _private/config.py ReplicaConfig — serialized def + args)."""

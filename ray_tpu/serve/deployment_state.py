@@ -63,8 +63,27 @@ class ReplicaWrapper:
         self.state = ReplicaState.STARTING
         self.started_at = time.time()
         opts = dict(info.config.ray_actor_options)
-        opts.setdefault("max_concurrency", max(1, info.config.max_ongoing_requests))
-        self.actor = ray_tpu.remote(ReplicaActor).options(**opts).remote(
+        if opts.get("isolation") == "process" or opts.get("runtime_env"):
+            # Process-tier replica: sync actor class (async actors cannot
+            # cross the process boundary); GIL isolation for the data plane
+            # (ref: every reference replica is its own worker process).
+            from ray_tpu.serve.replica import SyncReplicaActor
+
+            actor_cls = SyncReplicaActor
+            if info.config.max_ongoing_requests > 1:
+                import logging
+
+                logging.getLogger("ray_tpu.serve").warning(
+                    "deployment %s: process-tier replicas execute one request "
+                    "at a time (max_ongoing_requests=%d is per-replica "
+                    "concurrency only on the thread tier); scale with "
+                    "num_replicas instead", info.name,
+                    info.config.max_ongoing_requests)
+        else:
+            actor_cls = ReplicaActor
+            opts.setdefault("max_concurrency",
+                            max(1, info.config.max_ongoing_requests))
+        self.actor = ray_tpu.remote(actor_cls).options(**opts).remote(
             info.name, self.replica_id, info.deployment_def,
             info.init_args, dict(info.init_kwargs),
             user_config=info.config.user_config)

@@ -1,0 +1,158 @@
+"""gRPC ingress proxy.
+
+(ref: python/ray/serve/_private/proxy.py gRPCProxy:540 — a grpc.aio server
+whose service methods route to applications selected by the request's
+``application`` metadata key; proto `src/ray/protobuf/serve.proto`.)
+
+Generic-handler redesign: instead of compiled per-user protos (grpcio-tools
+is not in the image), the proxy registers a *generic* RPC handler that
+accepts ANY ``/package.Service/Method`` path with raw-bytes payloads.  The
+target application comes from the ``application`` metadata key (falling back
+to the sole deployed app); the called method name is forwarded so one
+ingress deployment can dispatch on it.  User callables receive a
+``GRPCRequest`` and return bytes/str (or any object, pickled).  Built-in
+methods mirror the reference's ``ListApplications`` and ``Healthz``.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Any, Dict, Optional
+
+from ray_tpu.serve.config import GRPCOptions
+from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve.long_poll import LongPollClient
+
+
+class GRPCRequest:
+    """What the ingress callable receives for a gRPC request
+    (ref: serve.grpc_util.RayServegRPCContext + user proto message)."""
+
+    def __init__(self, payload: bytes, method: str,
+                 metadata: Dict[str, str]):
+        self.payload = payload
+        self.method = method  # bare method name, e.g. "Predict"
+        self.metadata = metadata
+
+    def __repr__(self) -> str:
+        return f"GRPCRequest(method={self.method}, {len(self.payload)}B)"
+
+
+class GRPCProxy:
+    """grpc.server thread routing RPCs → ingress deployment handles."""
+
+    BUILTIN_SERVICE = "ray_tpu.serve.RayServeAPIService"
+
+    def __init__(self, controller_handle, options: GRPCOptions):
+        self._controller = controller_handle
+        self._options = options
+        self._route_table: Dict[str, Dict[str, str]] = {}
+        self._handles: Dict[str, DeploymentHandle] = {}
+        self._long_poll: Optional[LongPollClient] = None
+        self._server = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        import grpc
+
+        self._long_poll = LongPollClient(
+            self._controller, {"route_table": self._update_routes})
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=self._options.max_concurrency),
+            options=[("grpc.so_reuseport", 0)])
+        self._server.add_generic_rpc_handlers((_GenericHandler(self),))
+        port = self._server.add_insecure_port(
+            f"{self._options.host}:{self._options.port}")
+        self._options.port = port
+        self._server.start()
+
+    def _update_routes(self, table: Dict[str, Dict[str, str]]) -> None:
+        self._route_table = dict(table or {})
+
+    def stop(self) -> None:
+        if self._long_poll:
+            self._long_poll.stop()
+        if self._server is not None:
+            self._server.stop(grace=1.0)
+            self._server = None
+
+    @property
+    def address(self) -> str:
+        return f"{self._options.host}:{self._options.port}"
+
+    # -------------------------------------------------------------- routing
+    def _app_target(self, app_name: Optional[str]):
+        apps = {t["app_name"]: t for t in self._route_table.values()}
+        if app_name:
+            return apps.get(app_name)
+        if len(apps) == 1:  # sole app: metadata key optional
+            return next(iter(apps.values()))
+        return None
+
+    def handle_rpc(self, service: str, method: str, payload: bytes,
+                   metadata: Dict[str, str]) -> bytes:
+        if service == self.BUILTIN_SERVICE:
+            return self._handle_builtin(method)
+        target = self._app_target(metadata.get("application"))
+        if target is None:
+            raise KeyError(
+                f"no application for metadata "
+                f"application={metadata.get('application')!r}; "
+                f"deployed: {sorted(t['app_name'] for t in self._route_table.values())}")
+        app_name, ingress = target["app_name"], target["ingress"]
+        handle = self._handles.get(app_name)
+        if handle is None:
+            handle = self._handles[app_name] = DeploymentHandle(
+                ingress, app_name, self._controller)
+        req = GRPCRequest(payload, method, metadata)
+        result = handle.remote(req).result(timeout_s=60.0)
+        if isinstance(result, bytes):
+            return result
+        if isinstance(result, str):
+            return result.encode()
+        from ray_tpu._private import serialization
+
+        return serialization.dumps(result)
+
+    def _handle_builtin(self, method: str) -> bytes:
+        import json
+
+        if method == "Healthz":
+            return b"success"
+        if method == "ListApplications":
+            apps = sorted({t["app_name"]
+                           for t in self._route_table.values()})
+            return json.dumps(apps).encode()
+        raise KeyError(f"unknown builtin method {method!r}")
+
+
+class _GenericHandler:
+    """grpc GenericRpcHandler accepting any method path with bytes io."""
+
+    def __init__(self, proxy: GRPCProxy):
+        self._proxy = proxy
+
+    def service(self, handler_call_details):
+        import grpc
+
+        full = handler_call_details.method  # "/pkg.Service/Method"
+        _, _, rest = full.partition("/")
+        service, _, method = rest.partition("/")
+        metadata = {k: v for k, v in
+                    (handler_call_details.invocation_metadata or ())}
+
+        def unary_unary(request: bytes, context):
+            try:
+                return self._proxy.handle_rpc(service, method, request,
+                                              metadata)
+            except KeyError as e:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            except Exception as e:  # noqa: BLE001 — surfaced to the client
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+        return grpc.unary_unary_rpc_method_handler(
+            unary_unary,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b)

@@ -120,3 +120,49 @@ class ReplicaActor:
         deadline = time.time() + 5.0
         while self._num_ongoing > 0 and time.time() < deadline:
             await asyncio.sleep(0.02)
+
+
+class SyncReplicaActor(ReplicaActor):
+    """Process-tier replica: every async endpoint re-exposed sync so the
+    actor can run in its own OS process (isolation='process'), giving the
+    data plane real GIL isolation (the reference gets this for free — every
+    Serve replica is its own worker process; thread-tier replicas here share
+    the driver's interpreter).
+
+    Async user callables still work: each call drives them on a private
+    event loop via asyncio.run.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+
+    def initialize_and_get_metadata(self) -> Dict[str, Any]:
+        if self._user_config is not None:
+            asyncio.run(self._wrapper.call_reconfigure(self._user_config))
+        return {"replica_id": self.replica_id}
+
+    def handle_request(self, method_name: str, *args, **kwargs) -> Any:
+        self._num_ongoing += 1
+        try:
+            from ray_tpu.serve import context as serve_context
+
+            serve_context._set_internal_replica_context(
+                deployment=self.deployment_name, replica_id=self.replica_id,
+                replica=self)
+            return asyncio.run(self._wrapper.call(method_name, args, kwargs))
+        finally:
+            self._num_ongoing -= 1
+            self._num_processed += 1
+
+    def reconfigure(self, user_config: Any) -> None:
+        self._user_config = user_config
+        asyncio.run(self._wrapper.call_reconfigure(user_config))
+
+    def check_health(self) -> bool:
+        asyncio.run(self._wrapper.call_health_check())
+        return True
+
+    def prepare_for_shutdown(self) -> None:
+        deadline = time.time() + 5.0
+        while self._num_ongoing > 0 and time.time() < deadline:
+            time.sleep(0.02)

@@ -18,6 +18,7 @@ from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -52,7 +53,7 @@ __all__ = [
     "get_checkpoint", "Searcher", "BasicVariantGenerator", "RandomSearch",
     "ConcurrencyLimiter", "HyperOptStyleSearcher", "TrialScheduler",
     "FIFOScheduler", "ASHAScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "ResultGrid", "Trial", "Checkpoint",
+    "PB2", "PopulationBasedTraining", "ResultGrid", "Trial", "Checkpoint",
     "RunConfig", "FailureConfig", "CheckpointConfig",
     "uniform", "quniform", "loguniform", "qloguniform", "randn", "randint",
     "qrandint", "lograndint", "choice", "sample_from", "grid_search",

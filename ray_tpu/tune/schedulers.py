@@ -250,3 +250,98 @@ class PopulationBasedTraining(TrialScheduler):
             elif callable(spec):
                 config[key] = spec()
         return config
+
+
+class PB2(PopulationBasedTraining):
+    """Population-Based Bandits (ref: tune/schedulers/pb2.py PB2 — PBT where
+    explore() picks new hyperparameters with a GP-bandit (UCB) fit on
+    observed (hyperparams -> reward improvement) data instead of random
+    perturbation; Parker-Holder et al. 2020).
+
+    Requires numeric search bounds: ``hyperparam_mutations`` values must be
+    ``[low, high]`` lists or tune domains with numeric bounds.
+    """
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25, seed: Optional[int] = None):
+        super().__init__(metric=metric, mode=mode, time_attr=time_attr,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations=hyperparam_bounds,
+                         quantile_fraction=quantile_fraction, seed=seed)
+        self.bounds: Dict[str, tuple] = {}
+        for key, spec in (hyperparam_bounds or {}).items():
+            if isinstance(spec, (list, tuple)) and len(spec) == 2:
+                self.bounds[key] = (float(spec[0]), float(spec[1]))
+            else:
+                from ray_tpu.tune.search_space import Domain
+
+                if isinstance(spec, Domain) and hasattr(spec, "lower"):
+                    self.bounds[key] = (float(spec.lower), float(spec.upper))
+                else:
+                    raise ValueError(
+                        f"PB2 needs numeric [low, high] bounds for {key!r}")
+        #: GP training data: rows of (normalized hyperparams, reward delta)
+        self._X: List[List[float]] = []
+        self._y: List[float] = []
+        self._prev_score: Dict[str, float] = {}
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        score = result.get(self.metric)
+        if score is not None:
+            prev = self._prev_score.get(trial.trial_id)
+            if prev is not None:
+                self._X.append(self._normalize(trial.config))
+                delta = float(score) - prev
+                self._y.append(delta if self.mode == "max" else -delta)
+                if len(self._y) > 512:  # bound GP cost
+                    self._X.pop(0)
+                    self._y.pop(0)
+            self._prev_score[trial.trial_id] = float(score)
+        return super().on_trial_result(trial, result)
+
+    def _normalize(self, config: Dict[str, Any]) -> List[float]:
+        row = []
+        for key, (lo, hi) in sorted(self.bounds.items()):
+            v = float(config.get(key, lo))
+            row.append((v - lo) / (hi - lo) if hi > lo else 0.0)
+        return row
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        """GP-UCB over candidate configs (the PB2 selection step)."""
+        import numpy as np
+
+        keys = sorted(self.bounds)
+        if len(self._y) < 4:
+            # Cold start: uniform sample inside bounds.
+            for k in keys:
+                lo, hi = self.bounds[k]
+                config[k] = type(config.get(k, lo))(self._rng.uniform(lo, hi))
+            return config
+        X = np.asarray(self._X)
+        y = np.asarray(self._y)
+        y = (y - y.mean()) / (y.std() + 1e-8)
+
+        def kernel(A, B, ls=0.2):
+            d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+            return np.exp(-d2 / (2 * ls * ls))
+
+        K = kernel(X, X) + 1e-4 * np.eye(len(X))
+        Kinv_y = np.linalg.solve(K, y)
+        # Candidate pool: random points in the unit box.
+        cands = np.asarray([[self._rng.random() for _ in keys]
+                            for _ in range(64)])
+        Ks = kernel(cands, X)
+        mu = Ks @ Kinv_y
+        Kinv_Ks = np.linalg.solve(K, Ks.T)
+        var = np.clip(1.0 - np.einsum("ij,ji->i", Ks, Kinv_Ks), 1e-6, None)
+        ucb = mu + 1.0 * np.sqrt(var)
+        best = cands[int(np.argmax(ucb))]
+        for k, u in zip(keys, best):
+            lo, hi = self.bounds[k]
+            v = lo + float(u) * (hi - lo)
+            config[k] = type(config.get(k, v))(v) \
+                if isinstance(config.get(k), (int, float)) else v
+        return config

@@ -73,6 +73,9 @@ class TuneController:
         checkpoint_at_end: bool = False,
         callbacks: Optional[List] = None,
         time_budget_s: Optional[float] = None,
+        snapshot_fn: Optional[Callable[[List["Trial"]], None]] = None,
+        snapshot_period_s: float = 10.0,
+        restore_checkpoints: Optional[Dict[str, str]] = None,
     ):
         self.trainable_cls = trainable_cls
         self.searcher = searcher
@@ -88,6 +91,13 @@ class TuneController:
         self.checkpoint_at_end = checkpoint_at_end
         self.callbacks = callbacks or []
         self.time_budget_s = time_budget_s
+        #: Periodic experiment-state writer (ref: experiment_state.py) —
+        #: makes a crash-interrupted run restorable via Tuner.restore.
+        self.snapshot_fn = snapshot_fn
+        self.snapshot_period_s = snapshot_period_s
+        self._last_snapshot = 0.0
+        #: config-json -> checkpoint path for restored trials.
+        self.restore_checkpoints = restore_checkpoints or {}
 
         self.trials: List[Trial] = []
         self._searcher_done = False
@@ -117,6 +127,14 @@ class TuneController:
                 time.sleep(0.01)
                 continue
             self._process_events(live)
+            if (self.snapshot_fn is not None
+                    and time.monotonic() - self._last_snapshot
+                    > self.snapshot_period_s):
+                self._last_snapshot = time.monotonic()
+                try:
+                    self.snapshot_fn(self.trials)
+                except Exception:
+                    pass  # snapshots must never kill the experiment
             if deadline and time.monotonic() > deadline:
                 for t in live:
                     self._stop_trial(t, Trial.TERMINATED)
@@ -164,6 +182,14 @@ class TuneController:
             budget -= 1
 
     def _start_trial(self, trial: Trial, restore_from: Optional[str] = None) -> None:
+        if restore_from is None and trial.checkpoint_path is None \
+                and self.restore_checkpoints:
+            # Experiment restore: resume this config from its recorded
+            # checkpoint (keyed by config contents — trial ids are fresh).
+            import json as _json
+
+            key = _json.dumps(trial.config, sort_keys=True, default=str)
+            restore_from = self.restore_checkpoints.get(key)
         trial.actor = _TrainableActor.options(
             resources=trial.resources).remote(
             self.trainable_cls, trial.config, trial.logdir, trial.trial_id,

@@ -49,10 +49,84 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
+        self._restore_state: Optional[dict] = None
+        self._restore_path: Optional[str] = None
+
+    # ------------------------------------------------------------- restore
+    @classmethod
+    def restore(cls, path: str, trainable: Union[Callable, type],
+                *, resume_errored: bool = True,
+                tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its directory
+        (ref: tuner.py Tuner.restore / tune/execution/experiment_state.py).
+
+        Finished trials are carried through as results; unfinished (and,
+        with ``resume_errored``, errored) trials re-run with their recorded
+        configs, restoring from their last checkpoint when one exists.
+        """
+        state_file = os.path.join(path, "experiment_state.json")
+        with open(state_file) as f:
+            state = json.load(f)
+        tuner = cls(trainable, tune_config=tune_config, run_config=run_config)
+        tuner._restore_state = state
+        tuner._restore_path = path
+        tuner._resume_errored = resume_errored
+        return tuner
+
+    def _fit_restored(self) -> ResultGrid:
+        from ray_tpu.tune.trial import Trial
+
+        tc = self.tune_config
+        state = self._restore_state
+        done_trials = []
+        to_resume = []  # (config, checkpoint, trial_id)
+        for t in state["trials"]:
+            if t["status"] == Trial.TERMINATED:
+                trial = Trial(t["config"], self._restore_path, {},
+                              trial_id=t["trial_id"])
+                trial.status = Trial.TERMINATED
+                trial.last_result = t["last_result"]
+                trial.checkpoint_path = t.get("checkpoint")
+                done_trials.append(trial)
+            elif t["status"] == Trial.ERROR and not self._resume_errored:
+                continue
+            else:
+                to_resume.append((t["config"], t.get("checkpoint"),
+                                  t["trial_id"]))
+        resumed: list = []
+        if to_resume:
+            searcher = _ReplaySearcher([c for c, _, _ in to_resume])
+            searcher.set_search_properties(tc.metric, tc.mode, {})
+            controller = TuneController(
+                trainable_cls=self._as_trainable_cls(self.trainable),
+                searcher=searcher,
+                scheduler=tc.scheduler or FIFOScheduler(),
+                experiment_path=self._restore_path,
+                experiment_name=os.path.basename(self._restore_path),
+                metric=tc.metric, mode=tc.mode,
+                stop=self.run_config.stop,
+                max_concurrent_trials=tc.max_concurrent_trials,
+                max_failures=self.run_config.failure_config.max_failures,
+                trial_resources=dict(tc.trial_resources),
+                time_budget_s=tc.time_budget_s,
+                restore_checkpoints={  # trial resumes from its checkpoint
+                    json.dumps(c, sort_keys=True, default=str): ckpt
+                    for c, ckpt, _ in to_resume if ckpt},
+                # A resumed run must itself stay crash-resumable.
+                snapshot_fn=lambda trials: self._save_experiment_state(
+                    self._restore_path, done_trials + list(trials)),
+            )
+            resumed = controller.run()
+        trials = done_trials + list(resumed)
+        self._save_experiment_state(self._restore_path, trials)
+        return ResultGrid(trials, tc.metric, tc.mode)
 
     def fit(self) -> ResultGrid:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
+        if self._restore_state is not None:
+            return self._fit_restored()
         tc = self.tune_config
         name = self.run_config.name or f"tune_{int(time.time())}"
         storage = self.run_config.storage_path or tempfile.mkdtemp(prefix="ray_tpu_tune_")
@@ -81,6 +155,10 @@ class Tuner:
             max_failures=self.run_config.failure_config.max_failures,
             trial_resources=resources,
             time_budget_s=tc.time_budget_s,
+            # Periodic snapshots make the experiment restorable after a crash
+            # (ref: experiment_state.py periodic checkpointing).
+            snapshot_fn=lambda trials: self._save_experiment_state(
+                experiment_path, trials),
         )
         trials = controller.run()
         self._save_experiment_state(experiment_path, trials)
@@ -112,8 +190,36 @@ class Tuner:
                 for t in trials
             ],
         }
-        with open(os.path.join(experiment_path, "experiment_state.json"), "w") as f:
+        # Atomic write: the periodic snapshot exists to survive crashes, so
+        # a crash mid-dump must never corrupt the previous valid snapshot.
+        final = os.path.join(experiment_path, "experiment_state.json")
+        tmp = final + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(state, f, indent=1)
+        os.replace(tmp, final)
+
+
+class _ReplaySearcher(Searcher):
+    """Feeds a fixed list of configs (experiment restore)."""
+
+    def __init__(self, configs):
+        self._configs = list(configs)
+        self._i = 0
+
+    def set_search_properties(self, metric, mode, param_space) -> bool:
+        return True
+
+    def suggest(self, trial_id: str):
+        if self._i >= len(self._configs):
+            from ray_tpu.tune.search import FINISHED
+
+            return FINISHED
+        cfg = self._configs[self._i]
+        self._i += 1
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        pass
 
 
 def run(trainable, *, config: Optional[Dict[str, Any]] = None,

@@ -258,3 +258,60 @@ def test_map_batches_tuple_concurrency_builds_autoscaling_pool():
     op = ds._op
     assert isinstance(op.compute, ActorPoolStrategy)
     assert op.compute.pool_size == 2 and op.compute.max_size == 5
+
+
+# ------------------------------------------------------- aggregate breadth
+def test_std_unique_quantile(ray_start_regular):
+    import ray_tpu.data as rdata
+    from ray_tpu.data.aggregate import Count, Max, Mean, Min, Quantile, Std, Sum, Unique
+
+    ds = rdata.from_items([{"k": i % 3, "v": float(i)} for i in range(30)])
+    vals = np.arange(30, dtype=float)
+    assert abs(ds.std("v") - np.std(vals, ddof=1)) < 1e-9
+    assert ds.unique("k") == [0, 1, 2]
+    assert abs(ds.aggregate(Quantile("v", q=0.5)) - np.quantile(vals, 0.5)) < 1e-9
+    assert list(ds.aggregate(Unique("k"))) == [0, 1, 2]
+
+    multi = ds.aggregate(Sum("v"), Min("v"), Max("v"), Mean("v"), Count())
+    assert multi["sum(v)"] == vals.sum()
+    assert multi["min(v)"] == 0.0 and multi["max(v)"] == 29.0
+    assert abs(multi["mean(v)"] - vals.mean()) < 1e-9
+    assert multi["count()"] == 30
+
+
+def test_grouped_aggregate_multi(ray_start_regular):
+    import ray_tpu.data as rdata
+    from ray_tpu.data.aggregate import Mean, Std, Sum
+
+    ds = rdata.from_items([{"k": i % 2, "v": float(i)} for i in range(10)])
+    out = ds.groupby("k").aggregate(Sum("v"), Mean("v"), Std("v")).take_all()
+    by_k = {r["k"]: r for r in out}
+    evens = np.arange(0, 10, 2, dtype=float)
+    odds = np.arange(1, 10, 2, dtype=float)
+    assert by_k[0]["v_sum"] == evens.sum()
+    assert abs(by_k[1]["v_mean"] - odds.mean()) < 1e-9
+    assert abs(by_k[0]["v_stddev"] - np.std(evens, ddof=1)) < 1e-9
+
+    std_ds = ds.groupby("k").std("v").take_all()
+    assert len(std_ds) == 2
+
+
+def test_map_groups(ray_start_regular):
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items([{"k": i % 3, "v": float(i)} for i in range(12)])
+
+    def summarize(batch):
+        return {"k": batch["k"][:1], "total": [float(batch["v"].sum())],
+                "n": [len(batch["v"])]}
+
+    out = ds.groupby("k").map_groups(summarize).take_all()
+    assert len(out) == 3
+    by_k = {r["k"]: r for r in out}
+    assert by_k[0]["total"] == sum(float(i) for i in range(12) if i % 3 == 0)
+    assert all(r["n"] == 4 for r in out)
+
+    # key=None: one group over everything.
+    whole = ds.groupby(None).map_groups(
+        lambda b: {"n": [len(b["v"])]}).take_all()
+    assert whole == [{"n": 12}]

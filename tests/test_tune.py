@@ -174,3 +174,64 @@ def test_experiment_state_written(ray_start_regular, tmp_path):
                        run_config=RunConfig(name="exp", storage_path=str(tmp_path)))
     tuner.fit()
     assert (tmp_path / "exp" / "experiment_state.json").exists()
+
+
+def test_pb2_explores_within_bounds(ray_start_regular):
+    from ray_tpu.tune.schedulers import PB2
+
+    def fn(config):
+        lr = config["lr"]
+        score = 0.0
+        ckpt = tune.get_checkpoint()
+        if ckpt:
+            score = float(open(os.path.join(ckpt.path, "s.txt")).read())
+        for _ in range(12):
+            score += lr
+            import tempfile
+
+            d = tempfile.mkdtemp()
+            with open(os.path.join(d, "s.txt"), "w") as f:
+                f.write(str(score))
+            tune.report({"score": score},
+                        checkpoint=tune.Checkpoint.from_directory(d))
+
+    sched = PB2(time_attr="training_iteration", perturbation_interval=3,
+                hyperparam_bounds={"lr": [0.1, 10.0]}, seed=0)
+    grid = tune.run(fn, config={"lr": tune.grid_search([0.1, 0.5, 5.0, 8.0])},
+                    metric="score", mode="max", scheduler=sched,
+                    max_concurrent_trials=4)
+    assert grid.num_errors == 0
+    assert grid.num_terminated == 4
+    # Exploited configs stay inside the declared bounds.
+    for r in grid:
+        assert 0.1 <= r.metrics["config"]["lr"] <= 10.0
+
+
+def test_tuner_restore_resumes_unfinished(ray_start_regular, tmp_path):
+    """Crash-interrupted experiment: errored trial re-runs on restore,
+    finished trials carry through (ref: Tuner.restore)."""
+    from ray_tpu.train.config import RunConfig
+
+    marker = tmp_path / "second_attempt"
+
+    def flaky(config):
+        if config["x"] == 2 and not marker.exists():
+            marker.write_text("tried")
+            raise RuntimeError("simulated crash")
+        tune.report({"score": config["x"] * 10.0, "done": True})
+
+    tuner = tune.Tuner(
+        flaky, param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="restorable", storage_path=str(tmp_path)))
+    grid = tuner.fit()
+    assert grid.num_errors == 1
+    exp_path = str(tmp_path / "restorable")
+
+    restored = tune.Tuner.restore(exp_path, flaky)
+    restored.tune_config = tune.TuneConfig(metric="score", mode="max")
+    grid2 = restored.fit()
+    assert grid2.num_errors == 0
+    assert grid2.num_terminated == 3
+    scores = sorted(r.metrics["score"] for r in grid2)
+    assert scores == [10.0, 20.0, 30.0]
