@@ -1,0 +1,175 @@
+"""Cross-node borrowing protocol for the ownership-based refcount.
+
+TPU-native analogue of the reference's distributed ReferenceCounter
+borrowing (ref: src/ray/core_worker/reference_count.h:66 — when a ref is
+serialized to another worker, the owner records the borrower and keeps the
+object alive until every borrower reports its local count hit zero).
+
+Shape here: the borrower side registers a borrow with the object's owner
+the first time a remote-owned ref materializes in this process (ObjectRef
+deserialization), and releases it when the process-local refcount for that
+id drops to zero.  Messages ride the object-transfer TCP protocol
+(OP_ADD_BORROW / OP_RELEASE_BORROW) synchronously (see BorrowClient for the
+ordering argument).  The owner's store frees an object only when BOTH its
+local refcount is zero and no borrows remain.
+
+Failure notes (documented divergence from the reference's full protocol):
+a borrower that dies without releasing leaks its borrow on the owner until
+the owner runtime shuts down; the reference reclaims via worker-death
+pubsub, which maps here to node-death detection — future work.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID
+
+
+class BorrowClient:
+    """Borrower-side tracker (one per process).
+
+    All protocol messages are sent SYNCHRONOUSLY under the client lock:
+    - ADD_BORROW inside register() gives happens-before between a ref
+      materializing here and the owner's next free decision — by the time
+      deserialization returns, the owner has the borrow on its ledger.
+    - RELEASE_BORROW inside on_local_release() (still under the lock, after
+      re-checking the live refcount) serializes release-vs-re-register so a
+      re-borrow can never be cancelled by a stale release.
+    These events are rare (first/last handle per object per process) and the
+    round trip is one localhost-or-ICI-class TCP exchange, so blocking is
+    the right trade for the ordering guarantees (the reference gets the same
+    guarantees by piggybacking borrow reports on synchronous task replies —
+    reference_count.h:66).
+    """
+
+    def __init__(self, borrower_id: str):
+        self.borrower_id = borrower_id
+        self._lock = threading.Lock()
+        #: oid -> (owner_addr, local borrow handle count)
+        self._borrows: Dict[ObjectID, Tuple[str, int]] = {}
+        self.stats = {"registered": 0, "released": 0, "send_failures": 0}
+
+    # ----------------------------------------------------------- borrower API
+    def register(self, oid: ObjectID, owner_addr: str) -> None:
+        """Called on deserialization of a remote-owned ref; the first handle
+        per object registers with the owner before returning."""
+        with self._lock:
+            entry = self._borrows.get(oid)
+            if entry is not None:
+                self._borrows[oid] = (entry[0], entry[1] + 1)
+                return
+            self._borrows[oid] = (owner_addr, 1)
+            self.stats["registered"] += 1
+            self._send("add", oid, owner_addr)
+
+    def on_local_release(self, oid: ObjectID, count_fn=None) -> None:
+        """Refcounter zero-callback: all local handles died.  ``count_fn``
+        re-reads the live refcount under the borrow lock — a concurrent
+        re-deserialization may have revived the object between the zero
+        event and this call."""
+        with self._lock:
+            entry = self._borrows.get(oid)
+            if entry is None:
+                return
+            if count_fn is not None and count_fn(oid) > 0:
+                return  # revived: a fresh handle exists, keep the borrow
+            del self._borrows[oid]
+            self.stats["released"] += 1
+            self._send("release", oid, entry[0])
+
+    def holds(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._borrows
+
+    # ------------------------------------------------------------- transport
+    def _send(self, kind: str, oid: ObjectID, addr: str) -> None:
+        """Synchronous one-shot exchange; caller holds the lock."""
+        from ray_tpu._private import object_transfer as ot
+
+        try:
+            op = ot.OP_ADD_BORROW if kind == "add" else ot.OP_RELEASE_BORROW
+            sock = ot._request_sock(addr, 2.0)
+            try:
+                bid = self.borrower_id.encode()
+                import struct
+
+                sock.sendall(ot._req_header(op, oid)
+                             + struct.pack("<H", len(bid)) + bid)
+                ot._recv_exact(sock, 1)
+            finally:
+                sock.close()
+        except Exception:
+            # Owner gone or unreachable: nothing to protect anymore.
+            self.stats["send_failures"] += 1
+
+
+_client: Optional[BorrowClient] = None
+_client_lock = threading.Lock()
+
+
+def global_borrow_client() -> BorrowClient:
+    global _client
+    with _client_lock:
+        if _client is None:
+            import os
+            import uuid
+
+            _client = BorrowClient(f"{os.getpid()}-{uuid.uuid4().hex[:8]}")
+        return _client
+
+
+def notify_zero(oid: ObjectID, count_fn=None) -> None:
+    """Refcounter zero hook: release the borrow if this process held one.
+    No-op (and allocation-free) unless this process ever borrowed."""
+    c = _client
+    if c is not None:
+        c.on_local_release(oid, count_fn=count_fn)
+
+
+def release_all() -> None:
+    """Runtime shutdown: return every outstanding borrow to its owner.
+    Sends are synchronous, so every release is on the wire (and acked)
+    before this returns — nothing is lost to interpreter teardown."""
+    c = _client
+    if c is None:
+        return
+    with c._lock:
+        entries = list(c._borrows.items())
+        c._borrows.clear()
+        for oid, (addr, _) in entries:
+            c.stats["released"] += 1
+            c._send("release", oid, addr)
+
+
+class BorrowLedger:
+    """Owner-side record of which remote processes borrow which objects."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._borrowers: Dict[ObjectID, set] = {}
+
+    def add(self, oid: ObjectID, borrower_id: str) -> None:
+        with self._lock:
+            self._borrowers.setdefault(oid, set()).add(borrower_id)
+
+    def release(self, oid: ObjectID, borrower_id: str) -> bool:
+        """Returns True when the LAST borrower released (caller may free)."""
+        with self._lock:
+            holders = self._borrowers.get(oid)
+            if holders is None:
+                return False
+            holders.discard(borrower_id)
+            if not holders:
+                del self._borrowers[oid]
+                return True
+            return False
+
+    def is_borrowed(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._borrowers
+
+    def borrowed_ids(self):
+        with self._lock:
+            return list(self._borrowers)

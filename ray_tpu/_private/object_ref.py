@@ -29,7 +29,18 @@ class ObjectRef:
 
     @staticmethod
     def _deserialize(object_id: str, owner: str, owner_addr: str = "") -> "ObjectRef":
-        return ObjectRef(ObjectID(object_id), owner, owner_addr)
+        ref = ObjectRef(ObjectID(object_id), owner, owner_addr)
+        if owner_addr:
+            from ray_tpu._private.object_transfer import local_server_addr
+
+            if owner_addr != local_server_addr():
+                # A remote-owned ref materialized here: register the borrow
+                # so the owner keeps the primary copy alive until this
+                # process's handles die (ref: reference_count.h borrowers).
+                from ray_tpu._private.borrowing import global_borrow_client
+
+                global_borrow_client().register(ref.id, owner_addr)
+        return ref
 
     def _routable_owner_addr(self) -> str:
         """Owner address to embed when this ref crosses a process boundary.
@@ -137,6 +148,14 @@ class ReferenceCounter:
                 self._counts[object_id] = count
         if cb is not None:
             cb(object_id)
+        if count <= 0:
+            # Borrower-side of the cross-node protocol: if this process
+            # borrowed the object, tell the owner the last handle died.
+            # The live-count re-read closes the race with a concurrent
+            # re-deserialization reviving the ref.
+            from ray_tpu._private import borrowing
+
+            borrowing.notify_zero(object_id, count_fn=self.count)
 
     def count(self, object_id: ObjectID) -> int:
         with self._lock:

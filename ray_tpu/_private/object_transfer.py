@@ -53,6 +53,10 @@ OP_PULL = 1
 OP_CONTAINS = 2
 OP_PUSH = 3
 OP_FREE = 4
+#: Borrowing protocol (ref: reference_count.h borrower registration):
+#: request carries borrower_len:u16 + borrower id after the object id.
+OP_ADD_BORROW = 5
+OP_RELEASE_BORROW = 6
 
 ST_OK = 0
 ST_NOT_FOUND = 1
@@ -136,10 +140,14 @@ class ObjectTransferServer:
     def __init__(self, store_provider: Callable[[], object],
                  on_received: Optional[Callable[[ObjectID], None]] = None,
                  is_pending: Optional[Callable[[ObjectID], bool]] = None,
+                 on_borrow: Optional[Callable[[ObjectID, str], None]] = None,
+                 on_borrow_release: Optional[Callable[[ObjectID, str], None]] = None,
                  host: str = "127.0.0.1", port: int = 0):
         self._store_provider = store_provider
         self._on_received = on_received
         self._is_pending = is_pending
+        self._on_borrow = on_borrow
+        self._on_borrow_release = on_borrow_release
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -193,6 +201,14 @@ class ObjectTransferServer:
                     store = self._store_provider()
                     if store is not None:
                         store.free(oid)
+                    conn.sendall(bytes([ST_OK]))
+                elif op in (OP_ADD_BORROW, OP_RELEASE_BORROW):
+                    (blen,) = struct.unpack("<H", _recv_exact(conn, 2))
+                    borrower = _recv_exact(conn, blen).decode() if blen else ""
+                    cb = (self._on_borrow if op == OP_ADD_BORROW
+                          else self._on_borrow_release)
+                    if cb is not None:
+                        cb(oid, borrower)
                     conn.sendall(bytes([ST_OK]))
                 else:
                     conn.sendall(bytes([ST_ERROR]))

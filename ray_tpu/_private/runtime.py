@@ -280,6 +280,7 @@ class Runtime:
         # address; the pull manager fetches remote-owned refs on demand.
         self.object_server = None
         self._pull_mgr = None
+        self._borrows = None  # owner-side BorrowLedger (lazy)
         if self.config.enable_object_transfer:
             self.start_object_server()
 
@@ -382,9 +383,31 @@ class Runtime:
             self.object_server = object_transfer.ObjectTransferServer(
                 lambda: self.store, on_received=self._on_object_ready,
                 is_pending=self._object_is_pending,
+                on_borrow=self._on_remote_borrow,
+                on_borrow_release=self._on_remote_borrow_release,
                 host=self.config.object_transfer_host)
         self._pull_manager()  # pulls and serves share a lifetime
         return self.object_server.addr
+
+    # Borrowing protocol (owner side) — a borrowed object survives the local
+    # refcount hitting zero until every borrower releases
+    # (ref: reference_count.h:66 borrower bookkeeping).
+    def _borrow_ledger(self):
+        from ray_tpu._private.borrowing import BorrowLedger
+
+        if self._borrows is None:
+            self._borrows = BorrowLedger()
+        return self._borrows
+
+    def _on_remote_borrow(self, object_id: ObjectID, borrower: str) -> None:
+        self._borrow_ledger().add(object_id, borrower)
+
+    def _on_remote_borrow_release(self, object_id: ObjectID, borrower: str) -> None:
+        if self._borrow_ledger().release(object_id, borrower) \
+                and self.refcounter.count(object_id) == 0:
+            # Last borrower gone and no local handles: free now (the local
+            # zero-callback already fired and deferred to the borrow).
+            self._on_zero_refs(object_id)
 
     def _object_is_pending(self, object_id: ObjectID) -> bool:
         """Owner-side directory answer: is something still producing this
@@ -857,6 +880,11 @@ class Runtime:
             return self._lineage.get(object_id)
 
     def _on_zero_refs(self, object_id: ObjectID) -> None:
+        if self._borrows is not None and self._borrows.is_borrowed(object_id):
+            # Remote borrowers still hold handles: the owner keeps the
+            # primary copy until the last RELEASE_BORROW arrives
+            # (ref: reference_count.h — borrows keep the object pinned).
+            return
         self.store.free(object_id)
         with self._lineage_lock:
             self._lineage.pop(object_id, None)
@@ -1190,6 +1218,9 @@ class Runtime:
                 state.mailbox.put(None)
         self.process_pool.shutdown()
         self._exec_pool.shutdown(wait=False, cancel_futures=True)
+        from ray_tpu._private import borrowing
+
+        borrowing.release_all()  # return outstanding borrows to their owners
         if self.object_server is not None:
             self.object_server.stop()
             self.object_server = None

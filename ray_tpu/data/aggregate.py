@@ -36,9 +36,6 @@ class AggregateFn:
 class Count(AggregateFn):
     fn_name = "count"
 
-    def __init__(self, on: Optional[str] = None, alias_name: Optional[str] = None):
-        super().__init__(on, alias_name)
-
 
 class Sum(AggregateFn):
     fn_name = "sum"

@@ -133,9 +133,6 @@ class SyncReplicaActor(ReplicaActor):
     event loop via asyncio.run.
     """
 
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
-
     def initialize_and_get_metadata(self) -> Dict[str, Any]:
         if self._user_config is not None:
             asyncio.run(self._wrapper.call_reconfigure(self._user_config))

@@ -185,11 +185,14 @@ class TuneController:
         if restore_from is None and trial.checkpoint_path is None \
                 and self.restore_checkpoints:
             # Experiment restore: resume this config from its recorded
-            # checkpoint (keyed by config contents — trial ids are fresh).
+            # checkpoint (keyed by config contents — trial ids are fresh;
+            # duplicate configs pop their checkpoints in creation order).
             import json as _json
 
             key = _json.dumps(trial.config, sort_keys=True, default=str)
-            restore_from = self.restore_checkpoints.get(key)
+            ckpts = self.restore_checkpoints.get(key)
+            if ckpts:
+                restore_from = ckpts.pop(0)
         trial.actor = _TrainableActor.options(
             resources=trial.resources).remote(
             self.trainable_cls, trial.config, trial.logdir, trial.trial_id,

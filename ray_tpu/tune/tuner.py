@@ -110,9 +110,7 @@ class Tuner:
                 max_failures=self.run_config.failure_config.max_failures,
                 trial_resources=dict(tc.trial_resources),
                 time_budget_s=tc.time_budget_s,
-                restore_checkpoints={  # trial resumes from its checkpoint
-                    json.dumps(c, sort_keys=True, default=str): ckpt
-                    for c, ckpt, _ in to_resume if ckpt},
+                restore_checkpoints=_checkpoints_by_config(to_resume),
                 # A resumed run must itself stay crash-resumable.
                 snapshot_fn=lambda trials: self._save_experiment_state(
                     self._restore_path, done_trials + list(trials)),
@@ -197,6 +195,19 @@ class Tuner:
         with open(tmp, "w") as f:
             json.dump(state, f, indent=1)
         os.replace(tmp, final)
+
+
+def _checkpoints_by_config(to_resume) -> Dict[str, list]:
+    """config-json -> [checkpoints, in original trial order].  A LIST per
+    key because identical configs (num_samples>1 over a constant space) are
+    distinct trials with distinct checkpoints; the controller pops in trial
+    creation order so each resumed trial gets its own state back."""
+    out: Dict[str, list] = {}
+    for c, ckpt, _ in to_resume:
+        if ckpt:
+            out.setdefault(json.dumps(c, sort_keys=True, default=str),
+                           []).append(ckpt)
+    return out
 
 
 class _ReplaySearcher(Searcher):

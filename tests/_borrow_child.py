@@ -1,0 +1,31 @@
+"""Borrower process for the cross-node borrowing-protocol test.
+
+Reads a base64-pickled ObjectRef from argv, materializes it (registering a
+borrow with the owner — the parent process), pulls the value, prints GOT,
+then holds the ref until stdin closes; shutdown releases the borrow.
+"""
+
+import base64
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import ray_tpu  # noqa: E402
+from ray_tpu._private import serialization  # noqa: E402
+
+
+def main() -> None:
+    ray_tpu.init()
+    ref = serialization.loads(base64.b64decode(sys.argv[1]))
+    value = ray_tpu.get(ref, timeout=30)
+    print(f"GOT {int(value.sum())}", flush=True)
+    sys.stdin.read()  # parent closes stdin when it wants the release
+    del ref
+    ray_tpu.shutdown()  # release_all returns the borrow
+    print("RELEASED", flush=True)
+
+
+if __name__ == "__main__":
+    main()
