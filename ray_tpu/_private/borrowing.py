@@ -22,7 +22,7 @@ pubsub, which maps here to node-death detection — future work.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 from ray_tpu._private.ids import ObjectID
 
@@ -47,8 +47,10 @@ class BorrowClient:
     def __init__(self, borrower_id: str):
         self.borrower_id = borrower_id
         self._lock = threading.Lock()
-        #: oid -> (owner_addr, local borrow handle count)
-        self._borrows: Dict[ObjectID, Tuple[str, int]] = {}
+        #: oid -> owner address; membership = this process holds a borrow.
+        #: (Liveness of individual handles is the refcounter's job — the
+        #: release path re-reads the live count rather than shadowing it.)
+        self._borrows: Dict[ObjectID, str] = {}
         self.stats = {"registered": 0, "released": 0, "send_failures": 0}
 
     # ----------------------------------------------------------- borrower API
@@ -56,11 +58,9 @@ class BorrowClient:
         """Called on deserialization of a remote-owned ref; the first handle
         per object registers with the owner before returning."""
         with self._lock:
-            entry = self._borrows.get(oid)
-            if entry is not None:
-                self._borrows[oid] = (entry[0], entry[1] + 1)
+            if oid in self._borrows:
                 return
-            self._borrows[oid] = (owner_addr, 1)
+            self._borrows[oid] = owner_addr
             self.stats["registered"] += 1
             self._send("add", oid, owner_addr)
 
@@ -70,14 +70,14 @@ class BorrowClient:
         re-deserialization may have revived the object between the zero
         event and this call."""
         with self._lock:
-            entry = self._borrows.get(oid)
-            if entry is None:
+            addr = self._borrows.get(oid)
+            if addr is None:
                 return
             if count_fn is not None and count_fn(oid) > 0:
                 return  # revived: a fresh handle exists, keep the borrow
             del self._borrows[oid]
             self.stats["released"] += 1
-            self._send("release", oid, entry[0])
+            self._send("release", oid, addr)
 
     def holds(self, oid: ObjectID) -> bool:
         with self._lock:
@@ -138,7 +138,7 @@ def release_all() -> None:
     with c._lock:
         entries = list(c._borrows.items())
         c._borrows.clear()
-        for oid, (addr, _) in entries:
+        for oid, addr in entries:
             c.stats["released"] += 1
             c._send("release", oid, addr)
 

@@ -75,7 +75,7 @@ class TuneController:
         time_budget_s: Optional[float] = None,
         snapshot_fn: Optional[Callable[[List["Trial"]], None]] = None,
         snapshot_period_s: float = 10.0,
-        restore_checkpoints: Optional[Dict[str, str]] = None,
+        restore_checkpoints: Optional[Dict[str, List[str]]] = None,
     ):
         self.trainable_cls = trainable_cls
         self.searcher = searcher
@@ -192,7 +192,11 @@ class TuneController:
             key = _json.dumps(trial.config, sort_keys=True, default=str)
             ckpts = self.restore_checkpoints.get(key)
             if ckpts:
-                restore_from = ckpts.pop(0)
+                # Persist on the trial so a retry after an early failure
+                # restores from the SAME checkpoint instead of popping a
+                # sibling's (or starting over).
+                trial.checkpoint_path = ckpts.pop(0)
+                restore_from = trial.checkpoint_path
         trial.actor = _TrainableActor.options(
             resources=trial.resources).remote(
             self.trainable_cls, trial.config, trial.logdir, trial.trial_id,

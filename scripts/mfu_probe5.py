@@ -1,0 +1,58 @@
+"""Probe: bf16-materialized logits vs fp32 logits for the LM head + loss."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+import optax
+from ray_tpu.models import gpt2
+from ray_tpu.parallel import MeshSpec, make_mesh
+from ray_tpu.parallel.train_state import create_sharded_state, jit_train_step
+
+config = gpt2.GPTConfig()
+B = 16
+mesh = make_mesh(MeshSpec(data=1), jax.devices()[:1])
+opt = gpt2.make_optimizer(learning_rate=3e-4)
+
+def make_step(loss_variant):
+    def loss_fn(params, tokens, targets):
+        x = gpt2.forward_hidden(params, tokens, config)
+        wte = params["wte"].astype(config.dtype)
+        if loss_variant == "fp32":
+            logits = jnp.einsum("bsd,vd->bsv", x, wte,
+                                preferred_element_type=jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        else:  # bf16 materialization, fp32 reduction on the fly
+            logits = jnp.einsum("bsd,vd->bsv", x, wte)  # bf16 out
+            lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+            tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0].astype(jnp.float32)
+        return jnp.mean(lse - tgt)
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+    return jax.jit(step, donate_argnums=(0, 1))
+
+rng = np.random.default_rng(0)
+toks = rng.integers(0, config.vocab_size, (B, config.seq_len + 1), dtype=np.int64)
+t = jnp.asarray(toks, jnp.int32)
+tokens, targets = t[:, :-1], t[:, 1:]
+
+for variant in ["fp32", "bf16"]:
+    params, opt_state = create_sharded_state(
+        lambda k: gpt2.init_params(config, k), gpt2.logical_axes(config), mesh,
+        jax.random.key(0), opt)
+    step = make_step(variant)
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    _ = float(loss)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    l = float(loss)
+    dt = time.perf_counter() - t0
+    tok_s = 10 * B * config.seq_len / dt
+    flops = gpt2.flops_per_token(config) * tok_s
+    print(f"{variant}: {dt/10*1000:.1f} ms/step tokens/s={tok_s:,.0f} "
+          f"MFU={flops/197e12*100:.1f}% loss={l:.4f}")
