@@ -165,6 +165,57 @@ def cmd_run(args) -> int:
     return 0
 
 
+def cmd_up(args) -> int:
+    """`ray up` equivalent (ref: autoscaler/_private/commands.py create_or_
+    update_cluster): head + min workers + reconciler from a cluster YAML.
+
+    Clusters here are IN-PROCESS (virtual scheduler nodes / TPU slices), so
+    the cluster lives exactly as long as this command: the default mode
+    blocks, reconciling until Ctrl-C tears it down.  ``--no-block`` is for
+    scripting/tests (validate + bring up + exit, releasing everything).
+    """
+    from ray_tpu.autoscaler.launcher import launch_cluster
+
+    handle = launch_cluster(args.config, autoscale=not args.no_autoscale)
+    status = handle.status()
+    print(f"cluster {status['cluster_name']!r} up: "
+          f"{status['nodes']} nodes, resources={status['resources']}")
+    if args.no_block:
+        print("--no-block: cluster validated; it ends with this process "
+              "(use launch_cluster() from Python to drive one "
+              "programmatically)")
+        handle.teardown()
+        return 0
+    print("reconciling; Ctrl-C tears the cluster down")
+    import time as _t
+
+    try:
+        while True:
+            _t.sleep(5)
+            s = handle.status()
+            print(f"[reconcile] nodes={s['nodes']} workers={s['workers']}")
+    except KeyboardInterrupt:
+        handle.teardown()
+        print("cluster torn down")
+    return 0
+
+
+def cmd_down(args) -> int:
+    """In-process clusters end with their `up` process; this command only
+    tears down a runtime living in THIS process (programmatic use)."""
+    from ray_tpu._private.runtime import runtime_or_none
+
+    import ray_tpu
+
+    if runtime_or_none() is None:
+        print("no live runtime in this process — a `ray_tpu up` cluster "
+              "ends when its process does (Ctrl-C it)")
+        return 1
+    ray_tpu.shutdown()
+    print("cluster torn down")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -201,11 +252,22 @@ def main(argv=None) -> int:
     rp.add_argument("script")
     rp.add_argument("script_args", nargs=argparse.REMAINDER)
 
+    up = sub.add_parser("up", help="launch a cluster from a YAML config "
+                                   "(in-process; blocks until Ctrl-C)")
+    up.add_argument("config", help="cluster YAML path")
+    up.add_argument("--no-autoscale", action="store_true")
+    up.add_argument("--no-block", action="store_true",
+                    help="validate + bring up + exit (cluster ends with "
+                         "this process)")
+
+    down = sub.add_parser("down", help="tear down the cluster in this session")
+    down.add_argument("config", nargs="?", help="cluster YAML (informational)")
+
     args = p.parse_args(argv)
     return {
         "status": cmd_status, "list": cmd_list, "summary": cmd_summary,
         "timeline": cmd_timeline, "metrics": cmd_metrics, "job": cmd_job,
-        "logs": cmd_logs, "run": cmd_run,
+        "logs": cmd_logs, "run": cmd_run, "up": cmd_up, "down": cmd_down,
     }[args.cmd](args)
 
 

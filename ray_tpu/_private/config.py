@@ -108,6 +108,11 @@ class Config:
     log_dir: str = ""
     log_to_driver: bool = True
 
+    # --- control-plane persistence (ref: gcs_kv_manager.h + redis tier) ---
+    #: Persist the internal KV to a WAL under session_dir so control-plane
+    #: metadata survives a head restart.
+    kv_persist: bool = False
+
     # --- session ---
     #: Session-scoped scratch dir (runtime-env cache, job logs; the role of
     #: the reference's /tmp/ray/session_* tree).

@@ -1,0 +1,93 @@
+"""Memory monitor + worker-killing policy for OOM protection.
+
+TPU-native analogue of the reference's OOM defense (ref:
+src/ray/common/memory_monitor.h:52 — periodic cgroup/proc sampling against
+a usage threshold; src/ray/raylet/worker_killing_policy.h and
+worker_killing_policy_retriable_fifo.h — pick a victim worker, preferring
+retriable then newest, and kill it so the node survives).
+
+Here the monitored population is the process-tier worker pool (thread-tier
+workers share the driver's address space, where the object store's own
+spilling is the pressure valve).  The sampler is injectable so tests drive
+deterministic pressure without allocating memory.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+class MemoryMonitor:
+    """Samples usage fraction; over threshold → kill one victim per tick."""
+
+    def __init__(self, *,
+                 usage_fraction_fn: Optional[Callable[[], float]] = None,
+                 victims_fn: Optional[Callable[[], List]] = None,
+                 kill_fn: Optional[Callable[[object], None]] = None,
+                 threshold: float = 0.95,
+                 check_interval_s: float = 1.0,
+                 min_memory_free_bytes: Optional[int] = None):
+        self._usage = usage_fraction_fn or _system_usage_fraction
+        self._victims = victims_fn or (lambda: [])
+        self._kill = kill_fn or (lambda w: None)
+        self.threshold = threshold
+        self.interval = check_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"checks": 0, "kills": 0, "last_usage": 0.0}
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="memory-monitor", daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def tick(self) -> bool:
+        """One check (also the test entry point).  Returns True if a worker
+        was killed."""
+        self.stats["checks"] += 1
+        usage = self._usage()
+        self.stats["last_usage"] = usage
+        if usage < self.threshold:
+            return False
+        victim = self._choose_victim(self._victims())
+        if victim is None:
+            return False
+        self._kill(victim)
+        self.stats["kills"] += 1
+        return True
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — monitoring must not die
+                pass
+
+    @staticmethod
+    def _choose_victim(workers: List) -> Optional[object]:
+        """Retriable-first, then LIFO (newest task loses — it has the least
+        progress to lose; ref: worker_killing_policy_retriable_fifo.h)."""
+        if not workers:
+            return None
+        def sort_key(w):
+            retriable = bool(getattr(w, "retriable", True))
+            started = float(getattr(w, "started_at", 0.0))
+            # Retriable first (False sorts after True via `not`), then newest.
+            return (not retriable, -started)
+
+        return sorted(workers, key=sort_key)[0]
+
+
+def _system_usage_fraction() -> float:
+    try:
+        import psutil
+
+        return psutil.virtual_memory().percent / 100.0
+    except Exception:
+        return 0.0

@@ -1,0 +1,171 @@
+"""Cluster launcher: `ray_tpu up/down` over a cluster YAML.
+
+(ref: python/ray/autoscaler/_private/commands.py — `ray up` reads a cluster
+YAML validated against ray-schema.json, instantiates the configured
+NodeProvider, creates the head node, then lets the autoscaler reconcile
+worker counts between min_workers and max_workers.)
+
+TPU-native shape: providers provision *scheduler nodes* (virtual hosts for
+the in-process control plane, or TPU pod slices via TPUPodProvider), so
+`up` = init the runtime as head + create min workers + start the
+reconciling Monitor.  Cloud VMs are out of scope offline; the provider
+interface is where AWS/GCP/K8s plugins slot in (``provider.type`` accepts a
+"module:Class" import path exactly for that).
+"""
+
+from __future__ import annotations
+
+import importlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler.autoscaler import (Autoscaler, AutoscalerConfig,
+                                           Monitor, NodeTypeConfig)
+from ray_tpu.autoscaler.node_provider import (FakeNodeProvider, NodeProvider,
+                                              TPUPodProvider)
+
+_BUILTIN_PROVIDERS = {
+    "fake": FakeNodeProvider,
+    "local": FakeNodeProvider,
+    "tpu_pod": TPUPodProvider,
+}
+
+
+class ClusterConfigError(ValueError):
+    """Schema violation in the cluster YAML (ref: ray-schema.json checks)."""
+
+
+@dataclass
+class ClusterConfig:
+    cluster_name: str
+    provider: NodeProvider
+    node_types: Dict[str, NodeTypeConfig]
+    head_node_type: str
+    max_workers: int = 10
+    idle_timeout_s: float = 60.0
+    head_resources: Dict[str, float] = field(default_factory=dict)
+
+
+def load_cluster_config(source: Any) -> ClusterConfig:
+    """Parse + validate a cluster YAML path, YAML string, or dict."""
+    if isinstance(source, dict):
+        raw = source
+    else:
+        import os
+
+        import yaml
+
+        text = open(source).read() if os.path.exists(str(source)) else str(source)
+        raw = yaml.safe_load(text)
+    if not isinstance(raw, dict):
+        raise ClusterConfigError("cluster config must be a mapping")
+
+    name = raw.get("cluster_name", "default")
+    provider_cfg = raw.get("provider") or {}
+    ptype = provider_cfg.get("type", "fake")
+    provider_cls = _BUILTIN_PROVIDERS.get(ptype)
+    if provider_cls is None:
+        if ":" not in ptype:
+            raise ClusterConfigError(
+                f"unknown provider type {ptype!r}; builtins: "
+                f"{sorted(_BUILTIN_PROVIDERS)} or 'module:Class'")
+        mod, _, cls = ptype.partition(":")
+        provider_cls = getattr(importlib.import_module(mod), cls)
+    kwargs = {k: v for k, v in provider_cfg.items() if k != "type"}
+    provider = provider_cls(**kwargs)
+
+    types_raw = raw.get("available_node_types")
+    if not types_raw:
+        raise ClusterConfigError("available_node_types must list >=1 type")
+    node_types: Dict[str, NodeTypeConfig] = {}
+    for tname, tcfg in types_raw.items():
+        if "resources" not in tcfg:
+            raise ClusterConfigError(f"node type {tname!r} needs resources")
+        node_types[tname] = NodeTypeConfig(
+            resources={k: float(v) for k, v in tcfg["resources"].items()},
+            min_workers=int(tcfg.get("min_workers", 0)),
+            max_workers=int(tcfg.get("max_workers",
+                                     raw.get("max_workers", 10))),
+            labels=dict(tcfg.get("labels", {})))
+
+    head_type = raw.get("head_node_type")
+    if head_type is None or head_type not in node_types:
+        raise ClusterConfigError(
+            f"head_node_type {head_type!r} must name an available_node_type")
+    return ClusterConfig(
+        cluster_name=name, provider=provider, node_types=node_types,
+        head_node_type=head_type,
+        max_workers=int(raw.get("max_workers", 10)),
+        idle_timeout_s=float(raw.get("idle_timeout_s", 60.0)),
+        head_resources=dict(node_types[head_type].resources))
+
+
+class ClusterHandle:
+    """A launched cluster (ref: the state `ray up` leaves behind)."""
+
+    def __init__(self, config: ClusterConfig, autoscaler: Autoscaler,
+                 monitor: Optional[Monitor], worker_ids: List[str]):
+        self.config = config
+        self.autoscaler = autoscaler
+        self.monitor = monitor
+        self.worker_ids = list(worker_ids)
+
+    def status(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        return {
+            "cluster_name": self.config.cluster_name,
+            "nodes": len(ray_tpu.nodes()),
+            "workers": len(self.config.provider.non_terminated_nodes()),
+            "resources": ray_tpu.cluster_resources(),
+        }
+
+    def teardown(self) -> None:
+        """`ray down`: stop reconciling, terminate workers, shut the head."""
+        import ray_tpu
+
+        if self.monitor is not None:
+            self.monitor.stop()
+        for pid in list(self.config.provider.non_terminated_nodes()):
+            self.config.provider.terminate_node(pid)
+        ray_tpu.shutdown()
+
+
+def launch_cluster(source: Any, *, autoscale: bool = True) -> ClusterHandle:
+    """`ray up`: head + min_workers per type (+ reconciler when autoscale).
+
+    Idempotent-ish like the reference: re-running against a live runtime
+    reuses it (`ignore_reinit_error`).
+    """
+    import ray_tpu
+
+    config = load_cluster_config(source)
+    ray_tpu.init(ignore_reinit_error=True, resources=config.head_resources)
+    as_config = AutoscalerConfig(node_types=config.node_types,
+                                 idle_timeout_s=config.idle_timeout_s)
+    autoscaler = Autoscaler(as_config, config.provider)
+    worker_ids: List[str] = []
+    for tname, tcfg in config.node_types.items():
+        for _ in range(tcfg.min_workers):
+            worker_ids.append(autoscaler._launch(tname))
+    monitor = Monitor(autoscaler).start() if autoscale else None
+    return ClusterHandle(config, autoscaler, monitor, worker_ids)
+
+
+EXAMPLE_YAML = """\
+cluster_name: tpu-pod
+max_workers: 8
+provider:
+  type: tpu_pod
+  accelerator: v5e
+  chips_per_host: 4
+head_node_type: cpu_head
+available_node_types:
+  cpu_head:
+    resources: {CPU: 8}
+    min_workers: 0
+  tpu_worker:
+    resources: {CPU: 4, TPU: 4}
+    min_workers: 2
+    max_workers: 8
+"""

@@ -1,3 +1,3 @@
-from ray_tpu.models import gpt2
+from ray_tpu.models import gpt2, llama, moe
 
-__all__ = ["gpt2"]
+__all__ = ["gpt2", "llama", "moe"]

@@ -1,0 +1,231 @@
+"""Llama-family decoder: RMSNorm + RoPE + SwiGLU + grouped-query attention.
+
+Second dense model family on the same parallel substrate as GPT-2 (the
+reference is a runtime, not a model zoo — these models exist to prove the
+framework's training path on the architectures users actually run).  The
+module mirrors ``models/gpt2.py``'s functional contract exactly —
+init_params / logical_axes / forward / loss_fn / make_train_step — so every
+mesh axis (data/fsdp/tensor/seq via logical-axis rules, ring/ulysses
+attention for long context) composes without model-specific glue.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models import gpt2 as _g
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    n_layer: int = 8
+    n_head: int = 8
+    #: grouped-query attention: kv heads < query heads share k/v
+    n_kv_head: int = 4
+    d_model: int = 512
+    #: SwiGLU hidden dim (Llama uses ~8/3 * d_model rounded to 256)
+    d_ff: int = 1408
+    seq_len: int = 1024
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    attn_impl: str = "auto"  # auto | xla | pallas | splash | ring | ulysses
+    attn_block_q: int = 512
+    attn_block_kv: int = 512
+    logits_dtype: Any = jnp.bfloat16
+    # kept for MeshSpec probe parity with GPTConfig (pipelining of the llama
+    # stack rides the same `layers` axis; GPipe wiring arrives with demand)
+    pp_stages: int = 1
+    pp_microbatches: int = 0
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.n_head // self.n_kv_head
+
+    @staticmethod
+    def tiny() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=1024, n_layer=2, n_head=4, n_kv_head=2,
+                           d_model=128, d_ff=384, seq_len=128)
+
+    def __post_init__(self):
+        assert self.d_model % self.n_head == 0
+        assert self.n_head % self.n_kv_head == 0
+
+
+def init_params(config: LlamaConfig, key) -> Dict[str, Any]:
+    """Plain pytree; blocks stacked on a leading layer axis for lax.scan."""
+    D, L, V = config.d_model, config.n_layer, config.vocab_size
+    H, KV, hd, F = config.n_head, config.n_kv_head, config.head_dim, config.d_ff
+    std = 0.02
+    resid_std = std / math.sqrt(2 * L)
+    k_wte, k_blocks, k_head = jax.random.split(key, 3)
+
+    def norm(key, shape, s):
+        return jax.random.normal(key, shape, jnp.float32) * s
+
+    ks = jax.random.split(k_blocks, 7)
+    return {
+        "wte": norm(k_wte, (V, D), std),
+        "blocks": {
+            "attn_norm": jnp.ones((L, D)),
+            "wq": norm(ks[0], (L, D, H * hd), std),
+            "wk": norm(ks[1], (L, D, KV * hd), std),
+            "wv": norm(ks[2], (L, D, KV * hd), std),
+            "wo": norm(ks[3], (L, H * hd, D), resid_std),
+            "mlp_norm": jnp.ones((L, D)),
+            "w_gate": norm(ks[4], (L, D, F), std),
+            "w_up": norm(ks[5], (L, D, F), std),
+            "w_down": norm(ks[6], (L, F, D), resid_std),
+        },
+        "final_norm": jnp.ones((D,)),
+        # Untied LM head (Llama convention; GPT-2 ties to wte).
+        "lm_head": norm(k_head, (V, D), std),
+    }
+
+
+def logical_axes(config: LlamaConfig) -> Dict[str, Any]:
+    L = "layers"
+    return {
+        "wte": ("vocab", "embed"),
+        "blocks": {
+            "attn_norm": (L, "norm"),
+            "wq": (L, "embed", "heads"),
+            "wk": (L, "embed", "heads"),
+            "wv": (L, "embed", "heads"),
+            "wo": (L, "heads", "embed"),
+            "mlp_norm": (L, "norm"),
+            "w_gate": (L, "embed", "mlp"),
+            "w_up": (L, "embed", "mlp"),
+            "w_down": (L, "mlp", "embed"),
+        },
+        "final_norm": ("norm",),
+        "lm_head": ("vocab", "embed"),
+    }
+
+
+def num_params(config: LlamaConfig) -> int:
+    D, L, V, F = (config.d_model, config.n_layer, config.vocab_size,
+                  config.d_ff)
+    hd = config.head_dim
+    attn = D * config.n_head * hd + 2 * D * config.n_kv_head * hd \
+        + config.n_head * hd * D
+    mlp = 3 * D * F
+    per_block = 2 * D + attn + mlp
+    return 2 * V * D + L * per_block + D
+
+
+def flops_per_token(config: LlamaConfig) -> float:
+    return 6.0 * num_params(config) \
+        + 12.0 * config.n_layer * config.d_model * config.seq_len
+
+
+def _rmsnorm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return x32 * lax.rsqrt(ms + eps) * scale
+
+
+def _rope(x, theta: float):
+    """Rotary position embedding over (B, S, H, hd) — rotate-half form."""
+    B, S, H, hd = x.shape
+    half = hd // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = jnp.arange(S, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.cos(angles)[None, :, None, :]  # (1, S, 1, half)
+    sin = jnp.sin(angles)[None, :, None, :]
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :half], x32[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _block(x, blk, config: LlamaConfig):
+    dt = config.dtype
+    B, S, D = x.shape
+    H, KV, hd = config.n_head, config.n_kv_head, config.head_dim
+
+    h = _rmsnorm(x, blk["attn_norm"], config.rms_eps).astype(dt)
+    q = (h @ blk["wq"].astype(dt)).reshape(B, S, H, hd)
+    k = (h @ blk["wk"].astype(dt)).reshape(B, S, KV, hd)
+    v = (h @ blk["wv"].astype(dt)).reshape(B, S, KV, hd)
+    q = _rope(q, config.rope_theta)
+    k = _rope(k, config.rope_theta)
+    if KV != H:
+        # GQA: each kv head serves q_per_kv query heads.
+        k = jnp.repeat(k, config.q_per_kv, axis=2)
+        v = jnp.repeat(v, config.q_per_kv, axis=2)
+    # Reuse the GPT-2 attention dispatcher (xla/pallas/splash/ring/ulysses):
+    # it only reads attn_impl/blocks/head-shape from the config.
+    attn = _g._attention(q, k, v, config).astype(dt).reshape(B, S, H * hd)
+    x = x + attn @ blk["wo"].astype(dt)
+
+    h = _rmsnorm(x, blk["mlp_norm"], config.rms_eps).astype(dt)
+    gate = jax.nn.silu((h @ blk["w_gate"].astype(dt)).astype(jnp.float32))
+    up = (h @ blk["w_up"].astype(dt)).astype(jnp.float32)
+    x = x + ((gate * up).astype(dt) @ blk["w_down"].astype(dt))
+    return x
+
+
+def forward_hidden(params: Dict[str, Any], tokens, config: LlamaConfig):
+    dt = config.dtype
+    x = params["wte"][tokens].astype(dt)
+
+    def layer(x, blk):
+        out = _block(x, blk, config)
+        return out, None
+
+    if config.remat:
+        layer = jax.checkpoint(layer)
+    x, _ = lax.scan(layer, x, params["blocks"])
+    return _rmsnorm(x, params["final_norm"], config.rms_eps).astype(dt)
+
+
+def forward(params: Dict[str, Any], tokens, config: LlamaConfig):
+    x = forward_hidden(params, tokens, config)
+    return jnp.einsum("bsd,vd->bsv", x, params["lm_head"].astype(config.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+def loss_fn(params, tokens, targets, config: LlamaConfig):
+    x = forward_hidden(params, tokens, config)
+    logits = jnp.einsum("bsd,vd->bsv", x,
+                        params["lm_head"].astype(config.dtype),
+                        preferred_element_type=config.logits_dtype)
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tgt = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0].astype(jnp.float32)
+    return jnp.mean(lse - tgt)
+
+
+def make_optimizer(learning_rate=3e-4, weight_decay=0.1, b1=0.9, b2=0.95,
+                   grad_clip=1.0):
+    return _g.make_optimizer(learning_rate=learning_rate,
+                             weight_decay=weight_decay, b1=b1, b2=b2,
+                             grad_clip=grad_clip)
+
+
+def make_train_step(config: LlamaConfig, optimizer):
+    """Same contract as gpt2.make_train_step: XLA derives all gradient
+    collectives from the shardings."""
+    import optax
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets,
+                                                  config)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

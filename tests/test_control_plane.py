@@ -1,0 +1,219 @@
+"""Control-plane substrate tests: internal KV (+persistence/restart),
+pubsub channels, memory monitor policy.
+
+(ref test model: python/ray/tests/test_advanced_2.py internal_kv cases,
+src/ray/pubsub tests, raylet worker_killing_policy tests.)
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private.kv_store import KVStore
+from ray_tpu._private.memory_monitor import MemoryMonitor
+from ray_tpu.util.pubsub import Publisher, Subscriber
+
+
+# -------------------------------------------------------------- internal KV
+def test_kv_basic_and_namespaces():
+    kv = KVStore()
+    assert kv.put(b"a", b"1")
+    assert kv.get(b"a") == b"1"
+    assert not kv.put(b"a", b"2", overwrite=False)  # existing, no overwrite
+    assert kv.get(b"a") == b"1"
+    kv.put(b"a", b"2")
+    assert kv.get(b"a") == b"2"
+    kv.put(b"a", b"other", namespace="ns2")
+    assert kv.get(b"a", namespace="ns2") == b"other"
+    assert kv.get(b"a") == b"2"
+    kv.put(b"ab", b"x")
+    assert sorted(kv.keys(b"a")) == [b"a", b"ab"]
+    assert kv.delete(b"a") == 1
+    assert kv.delete(b"a") == 0
+    assert not kv.exists(b"a")
+
+
+def test_kv_persistence_replay_and_compaction(tmp_path):
+    path = str(tmp_path / "kv.jsonl")
+    kv = KVStore(persist_path=path, compact_threshold=50)
+    for i in range(100):  # crosses the compaction threshold
+        kv.put(f"k{i}".encode(), f"v{i}".encode())
+    kv.delete(b"k0")
+    # "Restart": a new store replays the WAL.
+    kv2 = KVStore(persist_path=path)
+    assert kv2.get(b"k1") == b"v1"
+    assert kv2.get(b"k99") == b"v99"
+    assert kv2.get(b"k0") is None
+    # Compaction kept the file bounded (live set, not full history).
+    n_lines = sum(1 for _ in open(path))
+    assert n_lines <= 150
+
+
+def test_kv_survives_torn_tail_write(tmp_path):
+    path = str(tmp_path / "kv.jsonl")
+    kv = KVStore(persist_path=path)
+    kv.put(b"good", b"1")
+    with open(path, "a") as f:
+        f.write('{"op": "put", "ns": "", "k"')  # crash mid-record
+    kv2 = KVStore(persist_path=path)
+    assert kv2.get(b"good") == b"1"
+
+
+def test_internal_kv_api(tmp_path):
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu.experimental import internal_kv as ikv
+
+    old = (GLOBAL_CONFIG.kv_persist, GLOBAL_CONFIG.session_dir)
+    GLOBAL_CONFIG.kv_persist = True
+    GLOBAL_CONFIG.session_dir = str(tmp_path)
+    try:
+        ikv._internal_kv_reset()
+        assert ikv._internal_kv_initialized()
+        ikv._internal_kv_put("fn:abc", b"payload")
+        assert ikv._internal_kv_get("fn:abc") == b"payload"
+        assert ikv._internal_kv_exists("fn:abc")
+        assert ikv._internal_kv_list("fn:") == [b"fn:abc"]
+        # reference contract: put returns True when key already existed.
+        assert ikv._internal_kv_put("fn:abc", b"x", overwrite=False) is True
+        # restart: reset drops memory; replay from the WAL restores.
+        ikv._internal_kv_reset()
+        assert ikv._internal_kv_get("fn:abc") == b"payload"
+        assert ikv._internal_kv_del("fn:abc") == 1
+    finally:
+        GLOBAL_CONFIG.kv_persist, GLOBAL_CONFIG.session_dir = old
+        ikv._internal_kv_reset()
+
+
+# ------------------------------------------------------------------ pubsub
+def test_publisher_long_poll_blocks_until_publish():
+    pub = Publisher()
+    got = []
+
+    def poller():
+        got.extend(pub.poll("ch", after_seq=0, timeout=5))
+
+    t = threading.Thread(target=poller)
+    t.start()
+    time.sleep(0.1)
+    assert not got  # parked
+    pub.publish("ch", {"x": 1}, key="k1")
+    t.join(5)
+    assert [(s, k, m["x"]) for s, k, m in got] == [(1, "k1", 1)]
+
+
+def test_publisher_seq_and_key_filter():
+    pub = Publisher()
+    pub.publish("ch", "a", key="k1")
+    pub.publish("ch", "b", key="k2")
+    pub.publish("ch", "c", key="k1")
+    msgs = pub.poll("ch", after_seq=0, key="k1", timeout=0)
+    assert [m for _, _, m in msgs] == ["a", "c"]
+    msgs = pub.poll("ch", after_seq=1, timeout=0)
+    assert [m for _, _, m in msgs] == ["b", "c"]
+
+
+def test_subscriber_dispatches_in_order():
+    pub = Publisher()
+    sub = Subscriber(pub)
+    seen = []
+    sub.subscribe("events", lambda k, m: seen.append((k, m)))
+    for i in range(5):
+        pub.publish("events", i, key=f"k{i % 2}")
+    deadline = time.time() + 5
+    while len(seen) < 5 and time.time() < deadline:
+        time.sleep(0.02)
+    assert [m for _, m in seen] == [0, 1, 2, 3, 4]
+    sub.close()
+
+
+def test_subscriber_key_filter():
+    pub = Publisher()
+    sub = Subscriber(pub)
+    seen = []
+    sub.subscribe("events", lambda k, m: seen.append(m), key="only")
+    pub.publish("events", "no", key="other")
+    pub.publish("events", "yes", key="only")
+    deadline = time.time() + 5
+    while not seen and time.time() < deadline:
+        time.sleep(0.02)
+    assert seen == ["yes"]
+    sub.close()
+
+
+# ---------------------------------------------------------- memory monitor
+class _FakeWorker:
+    def __init__(self, name, retriable, started_at):
+        self.name = name
+        self.retriable = retriable
+        self.started_at = started_at
+
+
+def test_memory_monitor_kills_retriable_newest_first():
+    usage = [0.5]
+    workers = [
+        _FakeWorker("old-retriable", True, 1.0),
+        _FakeWorker("new-retriable", True, 5.0),
+        _FakeWorker("non-retriable", False, 9.0),
+    ]
+    killed = []
+    mon = MemoryMonitor(
+        usage_fraction_fn=lambda: usage[0],
+        victims_fn=lambda: list(workers),
+        kill_fn=lambda w: (killed.append(w.name), workers.remove(w)),
+        threshold=0.9)
+    assert not mon.tick()  # under threshold: nothing dies
+    usage[0] = 0.97
+    assert mon.tick()
+    assert killed == ["new-retriable"]  # retriable first, newest first
+    assert mon.tick()
+    assert killed == ["new-retriable", "old-retriable"]
+    assert mon.tick()  # only the non-retriable remains; last resort
+    assert killed[-1] == "non-retriable"
+    assert not mon.tick()  # nobody left to kill
+    assert mon.stats["kills"] == 3
+
+
+# --------------------------------------------------------- cluster launcher
+def test_launch_cluster_from_yaml():
+    import ray_tpu
+    from ray_tpu.autoscaler.launcher import (EXAMPLE_YAML, ClusterConfigError,
+                                             launch_cluster,
+                                             load_cluster_config)
+
+    cfg = load_cluster_config(EXAMPLE_YAML)
+    assert cfg.cluster_name == "tpu-pod"
+    assert cfg.node_types["tpu_worker"].min_workers == 2
+    assert cfg.head_node_type == "cpu_head"
+
+    handle = launch_cluster(EXAMPLE_YAML, autoscale=False)
+    try:
+        status = handle.status()
+        # head + the two min TPU workers
+        assert status["nodes"] >= 3
+        assert status["resources"].get("TPU", 0) >= 8
+        # The TPU provider advertises slice-head resources like the
+        # reference's TPU-<ver>-<chips>-head trick.
+        tpu_nodes = [n for n in ray_tpu.nodes()
+                     if n["Resources"].get("TPU", 0) >= 4]
+        assert len(tpu_nodes) >= 2
+    finally:
+        handle.teardown()
+
+
+def test_cluster_config_validation():
+    from ray_tpu.autoscaler.launcher import (ClusterConfigError,
+                                             load_cluster_config)
+
+    with pytest.raises(ClusterConfigError):
+        load_cluster_config({"cluster_name": "x"})  # no node types
+    with pytest.raises(ClusterConfigError):
+        load_cluster_config({
+            "available_node_types": {"a": {"resources": {"CPU": 1}}},
+            "head_node_type": "missing"})
+    with pytest.raises(ClusterConfigError):
+        load_cluster_config({
+            "provider": {"type": "no_such_cloud"},
+            "available_node_types": {"a": {"resources": {"CPU": 1}}},
+            "head_node_type": "a"})
