@@ -81,6 +81,12 @@ class Config:
     #: Hard cap on process workers.
     max_process_workers: int = 16
 
+    # --- OOM defense (ref: memory_monitor.h:52, memory_usage_threshold) ---
+    #: Kill a busy process worker when system memory usage crosses this
+    #: fraction (1.0 disables the monitor; reference default 0.95).
+    memory_monitor_threshold: float = 1.0
+    memory_monitor_interval_s: float = 1.0
+
     # --- fault tolerance ---
     #: Period of the control plane's health check of actors/nodes
     #: (ref: gcs_health_check_manager.h:45).

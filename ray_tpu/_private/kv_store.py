@@ -31,10 +31,14 @@ class KVStore:
         self._data: Dict[str, Dict[bytes, bytes]] = {}
         self._lock = threading.RLock()
         self._persist_path = persist_path
+        self._wal = None  # persistent append handle (one open, not per-write)
         self._mutations = 0
         self._compact_threshold = compact_threshold
-        if persist_path and os.path.exists(persist_path):
-            self._replay()
+        if persist_path:
+            os.makedirs(os.path.dirname(persist_path) or ".", exist_ok=True)
+            if os.path.exists(persist_path):
+                self._replay()
+            self._wal = open(persist_path, "a")
 
     # ----------------------------------------------------------------- basic
     def get(self, key: bytes, namespace: str = "") -> Optional[bytes]:
@@ -43,15 +47,18 @@ class KVStore:
 
     def put(self, key: bytes, value: bytes, overwrite: bool = True,
             namespace: str = "") -> bool:
+        """Returns True iff the key was NEWLY added (matching the GCS Put
+        contract: an overwrite of an existing key reports added=0)."""
         key, value = bytes(key), bytes(value)
         with self._lock:
             ns = self._data.setdefault(namespace, {})
-            if not overwrite and key in ns:
+            existed = key in ns
+            if not overwrite and existed:
                 return False
             ns[key] = value
             self._log({"op": "put", "ns": namespace,
                        "k": _b64(key), "v": _b64(value)})
-            return True
+            return not existed
 
     def delete(self, key: bytes, namespace: str = "") -> int:
         key = bytes(key)
@@ -80,11 +87,10 @@ class KVStore:
     # ------------------------------------------------------------ durability
     def _log(self, record: dict) -> None:
         """Caller holds the lock."""
-        if not self._persist_path:
+        if self._wal is None:
             return
-        os.makedirs(os.path.dirname(self._persist_path), exist_ok=True)
-        with open(self._persist_path, "a") as f:
-            f.write(json.dumps(record) + "\n")
+        self._wal.write(json.dumps(record) + "\n")
+        self._wal.flush()
         self._mutations += 1
         if self._mutations >= self._compact_threshold:
             self._compact()
@@ -113,7 +119,9 @@ class KVStore:
                 for k, v in kv.items():
                     f.write(json.dumps({"op": "put", "ns": ns,
                                         "k": _b64(k), "v": _b64(v)}) + "\n")
+        self._wal.close()
         os.replace(tmp, self._persist_path)
+        self._wal = open(self._persist_path, "a")
         self._mutations = 0
 
 

@@ -28,12 +28,17 @@ class MemoryMonitor:
                  kill_fn: Optional[Callable[[object], None]] = None,
                  threshold: float = 0.95,
                  check_interval_s: float = 1.0,
-                 min_memory_free_bytes: Optional[int] = None):
+                 min_memory_free_bytes: Optional[int] = None,
+                 free_bytes_fn: Optional[Callable[[], int]] = None):
         self._usage = usage_fraction_fn or _system_usage_fraction
         self._victims = victims_fn or (lambda: [])
         self._kill = kill_fn or (lambda w: None)
         self.threshold = threshold
         self.interval = check_interval_s
+        #: absolute floor (ref: min_memory_free_bytes): pressure also when
+        #: free memory drops under this many bytes, whatever the fraction.
+        self.min_memory_free_bytes = min_memory_free_bytes
+        self._free_bytes = free_bytes_fn or _system_free_bytes
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.stats = {"checks": 0, "kills": 0, "last_usage": 0.0}
@@ -53,7 +58,9 @@ class MemoryMonitor:
         self.stats["checks"] += 1
         usage = self._usage()
         self.stats["last_usage"] = usage
-        if usage < self.threshold:
+        under_floor = (self.min_memory_free_bytes is not None
+                       and self._free_bytes() < self.min_memory_free_bytes)
+        if usage < self.threshold and not under_floor:
             return False
         victim = self._choose_victim(self._victims())
         if victim is None:
@@ -91,3 +98,12 @@ def _system_usage_fraction() -> float:
         return psutil.virtual_memory().percent / 100.0
     except Exception:
         return 0.0
+
+
+def _system_free_bytes() -> int:
+    try:
+        import psutil
+
+        return int(psutil.virtual_memory().available)
+    except Exception:
+        return 1 << 62  # unknowable: never trip the floor

@@ -281,6 +281,11 @@ class Runtime:
         self.object_server = None
         self._pull_mgr = None
         self._borrows = None  # owner-side BorrowLedger (lazy)
+
+        # OOM defense over busy process workers (ref: memory_monitor.h:52).
+        self._leased_workers: Dict[int, "_LeasedWorker"] = {}
+        self._leased_lock = threading.Lock()
+        self._memory_monitor = None
         if self.config.enable_object_transfer:
             self.start_object_server()
 
@@ -357,6 +362,45 @@ class Runtime:
         object_id = ObjectID.from_put(put_counter.next(), self.worker_id[:8])
         self.store.put(object_id, value, owner=_owner)
         return ObjectRef(object_id, owner=_owner)
+
+    # ----------------------------------------------------------- OOM defense
+    def _track_leased_worker(self, worker, retriable: bool) -> None:
+        """Register a busy process worker as an OOM-kill candidate
+        (ref: raylet worker_killing_policy — the monitor picks victims among
+        running workers, retriable-first/newest-first)."""
+        entry = _LeasedWorker(worker, retriable)
+        with self._leased_lock:
+            self._leased_workers[id(worker)] = entry
+        self._maybe_start_memory_monitor()
+
+    def _untrack_leased_worker(self, worker) -> None:
+        with self._leased_lock:
+            self._leased_workers.pop(id(worker), None)
+
+    def _maybe_start_memory_monitor(self) -> None:
+        if self._memory_monitor is not None \
+                or self.config.memory_monitor_threshold >= 1.0:
+            return
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+
+        def victims():
+            with self._leased_lock:
+                return list(self._leased_workers.values())
+
+        def kill(lw):
+            # Re-check membership under the lock: the task may have finished
+            # (worker untracked, possibly re-leased) between the monitor's
+            # snapshot and this kill — killing then would shoot an innocent.
+            with self._leased_lock:
+                if id(lw.worker) not in self._leased_workers:
+                    return
+                lw.worker.kill()
+
+        self._memory_monitor = MemoryMonitor(
+            victims_fn=victims, kill_fn=kill,
+            threshold=self.config.memory_monitor_threshold,
+            check_interval_s=self.config.memory_monitor_interval_s)
+        self._memory_monitor.start()
 
     # --------------------------------------------------- cluster introspection
     # Uniform surface shared with ClientRuntime so the public API never has
@@ -790,11 +834,14 @@ class Runtime:
             env_payload = env.stage()
             env_key = payload_key(env_payload)
         worker = self.process_pool.lease(env_key, env_payload)
+        self._track_leased_worker(worker, retriable=spec.max_retries > 0)
         try:
             result = worker.execute(fn_id, fn_bytes, args, kwargs)
         except (TaskError, WorkerCrashedError):
             self.process_pool.discard(worker)
             raise
+        finally:
+            self._untrack_leased_worker(worker)
         self.process_pool.release(worker)
         return result
 
@@ -1216,6 +1263,9 @@ class Runtime:
                 state.proc_worker = None
             for _ in state.threads or [None]:
                 state.mailbox.put(None)
+        if self._memory_monitor is not None:
+            self._memory_monitor.stop()
+            self._memory_monitor = None
         self.process_pool.shutdown()
         self._exec_pool.shutdown(wait=False, cancel_futures=True)
         from ray_tpu._private import borrowing
@@ -1226,6 +1276,17 @@ class Runtime:
             self.object_server = None
         self.store.shutdown()
         self.refcounter.clear()
+
+
+class _LeasedWorker:
+    """Kill-candidate record for the memory monitor."""
+
+    __slots__ = ("worker", "retriable", "started_at")
+
+    def __init__(self, worker, retriable: bool):
+        self.worker = worker
+        self.retriable = retriable
+        self.started_at = time.monotonic()
 
 
 class _ActorExit(BaseException):

@@ -43,6 +43,9 @@ class AutoscalerConfig:
     idle_timeout_s: float = 60.0
     #: Max nodes launched per reconcile pass (ref: upscaling_speed).
     max_launches_per_round: int = 100
+    #: Cluster-wide worker cap across ALL node types (ref: the top-level
+    #: max_workers in the cluster YAML); None = unbounded.
+    max_total_workers: Optional[int] = None
 
 
 class Autoscaler:
@@ -88,6 +91,10 @@ class Autoscaler:
             cfg = self.config.node_types[type_name]
             counts = self._counts()
             room = cfg.max_workers - counts.get(type_name, 0)
+            if self.config.max_total_workers is not None:
+                # Cluster-wide cap binds across all types together.
+                room = min(room, self.config.max_total_workers
+                           - sum(counts.values()))
             for _ in range(min(n, room,
                                self.config.max_launches_per_round - len(launched))):
                 launched.append(self._launch(type_name))

@@ -55,7 +55,18 @@ def load_cluster_config(source: Any) -> ClusterConfig:
 
         import yaml
 
-        text = open(source).read() if os.path.exists(str(source)) else str(source)
+        s = str(source)
+        looks_like_path = (s.endswith((".yaml", ".yml"))
+                           or os.sep in s) and "\n" not in s
+        if os.path.exists(s):
+            with open(s) as f:
+                text = f.read()
+        elif looks_like_path:
+            # A typo'd filename must not be parsed AS yaml — that yields a
+            # baffling "must be a mapping" error instead of the real cause.
+            raise FileNotFoundError(f"cluster config not found: {s}")
+        else:
+            text = s  # inline YAML string
         raw = yaml.safe_load(text)
     if not isinstance(raw, dict):
         raise ClusterConfigError("cluster config must be a mapping")
@@ -142,7 +153,8 @@ def launch_cluster(source: Any, *, autoscale: bool = True) -> ClusterHandle:
     config = load_cluster_config(source)
     ray_tpu.init(ignore_reinit_error=True, resources=config.head_resources)
     as_config = AutoscalerConfig(node_types=config.node_types,
-                                 idle_timeout_s=config.idle_timeout_s)
+                                 idle_timeout_s=config.idle_timeout_s,
+                                 max_total_workers=config.max_workers)
     autoscaler = Autoscaler(as_config, config.provider)
     worker_ids: List[str] = []
     for tname, tcfg in config.node_types.items():

@@ -55,11 +55,12 @@ def _internal_kv_get(key: Union[str, bytes], *, namespace: str = "") -> Optional
 
 def _internal_kv_put(key: Union[str, bytes], value: Union[str, bytes],
                      overwrite: bool = True, *, namespace: str = "") -> bool:
-    """Returns True if the value was NOT set because the key already existed
-    (matching the reference's inverted return contract)."""
-    updated = _get_store().put(_as_bytes(key), _as_bytes(value),
-                               overwrite=overwrite, namespace=namespace)
-    return not updated
+    """Returns True when the key ALREADY EXISTED (whether or not it was then
+    overwritten) — the reference's inverted contract, where GCS Put reports
+    added=0 for any existing key."""
+    newly_added = _get_store().put(_as_bytes(key), _as_bytes(value),
+                                   overwrite=overwrite, namespace=namespace)
+    return not newly_added
 
 
 def _internal_kv_del(key: Union[str, bytes], *, namespace: str = "") -> int:

@@ -217,3 +217,38 @@ def test_cluster_config_validation():
             "provider": {"type": "no_such_cloud"},
             "available_node_types": {"a": {"resources": {"CPU": 1}}},
             "head_node_type": "a"})
+
+
+def test_memory_monitor_kills_busy_process_worker():
+    """Integration: pressure (simulated) kills a busy process worker; the
+    task surfaces WorkerCrashedError / retries per its policy."""
+    import ray_tpu
+    from ray_tpu.exceptions import WorkerCrashedError
+
+    ray_tpu.init(ignore_reinit_error=True,
+                 _system_config={"memory_monitor_threshold": 0.999,
+                                 "memory_monitor_interval_s": 0.05})
+    from ray_tpu._private.runtime import get_runtime
+
+    rt = get_runtime()
+
+    @ray_tpu.remote(isolation="process", max_retries=0)
+    def long_task():
+        import time as _t
+
+        _t.sleep(30)
+        return "survived"
+
+    ref = long_task.remote()
+    deadline = time.time() + 15
+    while rt._memory_monitor is None and time.time() < deadline:
+        time.sleep(0.05)
+    assert rt._memory_monitor is not None, "monitor never started"
+    # Simulate pressure: every sample reads over-threshold.
+    rt._memory_monitor._usage = lambda: 1.0
+    with pytest.raises(Exception) as ei:
+        ray_tpu.get(ref, timeout=30)
+    assert "WorkerCrashedError" in repr(ei.value)
+    assert rt._memory_monitor.stats["kills"] >= 1
+    # Restore sanity for later tests in the session.
+    rt._memory_monitor._usage = lambda: 0.0

@@ -22,25 +22,37 @@ def test_forward_shapes_and_param_count():
     assert jnp.isfinite(logits).all()
 
 
-def test_gqa_matches_mha_when_heads_equal():
-    """n_kv_head == n_head must reduce GQA to plain MHA numerics."""
-    base = llama.LlamaConfig(vocab_size=256, n_layer=1, n_head=4, n_kv_head=4,
-                             d_model=64, d_ff=128, seq_len=32,
-                             dtype=jnp.float32, attn_impl="xla")
-    params = llama.init_params(base, jax.random.key(1))
-    tokens = jax.random.randint(jax.random.key(2), (2, 32), 0, 256)
-    out = llama.forward(params, tokens, base)
-
-    # Grouped variant with the SAME weights arranged for 2 kv heads cannot
-    # be numerically identical (different k/v projections), but the GQA path
-    # itself must be causal + finite and differ from zero.
+def test_gqa_equivalent_to_mha_with_tiled_kv():
+    """GQA with kv projections TILED to full heads must equal MHA exactly:
+    the repeat path shares each kv head across its query group, so an MHA
+    model whose wk/wv duplicate the kv heads per group is the same function.
+    """
     gqa = llama.LlamaConfig(vocab_size=256, n_layer=1, n_head=4, n_kv_head=2,
                             d_model=64, d_ff=128, seq_len=32,
                             dtype=jnp.float32, attn_impl="xla")
-    params2 = llama.init_params(gqa, jax.random.key(1))
-    out2 = llama.forward(params2, tokens, gqa)
-    assert out.shape == out2.shape
-    assert jnp.isfinite(out).all() and jnp.isfinite(out2).all()
+    mha = llama.LlamaConfig(vocab_size=256, n_layer=1, n_head=4, n_kv_head=4,
+                            d_model=64, d_ff=128, seq_len=32,
+                            dtype=jnp.float32, attn_impl="xla")
+    params = llama.init_params(gqa, jax.random.key(1))
+    hd, D = gqa.head_dim, gqa.d_model
+
+    def tile_kv(w):
+        # (L, D, KV*hd) -> (L, D, KV, hd) -> repeat each kv head q_per_kv
+        # times along the head axis -> (L, D, H*hd).
+        L = w.shape[0]
+        heads = w.reshape(L, D, gqa.n_kv_head, hd)
+        return jnp.repeat(heads, gqa.q_per_kv, axis=2).reshape(L, D, -1)
+
+    params_mha = dict(params)
+    params_mha["blocks"] = dict(params["blocks"])
+    params_mha["blocks"]["wk"] = tile_kv(params["blocks"]["wk"])
+    params_mha["blocks"]["wv"] = tile_kv(params["blocks"]["wv"])
+
+    tokens = jax.random.randint(jax.random.key(2), (2, 32), 0, 256)
+    out_gqa = llama.forward(params, tokens, gqa)
+    out_mha = llama.forward(params_mha, tokens, mha)
+    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha),
+                               rtol=1e-5, atol=1e-5)
 
 
 def test_rope_is_position_sensitive():
