@@ -216,6 +216,17 @@ def cmd_down(args) -> int:
     return 0
 
 
+def cmd_stack(args) -> int:
+    """`ray stack` equivalent: this process's threads + any process workers
+    of a runtime living here (cross-process runtimes expose the same dump
+    via the metrics agent's /api/stacks)."""
+    import ray_tpu  # noqa: F401 — ensures package import side effects
+    from ray_tpu._private import stack_profiler
+
+    print(stack_profiler.format_stacks(stack_profiler.collect_all_stacks()))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -263,11 +274,15 @@ def main(argv=None) -> int:
     down = sub.add_parser("down", help="tear down the cluster in this session")
     down.add_argument("config", nargs="?", help="cluster YAML (informational)")
 
+    sub.add_parser("stack", help="dump stacks of driver threads + process "
+                                 "workers (ref: `ray stack` / py-spy)")
+
     args = p.parse_args(argv)
     return {
         "status": cmd_status, "list": cmd_list, "summary": cmd_summary,
         "timeline": cmd_timeline, "metrics": cmd_metrics, "job": cmd_job,
         "logs": cmd_logs, "run": cmd_run, "up": cmd_up, "down": cmd_down,
+        "stack": cmd_stack,
     }[args.cmd](args)
 
 

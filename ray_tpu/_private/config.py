@@ -86,6 +86,9 @@ class Config:
     #: fraction (1.0 disables the monitor; reference default 0.95).
     memory_monitor_threshold: float = 1.0
     memory_monitor_interval_s: float = 1.0
+    #: Absolute floor: also treat free bytes below this as pressure
+    #: (0 = disabled; ref: min_memory_free_bytes).
+    memory_monitor_min_free_bytes: int = 0
 
     # --- fault tolerance ---
     #: Period of the control plane's health check of actors/nodes

@@ -84,6 +84,14 @@ class KVStore:
         with self._lock:
             return {ns: len(kv) for ns, kv in self._data.items()}
 
+    def close(self) -> None:
+        with self._lock:
+            if self._wal is not None:
+                try:
+                    self._wal.close()
+                finally:
+                    self._wal = None
+
     # ------------------------------------------------------------ durability
     def _log(self, record: dict) -> None:
         """Caller holds the lock."""

@@ -153,6 +153,12 @@ def _api_payload(runtime, path: str):
     fn = listings.get(path)
     if fn is not None:
         return fn()
+    if path == "/api/stacks":
+        # On-demand profiling (ref: dashboard reporter profile_manager.py:78
+        # py-spy dumps; here sys._current_frames + SIGUSR1 faulthandler).
+        from ray_tpu._private import stack_profiler
+
+        return stack_profiler.collect_all_stacks()
     if path == "/api/jobs":
         from ray_tpu.job import job_manager as jm_mod
 

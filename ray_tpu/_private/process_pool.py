@@ -105,6 +105,11 @@ def _actor_task_context(actor_id):
 def _worker_main(conn, arena_path: Optional[str], back_conn=None) -> None:
     # Keep workers off the TPU: the driver process owns the chips.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # On-demand stack dumps (`ray_tpu stack`, ref: py-spy via the reporter
+    # agent): SIGUSR1 → faulthandler dump readable by the driver.
+    from ray_tpu._private.stack_profiler import install_worker_dump_handler
+
+    install_worker_dump_handler()
     fn_cache: Dict[str, Any] = {}
     actor_instance: List[Any] = [None]  # box: set by actor_new
     arena = _attach_arena(arena_path)
@@ -214,6 +219,13 @@ class _ProcWorker:
         import sys
 
         self.env_key = env_key
+
+        # Export the resolved stack-dump dir so the spawned child (which
+        # sees only config DEFAULTS) registers its SIGUSR1 dump file where
+        # this driver will look for it (stack_profiler.dump_dir).
+        from ray_tpu._private.stack_profiler import dump_dir
+
+        os.environ["RAY_TPU_STACK_DUMP_DIR"] = dump_dir()
 
         ctx = mp.get_context("spawn")
         self.conn, child_conn = ctx.Pipe()

@@ -388,18 +388,21 @@ class Runtime:
                 return list(self._leased_workers.values())
 
         def kill(lw):
-            # Re-check membership under the lock: the task may have finished
-            # (worker untracked, possibly re-leased) between the monitor's
-            # snapshot and this kill — killing then would shoot an innocent.
+            # Re-check ENTRY IDENTITY under the lock: the task may have
+            # finished and the worker been re-leased to a new (possibly
+            # non-retriable) task between the monitor's snapshot and this
+            # kill — a same-id fresh entry means the victim is gone.
             with self._leased_lock:
-                if id(lw.worker) not in self._leased_workers:
+                if self._leased_workers.get(id(lw.worker)) is not lw:
                     return
                 lw.worker.kill()
 
         self._memory_monitor = MemoryMonitor(
             victims_fn=victims, kill_fn=kill,
             threshold=self.config.memory_monitor_threshold,
-            check_interval_s=self.config.memory_monitor_interval_s)
+            check_interval_s=self.config.memory_monitor_interval_s,
+            min_memory_free_bytes=(
+                self.config.memory_monitor_min_free_bytes or None))
         self._memory_monitor.start()
 
     # --------------------------------------------------- cluster introspection

@@ -1,0 +1,161 @@
+"""On-demand stack dumps of the driver and every worker process.
+
+TPU-native analogue of the reference's on-demand profiling (ref:
+python/ray/dashboard/modules/reporter/profile_manager.py:78 — py-spy stack
+dumps/flamegraphs of any worker from the dashboard; `ray stack` CLI).
+py-spy is not in the image, so:
+
+- driver/thread-tier workers: sampled in-process via
+  ``sys._current_frames`` (every thread, no interruption);
+- process-tier workers: each worker registers a SIGUSR1 faulthandler at
+  startup writing to a per-pid file under the session dir; the driver
+  signals the pid and collects the file (signal-based dumping works even
+  mid-task, the property py-spy provides externally).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional
+
+
+def dump_dir() -> str:
+    """Driver-side resolved dump dir (always from the live config)."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    d = os.path.join(GLOBAL_CONFIG.session_dir, "stack_dumps")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _worker_dump_dir() -> str:
+    """Worker-side dir: spawned children see only config DEFAULTS (never the
+    driver's _system_config), so the driver exports its resolved dir via env
+    at spawn time and the child prefers that."""
+    env = os.environ.get("RAY_TPU_STACK_DUMP_DIR")
+    if env:
+        os.makedirs(env, exist_ok=True)
+        return env
+    return dump_dir()
+
+
+# ---------------------------------------------------------------- worker side
+def install_worker_dump_handler() -> None:
+    """Called in every process worker's main: SIGUSR1 → dump all thread
+    stacks to <session>/stack_dumps/<pid>.txt (faulthandler is async-signal
+    -safe, unlike a Python-level handler formatting frames)."""
+    import faulthandler
+
+    try:
+        path = os.path.join(_worker_dump_dir(), f"{os.getpid()}.txt")
+        f = open(path, "w")
+        faulthandler.register(signal.SIGUSR1, file=f, all_threads=True)
+        # Keep the handle alive for the process lifetime.
+        globals().setdefault("_dump_files", []).append(f)
+    except Exception:
+        pass  # profiling is best-effort; workers must start regardless
+
+
+# ---------------------------------------------------------------- driver side
+def current_process_stacks() -> Dict[str, List[str]]:
+    """Thread-name → formatted stack for THIS process (driver + thread-tier
+    workers; ref: `ray stack` output shape)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    for ident, frame in sys._current_frames().items():
+        name = names.get(ident, f"thread-{ident}")
+        out[name] = traceback.format_stack(frame)
+    return out
+
+
+def dump_worker_stacks(pids: List[int], timeout_s: float = 2.0) -> Dict[int, str]:
+    """Signal each worker pid; collect its faulthandler dump file.
+
+    A worker is only signaled once its dump file exists — the file is
+    created when the handler registers, so its absence means the worker is
+    still booting and SIGUSR1 would hit the DEFAULT disposition and kill it.
+    (A stale same-pid file from an older session could defeat this gate;
+    sessions share /tmp dirs rarely enough that we accept the window rather
+    than plumb worker start-times through.)
+    """
+    d = dump_dir()
+    results: Dict[int, str] = {}
+    marks: Dict[int, float] = {}
+    for pid in pids:
+        path = os.path.join(d, f"{pid}.txt")
+        if not os.path.exists(path):
+            results[pid] = "<worker still starting; dump handler not ready>"
+            continue
+        try:
+            marks[pid] = os.path.getsize(path)
+            os.kill(pid, signal.SIGUSR1)
+        except (ProcessLookupError, PermissionError, OSError) as e:
+            results[pid] = f"<unreachable: {e}>"
+    deadline = time.monotonic() + timeout_s
+    pending = [p for p in pids if p not in results]
+    while pending and time.monotonic() < deadline:
+        time.sleep(0.05)
+        for pid in list(pending):
+            path = os.path.join(d, f"{pid}.txt")
+            try:
+                if os.path.exists(path) and os.path.getsize(path) > marks[pid]:
+                    with open(path) as f:
+                        f.seek(marks[pid])
+                        results[pid] = f.read()
+                    pending.remove(pid)
+            except OSError:
+                pass
+    for pid in pending:
+        results[pid] = "<no dump received (worker busy in native code?)>"
+    return results
+
+
+def collect_all_stacks() -> Dict[str, object]:
+    """Full cluster view: driver threads + every live process worker."""
+    out: Dict[str, object] = {"driver": current_process_stacks()}
+    pids = worker_pids()
+    if pids:
+        out["process_workers"] = dump_worker_stacks(pids)
+    return out
+
+
+def worker_pids() -> List[int]:
+    """All live process-tier worker pids known to the runtime."""
+    from ray_tpu._private.runtime import runtime_or_none
+
+    rt = runtime_or_none()
+    if rt is None or not hasattr(rt, "process_pool"):
+        return []
+    pids = set()
+    pool = rt.process_pool
+    with pool._lock:
+        for workers in pool._idle.values():
+            for w in workers:
+                if w.alive():
+                    pids.add(w.proc.pid)
+    with rt._leased_lock:
+        for lw in rt._leased_workers.values():
+            if lw.worker.alive():
+                pids.add(lw.worker.proc.pid)
+    with rt._actors_lock:
+        for state in rt._actors.values():
+            w = state.proc_worker
+            if w is not None and w.alive():
+                pids.add(w.proc.pid)
+    return sorted(pids)
+
+
+def format_stacks(stacks: Dict[str, object]) -> str:
+    lines: List[str] = []
+    for name, stack in sorted(stacks.get("driver", {}).items()):
+        lines.append(f"=== driver thread: {name} ===")
+        lines.extend(s.rstrip("\n") for s in stack)
+    for pid, text in sorted(stacks.get("process_workers", {}).items()):
+        lines.append(f"=== process worker pid={pid} ===")
+        lines.append(str(text).rstrip("\n"))
+    return "\n".join(lines)

@@ -76,10 +76,12 @@ class Autoscaler:
                 if pid not in live:
                     del self._owned[pid]
 
-        # 2. min_workers floor.
+        # 2. min_workers floor (still subject to the cluster-wide cap).
         counts = self._counts()
         for type_name, cfg in self.config.node_types.items():
             for _ in range(cfg.min_workers - counts.get(type_name, 0)):
+                if self._at_total_cap():
+                    break
                 launched.append(self._launch(type_name))
 
         # 3. Unmet demand -> more nodes (simple first-fit-decreasing binpack
@@ -123,6 +125,13 @@ class Autoscaler:
         return {"launched": launched, "terminated": terminated}
 
     # -------------------------------------------------------------- helpers
+    def _at_total_cap(self) -> bool:
+        cap = self.config.max_total_workers
+        if cap is None:
+            return False
+        with self._lock:
+            return len(self._owned) >= cap
+
     def _launch(self, type_name: str) -> str:
         cfg = self.config.node_types[type_name]
         pid = self.provider.create_node(type_name, dict(cfg.resources),

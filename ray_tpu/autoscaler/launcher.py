@@ -41,7 +41,8 @@ class ClusterConfig:
     provider: NodeProvider
     node_types: Dict[str, NodeTypeConfig]
     head_node_type: str
-    max_workers: int = 10
+    #: cluster-wide worker cap; None (top-level key absent) = unbounded.
+    max_workers: Optional[int] = None
     idle_timeout_s: float = 60.0
     head_resources: Dict[str, float] = field(default_factory=dict)
 
@@ -56,8 +57,9 @@ def load_cluster_config(source: Any) -> ClusterConfig:
         import yaml
 
         s = str(source)
-        looks_like_path = (s.endswith((".yaml", ".yml"))
-                           or os.sep in s) and "\n" not in s
+        # Inline YAML (flow style included) contains ':' or '{'; paths don't.
+        looks_like_path = "\n" not in s and ":" not in s and "{" not in s \
+            and (s.endswith((".yaml", ".yml")) or os.sep in s)
         if os.path.exists(s):
             with open(s) as f:
                 text = f.read()
@@ -103,10 +105,11 @@ def load_cluster_config(source: Any) -> ClusterConfig:
     if head_type is None or head_type not in node_types:
         raise ClusterConfigError(
             f"head_node_type {head_type!r} must name an available_node_type")
+    top_max = raw.get("max_workers")
     return ClusterConfig(
         cluster_name=name, provider=provider, node_types=node_types,
         head_node_type=head_type,
-        max_workers=int(raw.get("max_workers", 10)),
+        max_workers=None if top_max is None else int(top_max),
         idle_timeout_s=float(raw.get("idle_timeout_s", 60.0)),
         head_resources=dict(node_types[head_type].resources))
 
@@ -159,6 +162,8 @@ def launch_cluster(source: Any, *, autoscale: bool = True) -> ClusterHandle:
     worker_ids: List[str] = []
     for tname, tcfg in config.node_types.items():
         for _ in range(tcfg.min_workers):
+            if autoscaler._at_total_cap():
+                break
             worker_ids.append(autoscaler._launch(tname))
     monitor = Monitor(autoscaler).start() if autoscale else None
     return ClusterHandle(config, autoscaler, monitor, worker_ids)

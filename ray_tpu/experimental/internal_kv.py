@@ -38,6 +38,8 @@ def _internal_kv_reset() -> None:
     a fresh store replays it, which is exactly the restart path)."""
     global _store
     with _lock:
+        if _store is not None:
+            _store.close()  # don't leak the WAL fd across resets
         _store = None
 
 

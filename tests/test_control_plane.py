@@ -225,8 +225,10 @@ def test_memory_monitor_kills_busy_process_worker():
     import ray_tpu
     from ray_tpu.exceptions import WorkerCrashedError
 
-    ray_tpu.init(ignore_reinit_error=True,
-                 _system_config={"memory_monitor_threshold": 0.999,
+    # _system_config only applies on a FRESH runtime: drop any runtime a
+    # prior test left behind (order independence).
+    ray_tpu.shutdown()
+    ray_tpu.init(_system_config={"memory_monitor_threshold": 0.999,
                                  "memory_monitor_interval_s": 0.05})
     from ray_tpu._private.runtime import get_runtime
 
@@ -250,5 +252,20 @@ def test_memory_monitor_kills_busy_process_worker():
         ray_tpu.get(ref, timeout=30)
     assert "WorkerCrashedError" in repr(ei.value)
     assert rt._memory_monitor.stats["kills"] >= 1
-    # Restore sanity for later tests in the session.
-    rt._memory_monitor._usage = lambda: 0.0
+    # Leave nothing armed for later tests: stop the monitor + runtime.
+    rt._memory_monitor.stop()
+    ray_tpu.shutdown()
+
+
+def test_memory_monitor_min_free_bytes_floor():
+    """Absolute floor trips even when the usage fraction looks healthy."""
+    workers = [_FakeWorker("w", True, 1.0)]
+    killed = []
+    mon = MemoryMonitor(
+        usage_fraction_fn=lambda: 0.10,  # fraction alone would never trip
+        free_bytes_fn=lambda: 100 << 20,
+        victims_fn=lambda: list(workers),
+        kill_fn=lambda w: (killed.append(w.name), workers.remove(w)),
+        threshold=0.95, min_memory_free_bytes=1 << 30)
+    assert mon.tick()
+    assert killed == ["w"]

@@ -270,3 +270,38 @@ def test_cli_logs_lists_and_prints(tmp_path, capsys, monkeypatch):
     assert "hello from the job" in out
 
     assert main(["logs", "missing-job"]) == 1
+
+
+def test_stack_dumps_driver_and_process_workers(ray_start_regular):
+    """`ray stack` equivalent: driver thread frames + a SIGUSR1 faulthandler
+    dump from a busy process worker (ref: profile_manager.py py-spy dumps)."""
+    import time as _t
+
+    from ray_tpu._private import stack_profiler
+
+    @ray_tpu.remote(isolation="process")
+    def busy():
+        _t.sleep(3)
+        return "done"
+
+    ref = busy.remote()
+    # Wait for a worker AND its dump handler (file appears at registration;
+    # signaling a still-booting worker is refused by dump_worker_stacks).
+    import os as _os
+
+    deadline = _t.time() + 20
+    while _t.time() < deadline:
+        pids = stack_profiler.worker_pids()
+        if pids and all(
+                _os.path.exists(_os.path.join(stack_profiler.dump_dir(),
+                                              f"{p}.txt")) for p in pids):
+            break
+        _t.sleep(0.05)
+    stacks = stack_profiler.collect_all_stacks()
+    assert "MainThread" in stacks["driver"]
+    assert stacks.get("process_workers"), "no process worker dumped"
+    dump = "\n".join(str(v) for v in stacks["process_workers"].values())
+    assert "Thread" in dump or "File" in dump, dump[:200]
+    text = stack_profiler.format_stacks(stacks)
+    assert "driver thread" in text and "process worker pid=" in text
+    assert ray_tpu.get(ref, timeout=30) == "done"
