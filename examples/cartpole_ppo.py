@@ -36,7 +36,7 @@ def main() -> int:
                      num_envs_per_env_runner=8,
                      rollout_fragment_length=64)
         .training(train_batch_size=2048, minibatch_size=128, num_epochs=8,
-                  lr=3e-4, entropy_coeff=0.01, vf_clip_param=10.0,
+                  lr=3e-4, entropy_coeff=0.001, vf_clip_param=10.0,
                   lambda_=0.95, gamma=0.99)
         .debugging(seed=0)
     )

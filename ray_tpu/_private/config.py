@@ -155,3 +155,21 @@ def _coerce(value: str, typ: Any) -> Any:
 
 
 GLOBAL_CONFIG = Config()
+
+
+def session_subdir(name: str, env_var: str, *, export: bool = False) -> str:
+    """Resolve <session_dir>/<name>, honoring an env override so spawned
+    workers (which see only config DEFAULTS, never the driver's
+    _system_config) agree with the driver.  ``export=True`` publishes the
+    driver's resolved path into the env before spawning children."""
+    import os
+
+    env = os.environ.get(env_var)
+    if env and not export:
+        os.makedirs(env, exist_ok=True)
+        return env
+    d = os.path.join(GLOBAL_CONFIG.session_dir, name)
+    os.makedirs(d, exist_ok=True)
+    if export:
+        os.environ[env_var] = d
+    return d

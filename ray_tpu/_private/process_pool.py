@@ -110,6 +110,11 @@ def _worker_main(conn, arena_path: Optional[str], back_conn=None) -> None:
     from ray_tpu._private.stack_profiler import install_worker_dump_handler
 
     install_worker_dump_handler()
+    # Worker stdout/stderr → per-pid session log files, tailed back to the
+    # driver by the LogMonitor (ref: _private/log_monitor.py:103).
+    from ray_tpu._private.log_monitor import redirect_worker_output
+
+    redirect_worker_output()
     fn_cache: Dict[str, Any] = {}
     actor_instance: List[Any] = [None]  # box: set by actor_new
     arena = _attach_arena(arena_path)
@@ -213,6 +218,34 @@ def _next_handoff_key(prefix: str) -> str:
         return f"{prefix}:{os.getpid()}:{_HANDOFF_COUNTER}"
 
 
+_LOG_MONITOR = None
+_LOG_MONITOR_LOCK = threading.Lock()
+
+
+def _ensure_log_monitor() -> None:
+    """One driver-wide tailer streaming worker logs back to this terminal
+    while config.log_to_driver is on (ref: LogMonitor publishes to the
+    driver via GCS pubsub; in-process here)."""
+    global _LOG_MONITOR
+    if not GLOBAL_CONFIG.log_to_driver:
+        return
+    with _LOG_MONITOR_LOCK:
+        if _LOG_MONITOR is None:
+            from ray_tpu._private.log_monitor import LogMonitor
+
+            _LOG_MONITOR = LogMonitor().start()
+
+
+def stop_log_monitor() -> None:
+    """Runtime shutdown: end the tailer so a later init (possibly with
+    log_to_driver=False) doesn't inherit a still-streaming thread."""
+    global _LOG_MONITOR
+    with _LOG_MONITOR_LOCK:
+        if _LOG_MONITOR is not None:
+            _LOG_MONITOR.stop()
+            _LOG_MONITOR = None
+
+
 class _ProcWorker:
     def __init__(self, arena_path: Optional[str] = None, arena=None,
                  env_key: str = "", env_payload: Optional[dict] = None) -> None:
@@ -220,12 +253,15 @@ class _ProcWorker:
 
         self.env_key = env_key
 
-        # Export the resolved stack-dump dir so the spawned child (which
-        # sees only config DEFAULTS) registers its SIGUSR1 dump file where
-        # this driver will look for it (stack_profiler.dump_dir).
+        # Export resolved dirs so the spawned child (which sees only config
+        # DEFAULTS) writes its SIGUSR1 dump file and stdout/stderr logs
+        # where this driver will look for them.
+        from ray_tpu._private.log_monitor import log_dir
         from ray_tpu._private.stack_profiler import dump_dir
 
-        os.environ["RAY_TPU_STACK_DUMP_DIR"] = dump_dir()
+        dump_dir(export=True)
+        log_dir(export=True)
+        _ensure_log_monitor()
 
         ctx = mp.get_context("spawn")
         self.conn, child_conn = ctx.Pipe()

@@ -1270,6 +1270,9 @@ class Runtime:
             self._memory_monitor.stop()
             self._memory_monitor = None
         self.process_pool.shutdown()
+        from ray_tpu._private.process_pool import stop_log_monitor
+
+        stop_log_monitor()
         self._exec_pool.shutdown(wait=False, cancel_futures=True)
         from ray_tpu._private import borrowing
 

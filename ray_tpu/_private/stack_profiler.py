@@ -24,24 +24,13 @@ import traceback
 from typing import Dict, List, Optional
 
 
-def dump_dir() -> str:
-    """Driver-side resolved dump dir (always from the live config)."""
-    from ray_tpu._private.config import GLOBAL_CONFIG
+def dump_dir(export: bool = False) -> str:
+    """Driver side resolves from the live config (export=True publishes to
+    env for spawned children); workers prefer the exported env value."""
+    from ray_tpu._private.config import session_subdir
 
-    d = os.path.join(GLOBAL_CONFIG.session_dir, "stack_dumps")
-    os.makedirs(d, exist_ok=True)
-    return d
-
-
-def _worker_dump_dir() -> str:
-    """Worker-side dir: spawned children see only config DEFAULTS (never the
-    driver's _system_config), so the driver exports its resolved dir via env
-    at spawn time and the child prefers that."""
-    env = os.environ.get("RAY_TPU_STACK_DUMP_DIR")
-    if env:
-        os.makedirs(env, exist_ok=True)
-        return env
-    return dump_dir()
+    return session_subdir("stack_dumps", "RAY_TPU_STACK_DUMP_DIR",
+                          export=export)
 
 
 # ---------------------------------------------------------------- worker side
@@ -52,7 +41,7 @@ def install_worker_dump_handler() -> None:
     import faulthandler
 
     try:
-        path = os.path.join(_worker_dump_dir(), f"{os.getpid()}.txt")
+        path = os.path.join(dump_dir(), f"{os.getpid()}.txt")
         f = open(path, "w")
         faulthandler.register(signal.SIGUSR1, file=f, all_threads=True)
         # Keep the handle alive for the process lifetime.
@@ -98,16 +87,23 @@ def dump_worker_stacks(pids: List[int], timeout_s: float = 2.0) -> Dict[int, str
             results[pid] = f"<unreachable: {e}>"
     deadline = time.monotonic() + timeout_s
     pending = [p for p in pids if p not in results]
+    last_size: Dict[int, int] = {}
     while pending and time.monotonic() < deadline:
         time.sleep(0.05)
         for pid in list(pending):
             path = os.path.join(d, f"{pid}.txt")
             try:
-                if os.path.exists(path) and os.path.getsize(path) > marks[pid]:
+                size = os.path.getsize(path)
+                # Collect only once the dump is QUIESCENT (grew past the
+                # mark, then unchanged across a poll) — faulthandler writes
+                # incrementally and a partial read would drop thread stacks.
+                if size > marks[pid] and last_size.get(pid) == size:
                     with open(path) as f:
                         f.seek(marks[pid])
                         results[pid] = f.read()
                     pending.remove(pid)
+                else:
+                    last_size[pid] = size
             except OSError:
                 pass
     for pid in pending:

@@ -305,3 +305,39 @@ def test_stack_dumps_driver_and_process_workers(ray_start_regular):
     text = stack_profiler.format_stacks(stacks)
     assert "driver thread" in text and "process worker pid=" in text
     assert ray_tpu.get(ref, timeout=30) == "done"
+
+
+def test_worker_logs_captured_and_tailed(ray_start_regular, capsys):
+    """Process-worker prints land in per-pid session log files and are
+    re-emitted to the driver with (worker pid=N) prefixes
+    (ref: _private/log_monitor.py:103)."""
+    import os as _os
+
+    from ray_tpu._private.log_monitor import LogMonitor, log_dir
+
+    @ray_tpu.remote(isolation="process")
+    def chatty():
+        print("hello from the worker")
+        import sys as _s
+
+        print("warning line", file=_s.stderr)
+        return _os.getpid()
+
+    pid = ray_tpu.get(chatty.remote(), timeout=60)
+    out_path = _os.path.join(log_dir(), f"worker-{pid}.out")
+    err_path = _os.path.join(log_dir(), f"worker-{pid}.err")
+    deadline = time.time() + 10
+    while time.time() < deadline and not (
+            _os.path.exists(out_path)
+            and "hello from the worker" in open(out_path).read()):
+        time.sleep(0.05)
+    assert "hello from the worker" in open(out_path).read()
+    assert "warning line" in open(err_path).read()
+
+    # A fresh monitor (offset 0) re-emits the lines with pid prefixes.
+    lines = []
+    mon = LogMonitor(emit=lines.append)
+    mon.poll_once()
+    joined = "\n".join(lines)
+    assert f"(worker pid={pid}) hello from the worker" in joined
+    assert f"(worker pid={pid}, stderr) warning line" in joined
