@@ -8,11 +8,12 @@ from ray_tpu.train.session import (
     get_dataset_shard,
     report,
 )
+from ray_tpu.train.torch_trainer import TorchTrainer
 from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
 
 __all__ = [
     "Checkpoint", "CheckpointManager", "CheckpointConfig", "DataParallelTrainer",
     "FailureConfig", "JaxTrainer", "Result", "RunConfig", "ScalingConfig",
     "get_checkpoint", "get_context", "get_dataset_shard", "load_pytree",
-    "report", "save_pytree",
+    "report", "save_pytree", "TorchTrainer",
 ]

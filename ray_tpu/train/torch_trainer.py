@@ -1,0 +1,209 @@
+"""TorchTrainer — distributed data-parallel PyTorch on process-tier workers.
+
+(ref: python/ray/train/torch/torch_trainer.py:11 TorchTrainer +
+train/torch/config.py:66,115,153 _TorchBackend/_setup_torch_process_group —
+each Ray Train worker actor joins a torch.distributed process group; the
+user loop wraps its model with DDP via prepare_model.)
+
+TPU-native positioning: JAX is this framework's device path — TorchTrainer
+exists for CPU-side torch workloads and API parity.  Workers are
+PROCESS-tier actors (torch.distributed requires one process per rank) that
+rendezvous over gloo TCP; results flow back through an actor-backed report
+queue (the shared-memory TrainSession of the thread tier cannot cross a
+process boundary).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.session import TrainContext, init_session, clear_session
+from ray_tpu.train.trainer import DataParallelTrainer
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+class ProcessTrainSession:
+    """Pickle-safe session for process-tier workers: report() ships
+    (metrics, checkpoint path) through an actor-backed queue instead of the
+    thread tier's shared in-memory queue (ref: _TrainSession:112 — same
+    contract, different transport)."""
+
+    def __init__(self, context: TrainContext, report_queue,
+                 checkpoint_to_restore: Optional[Checkpoint] = None):
+        self.context = context
+        self._queue = report_queue
+        self.checkpoint_to_restore = checkpoint_to_restore
+        self.dataset_shards: Dict[str, Any] = {}
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        self._queue.put({
+            "rank": self.context.world_rank,
+            "metrics": dict(metrics),
+            "checkpoint_path": checkpoint.path if checkpoint else None,
+        })
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.checkpoint_to_restore
+
+    def get_dataset_shard(self, name: str):
+        raise ValueError(
+            "dataset shards are not available on process-tier torch workers "
+            "(streaming iterators cannot cross the process boundary); load "
+            "data inside the train_loop or use JaxTrainer")
+
+
+@ray_tpu.remote
+class TorchTrainWorker:
+    """One torch.distributed rank in its own OS process
+    (ref: _internal/worker_group.py:19 RayTrainWorker + torch backend
+    on_start).  Always created with isolation='process'."""
+
+    def __init__(self, rank: int, world_size: int, master_port: int):
+        from datetime import timedelta
+
+        import torch.distributed as dist
+
+        os.environ["MASTER_ADDR"] = "127.0.0.1"
+        os.environ["MASTER_PORT"] = str(master_port)
+        # Bounded rendezvous: the probed port is TOCTOU-racy (another
+        # process can steal it between probe and bind); without a timeout a
+        # stolen port means every rank hangs for gloo's 30-min default while
+        # fit() spins with no diagnostic.
+        dist.init_process_group(
+            backend="gloo",
+            init_method=f"tcp://127.0.0.1:{master_port}",
+            rank=rank, world_size=world_size,
+            timeout=timedelta(seconds=60))
+        self.rank = rank
+        self.world_size = world_size
+
+    def run(self, train_loop: Callable, loop_config: Optional[Dict[str, Any]],
+            session: ProcessTrainSession) -> str:
+        from ray_tpu.train.trainer import invoke_train_loop
+
+        init_session(session)
+        try:
+            invoke_train_loop(train_loop, loop_config)
+            return "done"
+        finally:
+            clear_session()
+
+    def shutdown_group(self) -> None:
+        import torch.distributed as dist
+
+        if dist.is_initialized():
+            dist.destroy_process_group()
+
+
+def prepare_model(model):
+    """Wrap the model for data-parallel training
+    (ref: train/torch/train_loop_utils.py prepare_model — DDP)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        return DistributedDataParallel(model)
+    return model
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TorchTrainer(DataParallelTrainer):
+    """Same controller contract as DataParallelTrainer (elastic restarts,
+    checkpoint manager, PG gang scheduling) with the worker group swapped
+    for process-tier torch ranks."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.datasets:
+            # Constructor-time invariant: fail before any placement-group
+            # reservation is paid for a run that can never proceed.
+            raise ValueError(
+                "TorchTrainer does not support datasets= (process workers "
+                "cannot receive streaming iterators); load data inside the "
+                "train_loop or use JaxTrainer")
+
+    def _run_with_pg(self, pg, run_name: str, group_name: str,
+                     manager: CheckpointManager, restore_ckpt) -> Dict:
+        from ray_tpu.exceptions import RayTpuError, TaskError
+        from ray_tpu.util.queue import Empty, Queue
+
+        scfg = self.scaling_config
+        world = scfg.num_workers
+        report_queue = Queue()
+        port = _free_port()
+        workers = []
+        sessions: List[ProcessTrainSession] = []
+        for rank in range(world):
+            ctx = TrainContext(world_rank=rank, world_size=world,
+                               local_rank=rank, trial_name=run_name,
+                               experiment_name=run_name,
+                               group_name=group_name)
+            sessions.append(ProcessTrainSession(ctx, report_queue,
+                                                restore_ckpt))
+            workers.append(
+                TorchTrainWorker.options(
+                    isolation="process",
+                    resources=scfg.worker_resources(),
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=pg,
+                        placement_group_bundle_index=rank),
+                ).remote(rank, world, port))
+
+        refs = [w.run.remote(self.train_loop, self.train_loop_config, s)
+                for w, s in zip(workers, sessions)]
+
+        history: List[Dict[str, Any]] = []
+        last_metrics: Optional[Dict[str, Any]] = None
+
+        def drain() -> None:
+            nonlocal last_metrics
+            while True:
+                try:
+                    item = report_queue.get_nowait()
+                except Empty:
+                    return
+                if item.get("checkpoint_path"):
+                    manager.register(Checkpoint(item["checkpoint_path"]),
+                                     item["metrics"])
+                if item["rank"] == 0:
+                    last_metrics = item["metrics"]
+                    history.append(item["metrics"])
+
+        pending = list(refs)
+        try:
+            while pending:
+                ready, pending = ray_tpu.wait(pending,
+                                              num_returns=len(pending),
+                                              timeout=0.05)
+                drain()
+                for r in ready:
+                    ray_tpu.get(r)
+            drain()
+            for w in workers:
+                try:
+                    ray_tpu.get(w.shutdown_group.remote(), timeout=10)
+                except Exception:
+                    pass
+            return {"status": "finished", "last_metrics": last_metrics,
+                    "history": history, "error": None}
+        except (TaskError, RayTpuError) as e:
+            for w in workers:
+                ray_tpu.kill(w)
+            drain()
+            return {"status": "failed", "last_metrics": last_metrics,
+                    "history": history, "error": e}
+        finally:
+            try:
+                report_queue.shutdown()
+            except Exception:
+                pass

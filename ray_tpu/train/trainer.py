@@ -58,6 +58,19 @@ class Result:
         return f"Result(metrics={self.metrics}, checkpoint={self.checkpoint}, error={self.error})"
 
 
+def invoke_train_loop(train_loop: Callable,
+                      loop_config: Optional[Dict[str, Any]]) -> None:
+    """Signature-dispatch shared by every worker kind (ref: the reference
+    accepts both `def loop()` and `def loop(config)`)."""
+    import inspect
+
+    sig = inspect.signature(train_loop)
+    if len(sig.parameters) >= 1:
+        train_loop(loop_config or {})
+    else:
+        train_loop()
+
+
 @ray_tpu.remote
 class TrainWorker:
     """(ref: _internal/worker_group.py:19 RayTrainWorker)"""
@@ -78,13 +91,7 @@ class TrainWorker:
             session: TrainSession) -> str:
         init_session(session)
         try:
-            import inspect
-
-            sig = inspect.signature(train_loop)
-            if len(sig.parameters) >= 1:
-                train_loop(loop_config or {})
-            else:
-                train_loop()
+            invoke_train_loop(train_loop, loop_config)
             return "done"
         except StopIteration:
             return "stopped"

@@ -1,0 +1,124 @@
+"""TorchTrainer: real torch.distributed (gloo) DDP across process-tier
+workers (ref: train/torch/torch_trainer.py + tests/test_torch_trainer.py —
+multi-worker DDP on one box, gradient sync through the process group).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import RunConfig, ScalingConfig, TorchTrainer
+
+
+@pytest.fixture(autouse=True)
+def _runtime():
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield
+
+
+def _loop(config):
+    import torch
+    import torch.distributed as dist
+    from ray_tpu import train
+    from ray_tpu.train.torch_trainer import prepare_model
+
+    ctx = train.get_context()
+    torch.manual_seed(0)  # identical init on every rank
+    model = prepare_model(torch.nn.Linear(4, 1))
+    opt = torch.optim.SGD(model.parameters(), lr=0.1)
+    g = torch.Generator().manual_seed(1234 + ctx.get_world_rank())
+    x = torch.randn(32, 4, generator=g)
+    y = x.sum(dim=1, keepdim=True)
+    for step in range(config["steps"]):
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()  # DDP allreduces gradients across ranks here
+        opt.step()
+        w = [p.detach().clone() for p in model.parameters()]
+        train.report({
+            "step": step, "loss": float(loss),
+            "rank": ctx.get_world_rank(),
+            "world_size": dist.get_world_size(),
+            "weight0": float(w[0].flatten()[0]),
+        })
+
+
+def test_torch_trainer_ddp_two_workers(tmp_path):
+    trainer = TorchTrainer(
+        _loop, train_loop_config={"steps": 4},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="torch_ddp", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["step"] == 3
+    assert result.metrics["world_size"] == 2
+    assert np.isfinite(result.metrics["loss"])
+    assert len(result.metrics_history) == 4  # rank-0 reports
+
+
+def test_torch_trainer_gradients_actually_sync(tmp_path):
+    """Ranks see DIFFERENT data; DDP averaging must keep their weights
+    identical after each step.  Every rank writes its final weights to a
+    file (the report history keeps rank 0 only), and the test compares the
+    two files — a broken allreduce (e.g. prepare_model not wrapping)
+    produces different weights and fails."""
+    import json
+
+    out_dir = str(tmp_path / "weights")
+
+    def loop(config):
+        import json as _json
+        import os as _os
+
+        import torch
+        from ray_tpu import train
+        from ray_tpu.train.torch_trainer import prepare_model
+
+        ctx = train.get_context()
+        torch.manual_seed(0)
+        model = prepare_model(torch.nn.Linear(3, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.05)
+        g = torch.Generator().manual_seed(ctx.get_world_rank())
+        x = torch.randn(16, 3, generator=g)  # different per rank
+        y = x.mean(dim=1, keepdim=True)
+        for _ in range(3):
+            opt.zero_grad()
+            torch.nn.functional.mse_loss(model(x), y).backward()
+            opt.step()
+        final = torch.cat([p.detach().flatten()
+                           for p in model.parameters()])
+        _os.makedirs(config["out_dir"], exist_ok=True)
+        with open(_os.path.join(config["out_dir"],
+                                f"rank{ctx.get_world_rank()}.json"), "w") as f:
+            _json.dump(final.tolist(), f)
+        train.report({"rank": ctx.get_world_rank()})
+
+    trainer = TorchTrainer(
+        loop, train_loop_config={"out_dir": out_dir},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="torch_sync", storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    w0 = json.load(open(f"{out_dir}/rank0.json"))
+    w1 = json.load(open(f"{out_dir}/rank1.json"))
+    np.testing.assert_allclose(w0, w1, rtol=1e-6)
+
+    # Negative control: without DDP the same per-rank data diverges.
+    import torch
+
+    def solo(rank):
+        torch.manual_seed(0)
+        model = torch.nn.Linear(3, 1)
+        opt = torch.optim.SGD(model.parameters(), lr=0.05)
+        g = torch.Generator().manual_seed(rank)
+        x = torch.randn(16, 3, generator=g)
+        y = x.mean(dim=1, keepdim=True)
+        for _ in range(3):
+            opt.zero_grad()
+            torch.nn.functional.mse_loss(model(x), y).backward()
+            opt.step()
+        return torch.cat([p.detach().flatten()
+                          for p in model.parameters()]).tolist()
+
+    assert not np.allclose(solo(0), solo(1)), \
+        "control failed: per-rank data too similar to detect sync"
