@@ -1,0 +1,72 @@
+"""Long-context training with ring attention over the `seq` mesh axis.
+
+Shards a 4k-token sequence (16k+ on real chips) across 4 devices (context parallelism): each
+device holds S/4 of every sequence, attention runs as a ppermute ring with
+streaming logsumexp (ops/ring_attention.py), and the train step compiles
+into ONE program whose gradient collectives XLA derives from the shardings.
+
+Run (virtual 8-device CPU mesh, no TPU pod needed):
+    python examples/long_context_ring_attention.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import time
+
+
+def main() -> None:
+    import jax
+
+    if jax.device_count() < 8:
+        # Self-provision the virtual CPU mesh (same trick as
+        # __graft_entry__.dryrun_multichip).
+        import jax._src.xla_bridge as xb
+
+        xb._clear_backends()
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+        jax.clear_caches()
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import MeshSpec, batch_sharding, make_mesh
+    from ray_tpu.parallel.train_state import (create_sharded_state,
+                                              jit_train_step)
+
+    devices = jax.devices()[:8]
+    # data=2 x seq=4: each sequence's tokens split over 4 devices.
+    spec = MeshSpec(data=2, seq=4)
+    mesh = make_mesh(spec, devices)
+    config = gpt2.GPTConfig(vocab_size=2048, n_layer=2, n_head=8,
+                            d_model=256, seq_len=4096, attn_impl="ring")
+    opt = gpt2.make_optimizer(learning_rate=1e-3)
+    params, opt_state = create_sharded_state(
+        lambda k: gpt2.init_params(config, k), gpt2.logical_axes(config),
+        mesh, jax.random.key(0), opt)
+    step = jit_train_step(gpt2.make_train_step(config, opt), mesh=mesh)
+
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(
+        rng.integers(0, config.vocab_size, (4, config.seq_len + 1)), jnp.int32)
+    tokens = jax.device_put(toks[:, :-1], batch_sharding(mesh))
+    targets = jax.device_put(toks[:, 1:], batch_sharding(mesh))
+
+    print(f"mesh={spec.axis_sizes()} seq_len={config.seq_len} "
+          f"(per-device shard: {config.seq_len // spec.seq})")
+    t0 = time.perf_counter()
+    for i in range(2):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        print(f"step {i}: loss={float(loss):.4f} "
+              f"({time.perf_counter() - t0:.1f}s)")
+    print("ring-attention training step OK")
+
+
+if __name__ == "__main__":
+    main()

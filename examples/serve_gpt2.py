@@ -1,0 +1,67 @@
+"""Serve a jitted GPT-2 forward pass behind HTTP + gRPC ingress.
+
+One TPU-resident replica holds the params; requests batch token ids and
+return next-token logits argmax.  Composition, autoscaling, rolling
+updates, and the pow-2 router all apply to this deployment like any other.
+
+Run: python examples/serve_gpt2.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import json
+
+
+def main() -> None:
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.models import gpt2
+
+    ray_tpu.init(ignore_reinit_error=True)
+    serve.start(http_options={"port": 0})
+
+    @serve.deployment(num_replicas=1)
+    class GPT2Next:
+        def __init__(self):
+            self.config = gpt2.GPTConfig(vocab_size=2048, n_layer=2,
+                                         n_head=4, d_model=256, seq_len=128,
+                                         attn_impl="xla")
+            self.params = gpt2.init_params(self.config, jax.random.key(0))
+            self._fwd = jax.jit(
+                lambda p, t: gpt2.forward(p, t, self.config))
+
+        async def __call__(self, request):
+            body = await request.json()
+            tokens = np.asarray(body["tokens"], np.int32)[None, :]
+            logits = self._fwd(self.params, jnp.asarray(tokens))
+            return {"next_token": int(jnp.argmax(logits[0, -1]))}
+
+    serve.run(GPT2Next.bind(), name="gpt2", route_prefix="/gpt2")
+
+    from ray_tpu.serve.api import _state
+
+    addr = _state["proxy"].address
+    req = urllib.request.Request(
+        f"{addr}/gpt2", data=json.dumps({"tokens": [1, 2, 3, 4]}).encode(),
+        headers={"Content-Type": "application/json"})
+    out = json.load(urllib.request.urlopen(req, timeout=30))
+    print("HTTP response:", out)
+    assert "next_token" in out
+    serve.shutdown()
+    ray_tpu.shutdown()
+    print("serve_gpt2 OK")
+
+
+if __name__ == "__main__":
+    main()
