@@ -12,8 +12,13 @@ from ray_tpu.train import RunConfig, ScalingConfig, TorchTrainer
 
 @pytest.fixture(autouse=True)
 def _runtime():
-    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    # A fresh runtime with enough CPUs for the 2-worker gang: earlier test
+    # modules may leave a 1-CPU runtime behind, and init(ignore_reinit_error)
+    # would silently reuse it, failing the PG reservation.
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
     yield
+    ray_tpu.shutdown()
 
 
 def _loop(config):
