@@ -54,6 +54,100 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Streaming result of handle.options(stream=True).remote(...)
+    (ref: handle.py DeploymentResponseGenerator): iterate sync or async;
+    every item is one pull from the replica that opened the stream.  The
+    stream id is an ObjectRef resolved lazily at the first pull, so
+    creating the generator never blocks (safe inside async replicas)."""
+
+    def __init__(self, replica_actor, stream_id_ref, on_done=None):
+        self._actor = replica_actor
+        self._sid_ref = stream_id_ref
+        self._sid: Optional[str] = None
+        self._on_done = on_done
+        self._finished = False
+
+    def _finish(self) -> None:
+        if not self._finished:
+            self._finished = True
+            if self._on_done is not None:
+                self._on_done()
+
+    def _resolve_sid(self) -> str:
+        if self._sid is None:
+            import ray_tpu
+
+            self._sid = ray_tpu.get(self._sid_ref, timeout=30.0)
+        return self._sid
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+
+        if self._finished:
+            raise StopIteration
+        try:
+            kind, value = ray_tpu.get(
+                self._actor.next_stream.remote(self._resolve_sid()))
+        except BaseException:
+            self._finish()
+            raise
+        if kind == "done":
+            self._finish()
+            raise StopIteration
+        return value
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        from ray_tpu._private import runtime as _rt
+
+        if self._finished:
+            raise StopAsyncIteration
+        try:
+            rt = _rt.get_runtime()
+            if self._sid is None:
+                self._sid = await rt.get_async(self._sid_ref)
+            kind, value = await rt.get_async(
+                self._actor.next_stream.remote(self._sid))
+        except BaseException:
+            self._finish()
+            raise
+        if kind == "done":
+            self._finish()
+            raise StopAsyncIteration
+        return value
+
+    def cancel(self, wait: bool = True) -> None:
+        """Stop early; releases the replica-side iterator.  ``wait=False``
+        fire-and-forgets (used by the GC finalizer, which must never block
+        an event loop or a tearing-down interpreter)."""
+        import ray_tpu
+
+        if self._finished:
+            return
+        try:
+            if self._sid is not None:
+                ref = self._actor.cancel_stream.remote(self._sid)
+                if wait:
+                    ray_tpu.get(ref, timeout=10.0)
+            elif wait:
+                self._actor.cancel_stream.remote(self._resolve_sid())
+        except Exception:
+            pass
+        self._finish()
+
+    def __del__(self):
+        try:
+            self.cancel(wait=False)
+        except Exception:
+            pass
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str,
                  controller_handle=None, method_name: str = "__call__"):
@@ -63,6 +157,7 @@ class DeploymentHandle:
         self._controller = controller_handle
         self._router = None
         self._router_lock = threading.Lock()
+        self._stream = False
 
     @property
     def deployment_id(self) -> str:
@@ -80,7 +175,8 @@ class DeploymentHandle:
                 self._router = Router(controller, self.deployment_id)
             return self._router
 
-    def options(self, *, method_name: Optional[str] = None) -> "DeploymentHandle":
+    def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         # Materialize the router BEFORE sharing: if the child built it, the
         # parent's _router would stay None and a duplicate Router (extra
         # long-poll + metrics threads, split queue accounting) would follow.
@@ -90,11 +186,17 @@ class DeploymentHandle:
                              method_name or self._method_name)
         h._router = self._router
         h._router_lock = self._router_lock
+        h._stream = self._stream if stream is None else bool(stream)
         return h
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
         router = self._get_router()
         method = self._method_name
+        if self._stream:
+            # Streaming (ref: handle.options(stream=True) → a generator of
+            # results): every item is pulled from the pinned replica.
+            actor, sid, done = router.assign_stream(method, *args, **kwargs)
+            return DeploymentResponseGenerator(actor, sid, done)
 
         def assign():
             return router.assign_request(method, *args, **kwargs)
@@ -104,7 +206,8 @@ class DeploymentHandle:
     # pickling: drop the live router; rebuilt lazily on the other side
     def __getstate__(self) -> Dict[str, Any]:
         return {"deployment_name": self.deployment_name,
-                "app_name": self.app_name, "_method_name": self._method_name}
+                "app_name": self.app_name, "_method_name": self._method_name,
+                "_stream": self._stream}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.deployment_name = state["deployment_name"]
@@ -113,6 +216,7 @@ class DeploymentHandle:
         self._controller = None
         self._router = None
         self._router_lock = threading.Lock()
+        self._stream = state.get("_stream", False)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):

@@ -24,20 +24,33 @@ class UserCallableWrapper:
         else:
             self._callable = deployment_def
 
-    async def call(self, method_name: str, args: tuple, kwargs: dict) -> Any:
+    def _target(self, method_name: str):
         if self._is_class:
             if method_name == "__call__":
-                target = self._callable
-            else:
-                target = getattr(self._callable, method_name)
-        else:
-            target = self._callable
-        result = target(*args, **kwargs)
+                return self._callable
+            return getattr(self._callable, method_name)
+        return self._callable
+
+    async def call(self, method_name: str, args: tuple, kwargs: dict) -> Any:
+        result = self._target(method_name)(*args, **kwargs)
         if inspect.isawaitable(result):
             result = await result
         if inspect.isgenerator(result):  # unary endpoint: drain to a list
             result = list(result)
         return result
+
+    async def call_streaming(self, method_name: str, args: tuple,
+                             kwargs: dict):
+        """Invoke WITHOUT draining; returns a sync or async iterator
+        (ref: replica.py streaming via Ray streaming generators)."""
+        result = self._target(method_name)(*args, **kwargs)
+        if inspect.isawaitable(result):
+            result = await result
+        if inspect.isgenerator(result) or hasattr(result, "__anext__"):
+            return result
+        raise TypeError(
+            f"streaming call to {method_name!r} did not return a generator "
+            f"(got {type(result).__name__}); use a non-streaming handle")
 
     async def call_reconfigure(self, user_config: Any) -> None:
         if self._is_class and hasattr(self._callable, "reconfigure"):
@@ -69,6 +82,7 @@ class ReplicaActor:
         self._num_processed = 0
         self._user_config = user_config
         self._multiplexed_model_ids: list = []
+        self._streams: Dict[str, Any] = {}
 
     async def initialize_and_get_metadata(self) -> Dict[str, Any]:
         if self._user_config is not None:
@@ -88,6 +102,100 @@ class ReplicaActor:
         finally:
             self._num_ongoing -= 1
             self._num_processed += 1
+
+    # ---------------------------------------------------------- streaming
+    # Pull protocol (ref: serve streaming responses over Ray streaming
+    # generators).  The actor-streaming path is a push model the async
+    # replica cannot host, so the router/handle PULLS items one actor call
+    # at a time — natural backpressure, same ordering guarantees.
+
+    #: Streams idle past this are reaped (client died without cancel — a
+    #: kill -9'd remote driver would otherwise pin _num_ongoing forever).
+    STREAM_IDLE_TIMEOUT_S = 300.0
+
+    def _set_replica_context(self) -> None:
+        from ray_tpu.serve import context as serve_context
+
+        serve_context._set_internal_replica_context(
+            deployment=self.deployment_name, replica_id=self.replica_id,
+            replica=self)
+
+    def _register_stream(self, it) -> str:
+        import uuid as _uuid
+
+        self._reap_idle_streams()
+        sid = _uuid.uuid4().hex[:16]
+        self._streams[sid] = [it, time.time()]
+        self._num_ongoing += 1
+        return sid
+
+    def _reap_idle_streams(self) -> None:
+        now = time.time()
+        for sid, (it, last) in list(self._streams.items()):
+            if now - last > self.STREAM_IDLE_TIMEOUT_S:
+                self._end_stream(sid)
+
+    async def start_stream(self, method_name: str, *args, **kwargs) -> str:
+        self._set_replica_context()
+        it = await self._wrapper.call_streaming(method_name, args, kwargs)
+        return self._register_stream(it)
+
+    async def next_stream(self, stream_id: str):
+        """("item", value) or ("done", None); exceptions propagate and end
+        the stream.  The replica context is (re)set per pull — the
+        generator BODY executes during pulls, in a different task than
+        start_stream's."""
+        entry = self._streams.get(stream_id)
+        if entry is None:
+            raise ValueError(f"unknown or finished stream {stream_id}")
+        entry[1] = time.time()
+        it = entry[0]
+        self._set_replica_context()
+        try:
+            if hasattr(it, "__anext__"):
+                try:
+                    return ("item", await it.__anext__())
+                except StopAsyncIteration:
+                    self._end_stream(stream_id)
+                    return ("done", None)
+            try:
+                return ("item", next(it))
+            except StopIteration:
+                self._end_stream(stream_id)
+                return ("done", None)
+        except Exception:
+            self._end_stream(stream_id)
+            raise
+
+    def cancel_stream(self, stream_id: str) -> None:
+        self._end_stream(stream_id)
+
+    def _end_stream(self, stream_id: str) -> None:
+        entry = self._streams.pop(stream_id, None)
+        if entry is None:
+            return
+        it = entry[0]
+        self._num_ongoing -= 1
+        self._num_processed += 1
+        if hasattr(it, "aclose"):
+            # Async generators clean up via aclose(); schedule it on the
+            # running loop when there is one (async tier), else best-effort.
+            try:
+                import asyncio as _aio
+
+                try:
+                    _aio.get_running_loop().create_task(it.aclose())
+                except RuntimeError:  # no running loop (sync tier)
+                    _aio.run(it.aclose())
+            except Exception:
+                pass
+            return
+        close = getattr(it, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
 
     # ------------------------------------------------------------ control
     def get_num_ongoing_requests(self) -> int:
@@ -150,6 +258,34 @@ class SyncReplicaActor(ReplicaActor):
         finally:
             self._num_ongoing -= 1
             self._num_processed += 1
+
+    def start_stream(self, method_name: str, *args, **kwargs) -> str:
+        import inspect as _inspect
+
+        self._set_replica_context()
+        result = self._wrapper._target(method_name)(*args, **kwargs)
+        if not _inspect.isgenerator(result):
+            raise TypeError(
+                "process-tier replicas stream SYNC generators only (an "
+                "async generator cannot resume across the per-call event "
+                "loops); use a thread-tier replica for async streaming")
+        return self._register_stream(result)
+
+    def next_stream(self, stream_id: str):
+        entry = self._streams.get(stream_id)
+        if entry is None:
+            raise ValueError(f"unknown or finished stream {stream_id}")
+        entry[1] = time.time()
+        self._set_replica_context()
+        try:
+            try:
+                return ("item", next(entry[0]))
+            except StopIteration:
+                self._end_stream(stream_id)
+                return ("done", None)
+        except Exception:
+            self._end_stream(stream_id)
+            raise
 
     def reconfigure(self, user_config: Any) -> None:
         self._user_config = user_config

@@ -124,11 +124,11 @@ class Router:
             except Exception:
                 pass
 
-    def assign_request(self, method_name: str, *args, **kwargs):
-        """Pick a replica and dispatch; returns the ObjectRef
-        (ref: Router.assign_request).  Replicas that turn out dead at
-        dispatch (rolling update raced the long-poll) are dropped locally
-        and the request re-assigned."""
+    def _dispatch(self, send):
+        """Shared choose-replica/retry core (ref: Router.assign_request):
+        replicas dead at dispatch (rolling update raced the long-poll) are
+        dropped locally and the request re-assigned.  ``send(replica)``
+        performs the actual (non-blocking) submit and returns its result."""
         from ray_tpu.exceptions import ActorDiedError
 
         deadline = time.time() + 30.0
@@ -142,22 +142,40 @@ class Router:
                 continue
             rid = replica["replica_id"]
             try:
-                ref = replica["actor"].handle_request.remote(
-                    method_name, *args, **kwargs)
+                out = send(replica)
             except ActorDiedError:
                 if not self._scheduler.drop_replica(rid):
                     self._replicas_populated.clear()
                 if time.time() > deadline:
                     raise
                 continue
-            break
-        self._scheduler.on_request_sent(rid)
+            self._scheduler.on_request_sent(rid)
+            return replica, rid, out
+
+    def assign_request(self, method_name: str, *args, **kwargs):
+        """Pick a replica and dispatch; returns the ObjectRef."""
+        _, rid, ref = self._dispatch(
+            lambda r: r["actor"].handle_request.remote(
+                method_name, *args, **kwargs))
         # Decrement the local queue estimate when the reply lands.
         from ray_tpu._private import runtime as _rt
 
         fut = _rt.get_runtime().as_future(ref)
         fut.add_done_callback(lambda _f: self._scheduler.on_request_done(rid))
         return ref
+
+    def assign_stream(self, method_name: str, *args, **kwargs):
+        """Streaming dispatch: open a pull stream on one replica; returns
+        (replica_actor, stream_id_REF, done_callback).  Non-blocking — the
+        stream id resolves at the first pull, so calling from inside an
+        async replica never stalls its event loop.  All pulls stay pinned
+        to the opening replica (a streaming response is served end-to-end
+        by one replica)."""
+        replica, rid, sid_ref = self._dispatch(
+            lambda r: r["actor"].start_stream.remote(
+                method_name, *args, **kwargs))
+        done = lambda: self._scheduler.on_request_done(rid)
+        return replica["actor"], sid_ref, done
 
     def stop(self) -> None:
         self._stopped.set()

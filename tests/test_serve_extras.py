@@ -87,3 +87,72 @@ def test_grpc_ingress_end_to_end(serve_instance):
         upper(b"x", metadata=(("application", "nope"),))
     assert e.value.code() == grpc.StatusCode.NOT_FOUND
     channel.close()
+
+
+# ----------------------------------------------------------- streaming
+def test_streaming_handle_sync_and_async_generators(serve_instance):
+    @serve.deployment
+    class Streamer:
+        def tokens(self, n):
+            for i in range(n):
+                yield f"tok{i}"
+
+        async def atokens(self, n):
+            import asyncio
+
+            for i in range(n):
+                await asyncio.sleep(0.001)
+                yield i * 10
+
+    handle = serve.run(Streamer.bind(), name="streamer", route_prefix=None)
+    out = list(handle.options(method_name="tokens", stream=True).remote(4))
+    assert out == ["tok0", "tok1", "tok2", "tok3"]
+
+    out2 = list(handle.options(method_name="atokens", stream=True).remote(3))
+    assert out2 == [0, 10, 20]
+
+
+def test_streaming_cancel_and_errors(serve_instance):
+    @serve.deployment
+    class Faulty:
+        def boom(self, n):
+            yield "ok"
+            raise RuntimeError("mid-stream failure")
+
+        def endless(self):
+            i = 0
+            while True:
+                yield i
+                i += 1
+
+    handle = serve.run(Faulty.bind(), name="faulty", route_prefix=None)
+    gen = handle.options(method_name="boom", stream=True).remote(1)
+    assert next(gen) == "ok"
+    with pytest.raises(Exception) as ei:
+        next(gen)
+    assert "mid-stream failure" in str(ei.value)
+
+    gen2 = handle.options(method_name="endless", stream=True).remote()
+    assert next(gen2) == 0
+    assert next(gen2) == 1
+    gen2.cancel()  # early termination must release the replica-side stream
+    with pytest.raises(StopIteration):
+        next(gen2)
+
+
+def test_streaming_process_tier_replica(serve_instance):
+    @serve.deployment(ray_actor_options={"isolation": "process"})
+    class ProcStreamer:
+        def count(self, n):
+            import os
+
+            for i in range(n):
+                yield {"i": i, "pid": os.getpid()}
+
+    handle = serve.run(ProcStreamer.bind(), name="proc_stream",
+                       route_prefix=None)
+    items = list(handle.options(method_name="count", stream=True).remote(3))
+    assert [it["i"] for it in items] == [0, 1, 2]
+    import os as _os
+
+    assert items[0]["pid"] != _os.getpid()
