@@ -1,3 +1,4 @@
+from ray_tpu.rl.algorithms.appo import APPO, APPOConfig, APPOLearner
 from ray_tpu.rl.algorithms.bc import BC, BCConfig, BCLearner
 from ray_tpu.rl.algorithms.cql import CQL, CQLConfig, CQLLearner
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, DQNLearner
@@ -5,7 +6,8 @@ from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig, IMPALALearner
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig, PPOLearner
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig, SACLearner
 
-__all__ = ["PPO", "PPOConfig", "PPOLearner", "DQN", "DQNConfig", "DQNLearner",
+__all__ = ["APPO", "APPOConfig", "APPOLearner",
+           "PPO", "PPOConfig", "PPOLearner", "DQN", "DQNConfig", "DQNLearner",
            "IMPALA", "IMPALAConfig", "IMPALALearner",
            "SAC", "SACConfig", "SACLearner", "BC", "BCConfig", "BCLearner",
            "CQL", "CQLConfig", "CQLLearner"]

@@ -1,0 +1,91 @@
+"""APPO — asynchronous PPO: IMPALA's actor-learner architecture with the
+PPO clipped surrogate over V-trace-corrected advantages.
+
+(ref: rllib/algorithms/appo/appo.py APPOConfig/APPO — 'asynchronous variant
+of PPO based on the IMPALA architecture'; loss in
+rllib/algorithms/appo/torch/appo_torch_learner.py — clipped surrogate with
+importance ratios against the behavior policy, V-trace value targets,
+periodic target-network refresh.)
+
+Inherits IMPALA's async sampling loop, fragment batching, and V-trace
+machinery wholesale; only the loss differs.  The target-network refresh is
+modeled by the broadcast_interval weight sync (the behavior policy IS the
+last-broadcast snapshot, which is what the ratio clips against).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+
+from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig, IMPALALearner
+from ray_tpu.rl.core.rl_module import Columns
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or APPO)
+        self.clip_param = 0.4  # ref: appo.py default clip
+        self.use_kl_loss = False
+        self.kl_coeff = 1.0
+        self.kl_target = 0.01
+
+
+class APPOLearner(IMPALALearner):
+    def compute_loss(self, params, batch: Dict[str, Any], key) -> Tuple[Any, Dict]:
+        cfg = self.config
+        (dist, inputs, target_logp, values, mask, denom, vs, pg_adv) = \
+            self._vtrace_terms(params, batch)
+
+        # PPO clipped surrogate with ratios against the BEHAVIOR policy
+        # (the last broadcast snapshot) — ref: appo_torch_learner.py.
+        ratio = jnp.exp(target_logp - batch[Columns.ACTION_LOGP])
+        surrogate = jnp.minimum(
+            pg_adv * ratio,
+            pg_adv * jnp.clip(ratio, 1 - cfg.clip_param, 1 + cfg.clip_param))
+        policy_loss = -jnp.sum(surrogate * mask) / denom
+        value_loss = 0.5 * jnp.sum(jnp.square(values - vs) * mask) / denom
+        entropy = jnp.sum(dist.entropy(inputs) * mask) / denom
+        total = (policy_loss + cfg.vf_loss_coeff * value_loss
+                 - cfg.entropy_coeff * entropy)
+        metrics = {"policy_loss": policy_loss, "vf_loss": value_loss,
+                   "entropy": entropy,
+                   "mean_ratio": jnp.sum(ratio * mask) / denom}
+        if cfg.use_kl_loss:
+            kl = jnp.sum((batch[Columns.ACTION_LOGP] - target_logp) * mask) / denom
+            # ADAPTIVE coefficient rides the batch as a 0-d array (no
+            # recompile); APPO._augment_batch injects + _after_learn adapts
+            # toward kl_target (ref: appo.py after_train_step).
+            kl_coeff = batch.get("kl_coeff", jnp.float32(cfg.kl_coeff))
+            total = total + kl_coeff * jnp.maximum(kl, 0.0)
+            metrics["mean_kl"] = kl
+        return total, metrics
+
+
+class APPO(IMPALA):
+    learner_class = APPOLearner
+    config_class = APPOConfig
+
+    def _augment_batch(self, batch):
+        cfg = self.algo_config
+        if cfg.use_kl_loss:
+            if not hasattr(self, "_kl_coeff"):
+                self._kl_coeff = float(cfg.kl_coeff)
+            import numpy as np
+
+            batch["kl_coeff"] = np.float32(self._kl_coeff)
+        return batch
+
+    def _after_learn(self, results) -> None:
+        """Adaptive KL schedule toward kl_target (ref: appo.py / this
+        repo's PPO: 1.5x when overshooting 2x target, halve under 0.5x)."""
+        cfg = self.algo_config
+        kl = results.get("mean_kl")
+        if not cfg.use_kl_loss or kl is None:
+            return
+        if kl > 2.0 * cfg.kl_target:
+            self._kl_coeff *= 1.5
+        elif kl < 0.5 * cfg.kl_target:
+            self._kl_coeff *= 0.5
+        results["curr_kl_coeff"] = self._kl_coeff

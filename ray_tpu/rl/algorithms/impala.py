@@ -71,7 +71,11 @@ def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
 
 
 class IMPALALearner(JaxLearner):
-    def compute_loss(self, params, batch: Dict[str, Any], key) -> Tuple[Any, Dict]:
+    def _vtrace_terms(self, params, batch: Dict[str, Any]):
+        """Shared V-trace machinery (also the base of APPO's loss): forward
+        pass, masked normalizer, vmapped V-trace over the fragment axis.
+        Padded steps have discount 0 AND masked deltas, so nothing leaks
+        backward through the scan into real steps."""
         cfg = self.config
         out = self.module.forward_train(params, batch[Columns.OBS])
         dist = self.module.action_dist
@@ -80,10 +84,6 @@ class IMPALALearner(JaxLearner):
         values = out[Columns.VF_PREDS]
         mask = batch["mask"]
         denom = jnp.maximum(jnp.sum(mask), 1.0)
-
-        # vmapped over the fragment axis: batch comes in as (B, T, ...).
-        # Padded steps have discount 0 AND masked deltas, so nothing leaks
-        # backward through the scan into real steps.
         vs, pg_adv = jax.vmap(
             lambda blp, tlp, r, v, bv, d, m: vtrace(
                 blp, tlp, r, v, bv, d,
@@ -91,9 +91,13 @@ class IMPALALearner(JaxLearner):
                 cfg.vtrace_clip_pg_rho_threshold, mask=m)
         )(batch[Columns.ACTION_LOGP], target_logp, batch[Columns.REWARDS],
           values, batch["bootstrap_value"], batch["discounts"], mask)
+        return (dist, inputs, target_logp, values, mask, denom,
+                jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv))
 
-        vs = jax.lax.stop_gradient(vs)
-        pg_adv = jax.lax.stop_gradient(pg_adv)
+    def compute_loss(self, params, batch: Dict[str, Any], key) -> Tuple[Any, Dict]:
+        cfg = self.config
+        (dist, inputs, target_logp, values, mask, denom, vs, pg_adv) = \
+            self._vtrace_terms(params, batch)
         policy_loss = -jnp.sum(target_logp * pg_adv * mask) / denom
         value_loss = 0.5 * jnp.sum(jnp.square(values - vs) * mask) / denom
         entropy = jnp.sum(dist.entropy(inputs) * mask) / denom
@@ -208,9 +212,17 @@ class IMPALA(Algorithm):
         bv = np.asarray(self._vf_fn(params, batch.pop("bootstrap_obs")))
         batch["bootstrap_value"] = (bv * (1.0 - batch.pop("bootstrap_terminated"))
                                     ).astype(np.float32)
+        batch = self._augment_batch(batch)  # subclass hook (APPO's kl_coeff)
         results = self.learner_group.update_from_batch(
             batch, num_epochs=cfg.num_epochs)
+        self._after_learn(results)
         self._updates += 1
         if self._updates % cfg.broadcast_interval == 0:
             self.env_runner_group.sync_weights(self.learner_group.get_weights())
         return results
+
+    def _augment_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return batch
+
+    def _after_learn(self, results: Dict[str, Any]) -> None:
+        pass
