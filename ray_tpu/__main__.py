@@ -227,6 +227,13 @@ def cmd_stack(args) -> int:
     return 0
 
 
+def cmd_memory(args) -> int:
+    from ray_tpu._private import heap_profiler
+
+    print(heap_profiler.format_heap(heap_profiler.heap_summary(args.top)))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -277,12 +284,16 @@ def main(argv=None) -> int:
     sub.add_parser("stack", help="dump stacks of driver threads + process "
                                  "workers (ref: `ray stack` / py-spy)")
 
+    mem = sub.add_parser("memory", help="heap profile via tracemalloc "
+                                        "(ref: dashboard memray profiling)")
+    mem.add_argument("--top", type=int, default=20)
+
     args = p.parse_args(argv)
     return {
         "status": cmd_status, "list": cmd_list, "summary": cmd_summary,
         "timeline": cmd_timeline, "metrics": cmd_metrics, "job": cmd_job,
         "logs": cmd_logs, "run": cmd_run, "up": cmd_up, "down": cmd_down,
-        "stack": cmd_stack,
+        "stack": cmd_stack, "memory": cmd_memory,
     }[args.cmd](args)
 
 

@@ -1,0 +1,58 @@
+"""On-demand heap profiling via tracemalloc.
+
+TPU-native analogue of the reference's memray integration (ref:
+python/ray/dashboard/modules/reporter/profile_manager.py — on-demand heap
+profiling of any worker from the dashboard).  memray is not in the image;
+tracemalloc gives allocation-site attribution for the driver process (which
+hosts every thread-tier worker — the tier that matters for heap pressure
+here).  First call starts tracing, so only allocations AFTER that are
+attributed; reported via `ray_tpu memory` and /api/memory.
+"""
+
+from __future__ import annotations
+
+import tracemalloc
+from typing import Dict, List
+
+
+def ensure_tracing(nframes: int = 16) -> bool:
+    """Idempotently start tracemalloc; returns True if it was ALREADY on
+    (i.e. the snapshot below covers a real window, not an empty one)."""
+    if tracemalloc.is_tracing():
+        return True
+    tracemalloc.start(nframes)
+    return False
+
+
+def heap_summary(top_n: int = 20, group_by: str = "lineno") -> Dict:
+    """Top allocation sites since tracing began (ref: memray table view)."""
+    was_tracing = ensure_tracing()
+    current, peak = tracemalloc.get_traced_memory()
+    stats: List[Dict] = []
+    if was_tracing:
+        snapshot = tracemalloc.take_snapshot()
+        for stat in snapshot.statistics(group_by)[:top_n]:
+            frame = stat.traceback[0]
+            stats.append({
+                "site": f"{frame.filename}:{frame.lineno}",
+                "size_bytes": stat.size,
+                "count": stat.count,
+            })
+    return {
+        "tracing_window_open": not was_tracing,
+        "traced_current_bytes": current,
+        "traced_peak_bytes": peak,
+        "top_sites": stats,
+    }
+
+
+def format_heap(summary: Dict) -> str:
+    lines = [f"traced: {summary['traced_current_bytes']/1e6:.1f} MB current, "
+             f"{summary['traced_peak_bytes']/1e6:.1f} MB peak"]
+    if summary["tracing_window_open"]:
+        lines.append("(tracing just started — run again to see allocations "
+                     "made since this call)")
+    for s in summary["top_sites"]:
+        lines.append(f"{s['size_bytes']/1e6:9.2f} MB  {s['count']:8d} allocs  "
+                     f"{s['site']}")
+    return "\n".join(lines)

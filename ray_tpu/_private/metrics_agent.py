@@ -159,6 +159,10 @@ def _api_payload(runtime, path: str):
         from ray_tpu._private import stack_profiler
 
         return stack_profiler.collect_all_stacks()
+    if path == "/api/memory":
+        from ray_tpu._private import heap_profiler
+
+        return heap_profiler.heap_summary()
     if path == "/api/jobs":
         from ray_tpu.job import job_manager as jm_mod
 
