@@ -13,6 +13,17 @@ id drops to zero.  Messages ride the object-transfer TCP protocol
 ordering argument).  The owner's store frees an object only when BOTH its
 local refcount is zero and no borrows remain.
 
+Serialization-time coverage: a ref serialized out-of-band (KV, pubsub,
+actor state) may outlive the sender's last local handle before any receiver
+deserializes it.  To close that window, pickling a remote-owned ref takes a
+**wire pin** on the owner — an ADD_BORROW under a one-shot ``wire:`` id
+carried inside the serialized form — which the receiver releases right
+after registering its own borrow (the reference gets the same guarantee by
+piggybacking borrower reports on task replies, reference_count.h:66).
+Serialized bytes that are dropped without ever being deserialized leak
+their pin until the owner shuts down — the same caveat the reference
+documents for refs stashed in external storage.
+
 Failure notes (documented divergence from the reference's full protocol):
 a borrower that dies without releasing leaks its borrow on the owner until
 the owner runtime shuts down; the reference reclaims via worker-death
@@ -86,23 +97,52 @@ class BorrowClient:
     # ------------------------------------------------------------- transport
     def _send(self, kind: str, oid: ObjectID, addr: str) -> None:
         """Synchronous one-shot exchange; caller holds the lock."""
-        from ray_tpu._private import object_transfer as ot
-
-        try:
-            op = ot.OP_ADD_BORROW if kind == "add" else ot.OP_RELEASE_BORROW
-            sock = ot._request_sock(addr, 2.0)
-            try:
-                bid = self.borrower_id.encode()
-                import struct
-
-                sock.sendall(ot._req_header(op, oid)
-                             + struct.pack("<H", len(bid)) + bid)
-                ot._recv_exact(sock, 1)
-            finally:
-                sock.close()
-        except Exception:
+        if not _send_borrow_op(kind, oid, addr, self.borrower_id):
             # Owner gone or unreachable: nothing to protect anymore.
             self.stats["send_failures"] += 1
+
+
+def _send_borrow_op(kind: str, oid: ObjectID, addr: str,
+                    borrower_id: str, timeout: float = 2.0) -> bool:
+    """One synchronous ADD/RELEASE_BORROW exchange; True on ack."""
+    from ray_tpu._private import object_transfer as ot
+
+    try:
+        op = ot.OP_ADD_BORROW if kind == "add" else ot.OP_RELEASE_BORROW
+        sock = ot._request_sock(addr, timeout)
+        try:
+            bid = borrower_id.encode()
+            import struct
+
+            sock.sendall(ot._req_header(op, oid)
+                         + struct.pack("<H", len(bid)) + bid)
+            ot._recv_exact(sock, 1)
+            return True
+        finally:
+            sock.close()
+    except Exception:
+        return False
+
+
+def pin_for_wire(oid: ObjectID, owner_addr: str) -> str:
+    """Take a one-shot owner-side pin covering a serialized copy in flight.
+
+    Called while the sender still holds a live handle (pickle requires one),
+    so the ADD lands before the sender's own borrow/refcount can release.
+    Returns the pin id to embed in the wire form, or "" if the owner is
+    unreachable (the copy then rides on the sender's handle alone — the
+    pre-fix behavior).
+    """
+    import uuid
+
+    pin = f"wire:{uuid.uuid4().hex[:12]}"
+    return pin if _send_borrow_op("add", oid, owner_addr, pin) else ""
+
+
+def release_wire_pin(oid: ObjectID, owner_addr: str, pin: str) -> None:
+    """Receiver side: drop the wire pin once a real borrow (or the owner's
+    own refcount, when the bytes came home) protects the object."""
+    _send_borrow_op("release", oid, owner_addr, pin)
 
 
 _client: Optional[BorrowClient] = None

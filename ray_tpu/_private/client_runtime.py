@@ -34,7 +34,9 @@ class ClientRuntime:
 
     # ------------------------------------------------------------- transport
     def _call(self, kind: str, *payload) -> Any:
-        req = serialization.dumps((kind, payload))
+        # In-band: the head deserializes while this call blocks, inside the
+        # sender's handle lifetime — wire pins would be pure overhead.
+        req = serialization.dumps_inband((kind, payload))
         with self._lock:
             self._conn.send_bytes(req)
             status, blob = serialization.loads(self._conn.recv_bytes())
@@ -49,7 +51,7 @@ class ClientRuntime:
             raise NotImplementedError(
                 "streaming-generator tasks cannot be submitted from inside a "
                 "process worker yet; submit from the driver")
-        return self._call("submit_task", serialization.dumps(spec))
+        return self._call("submit_task", serialization.dumps_inband(spec))
 
     def submit_actor_task(self, actor_id, spec) -> Any:
         if spec.generator:
@@ -57,29 +59,29 @@ class ClientRuntime:
                 "streaming-generator actor tasks cannot be submitted from "
                 "inside a process worker yet")
         return self._call("submit_actor_task", actor_id,
-                          serialization.dumps(spec))
+                          serialization.dumps_inband(spec))
 
     def create_actor(self, spec) -> None:
-        return self._call("create_actor", serialization.dumps(spec))
+        return self._call("create_actor", serialization.dumps_inband(spec))
 
     def put(self, value: Any, _owner: str = "") -> Any:
-        return self._call("put", serialization.dumps(value))
+        return self._call("put", serialization.dumps_inband(value))
 
     def get(self, refs: Any, timeout: Optional[float] = None) -> Any:
-        return self._call("get", serialization.dumps(refs), timeout)
+        return self._call("get", serialization.dumps_inband(refs), timeout)
 
     def wait(self, refs: Sequence, num_returns: int = 1,
              timeout: Optional[float] = None, fetch_local: bool = True):
         refs = list(refs)
         ready_idx, rest_idx = self._call(
-            "wait", serialization.dumps(refs), num_returns, timeout)
+            "wait", serialization.dumps_inband(refs), num_returns, timeout)
         return [refs[i] for i in ready_idx], [refs[i] for i in rest_idx]
 
     def kill_actor(self, actor_id, no_restart: bool = True) -> None:
         return self._call("kill_actor", actor_id, no_restart)
 
     def cancel(self, ref, force: bool = False) -> None:
-        return self._call("cancel", serialization.dumps(ref), force)
+        return self._call("cancel", serialization.dumps_inband(ref), force)
 
     def get_named_actor(self, name: str, namespace: Optional[str] = None):
         return self._call("get_named_actor", name, namespace)
@@ -95,6 +97,12 @@ class ClientRuntime:
 
     def list_task_events(self):
         return self._call("list_task_events")
+
+    def kv_call(self, op: str, *args) -> Any:
+        """Route an internal-KV operation to the head's store so the KV tier
+        is cluster-global, matching the reference's GCS KV (ADVICE r2 —
+        a worker-local store silently diverges from the driver's)."""
+        return self._call("internal_kv", op, *args)
 
     def get_actor_state(self, actor_id):
         # Worker-side callers (ray_tpu.get_actor) need .spec.cls and
@@ -205,6 +213,25 @@ def _handle(runtime, kind: str, payload: tuple) -> Any:
         return runtime.nodes()
     if kind == "list_task_events":
         return runtime.list_task_events()
+    if kind == "internal_kv":
+        # Runs in the head process, where _remote_call() is None, so these
+        # hit the head's real store (no recursion).
+        from ray_tpu.experimental import internal_kv as kv
+
+        op = payload[0]
+        if op == "get":
+            return kv._internal_kv_get(payload[1], namespace=payload[2])
+        if op == "put":
+            return kv._internal_kv_put(payload[1], payload[2],
+                                       overwrite=payload[3],
+                                       namespace=payload[4])
+        if op == "del":
+            return kv._internal_kv_del(payload[1], namespace=payload[2])
+        if op == "exists":
+            return kv._internal_kv_exists(payload[1], namespace=payload[2])
+        if op == "list":
+            return kv._internal_kv_list(payload[1], namespace=payload[2])
+        raise ValueError(f"unknown internal_kv op: {op!r}")
     if kind == "actor_info":
         state = runtime.get_actor_state(payload[0])
         if state is None:

@@ -28,7 +28,8 @@ class ObjectRef:
             _refcounter.add(self.id)
 
     @staticmethod
-    def _deserialize(object_id: str, owner: str, owner_addr: str = "") -> "ObjectRef":
+    def _deserialize(object_id: str, owner: str, owner_addr: str = "",
+                     wire_pin: str = "") -> "ObjectRef":
         ref = ObjectRef(ObjectID(object_id), owner, owner_addr)
         if owner_addr:
             from ray_tpu._private.object_transfer import local_server_addr
@@ -40,7 +41,43 @@ class ObjectRef:
                 from ray_tpu._private.borrowing import global_borrow_client
 
                 global_borrow_client().register(ref.id, owner_addr)
+        if wire_pin and owner_addr:
+            # The sender pinned the owner for this serialized copy; our own
+            # borrow (or, when the bytes came home, the handle just added to
+            # the owner's refcounter) now protects the object, so the pin's
+            # job is done.  Order matters: release only after registration.
+            from ray_tpu._private.borrowing import release_wire_pin
+
+            release_wire_pin(ref.id, owner_addr, wire_pin)
         return ref
+
+    def _wire_tuple(self):
+        """Args for ``_deserialize`` when this ref crosses a process boundary.
+
+        On OUT-OF-BAND pickles (serialization.wire_pins_enabled — KV,
+        pubsub, actor state, user dumps) remote-owned refs take a
+        serialization-time wire pin on the owner so the serialized copy
+        stays valid even if every local handle dies before a receiver
+        materializes it (ADVICE r2: borrow-at-serialization; ref:
+        reference_count.h:66 sender-side borrower reports).  The guarantee
+        is FIRST-materialization: the pin converts into the first reader's
+        borrow; later readers of the same blob are protected by ordinary
+        borrow liveness, exactly like any other handle.  In-band transports
+        (store puts, task args, backchannel request/reply) skip the pin —
+        their lifetime is carried by contained_refs capture or the sender's
+        synchronous receive window.
+        """
+        addr = self._routable_owner_addr()
+        pin = ""
+        if addr:
+            from ray_tpu._private import serialization
+            from ray_tpu._private.object_transfer import local_server_addr
+
+            if addr != local_server_addr() and serialization.wire_pins_enabled():
+                from ray_tpu._private.borrowing import pin_for_wire
+
+                pin = pin_for_wire(self.id, addr)
+        return (str(self.id), self.owner, addr, pin)
 
     def _routable_owner_addr(self) -> str:
         """Owner address to embed when this ref crosses a process boundary.
@@ -75,8 +112,7 @@ class ObjectRef:
         # through plain pickle would leak a negative count and free live
         # objects.  (serialization._Pickler additionally captures the ref
         # for borrow tracking via reducer_override.)
-        return (ObjectRef._deserialize,
-                (str(self.id), self.owner, self._routable_owner_addr()))
+        return (ObjectRef._deserialize, self._wire_tuple())
 
     def hex(self) -> str:
         return self.id.hex()

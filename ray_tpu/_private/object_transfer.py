@@ -142,12 +142,14 @@ class ObjectTransferServer:
                  is_pending: Optional[Callable[[ObjectID], bool]] = None,
                  on_borrow: Optional[Callable[[ObjectID, str], None]] = None,
                  on_borrow_release: Optional[Callable[[ObjectID, str], None]] = None,
+                 may_free: Optional[Callable[[ObjectID], bool]] = None,
                  host: str = "127.0.0.1", port: int = 0):
         self._store_provider = store_provider
         self._on_received = on_received
         self._is_pending = is_pending
         self._on_borrow = on_borrow
         self._on_borrow_release = on_borrow_release
+        self._may_free = may_free
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -198,8 +200,12 @@ class ObjectTransferServer:
                 elif op == OP_PUSH:
                     self._handle_push(conn, oid)
                 elif op == OP_FREE:
+                    # OP_FREE means "drop a CACHED copy" — it must never
+                    # evict a primary copy with live references or borrowers
+                    # (ADVICE r2): the node owner decides via may_free.
                     store = self._store_provider()
-                    if store is not None:
+                    if store is not None and (
+                            self._may_free is None or self._may_free(oid)):
                         store.free(oid)
                     conn.sendall(bytes([ST_OK]))
                 elif op in (OP_ADD_BORROW, OP_RELEASE_BORROW):

@@ -280,7 +280,12 @@ class Runtime:
         # address; the pull manager fetches remote-owned refs on demand.
         self.object_server = None
         self._pull_mgr = None
-        self._borrows = None  # owner-side BorrowLedger (lazy)
+        # Owner-side BorrowLedger — built eagerly: three threads (object
+        # server ADD/RELEASE/FREE handlers) race to touch it, and a lazy
+        # check-then-create could lose a concurrent borrow registration.
+        from ray_tpu._private.borrowing import BorrowLedger
+
+        self._borrows = BorrowLedger()
 
         # OOM defense over busy process workers (ref: memory_monitor.h:52).
         self._leased_workers: Dict[int, "_LeasedWorker"] = {}
@@ -432,6 +437,9 @@ class Runtime:
                 is_pending=self._object_is_pending,
                 on_borrow=self._on_remote_borrow,
                 on_borrow_release=self._on_remote_borrow_release,
+                may_free=lambda oid: (
+                    self.refcounter.count(oid) == 0
+                    and not self._borrow_ledger().is_borrowed(oid)),
                 host=self.config.object_transfer_host)
         self._pull_manager()  # pulls and serves share a lifetime
         return self.object_server.addr
@@ -440,10 +448,6 @@ class Runtime:
     # refcount hitting zero until every borrower releases
     # (ref: reference_count.h:66 borrower bookkeeping).
     def _borrow_ledger(self):
-        from ray_tpu._private.borrowing import BorrowLedger
-
-        if self._borrows is None:
-            self._borrows = BorrowLedger()
         return self._borrows
 
     def _on_remote_borrow(self, object_id: ObjectID, borrower: str) -> None:
@@ -526,6 +530,15 @@ class Runtime:
         return values[0] if single else values
 
     def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
+        # One deadline governs the whole get: the remote pull and the store
+        # wait share it, so get(timeout=T) blocks at most ~T, not 2T
+        # (ADVICE r2: the pull used to consume T and the store wait T again).
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        def _remaining() -> Optional[float]:
+            return None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+
         if not self.store.contains(ref.id):
             addr = self._remote_owner_addr(ref)
             if addr:
@@ -542,13 +555,13 @@ class Runtime:
                 if spec is not None:
                     self._resubmit(spec)
         try:
-            return self.store.get(ref.id, timeout)
+            return self.store.get(ref.id, _remaining())
         except ObjectLostError:
             spec = self._lineage_for(ref.id)
             if spec is None:
                 raise
             self._resubmit(spec)
-            return self.store.get(ref.id, timeout)
+            return self.store.get(ref.id, _remaining())
 
     async def get_async(self, ref: ObjectRef) -> Any:
         loop = asyncio.get_event_loop()

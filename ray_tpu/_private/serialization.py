@@ -53,6 +53,32 @@ def _capture_ref(ref: Any) -> None:
         refs.append(ref)
 
 
+# --------------------------------------------------------------- wire pins
+# Wire pins (borrowing.pin_for_wire) cost a synchronous TCP round trip to
+# the owner per remote-owned ref, so they are taken ONLY on out-of-band
+# pickles (KV, pubsub, actor state, user dumps) where the serialized copy
+# can outlive the sender's handles.  In-band paths — store puts (the store
+# lock is held while serializing!), task args, and backchannel request/
+# reply, where contained_refs capture or a synchronous receive window
+# already guarantees lifetime — run with pins disabled.
+def wire_pins_enabled() -> bool:
+    return getattr(_THREAD_LOCAL, "wire_pins", True)
+
+
+class no_wire_pins:
+    """Context manager: disable wire-pinning on this thread while pickling
+    through an in-band path whose lifetime is otherwise guaranteed."""
+
+    def __enter__(self):
+        self._prev = getattr(_THREAD_LOCAL, "wire_pins", True)
+        _THREAD_LOCAL.wire_pins = False
+        return self
+
+    def __exit__(self, *exc):
+        _THREAD_LOCAL.wire_pins = self._prev
+        return False
+
+
 class _Pickler(cloudpickle.CloudPickler):
     def reducer_override(self, obj: Any):
         # ObjectRefs serialize as their id + owner; capture for refcounting.
@@ -60,8 +86,9 @@ class _Pickler(cloudpickle.CloudPickler):
 
         if isinstance(obj, ObjectRef):
             _capture_ref(obj)
-            return (ObjectRef._deserialize,
-                    (str(obj.id), obj.owner, obj._routable_owner_addr()))
+            # _wire_tuple pins remote-owned refs on their owner for the
+            # lifetime of this serialized copy (see borrowing.pin_for_wire).
+            return (ObjectRef._deserialize, obj._wire_tuple())
         return super().reducer_override(obj)
 
 
@@ -69,9 +96,10 @@ def serialize(value: Any) -> SerializedObject:
     buffers: List[pickle.PickleBuffer] = []
     _THREAD_LOCAL.captured_refs = []
     try:
-        buf = io.BytesIO()
-        pickler = _Pickler(buf, protocol=5, buffer_callback=buffers.append)
-        pickler.dump(value)
+        with no_wire_pins():  # in-band: contained_refs carry the lifetime
+            buf = io.BytesIO()
+            pickler = _Pickler(buf, protocol=5, buffer_callback=buffers.append)
+            pickler.dump(value)
         return SerializedObject(buf.getvalue(), buffers, list(_THREAD_LOCAL.captured_refs))
     finally:
         _THREAD_LOCAL.captured_refs = None
@@ -100,8 +128,17 @@ def deserialize_flat(flat: memoryview) -> Any:
 
 
 def dumps(value: Any) -> bytes:
-    """One-shot in-band pickle (control messages, function exports)."""
+    """One-shot pickle (control messages, KV/pubsub payloads, function
+    exports).  Out-of-band by default: remote-owned refs take wire pins."""
     return cloudpickle.dumps(value, protocol=5)
+
+
+def dumps_inband(value: Any) -> bytes:
+    """One-shot pickle for request/reply transports where the receiver
+    deserializes synchronously inside the sender's handle lifetime — skips
+    the wire-pin round trips (see wire_pins_enabled)."""
+    with no_wire_pins():
+        return cloudpickle.dumps(value, protocol=5)
 
 
 loads = pickle.loads

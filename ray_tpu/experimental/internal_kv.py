@@ -1,11 +1,15 @@
 """Internal KV API (ref: python/ray/experimental/internal_kv.py —
 _internal_kv_get/put/del/exists/list over the GCS KV tier).
 
-Process-global store, lazily created; persistence is opt-in via
-``RAY_TPU_KV_PERSIST=1`` (or ``_system_config={"kv_persist": True}``), which
-writes a WAL under the session dir so control-plane metadata survives a
-head restart (ref: gcs_kv_manager.h + redis_store_client.h — the
-Redis-backed restartable GCS).
+Scope matches the reference's GCS-backed KV: **cluster-global**.  In the
+driver/head process the store is local (lazily created); inside process
+workers and ray:// drivers every call is routed over the nested-API
+backchannel to the head's store, so all participants read and write the
+same namespace (ref: gcs_kv_manager.h — one KV tier per cluster).
+Persistence is opt-in via ``RAY_TPU_KV_PERSIST=1`` (or
+``_system_config={"kv_persist": True}``), which writes a WAL under the
+session dir so control-plane metadata survives a head restart (ref:
+redis_store_client.h — the Redis-backed restartable GCS).
 """
 
 from __future__ import annotations
@@ -18,6 +22,14 @@ from ray_tpu._private.kv_store import KVStore
 
 _store: Optional[KVStore] = None
 _lock = threading.Lock()
+
+
+def _remote_call():
+    """The head-routing callable when this process is a worker/client
+    (its runtime proxies the nested API), else None (we ARE the head)."""
+    from ray_tpu._private.runtime import runtime_or_none
+
+    return getattr(runtime_or_none(), "kv_call", None)
 
 
 def _get_store() -> KVStore:
@@ -52,6 +64,9 @@ def _internal_kv_initialized() -> bool:
 
 
 def _internal_kv_get(key: Union[str, bytes], *, namespace: str = "") -> Optional[bytes]:
+    call = _remote_call()
+    if call is not None:
+        return call("get", _as_bytes(key), namespace)
     return _get_store().get(_as_bytes(key), namespace=namespace)
 
 
@@ -60,18 +75,30 @@ def _internal_kv_put(key: Union[str, bytes], value: Union[str, bytes],
     """Returns True when the key ALREADY EXISTED (whether or not it was then
     overwritten) — the reference's inverted contract, where GCS Put reports
     added=0 for any existing key."""
+    call = _remote_call()
+    if call is not None:
+        return call("put", _as_bytes(key), _as_bytes(value), overwrite, namespace)
     newly_added = _get_store().put(_as_bytes(key), _as_bytes(value),
                                    overwrite=overwrite, namespace=namespace)
     return not newly_added
 
 
 def _internal_kv_del(key: Union[str, bytes], *, namespace: str = "") -> int:
+    call = _remote_call()
+    if call is not None:
+        return call("del", _as_bytes(key), namespace)
     return _get_store().delete(_as_bytes(key), namespace=namespace)
 
 
 def _internal_kv_exists(key: Union[str, bytes], *, namespace: str = "") -> bool:
+    call = _remote_call()
+    if call is not None:
+        return call("exists", _as_bytes(key), namespace)
     return _get_store().exists(_as_bytes(key), namespace=namespace)
 
 
 def _internal_kv_list(prefix: Union[str, bytes], *, namespace: str = "") -> List[bytes]:
+    call = _remote_call()
+    if call is not None:
+        return call("list", _as_bytes(prefix), namespace)
     return _get_store().keys(_as_bytes(prefix), namespace=namespace)

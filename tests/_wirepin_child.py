@@ -1,0 +1,35 @@
+"""Borrower process for the serialization-time wire-pin test.
+
+Materializes a remote-owned ref (registering a borrow), RE-serializes it —
+which must take a wire pin on the owner — prints the new blob, then drops
+every local handle and shuts down (releasing the borrow).  The serialized
+copy it printed must stay valid purely on the strength of the wire pin.
+"""
+
+import base64
+import gc
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import ray_tpu  # noqa: E402
+from ray_tpu._private import serialization  # noqa: E402
+
+
+def main() -> None:
+    ray_tpu.init()
+    ref = serialization.loads(base64.b64decode(sys.argv[1]))
+    value = ray_tpu.get(ref, timeout=30)
+    blob = base64.b64encode(serialization.dumps(ref)).decode()
+    print(f"BLOB {blob}", flush=True)
+    print(f"GOT {int(value.sum())}", flush=True)
+    del ref
+    gc.collect()
+    ray_tpu.shutdown()  # release_all returns the borrow; the pin stays
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

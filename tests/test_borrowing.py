@@ -88,3 +88,55 @@ def test_local_roundtrip_does_not_borrow():
     client = borrowing._client
     if client is not None:
         assert not client.holds(ref.id)
+
+
+def test_wire_pin_outlives_sender_handles():
+    """ADVICE r2 (medium): a ref RE-serialized by a borrower must stay valid
+    even if both the borrower's handle and the owner's handles die before
+    the serialized copy is deserialized — the serialization-time wire pin
+    carries it across the gap (ref: reference_count.h:66 sender-side
+    borrower reports)."""
+    ray_tpu.init(ignore_reinit_error=True)
+    from ray_tpu._private.runtime import get_runtime
+
+    rt = get_runtime()
+    rt.start_object_server()
+
+    value = np.arange(512, dtype=np.int64)
+    ref = ray_tpu.put(value)
+    blob = base64.b64encode(serialization.dumps(ref)).decode()
+
+    child_path = os.path.join(os.path.dirname(__file__), "_wirepin_child.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, child_path, blob], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    out, err = proc.communicate(timeout=60)
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    assert lines and lines[0].startswith("BLOB "), out + err
+    assert "DONE" in lines[-1], out + err
+    reserialized = base64.b64decode(lines[0].split(" ", 1)[1])
+
+    oid = ref.id
+    # Drop the owner's last handle; the child's borrow is already released
+    # (it exited) — ONLY the wire pin keeps the object alive now.
+    del ref
+    gc.collect()
+    time.sleep(0.3)
+    assert rt.store.contains(oid), \
+        "object freed while a serialized (undeserialized) copy was live"
+
+    # Deserializing the child's blob releases the pin and protects the
+    # object through the fresh local handle.
+    ref2 = serialization.loads(reserialized)
+    assert int(ray_tpu.get(ref2, timeout=10).sum()) == int(value.sum())
+    assert not rt._borrow_ledger().is_borrowed(oid), \
+        "wire pin not released on deserialization"
+
+    del ref2
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline and rt.store.contains(oid):
+        time.sleep(0.1)
+    assert not rt.store.contains(oid), "object leaked after last handle died"

@@ -128,6 +128,20 @@ def test_contains_and_push(owner_node, borrower):
     assert not object_transfer.contains(addr, ref.id)
 
 
+def test_free_remote_refuses_primary_with_live_refs(borrower):
+    """ADVICE r2: OP_FREE drops CACHED copies only — a peer must not be able
+    to evict a primary copy that still has live local references."""
+    from ray_tpu._private.runtime import get_runtime
+
+    rt = get_runtime()
+    addr = rt.start_object_server()
+    ref = ray_tpu.put(np.arange(5))
+    rt.store.get_serialized(ref.id)  # materialize wire form
+    object_transfer.free_remote(addr, ref.id)  # must be refused
+    assert rt.store.contains(ref.id)
+    assert list(ray_tpu.get(ref)) == list(range(5))
+
+
 def test_pull_waits_for_slow_producer(owner_node, borrower):
     # The producing task sleeps past the owner's serve-wait slice, so the
     # borrower sees ST_PENDING and keeps retrying — a long-running producer

@@ -173,3 +173,33 @@ def test_actor_pool_survives_raising_task(ray_start_regular):
         pool.get_next()
     # The raising task returned its actor: the queued task still runs.
     assert pool.get_next() == 20
+
+
+def _kv_from_worker():
+    # Runs INSIDE a process worker: internal KV must hit the HEAD's store
+    # (cluster-global tier), not a silently divergent worker-local one.
+    from ray_tpu.experimental import internal_kv as kv
+
+    kv._internal_kv_put("worker-key", "from-worker", namespace="kvtest")
+    seen = kv._internal_kv_get("driver-key", namespace="kvtest")
+    existed = kv._internal_kv_put("driver-key", "overwrite", namespace="kvtest")
+    keys = sorted(kv._internal_kv_list("", namespace="kvtest"))
+    return seen, existed, keys
+
+
+def test_internal_kv_is_cluster_global(ray_start_regular):
+    """ADVICE r2: worker-side internal_kv routes over the backchannel to the
+    head's store (ref: gcs_kv_manager.h — one KV tier per cluster)."""
+    from ray_tpu.experimental import internal_kv as kv
+
+    kv._internal_kv_put("driver-key", "from-driver", namespace="kvtest")
+    f = ray_tpu.remote(_kv_from_worker).options(isolation="process")
+    seen, existed, keys = ray_tpu.get(f.remote(), timeout=120)
+    assert seen == b"from-driver"
+    assert existed is True  # reference contract: key already existed
+    assert keys == [b"driver-key", b"worker-key"]
+    # And the worker's write is visible back on the driver.
+    assert kv._internal_kv_get("worker-key", namespace="kvtest") == b"from-worker"
+    assert kv._internal_kv_get("driver-key", namespace="kvtest") == b"overwrite"
+    for k in keys:
+        kv._internal_kv_del(k, namespace="kvtest")
