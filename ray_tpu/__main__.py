@@ -234,6 +234,34 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_worker(args) -> int:
+    """Join a head as a worker node and serve dispatches until the head
+    hangs up (ref: `ray start --address=...` joining a cluster).
+
+    ``--host`` is the interface this node's OBJECT SERVER binds and
+    advertises (the address peers pull results from) — it must be
+    reachable from the head and the other nodes; the 127.0.0.1 default
+    only works for single-machine clusters.  The head has the matching
+    knob: RAY_TPU_OBJECT_TRANSFER_HOST + start_node_server(host=...).
+    """
+    import json as _json
+
+    if args.host:
+        import os as _os
+
+        _os.environ["RAY_TPU_OBJECT_TRANSFER_HOST"] = args.host
+    from ray_tpu._private.node_manager import WorkerNode
+
+    resources = _json.loads(args.resources) if args.resources else None
+    labels = dict(kv.split("=", 1) for kv in (args.labels or []))
+    node = WorkerNode(args.address, num_cpus=args.num_cpus,
+                      resources=resources, labels=labels or None,
+                      node_id=args.node_id)
+    print(f"NODE {node.node_id} JOINED {args.address}", flush=True)
+    node.serve_forever()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -288,12 +316,28 @@ def main(argv=None) -> int:
                                         "(ref: dashboard memray profiling)")
     mem.add_argument("--top", type=int, default=20)
 
+    wk = sub.add_parser("worker", help="join a head as a worker node "
+                                       "(ref: ray start --address)")
+    wk.add_argument("--address", required=True, help="head node-manager "
+                                                     "host:port")
+    wk.add_argument("--host", default=None,
+                    help="interface this node's object server binds AND "
+                         "advertises to peers (default 127.0.0.1 — "
+                         "single-machine only; use the host's cluster-"
+                         "reachable address for multi-machine)")
+    wk.add_argument("--num-cpus", type=float, default=None)
+    wk.add_argument("--resources", default=None,
+                    help='JSON dict of custom resources, e.g. \'{"gpu0": 1}\'')
+    wk.add_argument("--labels", nargs="*", default=None,
+                    help="node labels as key=value")
+    wk.add_argument("--node-id", default=None)
+
     args = p.parse_args(argv)
     return {
         "status": cmd_status, "list": cmd_list, "summary": cmd_summary,
         "timeline": cmd_timeline, "metrics": cmd_metrics, "job": cmd_job,
         "logs": cmd_logs, "run": cmd_run, "up": cmd_up, "down": cmd_down,
-        "stack": cmd_stack, "memory": cmd_memory,
+        "stack": cmd_stack, "memory": cmd_memory, "worker": cmd_worker,
     }[args.cmd](args)
 
 

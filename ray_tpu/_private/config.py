@@ -61,6 +61,24 @@ class Config:
     #: the waiting task is failed with ObjectTransferError.
     object_transfer_pull_retries: int = 3
 
+    # --- worker nodes (cross-host execution, ref: node_manager.h:117) ---
+    #: Task returns at or below this size travel inline in the completion
+    #: frame to the head's store; larger returns stay in the producing
+    #: node's store and peers pull them directly
+    #: (ref: max_direct_call_object_size split).
+    direct_return_max_bytes: int = 256 * 1024
+    #: Worker-node heartbeat cadence over the node connection.
+    node_heartbeat_interval_s: float = 2.0
+    #: Head declares a node dead after this long without a frame
+    #: (ref: gcs_health_check_manager.h:45 health-check timeout).
+    node_heartbeat_timeout_s: float = 30.0
+    #: Timeout for a worker node's synchronous control-plane requests to
+    #: the head (named actors, foreign actor calls, cluster KV).
+    node_request_timeout_s: float = 120.0
+    #: How long a dispatching node waits for remote actor creation before
+    #: reporting it dead.
+    actor_create_timeout_s: float = 300.0
+
     # --- scheduling ---
     #: Pack-then-spread crossover used by the hybrid policy
     #: (ref: hybrid_scheduling_policy.h:50 spread_threshold=0.5).

@@ -1,0 +1,553 @@
+"""Cross-host task/actor execution: worker nodes that JOIN a head and
+RECEIVE work.
+
+TPU-native analogue of the reference's raylet node manager + GCS node
+registry (ref: src/ray/raylet/node_manager.h:117 — per-node agent that
+leases workers and executes dispatched tasks; src/ray/gcs/gcs_server/
+gcs_node_manager.h — node registration/death; cluster_task_manager.h:42 —
+spillback to other nodes' resources).  The shapes differ deliberately:
+
+* The HEAD keeps the single global scheduler (one resource ledger, no
+  gossip needed at this scale).  A worker node registers its resources as
+  a REAL scheduler node; the dispatcher, on acquiring a lease on that
+  node, ships the TaskSpec over the node's persistent TCP connection
+  instead of running it in-process.
+* A WORKER NODE is a full local Runtime (store + object server + process
+  pool + actor FSM) minus global scheduling: dispatched specs execute
+  through the ordinary local pipeline (dependency pulls ride the object
+  plane), so generators, process isolation, retries and runtime envs all
+  work on remote nodes for free.
+* RESULTS follow the reference's direct-call split (ref: common/
+  ray_config_def.h max_direct_call_object_size): small returns travel
+  inline in the completion frame and land in the head's store; large
+  returns STAY in the producing node's store — the head records the
+  location, stamps it into refs that cross process boundaries, and peers
+  pull directly from the producer (no head relay).  The producer pins an
+  exported object with a ledger borrow under ``EXPORT_BORROWER`` until the
+  head's refcount for it dies, which releases the pin over the borrow
+  protocol (reusing reference_count.h-style lifetime rules).
+* NODE DEATH (connection loss or missed heartbeats) removes the node,
+  fails its in-flight tasks as retryable worker crashes, restarts its
+  actors elsewhere via the ordinary FSM, and resubmits lineage for
+  objects whose only copy lived there (ref: gcs_health_check_manager.h:45,
+  object_recovery_manager.h:38).
+
+Wire protocol: u32-length-prefixed pickled tuples (the ray:// framing);
+first frame worker->head is ("register", info).  All further frames are
+fire-and-forget messages except ("req", id, kind, payload) — the worker's
+control-plane fallback (named actors, foreign-actor calls, internal KV)
+answered by ("reply", id, ok, blob) through the same nested-API handler
+that powers process workers and ray:// drivers.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.ids import ActorID, NodeID, ObjectID, TaskID
+from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
+
+#: Ledger borrower id under which a node pins results exported to the
+#: cluster; the head releases it when its refcount for the object dies.
+EXPORT_BORROWER = "cluster-head"
+
+
+class _FramedConn:
+    """u32-length-prefixed frames of pickled tuples over one socket, with a
+    write lock so concurrent senders never interleave frames."""
+
+    def __init__(self, sock: socket.socket):
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+        self._rfile = sock.makefile("rb")
+        self._wlock = threading.Lock()
+
+    def send(self, msg: tuple) -> None:
+        data = serialization.dumps_inband(msg)
+        with self._wlock:
+            self._sock.sendall(struct.pack("<I", len(data)) + data)
+
+    def recv(self) -> tuple:
+        header = self._rfile.read(4)
+        if len(header) < 4:
+            raise EOFError("node connection closed")
+        (n,) = struct.unpack("<I", header)
+        data = self._rfile.read(n)
+        if len(data) < n:
+            raise EOFError("node connection closed mid-frame")
+        return serialization.loads(data)
+
+    def close(self) -> None:
+        try:
+            self._rfile.close()
+        except Exception:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ======================================================================
+# Head side
+# ======================================================================
+class RemoteNode:
+    """Head-side record of one joined worker node."""
+
+    def __init__(self, node_id: NodeID, conn: _FramedConn, info: dict):
+        self.node_id = node_id
+        self.conn = conn
+        self.info = info
+        self.object_addr: str = info.get("object_addr", "")
+        self.alive = True
+        #: Loss recovery ran (dispatch-failure, reader EOF and the monitor
+        #: all race to declare a node dead; recovery must run once).
+        self.lost_handled = False
+        self.last_heartbeat = time.monotonic()
+
+
+class NodeManagerServer:
+    """Accepts worker-node registrations; routes dispatches and replies.
+
+    One reader thread per node connection; sends go through the per-conn
+    write lock from whatever thread dispatches.
+    """
+
+    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0):
+        self._runtime = runtime
+        self._listener = socket.create_server((host, port))
+        self.host, self.port = self._listener.getsockname()[:2]
+        self.address = f"{self.host}:{self.port}"
+        self._stop = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="ray_tpu_node_server", daemon=True)
+        self._accept_thread.start()
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, name="ray_tpu_node_monitor", daemon=True)
+        self._monitor_thread.start()
+
+    # --------------------------------------------------------------- accept
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                if self._stop.is_set() or self._listener.fileno() < 0:
+                    return
+                time.sleep(0.02)
+                continue
+            threading.Thread(target=self._serve_node, args=(sock,),
+                             name="ray_tpu_node_conn", daemon=True).start()
+
+    def _serve_node(self, sock: socket.socket) -> None:
+        conn = _FramedConn(sock)
+        node: Optional[RemoteNode] = None
+        try:
+            kind, info = conn.recv()
+            if kind != "register":
+                conn.close()
+                return
+            node_id = NodeID(info["node_id"])
+            node = RemoteNode(node_id, conn, info)
+            # Ack BEFORE the scheduler learns the node: the first dispatch
+            # may race the ack onto the wire, and the worker expects
+            # ("registered", ...) as its first frame.
+            conn.send(("registered", str(self._runtime.head_node_id)))
+            self._runtime._register_remote_node(node, info)
+            while not self._stop.is_set():
+                frame = conn.recv()
+                self._handle_frame(node, frame)
+        except (EOFError, OSError, ConnectionError):
+            pass
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+        finally:
+            conn.close()
+            if node is not None and node.alive:
+                self._runtime._declare_node_lost(node)
+
+    # -------------------------------------------------------------- frames
+    def _handle_frame(self, node: RemoteNode, frame: tuple) -> None:
+        kind = frame[0]
+        node.last_heartbeat = time.monotonic()
+        if kind == "heartbeat":
+            return
+        if kind == "task_done":
+            _, task_id, results = frame
+            self._runtime._on_remote_task_done(node, TaskID(task_id), results)
+        elif kind == "task_yield":
+            _, task_id, index, item = frame
+            self._runtime._on_remote_task_yield(node, TaskID(task_id), index, item)
+        elif kind == "actor_ready":
+            self._runtime._on_remote_actor_ready(node, ActorID(frame[1]))
+        elif kind == "actor_dead":
+            err = serialization.loads(frame[2])
+            self._runtime._on_remote_actor_dead(node, ActorID(frame[1]), err)
+        elif kind == "req":
+            # Control-plane fallback: answered by the nested-API handler on
+            # a pool thread (reqs may block, e.g. a get()); the reader
+            # thread must stay free to receive task_done frames.
+            _, msg_id, rkind, payload = frame
+            threading.Thread(
+                target=self._serve_request,
+                args=(node, msg_id, rkind, payload),
+                name="ray_tpu_node_req", daemon=True).start()
+        else:
+            raise ValueError(f"unknown node frame: {kind!r}")
+
+    def _serve_request(self, node: RemoteNode, msg_id: int, kind: str,
+                       payload: tuple) -> None:
+        from ray_tpu._private.client_runtime import _handle
+
+        try:
+            result = _handle(self._runtime, kind, payload)
+            # wire_pins=True: refs in the reply take owner-side pins that
+            # the worker's deserialization converts into real borrows — a
+            # bounded lifetime, unlike parking every reply ref in a
+            # per-node dict forever.
+            sobj = serialization.serialize(result, wire_pins=True)
+            reply = ("reply", msg_id, "ok", sobj.to_bytes())
+        except BaseException as e:  # noqa: BLE001 — errors cross the wire
+            try:
+                blob = serialization.dumps((e, ""))
+            except Exception:
+                blob = serialization.dumps((RuntimeError(repr(e)), ""))
+            reply = ("reply", msg_id, "err", blob)
+        try:
+            node.conn.send(reply)
+        except (OSError, ConnectionError):
+            pass
+
+    # ------------------------------------------------------------- monitor
+    def _monitor_loop(self) -> None:
+        timeout = GLOBAL_CONFIG.node_heartbeat_timeout_s
+        while not self._stop.is_set():
+            time.sleep(min(2.0, timeout / 3))
+            now = time.monotonic()
+            for node in self._runtime._remote_nodes_snapshot():
+                if node.alive and now - node.last_heartbeat > timeout:
+                    # Partitioned or wedged: declare it dead (also closes
+                    # the socket, unwinding the reader thread).
+                    self._runtime._declare_node_lost(node)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+# ======================================================================
+# Worker side
+# ======================================================================
+class WorkerRuntime:
+    """Mixin methods installed on the worker node's local Runtime.
+
+    The worker's Runtime executes dispatched work through the ordinary
+    local pipeline; these fallbacks route CONTROL-PLANE operations the
+    local runtime cannot answer (named actors, calls on actors living
+    elsewhere, cluster KV) to the head over the node connection.  Built as
+    a dynamic subclass so Runtime itself stays head-agnostic.
+    """
+
+    _node: "WorkerNode" = None  # set by WorkerNode after install
+
+    def get_named_actor(self, name: str, namespace: Optional[str] = None):
+        try:
+            return super().get_named_actor(name, namespace)
+        except ValueError:
+            return self._node.head_request("get_named_actor", name, namespace)
+
+    def submit_actor_task(self, actor_id, spec):
+        if actor_id in self._actors:
+            return super().submit_actor_task(actor_id, spec)
+        # Actor lives on another node: the head routes the call.
+        if spec.generator:
+            raise NotImplementedError(
+                "streaming-generator calls on remote-node actors are not "
+                "supported yet; call from the driver")
+        return self._node.head_request(
+            "submit_actor_task", actor_id, serialization.dumps_inband(spec))
+
+    def kill_actor(self, actor_id, no_restart: bool = True) -> None:
+        if actor_id in self._actors:
+            return super().kill_actor(actor_id, no_restart)
+        return self._node.head_request("kill_actor", actor_id, no_restart)
+
+    def get_actor_state(self, actor_id):
+        local = super().get_actor_state(actor_id)
+        if local is not None:
+            return local
+        cls, max_task_retries, state_name = self._node.head_request(
+            "actor_info", actor_id)
+
+        class _Shim:
+            pass
+
+        spec = _Shim()
+        spec.cls = cls
+        spec.max_task_retries = max_task_retries
+        shim = _Shim()
+        shim.spec = spec
+        shim.state = state_name
+        return shim
+
+    def get_named_actor_or_none(self, name, namespace=None):  # pragma: no cover
+        try:
+            return self.get_named_actor(name, namespace)
+        except ValueError:
+            return None
+
+    def kv_call(self, op: str, *args) -> Any:
+        """internal_kv routes here (see experimental/internal_kv.py): the
+        cluster KV tier lives on the head."""
+        return self._node.head_request("internal_kv", op, *args)
+
+
+class WorkerNode:
+    """A worker-node process: joins a head, receives dispatches.
+
+    Entry point: ``ray_tpu worker --address=HOST:PORT`` (see __main__).
+    """
+
+    def __init__(self, address: str, num_cpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 node_id: Optional[str] = None):
+        from ray_tpu._private.runtime import Runtime, install_runtime
+
+        cls = type("WorkerNodeRuntime", (WorkerRuntime, Runtime), {})
+        self.runtime = cls(num_cpus=num_cpus, resources=resources,
+                           labels=labels)
+        self.runtime._node = self
+        install_runtime(self.runtime)
+        self.runtime.start_object_server()
+
+        host, _, port_s = address.rpartition(":")
+        sock = socket.create_connection((host, int(port_s)), timeout=30)
+        sock.settimeout(None)
+        self.conn = _FramedConn(sock)
+        self.node_id = NodeID(node_id) if node_id else NodeID.from_random()
+
+        local = self.runtime.scheduler.get_node(self.runtime.head_node_id)
+        self.conn.send(("register", {
+            "node_id": str(self.node_id),
+            "resources": dict(local.total),
+            "labels": dict(local.labels),
+            "object_addr": self.runtime.object_server.addr,
+            "pid": os.getpid(),
+        }))
+        kind, head_id = self.conn.recv()
+        if kind != "registered":
+            raise ConnectionError(f"head rejected registration: {kind!r}")
+        self.head_node_id = head_id
+
+        self._stop = threading.Event()
+        self._req_lock = threading.Lock()
+        self._req_counter = 0
+        self._pending_reqs: Dict[int, list] = {}
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="ray_tpu_node_hb", daemon=True)
+        self._hb_thread.start()
+
+    # ---------------------------------------------------------------- serve
+    def serve_forever(self) -> None:
+        """Reader loop; returns when the head hangs up or shutdown arrives."""
+        try:
+            while not self._stop.is_set():
+                frame = self.conn.recv()
+                self._handle_frame(frame)
+        except (EOFError, OSError, ConnectionError):
+            pass
+        finally:
+            self.stop()
+
+    def stop(self) -> None:
+        if self._stop.is_set():
+            return
+        self._stop.set()
+        self.conn.close()
+        from ray_tpu._private.runtime import shutdown_runtime
+
+        shutdown_runtime()
+
+    def _heartbeat_loop(self) -> None:
+        interval = GLOBAL_CONFIG.node_heartbeat_interval_s
+        while not self._stop.is_set():
+            time.sleep(interval)
+            try:
+                self.conn.send(("heartbeat",))
+            except (OSError, ConnectionError):
+                return
+
+    # --------------------------------------------------------------- frames
+    def _handle_frame(self, frame: tuple) -> None:
+        kind = frame[0]
+        if kind == "task":
+            spec = serialization.loads(frame[1])
+            spec.strategy = None  # head already placed it on this node
+            threading.Thread(target=self._run_dispatched, args=(spec,),
+                             name="ray_tpu_node_task", daemon=True).start()
+        elif kind == "actor_create":
+            spec = serialization.loads(frame[1])
+            spec.strategy = None
+            threading.Thread(target=self._create_actor, args=(spec,),
+                             name="ray_tpu_node_actor", daemon=True).start()
+        elif kind == "actor_task":
+            actor_id = ActorID(frame[1])
+            spec = serialization.loads(frame[2])
+            threading.Thread(target=self._run_actor_task,
+                             args=(actor_id, spec),
+                             name="ray_tpu_node_atask", daemon=True).start()
+        elif kind == "kill_actor":
+            self.runtime.kill_actor(ActorID(frame[1]), no_restart=frame[2])
+        elif kind == "cancel":
+            task_id = TaskID(frame[1])
+            self.runtime._cancelled.add(task_id)
+            ctx = self.runtime._running.get(task_id)
+            if ctx is not None:
+                ctx.cancelled.set()
+        elif kind == "reply":
+            _, msg_id, ok, blob = frame
+            with self._req_lock:
+                slot = self._pending_reqs.get(msg_id)
+            if slot is not None:
+                slot[1] = (ok, blob)
+                slot[0].set()
+        elif kind == "shutdown":
+            self._stop.set()
+            self.conn.close()
+        else:
+            raise ValueError(f"unknown dispatch frame: {kind!r}")
+
+    # ------------------------------------------------------------- dispatch
+    def _run_dispatched(self, spec) -> None:
+        try:
+            if spec.generator:
+                gen = self.runtime.submit_task(spec)
+                self._stream_generator(spec, gen)
+                return
+            self.runtime.submit_task(spec)
+            self._report_completion(spec)
+        except BaseException as e:  # noqa: BLE001 — submission itself failed
+            self._send_done(spec, [("error", serialization.dumps(e))
+                                   for _ in range(max(spec.num_returns, 1))])
+
+    def _create_actor(self, spec) -> None:
+        try:
+            self.runtime.create_actor(spec)
+            state = self.runtime.get_actor_state(spec.actor_id)
+            ready = state.ready_event.wait(
+                timeout=GLOBAL_CONFIG.actor_create_timeout_s)
+            if state.state == "ALIVE":
+                self.conn.send(("actor_ready", str(spec.actor_id)))
+            else:
+                if not ready:
+                    # Timed out while __init__ still runs: kill locally so
+                    # a late-finishing instance cannot linger as an orphan
+                    # holding this node's resources after the head already
+                    # declared the actor dead.
+                    self.runtime.kill_actor(spec.actor_id, no_restart=True)
+                cause = state.death_cause or ActorDiedError(
+                    "creation failed" if ready else
+                    f"creation timed out after "
+                    f"{GLOBAL_CONFIG.actor_create_timeout_s}s")
+                self.conn.send(("actor_dead", str(spec.actor_id),
+                                serialization.dumps(cause)))
+        except BaseException as e:  # noqa: BLE001
+            try:
+                self.conn.send(("actor_dead", str(spec.actor_id),
+                                serialization.dumps(e)))
+            except (OSError, ConnectionError):
+                pass
+
+    def _run_actor_task(self, actor_id: ActorID, spec) -> None:
+        try:
+            if spec.generator:
+                gen = self.runtime.submit_actor_task(actor_id, spec)
+                self._stream_generator(spec, gen)
+                return
+            self.runtime.submit_actor_task(actor_id, spec)
+            self._report_completion(spec)
+        except BaseException as e:  # noqa: BLE001
+            self._send_done(spec, [("error", serialization.dumps(e))
+                                   for _ in range(max(spec.num_returns, 1))])
+
+    # -------------------------------------------------------------- results
+    def _export_result(self, oid: ObjectID) -> tuple:
+        """Inline a small result; pin-and-locate a large one (ref:
+        max_direct_call_object_size split)."""
+        store = self.runtime.store
+        ser = bytes(store.get_serialized(oid))
+        if len(ser) <= GLOBAL_CONFIG.direct_return_max_bytes:
+            return ("inline", ser)
+        # Pin before our transient handles die: the head now owns lifetime;
+        # it releases this borrow when its refcount for the object dies.
+        self.runtime._borrow_ledger().add(oid, EXPORT_BORROWER)
+        return ("stored", self.runtime.object_server.addr)
+
+    def _report_completion(self, spec) -> None:
+        results: List[tuple] = []
+        for i in range(max(spec.num_returns, 1)):
+            oid = ObjectID.for_task_return(spec.task_id, i)
+            try:
+                # Blocks until the local pipeline resolves the object
+                # (success seals it; failure lands an error entry + raises).
+                self.runtime.store.get(oid, None)
+                results.append(self._export_result(oid))
+            except BaseException as e:  # noqa: BLE001
+                results.append(("error", serialization.dumps(e)))
+        self._send_done(spec, results)
+
+    def _stream_generator(self, spec, gen) -> None:
+        index = 0
+        try:
+            for ref in gen:
+                try:
+                    item = self._export_result(ref.id)
+                except BaseException as e:  # noqa: BLE001
+                    item = ("error", serialization.dumps(e))
+                self.conn.send(("task_yield", str(spec.task_id), index, item))
+                index += 1
+            self._send_done(spec, [])
+        except BaseException as e:  # noqa: BLE001 — generator body raised
+            self._send_done(spec, [("error", serialization.dumps(e))])
+
+    def _send_done(self, spec, results: List[tuple]) -> None:
+        try:
+            self.conn.send(("task_done", str(spec.task_id), results))
+        except (OSError, ConnectionError):
+            pass  # head gone; it will reschedule via node-loss handling
+
+    # ----------------------------------------------------- head control path
+    def head_request(self, kind: str, *payload) -> Any:
+        """Synchronous nested-API request to the head (correlation-id
+        multiplexed over the node connection — many may be in flight)."""
+        with self._req_lock:
+            self._req_counter += 1
+            msg_id = self._req_counter
+            slot = [threading.Event(), None]
+            self._pending_reqs[msg_id] = slot
+        try:
+            self.conn.send(("req", msg_id, kind, tuple(payload)))
+            if not slot[0].wait(timeout=GLOBAL_CONFIG.node_request_timeout_s):
+                raise TimeoutError(f"head request {kind!r} timed out")
+        finally:
+            with self._req_lock:
+                self._pending_reqs.pop(msg_id, None)
+        ok, blob = slot[1]
+        if ok == "err":
+            exc, _tb = serialization.loads(blob)
+            raise exc
+        return serialization.deserialize_flat(memoryview(blob))

@@ -73,10 +73,23 @@ class ObjectRef:
             from ray_tpu._private import serialization
             from ray_tpu._private.object_transfer import local_server_addr
 
-            if addr != local_server_addr() and serialization.wire_pins_enabled():
-                from ray_tpu._private.borrowing import pin_for_wire
+            if serialization.wire_pins_enabled():
+                if addr == local_server_addr():
+                    # We ARE the owner: pin via a direct ledger entry (no
+                    # TCP) so the serialized copy survives our own handles
+                    # dying before the receiver registers its borrow.
+                    from ray_tpu._private.runtime import runtime_or_none
 
-                pin = pin_for_wire(self.id, addr)
+                    rt = runtime_or_none()
+                    if rt is not None and hasattr(rt, "_borrow_ledger"):
+                        import uuid
+
+                        pin = f"wire:{uuid.uuid4().hex[:12]}"
+                        rt._borrow_ledger().add(self.id, pin)
+                else:
+                    from ray_tpu._private.borrowing import pin_for_wire
+
+                    pin = pin_for_wire(self.id, addr)
         return (str(self.id), self.owner, addr, pin)
 
     def _routable_owner_addr(self) -> str:
@@ -93,13 +106,20 @@ class ObjectRef:
         if self.owner_addr:
             return self.owner_addr
         from ray_tpu._private.object_transfer import local_server_addr
-
-        addr = local_server_addr()
-        if not addr:
-            return ""
         from ray_tpu._private.runtime import runtime_or_none
 
         rt = runtime_or_none()
+        # A result that STAYED on a worker node: stamp the holder's address
+        # so receivers pull peer-to-peer instead of asking this process
+        # (which only knows the location, not the bytes).
+        locate = getattr(rt, "location_of", None)
+        if locate is not None:
+            loc = locate(self.id)
+            if loc:
+                return loc
+        addr = local_server_addr()
+        if not addr:
+            return ""
         owns = getattr(rt, "owns_object", None)
         if owns is None or not owns(self.id):
             return ""

@@ -70,6 +70,11 @@ _runtime: Optional["Runtime"] = None
 #: Dispatcher wake token: retry the blocked list (see _notify_resources_freed).
 _RETRY_BLOCKED = object()
 
+
+def _noop() -> None:
+    """Stand-in release for dispatches that hold no per-task lease
+    (actor calls ride the actor's standing lease)."""
+
 _task_ctx = threading.local()
 
 
@@ -157,6 +162,9 @@ class _ActorState:
         #: Dedicated process worker hosting the instance when
         #: isolation="process" or a runtime_env is set (see _start_actor).
         self.proc_worker = None
+        #: Worker node hosting the instance when placement landed on a
+        #: joined remote node (None = this process hosts it).
+        self.remote_node: Optional[NodeID] = None
 
 
 class _LeanExecPool:
@@ -293,6 +301,22 @@ class Runtime:
         self._memory_monitor = None
         if self.config.enable_object_transfer:
             self.start_object_server()
+
+        # Cross-host worker nodes (ref: node_manager.h:117): joined nodes,
+        # their in-flight dispatches, and the location table for results
+        # that STAYED in a producing node's store (direct-call split).
+        self.node_server = None
+        self._remote_nodes: Dict[NodeID, Any] = {}
+        self._remote_nodes_lock = threading.Lock()
+        self._remote_inflight: Dict[TaskID, Tuple] = {}
+        self._remote_lock = threading.Lock()
+        self._object_locations: Dict[ObjectID, str] = {}
+        self._locations_lock = threading.Lock()
+        #: Waiters blocked until an object resolves EITHER locally or as a
+        #: remote location (_wait_value_or_location); fired by
+        #: _on_object_ready so the wake is event-driven, not polled.
+        self._ready_events: Dict[ObjectID, threading.Event] = {}
+        self._export_release_q: Optional["queue.SimpleQueue"] = None
 
         # Head node resources.
         from ray_tpu._private.accelerators import detect_accelerators
@@ -499,13 +523,257 @@ class Runtime:
             self._on_object_ready(object_id)
 
     def _remote_owner_addr(self, ref: ObjectRef) -> str:
-        """The address to pull a ref from, or "" if it is locally owned."""
-        addr = getattr(ref, "owner_addr", "")
+        """The address to pull a ref from, or "" if it is locally owned.
+
+        The location table wins over the ref's stamped owner address: it is
+        head-authoritative and survives reconstruction onto a different
+        node, whereas the stamp is frozen at serialization time."""
+        addr = self.location_of(ref.id) or getattr(ref, "owner_addr", "")
         if not addr:
             return ""
         if self.object_server is not None and addr == self.object_server.addr:
             return ""
         return addr
+
+    # ------------------------------------------------------- worker nodes
+    # Head side of cross-host execution (ref: node_manager.h:117,
+    # cluster_task_manager.h:42 spillback, gcs_node_manager.h registration).
+    def start_node_server(self, host: str = "127.0.0.1", port: int = 0) -> str:
+        """Start (idempotently) the head's node-manager service; worker
+        nodes join it via ``ray_tpu worker --address=<returned addr>``."""
+        from ray_tpu._private.node_manager import NodeManagerServer
+
+        if self.node_server is None:
+            self.start_object_server()  # results/args ride the object plane
+            self.node_server = NodeManagerServer(self, host=host, port=port)
+        return self.node_server.address
+
+    def location_of(self, object_id: ObjectID) -> str:
+        """Object-plane address of the node holding a result that stayed
+        remote ("" if unknown/local)."""
+        with self._locations_lock:
+            return self._object_locations.get(object_id, "")
+
+    def _register_remote_node(self, node, info: dict) -> None:
+        resources = dict(info.get("resources") or {})
+        labels = dict(info.get("labels") or {})
+        labels.setdefault("node-ip", node.conn._sock.getpeername()[0]
+                          if hasattr(node.conn, "_sock") else "")
+        with self._remote_nodes_lock:
+            self._remote_nodes[node.node_id] = node
+        self.scheduler.add_node(resources, labels, node_id=node.node_id)
+
+    def _remote_nodes_snapshot(self) -> List:
+        with self._remote_nodes_lock:
+            return list(self._remote_nodes.values())
+
+    def _remote_node(self, node_id: NodeID):
+        with self._remote_nodes_lock:
+            return self._remote_nodes.get(node_id)
+
+    def _dispatch_remote(self, spec: TaskSpec, node_id: NodeID, release) -> None:
+        """Ship a leased task to its node; completion frames finish it."""
+        node = self._remote_node(node_id)
+        if node is None or not node.alive:
+            release()
+            self._handle_task_failure(
+                spec, WorkerCrashedError(f"node {node_id} vanished before dispatch"))
+            return
+        self._emit_event(spec.task_id, spec.name, "SUBMITTED_TO_WORKER",
+                         node_id=str(node_id))
+        with self._remote_lock:
+            self._remote_inflight[spec.task_id] = (spec, release, node_id)
+        try:
+            node.conn.send(("task", serialization.dumps_inband(spec)))
+        except (OSError, ConnectionError):
+            with self._remote_lock:
+                self._remote_inflight.pop(spec.task_id, None)
+            release()
+            # The node is gone: run loss recovery NOW so the retry below
+            # (and every other blocked task) stops leasing its resources.
+            self._declare_node_lost(node)
+            self._handle_task_failure(
+                spec, WorkerCrashedError(f"node {node_id} unreachable"))
+        except BaseException as e:  # noqa: BLE001 — e.g. unpicklable func
+            with self._remote_lock:
+                self._remote_inflight.pop(spec.task_id, None)
+            release()
+            self._fail_task(spec, e, retry=False)
+
+    def _land_remote_result(self, object_id: ObjectID, item: Tuple, node) -> None:
+        kind, payload = item
+        if kind == "inline":
+            if not self.store.contains(object_id):
+                self.store.put_serialized(object_id, payload,
+                                          owner=str(node.node_id))
+        else:  # "stored": primary copy stays on the producer
+            with self._locations_lock:
+                self._object_locations[object_id] = payload
+        self._on_object_ready(object_id)
+
+    def _on_remote_task_done(self, node, task_id: TaskID, results: List[Tuple]) -> None:
+        with self._remote_lock:
+            entry = self._remote_inflight.pop(task_id, None)
+        if entry is None:
+            return  # node-loss handling or cancel already settled it
+        spec, release, _ = entry
+        release()
+        if spec.generator:
+            gen = self._generators.pop(task_id, None)
+            if results and results[0][0] == "error":
+                err = serialization.loads(results[0][1])
+                self._generators[task_id] = gen  # _fail_task pops + finishes
+                self._handle_task_failure(spec, err)
+                return
+            if gen is not None:
+                gen._finish()
+            self._inflight.discard(task_id)
+            self._emit_event(task_id, spec.name, "FINISHED")
+            return
+        errors = [r for r in results if r[0] == "error"]
+        if errors:
+            err = serialization.loads(errors[0][1])
+            self._handle_task_failure(spec, err)
+            return
+        for i, item in enumerate(results):
+            self._land_remote_result(
+                ObjectID.for_task_return(spec.task_id, i), item, node)
+        self._inflight.discard(task_id)
+        self._emit_event(task_id, spec.name, "FINISHED")
+
+    def _on_remote_task_yield(self, node, task_id: TaskID, index: int,
+                              item: Tuple) -> None:
+        object_id = ObjectID.for_task_return(task_id, index)
+        if item[0] == "error":
+            err = serialization.loads(item[1])
+            if not isinstance(err, (TaskError, ObjectLostError)):
+                err = TaskError(err, task_repr=str(task_id))
+            self.store.put_error(object_id, err)
+            self._on_object_ready(object_id)
+        else:
+            self._land_remote_result(object_id, item, node)
+        gen = self._generators.get(task_id)
+        if gen is not None:
+            gen._push(ObjectRef(object_id, owner=str(node.node_id)))
+
+    def _on_remote_actor_ready(self, node, actor_id: ActorID) -> None:
+        state = self._actors.get(actor_id)
+        if state is None:
+            return
+        state.state = _ActorState.ALIVE
+        state.ready_event.set()
+        if not state.threads:
+            self._start_actor_executors(state)
+
+    def _on_remote_actor_dead(self, node, actor_id: ActorID,
+                              err: BaseException) -> None:
+        """The node reports the actor terminally dead (creation failure or
+        its local FSM exhausted restarts) — mirror local death handling."""
+        state = self._actors.get(actor_id)
+        if state is None:
+            return
+        with state.lock:
+            state.remote_node = None
+            if state.release is not None:
+                state.release()
+                state.release = None
+            if not isinstance(err, ActorDiedError):
+                err = ActorDiedError(cause=err)
+            state.death_cause = err
+            state.state = _ActorState.DEAD
+            with self._actors_lock:
+                key = (state.spec.namespace, state.spec.name)
+                if state.spec.name and self._named_actors.get(key) == actor_id:
+                    del self._named_actors[key]
+            for _ in state.threads:
+                state.mailbox.put(None)
+        state.ready_event.set()
+        self._drain_mailbox(state)
+
+    def _declare_node_lost(self, node) -> None:
+        """Idempotent entry to node-death recovery: a failed send, the
+        reader's EOF and the heartbeat monitor all race to report it, but
+        recovery — and especially removing the node from the scheduler so
+        retries stop re-leasing it — must run exactly once, and EARLY (a
+        retry burning its whole budget on a dead-but-still-registered node
+        is the failure mode this guards)."""
+        with self._remote_nodes_lock:
+            if node.lost_handled:
+                return
+            node.lost_handled = True
+        node.alive = False
+        try:
+            node.conn.close()
+        except Exception:
+            pass
+        self._on_node_lost(node)
+
+    def _on_node_lost(self, node) -> None:
+        """Connection loss / missed heartbeats: remove the node, retry its
+        tasks, restart its actors, reconstruct its objects (ref:
+        gcs_health_check_manager.h:45, object_recovery_manager.h:38)."""
+        node_id = node.node_id
+        with self._remote_nodes_lock:
+            self._remote_nodes.pop(node_id, None)
+        self.scheduler.remove_node(node_id)
+
+        with self._remote_lock:
+            lost = [(tid, e) for tid, e in self._remote_inflight.items()
+                    if e[2] == node_id]
+            for tid, _ in lost:
+                del self._remote_inflight[tid]
+        for _tid, (spec, release, _) in lost:
+            release()
+            if spec.actor_id is not None:
+                self._fail_task(spec, ActorDiedError(
+                    f"node {node_id} died mid-call"), retry=False)
+            else:
+                self._handle_task_failure(
+                    spec, WorkerCrashedError(f"node {node_id} died"))
+
+        with self._locations_lock:
+            lost_oids = [oid for oid, addr in self._object_locations.items()
+                         if addr == node.object_addr]
+            for oid in lost_oids:
+                del self._object_locations[oid]
+        for oid in lost_oids:
+            if self.store.contains(oid):
+                continue
+            spec = self._lineage_for(oid)
+            if spec is not None and oid.task_id() not in self._inflight:
+                self._resubmit(spec)
+            elif spec is None:
+                self.store.put_error(oid, ObjectLostError(
+                    f"object {oid} lost with node {node_id}"))
+                self._on_object_ready(oid)
+
+        with self._actors_lock:
+            states = list(self._actors.values())
+        for state in states:
+            if state.remote_node == node_id:
+                state.remote_node = None  # node gone; no kill frame to send
+                self._kill_actor_state(state, ActorDiedError(
+                    f"node {node_id} died"), no_restart=False)
+
+    def _release_export(self, object_id: ObjectID, addr: str) -> None:
+        """Async-release a producer's export pin (we were the last holder).
+        Runs off-thread: this is reached from GC (`__del__`), which must
+        never block on TCP."""
+        if self._export_release_q is None:
+            q: "queue.SimpleQueue" = queue.SimpleQueue()
+
+            def _drain():
+                from ray_tpu._private.borrowing import _send_borrow_op
+                from ray_tpu._private.node_manager import EXPORT_BORROWER
+
+                while True:
+                    oid, a = q.get()
+                    _send_borrow_op("release", oid, a, EXPORT_BORROWER)
+
+            self._export_release_q = q
+            threading.Thread(target=_drain, name="ray_tpu_export_release",
+                             daemon=True).start()
+        self._export_release_q.put((object_id, addr))
 
     # ------------------------------------------------------------------- gets
     def get(self, refs: Any, timeout: Optional[float] = None) -> Any:
@@ -530,38 +798,90 @@ class Runtime:
         return values[0] if single else values
 
     def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
-        # One deadline governs the whole get: the remote pull and the store
-        # wait share it, so get(timeout=T) blocks at most ~T, not 2T
-        # (ADVICE r2: the pull used to consume T and the store wait T again).
+        # One deadline governs the whole get: remote pulls, inflight waits
+        # and the store materialization all share it, so get(timeout=T)
+        # blocks at most ~T, not a multiple (ADVICE r2).
         deadline = None if timeout is None else time.monotonic() + timeout
 
         def _remaining() -> Optional[float]:
             return None if deadline is None \
                 else max(0.0, deadline - time.monotonic())
 
-        if not self.store.contains(ref.id):
+        reconstructs = 0
+        while True:
+            if self.store.contains(ref.id):
+                try:
+                    return self.store.get(ref.id, _remaining())
+                except ObjectLostError:
+                    spec = self._lineage_for(ref.id)
+                    reconstructs += 1
+                    if spec is None or reconstructs > 3:
+                        raise
+                    # Drop the poisoned/freed entry so the loop waits for
+                    # the reconstruction instead of re-reading the error.
+                    self.store.free(ref.id)
+                    self._resubmit(spec)
+                    continue
             addr = self._remote_owner_addr(ref)
             if addr:
-                # Remote-owned: fetch the primary copy from the owner's
-                # object server (ref: pull_manager.h:52).  Raises
-                # ObjectTransferError (an ObjectLostError) on failure.
-                self._pull_manager().pull_blocking(ref.id, addr, timeout)
-            task_id = ref.id.task_id()
-            if task_id not in self._inflight and not addr:
-                # Not in flight and no value: the object was lost (evicted,
-                # freed, or its producing worker died) — reconstruct from
-                # lineage (ref: object_recovery_manager.h:38).
-                spec = self._lineage_for(ref.id)
-                if spec is not None:
+                # Remote copy exists (owner-stamped or location table):
+                # pull it (ref: pull_manager.h:52).  A lost holder falls
+                # back to lineage reconstruction.
+                try:
+                    self._pull_manager().pull_blocking(ref.id, addr, _remaining())
+                except GetTimeoutError:
+                    raise
+                except ObjectLostError:
+                    with self._locations_lock:  # the holder lied or died
+                        self._object_locations.pop(ref.id, None)
+                    if ref.id.task_id() in self._inflight:
+                        # A reconstruction is already running; wait for it.
+                        self._wait_value_or_location(ref.id, _remaining())
+                        continue
+                    spec = self._lineage_for(ref.id)
+                    reconstructs += 1
+                    if spec is None or reconstructs > 3:
+                        raise
                     self._resubmit(spec)
-        try:
-            return self.store.get(ref.id, _remaining())
-        except ObjectLostError:
+                continue
+            task_id = ref.id.task_id()
+            if task_id in self._inflight:
+                # Still computing (here or on a worker node): wait for a
+                # local value/error OR a remote location to appear.
+                self._wait_value_or_location(ref.id, _remaining())
+                continue
+            # Not in flight, no local value, no known copy: lost — try
+            # lineage (ref: object_recovery_manager.h:38).
             spec = self._lineage_for(ref.id)
-            if spec is None:
-                raise
-            self._resubmit(spec)
+            if spec is not None:
+                self._resubmit(spec)
+                continue
             return self.store.get(ref.id, _remaining())
+
+    def _wait_value_or_location(self, object_id: ObjectID,
+                                timeout: Optional[float]) -> None:
+        """Block until the object resolves locally (value/error) or a
+        worker node reports it produced-and-stored (location table).
+        Event-driven: every completion path funnels through
+        _on_object_ready, which fires the registered event."""
+        if self.store.contains(object_id) or self.location_of(object_id):
+            return
+        with self._locations_lock:
+            ev = self._ready_events.get(object_id)
+            if ev is None:
+                ev = self._ready_events[object_id] = threading.Event()
+        try:
+            # Re-check AFTER registering: a completion between the first
+            # check and the registration would otherwise be missed.
+            if self.store.contains(object_id) or self.location_of(object_id):
+                return
+            if not ev.wait(timeout):
+                raise GetTimeoutError(
+                    f"Timed out waiting for object {object_id}")
+        finally:
+            with self._locations_lock:
+                if self._ready_events.get(object_id) is ev and ev.is_set():
+                    del self._ready_events[object_id]
 
     async def get_async(self, ref: ObjectRef) -> Any:
         loop = asyncio.get_event_loop()
@@ -594,10 +914,24 @@ class Runtime:
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[ObjectRef] = []
         pending = list(refs)
+        requested: set = set()
         while len(ready) < num_returns:
             progressed = False
             for r in list(pending):
-                if self.store.contains(r.id):
+                is_ready = self.store.contains(r.id)
+                if not is_ready:
+                    loc = self.location_of(r.id)
+                    if loc:
+                        if fetch_local:
+                            # Produced on a worker node mid-wait: start the
+                            # pull; ready once it lands.
+                            if r.id not in requested:
+                                requested.add(r.id)
+                                self._pull_manager().request(r.id, loc)
+                        else:
+                            # fetch_local=False: existing anywhere counts.
+                            is_ready = True
+                if is_ready:
                     ready.append(r)
                     pending.remove(r)
                     progressed = True
@@ -649,6 +983,12 @@ class Runtime:
         deps = set()
         for a in list(spec.args) + list(spec.kwargs.values()):
             if isinstance(a, ObjectRef) and not self.store.contains(a.id):
+                if self.location_of(a.id):
+                    # Produced, held by a worker node: the EXECUTING side
+                    # pulls it on demand (it may be dispatched right back
+                    # to the holder — prefetching here would drag every
+                    # block through the head).
+                    continue
                 deps.add(a.id)
                 addr = self._remote_owner_addr(a)
                 if addr:
@@ -669,6 +1009,10 @@ class Runtime:
                 self._obj_waiters.setdefault(d, []).append(spec.task_id)
 
     def _on_object_ready(self, object_id: ObjectID) -> None:
+        with self._locations_lock:
+            ev = self._ready_events.pop(object_id, None)
+        if ev is not None:
+            ev.set()
         to_ready = []
         with self._deps_lock:
             for task_id in self._obj_waiters.pop(object_id, []):
@@ -771,6 +1115,12 @@ class Runtime:
             return False
         self.scheduler.clear_task_demand(spec.task_id)
         node_id, release = lease
+        if node_id in self._remote_nodes:
+            # Placed on a joined worker node: ship the spec over its
+            # connection (ref: cluster_task_manager.h spillback — here the
+            # grant itself lands on the remote node's resources).
+            self._dispatch_remote(spec, node_id, release)
+            return True
         self._emit_event(spec.task_id, spec.name, "SUBMITTED_TO_WORKER", node_id=str(node_id))
         try:
             self._exec_pool.submit(self._execute_task, spec, node_id, release)
@@ -826,12 +1176,18 @@ class Runtime:
             self._running.pop(spec.task_id, None)
             reacquire_box["release"]()
 
-    def _resolve_args(self, spec: TaskSpec):
-        def resolve(v):
-            return self.store.get(v.id) if isinstance(v, ObjectRef) else v
+    def _resolve_ref(self, v: Any) -> Any:
+        """Arg materialization shared by task and actor paths: local store
+        hit, else _get_one (object-plane pull + lineage reconstruction)."""
+        if not isinstance(v, ObjectRef):
+            return v
+        if self.store.contains(v.id):
+            return self.store.get(v.id)
+        return self._get_one(v, None)
 
-        args = tuple(resolve(a) for a in spec.args)
-        kwargs = {k: resolve(v) for k, v in spec.kwargs.items()}
+    def _resolve_args(self, spec: TaskSpec):
+        args = tuple(self._resolve_ref(a) for a in spec.args)
+        kwargs = {k: self._resolve_ref(v) for k, v in spec.kwargs.items()}
         return args, kwargs
 
     def _run_in_process(self, spec: TaskSpec, args, kwargs):
@@ -900,7 +1256,10 @@ class Runtime:
         self._inflight.discard(spec.task_id)
 
     def _handle_task_failure(self, spec: TaskSpec, error: BaseException) -> None:
-        is_app_error = not isinstance(error, (WorkerCrashedError, SystemError, MemoryError))
+        # ObjectLostError counts as a system error: a dependency's holder
+        # died; the retry re-waits deps while lineage reconstructs them.
+        is_app_error = not isinstance(
+            error, (WorkerCrashedError, SystemError, MemoryError, ObjectLostError))
         retryable = (not is_app_error) or spec.retry_exceptions
         if isinstance(error, (TaskCancelledError,)):
             retryable = False
@@ -928,6 +1287,16 @@ class Runtime:
     def cancel(self, ref: ObjectRef, force: bool = False) -> None:
         task_id = ref.id.task_id()
         self._cancelled.add(task_id)
+        with self._remote_lock:
+            remote = self._remote_inflight.get(task_id)
+        if remote is not None:
+            node = self._remote_node(remote[2])
+            if node is not None and node.alive:
+                try:
+                    node.conn.send(("cancel", str(task_id), force))
+                except (OSError, ConnectionError):
+                    pass
+            return
         ctx = self._running.get(task_id)
         if ctx is not None:
             ctx.cancelled.set()
@@ -948,6 +1317,12 @@ class Runtime:
             # primary copy until the last RELEASE_BORROW arrives
             # (ref: reference_count.h — borrows keep the object pinned).
             return
+        with self._locations_lock:
+            loc = self._object_locations.pop(object_id, None)
+        if loc:
+            # The last head-side handle died: release the producing node's
+            # export pin so it can free its copy (off-thread — GC path).
+            self._release_export(object_id, loc)
         self.store.free(object_id)
         with self._lineage_lock:
             self._lineage.pop(object_id, None)
@@ -981,6 +1356,9 @@ class Runtime:
             state.ready_event.set()
             return
         state.node_id, state.release = node_id, release
+        if node_id in self._remote_nodes:
+            self._start_remote_actor(state, node_id)
+            return
         use_process = spec.isolation == "process" or bool(
             getattr(spec, "runtime_env", None))
         try:
@@ -1029,13 +1407,84 @@ class Runtime:
         if first or not state.threads:
             self._start_actor_executors(state)
 
-    def _resolve_values(self, args, kwargs):
-        def resolve(v):
-            return self.store.get(v.id) if isinstance(v, ObjectRef) else v
+    def _start_remote_actor(self, state: _ActorState, node_id: NodeID) -> None:
+        """Ship actor creation to a worker node; readiness arrives as an
+        actor_ready/actor_dead frame (ref: gcs_actor_scheduler.h — the GCS
+        leases a remote worker for creation the same way)."""
+        node = self._remote_node(node_id)
+        spec = state.spec
+        if node is None or not node.alive:
+            # Vanished between lease and dispatch: retry the FSM.
+            if state.release is not None:
+                state.release()
+                state.release = None
+            self._kill_actor_state(state, ActorDiedError(
+                f"node {node_id} vanished before actor creation"),
+                no_restart=False)
+            return
+        state.remote_node = node_id
+        try:
+            node.conn.send(("actor_create", serialization.dumps_inband(spec)))
+        except (OSError, ConnectionError):
+            state.remote_node = None
+            if state.release is not None:
+                state.release()
+                state.release = None
+            self._kill_actor_state(state, ActorDiedError(
+                f"node {node_id} unreachable for actor creation"),
+                no_restart=False)
+            return
+        except BaseException as e:  # noqa: BLE001 — unpicklable class/args
+            state.remote_node = None
+            if state.release is not None:
+                state.release()
+                state.release = None
+            state.death_cause = TaskError(e, task_repr=f"{spec.cls.__name__}.__init__")
+            state.state = _ActorState.DEAD
+            state.ready_event.set()
+            self._drain_mailbox(state)
+        # state stays PENDING (or RESTARTING) until the node answers; the
+        # executor loops wait on ready_event before touching the mailbox.
 
-        return tuple(resolve(a) for a in args), {k: resolve(v) for k, v in kwargs.items()}
+    def _forward_actor_task(self, state: _ActorState, spec: TaskSpec) -> None:
+        """Mailbox consumer path for remotely-hosted actors: ship the call;
+        its completion frame lands the results."""
+        node = self._remote_node(state.remote_node) \
+            if state.remote_node is not None else None
+        if node is None or not node.alive:
+            self._fail_task(spec, ActorDiedError(
+                f"actor node {state.remote_node} died"), retry=False)
+            return
+        self._emit_event(spec.task_id, spec.name, "SUBMITTED_TO_WORKER",
+                         node_id=str(node.node_id))
+        with self._remote_lock:
+            self._remote_inflight[spec.task_id] = (spec, _noop, node.node_id)
+        try:
+            node.conn.send(("actor_task", str(spec.actor_id),
+                            serialization.dumps_inband(spec)))
+        except (OSError, ConnectionError):
+            with self._remote_lock:
+                self._remote_inflight.pop(spec.task_id, None)
+            self._declare_node_lost(node)
+            self._fail_task(spec, ActorDiedError(
+                f"actor node {node.node_id} unreachable"), retry=False)
+        except BaseException as e:  # noqa: BLE001
+            with self._remote_lock:
+                self._remote_inflight.pop(spec.task_id, None)
+            self._fail_task(spec, e, retry=False)
+
+    def _resolve_values(self, args, kwargs):
+        return (tuple(self._resolve_ref(a) for a in args),
+                {k: self._resolve_ref(v) for k, v in kwargs.items()})
 
     def _start_actor_executors(self, state: _ActorState) -> None:
+        if state.remote_node is not None:
+            # Remote host: ONE ordered forwarding thread (concurrency is
+            # enforced by the hosting node's own executors).
+            t = threading.Thread(target=self._actor_sync_loop, args=(state,), daemon=True)
+            t.start()
+            state.threads = [t]
+            return
         if state.is_async:
             t = threading.Thread(target=self._actor_async_loop, args=(state,), daemon=True)
             t.start()
@@ -1054,14 +1503,18 @@ class Runtime:
             if item is None:
                 return
             spec: TaskSpec = item
-            if state.state == _ActorState.RESTARTING:
-                # Wait out the restart instead of calling into a torn-down
+            if state.state in (_ActorState.RESTARTING, _ActorState.PENDING):
+                # Wait out a restart / a remote creation still in flight
+                # instead of calling into a torn-down or not-yet-built
                 # instance (ready_event is set on ALIVE or DEAD).
                 state.ready_event.wait(timeout=300)
             if state.state != _ActorState.ALIVE:
                 self._fail_task(spec, ActorDiedError(cause=state.death_cause), retry=False)
                 continue
-            self._execute_actor_task(state, spec)
+            if state.remote_node is not None:
+                self._forward_actor_task(state, spec)
+            else:
+                self._execute_actor_task(state, spec)
 
     def _actor_async_loop(self, state: _ActorState) -> None:
         loop = asyncio.new_event_loop()
@@ -1077,11 +1530,16 @@ class Runtime:
                 item = await loop.run_in_executor(None, state.mailbox.get)
                 if item is None:
                     return
-                if state.state == _ActorState.RESTARTING:
+                if state.state in (_ActorState.RESTARTING, _ActorState.PENDING):
                     await loop.run_in_executor(
                         None, state.ready_event.wait, 300)
                 if state.state != _ActorState.ALIVE:
                     self._fail_task(item, ActorDiedError(cause=state.death_cause), retry=False)
+                    continue
+                if state.remote_node is not None:
+                    # Restart landed on a worker node: forward instead of
+                    # executing against the (gone) local instance.
+                    self._forward_actor_task(state, item)
                     continue
                 loop.create_task(run_one(item))
 
@@ -1213,6 +1671,17 @@ class Runtime:
             if state.proc_worker is not None:
                 self.process_pool.discard(state.proc_worker)
                 state.proc_worker = None
+            if state.remote_node is not None:
+                # Tell the hosting node to tear down its instance (it must
+                # not run its own restart FSM after an explicit head kill);
+                # on node death remote_node was already cleared.
+                node = self._remote_node(state.remote_node)
+                state.remote_node = None
+                if node is not None and node.alive:
+                    try:
+                        node.conn.send(("kill_actor", str(spec.actor_id), True))
+                    except (OSError, ConnectionError):
+                        pass
             if can_restart:
                 state.state = _ActorState.RESTARTING
                 state.num_restarts += 1
@@ -1270,6 +1739,15 @@ class Runtime:
     def shutdown(self) -> None:
         self._dispatcher_stop.set()
         self._ready.put(None)
+        if self.node_server is not None:
+            for node in self._remote_nodes_snapshot():
+                node.alive = False  # suppress node-lost recovery on EOF
+                try:
+                    node.conn.send(("shutdown",))
+                except (OSError, ConnectionError):
+                    pass
+            self.node_server.stop()
+            self.node_server = None
         with self._actors_lock:
             actors = list(self._actors.values())
         for state in actors:

@@ -92,16 +92,22 @@ class _Pickler(cloudpickle.CloudPickler):
         return super().reducer_override(obj)
 
 
-def serialize(value: Any) -> SerializedObject:
+def serialize(value: Any, wire_pins: bool = False) -> SerializedObject:
+    """In-band by default (contained_refs carry the lifetime); pass
+    ``wire_pins=True`` for reply-style transports where the sender drops
+    its handles right after the send and the receiver's deserialization
+    must find the objects still alive."""
     buffers: List[pickle.PickleBuffer] = []
     _THREAD_LOCAL.captured_refs = []
+    prev = getattr(_THREAD_LOCAL, "wire_pins", True)
+    _THREAD_LOCAL.wire_pins = wire_pins
     try:
-        with no_wire_pins():  # in-band: contained_refs carry the lifetime
-            buf = io.BytesIO()
-            pickler = _Pickler(buf, protocol=5, buffer_callback=buffers.append)
-            pickler.dump(value)
+        buf = io.BytesIO()
+        pickler = _Pickler(buf, protocol=5, buffer_callback=buffers.append)
+        pickler.dump(value)
         return SerializedObject(buf.getvalue(), buffers, list(_THREAD_LOCAL.captured_refs))
     finally:
+        _THREAD_LOCAL.wire_pins = prev
         _THREAD_LOCAL.captured_refs = None
 
 

@@ -1,14 +1,25 @@
-"""In-process multi-node cluster simulation for tests
+"""Multi-node cluster harness for tests
 (ref: python/ray/cluster_utils.py — Cluster:135, add_node:202, remove_node:286).
 
-Nodes here are virtual scheduler nodes: scheduling semantics (spread,
-affinity, placement groups, spillback) are exercised for real while execution
-stays on this host — the same single-box multi-node trick the reference's
-test suite is built on.
+Two modes:
+
+* **virtual** (default): nodes are scheduler entries; scheduling semantics
+  (spread, affinity, placement groups, spillback) are exercised for real
+  while execution stays in this process — the single-box multi-node trick
+  the reference's test suite is built on.
+* **real=True**: each node is a separate OS process (`python -m ray_tpu
+  worker --address=...`) that JOINS this process's head over the node
+  manager and RECEIVES dispatched tasks/actors, with results riding the
+  object plane — the reference's `Cluster(add_node)` spawning raylet
+  processes (ref: node_manager.h:117).
 """
 
 from __future__ import annotations
 
+import os
+import subprocess
+import sys
+import time
 from typing import Dict, Optional
 
 import ray_tpu
@@ -18,31 +29,92 @@ from ray_tpu._private.runtime import get_runtime
 
 class Cluster:
     def __init__(self, initialize_head: bool = False,
-                 head_node_args: Optional[dict] = None):
+                 head_node_args: Optional[dict] = None,
+                 real: bool = False):
+        self.real = real
         self.head_node_id: Optional[NodeID] = None
         self._nodes: Dict[NodeID, dict] = {}
+        self._procs: Dict[NodeID, subprocess.Popen] = {}
+        self.node_address: str = ""
         if initialize_head:
             args = dict(head_node_args or {})
             runtime = ray_tpu.init(ignore_reinit_error=True, **args)
             self.head_node_id = runtime.head_node_id
             self._nodes[self.head_node_id] = args
+        if real:
+            self.node_address = get_runtime().start_node_server()
 
     def add_node(self, num_cpus: float = 1, num_tpus: float = 0,
                  resources: Optional[Dict[str, float]] = None,
-                 labels: Optional[Dict[str, str]] = None) -> NodeID:
+                 labels: Optional[Dict[str, str]] = None,
+                 wait: bool = True) -> NodeID:
         runtime = get_runtime()
         node_resources = {"CPU": float(num_cpus)}
         if num_tpus:
             node_resources["TPU"] = float(num_tpus)
         node_resources.update(resources or {})
-        node_id = runtime.scheduler.add_node(node_resources, labels)
+        if not self.real:
+            node_id = runtime.scheduler.add_node(node_resources, labels)
+            self._nodes[node_id] = node_resources
+            return node_id
+
+        if not self.node_address:
+            self.node_address = runtime.start_node_server()
+        node_id = NodeID.from_random()
+        import json
+
+        cmd = [sys.executable, "-m", "ray_tpu", "worker",
+               "--address", self.node_address,
+               "--num-cpus", str(num_cpus),
+               "--resources", json.dumps(
+                   {k: v for k, v in node_resources.items() if k != "CPU"}),
+               "--node-id", str(node_id)]
+        if labels:
+            cmd += ["--labels"] + [f"{k}={v}" for k, v in labels.items()]
+        env = dict(os.environ)
+        # Force CPU in node processes: this harness may run beside a live
+        # single-chip TPU runtime, and a second process grabbing the chip
+        # wedges both (one JAX client owns the chips — see runtime.py).
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True)
+        self._procs[node_id] = proc
         self._nodes[node_id] = node_resources
+        if wait:
+            self.wait_for_node(node_id)
         return node_id
 
-    def remove_node(self, node_id: NodeID) -> None:
-        get_runtime().scheduler.remove_node(node_id)
+    def wait_for_node(self, node_id: NodeID, timeout: float = 60.0) -> None:
+        """Block until the node registered with the head's scheduler."""
+        runtime = get_runtime()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            node = runtime.scheduler.get_node(node_id)
+            if node is not None and node.alive:
+                return
+            proc = self._procs.get(node_id)
+            if proc is not None and proc.poll() is not None:
+                out, err = proc.communicate()
+                raise RuntimeError(
+                    f"worker node {node_id} exited rc={proc.returncode}:\n"
+                    f"{out}\n{err}")
+            time.sleep(0.05)
+        raise TimeoutError(f"node {node_id} did not join within {timeout}s")
+
+    def remove_node(self, node_id: NodeID, allow_graceful: bool = True) -> None:
+        proc = self._procs.pop(node_id, None)
+        if proc is not None:
+            # Real node: kill the OS process; the head notices the dropped
+            # connection and runs node-death recovery (the point of the
+            # chaos tests).
+            proc.kill()
+            proc.wait(timeout=30)
+        else:
+            get_runtime().scheduler.remove_node(node_id)
         self._nodes.pop(node_id, None)
 
     def shutdown(self) -> None:
+        for node_id in list(self._procs):
+            self.remove_node(node_id)
         ray_tpu.shutdown()
         self._nodes.clear()

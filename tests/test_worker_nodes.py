@@ -1,0 +1,246 @@
+"""Cross-host execution tests: real worker-node OS processes joining a head
+and receiving task/actor dispatches (ref: src/ray/raylet/node_manager.h:117,
+gcs_node_manager.h registration, cluster_task_manager.h:42 spillback).
+
+VERDICT r2 item 1 done-criteria: head + 2 worker-node processes, placement
+by resource on specific nodes, object round-trips between nodes, node kill
+with lineage + actor-restart recovery on the survivors.
+
+All functions/classes shipped to nodes are defined INSIDE tests so
+cloudpickle serializes them by value — worker-node processes cannot import
+this test module.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.exceptions import ActorDiedError
+
+
+def _counter_cls():
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.v = start
+
+        def incr(self, by=1):
+            self.v += by
+            return self.v
+
+        def pid(self):
+            return os.getpid()
+
+    return Counter
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    ray_tpu.shutdown()
+    c = Cluster(initialize_head=True, real=True,
+                head_node_args={"num_cpus": 1})
+    a = c.add_node(num_cpus=4, resources={"nodeA": 8.0})
+    b = c.add_node(num_cpus=4, resources={"nodeB": 8.0})
+    yield {"cluster": c, "a": a, "b": b}
+    c.shutdown()
+
+
+def test_tasks_place_on_specific_nodes(cluster):
+    """Resource-targeted tasks really execute in the node processes."""
+
+    def whoami():
+        return os.getpid()
+
+    driver_pid = os.getpid()
+    fa = ray_tpu.remote(whoami).options(resources={"nodeA": 1.0})
+    fb = ray_tpu.remote(whoami).options(resources={"nodeB": 1.0})
+    pid_a = ray_tpu.get(fa.remote(), timeout=60)
+    pid_b = ray_tpu.get(fb.remote(), timeout=60)
+    assert pid_a != driver_pid and pid_b != driver_pid
+    assert pid_a != pid_b
+    assert ray_tpu.get(fa.remote(), timeout=60) == pid_a
+
+
+def test_small_results_inline_large_results_stay_remote(cluster):
+    from ray_tpu._private.runtime import get_runtime
+
+    rt = get_runtime()
+
+    def make(n):
+        return np.ones(n, dtype=np.float64)
+
+    small = ray_tpu.remote(make).options(resources={"nodeA": 1.0}).remote(8)
+    big = ray_tpu.remote(make).options(resources={"nodeA": 1.0}).remote(200_000)
+    assert ray_tpu.get(small, timeout=60).sum() == 8
+    # The big result's primary copy stays on the node; the head records a
+    # location and pulls on demand.
+    deadline = time.time() + 60
+    while time.time() < deadline and not rt.location_of(big.id) \
+            and not rt.store.contains(big.id):
+        time.sleep(0.05)
+    assert rt.location_of(big.id) or rt.store.contains(big.id)
+    assert ray_tpu.get(big, timeout=60).sum() == 200_000
+
+
+def test_objects_roundtrip_between_nodes(cluster):
+    """A big result produced on node A is consumed by a task on node B
+    (direct node-to-node pull, no driver relay of the values)."""
+
+    def make(n):
+        return np.arange(n, dtype=np.int64)
+
+    def consume(arr):
+        return int(arr.sum()), os.getpid()
+
+    ref = ray_tpu.remote(make).options(resources={"nodeA": 1.0}).remote(300_000)
+    total, pid_b = ray_tpu.get(
+        ray_tpu.remote(consume).options(resources={"nodeB": 1.0}).remote(ref),
+        timeout=90)
+    assert total == sum(range(300_000))
+    assert pid_b != os.getpid()
+
+
+def test_driver_put_consumed_on_node(cluster):
+    ref = ray_tpu.put(np.full(50_000, 3.0))
+
+    def consume(arr):
+        return float(arr.sum())
+
+    out = ray_tpu.get(
+        ray_tpu.remote(consume).options(resources={"nodeB": 1.0}).remote(ref),
+        timeout=90)
+    assert out == 150_000.0
+
+
+def test_actor_places_on_node_and_survives_calls(cluster):
+    Counter = _counter_cls()
+    a = Counter.options(resources={"nodeA": 1.0}).remote(100)
+    assert ray_tpu.get(a.incr.remote(), timeout=60) == 101
+    assert ray_tpu.get(a.incr.remote(5), timeout=60) == 106
+    assert ray_tpu.get(a.pid.remote(), timeout=60) != os.getpid()
+    ray_tpu.kill(a)  # release the node's standing lease for later tests
+
+
+def test_named_actor_reachable_from_other_node(cluster):
+    Counter = _counter_cls()
+    a = Counter.options(name="remote-counter",
+                        resources={"nodeA": 1.0}).remote(7)
+    assert ray_tpu.get(a.incr.remote(), timeout=60) == 8
+
+    def poke():
+        # Runs on node B: looks up the actor on node A through the head
+        # and calls it (foreign-actor forwarding).
+        h = ray_tpu.get_actor("remote-counter")
+        return ray_tpu.get(h.incr.remote(10), timeout=60)
+
+    out = ray_tpu.get(
+        ray_tpu.remote(poke).options(resources={"nodeB": 1.0}).remote(),
+        timeout=120)
+    assert out == 18
+
+
+def test_generator_streams_from_node(cluster):
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    g = ray_tpu.remote(gen).options(resources={"nodeB": 1.0}).remote(5)
+    vals = [ray_tpu.get(ref, timeout=60) for ref in g]
+    assert vals == [0, 1, 4, 9, 16]
+
+
+def test_internal_kv_from_worker_node(cluster):
+    from ray_tpu.experimental import internal_kv as kv
+
+    kv._internal_kv_put("nk", "head-value", namespace="nodetest")
+
+    def read():
+        from ray_tpu.experimental import internal_kv as kv2
+
+        return kv2._internal_kv_get("nk", namespace="nodetest")
+
+    out = ray_tpu.get(
+        ray_tpu.remote(read).options(resources={"nodeA": 1.0}).remote(),
+        timeout=60)
+    assert out == b"head-value"
+    kv._internal_kv_del("nk", namespace="nodetest")
+
+
+def test_node_death_task_retry_and_lineage(cluster):
+    """Kill a node holding the only copy of a result: lineage reproduces
+    it on the replacement node on next access."""
+    c = cluster["cluster"]
+    node_c = c.add_node(num_cpus=2, resources={"nodeC": 2.0})
+
+    def make(n):
+        return np.arange(n, dtype=np.int64)
+
+    ref = ray_tpu.remote(make).options(
+        resources={"nodeC": 1.0}, max_retries=3).remote(400_000)
+    from ray_tpu._private.runtime import get_runtime
+
+    rt = get_runtime()
+    deadline = time.time() + 60
+    while time.time() < deadline and not rt.location_of(ref.id):
+        time.sleep(0.05)
+    loc_before = rt.location_of(ref.id)
+    assert loc_before, "expected a located (node-held) result"
+
+    # Replacement capacity FIRST so the post-kill resubmit is feasible.
+    node_c2 = c.add_node(num_cpus=2, resources={"nodeC": 2.0})
+    c.remove_node(node_c)  # SIGKILL the producer's process
+    val = ray_tpu.get(ref, timeout=120)
+    assert int(val.sum()) == sum(range(400_000))
+    c.remove_node(node_c2)
+
+
+def test_node_death_actor_restarts_elsewhere(cluster):
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    c = cluster["cluster"]
+    node_d = c.add_node(num_cpus=2, resources={"nodeD": 2.0})
+    Counter = _counter_cls()
+    a = Counter.options(
+        max_restarts=2,
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            str(node_d), soft=True)).remote(50)
+    assert ray_tpu.get(a.incr.remote(), timeout=60) == 51
+    pid_before = ray_tpu.get(a.pid.remote(), timeout=60)
+    assert pid_before != os.getpid()
+
+    c.remove_node(node_d)
+    # The restart FSM re-places the actor (fresh state — reference
+    # semantics: restarts lose non-checkpointed state).
+    deadline = time.time() + 90
+    value = None
+    while time.time() < deadline:
+        try:
+            value = ray_tpu.get(a.incr.remote(), timeout=30)
+            break
+        except ActorDiedError:
+            time.sleep(0.5)
+    assert value == 51, f"actor did not restart cleanly (got {value})"
+    pid_after = ray_tpu.get(a.pid.remote(), timeout=30)
+    assert pid_after != pid_before
+
+
+def test_node_death_inflight_call_fails(cluster):
+    c = cluster["cluster"]
+    node_e = c.add_node(num_cpus=2, resources={"nodeE": 2.0})
+
+    def slow():
+        time.sleep(300)
+        return "done"
+
+    ref = ray_tpu.remote(slow).options(
+        resources={"nodeE": 1.0}, max_retries=0).remote()
+    time.sleep(1.0)  # let it dispatch
+    c.remove_node(node_e)
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=60)
