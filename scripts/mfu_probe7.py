@@ -1,0 +1,76 @@
+"""Probe 7: fused LM-head CE kernel on the real chip — correctness + timing
+vs the dense bf16-logits path (PERF.md r3).
+
+Usage: python scripts/mfu_probe7.py [--time]
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--time", action="store_true")
+    ap.add_argument("--block-rows", type=int, default=256)
+    args = ap.parse_args()
+
+    from ray_tpu.ops.fused_ce import fused_lm_head_ce
+
+    B, S, D, V = 16, 1024, 768, 50304
+    key = jax.random.PRNGKey(0)
+    kx, kw, kt = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (B, S, D), jnp.bfloat16)
+    w = jax.random.normal(kw, (V, D), jnp.float32) * 0.02
+    t = jax.random.randint(kt, (B, S), 0, 50257)
+
+    def dense(x, w, t):
+        logits = jnp.einsum("bsd,vd->bsv", x, w.astype(x.dtype),
+                            preferred_element_type=jnp.bfloat16)
+        lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        tgt = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+        return jnp.mean(lse - tgt.astype(jnp.float32))
+
+    dense_vg = jax.jit(jax.value_and_grad(dense, argnums=(0, 1)))
+    fused_vg = {}
+    for impl in ("pallas", "xla"):
+        fused_vg[impl] = jax.jit(jax.value_and_grad(
+            lambda a, b, impl=impl: fused_lm_head_ce(
+                a, b, t, block_rows=args.block_rows, bwd_impl=impl),
+            argnums=(0, 1)))
+
+    l0, (dx0, dw0) = dense_vg(x, w, t)
+    print("dense loss", float(l0))
+    for impl, f in fused_vg.items():
+        l1, (dx1, dw1) = f(x, w)
+        print(f"fused[{impl}] loss", float(l1),
+              "dloss", abs(float(l1) - float(l0)),
+              "dx max err", float(jnp.max(jnp.abs(
+                  dx1.astype(jnp.float32) - dx0.astype(jnp.float32)))),
+              "dw max err", float(jnp.max(jnp.abs(
+                  dw1.astype(jnp.float32) - dw0.astype(jnp.float32)))))
+
+    if args.time:
+        def bench(fn, *a, iters=20):
+            fn(*a)  # compile
+            for _ in range(3):
+                out = fn(*a)
+            float(out[0])
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(*a)
+            float(out[0])
+            return (time.perf_counter() - t0) / iters * 1000
+
+        print(f"dense head fwd+bwd: {bench(dense_vg, x, w, t):.2f} ms")
+        for impl, f in fused_vg.items():
+            print(f"fused[{impl}] head fwd+bwd: {bench(f, x, w):.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Equivalence tests for the fused LM-head cross-entropy kernel
+(ops/fused_ce.py) against the dense logsumexp path, fwd + bwd, in pallas
+interpret mode on CPU (the real-TPU numbers live in PERF.md)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.fused_ce import fused_lm_head_ce
+
+
+def _dense_ce(x, wte, targets):
+    logits = jnp.einsum("bsd,vd->bsv", x, wte.astype(x.dtype),
+                        preferred_element_type=jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt)
+
+
+@pytest.mark.parametrize("bwd_impl", ["pallas", "xla"])
+def test_fused_ce_matches_dense_fwd_bwd(bwd_impl):
+    key = jax.random.PRNGKey(0)
+    B, S, D, V = 2, 64, 32, 256
+    kx, kw, kt = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (B, S, D), jnp.float32)
+    w = jax.random.normal(kw, (V, D), jnp.float32) * 0.05
+    t = jax.random.randint(kt, (B, S), 0, V)
+
+    ref_loss, (ref_dx, ref_dw) = jax.value_and_grad(_dense_ce, argnums=(0, 1))(
+        x, w, t)
+    fused_loss, (dx, dw) = jax.value_and_grad(
+        lambda a, b: fused_lm_head_ce(a, b, t, bwd_impl=bwd_impl),
+        argnums=(0, 1))(x, w)
+
+    np.testing.assert_allclose(fused_loss, ref_loss, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(dx, ref_dx, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(dw, ref_dw, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_ce_bf16_close_to_fp32_dense():
+    key = jax.random.PRNGKey(1)
+    B, S, D, V = 2, 32, 64, 512
+    kx, kw, kt = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (B, S, D), jnp.bfloat16)
+    w = (jax.random.normal(kw, (V, D), jnp.float32) * 0.05)
+    t = jax.random.randint(kt, (B, S), 0, V)
+
+    ref = _dense_ce(x.astype(jnp.float32), w, t)
+    fused = fused_lm_head_ce(x, w, t)
+    assert abs(float(fused) - float(ref)) < 0.05
+
+
+def test_fused_ce_under_jit_and_odd_blocks():
+    key = jax.random.PRNGKey(2)
+    B, S, D, V = 1, 24, 16, 96  # deliberately non-power-of-two row count
+    kx, kw, kt = jax.random.split(key, 3)
+    x = jax.random.normal(kx, (B, S, D), jnp.float32)
+    w = jax.random.normal(kw, (V, D), jnp.float32) * 0.1
+    t = jax.random.randint(kt, (B, S), 0, V)
+    f = jax.jit(lambda a, b, c: fused_lm_head_ce(a, b, c))
+    np.testing.assert_allclose(f(x, w, t), _dense_ce(x, w, t),
+                               rtol=1e-5, atol=1e-5)
