@@ -50,7 +50,14 @@ def main() -> None:
     n_dev = len(devices)
     print(f"devices: {devices}", file=sys.stderr)
 
-    config = gpt2.GPTConfig()  # 124M, seq 1024, bf16, flash attn, save_attn remat
+    # 124M, seq 1024, bf16, splash attention.  PERF.md r3:
+    # - remat_policy="attn_outside" keeps the splash kernel's own
+    #   residuals across the backward (save_attn re-ran the splash
+    #   FORWARD inside the bwd, ~11 ms/step);
+    # - scan_layers=False unrolls the 12-layer loop, dropping the scan's
+    #   dynamic-update-slice residual stacking (~10 ms/step) for a longer
+    #   first compile.
+    config = gpt2.GPTConfig(remat_policy="attn_outside", scan_layers=False)
     batch_per_chip = 16
     B = batch_per_chip * n_dev
 

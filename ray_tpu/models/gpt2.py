@@ -59,6 +59,17 @@ class GPTConfig:
     #: Splash-attention kernel tile sizes.
     attn_block_q: int = 512
     attn_block_kv: int = 512
+    #: With remat_policy="attn_outside": also save the (B, S, 4D) MLP
+    #: activation across the post-block checkpoint, skipping the mlp_in
+    #: matmul's backward recompute for ~1.2 GB of activations (B=16).
+    save_mlp_act: bool = False
+    #: False = fully unroll the layer loop (a python loop, O(n_layer)
+    #: compile depth) instead of lax.scan, for ANY remat policy (ignored
+    #: when pp_stages > 1 — the pipeline schedule owns the layer loop).
+    #: Removes the scan's dynamic-update-slice residual stacking
+    #: (~10 ms/step in the r3 trace) at the cost of a longer first
+    #: compile (~33 s vs ~15 s for GPT-2-small).
+    scan_layers: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -206,32 +217,44 @@ def _attention(q, k, v, config: GPTConfig):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _block(x, blk, config: GPTConfig):
-    """One transformer block; x: (B, S, D) in compute dtype."""
+def _block_pre_attn(x, blk, config: GPTConfig):
+    """ln1 + qkv projection (the part BEFORE attention)."""
     from jax.ad_checkpoint import checkpoint_name
 
-    B, S, D = x.shape
-    H, hd = config.n_head, config.head_dim
     dt = config.dtype
-
     h = _layernorm(x, blk["ln1_scale"], blk["ln1_bias"]).astype(dt)
     h = checkpoint_name(h, "ln1_out")
     qkv = h @ blk["qkv_w"].astype(dt) + blk["qkv_b"].astype(dt)
-    qkv = checkpoint_name(qkv, "qkv")
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(B, S, H, hd)
-    k = k.reshape(B, S, H, hd)
-    v = v.reshape(B, S, H, hd)
-    attn = _attention(q, k, v, config).reshape(B, S, D)
-    attn = checkpoint_name(attn, "attn_out")
-    x = x + attn @ blk["out_w"].astype(dt) + blk["out_b"].astype(dt)
+    return checkpoint_name(qkv, "qkv")
 
+
+def _block_post_attn(x, attn, blk, config: GPTConfig):
+    """Residual out-projection + MLP (the part AFTER attention)."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    dt = config.dtype
+    x = x + attn @ blk["out_w"].astype(dt) + blk["out_b"].astype(dt)
     h = _layernorm(x, blk["ln2_scale"], blk["ln2_bias"]).astype(dt)
     h = checkpoint_name(h, "ln2_out")
     h = jax.nn.gelu(h @ blk["mlp_in_w"].astype(dt) + blk["mlp_in_b"].astype(dt))
     h = checkpoint_name(h, "mlp_act")
-    x = x + h @ blk["mlp_out_w"].astype(dt) + blk["mlp_out_b"].astype(dt)
-    return x
+    return x + h @ blk["mlp_out_w"].astype(dt) + blk["mlp_out_b"].astype(dt)
+
+
+def _block(x, blk, config: GPTConfig):
+    """One transformer block (pre-attn half + attention + post-attn half);
+    x: (B, S, D) in compute dtype."""
+    from jax.ad_checkpoint import checkpoint_name
+
+    B, S, D = x.shape
+    H, hd = config.n_head, config.head_dim
+
+    qkv = _block_pre_attn(x, blk, config)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    attn = _attention(q.reshape(B, S, H, hd), k.reshape(B, S, H, hd),
+                      v.reshape(B, S, H, hd), config).reshape(B, S, D)
+    attn = checkpoint_name(attn, "attn_out")
+    return _block_post_attn(x, attn, blk, config)
 
 
 def forward_hidden(params: Dict[str, Any], tokens, config: GPTConfig):
@@ -241,10 +264,77 @@ def forward_hidden(params: Dict[str, Any], tokens, config: GPTConfig):
     x = params["wte"][tokens].astype(dt) + params["wpe"][:S].astype(dt)
 
     block_fn = partial(_block, config=config)
+    if config.save_mlp_act and config.remat_policy != "attn_outside":
+        raise ValueError(
+            "save_mlp_act applies only to remat_policy='attn_outside' "
+            "(use remat_policy='save_attn_mlp' with the scan path)")
+    if config.remat and config.remat_policy == "attn_outside":
+        # Attention OUTSIDE the remat regions: profiling (PERF.md r3 trace)
+        # showed save_attn still re-ran the splash FORWARD in the backward
+        # — saving the attention output does not save the kernel's own
+        # custom-vjp residuals (lse), so the recompute regenerated them
+        # (~10.8 ms/step).  Splitting the block into two checkpointed
+        # halves with attention between them lets jax save q,k,v + lse
+        # (~1.2 GB at B=16) and skip the re-forward entirely.
+        #
+        # Only sound with flash-style attention kernels whose custom-vjp
+        # residuals are VMEM-scale: the plain XLA path would instead save
+        # the full (B, H, S, S) probs per layer for the backward (~5 GB
+        # at the benchmark shape).  "auto" resolves to splash on TPU; on
+        # CPU (tests) the shapes are tiny, so the XLA-path saves are fine.
+        if config.attn_impl == "xla":
+            raise ValueError(
+                "remat_policy='attn_outside' with attn_impl='xla' would "
+                "materialize per-layer (B, H, S, S) probs as saved "
+                "residuals; use a flash-style attn_impl or save_attn")
+        pre = jax.checkpoint(partial(_block_pre_attn, config=config))
+        post_policy = (
+            jax.checkpoint_policies.save_only_these_names("mlp_act")
+            if config.save_mlp_act else None)
+        post = (jax.checkpoint(partial(_block_post_attn, config=config),
+                               policy=post_policy)
+                if post_policy is not None
+                else jax.checkpoint(partial(_block_post_attn, config=config)))
+        H, hd = config.n_head, config.head_dim
+
+        def split_body(carry, blk):
+            x0 = carry
+            qkv = pre(x0, blk)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            Bq, Sq = q.shape[0], q.shape[1]
+            attn = _attention(
+                q.reshape(Bq, Sq, H, hd), k.reshape(Bq, Sq, H, hd),
+                v.reshape(Bq, Sq, H, hd), config).reshape(Bq, Sq, -1)
+            return post(x0, attn, blk), None
+
+        if config.pp_stages > 1:
+            raise ValueError(
+                "remat_policy='attn_outside' does not compose with "
+                "pp_stages>1 yet; use save_attn")
+        if config.scan_layers:
+            x, _ = lax.scan(split_body, x, params["blocks"],
+                            unroll=config.scan_unroll)
+        else:
+            for i in range(config.n_layer):
+                blk_i = jax.tree_util.tree_map(lambda a: a[i],
+                                               params["blocks"])
+                x, _ = split_body(x, blk_i)
+        x = _layernorm(x, params["lnf_scale"], params["lnf_bias"]).astype(dt)
+        return x
     if config.remat:
         policies = {
             "save_attn": lambda: jax.checkpoint_policies.save_only_these_names(
                 "attn_out"),
+            # Intermediate points on the recompute-vs-HBM curve: also save
+            # the qkv projection and/or the mlp activation, skipping their
+            # matmuls' recompute in the backward at ~0.9/1.2 GB of saved
+            # activations (B=16).  Measured on v5e r3 — see PERF.md.
+            "save_attn_qkv": lambda: jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "qkv"),
+            "save_attn_mlp": lambda: jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "mlp_act"),
+            "save_attn_qkv_mlp": lambda: jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "qkv", "mlp_act"),
             # Save every matmul input/output across the boundary: bwd then
             # recomputes only elementwise ops (layernorm/gelu/adds).  ~3 GB
             # of saved activations at B=16 — the compiler-friendly stand-in
@@ -258,13 +348,21 @@ def forward_hidden(params: Dict[str, Any], tokens, config: GPTConfig):
         if config.remat_policy not in policies:
             raise ValueError(
                 f"unknown remat_policy {config.remat_policy!r} "
-                f"(use {sorted(policies)})")
+                f"(use {sorted(policies) + ['attn_outside']})")
         policy = policies[config.remat_policy]()
         block_fn = (jax.checkpoint(block_fn, policy=policy) if policy is not None
                     else jax.checkpoint(block_fn))
 
     def scan_body(carry, blk):
         return block_fn(carry, blk), None
+
+    if not config.scan_layers and config.pp_stages == 1:
+        # Unrolled layer loop for any remat policy (see scan_layers doc).
+        for i in range(config.n_layer):
+            blk_i = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
+            x, _ = scan_body(x, blk_i)
+        x = _layernorm(x, params["lnf_scale"], params["lnf_bias"]).astype(dt)
+        return x
 
     if config.pp_stages > 1:
         # GPipe over the `pipe` mesh axis: each stage scans its local slice

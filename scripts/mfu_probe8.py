@@ -1,0 +1,79 @@
+"""Probe 8: attention backward cost hunt (PERF.md r3).
+
+mfu_trace.py attributed 63.8 ms of the 164.7 ms step to attention
+(fwd ~13 ms, bwd ~50 ms — ~4x fwd, vs the ~2.5x a balanced kernel
+shows).  Sweep splash's backward configuration at the WHOLE-STEP level.
+
+Usage: python scripts/mfu_probe8.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+
+
+def bench_step(cfg_kwargs, params, opt, opt_state, tok, tgt, iters=12):
+    from ray_tpu.models import gpt2
+
+    cfg = gpt2.GPTConfig(**cfg_kwargs)
+    step = jax.jit(gpt2.make_train_step(cfg, opt))
+    out = step(params, opt_state, tok, tgt)
+    float(out[2])
+    for _ in range(2):
+        out = step(params, opt_state, tok, tgt)
+    float(out[2])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = step(params, opt_state, tok, tgt)
+    float(out[2])
+    return (time.perf_counter() - t0) / iters * 1000
+
+
+def main():
+    from ray_tpu.models import gpt2
+    from ray_tpu.ops import attention as attn_mod
+
+    B = 16
+    cfg0 = gpt2.GPTConfig.small()
+    key = jax.random.PRNGKey(0)
+    params = jax.device_put(gpt2.init_params(cfg0, key))
+    tok = jax.random.randint(key, (B, cfg0.seq_len), 0, 50257)
+    tgt = jax.random.randint(key, (B, cfg0.seq_len), 0, 50257)
+    opt = gpt2.make_optimizer()
+    opt_state = opt.init(params)
+
+    # Patch-level sweep of splash fused_bwd since GPTConfig doesn't expose it.
+    orig = attn_mod.splash_attention
+
+    def run(name, fused_bwd, bq, bkv):
+        def patched(q, k, v, causal=True, sm_scale=None, block_q=512,
+                    block_kv=512, fb=fused_bwd):
+            return orig(q, k, v, causal=causal, sm_scale=sm_scale,
+                        block_q=bq, block_kv=bkv, fused_bwd=fb)
+
+        attn_mod.splash_attention = patched
+        try:
+            ms = bench_step({}, params, opt, opt_state, tok, tgt)
+        except Exception as e:  # noqa: BLE001
+            print(f"{name}: FAILED {type(e).__name__}: {str(e)[:120]}")
+            return
+        finally:
+            attn_mod.splash_attention = orig
+        flops = gpt2.flops_per_token(cfg0) * B * cfg0.seq_len
+        print(f"{name}: {ms:7.2f} ms  MFU {flops / (ms/1e3) / 197e12 * 100:5.2f}%")
+
+    run("baseline fused_bwd=T 512/512 ", True, 512, 512)
+    run("fused_bwd=False      512/512 ", False, 512, 512)
+    run("fused_bwd=T         1024/1024", True, 1024, 1024)
+    run("fused_bwd=F         1024/1024", False, 1024, 1024)
+    run("fused_bwd=T         1024/512 ", True, 1024, 512)
+    run("fused_bwd=T          512/1024", True, 512, 1024)
+    run("fused_bwd=T          256/512 ", True, 256, 512)
+    run("fused_bwd=F          256/256 ", False, 256, 256)
+
+
+if __name__ == "__main__":
+    main()

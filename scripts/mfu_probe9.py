@@ -1,0 +1,78 @@
+"""Probe 9: remat-policy curve — trade saved-activation HBM for skipped
+backward recompute (PERF.md r3).
+
+Usage: python scripts/mfu_probe9.py
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import jax
+
+
+def run_donated(name, params, opt, opt_state, tok, tgt, flops):
+    import jax
+    from ray_tpu.models import gpt2
+
+    cfg = gpt2.GPTConfig(remat_policy="attn_outside")
+    step = jax.jit(gpt2.make_train_step(cfg, opt), donate_argnums=(0, 1))
+    import time
+    p, s = params, opt_state
+    p, s, loss = step(p, s, tok, tgt)
+    float(loss)
+    for _ in range(2):
+        p, s, loss = step(p, s, tok, tgt)
+    float(loss)
+    t0 = time.perf_counter()
+    iters = 12
+    for _ in range(iters):
+        p, s, loss = step(p, s, tok, tgt)
+    float(loss)
+    ms = (time.perf_counter() - t0) / iters * 1000
+    print(f"{name}: {ms:7.2f} ms  MFU {flops / (ms/1e3) / 197e12 * 100:5.2f}%")
+
+
+def main():
+    from ray_tpu.models import gpt2
+
+    B = 16
+    key = jax.random.PRNGKey(0)
+    cfg0 = gpt2.GPTConfig.small()
+    params = jax.device_put(gpt2.init_params(cfg0, key))
+    tok = jax.random.randint(key, (B, cfg0.seq_len), 0, 50257)
+    tgt = jax.random.randint(key, (B, cfg0.seq_len), 0, 50257)
+    opt = gpt2.make_optimizer()
+    opt_state = opt.init(params)
+    flops = gpt2.flops_per_token(cfg0) * B * cfg0.seq_len
+
+    def run(name, **kw):
+        cfg = gpt2.GPTConfig(**kw)
+        step = jax.jit(gpt2.make_train_step(cfg, opt))
+        try:
+            out = step(params, opt_state, tok, tgt)
+            float(out[2])
+            for _ in range(2):
+                out = step(params, opt_state, tok, tgt)
+            float(out[2])
+            t0 = time.perf_counter()
+            iters = 12
+            for _ in range(iters):
+                out = step(params, opt_state, tok, tgt)
+            float(out[2])
+            ms = (time.perf_counter() - t0) / iters * 1000
+        except Exception as e:  # noqa: BLE001
+            print(f"{name}: FAILED {type(e).__name__}: {str(e)[:120]}")
+            return
+        print(f"{name}: {ms:7.2f} ms  MFU {flops / (ms/1e3) / 197e12 * 100:5.2f}%")
+
+    run("save_attn (baseline)   ", remat_policy="save_attn")
+    run("attn_outside           ", remat_policy="attn_outside")
+    run("attn_outside unrolled  ", remat_policy="attn_outside",
+        scan_layers=False)
+    run_donated("attn_outside + donate  ", params, opt, opt_state, tok, tgt, flops)
+
+
+if __name__ == "__main__":
+    main()

@@ -119,3 +119,32 @@ def test_graft_entry_dryrun():
     import __graft_entry__
 
     __graft_entry__.dryrun_multichip(8)
+
+
+def test_attn_outside_and_unrolled_match_scan_save_attn():
+    """remat_policy='attn_outside' (split-block checkpointing, the r3 MFU
+    win) and scan_layers=False (unrolled layers) are pure schedule changes:
+    loss and grads must match the save_attn scan path exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2
+
+    base = gpt2.GPTConfig.tiny()
+    key = jax.random.PRNGKey(0)
+    params = gpt2.init_params(base, key)
+    tok = jax.random.randint(key, (2, base.seq_len), 0, base.vocab_size)
+    tgt = jax.random.randint(key, (2, base.seq_len), 0, base.vocab_size)
+
+    ref_l, ref_g = jax.value_and_grad(gpt2.loss_fn)(params, tok, tgt, base)
+    import dataclasses
+
+    for kw in ({"remat_policy": "attn_outside"},
+               {"remat_policy": "attn_outside", "scan_layers": False},
+               {"scan_layers": False}):  # unrolled save_attn path
+        cfg = dataclasses.replace(base, **kw)
+        loss, grads = jax.value_and_grad(gpt2.loss_fn)(params, tok, tgt, cfg)
+        assert abs(float(loss) - float(ref_l)) < 1e-5, kw
+        err = max(jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))), grads, ref_g)))
+        assert err < 1e-4, (kw, err)
