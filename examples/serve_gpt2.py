@@ -1,8 +1,10 @@
-"""Serve a jitted GPT-2 forward pass behind HTTP + gRPC ingress.
+"""Serve a jitted GPT-2 behind HTTP: unary next-token AND streamed
+greedy decoding (tokens reach the client chunk-by-chunk over SSE — the
+LLM-serving headline path).
 
-One TPU-resident replica holds the params; requests batch token ids and
-return next-token logits argmax.  Composition, autoscaling, rolling
-updates, and the pow-2 router all apply to this deployment like any other.
+One TPU-resident replica holds the params; composition, autoscaling,
+rolling updates, and the pow-2 router all apply to this deployment like
+any other.
 
 Run: python examples/serve_gpt2.py
 """
@@ -49,6 +51,36 @@ def main() -> None:
 
     serve.run(GPT2Next.bind(), name="gpt2", route_prefix="/gpt2")
 
+    # Streaming app: greedy-decode one token per yield; the HTTP proxy
+    # forwards each as an SSE event / HTTP chunk the moment it exists.
+    @serve.deployment(num_replicas=1)
+    class GPT2Stream:
+        def __init__(self):
+            self.config = gpt2.GPTConfig(vocab_size=2048, n_layer=2,
+                                         n_head=4, d_model=256, seq_len=128,
+                                         attn_impl="xla")
+            self.params = gpt2.init_params(self.config, jax.random.key(0))
+            self._fwd = jax.jit(
+                lambda p, t: gpt2.forward(p, t, self.config))
+
+        def __call__(self, request):
+            tokens = [int(t) for t in
+                      request.query_params.get("tokens", "1,2,3").split(",")]
+            n = int(request.query_params.get("max_new", "8"))
+            # Pad to the model's fixed seq_len so every decode step hits
+            # ONE compiled program (growing shapes would re-jit per token).
+            S = self.config.seq_len
+            for _ in range(n):
+                arr = np.zeros((1, S), np.int32)
+                arr[0, :len(tokens)] = tokens
+                logits = self._fwd(self.params, jnp.asarray(arr))
+                nxt = int(jnp.argmax(logits[0, len(tokens) - 1]))
+                tokens.append(nxt)
+                yield json.dumps({"token": nxt})
+
+    serve.run(GPT2Stream.bind(), name="gpt2stream",
+              route_prefix="/gpt2stream")
+
     from ray_tpu.serve.api import _state
 
     addr = _state["proxy"].address
@@ -58,6 +90,21 @@ def main() -> None:
     out = json.load(urllib.request.urlopen(req, timeout=30))
     print("HTTP response:", out)
     assert "next_token" in out
+
+    # Stream tokens (same wire format a `curl -N .../gpt2stream` sees).
+    stream_req = urllib.request.Request(
+        f"{addr}/gpt2stream?tokens=1,2,3&max_new=5",
+        headers={"Accept": "text/event-stream"})
+    with urllib.request.urlopen(stream_req, timeout=60) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        events = []
+        for raw in resp:
+            line = raw.decode().strip()
+            if line.startswith("data: "):
+                events.append(json.loads(line[len("data: "):]))
+                print("streamed:", events[-1])
+    assert len(events) == 5 and all("token" in e for e in events)
+
     serve.shutdown()
     ray_tpu.shutdown()
     print("serve_gpt2 OK")

@@ -170,6 +170,19 @@ def _build_app(app: Application, app_name: str) -> tuple:
     return list(deployments.values()), app.deployment.name, ingress_handle
 
 
+def _ingress_streams(deployment_def) -> bool:
+    """Does the ingress __call__ stream (generator/async-generator)?  The
+    proxies then iterate the response instead of buffering it (ref:
+    proxy.py:532 — the reference streams ASGI responses the same way)."""
+    import inspect
+
+    fn = deployment_def
+    if inspect.isclass(deployment_def):
+        fn = getattr(deployment_def, "__call__", None)
+    return bool(fn) and (inspect.isgeneratorfunction(fn)
+                         or inspect.isasyncgenfunction(fn))
+
+
 def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/", blocking: bool = False,
         _local_testing_mode: bool = False) -> DeploymentHandle:
@@ -178,7 +191,8 @@ def run(app: Application, *, name: str = "default",
     controller = _get_controller()
     descs, ingress_name, handle = _build_app(app, name)
     ray_tpu.get(controller.deploy_application.remote(
-        name, route_prefix, ingress_name, descs))
+        name, route_prefix, ingress_name, descs,
+        ingress_streaming=_ingress_streams(app.deployment.func_or_class)))
     _wait_for_application(name, timeout_s=60.0)
     if blocking:  # pragma: no cover - interactive mode
         import time as _t

@@ -41,7 +41,8 @@ class ServeController:
     # ------------------------------------------------------------ app deploy
     async def deploy_application(self, app_name: str, route_prefix: Optional[str],
                                  ingress_name: str,
-                                 deployments: List[Dict[str, Any]]) -> None:
+                                 deployments: List[Dict[str, Any]],
+                                 ingress_streaming: bool = False) -> None:
         """(ref: controller.py deploy_application / application_state.py)"""
         await self._ensure_loop()
         new_names = {d["name"] for d in deployments}
@@ -63,6 +64,7 @@ class ServeController:
             "route_prefix": route_prefix,
             "deployments": sorted(new_names),
             "ingress": ingress_name,
+            "streaming": bool(ingress_streaming),
         }
         self._broadcast_routes()
 
@@ -76,7 +78,8 @@ class ServeController:
 
     def _broadcast_routes(self) -> None:
         routes = {
-            app["route_prefix"]: {"app_name": name, "ingress": app["ingress"]}
+            app["route_prefix"]: {"app_name": name, "ingress": app["ingress"],
+                                  "streaming": app.get("streaming", False)}
             for name, app in self._apps.items()
             if app["route_prefix"]
         }

@@ -91,10 +91,7 @@ class GRPCProxy:
             return next(iter(apps.values()))
         return None
 
-    def handle_rpc(self, service: str, method: str, payload: bytes,
-                   metadata: Dict[str, str]) -> bytes:
-        if service == self.BUILTIN_SERVICE:
-            return self._handle_builtin(method)
+    def _resolve_handle(self, metadata: Dict[str, str]) -> DeploymentHandle:
         target = self._app_target(metadata.get("application"))
         if target is None:
             raise KeyError(
@@ -106,6 +103,13 @@ class GRPCProxy:
         if handle is None:
             handle = self._handles[app_name] = DeploymentHandle(
                 ingress, app_name, self._controller)
+        return handle
+
+    def handle_rpc(self, service: str, method: str, payload: bytes,
+                   metadata: Dict[str, str]) -> bytes:
+        if service == self.BUILTIN_SERVICE:
+            return self._handle_builtin(method)
+        handle = self._resolve_handle(metadata)
         req = GRPCRequest(payload, method, metadata)
         result = handle.remote(req).result(timeout_s=60.0)
         if isinstance(result, bytes):
@@ -115,6 +119,35 @@ class GRPCProxy:
         from ray_tpu._private import serialization
 
         return serialization.dumps(result)
+
+    def handle_rpc_stream(self, service: str, method: str, payload: bytes,
+                          metadata: Dict[str, str]):
+        """Server-streaming RPC: yields one message per item the ingress
+        generator produces (ref: proxy.py:639 gRPC streaming entry).
+        Clients opt in with the ``streaming: 1`` metadata key — a generic
+        handler must pick the RPC arity before user code runs."""
+        if service == self.BUILTIN_SERVICE:
+            # Builtins are unary; answer locally even if the client set
+            # the streaming key (a one-message stream).
+            yield self._handle_builtin(method)
+            return
+        handle = self._resolve_handle(metadata)
+        req = GRPCRequest(payload, method, metadata)
+        gen = handle.options(stream=True).remote(req)
+        try:
+            for item in gen:
+                if isinstance(item, bytes):
+                    yield item
+                elif isinstance(item, str):
+                    yield item.encode()
+                else:
+                    from ray_tpu._private import serialization
+
+                    yield serialization.dumps(item)
+        finally:
+            # Client cancellation surfaces as GeneratorExit here; release
+            # the replica-side iterator either way.
+            gen.cancel(wait=False)
 
     def _handle_builtin(self, method: str) -> bytes:
         import json
@@ -152,6 +185,21 @@ class _GenericHandler:
             except Exception as e:  # noqa: BLE001 — surfaced to the client
                 context.abort(grpc.StatusCode.INTERNAL, repr(e))
 
+        def unary_stream(request: bytes, context):
+            try:
+                yield from self._proxy.handle_rpc_stream(
+                    service, method, request, metadata)
+            except KeyError as e:
+                context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            except Exception as e:  # noqa: BLE001 — mid-stream errors end
+                # the stream with INTERNAL status (reference parity).
+                context.abort(grpc.StatusCode.INTERNAL, repr(e))
+
+        if metadata.get("streaming") == "1":
+            return grpc.unary_stream_rpc_method_handler(
+                unary_stream,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b)
         return grpc.unary_unary_rpc_method_handler(
             unary_unary,
             request_deserializer=lambda b: b,

@@ -42,6 +42,10 @@ class Request:
 class HTTPProxy:
     """aiohttp server thread routing HTTP → ingress deployment handles."""
 
+    #: Per-item pull bound for streaming responses (the unary path's
+    #: result() uses 60 s the same way).
+    STREAM_PULL_TIMEOUT_S = 60.0
+
     def __init__(self, controller_handle, options: HTTPOptions):
         self._controller = controller_handle
         self._options = options
@@ -129,6 +133,11 @@ class HTTPProxy:
         body = await request.read()
         req = Request(request.method, request.path,
                       dict(request.query), dict(request.headers), body)
+        if target.get("streaming"):
+            # Generator ingress: chunked (or SSE) response, one HTTP chunk
+            # per yielded item — tokens reach the client as they are
+            # produced (ref: proxy.py:532 streaming ASGI send).
+            return await self._handle_streaming(request, handle, req)
         try:
             response = handle.remote(req)
             result = await asyncio.get_running_loop().run_in_executor(
@@ -136,6 +145,72 @@ class HTTPProxy:
         except Exception as e:  # noqa: BLE001
             return web.Response(status=500, text=f"Internal error: {e!r}")
         return self._to_http_response(result)
+
+    async def _handle_streaming(self, request, handle, req):
+        """Drive a replica stream into a chunked HTTP response.
+
+        Item mapping: bytes pass through; str encodes utf-8; anything else
+        is JSON + newline (ndjson).  When the client asked for
+        ``text/event-stream``, items are framed as SSE ``data:`` events.
+        A mid-stream replica error terminates the (already started)
+        response body — the status line is gone, matching the reference's
+        behavior for errors after the first chunk.  Client disconnects
+        cancel the replica-side stream so nothing leaks.
+        """
+        import json as _json
+
+        from aiohttp import web
+
+        loop = asyncio.get_running_loop()
+        try:
+            # Stream assignment can block (replica-set wait during a
+            # rolling update) — keep it off the event loop, like the
+            # unary path's executor hop.
+            gen = await loop.run_in_executor(
+                None, lambda: handle.options(stream=True).remote(req))
+        except Exception as e:  # noqa: BLE001
+            return web.Response(status=500, text=f"Internal error: {e!r}")
+        sse = "text/event-stream" in request.headers.get("Accept", "")
+        resp = web.StreamResponse()
+        resp.content_type = ("text/event-stream" if sse
+                             else "application/octet-stream")
+        resp.headers["Cache-Control"] = "no-cache"
+        started = False
+        try:
+            while True:
+                try:
+                    # Bound each pull like the unary path bounds its
+                    # result(): a wedged replica must not pin the
+                    # connection + stream slot forever.
+                    item = await asyncio.wait_for(
+                        gen.__anext__(), timeout=self.STREAM_PULL_TIMEOUT_S)
+                except StopAsyncIteration:
+                    break
+                if not started:
+                    await resp.prepare(request)
+                    started = True
+                if isinstance(item, bytes):
+                    chunk = item
+                elif isinstance(item, str):
+                    chunk = item.encode()
+                else:
+                    chunk = _json.dumps(item).encode() + b"\n"
+                if sse:
+                    chunk = b"data: " + chunk.rstrip(b"\n") + b"\n\n"
+                await resp.write(chunk)
+        except (ConnectionResetError, ConnectionError, asyncio.CancelledError):
+            # Client went away: release the replica-side iterator.
+            gen.cancel(wait=False)
+            raise
+        except Exception as e:  # noqa: BLE001 — replica raised mid-stream
+            gen.cancel(wait=False)
+            if not started:
+                return web.Response(status=500, text=f"Internal error: {e!r}")
+            # Headers already sent: nothing to do but end the body early.
+        if not started:
+            await resp.prepare(request)  # empty stream: headers + EOF
+        await resp.write_eof()
+        return resp
 
     @staticmethod
     def _to_http_response(result: Any):

@@ -18,8 +18,6 @@ import jax.numpy as jnp
 
 def bench(fn, *args, iters=15):
     out = fn(*args)
-    jax.tree_util.tree_map(
-        lambda x: x, out)
     _sync(out)
     for _ in range(3):
         out = fn(*args)
