@@ -246,6 +246,13 @@ def cmd_worker(args) -> int:
     """
     import json as _json
 
+    # Ops hook: `kill -USR1 <pid>` dumps all thread stacks to stderr
+    # (the reference's `ray stack` for remote nodes).
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     if args.host:
         import os as _os
 

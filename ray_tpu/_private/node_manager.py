@@ -360,6 +360,41 @@ class WorkerNode:
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name="ray_tpu_node_hb", daemon=True)
         self._hb_thread.start()
+        self._install_debug_signal()
+
+    def _install_debug_signal(self) -> None:
+        """`kill -USR2 <pid>`: dump dep-wait state to stderr (companion to
+        the USR1 stack dump — the two together diagnose a wedged node)."""
+        import signal
+        import sys
+
+        def dump(_sig, _frm):
+            rt = self.runtime
+            with rt._deps_lock:
+                items = list(rt._pending_deps.items())
+            for n in rt.scheduler.nodes():
+                print(f"[node {self.node_id}] sched node {n.id} "
+                      f"avail={n.available}", file=sys.stderr, flush=True)
+            print(f"[node {self.node_id}] blocked={rt._blocked_count} "
+                  f"running={list(rt._running)} "
+                  f"inflight={len(rt._inflight)}",
+                  file=sys.stderr, flush=True)
+            print(f"[node {self.node_id}] {len(items)} dep-waiting specs",
+                  file=sys.stderr, flush=True)
+            for tid, (spec, deps) in items[:8]:
+                print(f"  task {tid} {spec.name} waits {len(deps)}:",
+                      file=sys.stderr, flush=True)
+                for a in list(spec.args):
+                    oid = getattr(a, "id", None)
+                    if oid is not None and hasattr(a, "owner_addr"):
+                        print(f"    arg {oid} owner_addr={a.owner_addr!r} "
+                              f"state={rt.store.state_of(oid)}",
+                              file=sys.stderr, flush=True)
+
+        try:
+            signal.signal(signal.SIGUSR2, dump)
+        except ValueError:
+            pass  # not the main thread (embedded use); skip the hook
 
     # ---------------------------------------------------------------- serve
     def serve_forever(self) -> None:
@@ -438,8 +473,8 @@ class WorkerNode:
                 gen = self.runtime.submit_task(spec)
                 self._stream_generator(spec, gen)
                 return
-            self.runtime.submit_task(spec)
-            self._report_completion(spec)
+            refs = self.runtime.submit_task(spec)
+            self._report_completion(spec, refs)
         except BaseException as e:  # noqa: BLE001 — submission itself failed
             self._send_done(spec, [("error", serialization.dumps(e))
                                    for _ in range(max(spec.num_returns, 1))])
@@ -478,8 +513,8 @@ class WorkerNode:
                 gen = self.runtime.submit_actor_task(actor_id, spec)
                 self._stream_generator(spec, gen)
                 return
-            self.runtime.submit_actor_task(actor_id, spec)
-            self._report_completion(spec)
+            refs = self.runtime.submit_actor_task(actor_id, spec)
+            self._report_completion(spec, refs)
         except BaseException as e:  # noqa: BLE001
             self._send_done(spec, [("error", serialization.dumps(e))
                                    for _ in range(max(spec.num_returns, 1))])
@@ -497,7 +532,11 @@ class WorkerNode:
         self.runtime._borrow_ledger().add(oid, EXPORT_BORROWER)
         return ("stored", self.runtime.object_server.addr)
 
-    def _report_completion(self, spec) -> None:
+    def _report_completion(self, spec, refs) -> None:
+        # ``refs`` pins the local result objects for the duration of the
+        # export: dropping them lets the refcounter free a result that a
+        # FAST task produced before this frame even ran, and the store.get
+        # below would then wait forever on a freshly re-created entry.
         results: List[tuple] = []
         for i in range(max(spec.num_returns, 1)):
             oid = ObjectID.for_task_return(spec.task_id, i)
@@ -509,6 +548,7 @@ class WorkerNode:
             except BaseException as e:  # noqa: BLE001
                 results.append(("error", serialization.dumps(e)))
         self._send_done(spec, results)
+        del refs  # export done: inline copies shipped, stored copies pinned
 
     def _stream_generator(self, spec, gen) -> None:
         index = 0

@@ -406,37 +406,37 @@ def _limit_stream(stream: Iterator[Any], limit: int) -> Iterator[Any]:
 
 
 def _all_to_all(stream: Iterator[Any], op: LogicalOp) -> Iterator[Any]:
-    """Materializing ops (ref: planner/exchange/ shuffle)."""
-    blocks = [ray_tpu.get(r) for r in stream]
-    combined = concat_blocks(blocks)
-    acc = BlockAccessor(combined)
-    n = acc.num_rows()
+    """Exchange ops as DISTRIBUTED TASK STAGES (ref: planner/exchange/
+    push_based_shuffle_task_scheduler.py): map tasks partition each block
+    (hash/range/random), reduce tasks merge per partition.  The driver
+    touches only refs and sample/count metadata — never the block data
+    (the r2 driver-side concat_blocks of the whole dataset is gone)."""
+    from ray_tpu.data import exchange
 
+    refs = list(stream)
+    if not refs:
+        return
     if isinstance(op, Sort):
-        import pyarrow.compute as pc
-
-        idx = pc.sort_indices(
-            combined,
-            sort_keys=[(op.key, "descending" if op.descending else "ascending")])
-        combined = combined.take(idx)
-        yield ray_tpu.put(combined)
+        yield from exchange.sorted_exchange(refs, op.key, op.descending)
         return
     if isinstance(op, RandomShuffle):
-        rng = np.random.default_rng(op.seed)
-        perm = rng.permutation(n)
-        yield ray_tpu.put(acc.take(list(map(int, perm))))
+        yield from exchange.shuffle_exchange(refs, op.seed)
         return
     if isinstance(op, Repartition):
-        k = max(1, op.num_blocks)
-        size = max(1, (n + k - 1) // k)
-        for start in range(0, n, size):
-            yield ray_tpu.put(acc.slice(start, min(start + size, n)))
+        yield from exchange.repartition_exchange(refs, op.num_blocks)
         return
     if isinstance(op, Aggregate):
-        yield ray_tpu.put(_aggregate(combined, op))
+        if op.key is None:
+            yield ray_tpu.put(exchange.global_aggregate(refs, op))
+        else:
+            yield from exchange.hash_exchange(refs, op, "aggregate")
         return
     if isinstance(op, MapGroups):
-        yield ray_tpu.put(_map_groups(combined, op))
+        if op.key is None:
+            # Single group: one reduce task over all blocks.
+            yield exchange._reduce_map_groups.remote(op, *refs)
+        else:
+            yield from exchange.hash_exchange(refs, op, "map_groups")
         return
     raise TypeError(op)
 

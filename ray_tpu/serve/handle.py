@@ -67,6 +67,12 @@ class DeploymentResponseGenerator:
         self._sid: Optional[str] = None
         self._on_done = on_done
         self._finished = False
+        #: The REPLICA ended the stream (done marker, or an exception the
+        #: replica raised — it reaps its slot on those).  A local abort
+        #: (pull timeout, task cancellation, consumer bailing) leaves the
+        #: replica holding the slot, and cancel() must still fire even
+        #: though iteration already marked _finished.
+        self._server_done = False
 
     def _finish(self) -> None:
         if not self._finished:
@@ -92,10 +98,17 @@ class DeploymentResponseGenerator:
         try:
             kind, value = ray_tpu.get(
                 self._actor.next_stream.remote(self._resolve_sid()))
-        except BaseException:
+        except BaseException as e:
+            # A replica-raised error ended the stream server-side; local
+            # failures (timeout/cancel) did NOT — cancel() handles those.
+            from ray_tpu.exceptions import TaskError
+
+            if isinstance(e, TaskError):
+                self._server_done = True
             self._finish()
             raise
         if kind == "done":
+            self._server_done = True
             self._finish()
             raise StopIteration
         return value
@@ -114,22 +127,31 @@ class DeploymentResponseGenerator:
                 self._sid = await rt.get_async(self._sid_ref)
             kind, value = await rt.get_async(
                 self._actor.next_stream.remote(self._sid))
-        except BaseException:
+        except BaseException as e:
+            from ray_tpu.exceptions import TaskError
+
+            if isinstance(e, TaskError):
+                self._server_done = True
             self._finish()
             raise
         if kind == "done":
+            self._server_done = True
             self._finish()
             raise StopAsyncIteration
         return value
 
     def cancel(self, wait: bool = True) -> None:
-        """Stop early; releases the replica-side iterator.  ``wait=False``
-        fire-and-forgets (used by the GC finalizer, which must never block
-        an event loop or a tearing-down interpreter)."""
+        """Release the replica-side iterator.  Fires whenever the REPLICA
+        has not already ended the stream — including after a local abort
+        already marked iteration finished (a wedged pull or client
+        disconnect must not pin the replica's slot for the idle timeout).
+        ``wait=False`` fire-and-forgets (GC finalizer: never block an
+        event loop or a tearing-down interpreter)."""
         import ray_tpu
 
-        if self._finished:
+        if self._server_done:
             return
+        self._server_done = True  # one cancel is enough (it is idempotent)
         try:
             if self._sid is not None:
                 ref = self._actor.cancel_stream.remote(self._sid)

@@ -197,6 +197,13 @@ class HTTPProxy:
                     chunk = _json.dumps(item).encode() + b"\n"
                 if sse:
                     chunk = b"data: " + chunk.rstrip(b"\n") + b"\n\n"
+                # aiohttp does not cancel handlers on disconnect (and
+                # write() into a closing transport can silently no-op) —
+                # probe the transport so a vanished client releases the
+                # replica stream instead of streaming into the void.
+                tr = request.transport
+                if tr is None or tr.is_closing():
+                    raise ConnectionResetError("client disconnected")
                 await resp.write(chunk)
         except (ConnectionResetError, ConnectionError, asyncio.CancelledError):
             # Client went away: release the replica-side iterator.

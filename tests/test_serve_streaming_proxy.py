@@ -131,26 +131,36 @@ def test_http_client_disconnect_releases_stream(serve_instance):
                 yield f"x{i}"
                 i += 1
 
-    serve.run(Endless.bind(), name="endless_app", route_prefix="/endless")
+    handle = serve.run(Endless.bind(), name="endless_app",
+                       route_prefix="/endless")
     host, port = _http_host_port()
     conn = http.client.HTTPConnection(host, port, timeout=30)
     conn.request("GET", "/endless")
     resp = conn.getresponse()
     assert resp.read(2)  # stream is live
-    conn.sock.close()  # client vanishes mid-stream
+    # Really sever the TCP connection: plain sock.close() leaves the fd
+    # alive through http.client's buffered-reader dup, so no FIN is sent.
+    import socket as socket_mod
+
+    conn.sock.shutdown(socket_mod.SHUT_RDWR)
+    conn.close()
 
     # The replica-side stream must be reaped (cancel on write failure):
-    # its ongoing-request count returns to zero.
-    from ray_tpu.serve.api import _state
-
-    controller = _state["controller"]
+    # the replica's ongoing-request count returns to zero well before the
+    # 300s idle fallback.
+    scheduler = handle._get_router()._scheduler
     deadline = time.time() + 30
+    ongoing = None
     while time.time() < deadline:
-        stats = ray_tpu.get(controller.get_deployment_status.remote())
-        dep = stats.get("endless_app#Endless", {})
-        if dep.get("ongoing", dep.get("num_ongoing", 0)) in (0, None):
+        with scheduler._lock:
+            replicas = [dict(r) for r in scheduler._replicas]
+        counts = [ray_tpu.get(r["actor"].get_num_ongoing_requests.remote(),
+                              timeout=10) for r in replicas if "actor" in r]
+        ongoing = sum(counts) if counts else None
+        if ongoing == 0:
             break
-        time.sleep(0.2)
+        time.sleep(0.3)
+    assert ongoing == 0, f"replica stream slot leaked (ongoing={ongoing})"
 
 
 def test_grpc_server_streaming(serve_instance):
