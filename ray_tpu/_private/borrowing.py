@@ -24,10 +24,14 @@ Serialized bytes that are dropped without ever being deserialized leak
 their pin until the owner shuts down — the same caveat the reference
 documents for refs stashed in external storage.
 
-Failure notes (documented divergence from the reference's full protocol):
-a borrower that dies without releasing leaks its borrow on the owner until
-the owner runtime shuts down; the reference reclaims via worker-death
-pubsub, which maps here to node-death detection — future work.
+Borrower-death reclamation: each borrower holds one long-lived liveness
+connection per owner (OP_BORROW_SESSION); when the borrower process dies
+— including kill -9, where the OS closes the socket — the owner sees EOF
+and drops every borrow registered under that borrower's id, freeing
+objects it was the last holder of (the role of the reference's
+worker-death pubsub in reference_count.h).  Wire pins (``wire:*``) and
+cluster export pins are NOT session-backed and are never reaped this way
+— their lifetime is the serialized copy / the head's refcount.
 """
 
 from __future__ import annotations
@@ -62,7 +66,88 @@ class BorrowClient:
         #: (Liveness of individual handles is the refcounter's job — the
         #: release path re-reads the live count rather than shadowing it.)
         self._borrows: Dict[ObjectID, str] = {}
-        self.stats = {"registered": 0, "released": 0, "send_failures": 0}
+        #: owner addr -> long-lived liveness socket: its EOF tells the
+        #: owner this process died, reclaiming every borrow under our id
+        #: (ref: reference_count.h worker-death pubsub).
+        self._sessions: Dict[str, object] = {}
+        self._keeper: Optional[threading.Thread] = None
+        self.stats = {"registered": 0, "released": 0, "send_failures": 0,
+                      "session_repairs": 0}
+
+    def _open_session(self, addr: str):
+        from ray_tpu._private import object_transfer as ot
+
+        sock = ot._request_sock(addr, 2.0)
+        sock.sendall(ot._req_header(ot.OP_BORROW_SESSION, self.borrower_id))
+        ot._recv_exact(sock, 1)
+        sock.settimeout(None)
+        return sock
+
+    def _ensure_session(self, addr: str) -> None:
+        """Open (once per owner) the liveness connection; caller holds the
+        lock.  Best-effort: an unreachable owner also fails the borrow
+        send right after, which is the loud path."""
+        if addr in self._sessions:
+            return
+        try:
+            self._sessions[addr] = self._open_session(addr)
+        except Exception:
+            self.stats["send_failures"] += 1
+            return
+        if self._keeper is None:
+            self._keeper = threading.Thread(
+                target=self._session_keeper, name="borrow-session-keeper",
+                daemon=True)
+            self._keeper.start()
+
+    def _session_keeper(self) -> None:
+        """Watch the liveness sockets: a reset session (owner restart or a
+        transient network failure) is reopened and every borrow to that
+        owner RE-REGISTERED, so a borrower whose session blipped stays
+        protected (the owner cancels its pending reap if we reconnect
+        within its grace window)."""
+        import select
+
+        while True:
+            with self._lock:
+                socks = dict(self._sessions)
+            if not socks:
+                import time
+
+                time.sleep(1.0)
+                continue
+            try:
+                readable, _, _ = select.select(list(socks.values()), [], [], 2.0)
+            except (OSError, ValueError):
+                readable = []
+            dead_addrs = []
+            for addr, sock in socks.items():
+                if sock in readable:
+                    try:
+                        if sock.recv(64) == b"":
+                            dead_addrs.append(addr)
+                    except (ConnectionError, OSError):
+                        dead_addrs.append(addr)
+            for addr in dead_addrs:
+                with self._lock:
+                    if self._sessions.get(addr) is not socks[addr]:
+                        continue  # already repaired/cleared
+                    try:
+                        socks[addr].close()
+                    except OSError:
+                        pass
+                    del self._sessions[addr]
+                    held = [oid for oid, a in self._borrows.items()
+                            if a == addr]
+                    try:
+                        self._sessions[addr] = self._open_session(addr)
+                        for oid in held:
+                            self._send("add", oid, addr)
+                        self.stats["session_repairs"] += 1
+                    except Exception:
+                        # Owner really gone: its store died with it, so
+                        # there is nothing left to protect.
+                        self.stats["send_failures"] += 1
 
     # ----------------------------------------------------------- borrower API
     def register(self, oid: ObjectID, owner_addr: str) -> None:
@@ -71,6 +156,7 @@ class BorrowClient:
         with self._lock:
             if oid in self._borrows:
                 return
+            self._ensure_session(owner_addr)
             self._borrows[oid] = owner_addr
             self.stats["registered"] += 1
             self._send("add", oid, owner_addr)
@@ -171,7 +257,9 @@ def notify_zero(oid: ObjectID, count_fn=None) -> None:
 def release_all() -> None:
     """Runtime shutdown: return every outstanding borrow to its owner.
     Sends are synchronous, so every release is on the wire (and acked)
-    before this returns — nothing is lost to interpreter teardown."""
+    before this returns — nothing is lost to interpreter teardown.  The
+    liveness sessions close LAST, so the owner sees orderly releases, not
+    a death to reap."""
     c = _client
     if c is None:
         return
@@ -181,6 +269,12 @@ def release_all() -> None:
         for oid, addr in entries:
             c.stats["released"] += 1
             c._send("release", oid, addr)
+        for sock in c._sessions.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+        c._sessions.clear()
 
 
 class BorrowLedger:
@@ -213,3 +307,16 @@ class BorrowLedger:
     def borrowed_ids(self):
         with self._lock:
             return list(self._borrowers)
+
+    def drop_borrower(self, borrower_id: str) -> list:
+        """A borrower died without releasing: remove it everywhere.
+        Returns the oids whose LAST borrower it was (candidates to free)."""
+        freed = []
+        with self._lock:
+            for oid, holders in list(self._borrowers.items()):
+                if borrower_id in holders:
+                    holders.discard(borrower_id)
+                    if not holders:
+                        del self._borrowers[oid]
+                        freed.append(oid)
+        return freed

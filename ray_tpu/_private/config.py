@@ -61,6 +61,11 @@ class Config:
     #: the waiting task is failed with ObjectTransferError.
     object_transfer_pull_retries: int = 3
 
+    #: Grace window after a borrower's liveness session drops before its
+    #: borrows are reaped — a reconnect inside it cancels the reap
+    #: (transient TCP resets must not free live data).
+    borrow_session_grace_s: float = 5.0
+
     # --- worker nodes (cross-host execution, ref: node_manager.h:117) ---
     #: Task returns at or below this size travel inline in the completion
     #: frame to the head's store; larger returns stay in the producing

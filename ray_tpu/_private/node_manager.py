@@ -366,35 +366,43 @@ class WorkerNode:
         """`kill -USR2 <pid>`: dump dep-wait state to stderr (companion to
         the USR1 stack dump — the two together diagnose a wedged node)."""
         import signal
-        import sys
 
         def dump(_sig, _frm):
-            rt = self.runtime
-            with rt._deps_lock:
-                items = list(rt._pending_deps.items())
-            for n in rt.scheduler.nodes():
-                print(f"[node {self.node_id}] sched node {n.id} "
-                      f"avail={n.available}", file=sys.stderr, flush=True)
-            print(f"[node {self.node_id}] blocked={rt._blocked_count} "
-                  f"running={list(rt._running)} "
-                  f"inflight={len(rt._inflight)}",
-                  file=sys.stderr, flush=True)
-            print(f"[node {self.node_id}] {len(items)} dep-waiting specs",
-                  file=sys.stderr, flush=True)
-            for tid, (spec, deps) in items[:8]:
-                print(f"  task {tid} {spec.name} waits {len(deps)}:",
-                      file=sys.stderr, flush=True)
-                for a in list(spec.args):
-                    oid = getattr(a, "id", None)
-                    if oid is not None and hasattr(a, "owner_addr"):
-                        print(f"    arg {oid} owner_addr={a.owner_addr!r} "
-                              f"state={rt.store.state_of(oid)}",
-                              file=sys.stderr, flush=True)
+            # Off-thread: the handler interrupts the main thread mid-
+            # bytecode, possibly INSIDE one of the locks the dump takes —
+            # acquiring them inline would deadlock the node being probed.
+            threading.Thread(target=self._dump_state, name="usr2-dump",
+                             daemon=True).start()
 
         try:
             signal.signal(signal.SIGUSR2, dump)
         except ValueError:
             pass  # not the main thread (embedded use); skip the hook
+
+    def _dump_state(self) -> None:
+        import sys
+
+        rt = self.runtime
+        with rt._deps_lock:
+            items = list(rt._pending_deps.items())
+        for n in rt.scheduler.nodes():
+            print(f"[node {self.node_id}] sched node {n.id} "
+                  f"avail={n.available}", file=sys.stderr, flush=True)
+        print(f"[node {self.node_id}] blocked={rt._blocked_count} "
+              f"running={list(rt._running)} "
+              f"inflight={len(rt._inflight)}",
+              file=sys.stderr, flush=True)
+        print(f"[node {self.node_id}] {len(items)} dep-waiting specs",
+              file=sys.stderr, flush=True)
+        for tid, (spec, deps) in items[:8]:
+            print(f"  task {tid} {spec.name} waits {len(deps)}:",
+                  file=sys.stderr, flush=True)
+            for a in list(spec.args):
+                oid = getattr(a, "id", None)
+                if oid is not None and hasattr(a, "owner_addr"):
+                    print(f"    arg {oid} owner_addr={a.owner_addr!r} "
+                          f"state={rt.store.state_of(oid)}",
+                          file=sys.stderr, flush=True)
 
     # ---------------------------------------------------------------- serve
     def serve_forever(self) -> None:

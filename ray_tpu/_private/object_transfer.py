@@ -57,6 +57,12 @@ OP_FREE = 4
 #: request carries borrower_len:u16 + borrower id after the object id.
 OP_ADD_BORROW = 5
 OP_RELEASE_BORROW = 6
+#: Borrower-liveness session (ref: reference_count.h worker-death pubsub —
+#: the owner reclaims a dead borrower's borrows): the borrower holds ONE
+#: long-lived connection per owner; EOF on it means the borrower process
+#: died, and the owner drops every borrow registered under its id.  The
+#: object id field carries the borrower id; no reply is sent.
+OP_BORROW_SESSION = 7
 
 ST_OK = 0
 ST_NOT_FOUND = 1
@@ -143,6 +149,7 @@ class ObjectTransferServer:
                  on_borrow: Optional[Callable[[ObjectID, str], None]] = None,
                  on_borrow_release: Optional[Callable[[ObjectID, str], None]] = None,
                  may_free: Optional[Callable[[ObjectID], bool]] = None,
+                 on_borrower_lost: Optional[Callable[[str], None]] = None,
                  host: str = "127.0.0.1", port: int = 0):
         self._store_provider = store_provider
         self._on_received = on_received
@@ -150,6 +157,11 @@ class ObjectTransferServer:
         self._on_borrow = on_borrow
         self._on_borrow_release = on_borrow_release
         self._may_free = may_free
+        self._on_borrower_lost = on_borrower_lost
+        #: borrower id -> count of live liveness sessions (a reconnect
+        #: within the reap grace period cancels the pending reap).
+        self._live_sessions: Dict[str, int] = {}
+        self._sessions_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -216,6 +228,30 @@ class ObjectTransferServer:
                     if cb is not None:
                         cb(oid, borrower)
                     conn.sendall(bytes([ST_OK]))
+                elif op == OP_BORROW_SESSION:
+                    # The "object id" field carries the borrower id; this
+                    # connection now IS the borrower's liveness signal —
+                    # park until EOF, then (after a grace period in which
+                    # the borrower may reconnect — a transient TCP reset
+                    # must not read as death) reap its borrows.
+                    borrower = str(oid)
+                    with self._sessions_lock:
+                        self._live_sessions[borrower] = \
+                            self._live_sessions.get(borrower, 0) + 1
+                    conn.sendall(bytes([ST_OK]))
+                    try:
+                        while conn.recv(1):
+                            pass  # borrowers never send; drain defensively
+                    except (ConnectionError, OSError):
+                        pass
+                    with self._sessions_lock:
+                        self._live_sessions[borrower] -= 1
+                        if self._live_sessions[borrower] <= 0:
+                            del self._live_sessions[borrower]
+                    if self._on_borrower_lost is not None \
+                            and not self._stop.is_set():
+                        self._reap_after_grace(borrower)
+                    return
                 else:
                     conn.sendall(bytes([ST_ERROR]))
                     return
@@ -226,6 +262,22 @@ class ObjectTransferServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _reap_after_grace(self, borrower: str) -> None:
+        """EOF on a borrower's last session: wait out the grace window; if
+        no replacement session appeared, declare the borrower dead."""
+        def waiter():
+            import time as _t
+
+            _t.sleep(GLOBAL_CONFIG.borrow_session_grace_s)
+            with self._sessions_lock:
+                if self._live_sessions.get(borrower, 0) > 0:
+                    return  # reconnected (transient reset, not death)
+            if not self._stop.is_set():
+                self._on_borrower_lost(borrower)
+
+        threading.Thread(target=waiter, name="objxfer-borrow-reap",
+                         daemon=True).start()
 
     def _handle_pull(self, conn: socket.socket, oid: ObjectID) -> None:
         store = self._store_provider()

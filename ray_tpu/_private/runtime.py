@@ -464,6 +464,7 @@ class Runtime:
                 may_free=lambda oid: (
                     self.refcounter.count(oid) == 0
                     and not self._borrow_ledger().is_borrowed(oid)),
+                on_borrower_lost=self._on_borrower_lost,
                 host=self.config.object_transfer_host)
         self._pull_manager()  # pulls and serves share a lifetime
         return self.object_server.addr
@@ -483,6 +484,15 @@ class Runtime:
             # Last borrower gone and no local handles: free now (the local
             # zero-callback already fired and deferred to the borrow).
             self._on_zero_refs(object_id)
+
+    def _on_borrower_lost(self, borrower_id: str) -> None:
+        """A borrower process died without releasing (its liveness session
+        hit EOF): reap every borrow it held; objects whose LAST holder it
+        was — and with no local handles — free now (ref:
+        reference_count.h worker-death reclamation)."""
+        for object_id in self._borrow_ledger().drop_borrower(borrower_id):
+            if self.refcounter.count(object_id) == 0:
+                self._on_zero_refs(object_id)
 
     def _object_is_pending(self, object_id: ObjectID) -> bool:
         """Owner-side directory answer: is something still producing this

@@ -69,8 +69,11 @@ def _partition_hash(blk: Block, key: str, p: int):
     if vals.dtype.kind in "iub":
         buckets = (vals.astype(np.int64) % p + p) % p
     elif vals.dtype.kind == "f":
-        # hash() of numeric values is NOT randomized — stable everywhere.
-        buckets = np.asarray([abs(hash(float(v))) % p for v in vals])
+        # hash() of numeric values is NOT randomized — stable everywhere —
+        # EXCEPT NaN, whose hash is id-based since 3.10: pin all NaNs to
+        # bucket 0 so they stay one group across processes.
+        buckets = np.asarray([0 if v != v else abs(hash(float(v))) % p
+                              for v in vals])
     else:
         buckets = np.asarray(
             [int.from_bytes(str(v).encode()[-8:].rjust(8, b"\0"), "little") % p

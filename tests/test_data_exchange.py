@@ -43,9 +43,6 @@ def test_random_shuffle_preserves_multiset_and_seeds(ray_start_regular):
 def test_repartition_preserves_order(ray_start_regular):
     ds = data.range(101).repartition(7)
     assert [r["id"] for r in ds.take_all()] == list(range(101))
-    counts = [len(b) for b in ds.iter_batches(batch_size=None)]
-    # later consumption path may rebatch; just verify total
-    assert sum(counts) in (101, 7) or True
 
 
 def test_groupby_across_many_blocks(ray_start_regular):
