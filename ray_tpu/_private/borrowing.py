@@ -102,52 +102,80 @@ class BorrowClient:
 
     def _session_keeper(self) -> None:
         """Watch the liveness sockets: a reset session (owner restart or a
-        transient network failure) is reopened and every borrow to that
-        owner RE-REGISTERED, so a borrower whose session blipped stays
-        protected (the owner cancels its pending reap if we reconnect
-        within its grace window)."""
+        transient network failure) is reopened — RETRIED every pass while
+        borrows to that owner remain — and every borrow RE-REGISTERED, so
+        a borrower whose session blipped stays protected (the owner
+        cancels its pending reap if we reconnect within its grace
+        window).  All network I/O happens OUTSIDE the client lock: a slow
+        owner must not stall register/release (or another owner's repair
+        past its grace window)."""
         import select
+        import time
 
+        broken: set = set()  # addrs needing a reconnect attempt
         while True:
             with self._lock:
                 socks = dict(self._sessions)
-            if not socks:
-                import time
-
+                held_addrs = set(self._borrows.values())
+            live = {a: s for a, s in socks.items() if s is not None}
+            if live:
+                try:
+                    readable, _, _ = select.select(
+                        list(live.values()), [], [], 2.0)
+                except (OSError, ValueError):
+                    readable = []
+                for addr, sock in live.items():
+                    dead = False
+                    if sock in readable:
+                        try:
+                            dead = sock.recv(64) == b""
+                        except (ConnectionError, OSError):
+                            dead = True
+                    if dead:
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        with self._lock:
+                            if self._sessions.get(addr) is sock:
+                                del self._sessions[addr]
+                        broken.add(addr)
+            else:
                 time.sleep(1.0)
-                continue
-            try:
-                readable, _, _ = select.select(list(socks.values()), [], [], 2.0)
-            except (OSError, ValueError):
-                readable = []
-            dead_addrs = []
-            for addr, sock in socks.items():
-                if sock in readable:
-                    try:
-                        if sock.recv(64) == b"":
-                            dead_addrs.append(addr)
-                    except (ConnectionError, OSError):
-                        dead_addrs.append(addr)
-            for addr in dead_addrs:
-                with self._lock:
-                    if self._sessions.get(addr) is not socks[addr]:
-                        continue  # already repaired/cleared
-                    try:
-                        socks[addr].close()
-                    except OSError:
-                        pass
-                    del self._sessions[addr]
-                    held = [oid for oid, a in self._borrows.items()
-                            if a == addr]
-                    try:
-                        self._sessions[addr] = self._open_session(addr)
-                        for oid in held:
-                            self._send("add", oid, addr)
-                        self.stats["session_repairs"] += 1
-                    except Exception:
-                        # Owner really gone: its store died with it, so
-                        # there is nothing left to protect.
-                        self.stats["send_failures"] += 1
+            broken |= {a for a in held_addrs if a not in self._sessions}
+            for addr in list(broken):
+                if addr not in held_addrs:
+                    broken.discard(addr)  # nothing borrowed there anymore
+                    continue
+                self._repair_session(addr)
+                if addr in self._sessions:
+                    broken.discard(addr)
+
+    def _repair_session(self, addr: str) -> None:
+        """Reconnect + re-register borrows for one owner; network I/O runs
+        lock-free, with a release fix-up for borrows dropped mid-repair."""
+        try:
+            sock = self._open_session(addr)
+        except Exception:
+            self.stats["send_failures"] += 1
+            return  # keeper retries next pass
+        with self._lock:
+            if addr in self._sessions:
+                try:
+                    sock.close()  # raced a concurrent _ensure_session
+                except OSError:
+                    pass
+                return
+            self._sessions[addr] = sock
+            held = [oid for oid, a in self._borrows.items() if a == addr]
+        for oid in held:
+            _send_borrow_op("add", oid, addr, self.borrower_id)
+        with self._lock:
+            dropped = [oid for oid in held if oid not in self._borrows]
+        for oid in dropped:
+            # Released while we were re-adding: undo the stale re-add.
+            _send_borrow_op("release", oid, addr, self.borrower_id)
+        self.stats["session_repairs"] += 1
 
     # ----------------------------------------------------------- borrower API
     def register(self, oid: ObjectID, owner_addr: str) -> None:

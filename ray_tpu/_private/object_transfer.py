@@ -238,16 +238,20 @@ class ObjectTransferServer:
                     with self._sessions_lock:
                         self._live_sessions[borrower] = \
                             self._live_sessions.get(borrower, 0) + 1
-                    conn.sendall(bytes([ST_OK]))
                     try:
+                        conn.sendall(bytes([ST_OK]))
                         while conn.recv(1):
                             pass  # borrowers never send; drain defensively
                     except (ConnectionError, OSError):
                         pass
-                    with self._sessions_lock:
-                        self._live_sessions[borrower] -= 1
-                        if self._live_sessions[borrower] <= 0:
-                            del self._live_sessions[borrower]
+                    finally:
+                        # MUST pair with the increment even when the ack
+                        # send fails, or this borrower id's reaps are
+                        # suppressed forever (count stuck > 0).
+                        with self._sessions_lock:
+                            self._live_sessions[borrower] -= 1
+                            if self._live_sessions[borrower] <= 0:
+                                del self._live_sessions[borrower]
                     if self._on_borrower_lost is not None \
                             and not self._stop.is_set():
                         self._reap_after_grace(borrower)

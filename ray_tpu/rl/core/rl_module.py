@@ -221,6 +221,99 @@ class DefaultActorCritic(RLModule):
     forward_inference = forward_exploration
 
 
+class CNNActorCritic(RLModule):
+    """Conv encoder + shared-torso actor-critic for PIXEL observations
+    (ref: rllib/core/models/configs.py:653 CNNEncoderConfig — the new
+    stack's conv encoder; the default Atari torso shape).
+
+    Env runners flatten observations to float32 vectors; this module
+    reshapes them back to ``obs_shape`` (H, W, C), scales to [0, 1], runs
+    the conv stack on the MXU-friendly NHWC layout, and feeds one shared
+    embedding to the policy and value heads (standard for pixel RL —
+    separate towers double the conv cost for no measured gain).
+
+    model_config:
+      obs_shape      (H, W, C) — required.
+      conv_filters   ((out_channels, kernel, stride), ...).
+      hiddens        dense widths after flattening.
+    """
+
+    def __init__(self, observation_dim, action_dim, discrete=True,
+                 obs_shape=None,
+                 conv_filters=((16, 4, 2), (32, 3, 1)),
+                 hiddens: Sequence[int] = (128,), **kw):
+        if obs_shape is None:
+            raise ValueError("CNNActorCritic requires model_config["
+                             "'obs_shape'] = (H, W, C)")
+        super().__init__(observation_dim, action_dim, discrete,
+                         obs_shape=tuple(obs_shape),
+                         conv_filters=tuple(map(tuple, conv_filters)),
+                         hiddens=tuple(hiddens), **kw)
+        self.obs_shape = tuple(obs_shape)
+        self.conv_filters = tuple(map(tuple, conv_filters))
+        self.hiddens = tuple(hiddens)
+
+    def _conv_out_dim(self) -> Tuple[int, int, int]:
+        h, w, c = self.obs_shape
+        for out_c, k, s in self.conv_filters:
+            h = (h - k) // s + 1
+            w = (w - k) // s + 1
+            c = out_c
+        return h, w, c
+
+    def init_params(self, key) -> Params:
+        orth = jax.nn.initializers.orthogonal
+        convs = []
+        in_c = self.obs_shape[-1]
+        for out_c, k, s in self.conv_filters:
+            key, sub = jax.random.split(key)
+            convs.append({
+                "w": orth(scale=float(np.sqrt(2.0)))(
+                    sub, (k, k, in_c, out_c), jnp.float32),
+                "b": jnp.zeros((out_c,), jnp.float32),
+            })
+            in_c = out_c
+        h, w, c = self._conv_out_dim()
+        key, k_torso, k_pi, k_vf = jax.random.split(key, 4)
+        torso = _mlp_init(k_torso, self.hiddens[:-1], self.hiddens[-1],
+                          h * w * c, out_scale=float(np.sqrt(2.0)))
+        return {
+            "convs": convs,
+            "torso": torso,
+            "pi": _mlp_init(k_pi, (), self.dist_input_dim, self.hiddens[-1],
+                            out_scale=0.01),
+            "vf": _mlp_init(k_vf, (), 1, self.hiddens[-1], out_scale=1.0),
+        }
+
+    def _embed(self, params, obs):
+        x = jnp.asarray(obs, jnp.float32)
+        # Learners batch as (B, T, obs_dim), runners as (N, obs_dim): fold
+        # every leading dim into the conv batch, restore after the torso.
+        lead = x.shape[:-1]
+        x = x.reshape((-1, *self.obs_shape)) / 255.0
+        for (_out_c, _k, s), layer in zip(self.conv_filters, params["convs"]):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(s, s), padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC")) + layer["b"]
+            x = jax.nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        z = jax.nn.relu(_mlp_apply(params["torso"], x))
+        return z.reshape((*lead, z.shape[-1]))
+
+    def forward_train(self, params, obs) -> Dict[str, Any]:
+        z = self._embed(params, obs)
+        return {
+            Columns.ACTION_DIST_INPUTS: _mlp_apply(params["pi"], z),
+            Columns.VF_PREDS: _mlp_apply(params["vf"], z)[..., 0],
+        }
+
+    def forward_exploration(self, params, obs) -> Dict[str, Any]:
+        z = self._embed(params, obs)
+        return {Columns.ACTION_DIST_INPUTS: _mlp_apply(params["pi"], z)}
+
+    forward_inference = forward_exploration
+
+
 class DefaultQModule(RLModule):
     """Q-network module for DQN (ref: rllib/algorithms/dqn/default_dqn_rl_module.py).
 
