@@ -23,6 +23,44 @@ from typing import Any, Optional, Sequence
 from ray_tpu._private import serialization
 
 
+class _ProxiedRefGenerator:
+    """Worker-side face of a driver-hosted ObjectRefGenerator: each pull is
+    one nested-API round trip returning the next yielded ObjectRef (VERDICT
+    r2 item 8 — streaming submission from process workers/ray:// drivers;
+    ref: _raylet.pyx streaming generator protocol)."""
+
+    def __init__(self, call, token: str):
+        self._call = call
+        self._token = token
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        kind, ref = self._call("gen_next", self._token)
+        if kind == "done":
+            self._done = True
+            raise StopIteration
+        return ref
+
+    def cancel(self) -> None:
+        if not self._done:
+            self._done = True
+            try:
+                self._call("gen_cancel", self._token)
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self.cancel()
+        except Exception:
+            pass
+
+
 class ClientRuntime:
     """Installed as the global runtime inside process workers."""
 
@@ -48,16 +86,16 @@ class ClientRuntime:
     # ------------------------------------------------------------ public API
     def submit_task(self, spec) -> Any:
         if spec.generator:
-            raise NotImplementedError(
-                "streaming-generator tasks cannot be submitted from inside a "
-                "process worker yet; submit from the driver")
+            token = self._call("submit_task_gen",
+                               serialization.dumps_inband(spec))
+            return _ProxiedRefGenerator(self._call, token)
         return self._call("submit_task", serialization.dumps_inband(spec))
 
     def submit_actor_task(self, actor_id, spec) -> Any:
         if spec.generator:
-            raise NotImplementedError(
-                "streaming-generator actor tasks cannot be submitted from "
-                "inside a process worker yet")
+            token = self._call("submit_actor_task_gen", actor_id,
+                               serialization.dumps_inband(spec))
+            return _ProxiedRefGenerator(self._call, token)
         return self._call("submit_actor_task", actor_id,
                           serialization.dumps_inband(spec))
 
@@ -148,6 +186,7 @@ def serve_backchannel(conn, describe: str = "") -> None:
     # (ref: reference_count.h borrower protocol — here the borrow lives until
     # the worker disconnects, which clears this dict).
     borrowed: dict = {}
+    state: dict = {"gens": {}}  # live proxied generators, per connection
     while True:
         try:
             msg = conn.recv_bytes()
@@ -159,10 +198,14 @@ def serve_backchannel(conn, describe: str = "") -> None:
             if runtime is None:
                 raise RuntimeError(
                     "driver runtime is gone; nested call cannot be served")
-            result = _handle(runtime, kind, payload)
+            result = _handle(runtime, kind, payload, state=state)
             sobj = serialization.serialize(result)
-            for r in sobj.contained_refs:
-                borrowed[r.id] = r
+            if kind != "gen_next":
+                # gen_next replies are pinned by their stream's token entry
+                # (released when the stream ends) — parking them here too
+                # would hold every streamed item for the CONNECTION's life.
+                for r in sobj.contained_refs:
+                    borrowed[r.id] = r
             reply = ("ok", sobj.to_bytes())
         except BaseException as e:  # noqa: BLE001 — errors cross the boundary
             import traceback
@@ -179,12 +222,48 @@ def serve_backchannel(conn, describe: str = "") -> None:
             return
 
 
-def _handle(runtime, kind: str, payload: tuple) -> Any:
+def _handle(runtime, kind: str, payload: tuple, state: dict = None) -> Any:
     if kind == "submit_task":
         return runtime.submit_task(serialization.loads(payload[0]))
     if kind == "submit_actor_task":
         return runtime.submit_actor_task(payload[0],
                                          serialization.loads(payload[1]))
+    if kind in ("submit_task_gen", "submit_actor_task_gen"):
+        # Streaming submission: host the driver-side ObjectRefGenerator,
+        # hand back a pull token (the worker iterates via gen_next).
+        import uuid
+
+        if state is None:
+            raise RuntimeError("streaming submission needs per-connection "
+                               "state (gen tokens)")
+        if kind == "submit_task_gen":
+            gen = runtime.submit_task(serialization.loads(payload[0]))
+        else:
+            gen = runtime.submit_actor_task(
+                payload[0], serialization.loads(payload[1]))
+        token = uuid.uuid4().hex[:16]
+        # refs: driver-side handles for yielded items, holding them alive
+        # until the STREAM ends (not the connection — a long-lived worker
+        # must not pin every item it ever streamed).
+        state.setdefault("gens", {})[token] = {"gen": gen, "refs": []}
+        return token
+    if kind == "gen_next":
+        entry = (state or {}).get("gens", {}).get(payload[0])
+        if entry is None:
+            raise ValueError(f"unknown or finished generator {payload[0]!r}")
+        try:
+            ref = next(entry["gen"])
+            entry["refs"].append(ref)
+            return ("item", ref)
+        except StopIteration:
+            state["gens"].pop(payload[0], None)
+            return ("done", None)
+        except BaseException:
+            state["gens"].pop(payload[0], None)
+            raise
+    if kind == "gen_cancel":
+        (state or {}).get("gens", {}).pop(payload[0], None)
+        return None
     if kind == "create_actor":
         return runtime.create_actor(serialization.loads(payload[0]))
     if kind == "put":

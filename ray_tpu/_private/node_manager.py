@@ -112,6 +112,8 @@ class RemoteNode:
         #: all race to declare a node dead; recovery must run once).
         self.lost_handled = False
         self.last_heartbeat = time.monotonic()
+        #: Per-node nested-API state (streaming-submission gen tokens).
+        self.gen_state: dict = {"gens": {}}
 
 
 class NodeManagerServer:
@@ -210,7 +212,8 @@ class NodeManagerServer:
         from ray_tpu._private.client_runtime import _handle
 
         try:
-            result = _handle(self._runtime, kind, payload)
+            result = _handle(self._runtime, kind, payload,
+                             state=node.gen_state)
             # wire_pins=True: refs in the reply take owner-side pins that
             # the worker's deserialization converts into real borrows — a
             # bounded lifetime, unlike parking every reply ref in a
@@ -274,9 +277,12 @@ class WorkerRuntime:
             return super().submit_actor_task(actor_id, spec)
         # Actor lives on another node: the head routes the call.
         if spec.generator:
-            raise NotImplementedError(
-                "streaming-generator calls on remote-node actors are not "
-                "supported yet; call from the driver")
+            from ray_tpu._private.client_runtime import _ProxiedRefGenerator
+
+            token = self._node.head_request(
+                "submit_actor_task_gen", actor_id,
+                serialization.dumps_inband(spec))
+            return _ProxiedRefGenerator(self._node.head_request, token)
         return self._node.head_request(
             "submit_actor_task", actor_id, serialization.dumps_inband(spec))
 

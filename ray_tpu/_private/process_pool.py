@@ -8,17 +8,26 @@ because on TPU hosts the chips are owned by one JAX client in the driver
 process and compute-bound work releases the GIL inside XLA anyway.
 
 Protocol per worker (spawn ctx; a fork after JAX/TPU init is unsafe):
-  driver -> worker: ("exec", seq, fn_id, fn_bytes|None, args_spec)
+  driver -> worker: ("exec"|"exec_gen", seq, fn_id, fn_bytes|None, args_spec)
+                    ("actor_call"|"actor_call_gen", seq, method, args_spec)
   worker -> driver: ("ok", seq, result_spec) | ("err", seq, flat_exc)
+                    | ("yield", seq, item_spec)   [streaming kinds]
 where a spec is ("inline", bytes) or ("plasma", key) — payloads above
 ``plasma_handoff_threshold`` travel through the native shared-memory arena
 (ray_tpu/native/src/plasma.cc) zero-copy instead of the pipe, the analogue of
 the reference passing ObjectIDs + plasma fds rather than bytes
 (ref: plasma/client.h, fling.cc).
+
+The pipe is MULTIPLEXED by seq: the driver side has one reader thread per
+worker routing replies to per-request queues, and the worker side runs
+exec/actor_call requests on threads (bounded) with a send lock — so a
+process actor with max_concurrency > 1 really executes concurrently, and
+streaming generators interleave with other requests (ref: core_worker's
+concurrent actor calls + streaming generator protocol, _raylet.pyx:1097).
 Functions are cached worker-side by fn_id so hot loops ship only args
 (ref: function table export via GCS KV, _private/function_manager.py).
 Leases are reused: a released worker goes back to the idle pool keyed by
-nothing (runtime-env keying can come with runtime envs).
+runtime-env hash.
 """
 
 from __future__ import annotations
@@ -127,8 +136,17 @@ def _worker_main(conn, arena_path: Optional[str], back_conn=None) -> None:
         install_runtime(ClientRuntime(
             back_conn, worker_id=f"proc-worker-{os.getpid()}"))
 
+    send_lock = threading.Lock()
+    #: Streams the driver abandoned (cancel/early error): the worker's
+    #: yield loops check membership and stop pumping the user generator.
+    stopped_streams: set = set()
+
+    def send(msg) -> None:
+        with send_lock:
+            conn.send_bytes(serialization.dumps(msg))
+
     def reply_ok(seq, payload):
-        conn.send_bytes(serialization.dumps(("ok", seq, payload)))
+        send(("ok", seq, payload))
 
     def reply_err(seq, e):
         import traceback
@@ -138,7 +156,82 @@ def _worker_main(conn, arena_path: Optional[str], back_conn=None) -> None:
             blob = serialization.dumps((e, tb))
         except Exception:
             blob = serialization.dumps((RuntimeError(repr(e)), tb))
-        conn.send_bytes(serialization.dumps(("err", seq, blob)))
+        send(("err", seq, blob))
+
+    def run_exec(seq, fn_id, fn_bytes, args_spec, streaming):
+        try:
+            if fn_id not in fn_cache:
+                if fn_bytes is not None:
+                    fn_cache[fn_id] = serialization.loads(fn_bytes)
+                else:
+                    # Concurrent first-use race: another in-flight request
+                    # carries the bytes; wait for its thread to cache them.
+                    deadline = time.monotonic() + 10
+                    while fn_id not in fn_cache:
+                        if time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"function {fn_id} never arrived")
+                        time.sleep(0.005)
+            fn = fn_cache[fn_id]
+            flat_args = _spec_take(arena, args_spec)
+            args, kwargs = serialization.deserialize_flat(memoryview(flat_args))
+            if streaming:
+                n = 0
+                for item in fn(*args, **kwargs):
+                    if seq in stopped_streams:
+                        break  # driver abandoned the stream
+                    payload = serialization.serialize(item).to_bytes()
+                    send(("yield", seq, _spec_put(
+                        arena, f"res:{os.getpid()}:{seq}:{n}", payload)))
+                    n += 1
+                stopped_streams.discard(seq)
+                reply_ok(seq, None)
+                return
+            result = fn(*args, **kwargs)
+            payload = serialization.serialize(result).to_bytes()
+            reply_ok(seq, _spec_put(arena, f"res:{os.getpid()}:{seq}", payload))
+        except BaseException as e:  # noqa: BLE001 — errors cross the boundary
+            reply_err(seq, e)
+
+    def run_actor_call(seq, method_name, args_spec, streaming):
+        try:
+            if actor_instance[0] is None:
+                raise RuntimeError("actor_call before actor_new")
+            method = getattr(actor_instance[0], method_name)
+            flat_args = _spec_take(arena, args_spec)
+            args, kwargs = serialization.deserialize_flat(memoryview(flat_args))
+            # Run under an actor-scoped task context so exit_actor() and
+            # get_runtime_context() work inside the method; _ActorExit
+            # crosses back via reply_err and is unwrapped driver-side.
+            with _actor_task_context(actor_instance[1]):
+                if streaming:
+                    n = 0
+                    for item in method(*args, **kwargs):
+                        if seq in stopped_streams:
+                            break  # driver abandoned the stream
+                        payload = serialization.serialize(item).to_bytes()
+                        send(("yield", seq, _spec_put(
+                            arena, f"res:{os.getpid()}:{seq}:{n}", payload)))
+                        n += 1
+                    stopped_streams.discard(seq)
+                    reply_ok(seq, None)
+                    return
+                result = method(*args, **kwargs)
+            payload = serialization.serialize(result).to_bytes()
+            reply_ok(seq, _spec_put(arena, f"res:{os.getpid()}:{seq}", payload))
+        except BaseException as e:  # noqa: BLE001
+            reply_err(seq, e)
+
+    #: Bound on concurrent in-worker requests (actor max_concurrency is
+    #: enforced by the driver's mailbox threads; this is a backstop).
+    work_sem = threading.BoundedSemaphore(64)
+
+    def spawn(target, *args):
+        def run():
+            with work_sem:
+                target(*args)
+
+        threading.Thread(target=run, daemon=True).start()
 
     while True:
         try:
@@ -158,19 +251,12 @@ def _worker_main(conn, arena_path: Optional[str], back_conn=None) -> None:
                 reply_ok(0, None)
             except BaseException as e:  # noqa: BLE001
                 reply_err(0, e)
-        elif kind == "exec":
+        elif kind in ("exec", "exec_gen"):
             _, seq, fn_id, fn_bytes, args_spec = req
-            try:
-                if fn_id not in fn_cache:
-                    fn_cache[fn_id] = serialization.loads(fn_bytes)
-                fn = fn_cache[fn_id]
-                flat_args = _spec_take(arena, args_spec)
-                args, kwargs = serialization.deserialize_flat(memoryview(flat_args))
-                result = fn(*args, **kwargs)
-                payload = serialization.serialize(result).to_bytes()
-                reply_ok(seq, _spec_put(arena, f"res:{os.getpid()}:{seq}", payload))
-            except BaseException as e:  # noqa: BLE001 — errors cross the boundary
-                reply_err(seq, e)
+            # Off-thread: concurrent requests (max_concurrency > 1 actors,
+            # interleaved streams) must not serialize behind one another.
+            spawn(run_exec, seq, fn_id, fn_bytes, args_spec,
+                  kind == "exec_gen")
         elif kind == "actor_new":
             # This worker becomes a dedicated actor host: instantiate the
             # class and hold it for the worker's lifetime (ref: the reference
@@ -186,23 +272,12 @@ def _worker_main(conn, arena_path: Optional[str], back_conn=None) -> None:
                 reply_ok(seq, None)
             except BaseException as e:  # noqa: BLE001
                 reply_err(seq, e)
-        elif kind == "actor_call":
+        elif kind in ("actor_call", "actor_call_gen"):
             _, seq, method_name, args_spec = req
-            try:
-                if actor_instance[0] is None:
-                    raise RuntimeError("actor_call before actor_new")
-                method = getattr(actor_instance[0], method_name)
-                flat_args = _spec_take(arena, args_spec)
-                args, kwargs = serialization.deserialize_flat(memoryview(flat_args))
-                # Run under an actor-scoped task context so exit_actor() and
-                # get_runtime_context() work inside the method; _ActorExit
-                # crosses back via reply_err and is unwrapped driver-side.
-                with _actor_task_context(actor_instance[1]):
-                    result = method(*args, **kwargs)
-                payload = serialization.serialize(result).to_bytes()
-                reply_ok(seq, _spec_put(arena, f"res:{os.getpid()}:{seq}", payload))
-            except BaseException as e:  # noqa: BLE001
-                reply_err(seq, e)
+            spawn(run_actor_call, seq, method_name, args_spec,
+                  kind == "actor_call_gen")
+        elif kind == "gen_stop":
+            stopped_streams.add(req[1])
         elif kind == "shutdown":
             return
 
@@ -295,57 +370,130 @@ class _ProcWorker:
         self._back_thread.start()
         self._arena = arena  # the pool's shared driver-side client
         import itertools
+        import queue as queue_mod
 
         self._seq_counter = itertools.count(1)  # GIL-atomic next()
-        self.seq = 0
         self.sent_fns: set = set()
         self.last_used = time.monotonic()
-        # One request in flight per worker: actor mailboxes may run with
-        # max_concurrency > 1 but the pipe protocol is strictly serial.
-        self._req_lock = threading.Lock()
+        # The pipe is seq-multiplexed: sends serialize under this lock; a
+        # reader thread routes replies (ok/err/yield) to per-seq queues, so
+        # max_concurrency > 1 actors and interleaved streams really overlap.
+        self._send_lock = threading.Lock()
+        self._pending: Dict[int, "queue_mod.SimpleQueue"] = {}
+        self._pending_lock = threading.Lock()
+        self._dead = False
+        self._queue_mod = queue_mod
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"procworker-read-{self.proc.pid}",
+            daemon=True)
+        self._reader.start()
         if env_payload is not None:
             from ray_tpu.exceptions import TaskError
 
-            self.conn.send_bytes(
-                serialization.dumps(("setup_env", env_payload)))
-            kind, _, payload = serialization.loads(self.conn.recv_bytes())
+            q = self._register(0)
+            with self._send_lock:
+                self.conn.send_bytes(
+                    serialization.dumps(("setup_env", env_payload)))
+            kind, payload = q.get()
+            self._unregister(0)
             if kind == "err":
                 exc, tb = serialization.loads(payload)
                 self.kill()
                 raise TaskError(exc, tb=tb)
+            if kind == "crash":
+                self.kill()
+                raise RuntimeError("process worker died during env setup")
 
-    def _roundtrip(self, kind: str, header_rest: tuple, args: tuple,
-                   kwargs: dict, has_result: bool = True) -> Any:
-        """Ship one request ((kind, seq, *header_rest) + serialized args),
-        await the reply.  The seq is allocated here so the crash-path
-        cleanup below always names THIS request's result key, not another
-        thread's (the request itself is serialized by _req_lock).
+    # ----------------------------------------------------------- multiplexer
+    def _register(self, seq: int):
+        q = self._queue_mod.SimpleQueue()
+        with self._pending_lock:
+            if self._dead:
+                q.put(("crash", None))
+            self._pending[seq] = q
+        return q
 
-        Raises WorkerCrashedError if the process dies, TaskError on a
-        worker-side exception."""
-        from ray_tpu.exceptions import TaskError, WorkerCrashedError
+    def _unregister(self, seq: int) -> None:
+        with self._pending_lock:
+            self._pending.pop(seq, None)
 
+    def _read_loop(self) -> None:
+        while True:
+            try:
+                reply = serialization.loads(self.conn.recv_bytes())
+            except (EOFError, OSError):
+                break
+            except Exception:
+                break
+            rkind, seq, payload = reply
+            with self._pending_lock:
+                q = self._pending.get(seq)
+            if q is not None:
+                q.put((rkind, payload))
+            elif rkind == "yield":
+                # Stream abandoned before this item arrived: a plasma
+                # payload would otherwise pin arena memory forever.
+                _spec_cleanup(self._arena, payload)
+        # Worker gone: wake every in-flight request with a crash marker.
+        with self._pending_lock:
+            self._dead = True
+            waiters = list(self._pending.values())
+        for q in waiters:
+            q.put(("crash", None))
+
+    def _submit(self, kind: str, header_rest: tuple, args: tuple,
+                kwargs: dict):
+        """Ship one request; returns (seq, queue, args_spec)."""
         arena = self._arena
         seq = next(self._seq_counter)  # GIL-atomic
-        self.seq = seq
         flat_args = serialization.serialize((args, kwargs)).to_bytes()
         args_spec = _spec_put(arena, _next_handoff_key("args"), flat_args)
         header = (kind, seq) + header_rest
+        q = self._register(seq)
         try:
-            with self._req_lock:
+            with self._send_lock:
                 self.conn.send_bytes(serialization.dumps(header + (args_spec,)))
-                reply = serialization.loads(self.conn.recv_bytes())
         except (EOFError, OSError) as e:
-            # Worker died. Reclaim the args if unconsumed, and the result
-            # object if the worker got far enough to produce one before
-            # dying (its key is derivable: worker pid + this seq) — a
-            # sealed-but-unreported result would otherwise pin arena memory
-            # forever (refcount 1 blocks LRU eviction).
+            from ray_tpu.exceptions import WorkerCrashedError
+
+            self._unregister(seq)
+            _spec_cleanup(arena, args_spec)
+            raise WorkerCrashedError(f"process worker died: {e}") from e
+        return seq, q, args_spec
+
+    def _raise_reply_error(self, payload):
+        from ray_tpu.exceptions import TaskError
+        from ray_tpu._private.runtime import _ActorExit
+
+        exc, tb = serialization.loads(payload)
+        if isinstance(exc, _ActorExit):
+            # exit_actor() inside a process actor: re-raise unwrapped so the
+            # runtime's actor FSM sees it (runtime.py _execute_actor_task).
+            raise exc
+        raise TaskError(exc, tb=tb)
+
+    def _roundtrip(self, kind: str, header_rest: tuple, args: tuple,
+                   kwargs: dict, has_result: bool = True) -> Any:
+        """One request/reply over the multiplexed pipe.
+
+        Raises WorkerCrashedError if the process dies, TaskError on a
+        worker-side exception."""
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        arena = self._arena
+        seq, q, args_spec = self._submit(kind, header_rest, args, kwargs)
+        try:
+            rkind, payload = q.get()
+        finally:
+            self._unregister(seq)
+        self.last_used = time.monotonic()
+        if rkind == "crash":
+            # Reclaim the args if unconsumed, and the result object if the
+            # worker got far enough to produce one before dying — a sealed-
+            # but-unreported result would otherwise pin arena memory forever.
             _spec_cleanup(arena, args_spec)
             _spec_cleanup(arena, ("plasma", f"res:{self.proc.pid}:{seq}"))
-            raise WorkerCrashedError(f"process worker died: {e}") from e
-        rkind, _seq, payload = reply
-        self.last_used = time.monotonic()
+            raise WorkerCrashedError("process worker died")
         if rkind == "ok":
             # The worker reached the result, so it consumed the args spec.
             if not has_result or payload is None:
@@ -354,20 +502,64 @@ class _ProcWorker:
                 memoryview(_spec_take(arena, payload)))
         # Error may have struck before the worker consumed the args.
         _spec_cleanup(arena, args_spec)
-        exc, tb = serialization.loads(payload)
-        from ray_tpu._private.runtime import _ActorExit
+        self._raise_reply_error(payload)
 
-        if isinstance(exc, _ActorExit):
-            # exit_actor() inside a process actor: re-raise unwrapped so the
-            # runtime's actor FSM sees it (runtime.py _execute_actor_task).
-            raise exc
-        raise TaskError(exc, tb=tb)
+    def _stream(self, kind: str, header_rest: tuple, args: tuple,
+                kwargs: dict):
+        """Streaming request: yields items as the worker produces them;
+        terminates on the worker's ok (end) / err (raised) / crash."""
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        arena = self._arena
+        seq, q, args_spec = self._submit(kind, header_rest, args, kwargs)
+        finished = False
+        try:
+            while True:
+                rkind, payload = q.get()
+                self.last_used = time.monotonic()
+                if rkind == "yield":
+                    yield serialization.deserialize_flat(
+                        memoryview(_spec_take(arena, payload)))
+                    continue
+                if rkind == "ok":
+                    finished = True
+                    return
+                finished = True
+                if rkind == "crash":
+                    _spec_cleanup(arena, args_spec)
+                    raise WorkerCrashedError("process worker died mid-stream")
+                _spec_cleanup(arena, args_spec)
+                self._raise_reply_error(payload)
+        finally:
+            self._unregister(seq)
+            if not finished:
+                # Consumer abandoned the stream (cancel / early close):
+                # tell the worker to stop pumping; items already in our
+                # queue are reclaimed here, late ones by the reader's
+                # dropped-yield cleanup.
+                try:
+                    with self._send_lock:
+                        self.conn.send_bytes(
+                            serialization.dumps(("gen_stop", seq)))
+                except (EOFError, OSError):
+                    pass
+                while not q.empty():
+                    rkind, payload = q.get()
+                    if rkind == "yield":
+                        _spec_cleanup(arena, payload)
 
     def execute(self, fn_id: str, fn_bytes: bytes, args: tuple, kwargs: dict) -> Any:
         """Run one task; raises WorkerCrashedError if the process dies."""
         send_fn = fn_bytes if fn_id not in self.sent_fns else None
         self.sent_fns.add(fn_id)
         return self._roundtrip("exec", (fn_id, send_fn), args, kwargs)
+
+    def execute_gen(self, fn_id: str, fn_bytes: bytes, args: tuple,
+                    kwargs: dict):
+        """Run one GENERATOR task; yields items as the worker sends them."""
+        send_fn = fn_bytes if fn_id not in self.sent_fns else None
+        self.sent_fns.add(fn_id)
+        return self._stream("exec_gen", (fn_id, send_fn), args, kwargs)
 
     def actor_new(self, cls_bytes: bytes, actor_id: str, args: tuple,
                   kwargs: dict) -> None:
@@ -378,6 +570,10 @@ class _ProcWorker:
     def actor_call(self, method_name: str, args: tuple, kwargs: dict) -> Any:
         """Invoke a method on the worker-resident actor instance."""
         return self._roundtrip("actor_call", (method_name,), args, kwargs)
+
+    def actor_call_gen(self, method_name: str, args: tuple, kwargs: dict):
+        """Invoke a GENERATOR method; yields items as the worker sends them."""
+        return self._stream("actor_call_gen", (method_name,), args, kwargs)
 
     def alive(self) -> bool:
         return self.proc.is_alive()

@@ -65,11 +65,6 @@ class RemoteFunction:
         num_returns = opts["num_returns"]
         if not isinstance(num_returns, int):
             num_returns = 1
-        if generator and opts.get("runtime_env"):
-            # Generators stream through the in-driver generator pump;
-            # runtime envs require the process tier. Loud beats silent.
-            raise ValueError(
-                "runtime_env is not supported on generator tasks yet")
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             name=opts.get("name") or self.__name__,

@@ -17,16 +17,10 @@ from __future__ import annotations
 
 import numpy as np
 
-try:
-    import gymnasium as gym
-
-    _BASE = gym.Env
-except Exception:  # pragma: no cover - gymnasium is in the image
-    gym = None
-    _BASE = object
+import gymnasium as gym  # required: spaces + Env are load-bearing
 
 
-class PixelGridworld(_BASE):
+class PixelGridworld(gym.Env):
     metadata = {"render_modes": []}
 
     def __init__(self, n: int = 5, cell: int = 2, max_steps: int = 30,

@@ -70,19 +70,13 @@ class ReplicaWrapper:
             from ray_tpu.serve.replica import SyncReplicaActor
 
             actor_cls = SyncReplicaActor
-            if info.config.max_ongoing_requests > 1:
-                import logging
-
-                logging.getLogger("ray_tpu.serve").warning(
-                    "deployment %s: process-tier replicas execute one request "
-                    "at a time (max_ongoing_requests=%d is per-replica "
-                    "concurrency only on the thread tier); scale with "
-                    "num_replicas instead", info.name,
-                    info.config.max_ongoing_requests)
         else:
             actor_cls = ReplicaActor
-            opts.setdefault("max_concurrency",
-                            max(1, info.config.max_ongoing_requests))
+        # Real per-replica concurrency on BOTH tiers: thread replicas via
+        # mailbox threads; process replicas via the seq-multiplexed worker
+        # pipe + in-worker threads (process_pool.py).
+        opts.setdefault("max_concurrency",
+                        max(1, info.config.max_ongoing_requests))
         self.actor = ray_tpu.remote(actor_cls).options(**opts).remote(
             info.name, self.replica_id, info.deployment_def,
             info.init_args, dict(info.init_kwargs),

@@ -156,3 +156,30 @@ def test_streaming_process_tier_replica(serve_instance):
     import os as _os
 
     assert items[0]["pid"] != _os.getpid()
+
+
+def test_process_replica_concurrent_requests(serve_instance):
+    """max_ongoing_requests > 1 on a PROCESS-TIER replica overlaps requests
+    for real (the worker pipe is seq-multiplexed and the worker threads its
+    calls) — the r2 one-request-at-a-time limitation is gone."""
+    import time as _time
+
+    @serve.deployment(ray_actor_options={"isolation": "process"},
+                      max_ongoing_requests=3)
+    class SlowProc:
+        def __call__(self, s):
+            import time
+
+            time.sleep(float(s))
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(SlowProc.bind(), name="slowproc", route_prefix=None)
+    handle.remote(0.01).result(timeout_s=60)  # absorb worker spawn cost
+    t0 = _time.monotonic()
+    rs = [handle.remote(0.8) for _ in range(3)]
+    pids = {r.result(timeout_s=60) for r in rs}
+    wall = _time.monotonic() - t0
+    assert len(pids) == 1
+    assert wall < 2.0, f"process replica serialized requests: {wall:.1f}s"
