@@ -37,6 +37,69 @@ class ServeController:
         if self._loop_task is None:
             self._loop_task = asyncio.get_running_loop().create_task(
                 self.run_control_loop())
+            self._restore_persisted_apps()
+
+    # ------------------------------------------------------ app persistence
+    # Deployed applications survive a head restart when the internal KV is
+    # WAL-backed (RAY_TPU_KV_PERSIST=1): each deploy/delete writes the app
+    # record to the "serve" namespace; a fresh controller redeploys them
+    # (ref: the reference's GCS-checkpointed serve controller state —
+    # serve/_private/application_state.py + test_gcs_fault_tolerance.py).
+    _KV_NS = "serve-apps"
+
+    def _persist_app(self, app_name: str, record: dict) -> None:
+        from ray_tpu._private import serialization
+        from ray_tpu.experimental import internal_kv as kv
+
+        try:
+            kv._internal_kv_put(app_name, serialization.dumps(record),
+                                namespace=self._KV_NS)
+        except Exception:
+            pass  # persistence is best-effort; serving must not fail on it
+
+    def _unpersist_app(self, app_name: str) -> None:
+        from ray_tpu.experimental import internal_kv as kv
+
+        try:
+            kv._internal_kv_del(app_name, namespace=self._KV_NS)
+        except Exception:
+            pass
+
+    def _restore_persisted_apps(self) -> None:
+        from ray_tpu._private import serialization
+        from ray_tpu.experimental import internal_kv as kv
+
+        try:
+            names = kv._internal_kv_list("", namespace=self._KV_NS)
+        except Exception:
+            return
+        for name in names:
+            try:
+                record = serialization.loads(
+                    kv._internal_kv_get(name, namespace=self._KV_NS))
+                for d in record["deployments"]:
+                    info = DeploymentInfo(
+                        name=d["name"], app_name=record["app_name"],
+                        deployment_def=d["deployment_def"],
+                        init_args=tuple(d.get("init_args", ())),
+                        init_kwargs=dict(d.get("init_kwargs", {})),
+                        config=d.get("config") or DeploymentConfig(),
+                        route_prefix=record["route_prefix"])
+                    self._manager.deploy(info)
+                self._apps[record["app_name"]] = {
+                    "route_prefix": record["route_prefix"],
+                    "deployments": sorted(d["name"]
+                                          for d in record["deployments"]),
+                    "ingress": record["ingress"],
+                    "streaming": record.get("streaming", False),
+                }
+            except Exception:  # noqa: BLE001 — a bad record must not wedge
+                import logging
+
+                logging.getLogger("ray_tpu.serve").exception(
+                    "failed to restore persisted serve app %r", name)
+        if names:
+            self._broadcast_routes()
 
     # ------------------------------------------------------------ app deploy
     async def deploy_application(self, app_name: str, route_prefix: Optional[str],
@@ -66,12 +129,18 @@ class ServeController:
             "ingress": ingress_name,
             "streaming": bool(ingress_streaming),
         }
+        self._persist_app(app_name, {
+            "app_name": app_name, "route_prefix": route_prefix,
+            "ingress": ingress_name, "streaming": bool(ingress_streaming),
+            "deployments": deployments,
+        })
         self._broadcast_routes()
 
     async def delete_application(self, app_name: str) -> None:
         app = self._apps.pop(app_name, None)
         if not app:
             return
+        self._unpersist_app(app_name)
         for name in app["deployments"]:
             self._manager.delete(f"{app_name}#{name}")
         self._broadcast_routes()
@@ -166,8 +235,11 @@ class ServeController:
     def list_applications(self) -> List[str]:
         return sorted(self._apps)
 
-    def get_deployment_status(self) -> Dict[str, Dict[str, Any]]:
-        """(ref: serve.status() — DeploymentStatus per deployment)"""
+    async def get_deployment_status(self) -> Dict[str, Dict[str, Any]]:
+        """(ref: serve.status() — DeploymentStatus per deployment).  Async
+        so it can kick the control loop (and the persisted-app restore) for
+        callers that query before any deploy/long-poll touched it."""
+        await self._ensure_loop()
         out = {}
         for dep_id, state in self._manager.deployments.items():
             running = state.num_running()

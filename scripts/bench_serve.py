@@ -1,0 +1,89 @@
+"""Serve benchmark artifact (VERDICT r2 item 9): router latency + HTTP
+streaming throughput, written to BENCH_SERVE.json (ref:
+release/microbenchmark/run_microbenchmark.py pattern).
+
+Usage: python scripts/bench_serve.py [--requests 300]
+"""
+
+import argparse
+import http.client
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=300)
+    ap.add_argument("--stream-tokens", type=int, default=2000)
+    ap.add_argument("--out", default="BENCH_SERVE.json")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start(http_options={"port": 0})
+
+    @serve.deployment(max_ongoing_requests=8)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind(), name="bench_echo", route_prefix=None)
+    handle.remote(0).result(timeout_s=60)  # warm
+
+    # ---- unary handle round-trip latency through the pow-2 router
+    lat = []
+    for i in range(args.requests):
+        t0 = time.perf_counter()
+        assert handle.remote(i).result(timeout_s=30) == i
+        lat.append((time.perf_counter() - t0) * 1000)
+    lat = np.asarray(lat)
+
+    # ---- HTTP streaming throughput (tokens/s through the chunked proxy)
+    @serve.deployment
+    class Tokens:
+        def __call__(self, request):
+            n = int(request.query_params.get("n", "100"))
+            for i in range(n):
+                yield f"tok{i} "
+
+    serve.run(Tokens.bind(), name="bench_stream", route_prefix="/bstream")
+    from ray_tpu.serve.api import _state
+
+    opts = _state["proxy"]._options
+    # Warm the stream path once, then time request->last-byte wall clock.
+    conn = http.client.HTTPConnection(opts.host, opts.port, timeout=120)
+    conn.request("GET", "/bstream?n=10")
+    conn.getresponse().read()
+    conn.close()
+    t0 = time.perf_counter()
+    conn = http.client.HTTPConnection(opts.host, opts.port, timeout=120)
+    conn.request("GET", f"/bstream?n={args.stream_tokens}")
+    body = conn.getresponse().read()
+    stream_s = time.perf_counter() - t0
+    ntok = len(body.split())
+    conn.close()
+
+    artifact = {
+        "router_unary_p50_ms": round(float(np.percentile(lat, 50)), 3),
+        "router_unary_p99_ms": round(float(np.percentile(lat, 99)), 3),
+        "router_unary_qps": round(args.requests / (lat.sum() / 1000), 1),
+        "http_stream_tokens_per_s": round(ntok / stream_s, 1),
+        "requests": args.requests,
+        "stream_tokens": ntok,
+    }
+    serve.shutdown()
+    ray_tpu.shutdown()
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(json.dumps(artifact))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Child for the head-restart crash test (two phases, one session dir).
+
+Phase "crash": bring up a WAL-backed head (persistent KV + serve app +
+half-finished workflow), print READY, and park until SIGKILLed.
+Phase "restore": same session dir; assert KV + serve app + workflow all
+come back (ref: python/ray/tests/test_gcs_fault_tolerance.py).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build_app():
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, request):
+            return {"echo": "alive"}
+
+    return serve.run(Echo.bind(), name="persist_app", route_prefix="/persist")
+
+
+def main() -> None:
+    phase = sys.argv[1]
+    session_dir = sys.argv[2]
+
+    import ray_tpu
+    from ray_tpu import serve, workflow
+    from ray_tpu.experimental import internal_kv as kv
+
+    ray_tpu.init(num_cpus=4, _system_config={
+        "kv_persist": True, "session_dir": session_dir})
+    workflow.init_storage(os.path.join(session_dir, "wf"))
+
+    if phase == "crash":
+        kv._internal_kv_put("alpha", "1", namespace="crashns")
+        kv._internal_kv_put("beta", "2", namespace="crashns")
+        serve.start(http_options={"port": 0})
+        build_app()
+
+        # Half-finished workflow: step one checkpoints, step two dies while
+        # a marker file is present (removed before the restore phase).
+        marker = os.path.join(session_dir, "fail_step2")
+        open(marker, "w").close()
+
+        @ray_tpu.remote
+        def step1(x):
+            return x + 1
+
+        @ray_tpu.remote
+        def step2(x, marker=marker):
+            if os.path.exists(marker):
+                raise RuntimeError("injected step2 failure")
+            return x * 10
+
+        try:
+            workflow.run(step2.bind(step1.bind(4)), workflow_id="wf-crash")
+        except Exception:
+            pass  # expected: step2 fails, step1's checkpoint is durable
+        print("READY", flush=True)
+        import time
+
+        while True:  # parent SIGKILLs us here — no cleanup runs
+            time.sleep(1)
+
+    # ---- phase == "restore": a fresh head over the same WAL/session -----
+    assert kv._internal_kv_get("alpha", namespace="crashns") == b"1"
+    assert kv._internal_kv_get("beta", namespace="crashns") == b"2"
+    print("KV-OK", flush=True)
+
+    serve.start(http_options={"port": 0})
+    import time
+
+    from ray_tpu.serve.api import _state, _wait_for_application
+
+    # The controller restores the persisted app; wait for it to be healthy
+    # and answer a real request.
+    _wait_for_application("persist_app", timeout_s=60.0)
+    import json
+    import urllib.request
+
+    addr = _state["proxy"].address
+    out = json.load(urllib.request.urlopen(f"{addr}/persist", timeout=30))
+    assert out == {"echo": "alive"}, out
+    print("SERVE-OK", flush=True)
+
+    # Workflow resume: step1's checkpoint is reused (step2 now succeeds).
+    @ray_tpu.remote
+    def step1(x):
+        raise AssertionError("step1 must come from its checkpoint")
+
+    marker = os.path.join(session_dir, "fail_step2")
+    if os.path.exists(marker):
+        os.remove(marker)
+    result = workflow.resume("wf-crash")
+    assert result == 50, result
+    print("WORKFLOW-OK", flush=True)
+    ray_tpu.shutdown()
+    print("RESTORE-DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()
