@@ -52,7 +52,18 @@ class ServeController:
         from ray_tpu.experimental import internal_kv as kv
 
         try:
-            kv._internal_kv_put(app_name, serialization.dumps(record),
+            sobj = serialization.serialize(record)
+            if sobj.contained_refs:
+                # ObjectRefs in init args reference THIS process's objects;
+                # a restored head could never resolve them — skip, loudly.
+                import logging
+
+                logging.getLogger("ray_tpu.serve").warning(
+                    "app %r binds ObjectRef init args; it will NOT be "
+                    "restored after a head restart (pass plain values or "
+                    "re-deploy after restarts)", app_name)
+                return
+            kv._internal_kv_put(app_name, sobj.to_bytes(),
                                 namespace=self._KV_NS)
         except Exception:
             pass  # persistence is best-effort; serving must not fail on it
@@ -75,16 +86,22 @@ class ServeController:
             return
         for name in names:
             try:
-                record = serialization.loads(
-                    kv._internal_kv_get(name, namespace=self._KV_NS))
-                for d in record["deployments"]:
-                    info = DeploymentInfo(
+                record = serialization.deserialize_flat(memoryview(
+                    kv._internal_kv_get(name, namespace=self._KV_NS)))
+                # Build EVERY DeploymentInfo before deploying ANY: a bad
+                # second deployment must not leave the first one running
+                # as an orphan with no _apps entry to delete it through.
+                infos = [
+                    DeploymentInfo(
                         name=d["name"], app_name=record["app_name"],
                         deployment_def=d["deployment_def"],
                         init_args=tuple(d.get("init_args", ())),
                         init_kwargs=dict(d.get("init_kwargs", {})),
                         config=d.get("config") or DeploymentConfig(),
                         route_prefix=record["route_prefix"])
+                    for d in record["deployments"]
+                ]
+                for info in infos:
                     self._manager.deploy(info)
                 self._apps[record["app_name"]] = {
                     "route_prefix": record["route_prefix"],
@@ -137,6 +154,9 @@ class ServeController:
         self._broadcast_routes()
 
     async def delete_application(self, app_name: str) -> None:
+        # Restore first: deleting right after a head restart must remove
+        # the PERSISTED app too, not miss it and let it resurrect later.
+        await self._ensure_loop()
         app = self._apps.pop(app_name, None)
         if not app:
             return
@@ -229,10 +249,12 @@ class ServeController:
         return await self._long_poll.listen_for_change(keys_to_snapshot_ids,
                                                        timeout_s)
 
-    def get_app_config(self, app_name: str) -> Optional[Dict[str, Any]]:
+    async def get_app_config(self, app_name: str) -> Optional[Dict[str, Any]]:
+        await self._ensure_loop()  # restore persisted apps before answering
         return self._apps.get(app_name)
 
-    def list_applications(self) -> List[str]:
+    async def list_applications(self) -> List[str]:
+        await self._ensure_loop()
         return sorted(self._apps)
 
     async def get_deployment_status(self) -> Dict[str, Dict[str, Any]]:

@@ -16,20 +16,40 @@ CHILD = os.path.join(os.path.dirname(__file__), "_head_restart_child.py")
 def _run_phase(phase: str, session_dir: str, wait_ready: bool):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # stderr merges into stdout: an undrained stderr pipe filling up would
+    # block the child before READY while the parent blocks in readline.
     proc = subprocess.Popen(
         [sys.executable, CHILD, phase, session_dir], env=env,
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
     if not wait_ready:
         return proc
+
+    import queue
+    import threading
+
+    lines: "queue.Queue" = queue.Queue()
+
+    def pump():
+        for line in proc.stdout:
+            lines.put(line)
+        lines.put(None)
+
+    threading.Thread(target=pump, daemon=True).start()
     deadline = time.time() + 120
+    seen = []
     while time.time() < deadline:
-        line = proc.stdout.readline()
+        try:
+            line = lines.get(timeout=max(0.1, deadline - time.time()))
+        except queue.Empty:
+            break
+        if line is None:
+            break
+        seen.append(line)
         if line.strip() == "READY":
             return proc
-        if proc.poll() is not None:
-            break
-    out, err = proc.communicate(timeout=10)
-    raise AssertionError(f"crash phase never reached READY:\n{out}\n{err}")
+    proc.kill()
+    raise AssertionError(
+        f"crash phase never reached READY:\n{''.join(seen)}")
 
 
 def test_head_kill9_then_restore():
