@@ -276,6 +276,41 @@ def _worker_main(conn, arena_path: Optional[str], back_conn=None) -> None:
             _, seq, method_name, args_spec = req
             spawn(run_actor_call, seq, method_name, args_spec,
                   kind == "actor_call_gen")
+        elif kind == "actor_exec":
+            # Run an arbitrary shipped function against the resident actor
+            # instance (compiled-DAG executor loops live here: long-running,
+            # multiplexed beside ordinary calls).
+            _, seq, fn_bytes, args_spec = req
+
+            def run_actor_exec(seq=seq, fn_bytes=fn_bytes,
+                               args_spec=args_spec):
+                try:
+                    if actor_instance[0] is None:
+                        raise RuntimeError("actor_exec before actor_new")
+                    if arena is not None:
+                        # Unpickled shm channels attach by path: reuse THIS
+                        # worker's client instead of opening a second mmap.
+                        try:
+                            from ray_tpu.dag.channel import seed_arena_client
+
+                            seed_arena_client(arena.path, arena)
+                        except Exception:
+                            pass
+                    fn = serialization.loads(fn_bytes)
+                    flat = _spec_take(arena, args_spec)
+                    args, kwargs = serialization.deserialize_flat(
+                        memoryview(flat))
+                    with _actor_task_context(
+                            actor_instance[1] if len(actor_instance) > 1
+                            else None):
+                        result = fn(actor_instance[0], *args, **kwargs)
+                    payload = serialization.serialize(result).to_bytes()
+                    reply_ok(seq, _spec_put(
+                        arena, f"res:{os.getpid()}:{seq}", payload))
+                except BaseException as e:  # noqa: BLE001
+                    reply_err(seq, e)
+
+            spawn(run_actor_exec)
         elif kind == "gen_stop":
             stopped_streams.add(req[1])
         elif kind == "shutdown":
@@ -574,6 +609,11 @@ class _ProcWorker:
     def actor_call_gen(self, method_name: str, args: tuple, kwargs: dict):
         """Invoke a GENERATOR method; yields items as the worker sends them."""
         return self._stream("actor_call_gen", (method_name,), args, kwargs)
+
+    def actor_exec(self, fn_bytes: bytes, args: tuple, kwargs: dict) -> Any:
+        """Run fn(instance, *args, **kwargs) against the worker-resident
+        actor instance (compiled-DAG resident loops)."""
+        return self._roundtrip("actor_exec", (fn_bytes,), args, kwargs)
 
     def alive(self) -> bool:
         return self.proc.is_alive()

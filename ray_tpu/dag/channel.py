@@ -106,27 +106,74 @@ class DeviceChannel(Channel):
         return jax.tree_util.tree_map(move, value)
 
 
+#: Process-wide arena clients keyed by path: channels that cross processes
+#: pickle their PATH and re-attach lazily wherever they land.
+_ARENA_CLIENTS: dict = {}
+_ARENA_LOCK = threading.Lock()
+
+
+def seed_arena_client(path: str, client) -> None:
+    """Register an existing client (e.g. the object store's) so channels in
+    this process reuse it instead of opening a second mmap."""
+    with _ARENA_LOCK:
+        _ARENA_CLIENTS.setdefault(path, client)
+
+
+def _arena_for(path: str):
+    with _ARENA_LOCK:
+        client = _ARENA_CLIENTS.get(path)
+        if client is None:
+            from ray_tpu.native.plasma import PlasmaClient
+
+            client = _ARENA_CLIENTS[path] = PlasmaClient(path, create=False)
+        return client
+
+
 class SharedMemoryChannel:
     """Cross-process channel over the native plasma arena: each element is a
-    sealed shm object keyed ``<name>:<seq>``; the reader busy-waits on the
-    next seq with the arena's blocking get (ref: shared_memory_channel.py —
+    sealed shm object keyed ``<name>:<seq>``; the reader blocks on the next
+    seq with the arena's blocking get (ref: shared_memory_channel.py —
     there one *mutable* plasma object is rewritten per element; here one
     immutable object per element, deleted after read, which keeps the C++
     store simple and is just as zero-copy).
 
-    Both endpoints need a ``PlasmaClient`` attached to the same arena path.
+    PICKLABLE across processes: only the arena PATH travels; each process
+    attaches its own client lazily (seeded with the store's client on the
+    driver).  close() seals a ``<name>:__closed__`` sentinel so readers and
+    writers in OTHER processes observe the teardown too.
     """
 
-    def __init__(self, arena, name: str, maxsize: int = 16):
-        self._arena = arena
+    def __init__(self, arena=None, name: str = "", maxsize: int = 16,
+                 arena_path: Optional[str] = None):
+        self._arena_obj = arena
+        self._arena_path = arena_path or getattr(arena, "path", None)
+        if self._arena_obj is None and not self._arena_path:
+            raise ValueError("SharedMemoryChannel needs an arena or its path")
         self.name = name
         self._maxsize = max(1, maxsize)
         self._wseq = 0
         self._rseq = 0
         self._closed = False
 
+    @property
+    def _arena(self):
+        if self._arena_obj is None:
+            self._arena_obj = _arena_for(self._arena_path)
+        return self._arena_obj
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_arena_obj"] = None  # re-attach by path on the other side
+        return state
+
+    def _peer_closed(self) -> bool:
+        try:
+            return self._arena.contains(f"{self.name}:__closed__")
+        except Exception:
+            return True
+
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
-        if self._closed:
+        if self._closed or self._peer_closed():
             raise ChannelClosed(self.name)
         payload = pickle.dumps(value, protocol=5)
         # Backpressure: don't run more than maxsize elements ahead of the
@@ -137,6 +184,8 @@ class SharedMemoryChannel:
         while self._wseq - self._oldest_live() >= self._maxsize:
             if deadline is not None and _time.monotonic() > deadline:
                 raise ChannelTimeout(f"write timeout on shm channel {self.name!r}")
+            if self._closed or self._peer_closed():
+                raise ChannelClosed(self.name)
             _time.sleep(0.0005)
         self._arena.put_bytes(f"{self.name}:{self._wseq}", payload)
         self._wseq += 1
@@ -151,12 +200,23 @@ class SharedMemoryChannel:
         return self._rseq
 
     def read(self, timeout: Optional[float] = None) -> Any:
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
         key = f"{self.name}:{self._rseq}"
-        data = self._arena.get_bytes(key, timeout=timeout if timeout is not None else 30)
-        if data is None:
-            if self._closed:
+        while True:
+            slice_s = 0.25
+            if deadline is not None:
+                left = deadline - _time.monotonic()
+                if left <= 0:
+                    raise ChannelTimeout(
+                        f"read timeout on shm channel {self.name!r}")
+                slice_s = min(slice_s, left)
+            data = self._arena.get_bytes(key, timeout=slice_s)
+            if data is not None:
+                break
+            if self._closed or self._peer_closed():
                 raise ChannelClosed(self.name)
-            raise ChannelTimeout(f"read timeout on shm channel {self.name!r}")
         self._arena.release(key)
         self._arena.delete(key)
         self._rseq += 1
@@ -164,3 +224,33 @@ class SharedMemoryChannel:
 
     def close(self) -> None:
         self._closed = True
+        try:
+            if not self._peer_closed():
+                self._arena.put_bytes(f"{self.name}:__closed__", b"1")
+        except Exception:
+            pass
+
+    def reclaim(self) -> None:
+        """Delete every arena object of this channel (unread elements and
+        the close sentinel).  Call AFTER both endpoints stopped — e.g. the
+        compiled DAG's teardown, once its loops joined.  Probes forward
+        with a miss tolerance because consumed seqs leave holes."""
+        def drop(key: str) -> bool:
+            try:
+                if not self._arena.contains(key):
+                    return False
+                self._arena.release(key)
+                self._arena.delete(key)
+                return True
+            except Exception:
+                return False
+
+        misses, k = 0, 0
+        budget = max(64, 2 * self._maxsize)
+        while misses < budget:
+            if drop(f"{self.name}:{k}"):
+                misses = 0
+            else:
+                misses += 1
+            k += 1
+        drop(f"{self.name}:__closed__")

@@ -177,6 +177,39 @@ class CompiledDAG:
     def _compile(self) -> None:
         from ray_tpu._private.runtime import get_runtime
 
+        runtime_early = get_runtime()
+
+        def _placement(node: Optional[ClassMethodNode]):
+            """Which OS process hosts a node: "driver" for the driver and
+            thread-tier actors (they share the heap), or the process
+            worker's identity for process-isolated actors."""
+            if node is None:
+                return "driver"
+            state = runtime_early.get_actor_state(
+                node._resolve_handle()._ray_actor_id)
+            if state is None:
+                return "driver"
+            # Wait out async creation so proc_worker is authoritative —
+            # guessing wrong wires an unpicklable in-process channel into a
+            # worker's schedule.  Worker spawn + in-worker __init__ can take
+            # tens of seconds on a loaded box, so the bound is generous and
+            # expiry is LOUD, never a silent "driver".
+            import time as _t
+
+            deadline = _t.monotonic() + 120
+            while (state.instance is None and state.proc_worker is None
+                   and state.state not in ("DEAD",)
+                   and _t.monotonic() < deadline):
+                _t.sleep(0.005)
+            if state.instance is None and state.proc_worker is None \
+                    and state.state != "DEAD":
+                raise TimeoutError(
+                    f"actor for {node._method_name!r} not ready within 120s; "
+                    "cannot determine its process placement for the "
+                    "compiled DAG")
+            return ("proc", id(state.proc_worker)) \
+                if state.proc_worker is not None else "driver"
+
         topo = self._output_node._topo()
         out_node = self._output_node
         leaves = (
@@ -203,10 +236,42 @@ class CompiledDAG:
         for n in compute_nodes:
             ops[id(n)] = _CompiledOp(n, n._method_name)
 
-        def make_channel(producer: Optional[ClassMethodNode]) -> Channel:
+        import uuid
+
+        # Globally unique channel namespace: id(self) recycles after GC and
+        # a reused address would collide with a torn-down DAG's stale
+        # sentinels/elements in the arena.
+        chan_ns = uuid.uuid4().hex[:12]
+        shm_counter = [0]
+
+        def make_channel(producer: Optional[ClassMethodNode],
+                         consumer: Optional[ClassMethodNode]) -> Channel:
             transport = getattr(producer, "_tensor_transport", None) if producer else None
             if transport is not None:
                 ch = DeviceChannel(device=transport, maxsize=self._max_buffered)
+            elif "driver" != _placement(producer) or \
+                    "driver" != _placement(consumer):
+                # An endpoint lives in a process worker: the edge rides the
+                # native plasma arena (ref: shared_memory_channel.py — the
+                # reference's compiled graphs use mutable plasma objects
+                # for exactly these cross-worker edges).  In-process
+                # Channels hold threading primitives and cannot pickle, so
+                # every process-actor edge — including worker-internal
+                # ones — uses shm.
+                from ray_tpu.dag.channel import SharedMemoryChannel, seed_arena_client
+
+                arena_path = runtime_early.store.arena_path
+                if arena_path is None:
+                    raise ValueError(
+                        "compiled DAGs over process-isolated actors need "
+                        "the native plasma arena (store has none)")
+                seed_arena_client(arena_path, runtime_early.store.plasma)
+                shm_counter[0] += 1
+                ch = SharedMemoryChannel(
+                    arena=runtime_early.store.plasma,
+                    arena_path=arena_path,
+                    name=f"dagch:{chan_ns}:{shm_counter[0]}",
+                    maxsize=self._max_buffered)
             else:
                 ch = Channel(maxsize=self._max_buffered)
             self._all_channels.append(ch)
@@ -222,7 +287,7 @@ class CompiledDAG:
             def wire(a) -> _ArgSource:
                 if isinstance(a, (InputNode, InputAttributeNode)):
                     if not op_input_ch:
-                        ch = make_channel(None)
+                        ch = make_channel(None, n)
                         self._input_channels.append(ch)
                         op_input_ch.append(ch)
                     key = a._key if isinstance(a, InputAttributeNode) else None
@@ -230,7 +295,7 @@ class CompiledDAG:
                         _ArgSource.INPUT, channel=op_input_ch[0], input_key=key
                     )
                 if isinstance(a, ClassMethodNode):
-                    ch = make_channel(a)
+                    ch = make_channel(a, n)
                     ops[id(a)].out_channels.append(ch)
                     return _ArgSource(_ArgSource.CHANNEL, channel=ch)
                 if isinstance(a, DAGNode):
@@ -246,7 +311,7 @@ class CompiledDAG:
 
         # Driver-facing output channels, one per leaf, in leaf order.
         for leaf in leaves:
-            ch = make_channel(leaf)
+            ch = make_channel(leaf, None)
             ops[id(leaf)].out_channels.append(ch)
             self._output_channels.append(ch)
 
@@ -270,12 +335,36 @@ class CompiledDAG:
             if state is None:
                 raise ValueError(f"Actor {actor_id} not found for compiled DAG")
             # Actor construction is async; wait until the instance exists
-            # before pinning the resident loop on it.
+            # (thread tier) or the worker process holds it (process tier).
             import time as _time
 
             deadline = _time.monotonic() + 30
-            while state.instance is None and _time.monotonic() < deadline:
+            while (state.instance is None and state.proc_worker is None
+                   and _time.monotonic() < deadline):
                 _time.sleep(0.002)
+            if state.proc_worker is not None:
+                # PROCESS-ISOLATED actor: the resident loop runs INSIDE the
+                # worker process against its instance; every edge is a shm
+                # channel, so the schedule pickles (ref:
+                # compiled_dag_node.py:711 cross-worker execution).
+                from ray_tpu._private import serialization
+
+                slim = []
+                for op in schedule:
+                    clone = _CompiledOp(None, op.method_name)
+                    clone.arg_sources = op.arg_sources
+                    clone.kwarg_sources = op.kwarg_sources
+                    clone.out_channels = op.out_channels
+                    clone.reads_input = op.reads_input
+                    slim.append(clone)
+                fn_bytes = serialization.dumps(_actor_exec_loop)
+                worker = state.proc_worker
+                t = threading.Thread(
+                    target=self._proc_loop_runner, args=(worker, fn_bytes, slim),
+                    name=f"dag-proc-loop-{actor_id}", daemon=True)
+                t.start()
+                self._loop_refs.append(t)
+                continue
             if state.instance is None:
                 raise TimeoutError(f"Actor {actor_id} not ready for compiled DAG")
             loop_attr = f"__ray_tpu_dag_loop_{id(self):x}__"
@@ -298,6 +387,25 @@ class CompiledDAG:
                 method_name=loop_attr,
             )
             self._loop_refs.append(runtime.submit_actor_task(actor_id, spec))
+
+    def _proc_loop_runner(self, worker, fn_bytes: bytes, schedule) -> None:
+        """Driver-side thread hosting one process actor's resident-loop
+        request; returns when the loop exits on ChannelClosed."""
+        try:
+            worker.actor_exec(fn_bytes, (schedule,), {})
+        except Exception:
+            if not self._torn_down:
+                # A loop dying mid-service wedges every consumer blocked on
+                # its channels: tear the edges down so reads raise
+                # ChannelClosed, and say why on stderr.
+                import traceback
+
+                traceback.print_exc()
+                for ch in self._all_channels:
+                    try:
+                        ch.close()
+                    except Exception:
+                        pass
 
     # -- execution ---------------------------------------------------------
 
@@ -353,9 +461,22 @@ class CompiledDAG:
         runtime = get_runtime()
         for ref in self._loop_refs:
             try:
-                runtime.get(ref, timeout=5)
+                if isinstance(ref, threading.Thread):
+                    ref.join(timeout=5)  # process-actor loop host thread
+                else:
+                    runtime.get(ref, timeout=5)
             except Exception:
                 pass
+        # Reclaim shm channel objects (unread elements + close sentinels):
+        # the arena is shared with the object store, so leftovers from
+        # repeated compile/teardown cycles would eat its capacity.
+        for ch in self._all_channels:
+            reclaim = getattr(ch, "reclaim", None)
+            if reclaim is not None:
+                try:
+                    reclaim()
+                except Exception:
+                    pass
 
     def __del__(self):
         try:

@@ -46,6 +46,10 @@ class ServeController:
     # (ref: the reference's GCS-checkpointed serve controller state —
     # serve/_private/application_state.py + test_gcs_fault_tolerance.py).
     _KV_NS = "serve-apps"
+    #: Record-format magic: deserialize_flat misparses arbitrary bytes (it
+    #: reads a buffer count from the header), so unversioned/legacy records
+    #: must be skippable, not interpretable.
+    _KV_MAGIC = b"RTPU-SRV1\x00"
 
     def _persist_app(self, app_name: str, record: dict) -> None:
         from ray_tpu._private import serialization
@@ -55,15 +59,18 @@ class ServeController:
             sobj = serialization.serialize(record)
             if sobj.contained_refs:
                 # ObjectRefs in init args reference THIS process's objects;
-                # a restored head could never resolve them — skip, loudly.
+                # a restored head could never resolve them — skip, loudly,
+                # and drop any OLDER persisted version so a stale app
+                # cannot resurrect in its place after a restart.
                 import logging
 
                 logging.getLogger("ray_tpu.serve").warning(
                     "app %r binds ObjectRef init args; it will NOT be "
                     "restored after a head restart (pass plain values or "
                     "re-deploy after restarts)", app_name)
+                self._unpersist_app(app_name)
                 return
-            kv._internal_kv_put(app_name, sobj.to_bytes(),
+            kv._internal_kv_put(app_name, self._KV_MAGIC + sobj.to_bytes(),
                                 namespace=self._KV_NS)
         except Exception:
             pass  # persistence is best-effort; serving must not fail on it
@@ -86,8 +93,16 @@ class ServeController:
             return
         for name in names:
             try:
-                record = serialization.deserialize_flat(memoryview(
-                    kv._internal_kv_get(name, namespace=self._KV_NS)))
+                raw = kv._internal_kv_get(name, namespace=self._KV_NS)
+                if not raw or not raw.startswith(self._KV_MAGIC):
+                    import logging
+
+                    logging.getLogger("ray_tpu.serve").warning(
+                        "skipping persisted serve app %r: unknown record "
+                        "format", name)
+                    continue
+                record = serialization.deserialize_flat(
+                    memoryview(raw)[len(self._KV_MAGIC):])
                 # Build EVERY DeploymentInfo before deploying ANY: a bad
                 # second deployment must not leave the first one running
                 # as an orphan with no _apps entry to delete it through.
