@@ -98,7 +98,8 @@ def main() -> None:
         first = dag.execute(
             rng.integers(0, 512, (1, 32), dtype=np.int64)).get(timeout=300)
         pa, pb = first["stage_pids"]
-        assert pa != pb != os.getpid(), "stages must be separate processes"
+        assert pa != pb and os.getpid() not in (pa, pb), \
+            "stages must be separate processes"
         print(f"stages in pids {pa} and {pb} (driver {os.getpid()})")
 
         t0 = time.perf_counter()

@@ -61,6 +61,12 @@ class Config:
     #: the waiting task is failed with ObjectTransferError.
     object_transfer_pull_retries: int = 3
 
+    #: Rendezvous bound for in-process collective ops: a lost/wedged rank
+    #: fails the other participants after this long instead of holding
+    #: them hostage (per-group override via init_collective_group's
+    #: timeout_s).
+    collective_timeout_s: float = 300.0
+
     #: Grace window after a borrower's liveness session drops before its
     #: borrows are reaped — a reconnect inside it cancels the reap
     #: (transient TCP resets must not free live data).

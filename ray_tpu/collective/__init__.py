@@ -38,15 +38,22 @@ class GroupManager:
         return self._actor_ranks.get((group_name, actor_id))
 
     def create_group(self, group_name: str, world_size: int,
-                     devices: Optional[List[Any]] = None) -> XLACollectiveGroup:
+                     devices: Optional[List[Any]] = None,
+                     timeout_s=None) -> XLACollectiveGroup:
         with self._lock:
             group = self._groups.get(group_name)
             if group is None:
-                group = XLACollectiveGroup(group_name, world_size, devices)
+                group = XLACollectiveGroup(group_name, world_size, devices,
+                                           timeout_s=timeout_s)
                 self._groups[group_name] = group
             elif group.world_size != world_size:
                 raise ValueError(
                     f"Group '{group_name}' exists with world_size={group.world_size}")
+            elif timeout_s is not None:
+                # Group already materialized by another rank: honor the
+                # explicit per-group override anyway instead of silently
+                # keeping whatever the first creator got.
+                group.timeout_s = float(timeout_s)
             return group
 
     def get_group(self, group_name: str) -> XLACollectiveGroup:
@@ -90,7 +97,8 @@ def _ctx_rank(group_name: str, rank: Optional[int]) -> int:
 
 
 def init_collective_group(world_size: int, rank: int, backend: str = "xla",
-                          group_name: str = "default") -> None:
+                          group_name: str = "default",
+                          timeout_s=None) -> None:
     """Declare this worker a member of the group (ref: collective.py:120).
 
     Unlike the NCCL backend there is no unique-id rendezvous over an actor
@@ -101,7 +109,7 @@ def init_collective_group(world_size: int, rank: int, backend: str = "xla",
         raise ValueError(f"Unsupported backend '{backend}'; the TPU-native backend is 'xla'")
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world_size {world_size}")
-    _manager.create_group(group_name, world_size)
+    _manager.create_group(group_name, world_size, timeout_s=timeout_s)
     from ray_tpu._private.runtime import current_task_context
 
     ctx = current_task_context()
@@ -113,7 +121,8 @@ def init_collective_group(world_size: int, rank: int, backend: str = "xla",
 
 
 def create_collective_group(actors: List[Any], world_size: int, ranks: List[int],
-                            backend: str = "xla", group_name: str = "default") -> None:
+                            backend: str = "xla", group_name: str = "default",
+                            timeout_s=None) -> None:
     """Driver-side declaration for a set of actors (ref: collective.py:151).
 
     Binds each actor's identity to its rank directly in the group manager —
@@ -121,7 +130,7 @@ def create_collective_group(actors: List[Any], world_size: int, ranks: List[int]
     """
     if len(actors) != len(ranks):
         raise ValueError("actors and ranks must have the same length")
-    _manager.create_group(group_name, world_size)
+    _manager.create_group(group_name, world_size, timeout_s=timeout_s)
     for actor, rank in zip(actors, ranks):
         _manager.bind_actor_rank(group_name, str(actor._ray_actor_id), rank)
 

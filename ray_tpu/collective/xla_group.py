@@ -38,8 +38,9 @@ class _Rendezvous:
     a named actor, nccl_util.py) — here a barrier across the ranks' threads.
     """
 
-    def __init__(self, world_size: int):
+    def __init__(self, world_size: int, timeout_s: float = 300.0):
         self.world_size = world_size
+        self.timeout_s = timeout_s
         self.lock = threading.Lock()
         self.slots: Dict[int, Any] = {}
         self.arrivals = 0  # counted at lookup under the group lock
@@ -63,7 +64,7 @@ class _Rendezvous:
             finally:
                 self.done.set()
         else:
-            if not self.done.wait(timeout=300.0):
+            if not self.done.wait(timeout=self.timeout_s):
                 # Withdraw our contribution so a retry of this round is clean
                 # instead of hitting "contributed twice" on a wedged group.
                 with self.lock:
@@ -80,8 +81,17 @@ class _Rendezvous:
 
 class XLACollectiveGroup:
     def __init__(self, group_name: str, world_size: int,
-                 devices: Optional[List[Any]] = None):
+                 devices: Optional[List[Any]] = None,
+                 timeout_s: Optional[float] = None):
         import jax
+
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        #: Rendezvous bound: a lost rank fails the OTHERS after this long
+        #: instead of holding them hostage (r2 weak #8 — the 300 s constant
+        #: was not operator-tunable; elastic trainers want seconds here).
+        self.timeout_s = float(timeout_s if timeout_s is not None
+                               else GLOBAL_CONFIG.collective_timeout_s)
 
         all_devices = devices if devices is not None else jax.devices()
         if world_size > len(all_devices):
@@ -137,7 +147,7 @@ class XLACollectiveGroup:
             key = (op, seq)
             rv = self._rendezvous.get(key)
             if rv is None:
-                rv = _Rendezvous(self.world_size)
+                rv = _Rendezvous(self.world_size, self.timeout_s)
                 self._rendezvous[key] = rv
             rv.arrivals += 1
             if rv.arrivals == n:

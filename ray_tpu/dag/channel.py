@@ -230,11 +230,15 @@ class SharedMemoryChannel:
         except Exception:
             pass
 
-    def reclaim(self) -> None:
-        """Delete every arena object of this channel (unread elements and
-        the close sentinel).  Call AFTER both endpoints stopped — e.g. the
-        compiled DAG's teardown, once its loops joined.  Probes forward
-        with a miss tolerance because consumed seqs leave holes."""
+    def reclaim(self, drop_sentinel: bool = True) -> None:
+        """Delete this channel's arena objects (unread elements; the close
+        sentinel only when ``drop_sentinel`` — a straggling endpoint still
+        needs it to observe the close).  Call from the compiled DAG's
+        teardown, after its loops joined.  Probes forward from this side's
+        consumed floor with a miss tolerance (consumed seqs leave holes);
+        elements beyond the probe budget on channels whose reader lived in
+        another process can escape — a bounded residue of at most
+        ``maxsize`` pickled items per torn-down channel."""
         def drop(key: str) -> bool:
             try:
                 if not self._arena.contains(key):
@@ -245,12 +249,13 @@ class SharedMemoryChannel:
             except Exception:
                 return False
 
-        misses, k = 0, 0
-        budget = max(64, 2 * self._maxsize)
+        misses, k = 0, max(0, self._rseq)
+        budget = max(256, 8 * self._maxsize)
         while misses < budget:
             if drop(f"{self.name}:{k}"):
                 misses = 0
             else:
                 misses += 1
             k += 1
-        drop(f"{self.name}:__closed__")
+        if drop_sentinel:
+            drop(f"{self.name}:__closed__")

@@ -201,8 +201,12 @@ class CompiledDAG:
                    and state.state not in ("DEAD",)
                    and _t.monotonic() < deadline):
                 _t.sleep(0.005)
-            if state.instance is None and state.proc_worker is None \
-                    and state.state != "DEAD":
+            if state.state == "DEAD":
+                raise ValueError(
+                    f"actor for {node._method_name!r} is DEAD "
+                    f"(cause: {state.death_cause!r}); cannot compile a DAG "
+                    "over it")
+            if state.instance is None and state.proc_worker is None:
                 raise TimeoutError(
                     f"actor for {node._method_name!r} not ready within 120s; "
                     "cannot determine its process placement for the "
@@ -459,22 +463,27 @@ class CompiledDAG:
         from ray_tpu._private.runtime import get_runtime
 
         runtime = get_runtime()
+        joined_all = True
         for ref in self._loop_refs:
             try:
                 if isinstance(ref, threading.Thread):
                     ref.join(timeout=5)  # process-actor loop host thread
+                    joined_all = joined_all and not ref.is_alive()
                 else:
                     runtime.get(ref, timeout=5)
             except Exception:
-                pass
+                joined_all = False
         # Reclaim shm channel objects (unread elements + close sentinels):
         # the arena is shared with the object store, so leftovers from
-        # repeated compile/teardown cycles would eat its capacity.
+        # repeated compile/teardown cycles would eat its capacity.  The
+        # sentinel survives unless every loop provably exited — deleting it
+        # under a still-running loop would UN-close the channel and let the
+        # straggler seal unreclaimable writes.
         for ch in self._all_channels:
             reclaim = getattr(ch, "reclaim", None)
             if reclaim is not None:
                 try:
-                    reclaim()
+                    reclaim(drop_sentinel=joined_all)
                 except Exception:
                     pass
 

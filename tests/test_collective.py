@@ -229,3 +229,32 @@ def test_oversubscribed_group_warns_loudly():
         group = XLACollectiveGroup("oversub", 99)
     assert group._oversubscribed
     group.destroy()
+
+
+def test_rendezvous_timeout_is_configurable():
+    """r2 weak #8: a straggler-free rank must not be held hostage for the
+    full 300s default — the bound is an operator knob now."""
+    import threading
+    import time
+
+    import numpy as np
+
+    from ray_tpu.collective.xla_group import XLACollectiveGroup
+
+    group = XLACollectiveGroup("short-timeout", 2, timeout_s=1.0)
+    t0 = time.time()
+    err = []
+
+    def lone_rank():
+        try:
+            group.allreduce(0, np.ones(4))
+        except TimeoutError as e:
+            err.append(e)
+
+    t = threading.Thread(target=lone_rank)
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert err and "rendezvous timed out" in str(err[0])
+    assert time.time() - t0 < 10, "timeout knob was not honored"
+    group.destroy()
