@@ -39,12 +39,29 @@ class GroupManager:
 
     def create_group(self, group_name: str, world_size: int,
                      devices: Optional[List[Any]] = None,
-                     timeout_s=None) -> XLACollectiveGroup:
+                     timeout_s=None, backend: str = "xla") -> XLACollectiveGroup:
         with self._lock:
             group = self._groups.get(group_name)
             if group is None:
-                group = XLACollectiveGroup(group_name, world_size, devices,
-                                           timeout_s=timeout_s)
+                from ray_tpu.collective.dcn_group import (
+                    DCNCollectiveGroup,
+                    multiprocess_world,
+                )
+
+                # Multi-process rank layout (jax.distributed, ONE rank per
+                # process — world_size == process_count): collectives must be
+                # global SPMD programs, not in-process rendezvous — the other
+                # ranks live in other OS processes.  Any other layout (more
+                # ranks than processes = thread-tier workers sharing a
+                # process, possibly mesh-joined to a jax.distributed cluster)
+                # keeps the in-process tier; backend="xla_local" forces it.
+                nproc = multiprocess_world()
+                if nproc > 1 and world_size == nproc and backend != "xla_local":
+                    group = DCNCollectiveGroup(group_name, world_size, devices,
+                                               timeout_s=timeout_s)
+                else:
+                    group = XLACollectiveGroup(group_name, world_size, devices,
+                                               timeout_s=timeout_s)
                 self._groups[group_name] = group
             elif group.world_size != world_size:
                 raise ValueError(
@@ -104,12 +121,18 @@ def init_collective_group(world_size: int, rank: int, backend: str = "xla",
     Unlike the NCCL backend there is no unique-id rendezvous over an actor
     store: the xla backend's group is materialized on first use, and the
     calling thread is bound to ``rank`` for subsequent collective calls.
+
+    When this process is one rank of a jax.distributed cluster (one rank per
+    process), the group's ops run as global SPMD programs over DCN/ICI
+    (dcn_group.py); ``backend="xla_local"`` opts out, forcing the in-process
+    thread-rendezvous tier regardless.
     """
-    if backend not in ("xla", "tpu", "ici"):
+    if backend not in ("xla", "tpu", "ici", "xla_local"):
         raise ValueError(f"Unsupported backend '{backend}'; the TPU-native backend is 'xla'")
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world_size {world_size}")
-    _manager.create_group(group_name, world_size, timeout_s=timeout_s)
+    _manager.create_group(group_name, world_size, timeout_s=timeout_s,
+                          backend=backend)
     from ray_tpu._private.runtime import current_task_context
 
     ctx = current_task_context()
@@ -130,7 +153,8 @@ def create_collective_group(actors: List[Any], world_size: int, ranks: List[int]
     """
     if len(actors) != len(ranks):
         raise ValueError("actors and ranks must have the same length")
-    _manager.create_group(group_name, world_size, timeout_s=timeout_s)
+    _manager.create_group(group_name, world_size, timeout_s=timeout_s,
+                          backend=backend)
     for actor, rank in zip(actors, ranks):
         _manager.bind_actor_rank(group_name, str(actor._ray_actor_id), rank)
 

@@ -12,8 +12,10 @@ Where the reference's ranks rendezvous via a named-actor unique-id store and
 then issue runtime NCCL verbs, ranks here rendezvous in-process (threads of
 the multi-controller host process) and the "verb" is a cached jitted program
 per (op, shape, dtype): the compiler schedules the transfer, overlaps it, and
-fuses surrounding elementwise work.  Multi-host groups extend the same mesh
-across processes via jax.distributed (DCN tier).
+fuses surrounding elementwise work.  Groups whose ranks are separate OS
+processes (jax.distributed) are built as DCNCollectiveGroup instead — same
+call surface, ops compiled as global SPMD programs (see dcn_group.py); the
+GroupManager picks the tier automatically.
 """
 
 from __future__ import annotations

@@ -82,6 +82,39 @@ def load_pytree(path: str, template: Optional[Any] = None) -> Any:
         return ckptr.restore(path)
 
 
+def pack_checkpoint(checkpoint: Optional[Checkpoint]) -> Optional[bytes]:
+    """Checkpoint directory -> tar.gz bytes, for shipping across hosts.
+
+    Multi-host trainer workers live on other machines: a path-valued
+    Checkpoint is meaningless there, so report/restore moves the directory
+    by value through the object plane (ref: the reference syncs checkpoint
+    dirs through storage_path/pyarrow fs — train/_internal/storage.py; an
+    in-band copy is the storage-less equivalent)."""
+    if checkpoint is None:
+        return None
+    import io
+    import tarfile
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        tar.add(checkpoint.path, arcname=".")
+    return buf.getvalue()
+
+
+def unpack_checkpoint(blob: Optional[bytes],
+                      path: Optional[str] = None) -> Optional[Checkpoint]:
+    """Inverse of pack_checkpoint: extract into a fresh local directory."""
+    if blob is None:
+        return None
+    import io
+    import tarfile
+
+    path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+    with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
+        tar.extractall(path, filter="data")
+    return Checkpoint(path)
+
+
 class CheckpointManager:
     """Top-K checkpoint retention (ref: _internal/checkpoint_manager.py)."""
 

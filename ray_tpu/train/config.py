@@ -23,6 +23,12 @@ class ScalingConfig:
     #: chips per worker when use_tpu (the reference's GPUs-per-worker analogue)
     tpus_per_worker: float = 1.0
     placement_strategy: str = "PACK"
+    #: "threads" — workers share this process's JAX client (single TPU host);
+    #: "processes" — each worker is its own OS process joined into one
+    #: jax.distributed cluster (multi-host SPMD, ref: backend_executor.py:69
+    #: worker actors across nodes); "auto" — processes iff the placement
+    #: group's bundles land on worker nodes beyond the head.
+    worker_mode: str = "auto"
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker is not None:

@@ -16,7 +16,6 @@ process boundary).
 from __future__ import annotations
 
 import os
-import socket
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
@@ -63,24 +62,35 @@ class TorchTrainWorker:
     (ref: _internal/worker_group.py:19 RayTrainWorker + torch backend
     on_start).  Always created with isolation='process'."""
 
-    def __init__(self, rank: int, world_size: int, master_port: int):
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+
+    def reserve_master(self) -> str:
+        """Rank 0 picks the gloo rendezvous address on ITS host (ref:
+        torch/config.py:66 — master address taken from the rank-0 worker's
+        node, so the group can span machines)."""
+        from ray_tpu.train.trainer import _reserve_addr
+
+        return _reserve_addr()
+
+    def setup(self, master: str) -> None:
         from datetime import timedelta
 
         import torch.distributed as dist
 
-        os.environ["MASTER_ADDR"] = "127.0.0.1"
-        os.environ["MASTER_PORT"] = str(master_port)
+        addr, _, port = master.rpartition(":")
+        os.environ["MASTER_ADDR"] = addr
+        os.environ["MASTER_PORT"] = port
         # Bounded rendezvous: the probed port is TOCTOU-racy (another
         # process can steal it between probe and bind); without a timeout a
         # stolen port means every rank hangs for gloo's 30-min default while
         # fit() spins with no diagnostic.
         dist.init_process_group(
             backend="gloo",
-            init_method=f"tcp://127.0.0.1:{master_port}",
-            rank=rank, world_size=world_size,
+            init_method=f"tcp://{master}",
+            rank=self.rank, world_size=self.world_size,
             timeout=timedelta(seconds=60))
-        self.rank = rank
-        self.world_size = world_size
 
     def run(self, train_loop: Callable, loop_config: Optional[Dict[str, Any]],
             session: ProcessTrainSession) -> str:
@@ -111,12 +121,6 @@ def prepare_model(model):
     return model
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 class TorchTrainer(DataParallelTrainer):
     """Same controller contract as DataParallelTrainer (elastic restarts,
     checkpoint manager, PG gang scheduling) with the worker group swapped
@@ -140,7 +144,6 @@ class TorchTrainer(DataParallelTrainer):
         scfg = self.scaling_config
         world = scfg.num_workers
         report_queue = Queue()
-        port = _free_port()
         workers = []
         sessions: List[ProcessTrainSession] = []
         for rank in range(world):
@@ -157,7 +160,19 @@ class TorchTrainer(DataParallelTrainer):
                     scheduling_strategy=PlacementGroupSchedulingStrategy(
                         placement_group=pg,
                         placement_group_bundle_index=rank),
-                ).remote(rank, world, port))
+                ).remote(rank, world))
+
+        try:
+            master = ray_tpu.get(workers[0].reserve_master.remote(),
+                                 timeout=120)
+            ray_tpu.get([w.setup.remote(master) for w in workers],
+                        timeout=180)
+        except (TaskError, RayTpuError) as e:
+            for w in workers:
+                ray_tpu.kill(w)
+            report_queue.shutdown()
+            return {"status": "failed", "last_metrics": None, "history": [],
+                    "error": e}
 
         refs = [w.run.remote(self.train_loop, self.train_loop_config, s)
                 for w, s in zip(workers, sessions)]
@@ -179,16 +194,10 @@ class TorchTrainer(DataParallelTrainer):
                     last_metrics = item["metrics"]
                     history.append(item["metrics"])
 
-        pending = list(refs)
         try:
-            while pending:
-                ready, pending = ray_tpu.wait(pending,
-                                              num_returns=len(pending),
-                                              timeout=0.05)
-                drain()
-                for r in ready:
-                    ray_tpu.get(r)
-            drain()
+            from ray_tpu.train.trainer import _drive_worker_refs
+
+            _drive_worker_refs(refs, drain)
             for w in workers:
                 try:
                     ray_tpu.get(w.shutdown_group.remote(), timeout=10)

@@ -99,6 +99,137 @@ class TrainWorker:
             clear_session()
 
 
+def _node_ip() -> str:
+    """Best-effort routable IP of this host (ref: ray._private.services
+    get_node_ip_address — UDP-connect trick, no packets sent)."""
+    import socket
+
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+def _reserve_addr() -> str:
+    """Probe a free port on this host and return "ip:port" — the rendezvous
+    address a rank-0 worker advertises (jax.distributed coordinator / gloo
+    master).  TOCTOU-racy by nature; the consumers bound their rendezvous
+    with timeouts for exactly that reason."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"{_node_ip()}:{port}"
+
+
+def _drive_worker_refs(refs, drain) -> None:
+    """Poll a worker group's run() refs to completion, draining the report
+    channel as results stream in; re-raises the first worker error (shared
+    by the process-tier controllers — torch and jax.distributed)."""
+    pending = list(refs)
+    while pending:
+        ready, pending = ray_tpu.wait(pending, num_returns=len(pending),
+                                      timeout=0.05)
+        drain()
+        for r in ready:
+            ray_tpu.get(r)  # raise worker errors here
+    drain()
+
+
+class DistTrainSession:
+    """Pickle-safe session for multi-host workers: report() ships metrics +
+    the checkpoint directory BY VALUE (tar.gz) through an actor-backed queue
+    — worker processes live on other machines, so neither the thread tier's
+    in-memory queue nor bare paths can cross (ref: _TrainSession:112
+    contract; train/_internal/storage.py checkpoint upload)."""
+
+    def __init__(self, context: TrainContext, report_queue,
+                 checkpoint_to_restore: Optional[Checkpoint] = None):
+        self.context = context
+        self._queue = report_queue
+        self.checkpoint_to_restore = checkpoint_to_restore
+        self.dataset_shards: Dict[str, Any] = {}
+        self.stop_requested = threading.Event()
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        from ray_tpu.train.checkpoint import pack_checkpoint
+
+        self._queue.put({
+            "rank": self.context.world_rank,
+            "metrics": dict(metrics),
+            "checkpoint_blob": pack_checkpoint(checkpoint),
+        })
+
+
+@ray_tpu.remote
+class JaxDistTrainWorker:
+    """One jax.distributed rank in its own OS process.
+
+    The multi-host worker tier (ref: _internal/backend_executor.py:69 — the
+    worker group's actors span nodes and are bootstrapped into one process
+    group; train/torch/config.py:66,115 _setup_torch_process_group).  Here
+    the process group is JAX's multi-controller runtime: after setup(),
+    jax.devices() on every worker is the GLOBAL device set, meshes span the
+    cluster, and ray_tpu.collective ops compile to global SPMD programs
+    (collective/dcn_group.py).  Always created with isolation='process'."""
+
+    def __init__(self, rank: int, world_size: int, group_name: str):
+        self.rank = rank
+        self.world = world_size
+        self.group_name = group_name
+
+    def reserve_coordinator(self) -> str:
+        """Rank 0 picks the jax.distributed coordinator address on ITS host."""
+        return _reserve_addr()
+
+    def setup(self, coordinator: str) -> Dict[str, Any]:
+        """Join the multi-controller cluster; returns topology for sanity
+        checks.  Called CONCURRENTLY on all ranks (initialize barriers)."""
+        from ray_tpu.collective import distributed
+
+        distributed.initialize(coordinator, self.world, self.rank)
+        collective.init_collective_group(self.world, self.rank, backend="xla",
+                                         group_name=self.group_name)
+        import jax
+
+        return {"rank": self.rank, "process_count": jax.process_count(),
+                "global_devices": len(jax.devices())}
+
+    def run(self, train_loop: Callable, loop_config: Optional[Dict[str, Any]],
+            context: TrainContext, report_queue,
+            restore_blob: Optional[bytes]) -> str:
+        import shutil
+
+        from ray_tpu.train.checkpoint import unpack_checkpoint
+
+        restore = unpack_checkpoint(restore_blob)
+        session = DistTrainSession(context, report_queue, restore)
+        init_session(session)
+        try:
+            invoke_train_loop(train_loop, loop_config)
+            return "done"
+        finally:
+            clear_session()
+            if restore is not None:
+                # The unpacked restore dir is this attempt's scratch copy —
+                # N workers x N restarts of model-sized leaks otherwise.
+                shutil.rmtree(restore.path, ignore_errors=True)
+
+    def teardown(self) -> None:
+        collective.destroy_collective_group(self.group_name)
+        from ray_tpu.collective import distributed
+
+        distributed.shutdown()
+
+
 class DataParallelTrainer:
     """(ref: python/ray/train/data_parallel_trainer.py:25)"""
 
@@ -209,8 +340,27 @@ class DataParallelTrainer:
             collective.destroy_collective_group(group_name)
             remove_placement_group(pg)
 
+    def _worker_mode(self, pg) -> str:
+        """threads (one TPU host, shared JAX client) vs processes (one
+        jax.distributed rank per worker process — required once the worker
+        group spans nodes: a thread here cannot execute on another host)."""
+        mode = getattr(self.scaling_config, "worker_mode", "auto")
+        if mode in ("threads", "processes"):
+            return mode
+        if mode != "auto":
+            raise ValueError(f"worker_mode must be auto|threads|processes, got {mode!r}")
+        from ray_tpu._private.runtime import get_runtime
+
+        head = str(get_runtime().head_node_id)
+        return "processes" if any(
+            n is not None and n != head for n in pg.bundle_node_ids()
+        ) else "threads"
+
     def _run_with_pg(self, pg, run_name: str, group_name: str,
                      manager: CheckpointManager, restore_ckpt) -> Dict:
+        if self._worker_mode(pg) == "processes":
+            return self._run_distributed(pg, run_name, group_name, manager,
+                                         restore_ckpt)
         scfg = self.scaling_config
         world = scfg.num_workers
         dataset_shards = self._split_datasets(world)
@@ -270,6 +420,106 @@ class DataParallelTrainer:
             return {"status": "failed", "last_metrics": last_metrics,
                     "history": history, "error": e}
 
+    # ------------------------------------------------- multi-host attempt
+    def _run_distributed(self, pg, run_name: str, group_name: str,
+                         manager: CheckpointManager, restore_ckpt) -> Dict:
+        """One attempt with process-tier workers spanning worker nodes.
+
+        rank 0 reserves the jax.distributed coordinator on its own host,
+        every worker joins with its placement-group rank, and the group's
+        collectives become global SPMD programs (ref: backend_executor.py
+        _setup_worker_group + torch/config.py:115 — the same
+        coordinator-address + rank/world bootstrap, NCCL swapped for XLA)."""
+        from ray_tpu.train.checkpoint import pack_checkpoint, unpack_checkpoint
+        from ray_tpu.util.queue import Empty, Queue
+
+        scfg = self.scaling_config
+        world = scfg.num_workers
+        if self.datasets:
+            return {"status": "fatal", "last_metrics": None, "history": [],
+                    "error": ValueError(
+                        "datasets= require thread-tier workers (streaming "
+                        "iterators cannot cross process boundaries); use "
+                        "ScalingConfig(worker_mode='threads') or load data "
+                        "inside the train_loop")}
+        node_ids = pg.bundle_node_ids()
+        node_order: List[Optional[str]] = []
+        for n in node_ids:
+            if n not in node_order:
+                node_order.append(n)
+        local_counter: Dict[Optional[str], int] = {}
+        workers = []
+        contexts: List[TrainContext] = []
+        for rank in range(world):
+            n = node_ids[rank] if rank < len(node_ids) else None
+            local_rank = local_counter.get(n, 0)
+            local_counter[n] = local_rank + 1
+            contexts.append(TrainContext(
+                world_rank=rank, world_size=world, local_rank=local_rank,
+                node_rank=node_order.index(n), trial_name=run_name,
+                experiment_name=run_name, group_name=group_name))
+            workers.append(
+                JaxDistTrainWorker.options(
+                    isolation="process",
+                    resources=scfg.worker_resources(),
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=pg, placement_group_bundle_index=rank),
+                ).remote(rank, world, group_name))
+
+        report_queue = Queue()
+        history: List[Dict[str, Any]] = []
+        last_metrics: Optional[Dict[str, Any]] = None
+
+        def drain() -> None:
+            nonlocal last_metrics
+            while True:
+                try:
+                    item = report_queue.get_nowait()
+                except Empty:
+                    return
+                if item.get("checkpoint_blob"):
+                    # unpack lands in a ray_tpu_ckpt_ tempdir, which
+                    # register() MOVES into managed storage (no double copy).
+                    manager.register(unpack_checkpoint(item["checkpoint_blob"]),
+                                     item["metrics"])
+                if item["rank"] == 0:
+                    last_metrics = item["metrics"]
+                    history.append(item["metrics"])
+
+        try:
+            coord = ray_tpu.get(workers[0].reserve_coordinator.remote(),
+                                timeout=120)
+            ray_tpu.get([w.setup.remote(coord) for w in workers], timeout=300)
+            blob = pack_checkpoint(restore_ckpt)
+            refs = [w.run.remote(self.train_loop, self.train_loop_config, ctx,
+                                 report_queue, blob)
+                    for w, ctx in zip(workers, contexts)]
+            _drive_worker_refs(refs, drain)
+            for w in workers:
+                try:
+                    ray_tpu.get(w.teardown.remote(), timeout=15)
+                except Exception:
+                    pass
+            return {"status": "finished", "last_metrics": last_metrics,
+                    "history": history, "error": None}
+        except (TaskError, RayTpuError) as e:
+            # A dead node/worker leaves the others wedged inside a global
+            # SPMD collective; killing their processes (finally below) is
+            # what unblocks the restart.
+            drain()
+            return {"status": "failed", "last_metrics": last_metrics,
+                    "history": history, "error": e}
+        finally:
+            for w in workers:
+                try:
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+            try:
+                report_queue.shutdown()
+            except Exception:
+                pass
+
     def _drain_sessions(self, sessions: List[TrainSession], manager: CheckpointManager,
                         last_metrics: Optional[Dict[str, Any]]):
         history = []
@@ -308,4 +558,11 @@ class JaxTrainer(DataParallelTrainer):
     """The TPU trainer (BASELINE north star: `JaxTrainer` pinning workers to
     TPU processes).  Identical controller; workers join the 'xla' collective
     group so `ray_tpu.collective.allreduce` inside the loop compiles to psum
-    over ICI, and `use_tpu=True` reserves chips per worker."""
+    over ICI, and `use_tpu=True` reserves chips per worker.
+
+    Single host, the workers are threads sharing one JAX client (mesh mode).
+    When the placement group lands workers on OTHER nodes (or
+    ``ScalingConfig(worker_mode="processes")``), each worker becomes its own
+    OS process joined into one jax.distributed cluster: jax.devices() spans
+    every worker's chips, meshes ride ICI within a host and DCN across, and
+    the same train_loop runs unchanged (multi-controller SPMD)."""
