@@ -1,13 +1,16 @@
-"""Pipeline-parallel inference over a compiled DAG of PROCESS actors.
+"""Pipeline-parallel inference over a compiled DAG of isolated actors.
 
-Two GIL-isolated worker processes each hold HALF of a (tiny) GPT-2's
-layers; a compiled DAG streams requests through stage A -> stage B over
-shared-memory (plasma) channel edges, overlapping the stages across
+Two workers each hold HALF of a (tiny) GPT-2's layers; a compiled DAG
+streams requests through stage A -> stage B, overlapping the stages across
 consecutive requests — the reference's compiled-graph TP/PP serving
 substrate (ref: python/ray/dag/compiled_dag_node.py:711,
 experimental/channel/shared_memory_channel.py).
 
-Run: python examples/pp_inference_dag.py
+Run: python examples/pp_inference_dag.py           # 2 process actors (shm edges)
+     python examples/pp_inference_dag.py --nodes   # 2 real worker NODES
+                                                   # (RemoteChannel edges over
+                                                   # the object plane — the
+                                                   # cross-host PP tier)
 """
 
 from __future__ import annotations
@@ -25,7 +28,17 @@ def main() -> None:
     import ray_tpu
     from ray_tpu.dag import InputNode
 
-    ray_tpu.init(ignore_reinit_error=True)
+    use_nodes = "--nodes" in sys.argv[1:]
+    cluster = None
+    if use_nodes:
+        from ray_tpu.cluster_utils import Cluster
+
+        cluster = Cluster(initialize_head=True, real=True,
+                          head_node_args={"num_cpus": 2})
+        cluster.add_node(num_cpus=2, resources={"stageA": 1.0})
+        cluster.add_node(num_cpus=2, resources={"stageB": 1.0})
+    else:
+        ray_tpu.init(ignore_reinit_error=True)
 
     CFG = dict(vocab_size=512, n_layer=4, n_head=4, d_model=128, seq_len=32)
 
@@ -86,8 +99,12 @@ def main() -> None:
             return {"next_token": int(jnp.argmax(logits[0, -1])),
                     "stage_pids": (stage_a_pid, os.getpid())}
 
-    a = StageA.options(isolation="process").remote(CFG)
-    b = StageB.options(isolation="process").remote(CFG)
+    if use_nodes:
+        a = StageA.options(resources={"stageA": 1.0}).remote(CFG)
+        b = StageB.options(resources={"stageB": 1.0}).remote(CFG)
+    else:
+        a = StageA.options(isolation="process").remote(CFG)
+        b = StageB.options(isolation="process").remote(CFG)
 
     with InputNode() as inp:
         out = b.forward.bind(a.forward.bind(inp))
@@ -112,11 +129,15 @@ def main() -> None:
                 rng.integers(0, 512, (1, 32), dtype=np.int64)).get(timeout=120))
         dt = time.perf_counter() - t0
         assert all("next_token" in o for o in outs)
+        tier = "node" if use_nodes else "process"
         print(f"{n} pipelined requests in {dt:.2f}s "
-              f"({n / dt:.1f} req/s through 2 process stages)")
+              f"({n / dt:.1f} req/s through 2 {tier} stages)")
     finally:
         dag.teardown()
-    ray_tpu.shutdown()
+    if cluster is not None:
+        cluster.shutdown()
+    else:
+        ray_tpu.shutdown()
     print("pp_inference_dag OK")
 
 

@@ -352,6 +352,9 @@ class WorkerNode:
             "resources": dict(local.total),
             "labels": dict(local.labels),
             "object_addr": self.runtime.object_server.addr,
+            # Node-local plasma arena: compiled-DAG channel elements pushed
+            # to this node land here (dag/channel.py RemoteChannel).
+            "arena_path": self.runtime.store.arena_path,
             "pid": os.getpid(),
         }))
         kind, head_id = self.conn.recv()

@@ -63,10 +63,25 @@ OP_RELEASE_BORROW = 6
 #: died, and the owner drops every borrow registered under its id.  The
 #: object id field carries the borrower id; no reply is sent.
 OP_BORROW_SESSION = 7
+#: Compiled-DAG channel plane (dag/channel.py RemoteChannel): an element
+#: pushed by a producer in ANOTHER runtime lands in this runtime's plasma
+#: arena under the channel's ``<name>:<seq>`` key; the local consumer reads
+#: and deletes it.  The id field of the frame carries the channel name.
+#: (ref: the reference's cross-worker compiled-graph edges —
+#: experimental/channel/shared_memory_channel.py + torch NCCL channels; here
+#: one transport tier rides the existing object-plane TCP endpoint.)
+OP_CHAN_PUSH = 8
+OP_CHAN_CLOSE = 9
+OP_CHAN_RECLAIM = 10
 
 ST_OK = 0
 ST_NOT_FOUND = 1
 ST_ERROR = 2
+#: Channel backpressure: the element was NOT accepted — the consumer is
+#: ``maxsize`` behind; retry after a short sleep.
+ST_FULL = 5
+#: The channel was closed (sentinel present); writers must stop.
+ST_CLOSED = 6
 #: The owner knows the object (entry pending / producing task in flight) but
 #: it is not ready yet — the borrower should keep waiting, NOT declare loss.
 ST_PENDING = 3
@@ -162,6 +177,17 @@ class ObjectTransferServer:
         #: within the reap grace period cancels the pending reap).
         self._live_sessions: Dict[str, int] = {}
         self._sessions_lock = threading.Lock()
+        #: channel name -> consumed floor (lowest seq that may still be
+        #: live), advanced by probing — the reader deletes in order.
+        self._chan_floors: Dict[str, int] = {}
+        #: channel name -> next seq not yet accepted.  A re-push of an
+        #: accepted seq (ack lost to a connection reset; the producer
+        #: retried) must be answered ST_OK WITHOUT re-sealing — the reader
+        #: may have
+        #: consumed it already, and a re-sealed dead element would pin the
+        #: floor and wedge the channel in ST_FULL forever.
+        self._chan_next: Dict[str, int] = {}
+        self._chan_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -228,6 +254,20 @@ class ObjectTransferServer:
                     if cb is not None:
                         cb(oid, borrower)
                     conn.sendall(bytes([ST_OK]))
+                elif op == OP_CHAN_PUSH:
+                    self._handle_chan_push(conn, str(oid))
+                elif op == OP_CHAN_CLOSE:
+                    arena = self._chan_arena()
+                    if arena is None:
+                        conn.sendall(bytes([ST_ERROR]))
+                    else:
+                        key = f"{oid}:__closed__"
+                        if not arena.contains(key):
+                            arena.put_bytes(key, b"1")
+                        conn.sendall(bytes([ST_OK]))
+                elif op == OP_CHAN_RECLAIM:
+                    drop_sentinel = _recv_exact(conn, 1)[0] != 0
+                    self._handle_chan_reclaim(conn, str(oid), drop_sentinel)
                 elif op == OP_BORROW_SESSION:
                     # The "object id" field carries the borrower id; this
                     # connection now IS the borrower's liveness signal —
@@ -332,6 +372,81 @@ class ObjectTransferServer:
             payload = serialization.dumps(RuntimeError(repr(err)))
         conn.sendall(bytes([ST_FAILED]) + struct.pack("<Q", len(payload)))
         _send_payload(conn, payload)
+
+    # ------------------------------------------------- channel plane
+    def _chan_arena(self):
+        store = self._store_provider()
+        return getattr(store, "plasma", None) if store is not None else None
+
+    def _handle_chan_push(self, conn: socket.socket, name: str) -> None:
+        seq, maxsize, flags = struct.unpack("<IIB", _recv_exact(conn, 9))
+        probe = bool(flags & 1)
+        payload = b""
+        if not probe:
+            (size,) = struct.unpack("<Q", _recv_exact(conn, 8))
+            payload = _recv_into(conn, size)
+        arena = self._chan_arena()
+        if arena is None:
+            conn.sendall(bytes([ST_ERROR]))
+            return
+        if arena.contains(f"{name}:__closed__"):
+            conn.sendall(bytes([ST_CLOSED]))
+            return
+        with self._chan_lock:
+            if seq < self._chan_next.get(name, 0):
+                # Duplicate of an already-accepted element (the ack was lost
+                # to a reset and the producer retried): acknowledge, never
+                # re-seal — the reader may have consumed it already.
+                conn.sendall(bytes([ST_OK]))
+                return
+            floor = self._chan_floors.get(name, 0)
+            while floor < seq and not arena.contains(f"{name}:{floor}"):
+                floor += 1
+            self._chan_floors[name] = floor
+            if seq - floor >= max(1, maxsize):
+                conn.sendall(bytes([ST_FULL]))
+                return
+            if probe:
+                # Capacity probe only (backpressured writers poll with these
+                # instead of retransmitting the payload): report admissible.
+                conn.sendall(bytes([ST_OK]))
+                return
+            arena.put_bytes(f"{name}:{seq}", bytes(payload))
+            self._chan_next[name] = seq + 1
+        conn.sendall(bytes([ST_OK]))
+
+    def _handle_chan_reclaim(self, conn: socket.socket, name: str,
+                             drop_sentinel: bool) -> None:
+        """Delete a torn-down channel's arena objects (same probe-forward
+        scheme as SharedMemoryChannel.reclaim, run where the arena lives)."""
+        arena = self._chan_arena()
+        if arena is None:
+            conn.sendall(bytes([ST_ERROR]))
+            return
+
+        def drop(key: str) -> bool:
+            try:
+                if not arena.contains(key):
+                    return False
+                arena.release(key)
+                arena.delete(key)
+                return True
+            except Exception:
+                return False
+
+        with self._chan_lock:
+            start = self._chan_floors.pop(name, 0)
+            self._chan_next.pop(name, None)
+        misses, k = 0, start
+        while misses < 256:
+            if drop(f"{name}:{k}"):
+                misses = 0
+            else:
+                misses += 1
+            k += 1
+        if drop_sentinel:
+            drop(f"{name}:__closed__")
+        conn.sendall(bytes([ST_OK]))
 
     def _handle_push(self, conn: socket.socket, oid: ObjectID) -> None:
         (owner_len,) = struct.unpack("<H", _recv_exact(conn, 2))
@@ -610,6 +725,48 @@ def free_remote(addr: str, oid: ObjectID, timeout: float = 5.0) -> None:
     sock = _request_sock(addr, timeout)
     try:
         sock.sendall(_req_header(OP_FREE, oid))
+        _recv_exact(sock, 1)
+    finally:
+        sock.close()
+
+
+# ----------------------------------------------------------- channel plane
+def chan_connect(addr: str, timeout: float = 30.0) -> socket.socket:
+    """Persistent producer-side connection for a channel's pushes."""
+    return _request_sock(addr, timeout)
+
+
+def chan_push_sock(sock: socket.socket, name: str, seq: int, maxsize: int,
+                   payload: bytes, probe: bool = False) -> int:
+    """Push one element (or, with ``probe``, just ask whether seq would be
+    admitted — no payload travels) over an open channel connection;
+    returns ST_*.  Backpressured writers poll with probes so a full channel
+    costs 9 header bytes per retry, not a payload retransmit."""
+    frame = _req_header(OP_CHAN_PUSH, name) + struct.pack(
+        "<IIB", seq, maxsize, 1 if probe else 0)
+    if probe:
+        sock.sendall(frame)
+    else:
+        sock.sendall(frame + struct.pack("<Q", len(payload)))
+        _send_payload(sock, payload)
+    return _recv_exact(sock, 1)[0]
+
+
+def chan_close_remote(addr: str, name: str, timeout: float = 10.0) -> None:
+    sock = _request_sock(addr, timeout)
+    try:
+        sock.sendall(_req_header(OP_CHAN_CLOSE, name))
+        _recv_exact(sock, 1)
+    finally:
+        sock.close()
+
+
+def chan_reclaim_remote(addr: str, name: str, drop_sentinel: bool,
+                        timeout: float = 30.0) -> None:
+    sock = _request_sock(addr, timeout)
+    try:
+        sock.sendall(_req_header(OP_CHAN_RECLAIM, name)
+                     + bytes([1 if drop_sentinel else 0]))
         _recv_exact(sock, 1)
     finally:
         sock.close()

@@ -1609,7 +1609,18 @@ class Runtime:
         try:
             with tracing.task_execute_span(spec):
                 args, kwargs = self._resolve_args(spec)
-                if worker is not None:
+                from ray_tpu._private.task_spec import EXEC_FN_METHOD
+
+                if spec.method_name == EXEC_FN_METHOD and spec.func is not None:
+                    # Shipped-function actor task (compiled-DAG resident
+                    # loops): run spec.func against the instance — the
+                    # instance has no such method to look up.
+                    if worker is not None:
+                        result = worker.actor_exec(
+                            serialization.dumps(spec.func), args, kwargs)
+                    else:
+                        result = spec.func(state.instance, *args, **kwargs)
+                elif worker is not None:
                     if spec.generator:
                         # Stream the method's items over the multiplexed
                         # worker pipe into the generator machinery.

@@ -13,6 +13,14 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private.ids import ActorID, TaskID
 from ray_tpu._private.scheduling import SchedulingStrategy
 
+#: Actor-task escape hatch: a spec with this method_name runs ``spec.func``
+#: with the actor INSTANCE prepended to its args instead of looking the
+#: method up on the instance — how a compiled DAG installs its resident
+#: executor loop on an actor hosted in another runtime (ref: the reference
+#: submits do_exec_tasks to each actor the same way,
+#: compiled_dag_node.py:711).
+EXEC_FN_METHOD = "__ray_tpu_exec_fn__"
+
 
 class TaskSpec:
     __slots__ = (

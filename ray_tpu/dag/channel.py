@@ -112,17 +112,26 @@ _ARENA_CLIENTS: dict = {}
 _ARENA_LOCK = threading.Lock()
 
 
+def _client_closed(client) -> bool:
+    return not getattr(client, "_h", None)
+
+
 def seed_arena_client(path: str, client) -> None:
     """Register an existing client (e.g. the object store's) so channels in
     this process reuse it instead of opening a second mmap."""
     with _ARENA_LOCK:
-        _ARENA_CLIENTS.setdefault(path, client)
+        cached = _ARENA_CLIENTS.get(path)
+        if cached is None or _client_closed(cached):
+            _ARENA_CLIENTS[path] = client
 
 
 def _arena_for(path: str):
     with _ARENA_LOCK:
         client = _ARENA_CLIENTS.get(path)
-        if client is None:
+        if client is None or _client_closed(client):
+            # None, or a stale cache entry from a previous runtime in this
+            # process whose store closed it (arena paths repeat per-pid
+            # across init/shutdown cycles).
             from ray_tpu.native.plasma import PlasmaClient
 
             client = _ARENA_CLIENTS[path] = PlasmaClient(path, create=False)
@@ -259,3 +268,116 @@ class SharedMemoryChannel:
             k += 1
         if drop_sentinel:
             drop(f"{self.name}:__closed__")
+
+
+class RemoteChannel(SharedMemoryChannel):
+    """Cross-RUNTIME channel: the consumer runtime's object server receives
+    pushed elements over TCP (OP_CHAN_PUSH) and lands them in ITS plasma
+    arena under the same ``<name>:<seq>`` keys; the consumer reads/deletes
+    from that local arena exactly like SharedMemoryChannel.
+
+    This is the node-to-node tier of the channel fabric — the role NCCL
+    channels play for the reference's cross-host compiled graphs (ref:
+    python/ray/experimental/channel/torch_tensor_nccl_channel.py,
+    nccl_group.py:318).  TPU-native split: on-device tensors cross chips
+    inside jitted programs over ICI; this channel is the host-side data and
+    control edge between runtimes, riding the existing object-plane TCP
+    endpoint (one wire protocol, no second fabric).
+
+    write() always pushes to ``consumer_addr`` — even from the consumer's
+    own host, one code path; the server applies backpressure (ST_FULL) when
+    the writer runs ``maxsize`` ahead of the reader.  read() attaches the
+    arena at ``arena_path``, reachable only in the consumer runtime's
+    processes.  close() is a control frame, callable from any endpoint."""
+
+    def __init__(self, name: str, consumer_addr: str, arena_path: str,
+                 maxsize: int = 16):
+        super().__init__(arena=None, name=name, maxsize=maxsize,
+                         arena_path=arena_path)
+        self._consumer_addr = consumer_addr
+        self._sock = None
+
+    def __getstate__(self):
+        state = super().__getstate__()
+        state["_sock"] = None  # producer connections never travel
+        return state
+
+    def _disconnect(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        import time as _time
+
+        from ray_tpu._private import object_transfer as ot
+
+        if self._closed:
+            raise ChannelClosed(self.name)
+        payload = pickle.dumps(value, protocol=5)
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        reconnects = 0
+        probe = False  # backpressured: poll with payload-less probes
+        while True:
+            try:
+                if self._sock is None:
+                    self._sock = ot.chan_connect(self._consumer_addr)
+                st = ot.chan_push_sock(self._sock, self.name, self._wseq,
+                                       self._maxsize, payload, probe=probe)
+            except (OSError, ConnectionError):
+                # One reconnect per element: a transient reset heals; a dead
+                # consumer runtime is a closed edge (node-death teardown).
+                self._disconnect()
+                reconnects += 1
+                if reconnects > 1:
+                    raise ChannelClosed(self.name)
+                probe = False  # ack lost mid-frame: re-push the payload
+                continue
+            if st == ot.ST_OK:
+                if probe:
+                    probe = False  # admitted — now ship the payload
+                    continue
+                self._wseq += 1
+                return
+            if st == ot.ST_FULL:
+                if deadline is not None and _time.monotonic() > deadline:
+                    raise ChannelTimeout(
+                        f"write timeout on remote channel {self.name!r}")
+                probe = True
+                _time.sleep(0.0005)
+                continue
+            # ST_CLOSED, or ST_ERROR (arena torn down with the runtime)
+            raise ChannelClosed(self.name)
+
+    def close(self) -> None:
+        self._closed = True
+        self._disconnect()
+        from ray_tpu._private import object_transfer as ot
+
+        try:
+            ot.chan_close_remote(self._consumer_addr, self.name)
+        except (OSError, ConnectionError):
+            pass  # consumer runtime already gone — closed either way
+
+    def reclaim(self, drop_sentinel: bool = True) -> None:
+        from ray_tpu._private import object_transfer as ot
+
+        try:
+            ot.chan_reclaim_remote(self._consumer_addr, self.name,
+                                   drop_sentinel)
+        except (OSError, ConnectionError):
+            pass  # arena died with its runtime; nothing left to reclaim
+
+
+class NodeLocalChannel(RemoteChannel):
+    """Edge whose BOTH endpoints live inside one worker node's runtime:
+    reads AND writes go straight to that node's arena (plain shm, no TCP
+    hop).  Only the control plane stays remote — the DRIVER owns teardown,
+    cannot attach the node's arena, and so closes/reclaims through the
+    node's object-plane endpoint (inherited from RemoteChannel)."""
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        SharedMemoryChannel.write(self, value, timeout)

@@ -181,8 +181,9 @@ class CompiledDAG:
 
         def _placement(node: Optional[ClassMethodNode]):
             """Which OS process hosts a node: "driver" for the driver and
-            thread-tier actors (they share the heap), or the process
-            worker's identity for process-isolated actors."""
+            thread-tier actors (they share the heap), ("proc", id) for
+            process-isolated actors on this host, ("node", node_id) for
+            actors hosted by a worker node's runtime."""
             if node is None:
                 return "driver"
             state = runtime_early.get_actor_state(
@@ -198,6 +199,7 @@ class CompiledDAG:
 
             deadline = _t.monotonic() + 120
             while (state.instance is None and state.proc_worker is None
+                   and state.remote_node is None
                    and state.state not in ("DEAD",)
                    and _t.monotonic() < deadline):
                 _t.sleep(0.005)
@@ -206,6 +208,8 @@ class CompiledDAG:
                     f"actor for {node._method_name!r} is DEAD "
                     f"(cause: {state.death_cause!r}); cannot compile a DAG "
                     "over it")
+            if state.remote_node is not None:
+                return ("node", str(state.remote_node))
             if state.instance is None and state.proc_worker is None:
                 raise TimeoutError(
                     f"actor for {node._method_name!r} not ready within 120s; "
@@ -213,6 +217,36 @@ class CompiledDAG:
                     "compiled DAG")
             return ("proc", id(state.proc_worker)) \
                 if state.proc_worker is not None else "driver"
+
+        def _runtime_of(placement) -> str:
+            """Collapse a placement to its hosting RUNTIME: worker-node id,
+            or "driver" for everything in this process tree (driver threads
+            + its process workers share the driver's arena)."""
+            if isinstance(placement, tuple) and placement[0] == "node":
+                return placement[1]
+            return "driver"
+
+        def _runtime_endpoint(runtime_id: str):
+            """(object-server addr, arena path) of a runtime — where pushed
+            channel elements for consumers in that runtime must land."""
+            if runtime_id == "driver":
+                if runtime_early.object_server is None:
+                    runtime_early.start_object_server()
+                return (runtime_early.object_server.addr,
+                        runtime_early.store.arena_path)
+            from ray_tpu._private.ids import NodeID
+
+            node = runtime_early._remote_node(NodeID(runtime_id))
+            if node is None or not node.alive:
+                raise ValueError(
+                    f"worker node {runtime_id} is gone; cannot compile a "
+                    "DAG over its actors")
+            arena = node.info.get("arena_path")
+            if not arena:
+                raise ValueError(
+                    f"worker node {runtime_id} has no plasma arena; "
+                    "compiled-DAG channels need one")
+            return node.object_addr, arena
 
         topo = self._output_node._topo()
         out_node = self._output_node
@@ -251,10 +285,39 @@ class CompiledDAG:
         def make_channel(producer: Optional[ClassMethodNode],
                          consumer: Optional[ClassMethodNode]) -> Channel:
             transport = getattr(producer, "_tensor_transport", None) if producer else None
+            p_prod, p_cons = _placement(producer), _placement(consumer)
+            r_prod, r_cons = _runtime_of(p_prod), _runtime_of(p_cons)
             if transport is not None:
                 ch = DeviceChannel(device=transport, maxsize=self._max_buffered)
-            elif "driver" != _placement(producer) or \
-                    "driver" != _placement(consumer):
+            elif r_prod != r_cons:
+                # The edge crosses RUNTIMES (driver <-> node or node <->
+                # node): elements ride the consumer runtime's object-plane
+                # endpoint into its arena (ref: the reference's cross-host
+                # compiled-graph edges — torch_tensor_nccl_channel.py; here
+                # the host wire is the object plane, device hops stay
+                # inside jitted programs on ICI).
+                from ray_tpu.dag.channel import RemoteChannel
+
+                addr, arena_path = _runtime_endpoint(r_cons)
+                shm_counter[0] += 1
+                ch = RemoteChannel(
+                    name=f"dagch:{chan_ns}:{shm_counter[0]}",
+                    consumer_addr=addr, arena_path=arena_path,
+                    maxsize=self._max_buffered)
+            elif r_prod != "driver":
+                # Both endpoints inside ONE worker node's runtime: reads and
+                # writes are direct shm on that node's arena; only the
+                # driver's close/reclaim control frames ride the node's
+                # object-plane endpoint (the driver can't attach the arena).
+                from ray_tpu.dag.channel import NodeLocalChannel
+
+                addr, arena_path = _runtime_endpoint(r_prod)
+                shm_counter[0] += 1
+                ch = NodeLocalChannel(
+                    name=f"dagch:{chan_ns}:{shm_counter[0]}",
+                    consumer_addr=addr, arena_path=arena_path,
+                    maxsize=self._max_buffered)
+            elif "driver" != p_prod or "driver" != p_cons:
                 # An endpoint lives in a process worker: the edge rides the
                 # native plasma arena (ref: shared_memory_channel.py — the
                 # reference's compiled graphs use mutable plasma objects
@@ -339,13 +402,55 @@ class CompiledDAG:
             if state is None:
                 raise ValueError(f"Actor {actor_id} not found for compiled DAG")
             # Actor construction is async; wait until the instance exists
-            # (thread tier) or the worker process holds it (process tier).
+            # (thread tier), the worker process holds it (process tier), or
+            # a worker node hosts it (node tier).
             import time as _time
 
             deadline = _time.monotonic() + 30
             while (state.instance is None and state.proc_worker is None
+                   and state.remote_node is None
                    and _time.monotonic() < deadline):
                 _time.sleep(0.002)
+            if state.remote_node is not None:
+                # NODE-HOSTED actor: ship the resident loop as a shipped-
+                # function actor task (EXEC_FN_METHOD); the hosting node
+                # runs it against its local instance, and every edge is a
+                # Remote/shm channel so the schedule pickles (ref:
+                # compiled_dag_node.py:711 — the reference submits
+                # do_exec_tasks to each actor identically).
+                from ray_tpu._private.task_spec import EXEC_FN_METHOD
+
+                slim = []
+                for op in schedule:
+                    clone = _CompiledOp(None, op.method_name)
+                    clone.arg_sources = op.arg_sources
+                    clone.kwarg_sources = op.kwarg_sources
+                    clone.out_channels = op.out_channels
+                    clone.reads_input = op.reads_input
+                    slim.append(clone)
+                spec = TaskSpec(
+                    task_id=TaskID.from_random(),
+                    name=f"{handle._cls.__name__}.compiled_dag_loop",
+                    func=_actor_exec_loop,
+                    args=(slim,),
+                    kwargs={},
+                    num_returns=1,
+                    resources={},
+                    strategy=None,
+                    max_retries=0,
+                    actor_id=actor_id,
+                    method_name=EXEC_FN_METHOD,
+                )
+                ref = runtime.submit_actor_task(actor_id, spec)
+                # Watcher mirrors _proc_loop_runner: a loop dying on a
+                # non-ChannelClosed error (unpicklable result, node death)
+                # must close every edge, or blocked peers hang forever.
+                t = threading.Thread(
+                    target=self._node_loop_watcher, args=(ref,),
+                    name=f"dag-node-loop-{actor_id}", daemon=True)
+                t.start()
+                self._loop_refs.append(t)
+                continue
             if state.proc_worker is not None:
                 # PROCESS-ISOLATED actor: the resident loop runs INSIDE the
                 # worker process against its instance; every edge is a shm
@@ -391,6 +496,24 @@ class CompiledDAG:
                 method_name=loop_attr,
             )
             self._loop_refs.append(runtime.submit_actor_task(actor_id, spec))
+
+    def _node_loop_watcher(self, ref) -> None:
+        """Driver-side thread shadowing one node-hosted resident loop;
+        returns when the loop exits cleanly on ChannelClosed."""
+        from ray_tpu._private.runtime import get_runtime
+
+        try:
+            get_runtime().get(ref)
+        except Exception:
+            if not self._torn_down:
+                import traceback
+
+                traceback.print_exc()
+                for ch in self._all_channels:
+                    try:
+                        ch.close()
+                    except Exception:
+                        pass
 
     def _proc_loop_runner(self, worker, fn_bytes: bytes, schedule) -> None:
         """Driver-side thread hosting one process actor's resident-loop
