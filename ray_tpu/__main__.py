@@ -1,9 +1,10 @@
 """CLI: `python -m ray_tpu <command>`.
 
-Counterpart of the reference's `ray` CLI surface that applies to the
-single-runtime model (ref: python/ray/scripts/scripts.py `ray status`,
+Counterpart of the reference's `ray` CLI surface (ref:
+python/ray/scripts/scripts.py `ray status`/`ray start`,
 util/state/state_cli.py `ray list/summary`, _private/state.py timeline).
-Cluster lifecycle commands (`ray up/start`) belong to the autoscaler layer.
+`start --head` runs the standalone head daemon, `worker` joins it as a
+node, `up/down` drive cluster YAML through the autoscaler layer.
 
 Note: each invocation starts a fresh runtime in this process, so the
 list/summary commands are mainly useful inside a driver (via
@@ -234,6 +235,57 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_start(args) -> int:
+    """Standalone head daemon: the control plane with NO driver attached
+    (ref: `ray start --head`, python/ray/scripts/scripts.py:start — GCS +
+    raylet as long-lived services).  Drivers come and go over ray://
+    (client server); worker nodes join over the node server; state persists
+    to --session-dir so a kill -9'd head restarts in place and nodes
+    re-register (node_manager.py:_try_rejoin)."""
+    if not args.head:
+        print("only `start --head` is supported; worker nodes join with "
+              "`ray_tpu worker --address=...`", file=sys.stderr)
+        return 2
+    import json as _json
+    import os as _os
+    import signal
+    import threading
+
+    import ray_tpu
+    from ray_tpu.util.client import ClientServer
+
+    sysconf = None
+    if args.session_dir:
+        sysconf = {"kv_persist": True, "session_dir": args.session_dir}
+    runtime = ray_tpu.init(num_cpus=args.num_cpus,
+                           resources=_json.loads(args.resources)
+                           if args.resources else None,
+                           _system_config=sysconf)
+    node_addr = runtime.start_node_server(port=args.port)
+    client = ClientServer(port=args.client_port)
+    if args.session_dir:
+        _os.makedirs(args.session_dir, exist_ok=True)
+        with open(_os.path.join(args.session_dir, "head_address.json"),
+                  "w") as f:
+            _json.dump({"node_address": node_addr,
+                        "client_address": client.address,
+                        "pid": _os.getpid()}, f)
+    print(f"HEAD node-address={node_addr} "
+          f"client-address={client.address}", flush=True)
+    print("READY", flush=True)
+
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda *_: done.set())
+        except ValueError:
+            pass
+    done.wait()
+    client.stop()
+    ray_tpu.shutdown()
+    return 0
+
+
 def cmd_worker(args) -> int:
     """Join a head as a worker node and serve dispatches until the head
     hangs up (ref: `ray start --address=...` joining a cluster).
@@ -323,6 +375,21 @@ def main(argv=None) -> int:
                                         "(ref: dashboard memray profiling)")
     mem.add_argument("--top", type=int, default=20)
 
+    st = sub.add_parser("start", help="start a standalone head daemon "
+                                      "(ref: ray start --head)")
+    st.add_argument("--head", action="store_true",
+                    help="run the head control plane (required)")
+    st.add_argument("--port", type=int, default=0,
+                    help="node-manager port worker nodes join on")
+    st.add_argument("--client-port", type=int, default=0,
+                    help="ray:// client-server port drivers attach to")
+    st.add_argument("--num-cpus", type=float, default=None)
+    st.add_argument("--resources", default=None,
+                    help='JSON dict of custom resources on the head')
+    st.add_argument("--session-dir", default=None,
+                    help="persist control-plane state here (WAL KV); a "
+                         "restarted head over the same dir restores it")
+
     wk = sub.add_parser("worker", help="join a head as a worker node "
                                        "(ref: ray start --address)")
     wk.add_argument("--address", required=True, help="head node-manager "
@@ -345,6 +412,7 @@ def main(argv=None) -> int:
         "timeline": cmd_timeline, "metrics": cmd_metrics, "job": cmd_job,
         "logs": cmd_logs, "run": cmd_run, "up": cmd_up, "down": cmd_down,
         "stack": cmd_stack, "memory": cmd_memory, "worker": cmd_worker,
+        "start": cmd_start,
     }[args.cmd](args)
 
 

@@ -80,6 +80,12 @@ class Config:
     direct_return_max_bytes: int = 256 * 1024
     #: Worker-node heartbeat cadence over the node connection.
     node_heartbeat_interval_s: float = 2.0
+    #: How long a worker node keeps retrying to reconnect + re-register
+    #: after losing its head connection (a restarted head comes back within
+    #: this window and the node rejoins; 0 disables rejoin — drop the node
+    #: on first disconnect).  Ref: python/ray/_private/node.py:1407 raylets
+    #: tolerating GCS downtime.
+    node_reconnect_grace_s: float = 120.0
     #: Head declares a node dead after this long without a frame
     #: (ref: gcs_health_check_manager.h:45 health-check timeout).
     node_heartbeat_timeout_s: float = 30.0

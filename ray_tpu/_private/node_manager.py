@@ -161,8 +161,15 @@ class NodeManagerServer:
             node = RemoteNode(node_id, conn, info)
             # Ack BEFORE the scheduler learns the node: the first dispatch
             # may race the ack onto the wire, and the worker expects
-            # ("registered", ...) as its first frame.
-            conn.send(("registered", str(self._runtime.head_node_id)))
+            # ("registered", ...) as its first frame.  The third field
+            # tells a REJOINING node whether the head still knows it
+            # (False = keep local state; True = fresh session, reset —
+            # loss recovery already restarted its actors elsewhere).
+            existing = self._runtime.scheduler.get_node(node_id)
+            known = (self._runtime._remote_node(node_id) is not None
+                     and existing is not None and existing.alive)
+            conn.send(("registered", str(self._runtime.head_node_id),
+                       not known))
             self._runtime._register_remote_node(node, info)
             while not self._stop.is_set():
                 frame = conn.recv()
@@ -340,14 +347,28 @@ class WorkerNode:
         install_runtime(self.runtime)
         self.runtime.start_object_server()
 
-        host, _, port_s = address.rpartition(":")
+        self.address = address
+        self.node_id = NodeID(node_id) if node_id else NodeID.from_random()
+        self._stop = threading.Event()
+        self._req_lock = threading.Lock()
+        self._req_counter = 0
+        self._pending_reqs: Dict[int, list] = {}
+
+        self.conn, self.head_node_id, _ = self._connect_and_register()
+
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name="ray_tpu_node_hb", daemon=True)
+        self._hb_thread.start()
+        self._install_debug_signal()
+
+    def _connect_and_register(self):
+        """Dial the head and register; returns (conn, head session id)."""
+        host, _, port_s = self.address.rpartition(":")
         sock = socket.create_connection((host, int(port_s)), timeout=30)
         sock.settimeout(None)
-        self.conn = _FramedConn(sock)
-        self.node_id = NodeID(node_id) if node_id else NodeID.from_random()
-
+        conn = _FramedConn(sock)
         local = self.runtime.scheduler.get_node(self.runtime.head_node_id)
-        self.conn.send(("register", {
+        conn.send(("register", {
             "node_id": str(self.node_id),
             "resources": dict(local.total),
             "labels": dict(local.labels),
@@ -357,19 +378,11 @@ class WorkerNode:
             "arena_path": self.runtime.store.arena_path,
             "pid": os.getpid(),
         }))
-        kind, head_id = self.conn.recv()
-        if kind != "registered":
-            raise ConnectionError(f"head rejected registration: {kind!r}")
-        self.head_node_id = head_id
-
-        self._stop = threading.Event()
-        self._req_lock = threading.Lock()
-        self._req_counter = 0
-        self._pending_reqs: Dict[int, list] = {}
-        self._hb_thread = threading.Thread(
-            target=self._heartbeat_loop, name="ray_tpu_node_hb", daemon=True)
-        self._hb_thread.start()
-        self._install_debug_signal()
+        msg = conn.recv()
+        if msg[0] != "registered":
+            raise ConnectionError(f"head rejected registration: {msg[0]!r}")
+        fresh = bool(msg[2]) if len(msg) > 2 else True
+        return conn, msg[1], fresh
 
     def _install_debug_signal(self) -> None:
         """`kill -USR2 <pid>`: dump dep-wait state to stderr (companion to
@@ -415,15 +428,77 @@ class WorkerNode:
 
     # ---------------------------------------------------------------- serve
     def serve_forever(self) -> None:
-        """Reader loop; returns when the head hangs up or shutdown arrives."""
+        """Reader loop; survives head restarts by re-registering within the
+        reconnect grace window; returns on shutdown or grace expiry."""
         try:
             while not self._stop.is_set():
-                frame = self.conn.recv()
+                try:
+                    frame = self.conn.recv()
+                except (EOFError, OSError, ConnectionError):
+                    if not self._try_rejoin():
+                        return
+                    continue
                 self._handle_frame(frame)
-        except (EOFError, OSError, ConnectionError):
-            pass
         finally:
             self.stop()
+
+    def _try_rejoin(self) -> bool:
+        """Head connection lost: keep retrying register for the grace
+        window — a restarted head accepts us back and tasks place here
+        again (ref: python/ray/_private/node.py:1407, raylet re-register
+        across GCS restarts; python/ray/tests/test_gcs_fault_tolerance.py).
+        """
+        grace = GLOBAL_CONFIG.node_reconnect_grace_s
+        if self._stop.is_set() or grace <= 0:
+            return False
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        # Replies to in-flight head requests will never arrive: fail them
+        # now instead of letting each ride out its full timeout.
+        lost = ConnectionError("head connection lost (rejoining)")
+        with self._req_lock:
+            for slot in self._pending_reqs.values():
+                slot[1] = ("err", serialization.dumps((lost, None)))
+                slot[0].set()
+            self._pending_reqs.clear()
+        deadline = time.monotonic() + grace
+        while not self._stop.is_set() and time.monotonic() < deadline:
+            try:
+                conn, head_id, fresh = self._connect_and_register()
+            except (OSError, ConnectionError, EOFError):
+                time.sleep(1.0)
+                continue
+            if fresh or head_id != self.head_node_id:
+                # The head's control plane holds no state for us — it
+                # restarted, or it already ran loss recovery and restarted
+                # our actors elsewhere.  Drop everything the dead session
+                # placed here (actors would be split-brain duplicates, and
+                # orphan leases/export pins would leak this node's
+                # resources forever).
+                self._reset_local_state()
+                self.head_node_id = head_id
+            self.conn = conn
+            print(f"[node {self.node_id}] rejoined head {head_id} "
+                  f"at {self.address} (fresh={fresh})", flush=True)
+            return True
+        return False
+
+    def _reset_local_state(self) -> None:
+        """Kill everything the previous head session placed on this node."""
+        rt = self.runtime
+        for actor_id in list(getattr(rt, "_actors", {})):
+            try:
+                rt.kill_actor(actor_id, no_restart=True)
+            except Exception:
+                pass
+        # Export pins the dead head held on our results (node_manager
+        # EXPORT_BORROWER borrows) will never be released by it.
+        try:
+            rt._on_borrower_lost(EXPORT_BORROWER)
+        except Exception:
+            pass
 
     def stop(self) -> None:
         if self._stop.is_set():
@@ -441,7 +516,9 @@ class WorkerNode:
             try:
                 self.conn.send(("heartbeat",))
             except (OSError, ConnectionError):
-                return
+                # Disconnected: keep looping — serve_forever's rejoin swaps
+                # in a fresh conn and heartbeats resume on it.
+                continue
 
     # --------------------------------------------------------------- frames
     def _handle_frame(self, frame: tuple) -> None:

@@ -267,7 +267,9 @@ class ObjectTransferServer:
                         conn.sendall(bytes([ST_OK]))
                 elif op == OP_CHAN_RECLAIM:
                     drop_sentinel = _recv_exact(conn, 1)[0] != 0
-                    self._handle_chan_reclaim(conn, str(oid), drop_sentinel)
+                    (budget,) = struct.unpack("<I", _recv_exact(conn, 4))
+                    self._handle_chan_reclaim(conn, str(oid), drop_sentinel,
+                                              budget)
                 elif op == OP_BORROW_SESSION:
                     # The "object id" field carries the borrower id; this
                     # connection now IS the borrower's liveness signal —
@@ -403,22 +405,34 @@ class ObjectTransferServer:
             while floor < seq and not arena.contains(f"{name}:{floor}"):
                 floor += 1
             self._chan_floors[name] = floor
-            if seq - floor >= max(1, maxsize):
-                conn.sendall(bytes([ST_FULL]))
+            admissible = seq - floor < max(1, maxsize)
+        if not admissible:
+            conn.sendall(bytes([ST_FULL]))
+            return
+        if not probe:
+            # The payload memcpy runs OUTSIDE the lock (a multi-MB copy
+            # under the global lock would head-of-line block every other
+            # channel through this node); contains() guards the race with a
+            # duplicate re-push of the same seq.  chan_next advances only
+            # AFTER the element is sealed, and before the ack — so a
+            # retried seq is dup-acked only once it really exists.
+            try:
+                if not arena.contains(f"{name}:{seq}"):
+                    arena.put_bytes(f"{name}:{seq}", bytes(payload))
+            except Exception:
+                conn.sendall(bytes([ST_ERROR]))
                 return
-            if probe:
-                # Capacity probe only (backpressured writers poll with these
-                # instead of retransmitting the payload): report admissible.
-                conn.sendall(bytes([ST_OK]))
-                return
-            arena.put_bytes(f"{name}:{seq}", bytes(payload))
-            self._chan_next[name] = seq + 1
+            with self._chan_lock:
+                self._chan_next[name] = max(
+                    self._chan_next.get(name, 0), seq + 1)
         conn.sendall(bytes([ST_OK]))
 
     def _handle_chan_reclaim(self, conn: socket.socket, name: str,
-                             drop_sentinel: bool) -> None:
+                             drop_sentinel: bool, budget: int) -> None:
         """Delete a torn-down channel's arena objects (same probe-forward
-        scheme as SharedMemoryChannel.reclaim, run where the arena lives)."""
+        scheme as SharedMemoryChannel.reclaim, run where the arena lives;
+        the caller sizes ``budget`` to its maxsize so deep channels don't
+        out-run the miss tolerance)."""
         arena = self._chan_arena()
         if arena is None:
             conn.sendall(bytes([ST_ERROR]))
@@ -438,7 +452,8 @@ class ObjectTransferServer:
             start = self._chan_floors.pop(name, 0)
             self._chan_next.pop(name, None)
         misses, k = 0, start
-        while misses < 256:
+        budget = max(256, min(budget, 1 << 20))
+        while misses < budget:
             if drop(f"{name}:{k}"):
                 misses = 0
             else:
@@ -762,11 +777,12 @@ def chan_close_remote(addr: str, name: str, timeout: float = 10.0) -> None:
 
 
 def chan_reclaim_remote(addr: str, name: str, drop_sentinel: bool,
-                        timeout: float = 30.0) -> None:
+                        budget: int = 256, timeout: float = 30.0) -> None:
     sock = _request_sock(addr, timeout)
     try:
         sock.sendall(_req_header(OP_CHAN_RECLAIM, name)
-                     + bytes([1 if drop_sentinel else 0]))
+                     + bytes([1 if drop_sentinel else 0])
+                     + struct.pack("<I", budget))
         _recv_exact(sock, 1)
     finally:
         sock.close()

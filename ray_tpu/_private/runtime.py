@@ -564,14 +564,24 @@ class Runtime:
         with self._locations_lock:
             return self._object_locations.get(object_id, "")
 
-    def _register_remote_node(self, node, info: dict) -> None:
+    def _register_remote_node(self, node, info: dict) -> bool:
+        """Returns True when this is a FRESH registration — the head holds
+        no state for the node (first join, or loss recovery already ran and
+        dropped it).  A re-register of a still-known node (transient
+        reconnect that beat the loss handler) keeps the head's scheduler
+        ledger so in-flight leases aren't double-counted."""
         resources = dict(info.get("resources") or {})
         labels = dict(info.get("labels") or {})
         labels.setdefault("node-ip", node.conn._sock.getpeername()[0]
                           if hasattr(node.conn, "_sock") else "")
         with self._remote_nodes_lock:
+            fresh = node.node_id not in self._remote_nodes
             self._remote_nodes[node.node_id] = node
-        self.scheduler.add_node(resources, labels, node_id=node.node_id)
+        existing = self.scheduler.get_node(node.node_id)
+        if fresh or existing is None or not existing.alive:
+            self.scheduler.add_node(resources, labels, node_id=node.node_id)
+            fresh = True
+        return fresh
 
     def _remote_nodes_snapshot(self) -> List:
         with self._remote_nodes_lock:
@@ -724,7 +734,16 @@ class Runtime:
         gcs_health_check_manager.h:45, object_recovery_manager.h:38)."""
         node_id = node.node_id
         with self._remote_nodes_lock:
-            self._remote_nodes.pop(node_id, None)
+            superseded = self._remote_nodes.get(node_id) is not node
+            if not superseded:
+                self._remote_nodes.pop(node_id, None)
+        if superseded:
+            # The node already RE-REGISTERED over a fresh connection (rejoin
+            # races this loss handler): the process is alive, its dispatched
+            # work keeps running and reports over the NEW connection —
+            # removing it from the registry/scheduler or restarting its
+            # actors here would silently wreck a live, rejoined node.
+            return
         self.scheduler.remove_node(node_id)
 
         with self._remote_lock:

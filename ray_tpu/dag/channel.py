@@ -328,13 +328,18 @@ class RemoteChannel(SharedMemoryChannel):
                 st = ot.chan_push_sock(self._sock, self.name, self._wseq,
                                        self._maxsize, payload, probe=probe)
             except (OSError, ConnectionError):
-                # One reconnect per element: a transient reset heals; a dead
-                # consumer runtime is a closed edge (node-death teardown).
+                # A few reconnects ride out transient resets.  Past that,
+                # re-raise the SOCKET error — mapping it to ChannelClosed
+                # would read as graceful teardown and let the exec loop
+                # exit cleanly, silently wedging the rest of the DAG; a
+                # raw error fails the loop task so the driver-side watcher
+                # closes every edge.
                 self._disconnect()
                 reconnects += 1
-                if reconnects > 1:
-                    raise ChannelClosed(self.name)
+                if reconnects > 3:
+                    raise
                 probe = False  # ack lost mid-frame: re-push the payload
+                _time.sleep(0.05 * reconnects)
                 continue
             if st == ot.ST_OK:
                 if probe:
@@ -367,7 +372,8 @@ class RemoteChannel(SharedMemoryChannel):
 
         try:
             ot.chan_reclaim_remote(self._consumer_addr, self.name,
-                                   drop_sentinel)
+                                   drop_sentinel,
+                                   budget=max(256, 8 * self._maxsize))
         except (OSError, ConnectionError):
             pass  # arena died with its runtime; nothing left to reclaim
 

@@ -132,6 +132,20 @@ def _actor_exec_loop(instance, ops: List[_CompiledOp]) -> None:
             return
 
 
+def _slim_schedule(schedule: List[_CompiledOp]) -> List[_CompiledOp]:
+    """Strip DAG-node references so a schedule pickles to another process:
+    only method names, arg sources and channels travel."""
+    slim = []
+    for op in schedule:
+        clone = _CompiledOp(None, op.method_name)
+        clone.arg_sources = op.arg_sources
+        clone.kwarg_sources = op.kwarg_sources
+        clone.out_channels = op.out_channels
+        clone.reads_input = op.reads_input
+        slim.append(clone)
+    return slim
+
+
 class CompiledDAGRef:
     """Future for one compiled execution (ref: compiled_dag_ref.py)."""
 
@@ -420,14 +434,7 @@ class CompiledDAG:
                 # do_exec_tasks to each actor identically).
                 from ray_tpu._private.task_spec import EXEC_FN_METHOD
 
-                slim = []
-                for op in schedule:
-                    clone = _CompiledOp(None, op.method_name)
-                    clone.arg_sources = op.arg_sources
-                    clone.kwarg_sources = op.kwarg_sources
-                    clone.out_channels = op.out_channels
-                    clone.reads_input = op.reads_input
-                    slim.append(clone)
+                slim = _slim_schedule(schedule)
                 spec = TaskSpec(
                     task_id=TaskID.from_random(),
                     name=f"{handle._cls.__name__}.compiled_dag_loop",
@@ -458,14 +465,7 @@ class CompiledDAG:
                 # compiled_dag_node.py:711 cross-worker execution).
                 from ray_tpu._private import serialization
 
-                slim = []
-                for op in schedule:
-                    clone = _CompiledOp(None, op.method_name)
-                    clone.arg_sources = op.arg_sources
-                    clone.kwarg_sources = op.kwarg_sources
-                    clone.out_channels = op.out_channels
-                    clone.reads_input = op.reads_input
-                    slim.append(clone)
+                slim = _slim_schedule(schedule)
                 fn_bytes = serialization.dumps(_actor_exec_loop)
                 worker = state.proc_worker
                 t = threading.Thread(
