@@ -246,7 +246,6 @@ def cmd_start(args) -> int:
         print("only `start --head` is supported; worker nodes join with "
               "`ray_tpu worker --address=...`", file=sys.stderr)
         return 2
-    import json as _json
     import os as _os
     import signal
     import threading
@@ -258,7 +257,7 @@ def cmd_start(args) -> int:
     if args.session_dir:
         sysconf = {"kv_persist": True, "session_dir": args.session_dir}
     runtime = ray_tpu.init(num_cpus=args.num_cpus,
-                           resources=_json.loads(args.resources)
+                           resources=json.loads(args.resources)
                            if args.resources else None,
                            _system_config=sysconf)
     node_addr = runtime.start_node_server(port=args.port)
@@ -267,7 +266,7 @@ def cmd_start(args) -> int:
         _os.makedirs(args.session_dir, exist_ok=True)
         with open(_os.path.join(args.session_dir, "head_address.json"),
                   "w") as f:
-            _json.dump({"node_address": node_addr,
+            json.dump({"node_address": node_addr,
                         "client_address": client.address,
                         "pid": _os.getpid()}, f)
     print(f"HEAD node-address={node_addr} "

@@ -86,6 +86,11 @@ class Config:
     #: on first disconnect).  Ref: python/ray/_private/node.py:1407 raylets
     #: tolerating GCS downtime.
     node_reconnect_grace_s: float = 120.0
+    #: Bound on a worker node's dispatch-handler threads (task/actor frames
+    #: from the head each occupy one handler until their result exports; a
+    #: raw thread-per-frame let 10k queued actor calls mean 10k threads —
+    #: ref: src/ray/raylet/worker_pool.h:216 bounded worker pools).
+    node_dispatch_max_threads: int = 256
     #: Head declares a node dead after this long without a frame
     #: (ref: gcs_health_check_manager.h:45 health-check timeout).
     node_heartbeat_timeout_s: float = 30.0

@@ -353,6 +353,24 @@ class WorkerNode:
         self._req_lock = threading.Lock()
         self._req_counter = 0
         self._pending_reqs: Dict[int, list] = {}
+        #: Result/actor-state frames whose send failed mid-disconnect: a
+        #: SAME-session rejoin re-delivers them (the head suppressed its
+        #: loss recovery for us, so nothing else would complete the tasks).
+        self._undelivered: list = []
+        self._undelivered_lock = threading.Lock()
+
+        # Bounded dispatch handlers (ref: worker_pool.h:216): each inbound
+        # task/actor frame occupies one pool slot until its result exports;
+        # idle threads are reused, and the cap stops a deep actor-call queue
+        # from growing one OS thread per call.
+        from ray_tpu._private.runtime import _LeanExecPool
+
+        self._dispatch_pool = _LeanExecPool(
+            max_threads=GLOBAL_CONFIG.node_dispatch_max_threads,
+            name="node_dispatch")
+        #: Cap on detached slow-result waiter threads (see
+        #: _report_or_handoff); past it, handlers wait in-slot.
+        self._waiter_slots = threading.BoundedSemaphore(2048)
 
         self.conn, self.head_node_id, _ = self._connect_and_register()
 
@@ -365,7 +383,9 @@ class WorkerNode:
         """Dial the head and register; returns (conn, head session id)."""
         host, _, port_s = self.address.rpartition(":")
         sock = socket.create_connection((host, int(port_s)), timeout=30)
-        sock.settimeout(None)
+        # Keep the timeout through the ack: a head whose listener is up but
+        # whose runtime is stalled must not wedge the rejoin loop forever.
+        sock.settimeout(30)
         conn = _FramedConn(sock)
         local = self.runtime.scheduler.get_node(self.runtime.head_node_id)
         conn.send(("register", {
@@ -381,6 +401,7 @@ class WorkerNode:
         msg = conn.recv()
         if msg[0] != "registered":
             raise ConnectionError(f"head rejected registration: {msg[0]!r}")
+        sock.settimeout(None)  # registered: back to blocking serve mode
         fresh = bool(msg[2]) if len(msg) > 2 else True
         return conn, msg[1], fresh
 
@@ -479,11 +500,31 @@ class WorkerNode:
                 # resources forever).
                 self._reset_local_state()
                 self.head_node_id = head_id
+                with self._undelivered_lock:
+                    self._undelivered.clear()  # new session: stale results
             self.conn = conn
+            # Same-session rejoin: re-deliver completions whose send failed
+            # during the gap — the head suppressed loss recovery for us, so
+            # nothing else will finish those tasks.
+            with self._undelivered_lock:
+                backlog, self._undelivered = self._undelivered, []
+            for frame in backlog:
+                self._send_to_head(frame)
             print(f"[node {self.node_id}] rejoined head {head_id} "
                   f"at {self.address} (fresh={fresh})", flush=True)
             return True
         return False
+
+    def _send_to_head(self, frame: tuple) -> None:
+        """Send a result-bearing frame; on failure queue it for re-delivery
+        after a same-session rejoin (losing a task_done frame to a blip
+        would hang its driver forever — the head's superseded-loss handling
+        deliberately does NOT fail in-flight work of a rejoining node)."""
+        try:
+            self.conn.send(frame)
+        except (OSError, ConnectionError):
+            with self._undelivered_lock:
+                self._undelivered.append(frame)
 
     def _reset_local_state(self) -> None:
         """Kill everything the previous head session placed on this node."""
@@ -505,6 +546,10 @@ class WorkerNode:
             return
         self._stop.set()
         self.conn.close()
+        try:
+            self._dispatch_pool.shutdown()
+        except Exception:
+            pass
         from ray_tpu._private.runtime import shutdown_runtime
 
         shutdown_runtime()
@@ -526,19 +571,15 @@ class WorkerNode:
         if kind == "task":
             spec = serialization.loads(frame[1])
             spec.strategy = None  # head already placed it on this node
-            threading.Thread(target=self._run_dispatched, args=(spec,),
-                             name="ray_tpu_node_task", daemon=True).start()
+            self._dispatch_pool.submit(self._run_dispatched, spec)
         elif kind == "actor_create":
             spec = serialization.loads(frame[1])
             spec.strategy = None
-            threading.Thread(target=self._create_actor, args=(spec,),
-                             name="ray_tpu_node_actor", daemon=True).start()
+            self._dispatch_pool.submit(self._create_actor, spec)
         elif kind == "actor_task":
             actor_id = ActorID(frame[1])
             spec = serialization.loads(frame[2])
-            threading.Thread(target=self._run_actor_task,
-                             args=(actor_id, spec),
-                             name="ray_tpu_node_atask", daemon=True).start()
+            self._dispatch_pool.submit(self._run_actor_task, actor_id, spec)
         elif kind == "kill_actor":
             self.runtime.kill_actor(ActorID(frame[1]), no_restart=frame[2])
         elif kind == "cancel":
@@ -561,26 +602,88 @@ class WorkerNode:
             raise ValueError(f"unknown dispatch frame: {kind!r}")
 
     # ------------------------------------------------------------- dispatch
+    #
+    # Two-phase handling keeps the bounded pool deadlock-free: the pool slot
+    # does the SUBMISSION (fast) and exports results that land within a
+    # short grace; anything still running hands off to a detached waiter
+    # thread and frees the slot.  Without the handoff, 256 handlers blocked
+    # on nested same-node calls would starve the very frames they wait on;
+    # with it, only genuinely long-running work costs a thread, and the
+    # short-task storm path (the thread-per-frame blow-up) stays pooled.
+    _FAST_EXPORT_GRACE_S = 0.25
+
     def _run_dispatched(self, spec) -> None:
         try:
             if spec.generator:
                 gen = self.runtime.submit_task(spec)
-                self._stream_generator(spec, gen)
+                # Streams are long-lived by nature: never hold a pool slot.
+                threading.Thread(
+                    target=self._stream_generator, args=(spec, gen),
+                    name="node_dispatch_stream", daemon=True).start()
                 return
             refs = self.runtime.submit_task(spec)
-            self._report_completion(spec, refs)
+            self._report_or_handoff(spec, refs)
         except BaseException as e:  # noqa: BLE001 — submission itself failed
             self._send_done(spec, [("error", serialization.dumps(e))
                                    for _ in range(max(spec.num_returns, 1))])
+
+    def _results_ready_within(self, spec, budget: float) -> bool:
+        store = self.runtime.store
+        deadline = time.monotonic() + budget
+        for i in range(max(spec.num_returns, 1)):
+            oid = ObjectID.for_task_return(spec.task_id, i)
+            left = deadline - time.monotonic()
+            if left <= 0 or not store.wait_ready(oid, left):
+                return False
+        return True
+
+    def _guarded_report(self, spec, refs) -> None:
+        try:
+            self._report_completion(spec, refs)
+        except BaseException as e:  # noqa: BLE001
+            self._send_done(spec, [("error", serialization.dumps(e))
+                                   for _ in range(max(spec.num_returns, 1))])
+
+    def _report_or_handoff(self, spec, refs) -> None:
+        if self._results_ready_within(spec, self._FAST_EXPORT_GRACE_S):
+            self._report_completion(spec, refs)
+            return
+        if self._waiter_slots.acquire(blocking=False):
+            def run():
+                try:
+                    self._guarded_report(spec, refs)
+                finally:
+                    self._waiter_slots.release()
+
+            threading.Thread(target=run, name="node_dispatch_wait",
+                             daemon=True).start()
+        else:
+            # Waiter tier saturated too: wait in-slot (the pre-pool
+            # behavior) rather than grow threads without bound.
+            self._guarded_report(spec, refs)
 
     def _create_actor(self, spec) -> None:
         try:
             self.runtime.create_actor(spec)
             state = self.runtime.get_actor_state(spec.actor_id)
+        except BaseException as e:  # noqa: BLE001
+            try:
+                self._send_to_head(("actor_dead", str(spec.actor_id),
+                                    serialization.dumps(e)))
+            except Exception:
+                pass  # even serializing the cause failed
+            return
+        # The ready-wait can take the full creation timeout: never hold a
+        # pool slot for it (creations are rare; the storm path is tasks).
+        threading.Thread(target=self._await_actor_ready, args=(spec, state),
+                         name="node_actor_ready", daemon=True).start()
+
+    def _await_actor_ready(self, spec, state) -> None:
+        try:
             ready = state.ready_event.wait(
                 timeout=GLOBAL_CONFIG.actor_create_timeout_s)
             if state.state == "ALIVE":
-                self.conn.send(("actor_ready", str(spec.actor_id)))
+                self._send_to_head(("actor_ready", str(spec.actor_id)))
             else:
                 if not ready:
                     # Timed out while __init__ still runs: kill locally so
@@ -592,23 +695,25 @@ class WorkerNode:
                     "creation failed" if ready else
                     f"creation timed out after "
                     f"{GLOBAL_CONFIG.actor_create_timeout_s}s")
-                self.conn.send(("actor_dead", str(spec.actor_id),
-                                serialization.dumps(cause)))
+                self._send_to_head(("actor_dead", str(spec.actor_id),
+                                    serialization.dumps(cause)))
         except BaseException as e:  # noqa: BLE001
             try:
-                self.conn.send(("actor_dead", str(spec.actor_id),
-                                serialization.dumps(e)))
-            except (OSError, ConnectionError):
+                self._send_to_head(("actor_dead", str(spec.actor_id),
+                                    serialization.dumps(e)))
+            except Exception:
                 pass
 
     def _run_actor_task(self, actor_id: ActorID, spec) -> None:
         try:
             if spec.generator:
                 gen = self.runtime.submit_actor_task(actor_id, spec)
-                self._stream_generator(spec, gen)
+                threading.Thread(
+                    target=self._stream_generator, args=(spec, gen),
+                    name="node_dispatch_stream", daemon=True).start()
                 return
             refs = self.runtime.submit_actor_task(actor_id, spec)
-            self._report_completion(spec, refs)
+            self._report_or_handoff(spec, refs)
         except BaseException as e:  # noqa: BLE001
             self._send_done(spec, [("error", serialization.dumps(e))
                                    for _ in range(max(spec.num_returns, 1))])
@@ -652,17 +757,15 @@ class WorkerNode:
                     item = self._export_result(ref.id)
                 except BaseException as e:  # noqa: BLE001
                     item = ("error", serialization.dumps(e))
-                self.conn.send(("task_yield", str(spec.task_id), index, item))
+                self._send_to_head(("task_yield", str(spec.task_id), index,
+                                    item))
                 index += 1
             self._send_done(spec, [])
         except BaseException as e:  # noqa: BLE001 — generator body raised
             self._send_done(spec, [("error", serialization.dumps(e))])
 
     def _send_done(self, spec, results: List[tuple]) -> None:
-        try:
-            self.conn.send(("task_done", str(spec.task_id), results))
-        except (OSError, ConnectionError):
-            pass  # head gone; it will reschedule via node-loss handling
+        self._send_to_head(("task_done", str(spec.task_id), results))
 
     # ----------------------------------------------------- head control path
     def head_request(self, kind: str, *payload) -> Any:

@@ -395,17 +395,22 @@ class ObjectTransferServer:
             conn.sendall(bytes([ST_CLOSED]))
             return
         with self._chan_lock:
-            if seq < self._chan_next.get(name, 0):
-                # Duplicate of an already-accepted element (the ack was lost
-                # to a reset and the producer retried): acknowledge, never
-                # re-seal — the reader may have consumed it already.
-                conn.sendall(bytes([ST_OK]))
-                return
-            floor = self._chan_floors.get(name, 0)
-            while floor < seq and not arena.contains(f"{name}:{floor}"):
-                floor += 1
-            self._chan_floors[name] = floor
-            admissible = seq - floor < max(1, maxsize)
+            # Duplicate of an already-accepted element (the ack was lost to
+            # a reset and the producer retried): acknowledge, never re-seal
+            # — the reader may have consumed it already.
+            duplicate = seq < self._chan_next.get(name, 0)
+            admissible = False
+            if not duplicate:
+                floor = self._chan_floors.get(name, 0)
+                while floor < seq and not arena.contains(f"{name}:{floor}"):
+                    floor += 1
+                self._chan_floors[name] = floor
+                admissible = seq - floor < max(1, maxsize)
+        # All socket I/O happens OUTSIDE the lock (a stalled peer must not
+        # head-of-line block every other channel through this node).
+        if duplicate:
+            conn.sendall(bytes([ST_OK]))
+            return
         if not admissible:
             conn.sendall(bytes([ST_FULL]))
             return

@@ -737,6 +737,10 @@ class Runtime:
             superseded = self._remote_nodes.get(node_id) is not node
             if not superseded:
                 self._remote_nodes.pop(node_id, None)
+                # Inside the lock: a rejoin that re-registers between the
+                # pop and this removal would have its fresh scheduler entry
+                # deleted out from under it (register takes this lock too).
+                self.scheduler.remove_node(node_id)
         if superseded:
             # The node already RE-REGISTERED over a fresh connection (rejoin
             # races this loss handler): the process is alive, its dispatched
@@ -744,7 +748,6 @@ class Runtime:
             # removing it from the registry/scheduler or restarting its
             # actors here would silently wreck a live, rejoined node.
             return
-        self.scheduler.remove_node(node_id)
 
         with self._remote_lock:
             lost = [(tid, e) for tid, e in self._remote_inflight.items()

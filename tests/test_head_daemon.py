@@ -35,17 +35,34 @@ def _child_env() -> dict:
 
 
 def _spawn(args, wait_line: str, timeout: float = 90.0) -> subprocess.Popen:
+    import queue
+    import threading
+
     proc = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu"] + args, env=_child_env(), cwd=REPO,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    # Pump thread + queue: a silent-but-alive child trips THIS timeout
+    # (with captured output) instead of wedging the test in readline(),
+    # and buffered multi-line reads can't be missed (the select-on-fd
+    # approach loses lines Python already buffered).
+    lines: "queue.Queue" = queue.Queue()
+
+    def pump():
+        for line in proc.stdout:
+            lines.put(line)
+        lines.put(None)
+
+    threading.Thread(target=pump, daemon=True).start()
     deadline = time.time() + timeout
     seen = []
     while time.time() < deadline:
-        if proc.poll() is not None:
-            out = proc.stdout.read()
+        try:
+            line = lines.get(timeout=max(0.1, deadline - time.time()))
+        except queue.Empty:
+            break
+        if line is None:
             raise RuntimeError(
-                f"child exited rc={proc.returncode}:\n{''.join(seen)}{out}")
-        line = proc.stdout.readline()
+                f"child exited rc={proc.wait()}:\n{''.join(seen)}")
         seen.append(line)
         if wait_line in line:
             return proc

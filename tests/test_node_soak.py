@@ -1,0 +1,79 @@
+"""Node dispatch-path soak (VERDICT r3 weak #3): thousands of dispatched
+tasks and deep actor-call queues across real worker nodes must not grow
+one OS thread per frame — dispatch handlers come from a bounded pool
+(node_manager.py _dispatch_pool; ref: src/ray/raylet/worker_pool.h:216)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def _nthreads(pid: int) -> int:
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("Threads:"):
+                return int(line.split()[1])
+    return -1
+
+
+@pytest.fixture(scope="module")
+def soak_cluster():
+    ray_tpu.shutdown()
+    c = Cluster(initialize_head=True, real=True,
+                head_node_args={"num_cpus": 1})
+    a = c.add_node(num_cpus=4, resources={"sa": 10_000.0})
+    b = c.add_node(num_cpus=4, resources={"sb": 10_000.0})
+    yield c
+    c.shutdown()
+
+
+def test_task_soak_across_nodes_bounded_threads(soak_cluster):
+    c = soak_cluster
+    pids = [p.pid for p in c._procs.values()]
+
+    def bump(i):
+        return i + 1
+
+    n = 5000
+    refs = []
+    for i in range(n):
+        res = {"sa": 1.0} if i % 2 == 0 else {"sb": 1.0}
+        refs.append(ray_tpu.remote(bump).options(resources=res).remote(i))
+    peak = 0
+    done = []
+    chunk = 500
+    for k in range(0, n, chunk):
+        done.extend(ray_tpu.get(refs[k:k + chunk], timeout=300))
+        peak = max(peak, *(_nthreads(p) for p in pids))
+    assert done == [i + 1 for i in range(n)]
+    # Bounded: the dispatch pool cap (256) + runtime machinery, never
+    # thread-per-frame (which would exceed 1000 here).
+    assert peak < 600, f"node thread count blew up: {peak}"
+
+
+def test_actor_call_queue_soak_bounded_threads(soak_cluster):
+    c = soak_cluster
+    pids = [p.pid for p in c._procs.values()]
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.v = 0
+
+        def incr(self):
+            self.v += 1
+            return self.v
+
+    a = Counter.options(resources={"sa": 1.0}).remote()
+    n = 2000
+    refs = [a.incr.remote() for _ in range(n)]
+    time.sleep(0.2)  # let the queue pile up before sampling
+    mid = max(_nthreads(p) for p in pids)
+    vals = ray_tpu.get(refs, timeout=300)
+    assert vals[-1] == n and sorted(vals) == list(range(1, n + 1))
+    assert mid < 600, f"actor-call queue grew threads per call: {mid}"
+    ray_tpu.kill(a)
