@@ -3,6 +3,7 @@ from ray_tpu.rl.algorithms.bc import BC, BCConfig, BCLearner
 from ray_tpu.rl.algorithms.cql import CQL, CQLConfig, CQLLearner
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, DQNLearner
 from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig, IMPALALearner
+from ray_tpu.rl.algorithms.marwil import MARWIL, MARWILConfig, MARWILLearner
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig, PPOLearner
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig, SACLearner
 
@@ -10,4 +11,5 @@ __all__ = ["APPO", "APPOConfig", "APPOLearner",
            "PPO", "PPOConfig", "PPOLearner", "DQN", "DQNConfig", "DQNLearner",
            "IMPALA", "IMPALAConfig", "IMPALALearner",
            "SAC", "SACConfig", "SACLearner", "BC", "BCConfig", "BCLearner",
-           "CQL", "CQLConfig", "CQLLearner"]
+           "CQL", "CQLConfig", "CQLLearner",
+           "MARWIL", "MARWILConfig", "MARWILLearner"]

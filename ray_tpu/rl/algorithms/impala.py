@@ -40,6 +40,11 @@ class IMPALAConfig(AlgorithmConfig):
         self.minibatch_size = None
         self.broadcast_interval = 1  # weight sync every N updates
         self.max_requests_in_flight_per_env_runner = 2
+        #: Aggregation actors between runners and learner (ref:
+        #: impala.py:135-197 AggregatorActor): fragments are stitched into
+        #: train batches OFF the learner loop, and weight broadcasts go
+        #: async — the driver only routes refs.  0 = aggregate inline.
+        self.num_aggregator_actors = 0
 
 
 def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
@@ -107,73 +112,115 @@ class IMPALALearner(JaxLearner):
                        "entropy": entropy}
 
 
+def build_vtrace_batch(episodes, T: int, gamma: float) -> Dict[str, np.ndarray]:
+    """Chunk fragments into (B, T) rows for the vmapped V-trace.
+
+    Fragments longer than T are SPLIT into multiple rows (never discarded);
+    short rows are zero-padded and masked out of the loss.  Module-level so
+    aggregation actors run it off the learner loop (ref: impala.py:135-197
+    AggregatorActor)."""
+    cols: Dict[str, List] = {k: [] for k in
+                             (Columns.OBS, Columns.ACTIONS, Columns.REWARDS,
+                              Columns.ACTION_LOGP, "discounts", "mask",
+                              "bootstrap_obs", "bootstrap_terminated")}
+    for ep in episodes:
+        arr = ep.to_numpy()
+        t = len(ep)
+        for start in range(0, t, T):
+            end = min(start + T, t)
+            n = end - start
+            pad = T - n
+
+            def padded(x, value=0.0):
+                x = x[start:end]
+                if pad:
+                    x = np.concatenate([x, np.full((pad, *x.shape[1:]),
+                                                   value, x.dtype)])
+                return x
+
+            cols[Columns.OBS].append(padded(arr["obs"][:-1]))
+            cols[Columns.ACTIONS].append(padded(arr["actions"]))
+            cols[Columns.REWARDS].append(padded(arr["rewards"]))
+            cols[Columns.ACTION_LOGP].append(padded(arr[Columns.ACTION_LOGP]))
+            terminal_chunk = ep.is_terminated and end == t
+            disc = np.full(n, gamma, np.float32)
+            if terminal_chunk:
+                disc[-1] = 0.0
+            if pad:
+                disc = np.concatenate([disc, np.zeros(pad, np.float32)])
+            cols["discounts"].append(disc)
+            mask = np.concatenate([np.ones(n, np.float32),
+                                   np.zeros(pad, np.float32)])
+            cols["mask"].append(mask)
+            cols["bootstrap_obs"].append(arr["obs"][end])
+            cols["bootstrap_terminated"].append(
+                1.0 if terminal_chunk else 0.0)
+    return {k: np.stack(v).astype(np.float32) if k != Columns.ACTIONS
+            else np.stack(v)
+            for k, v in cols.items()}
+
+
+class BatchAggregator:
+    """Aggregation actor: buffers episode fragments, emits a train batch
+    once enough steps accumulated (ref: impala.py:135-197 AggregatorActor +
+    aggregator_actor.py — the tier that keeps episode stitching off the
+    learner loop)."""
+
+    def __init__(self, T: int, gamma: float, train_batch_size: int):
+        self._T = T
+        self._gamma = gamma
+        self._target = train_batch_size
+        self._buf: List[Any] = []
+        self._steps = 0
+
+    def add(self, episodes) -> Any:
+        """Returns a ready (B, T) batch dict, or None while accumulating."""
+        live = [ep for ep in episodes if len(ep) > 0]
+        self._buf.extend(live)
+        self._steps += sum(len(ep) for ep in live)
+        if self._steps < self._target:
+            return None
+        episodes, self._buf, self._steps = self._buf, [], 0
+        return build_vtrace_batch(episodes, self._T, self._gamma)
+
+
 class IMPALA(Algorithm):
     learner_class = IMPALALearner
     config_class = IMPALAConfig
 
     def setup(self, config) -> None:
         super().setup(config)
+        cfg = self.algo_config
         self._inflight: Dict[Any, Any] = {}  # ref -> runner
         self._updates = 0
+        self._aggregators: List[Any] = []
+        self._agg_rr = 0
+        self._pending_batches: List[Any] = []
+        if cfg.num_aggregator_actors and self.env_runner_group.runners:
+            agg_cls = ray_tpu.remote(BatchAggregator)
+            self._aggregators = [
+                agg_cls.remote(cfg.rollout_fragment_length, cfg.gamma,
+                               cfg.train_batch_size)
+                for _ in range(cfg.num_aggregator_actors)]
 
     def _batch_from_episodes(self, episodes) -> Dict[str, np.ndarray]:
-        """Chunk fragments into (B, T) rows for the vmapped V-trace.
-
-        Fragments longer than T are SPLIT into multiple rows (never
-        discarded); short rows are zero-padded and masked out of the loss.
-        """
         cfg = self.algo_config
-        T = cfg.rollout_fragment_length
-        cols: Dict[str, List] = {k: [] for k in
-                                 (Columns.OBS, Columns.ACTIONS, Columns.REWARDS,
-                                  Columns.ACTION_LOGP, "discounts", "mask",
-                                  "bootstrap_obs", "bootstrap_terminated")}
-        for ep in episodes:
-            arr = ep.to_numpy()
-            t = len(ep)
-            for start in range(0, t, T):
-                end = min(start + T, t)
-                n = end - start
-                pad = T - n
+        return build_vtrace_batch(episodes, cfg.rollout_fragment_length,
+                                  cfg.gamma)
 
-                def padded(x, value=0.0):
-                    x = x[start:end]
-                    if pad:
-                        x = np.concatenate([x, np.full((pad, *x.shape[1:]),
-                                                       value, x.dtype)])
-                    return x
+    def cleanup(self) -> None:
+        for agg in self._aggregators:
+            try:
+                ray_tpu.kill(agg)
+            except Exception:
+                pass
+        self._aggregators = []
+        super().cleanup()
 
-                cols[Columns.OBS].append(padded(arr["obs"][:-1]))
-                cols[Columns.ACTIONS].append(padded(arr["actions"]))
-                cols[Columns.REWARDS].append(padded(arr["rewards"]))
-                cols[Columns.ACTION_LOGP].append(padded(arr[Columns.ACTION_LOGP]))
-                terminal_chunk = ep.is_terminated and end == t
-                disc = np.full(n, cfg.gamma, np.float32)
-                if terminal_chunk:
-                    disc[-1] = 0.0
-                if pad:
-                    disc = np.concatenate([disc, np.zeros(pad, np.float32)])
-                cols["discounts"].append(disc)
-                mask = np.concatenate([np.ones(n, np.float32),
-                                       np.zeros(pad, np.float32)])
-                cols["mask"].append(mask)
-                cols["bootstrap_obs"].append(arr["obs"][end])
-                cols["bootstrap_terminated"].append(
-                    1.0 if terminal_chunk else 0.0)
-        batch = {k: np.stack(v).astype(np.float32) if k != Columns.ACTIONS
-                 else np.stack(v)
-                 for k, v in cols.items()}
-        return batch
-
-    def training_step(self) -> Dict[str, Any]:
+    def _saturate_runners(self) -> None:
+        """Keep every runner loaded with in-flight sample requests."""
         cfg = self.algo_config
         runners = self.env_runner_group.runners
-        if not runners:
-            # Synchronous fallback (num_env_runners=0): plain on-policy step.
-            episodes = self._sample_batch()
-            return {"learners": self._learn(episodes)}
-
-        # Keep every runner saturated with in-flight sample requests.
         per = max(cfg.rollout_fragment_length,
                   cfg.train_batch_size // len(runners))
         for r in runners:
@@ -181,6 +228,16 @@ class IMPALA(Algorithm):
             while inflight_for_r < cfg.max_requests_in_flight_per_env_runner:
                 self._inflight[r.sample.remote(num_timesteps=per)] = r
                 inflight_for_r += 1
+
+    def training_step(self) -> Dict[str, Any]:
+        runners = self.env_runner_group.runners
+        if not runners:
+            # Synchronous fallback (num_env_runners=0): plain on-policy step.
+            episodes = self._sample_batch()
+            return {"learners": self._learn(episodes)}
+        self._saturate_runners()
+        if self._aggregators:
+            return self._aggregated_step()
 
         ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
                                 timeout=30.0)
@@ -192,12 +249,48 @@ class IMPALA(Algorithm):
         return {"learners": self._learn(episodes),
                 "num_inflight_requests": len(self._inflight)}
 
+    def _aggregated_step(self) -> Dict[str, Any]:
+        """Aggregator pipeline: the driver only ROUTES refs — finished
+        sample refs go to aggregation actors (round-robin), ready batches
+        go to the learner, weight broadcasts are fire-and-forget (ref:
+        impala.py:135-197 — sampling, aggregation and learning overlap)."""
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                timeout=30.0)
+        for ref in ready:
+            self._inflight.pop(ref, None)
+            agg = self._aggregators[self._agg_rr % len(self._aggregators)]
+            self._agg_rr += 1
+            # The episode payload flows runner -> aggregator; the driver
+            # never materializes it.
+            self._pending_batches.append(agg.add.remote(ref))
+        self._saturate_runners()  # samplers never idle while we learn
+
+        results: Dict[str, Any] = {}
+        n_learned = 0
+        if self._pending_batches:
+            done, self._pending_batches = ray_tpu.wait(
+                self._pending_batches,
+                num_returns=len(self._pending_batches), timeout=0.02)
+            for bref in done:
+                batch = ray_tpu.get(bref)
+                if batch is None:
+                    continue  # aggregator still accumulating
+                self._lifetime_steps += int(batch["mask"].sum())
+                results = self._learn_from_batch(batch)
+                n_learned += 1
+        return {"learners": results,
+                "num_inflight_requests": len(self._inflight),
+                "num_pending_agg_batches": len(self._pending_batches),
+                "num_batches_learned": n_learned}
+
     def _learn(self, episodes) -> Dict[str, Any]:
-        cfg = self.algo_config
         episodes = [ep for ep in episodes if len(ep) > 0]
         if not episodes:
             return {}
-        batch = self._batch_from_episodes(episodes)
+        return self._learn_from_batch(self._batch_from_episodes(episodes))
+
+    def _learn_from_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        cfg = self.algo_config
         # Bootstrap values from current params (host-side, jitted).
         if self.learner_group._local is not None:
             learner = self.learner_group._local
@@ -218,7 +311,12 @@ class IMPALA(Algorithm):
         self._after_learn(results)
         self._updates += 1
         if self._updates % cfg.broadcast_interval == 0:
-            self.env_runner_group.sync_weights(self.learner_group.get_weights())
+            # Fire-and-forget under the aggregator pipeline: actor mailbox
+            # order guarantees a runner applies the weights before its next
+            # sample call; blocking would stall the learner loop.
+            self.env_runner_group.sync_weights(
+                self.learner_group.get_weights(),
+                block=not self._aggregators)
         return results
 
     def _augment_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:

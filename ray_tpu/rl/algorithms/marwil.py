@@ -1,0 +1,101 @@
+"""MARWIL — Monotonic Advantage Re-Weighted Imitation Learning.
+
+(ref: rllib/algorithms/marwil/marwil.py MARWILConfig/MARWIL; loss in
+rllib/algorithms/marwil/torch/marwil_torch_learner.py — behavior cloning
+re-weighted by exp(beta * advantage), advantage = return-to-go - V(s),
+normalized by a running second moment; Wang et al. 2018.)
+
+Shares the offline substrate with BC (OfflineData over flat transition
+rows); the returns-to-go column is derived once at setup from the
+dataset's reward/terminated columns (row order is episode order — see
+offline.record_episodes).  ``beta=0`` recovers plain BC plus a value
+baseline, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl.algorithms.bc import BC, BCConfig
+from ray_tpu.rl.core.learner import JaxLearner
+from ray_tpu.rl.core.rl_module import Columns
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or MARWIL)
+        #: Advantage re-weighting temperature (0 = BC + value baseline).
+        self.beta = 1.0
+        self.vf_coeff = 1.0
+        #: Exponent clip keeping exp(beta * adv_norm) finite early in
+        #: training (the reference bounds via its moving-average norm).
+        self.max_advantage_exponent = 10.0
+
+
+class MARWILLearner(JaxLearner):
+    def compute_loss(self, params, batch: Dict[str, Any], key) -> Tuple[Any, Dict]:
+        cfg = self.config
+        out = self.module.forward_train(params, batch[Columns.OBS])
+        dist = self.module.action_dist
+        inputs = out[Columns.ACTION_DIST_INPUTS]
+        logp = dist.logp(inputs, batch[Columns.ACTIONS])
+        values = out[Columns.VF_PREDS]
+        returns = batch["returns"]
+        adv = returns - values
+        # Batch second-moment normalizer (the reference keeps a moving
+        # average; a per-batch one is the stationary-offline equivalent).
+        norm = jnp.sqrt(jnp.mean(jnp.square(jax.lax.stop_gradient(adv))) + 1e-8)
+        exponent = jnp.clip(cfg.beta * jax.lax.stop_gradient(adv) / norm,
+                            -cfg.max_advantage_exponent,
+                            cfg.max_advantage_exponent)
+        weights = jnp.exp(exponent)
+        policy_loss = -jnp.mean(weights * logp)
+        vf_loss = jnp.mean(jnp.square(adv))
+        entropy = jnp.mean(dist.entropy(inputs))
+        total = policy_loss + cfg.vf_coeff * vf_loss
+        coeff = getattr(cfg, "entropy_coeff", 0.0)
+        if coeff:
+            total = total - coeff * entropy
+        return total, {"policy_loss": policy_loss, "vf_loss": vf_loss,
+                       "mean_advantage": jnp.mean(adv),
+                       "bc_logp": jnp.mean(logp), "entropy": entropy}
+
+
+def returns_to_go(rewards: np.ndarray, boundaries: np.ndarray,
+                  gamma: float) -> np.ndarray:
+    """Discounted return from each step to its episode's end, computed over
+    flat transition rows in episode order.  ``boundaries`` marks the LAST
+    step of each episode (terminated OR truncated — returns must not bleed
+    across a time-limit cut); the dataset tail counts as a boundary."""
+    out = np.zeros(len(rewards), np.float32)
+    acc = 0.0
+    for i in range(len(rewards) - 1, -1, -1):
+        if boundaries[i]:
+            acc = 0.0
+        acc = float(rewards[i]) + gamma * acc
+        out[i] = acc
+    return out
+
+
+class MARWIL(BC):
+    learner_class = MARWILLearner
+    config_class = MARWILConfig
+
+    def setup(self, config) -> None:
+        super().setup(config)
+        cols = self.offline.columns
+        if "returns" not in cols:
+            if Columns.REWARDS not in cols:
+                raise ValueError(
+                    "MARWIL needs a 'returns' or 'rewards' column in the "
+                    "offline dataset")
+            n = self.offline.size
+            term = np.asarray(cols.get(Columns.TERMINATEDS, np.zeros(n)))
+            trunc = np.asarray(cols.get(Columns.TRUNCATEDS, np.zeros(n)))
+            cols["returns"] = returns_to_go(
+                np.asarray(cols[Columns.REWARDS], np.float32),
+                (term > 0) | (trunc > 0), self.algo_config.gamma)

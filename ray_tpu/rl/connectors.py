@@ -112,7 +112,7 @@ def strip_internal(batch: Dict[str, Any], episodes=None, **kw) -> Dict[str, Any]
 
 def episodes_to_transitions(episodes: List[SingleAgentEpisode]) -> Dict[str, np.ndarray]:
     """(obs, action, reward, next_obs, done) rows for replay buffers (DQN)."""
-    obs, actions, rewards, next_obs, dones = [], [], [], [], []
+    obs, actions, rewards, next_obs, dones, truncs = [], [], [], [], [], []
     for ep in episodes:
         arr = ep.to_numpy()
         T = len(ep)
@@ -124,10 +124,18 @@ def episodes_to_transitions(episodes: List[SingleAgentEpisode]) -> Dict[str, np.
         if ep.is_terminated:
             d[-1] = 1.0
         dones.append(d)
+        # Truncation marks an episode BOUNDARY without a terminal state —
+        # offline consumers (MARWIL returns-to-go) must not let value
+        # bootstraps/returns bleed across it.
+        t = np.zeros(T, np.float32)
+        if ep.is_truncated:
+            t[-1] = 1.0
+        truncs.append(t)
     return {
         Columns.OBS: np.concatenate(obs).astype(np.float32),
         Columns.ACTIONS: np.concatenate(actions),
         Columns.REWARDS: np.concatenate(rewards).astype(np.float32),
         Columns.NEXT_OBS: np.concatenate(next_obs).astype(np.float32),
         Columns.TERMINATEDS: np.concatenate(dones),
+        Columns.TRUNCATEDS: np.concatenate(truncs),
     }

