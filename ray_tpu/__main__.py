@@ -25,10 +25,9 @@ def _init(args):
     return ray_tpu
 
 
-def cmd_status(args) -> int:
-    rt = _init(args)
-    total = rt.cluster_resources()
-    avail = rt.available_resources()
+def _print_cluster_snapshot(snap: dict) -> None:
+    total = snap["cluster_resources"]
+    avail = snap["available_resources"]
     print("======== Cluster status ========")
     print("Resources")
     print("---------------------------------------------------------------")
@@ -36,6 +35,38 @@ def cmd_status(args) -> int:
     for name in sorted(total):
         used = total[name] - avail.get(name, 0.0)
         print(f" {used:g}/{total[name]:g} {name}")
+    per_node = snap.get("per_node") or []
+    print(f"Nodes ({len(per_node)}):")
+    for row in per_node:
+        role = "head  " if row.get("is_head") else "worker"
+        extras = []
+        if row.get("num_actors") is not None:
+            extras.append(f"actors={row['num_actors']}")
+        if row.get("store_bytes_used") is not None:
+            extras.append(f"store={row['store_bytes_used']}B")
+        if row.get("heartbeat_age_s") is not None:
+            extras.append(f"hb={row['heartbeat_age_s']}s")
+        print(f" {role} {row['node_id']} alive={row.get('alive')} "
+              f"res={row.get('resources')} {' '.join(extras)}")
+
+
+def cmd_status(args) -> int:
+    if getattr(args, "dashboard", None):
+        # Query a LIVE cluster's aggregating head instead of starting a
+        # fresh runtime in this process (ref: `ray status` against GCS).
+        import json as _json
+        import urllib.request
+
+        url = args.dashboard.rstrip("/") + "/api/cluster"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            snap = _json.loads(resp.read())
+        _print_cluster_snapshot(snap)
+        return 0
+    _init(args)
+    from ray_tpu._private.metrics_agent import cluster_snapshot
+    from ray_tpu._private.runtime import get_runtime
+
+    _print_cluster_snapshot(cluster_snapshot(get_runtime()))
     return 0
 
 
@@ -262,15 +293,21 @@ def cmd_start(args) -> int:
                            _system_config=sysconf)
     node_addr = runtime.start_node_server(port=args.port)
     client = ClientServer(port=args.client_port)
+    from ray_tpu._private.metrics_agent import MetricsAgent
+
+    dash = MetricsAgent(runtime, port=args.dashboard_port,
+                        host=args.dashboard_host)
+    dash_url = f"http://{args.dashboard_host}:{dash.port}"
     if args.session_dir:
         _os.makedirs(args.session_dir, exist_ok=True)
         with open(_os.path.join(args.session_dir, "head_address.json"),
                   "w") as f:
             json.dump({"node_address": node_addr,
-                        "client_address": client.address,
-                        "pid": _os.getpid()}, f)
+                       "client_address": client.address,
+                       "dashboard_url": dash_url,
+                       "pid": _os.getpid()}, f)
     print(f"HEAD node-address={node_addr} "
-          f"client-address={client.address}", flush=True)
+          f"client-address={client.address} dashboard={dash_url}", flush=True)
     print("READY", flush=True)
 
     done = threading.Event()
@@ -280,6 +317,7 @@ def cmd_start(args) -> int:
         except ValueError:
             pass
     done.wait()
+    dash.stop()
     client.stop()
     ray_tpu.shutdown()
     return 0
@@ -324,7 +362,10 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    sub.add_parser("status", help="cluster resource usage")
+    stat = sub.add_parser("status", help="cluster resource usage")
+    stat.add_argument("--dashboard", default=None,
+                      help="query a live head's dashboard URL instead of "
+                           "starting a runtime here")
 
     lp = sub.add_parser("list", help="list entities (state API)")
     lp.add_argument("entity", choices=["tasks", "actors", "objects", "nodes",
@@ -388,6 +429,14 @@ def main(argv=None) -> int:
     st.add_argument("--session-dir", default=None,
                     help="persist control-plane state here (WAL KV); a "
                          "restarted head over the same dir restores it")
+    st.add_argument("--dashboard-port", type=int, default=0,
+                    help="HTTP port for the aggregating dashboard "
+                         "(/ = cluster view, /node/<id> = drilldown)")
+    st.add_argument("--dashboard-host", default="127.0.0.1",
+                    help="interface the dashboard binds AND advertises "
+                         "(loopback default = single-machine; use a "
+                         "cluster-reachable address for remote `status "
+                         "--dashboard` queries)")
 
     wk = sub.add_parser("worker", help="join a head as a worker node "
                                        "(ref: ray start --address)")

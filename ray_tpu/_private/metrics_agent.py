@@ -73,7 +73,7 @@ def sample_runtime(runtime) -> None:
 class MetricsAgent:
     """HTTP scrape endpoint (GET /metrics) over the process registry."""
 
-    def __init__(self, runtime, port: int = 0):
+    def __init__(self, runtime, port: int = 0, host: str = "127.0.0.1"):
         self._runtime = runtime
 
         agent = self
@@ -111,6 +111,14 @@ class MetricsAgent:
                         self._send(_status_page(agent._runtime).encode(),
                                    "text/html; charset=utf-8")
                         return
+                    if path.startswith("/node/"):
+                        body = _node_page(agent._runtime,
+                                          path[len("/node/"):])
+                        if body is None:
+                            self.send_error(404)
+                            return
+                        self._send(body.encode(), "text/html; charset=utf-8")
+                        return
                     self.send_error(404)
                 except Exception as e:  # a scrape must never kill the server
                     self.send_error(500, str(e))
@@ -118,7 +126,8 @@ class MetricsAgent:
             def log_message(self, *a):  # quiet
                 pass
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="ray_tpu_metrics_agent",
@@ -130,19 +139,148 @@ class MetricsAgent:
         self._server.server_close()
 
 
+def _log_tails(limit_files: int = 3, tail_bytes: int = 1200) -> dict:
+    """Last bytes of the newest session log files (the drilldown's log
+    view; ref: dashboard log endpoints + _private/log_monitor.py)."""
+    import os
+
+    try:
+        from ray_tpu._private.log_monitor import log_dir
+
+        d = log_dir()
+        files = sorted(
+            (os.path.join(d, f) for f in os.listdir(d) if f.endswith(".log")),
+            key=os.path.getmtime, reverse=True)[:limit_files]
+    except Exception:
+        return {}
+    tails = {}
+    for path in files:
+        try:
+            with open(path, "rb") as f:
+                f.seek(max(0, os.path.getsize(path) - tail_bytes))
+                tails[os.path.basename(path)] = f.read().decode(
+                    errors="replace")
+        except OSError:
+            continue
+    return tails
+
+
+def runtime_summary(runtime) -> dict:
+    """The cheap per-runtime row (no log I/O, no object listing) — what the
+    cluster table needs on its 5-second refresh hot path."""
+    import os
+
+    used, cap = runtime.store.usage()
+    return {
+        "pid": os.getpid(),
+        "store_bytes_used": used,
+        "store_capacity_bytes": cap,
+        "actors": runtime.list_actor_states(),
+        "num_running_tasks": len(runtime._running),
+        "num_inflight_tasks": len(runtime._inflight),
+    }
+
+
+def runtime_snapshot(runtime) -> dict:
+    """One runtime's FULL live state — served by worker nodes over info_req
+    and by the head for its drilldown page (the per-node agent report the
+    aggregation tier collects; ref: dashboard/head.py:65 + reporter
+    agent)."""
+    import threading as _threading
+
+    snap = runtime_summary(runtime)
+    snap.update({
+        "num_objects": len(runtime.store.object_summaries()),
+        "num_threads": _threading.active_count(),
+        "log_tail": _log_tails(),
+    })
+    return snap
+
+
+def cluster_snapshot(runtime, with_details: bool = True) -> dict:
+    """Aggregate the whole cluster: the head's scheduler/ledger view joined
+    with each node's own agent report (ref: dashboard/head.py:65 — the
+    aggregating head the per-runtime REST tier lacked)."""
+    import threading as _threading
+    import time as _time
+
+    head_id = str(runtime.head_node_id)
+    remote = {str(n.node_id): n for n in runtime._remote_nodes_snapshot()}
+    details: dict = {}
+    if with_details and runtime.node_server is not None and remote:
+        def fetch(nid, rn):
+            try:
+                details[nid] = runtime.node_server.node_info(rn)
+            except Exception as e:  # noqa: BLE001
+                details[nid] = {"error": repr(e)}
+
+        threads = [_threading.Thread(target=fetch, args=item, daemon=True)
+                   for item in remote.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+    per_node = []
+    for n in runtime.scheduler.nodes():
+        nid = str(n.id)
+        is_head = nid == head_id
+        rn = remote.get(nid)
+        detail = (runtime_summary(runtime) if is_head and with_details
+                  else details.get(nid))
+        row = {
+            "node_id": nid,
+            "is_head": is_head,
+            "alive": n.alive,
+            "resources": dict(n.total),
+            "available": dict(n.available),
+            "heartbeat_age_s": round(_time.monotonic() - rn.last_heartbeat, 1)
+            if rn else None,
+        }
+        if detail:
+            row.update({
+                "pid": detail.get("pid"),
+                "store_bytes_used": detail.get("store_bytes_used"),
+                "num_actors": len(detail.get("actors") or []),
+                "num_running_tasks": detail.get("num_running_tasks"),
+            })
+        per_node.append(row)
+    return {
+        "cluster_resources": runtime.scheduler.cluster_resources(),
+        "available_resources": runtime.scheduler.available_resources(),
+        "head_node_id": head_id,
+        "per_node": per_node,
+    }
+
+
+def node_detail(runtime, node_id: str):
+    """Full drilldown for one node (""/head id = the head runtime)."""
+    if node_id in ("", str(runtime.head_node_id)):
+        snap = runtime_snapshot(runtime)
+        snap["node_id"] = str(runtime.head_node_id)
+        return snap
+    for rn in runtime._remote_nodes_snapshot():
+        if str(rn.node_id) == node_id:
+            if runtime.node_server is None:
+                return None
+            return runtime.node_server.node_info(rn)
+    return None
+
+
 def _api_payload(runtime, path: str):
     """REST views over the state API (ref: dashboard state_head.py:47 — the
     same rows `ray list ...` prints, as JSON over HTTP)."""
     from ray_tpu.util import state as state_api
 
     if path in ("/api", "/api/cluster"):
-        return {
-            "cluster_resources": runtime.scheduler.cluster_resources(),
-            "available_resources": runtime.scheduler.available_resources(),
-            "nodes": len(runtime.scheduler.nodes()),
+        payload = cluster_snapshot(runtime)
+        payload.update({
+            "nodes": len(payload["per_node"]),
             "tasks": state_api.summarize_tasks(),
             "actors": state_api.summarize_actors(),
-        }
+        })
+        return payload
+    if path.startswith("/api/node/"):
+        return node_detail(runtime, path[len("/api/node/"):])
     listings = {
         "/api/tasks": state_api.list_tasks,
         "/api/actors": state_api.list_actors,
@@ -195,19 +333,79 @@ def _status_page(runtime) -> str:
             for r in rows[:100])
         return f"<table border=1 cellpadding=4><tr>{head}</tr>{body}</table>"
 
-    nodes = state_api.list_nodes()
+    snap = cluster_snapshot(runtime)
     actors = state_api.list_actors()
     tasks = state_api.list_tasks()[-50:]
-    res = esc(runtime.scheduler.cluster_resources())
-    avail = esc(runtime.scheduler.available_resources())
+    res = esc(snap["cluster_resources"])
+    avail = esc(snap["available_resources"])
+    node_rows = []
+    for row in snap["per_node"]:
+        nid = esc(row["node_id"])
+        node_rows.append(
+            f"<tr><td><a href=\"/node/{nid}\">{nid}</a></td>"
+            f"<td>{'head' if row['is_head'] else 'worker'}</td>"
+            f"<td>{esc(row['alive'])}</td>"
+            f"<td>{esc(row['resources'])}</td>"
+            f"<td>{esc(row['available'])}</td>"
+            f"<td>{esc(row.get('num_actors', ''))}</td>"
+            f"<td>{esc(row.get('store_bytes_used', ''))}</td>"
+            f"<td>{esc(row.get('heartbeat_age_s', ''))}</td></tr>")
+    nodes_table = (
+        "<table border=1 cellpadding=4><tr><th>node</th><th>role</th>"
+        "<th>alive</th><th>resources</th><th>available</th><th>actors</th>"
+        "<th>store bytes</th><th>hb age s</th></tr>"
+        + "".join(node_rows) + "</table>")
     return f"""<!doctype html><html><head><title>ray_tpu status</title>
 <meta http-equiv="refresh" content="5"></head><body>
 <h2>ray_tpu cluster</h2>
 <p>resources: {res} &nbsp; available: {avail}</p>
-<h3>nodes ({len(nodes)})</h3>{table(nodes, ["node_id", "alive", "resources"])}
+<h3>nodes ({len(snap['per_node'])})</h3>{nodes_table}
 <h3>actors ({len(actors)})</h3>
 {table(actors, ["actor_id", "class_name", "state", "name", "num_restarts"])}
 <h3>recent tasks</h3>
 {table(tasks, ["task_id", "name", "state", "attempt"])}
 <p><a href="/metrics">/metrics</a> &middot; <a href="/api/cluster">/api/cluster</a></p>
+</body></html>"""
+
+
+def _node_page(runtime, node_id: str):
+    """Per-node drilldown: the node's own agent report rendered as HTML
+    (ref: dashboard per-node view — modules/node/node_head.py)."""
+    import html as _html
+
+    try:
+        detail = node_detail(runtime, node_id)
+    except Exception as e:  # noqa: BLE001 — render the failure, not a 500
+        detail = {"node_id": node_id, "error": repr(e)}
+    if detail is None:
+        return None
+
+    def esc(v) -> str:
+        return _html.escape(str(v))
+
+    actors = detail.get("actors") or []
+    actor_rows = "".join(
+        "<tr>" + "".join(
+            f"<td>{esc(a.get(c, ''))}</td>"
+            for c in ("actor_id", "class_name", "state", "name"))
+        + "</tr>" for a in actors) or "<tr><td colspan=4><i>none</i></td></tr>"
+    logs = "".join(
+        f"<h4>{esc(name)}</h4><pre>{esc(tail)}</pre>"
+        for name, tail in (detail.get("log_tail") or {}).items())
+    return f"""<!doctype html><html><head>
+<title>node {esc(node_id)}</title></head><body>
+<p><a href="/">&larr; cluster</a></p>
+<h2>node {esc(detail.get('node_id', node_id))}</h2>
+<p>pid: {esc(detail.get('pid', '?'))} &nbsp;
+store: {esc(detail.get('store_bytes_used', '?'))} /
+{esc(detail.get('store_capacity_bytes', '?'))} bytes &nbsp;
+objects: {esc(detail.get('num_objects', '?'))} &nbsp;
+running tasks: {esc(detail.get('num_running_tasks', '?'))} &nbsp;
+threads: {esc(detail.get('num_threads', '?'))}</p>
+{f"<p><b>error:</b> {esc(detail['error'])}</p>" if detail.get('error') else ''}
+<h3>actors ({len(actors)})</h3>
+<table border=1 cellpadding=4>
+<tr><th>actor_id</th><th>class</th><th>state</th><th>name</th></tr>
+{actor_rows}</table>
+<h3>log tails</h3>{logs or '<p><i>none</i></p>'}
 </body></html>"""

@@ -114,6 +114,10 @@ class RemoteNode:
         self.last_heartbeat = time.monotonic()
         #: Per-node nested-API state (streaming-submission gen tokens).
         self.gen_state: dict = {"gens": {}}
+        #: In-flight head->node info requests (dashboard drilldown).
+        self.pending_info: Dict[int, list] = {}
+        self.info_counter = 0
+        self.info_lock = threading.Lock()
 
 
 class NodeManagerServer:
@@ -211,8 +215,33 @@ class NodeManagerServer:
                 target=self._serve_request,
                 args=(node, msg_id, rkind, payload),
                 name="ray_tpu_node_req", daemon=True).start()
+        elif kind == "info_reply":
+            _, msg_id, blob = frame
+            with node.info_lock:
+                slot = node.pending_info.get(msg_id)
+            if slot is not None:
+                slot[1] = serialization.loads(blob)
+                slot[0].set()
         else:
             raise ValueError(f"unknown node frame: {kind!r}")
+
+    def node_info(self, node: RemoteNode, timeout: float = 3.0) -> dict:
+        """Ask a node for its live state snapshot (the dashboard
+        aggregation/drilldown path — ref: dashboard/head.py:65 collecting
+        per-node agent reports)."""
+        with node.info_lock:
+            node.info_counter += 1
+            msg_id = node.info_counter
+            slot = [threading.Event(), None]
+            node.pending_info[msg_id] = slot
+        try:
+            node.conn.send(("info_req", msg_id))
+            if not slot[0].wait(timeout):
+                raise TimeoutError(f"node {node.node_id} info timed out")
+            return slot[1]
+        finally:
+            with node.info_lock:
+                node.pending_info.pop(msg_id, None)
 
     def _serve_request(self, node: RemoteNode, msg_id: int, kind: str,
                        payload: tuple) -> None:
@@ -595,11 +624,30 @@ class WorkerNode:
             if slot is not None:
                 slot[1] = (ok, blob)
                 slot[0].set()
+        elif kind == "info_req":
+            msg_id = frame[1]
+            # Off the reader thread: the snapshot touches runtime locks.
+            threading.Thread(target=self._answer_info, args=(msg_id,),
+                             name="ray_tpu_node_info", daemon=True).start()
         elif kind == "shutdown":
             self._stop.set()
             self.conn.close()
         else:
             raise ValueError(f"unknown dispatch frame: {kind!r}")
+
+    def _answer_info(self, msg_id: int) -> None:
+        from ray_tpu._private.metrics_agent import runtime_snapshot
+
+        try:
+            snap = runtime_snapshot(self.runtime)
+            snap["node_id"] = str(self.node_id)
+        except Exception as e:  # noqa: BLE001
+            snap = {"node_id": str(self.node_id), "error": repr(e)}
+        try:
+            self.conn.send(("info_reply", msg_id,
+                            serialization.dumps_inband(snap)))
+        except (OSError, ConnectionError):
+            pass  # head gone; it timed out anyway
 
     # ------------------------------------------------------------- dispatch
     #

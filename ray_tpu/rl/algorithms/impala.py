@@ -256,8 +256,14 @@ class IMPALA(Algorithm):
         impala.py:135-197 — sampling, aggregation and learning overlap)."""
         ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
                                 timeout=30.0)
-        for ref in ready:
-            self._inflight.pop(ref, None)
+        # Drain EVERY completed ref, not just the first: undrained refs
+        # count toward the in-flight cap, so leaving them parked while a
+        # learner update runs stalls the runners the cap governs.
+        more, _ = ray_tpu.wait(list(self._inflight),
+                               num_returns=len(self._inflight), timeout=0)
+        for ref in dict.fromkeys(list(ready) + list(more)):
+            if self._inflight.pop(ref, None) is None:
+                continue
             agg = self._aggregators[self._agg_rr % len(self._aggregators)]
             self._agg_rr += 1
             # The episode payload flows runner -> aggregator; the driver
@@ -265,8 +271,7 @@ class IMPALA(Algorithm):
             self._pending_batches.append(agg.add.remote(ref))
         self._saturate_runners()  # samplers never idle while we learn
 
-        results: Dict[str, Any] = {}
-        n_learned = 0
+        per_batch: List[Dict[str, Any]] = []
         if self._pending_batches:
             done, self._pending_batches = ray_tpu.wait(
                 self._pending_batches,
@@ -276,12 +281,26 @@ class IMPALA(Algorithm):
                 if batch is None:
                     continue  # aggregator still accumulating
                 self._lifetime_steps += int(batch["mask"].sum())
-                results = self._learn_from_batch(batch)
-                n_learned += 1
+                per_batch.append(self._learn_from_batch(batch))
+        if len(per_batch) > 1:
+            # Mean over this step's updates — returning only the last batch
+            # would bias reported losses toward a subsample.
+            results = {}
+            for k in set().union(*per_batch):
+                vals = []
+                for r in per_batch:
+                    try:
+                        vals.append(float(r[k]))
+                    except (KeyError, TypeError, ValueError):
+                        pass
+                if vals:
+                    results[k] = float(np.mean(vals))
+        else:
+            results = per_batch[0] if per_batch else {}
         return {"learners": results,
                 "num_inflight_requests": len(self._inflight),
                 "num_pending_agg_batches": len(self._pending_batches),
-                "num_batches_learned": n_learned}
+                "num_batches_learned": len(per_batch)}
 
     def _learn(self, episodes) -> Dict[str, Any]:
         episodes = [ep for ep in episodes if len(ep) > 0]

@@ -93,9 +93,27 @@ class MARWIL(BC):
                 raise ValueError(
                     "MARWIL needs a 'returns' or 'rewards' column in the "
                     "offline dataset")
+            if Columns.TERMINATEDS not in cols:
+                # Without boundary flags returns-to-go would treat the
+                # whole dataset as ONE episode — silently wrong advantages.
+                raise ValueError(
+                    "MARWIL needs episode boundaries to derive returns: "
+                    "provide a 'returns' column, or record the dataset "
+                    "with terminateds/truncateds (offline.record_episodes "
+                    "emits both)")
             n = self.offline.size
-            term = np.asarray(cols.get(Columns.TERMINATEDS, np.zeros(n)))
-            trunc = np.asarray(cols.get(Columns.TRUNCATEDS, np.zeros(n)))
+            term = np.asarray(cols[Columns.TERMINATEDS])
+            if Columns.TRUNCATEDS in cols:
+                trunc = np.asarray(cols[Columns.TRUNCATEDS])
+            else:
+                import warnings
+
+                warnings.warn(
+                    "offline dataset has no truncateds column (recorded "
+                    "before truncation tracking): returns-to-go will bleed "
+                    "across time-limit episode cuts", RuntimeWarning,
+                    stacklevel=2)
+                trunc = np.zeros(n)
             cols["returns"] = returns_to_go(
                 np.asarray(cols[Columns.REWARDS], np.float32),
                 (term > 0) | (trunc > 0), self.algo_config.gamma)

@@ -94,10 +94,18 @@ class EnvRunnerGroup:
         prev = getattr(self, "_pending_sync", None)
         self._pending_sync = refs
         if prev:
-            try:
-                ray_tpu.get(prev, timeout=10)
-            except Exception:
-                pass
+            self._settle_sync(prev)
+
+    def _settle_sync(self, refs) -> None:
+        import sys
+
+        try:
+            ray_tpu.get(refs, timeout=10)
+        except Exception as e:  # noqa: BLE001
+            # A runner that can't apply weights samples with STALE params
+            # forever — say so instead of silently eating it.
+            print(f"[env_runner_group] weight broadcast failed: {e!r}",
+                  file=sys.stderr, flush=True)
 
     def foreach_env_runner(self, fn_name: str, *args, **kwargs) -> List[Any]:
         if self._local_runner is not None:
@@ -115,6 +123,10 @@ class EnvRunnerGroup:
         return self._remote_runners
 
     def stop(self) -> None:
+        pending = getattr(self, "_pending_sync", None)
+        if pending:
+            self._pending_sync = None
+            self._settle_sync(pending)  # last broadcast must not leak refs
         if self._local_runner is not None:
             self._local_runner.stop()
         for r in self._remote_runners:
