@@ -57,9 +57,16 @@ def cmd_status(args) -> int:
         import json as _json
         import urllib.request
 
-        url = args.dashboard.rstrip("/") + "/api/cluster"
-        with urllib.request.urlopen(url, timeout=10) as resp:
-            snap = _json.loads(resp.read())
+        base = args.dashboard.rstrip("/")
+        if "://" not in base:
+            base = "http://" + base  # accept bare host:port
+        try:
+            with urllib.request.urlopen(base + "/api/cluster",
+                                        timeout=10) as resp:
+                snap = _json.loads(resp.read())
+        except (OSError, ValueError) as e:
+            print(f"cannot reach dashboard at {base}: {e}", file=sys.stderr)
+            return 1
         _print_cluster_snapshot(snap)
         return 0
     _init(args)

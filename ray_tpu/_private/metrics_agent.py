@@ -165,6 +165,15 @@ def _log_tails(limit_files: int = 3, tail_bytes: int = 1200) -> dict:
     return tails
 
 
+def _local_actor_states(runtime) -> list:
+    """Actors HOSTED BY this runtime: the head's ledger also tracks actors
+    it forwarded to worker nodes — counting those on the head's own row
+    would double-count them against the hosting node's report."""
+    local_id = str(runtime.head_node_id)
+    return [a for a in runtime.list_actor_states()
+            if a.get("node_id") in ("", local_id)]
+
+
 def runtime_summary(runtime) -> dict:
     """The cheap per-runtime row (no log I/O, no object listing) — what the
     cluster table needs on its 5-second refresh hot path."""
@@ -175,7 +184,7 @@ def runtime_summary(runtime) -> dict:
         "pid": os.getpid(),
         "store_bytes_used": used,
         "store_capacity_bytes": cap,
-        "actors": runtime.list_actor_states(),
+        "actors": _local_actor_states(runtime),
         "num_running_tasks": len(runtime._running),
         "num_inflight_tasks": len(runtime._inflight),
     }
@@ -210,7 +219,8 @@ def cluster_snapshot(runtime, with_details: bool = True) -> dict:
     if with_details and runtime.node_server is not None and remote:
         def fetch(nid, rn):
             try:
-                details[nid] = runtime.node_server.node_info(rn)
+                details[nid] = runtime.node_server.node_info(
+                    rn, detail="summary")
             except Exception as e:  # noqa: BLE001
                 details[nid] = {"error": repr(e)}
 

@@ -225,17 +225,19 @@ class NodeManagerServer:
         else:
             raise ValueError(f"unknown node frame: {kind!r}")
 
-    def node_info(self, node: RemoteNode, timeout: float = 3.0) -> dict:
+    def node_info(self, node: RemoteNode, timeout: float = 3.0,
+                  detail: str = "full") -> dict:
         """Ask a node for its live state snapshot (the dashboard
         aggregation/drilldown path — ref: dashboard/head.py:65 collecting
-        per-node agent reports)."""
+        per-node agent reports).  ``detail="summary"`` skips log tails and
+        object listings (the cluster table's refresh path)."""
         with node.info_lock:
             node.info_counter += 1
             msg_id = node.info_counter
             slot = [threading.Event(), None]
             node.pending_info[msg_id] = slot
         try:
-            node.conn.send(("info_req", msg_id))
+            node.conn.send(("info_req", msg_id, detail))
             if not slot[0].wait(timeout):
                 raise TimeoutError(f"node {node.node_id} info timed out")
             return slot[1]
@@ -626,8 +628,9 @@ class WorkerNode:
                 slot[0].set()
         elif kind == "info_req":
             msg_id = frame[1]
+            detail = frame[2] if len(frame) > 2 else "full"
             # Off the reader thread: the snapshot touches runtime locks.
-            threading.Thread(target=self._answer_info, args=(msg_id,),
+            threading.Thread(target=self._answer_info, args=(msg_id, detail),
                              name="ray_tpu_node_info", daemon=True).start()
         elif kind == "shutdown":
             self._stop.set()
@@ -635,11 +638,17 @@ class WorkerNode:
         else:
             raise ValueError(f"unknown dispatch frame: {kind!r}")
 
-    def _answer_info(self, msg_id: int) -> None:
-        from ray_tpu._private.metrics_agent import runtime_snapshot
+    def _answer_info(self, msg_id: int, detail: str = "full") -> None:
+        from ray_tpu._private.metrics_agent import (
+            runtime_snapshot,
+            runtime_summary,
+        )
 
         try:
-            snap = runtime_snapshot(self.runtime)
+            # "summary" keeps the cluster table's 5s refresh off log-file
+            # I/O and object listings; only the drilldown pays for "full".
+            build = runtime_summary if detail == "summary" else runtime_snapshot
+            snap = build(self.runtime)
             snap["node_id"] = str(self.node_id)
         except Exception as e:  # noqa: BLE001
             snap = {"node_id": str(self.node_id), "error": repr(e)}

@@ -1,0 +1,214 @@
+"""GCE TPU node provider over a mockable API client.
+
+(ref: python/ray/autoscaler/_private/gcp/node_provider.py GCPNodeProvider —
+create/terminate/list against the googleapiclient `tpu.projects.locations.
+nodes` surface; _private/gcp/config.py resource naming.)
+
+Offline twist: ``MockGCETPUAPI`` implements the same verbs, and its
+"instances" are REAL ``ray_tpu worker`` OS processes joining the head over
+the node server — so `ray_tpu up` with this provider exercises the whole
+autoscaler -> provider -> cloud-API -> node-join path on one box.  Against
+real GCP you swap the api object for one backed by googleapiclient; the
+provider logic (naming, topology labels, slice packing, registration
+waits) is identical.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+class MockGCETPUAPI:
+    """The `projects.locations.nodes` verb surface, instances backed by
+    real worker-node processes on this host."""
+
+    def __init__(self, project: str = "mock-project",
+                 zone: str = "us-central2-b"):
+        self.project = project
+        self.zone = zone
+        self._instances: Dict[str, dict] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def _qualified(self, name: str) -> str:
+        return (f"projects/{self.project}/locations/{self.zone}"
+                f"/nodes/{name}")
+
+    def create_node(self, name: str, accelerator_type: str, head_address: str,
+                    num_cpus: float, resources: Dict[str, float],
+                    labels: Dict[str, str], node_id: str) -> dict:
+        """POST nodes.create — spawns the 'TPU VM' (a worker process)."""
+        import json
+
+        from ray_tpu.cluster_utils import worker_node_env
+
+        cmd = [sys.executable, "-m", "ray_tpu", "worker",
+               "--address", head_address,
+               "--num-cpus", str(num_cpus),
+               "--resources", json.dumps(resources),
+               "--node-id", node_id]
+        if labels:
+            cmd += ["--labels"] + [f"{k}={v}" for k, v in labels.items()]
+        proc = subprocess.Popen(cmd, env=worker_node_env(),
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        record = {
+            "name": self._qualified(name),
+            "state": "CREATING",
+            "acceleratorType": accelerator_type,
+            "labels": dict(labels),
+            "networkEndpoints": [{"ipAddress": "127.0.0.1"}],
+            "metadata": {"node_id": node_id, "pid": proc.pid},
+        }
+        with self._lock:
+            self._instances[name] = record
+            self._procs[name] = proc
+        return record
+
+    def get_node(self, name: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._instances.get(name)
+            if rec is None:
+                return None
+            proc = self._procs.get(name)
+            if rec["state"] in ("CREATING", "READY"):
+                rec["state"] = ("READY" if proc is not None
+                                and proc.poll() is None else "TERMINATED")
+            return dict(rec)
+
+    def delete_node(self, name: str) -> None:
+        """DELETE nodes.delete — kills the instance process."""
+        with self._lock:
+            rec = self._instances.pop(name, None)
+            proc = self._procs.pop(name, None)
+        if rec is None:
+            return
+        if proc is not None:
+            proc.kill()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def list_nodes(self) -> List[dict]:
+        with self._lock:
+            names = list(self._instances)
+        return [rec for rec in (self.get_node(n) for n in names)
+                if rec is not None]
+
+
+class GCETPUNodeProvider(NodeProvider):
+    """Slice-aware GCE TPU provider: each created node is a TPU host VM
+    with chips + topology labels; every ``hosts_per_slice`` hosts share an
+    ici-slice label and the first host carries the pod-head resource (ref:
+    gcp/node_provider.py + _private/accelerators/tpu.py:356)."""
+
+    def __init__(self, project: str = "mock-project",
+                 zone: str = "us-central2-b", accelerator: str = "v5e",
+                 chips_per_host: int = 4, hosts_per_slice: int = 4,
+                 api: Optional[MockGCETPUAPI] = None,
+                 registration_timeout_s: float = 90.0):
+        self.accelerator = accelerator
+        self.chips_per_host = chips_per_host
+        self.hosts_per_slice = hosts_per_slice
+        self.registration_timeout_s = registration_timeout_s
+        self._api = api or MockGCETPUAPI(project=project, zone=zone)
+        self._node_ids: Dict[str, object] = {}  # instance -> scheduler id
+        self._lock = threading.Lock()
+        self._slice_counter = 0
+        self._in_slice = 0
+
+    # ------------------------------------------------------------- helpers
+    @property
+    def api(self) -> MockGCETPUAPI:
+        return self._api
+
+    def _head_address(self) -> str:
+        from ray_tpu._private.runtime import get_runtime
+
+        return get_runtime().start_node_server()
+
+    def _slice_assignment(self):
+        with self._lock:
+            if self._in_slice >= self.hosts_per_slice:
+                self._slice_counter += 1
+                self._in_slice = 0
+            first = self._in_slice == 0
+            name = f"{self.accelerator}-slice-{self._slice_counter}"
+            self._in_slice += 1
+        return name, first
+
+    # ----------------------------------------------------------- interface
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    labels: Dict[str, str]) -> str:
+        from ray_tpu._private.ids import NodeID
+        from ray_tpu._private.runtime import get_runtime
+
+        slice_name, first_in_slice = self._slice_assignment()
+        pod_chips = self.chips_per_host * self.hosts_per_slice
+        res = {k: float(v) for k, v in resources.items() if k != "CPU"}
+        res["TPU"] = float(self.chips_per_host)
+        if first_in_slice:
+            # Pod-head resource: one per slice, the scheduling anchor for
+            # "give me the whole slice" (ref: tpu.py:356-358).
+            res[f"TPU-{self.accelerator}-{pod_chips}-head"] = 1.0
+        node_labels = {
+            **labels,
+            "node-type": node_type,
+            "ici-slice": slice_name,
+            "accelerator-type": f"tpu-{self.accelerator}",
+        }
+        name = f"ray-{node_type}-{uuid.uuid4().hex[:8]}"
+        node_id = NodeID.from_random()
+        self._api.create_node(
+            name, f"{self.accelerator}-{pod_chips}", self._head_address(),
+            num_cpus=float(resources.get("CPU", 1.0)), resources=res,
+            labels=node_labels, node_id=str(node_id))
+        # The cloud API returns an operation; "done" here = the VM's worker
+        # registered with the head (ref: GCPNodeProvider polling operations
+        # + waiting for ray start on the VM).
+        runtime = get_runtime()
+        deadline = time.monotonic() + self.registration_timeout_s
+        while time.monotonic() < deadline:
+            node = runtime.scheduler.get_node(node_id)
+            if node is not None and node.alive:
+                break
+            rec = self._api.get_node(name)
+            if rec is None or rec["state"] == "TERMINATED":
+                raise RuntimeError(
+                    f"GCE TPU instance {name} died before registering")
+            time.sleep(0.1)
+        else:
+            self._api.delete_node(name)
+            raise TimeoutError(
+                f"GCE TPU instance {name} did not register within "
+                f"{self.registration_timeout_s}s")
+        with self._lock:
+            self._node_ids[name] = node_id
+        return name
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        self._api.delete_node(provider_node_id)
+        with self._lock:
+            self._node_ids.pop(provider_node_id, None)
+        # The head's node-death handling reclaims the scheduler entry when
+        # the connection drops — same path as a real VM disappearing.
+
+    def non_terminated_nodes(self) -> List[str]:
+        out = []
+        for rec in self._api.list_nodes():
+            if rec["state"] in ("CREATING", "READY"):
+                out.append(rec["name"].rsplit("/", 1)[1])
+        return out
+
+    def scheduler_node_id(self, provider_node_id: str):
+        with self._lock:
+            return self._node_ids.get(provider_node_id)

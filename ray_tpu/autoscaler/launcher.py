@@ -21,6 +21,7 @@ from typing import Any, Dict, List, Optional
 
 from ray_tpu.autoscaler.autoscaler import (Autoscaler, AutoscalerConfig,
                                            Monitor, NodeTypeConfig)
+from ray_tpu.autoscaler.gce import GCETPUNodeProvider
 from ray_tpu.autoscaler.node_provider import (FakeNodeProvider, NodeProvider,
                                               TPUPodProvider)
 
@@ -28,6 +29,9 @@ _BUILTIN_PROVIDERS = {
     "fake": FakeNodeProvider,
     "local": FakeNodeProvider,
     "tpu_pod": TPUPodProvider,
+    # Real worker-node processes behind a (mockable) GCE TPU API client
+    # (ref: autoscaler/_private/gcp/node_provider.py).
+    "gce_tpu": GCETPUNodeProvider,
 }
 
 

@@ -27,6 +27,40 @@ from ray_tpu._private.ids import NodeID
 from ray_tpu._private.runtime import get_runtime
 
 
+def worker_node_env() -> Dict[str, str]:
+    """Environment for a spawned worker-node process on THIS host.
+
+    Forces CPU jax (a second process grabbing the one TPU chip wedges
+    both), scrubs the driver host's accelerator-plugin env (node processes
+    simulate OTHER hosts; inherited PJRT plugin state silently degrades
+    their multi-process jax), and guarantees this ray_tpu checkout is
+    importable."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for key in list(env):
+        if key.startswith(("TPU_", "AXON_", "_AXON", "PALLAS_AXON")) \
+                or key == "PJRT_LIBRARY_PATH":
+            del env[key]
+    if "PYTHONPATH" in env:
+        # Only the plugin's sitecustomize dir is dropped (exact basename
+        # match — a bare substring test would eat unrelated user paths).
+        parts = [p for p in env["PYTHONPATH"].split(os.pathsep)
+                 if p and os.path.basename(p.rstrip("/")) != ".axon_site"]
+        if parts:
+            env["PYTHONPATH"] = os.pathsep.join(parts)
+        else:
+            del env["PYTHONPATH"]
+    # Node processes must import THIS ray_tpu even when the driver got it
+    # via sys.path (dev checkout driven from a scratch cwd).
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        ray_tpu.__file__)))
+    existing = env.get("PYTHONPATH", "")
+    if pkg_root not in existing.split(os.pathsep):
+        env["PYTHONPATH"] = (pkg_root + os.pathsep + existing).rstrip(
+            os.pathsep)
+    return env
+
+
 class Cluster:
     def __init__(self, initialize_head: bool = False,
                  head_node_args: Optional[dict] = None,
@@ -71,38 +105,7 @@ class Cluster:
                "--node-id", str(node_id)]
         if labels:
             cmd += ["--labels"] + [f"{k}={v}" for k, v in labels.items()]
-        env = dict(os.environ)
-        # Force CPU in node processes: this harness may run beside a live
-        # single-chip TPU runtime, and a second process grabbing the chip
-        # wedges both (one JAX client owns the chips — see runtime.py).
-        env["JAX_PLATFORMS"] = "cpu"
-        # Scrub the driver host's accelerator plumbing: node processes
-        # simulate OTHER hosts, and inherited PJRT-plugin env (plugin .so
-        # paths, TPU topology vars, sitecustomize dirs that re-register the
-        # plugin in every child) breaks their CPU jax — specifically
-        # multi-process jax.distributed in their worker processes comes up
-        # single-process when a stray TPU plugin registers first.
-        for key in list(env):
-            if key.startswith(("TPU_", "AXON_", "_AXON", "PALLAS_AXON")) \
-                    or key == "PJRT_LIBRARY_PATH":
-                del env[key]
-        if "PYTHONPATH" in env:
-            # Only the plugin's sitecustomize dir is dropped (exact basename
-            # match — a bare substring test would eat unrelated user paths).
-            parts = [p for p in env["PYTHONPATH"].split(os.pathsep)
-                     if p and os.path.basename(p.rstrip("/")) != ".axon_site"]
-            if parts:
-                env["PYTHONPATH"] = os.pathsep.join(parts)
-            else:
-                del env["PYTHONPATH"]
-        # Node processes must import THIS ray_tpu even when the driver got it
-        # via sys.path (dev checkout driven from a scratch cwd).
-        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
-            ray_tpu.__file__)))
-        existing = env.get("PYTHONPATH", "")
-        if pkg_root not in existing.split(os.pathsep):
-            env["PYTHONPATH"] = (pkg_root + os.pathsep + existing).rstrip(
-                os.pathsep)
+        env = worker_node_env()
         proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                                 stderr=subprocess.PIPE, text=True)
         self._procs[node_id] = proc

@@ -293,8 +293,16 @@ class IMPALA(Algorithm):
                         vals.append(float(r[k]))
                     except (KeyError, TypeError, ValueError):
                         pass
-                if vals:
+                if len(vals) == len(per_batch):
                     results[k] = float(np.mean(vals))
+                else:
+                    # Non-scalar metric (array/nested): pass the LAST value
+                    # through so the key's schema stays stable across steps
+                    # instead of vanishing whenever >1 batch completed.
+                    for r in reversed(per_batch):
+                        if k in r:
+                            results[k] = r[k]
+                            break
         else:
             results = per_batch[0] if per_batch else {}
         return {"learners": results,
