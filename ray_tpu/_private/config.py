@@ -91,6 +91,9 @@ class Config:
     #: raw thread-per-frame let 10k queued actor calls mean 10k threads —
     #: ref: src/ray/raylet/worker_pool.h:216 bounded worker pools).
     node_dispatch_max_threads: int = 256
+    #: Reduce-partition cap for data-exchange stages (shuffle/sort/groupby);
+    #: raise on wide clusters where 32-way reduce under-parallelizes.
+    data_max_partitions: int = 32
     #: Head declares a node dead after this long without a frame
     #: (ref: gcs_health_check_manager.h:45 health-check timeout).
     node_heartbeat_timeout_s: float = 30.0

@@ -15,9 +15,7 @@ waits) is identical.
 
 from __future__ import annotations
 
-import os
 import subprocess
-import sys
 import threading
 import time
 import uuid
@@ -46,20 +44,13 @@ class MockGCETPUAPI:
                     num_cpus: float, resources: Dict[str, float],
                     labels: Dict[str, str], node_id: str) -> dict:
         """POST nodes.create — spawns the 'TPU VM' (a worker process)."""
-        import json
+        from ray_tpu.cluster_utils import worker_node_cmd, worker_node_env
 
-        from ray_tpu.cluster_utils import worker_node_env
-
-        cmd = [sys.executable, "-m", "ray_tpu", "worker",
-               "--address", head_address,
-               "--num-cpus", str(num_cpus),
-               "--resources", json.dumps(resources),
-               "--node-id", node_id]
-        if labels:
-            cmd += ["--labels"] + [f"{k}={v}" for k, v in labels.items()]
-        proc = subprocess.Popen(cmd, env=worker_node_env(),
-                                stdout=subprocess.DEVNULL,
-                                stderr=subprocess.DEVNULL)
+        proc = subprocess.Popen(
+            worker_node_cmd(head_address, num_cpus, resources, labels,
+                            node_id),
+            env=worker_node_env(), stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
         record = {
             "name": self._qualified(name),
             "state": "CREATING",
@@ -143,8 +134,16 @@ class GCETPUNodeProvider(NodeProvider):
                 self._in_slice = 0
             first = self._in_slice == 0
             name = f"{self.accelerator}-slice-{self._slice_counter}"
+            index = self._in_slice
             self._in_slice += 1
-        return name, first
+        return name, first, self._slice_counter, index
+
+    def _slice_rollback(self, counter: int, index: int) -> None:
+        """A host FAILED to come up: return its slice slot, or the retry of
+        a slice's first host would never get the pod-head resource."""
+        with self._lock:
+            if self._slice_counter == counter and self._in_slice == index + 1:
+                self._in_slice = index
 
     # ----------------------------------------------------------- interface
     def create_node(self, node_type: str, resources: Dict[str, float],
@@ -152,7 +151,8 @@ class GCETPUNodeProvider(NodeProvider):
         from ray_tpu._private.ids import NodeID
         from ray_tpu._private.runtime import get_runtime
 
-        slice_name, first_in_slice = self._slice_assignment()
+        slice_name, first_in_slice, s_counter, s_index = \
+            self._slice_assignment()
         pod_chips = self.chips_per_host * self.hosts_per_slice
         res = {k: float(v) for k, v in resources.items() if k != "CPU"}
         res["TPU"] = float(self.chips_per_host)
@@ -183,11 +183,13 @@ class GCETPUNodeProvider(NodeProvider):
                 break
             rec = self._api.get_node(name)
             if rec is None or rec["state"] == "TERMINATED":
+                self._slice_rollback(s_counter, s_index)
                 raise RuntimeError(
                     f"GCE TPU instance {name} died before registering")
             time.sleep(0.1)
         else:
             self._api.delete_node(name)
+            self._slice_rollback(s_counter, s_index)
             raise TimeoutError(
                 f"GCE TPU instance {name} did not register within "
                 f"{self.registration_timeout_s}s")

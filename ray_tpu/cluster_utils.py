@@ -27,6 +27,26 @@ from ray_tpu._private.ids import NodeID
 from ray_tpu._private.runtime import get_runtime
 
 
+def worker_node_cmd(address: str, num_cpus: float,
+                    resources: Optional[Dict[str, float]] = None,
+                    labels: Optional[Dict[str, str]] = None,
+                    node_id: Optional[str] = None) -> list:
+    """Command line for a worker-node process joining ``address`` (shared
+    by the test harness and node providers, so a new worker flag cannot
+    silently drift between them)."""
+    import json
+
+    cmd = [sys.executable, "-m", "ray_tpu", "worker",
+           "--address", address,
+           "--num-cpus", str(num_cpus),
+           "--resources", json.dumps(resources or {})]
+    if node_id:
+        cmd += ["--node-id", str(node_id)]
+    if labels:
+        cmd += ["--labels"] + [f"{k}={v}" for k, v in labels.items()]
+    return cmd
+
+
 def worker_node_env() -> Dict[str, str]:
     """Environment for a spawned worker-node process on THIS host.
 
@@ -95,16 +115,10 @@ class Cluster:
         if not self.node_address:
             self.node_address = runtime.start_node_server()
         node_id = NodeID.from_random()
-        import json
-
-        cmd = [sys.executable, "-m", "ray_tpu", "worker",
-               "--address", self.node_address,
-               "--num-cpus", str(num_cpus),
-               "--resources", json.dumps(
-                   {k: v for k, v in node_resources.items() if k != "CPU"}),
-               "--node-id", str(node_id)]
-        if labels:
-            cmd += ["--labels"] + [f"{k}={v}" for k, v in labels.items()]
+        cmd = worker_node_cmd(
+            self.node_address, num_cpus,
+            {k: v for k, v in node_resources.items() if k != "CPU"},
+            labels, str(node_id))
         env = worker_node_env()
         proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                                 stderr=subprocess.PIPE, text=True)

@@ -49,8 +49,65 @@ def block_from_batch(batch: Batch) -> Block:
     raise TypeError(f"Cannot make a block from {type(batch)}")
 
 
-#: Field metadata key holding the per-row tensor shape for ndim>=3 columns.
+#: Field metadata key holding the per-row tensor shape for ndim>=3 columns
+#: (legacy encoding — data written before ArrowTensorType still reads).
 _SHAPE_META = b"ray_tpu.tensor_shape"
+
+
+class ArrowTensorType(pa.ExtensionType):
+    """Fixed-shape tensor column type: each row is an ndarray of ``shape``.
+
+    A REAL Arrow extension type (ref: python/ray/air/util/tensor_extensions/
+    arrow.py ArrowTensorType) — the shape rides in the type itself and
+    survives parquet/IPC/exchange without side-channel field metadata.
+    Storage: fixed-size-list of the flattened values."""
+
+    EXT_NAME = "ray_tpu.tensor"
+
+    def __init__(self, shape: Tuple[int, ...], value_type: pa.DataType):
+        self._shape = tuple(int(s) for s in shape)
+        size = 1
+        for s in self._shape:
+            size *= s
+        super().__init__(pa.list_(value_type, size), self.EXT_NAME)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._shape
+
+    @property
+    def value_type(self) -> pa.DataType:
+        return self.storage_type.value_type
+
+    def __arrow_ext_serialize__(self) -> bytes:
+        import json
+
+        return json.dumps(list(self._shape)).encode()
+
+    @classmethod
+    def __arrow_ext_deserialize__(cls, storage_type, serialized):
+        import json
+
+        return cls(tuple(json.loads(serialized.decode())),
+                   storage_type.value_type)
+
+    @classmethod
+    def from_numpy(cls, arr: np.ndarray) -> pa.ExtensionArray:
+        # Explicit row width: reshape(len, -1) is a ValueError on ZERO rows.
+        width = int(np.prod(arr.shape[1:], dtype=np.int64))
+        flat = np.ascontiguousarray(arr).reshape(len(arr), width)
+        storage = pa.FixedSizeListArray.from_arrays(
+            pa.array(flat.ravel()), width)
+        return pa.ExtensionArray.from_storage(
+            cls(arr.shape[1:], storage.type.value_type), storage)
+
+
+# Registration is process-global and idempotent per name; needed so parquet/
+# IPC readers reconstruct the extension type instead of its storage type.
+try:
+    pa.register_extension_type(ArrowTensorType((1,), pa.int64()))
+except pa.ArrowKeyError:
+    pass  # already registered (module reload)
 
 
 def _to_arrow_array(name: str, values) -> Tuple[pa.Array, pa.Field]:
@@ -58,12 +115,8 @@ def _to_arrow_array(name: str, values) -> Tuple[pa.Array, pa.Field]:
         return values, pa.field(name, values.type)
     arr = np.asarray(values)
     if arr.ndim > 1:
-        # Tensor columns: fixed-size-list arrays with the per-row shape in
-        # field metadata so ndim>=3 round-trips (ref: ArrowTensorArray).
-        flat = arr.reshape(len(arr), -1)
-        pa_arr = pa.FixedSizeListArray.from_arrays(pa.array(flat.ravel()), flat.shape[1])
-        meta = {_SHAPE_META: ",".join(map(str, arr.shape[1:])).encode()}
-        return pa_arr, pa.field(name, pa_arr.type, metadata=meta)
+        pa_arr = ArrowTensorType.from_numpy(arr)
+        return pa_arr, pa.field(name, pa_arr.type)
     pa_arr = pa.array(arr)
     return pa_arr, pa.field(name, pa_arr.type)
 
@@ -114,7 +167,13 @@ class BlockAccessor:
 
 def column_to_numpy(block: Block, name: str) -> np.ndarray:
     col = block.column(name)
+    if isinstance(col.type, ArrowTensorType):
+        combined = col.combine_chunks()
+        flat = combined.storage.values.to_numpy(zero_copy_only=False)
+        return flat.reshape((len(col),) + col.type.shape)
     if isinstance(col.type, pa.FixedSizeListType):
+        # Legacy tensor encoding (pre-ArrowTensorType): shape from field
+        # metadata; plain fixed-size-list columns unroll as (N, list_size).
         combined = col.combine_chunks()
         flat = combined.values.to_numpy(zero_copy_only=False)
         field = block.schema.field(name)

@@ -28,13 +28,18 @@ from ray_tpu.data import block as block_mod
 from ray_tpu.data.block import Block, BlockAccessor, block_from_rows, concat_blocks
 
 #: Cap on reduce partitions (P) — below it, P tracks the input block count.
+#: Default; the live value comes from the config flag so operators can
+#: raise it for wide clusters (RAY_TPU_DATA_MAX_PARTITIONS).
 MAX_PARTITIONS = 32
 #: Map/reduce tasks in flight (same backpressure role as executor.MAX_IN_FLIGHT).
 MAX_IN_FLIGHT = 8
 
 
 def _num_partitions(n_blocks: int) -> int:
-    return max(1, min(n_blocks, MAX_PARTITIONS))
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    cap = getattr(GLOBAL_CONFIG, "data_max_partitions", MAX_PARTITIONS)
+    return max(1, min(n_blocks, cap))
 
 
 # ----------------------------------------------------------------- map tasks

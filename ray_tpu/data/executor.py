@@ -433,7 +433,11 @@ def _all_to_all(stream: Iterator[Any], op: LogicalOp) -> Iterator[Any]:
         return
     if isinstance(op, MapGroups):
         if op.key is None:
-            # Single group: one reduce task over all blocks.
+            # Keyless map_groups = ONE group by definition, so the UDF must
+            # see the whole dataset in one task — exactly the reference's
+            # behavior (grouped_data.py:188 repartition(1) when key is
+            # None; its docstring warns each group must fit one node).
+            # Per-group distribution applies only with a key (hash path).
             yield exchange._reduce_map_groups.remote(op, *refs)
         else:
             yield from exchange.hash_exchange(refs, op, "map_groups")
@@ -465,6 +469,13 @@ def _aggregate(block: Block, op: Aggregate) -> Block:
             vals = block_mod.column_to_numpy(block, col)
             row[name] = _agg_fn(fn, spec)(vals)
         return block_from_rows([row])
+    if any(_normalize_agg(a)[1] in ("quantile", "unique") for a in op.aggs):
+        # Arrow's group_by has no exact kernel for these: sort by key and
+        # reduce each group slice with numpy (ref: the reference's
+        # sort-based per-group path — push_based_shuffle + SortAggregate).
+        # Exactness holds because the hash exchange lands ALL rows of a key
+        # in one partition before this runs.
+        return _aggregate_sorted(block, op)
     arrow_aggs = []
     renames: Dict[str, str] = {}
     for agg in op.aggs:
@@ -482,6 +493,53 @@ def _aggregate(block: Block, op: Aggregate) -> Block:
         tbl = tbl.rename_columns(
             [renames.get(c, c) for c in tbl.column_names])
     return tbl
+
+
+def _aggregate_sorted(block: Block, op: Aggregate) -> Block:
+    """Per-group aggregation by sort + boundary slicing: supports every
+    agg fn including the order-statistics ones arrow's group_by cannot
+    (quantile, unique)."""
+    tbl = block.sort_by(op.key)
+    keys = block_mod.column_to_numpy(tbl, op.key)
+    n = len(keys)
+    if n == 0:
+        return block_from_rows([])
+    changed = keys[1:] != keys[:-1]
+    if np.issubdtype(np.asarray(keys).dtype, np.floating):
+        # NaN != NaN would split the null group into one row per NaN;
+        # adjacent NaNs (sorted together) are ONE group, like arrow's.
+        both_nan = np.isnan(keys[1:]) & np.isnan(keys[:-1])
+        changed = changed & ~both_nan
+    boundaries = [0] + [i + 1 for i in np.nonzero(changed)[0]] + [n]
+    cols: Dict[str, np.ndarray] = {}
+    rows: List[Dict[str, Any]] = []
+    for gi in range(len(boundaries) - 1):
+        start, end = boundaries[gi], boundaries[gi + 1]
+        row: Dict[str, Any] = {op.key: keys[start]}
+        for agg in op.aggs:
+            col, fn, spec = _normalize_agg(agg)
+            if col == "*":
+                col, fn = op.key, "count"
+            if spec is not None and spec.alias_name:
+                name = spec.alias_name
+            else:
+                # Match the arrow path's "<col>_<kernel>" naming.
+                kernel = {"std": "stddev"}.get(fn, fn)
+                name = f"{col}_{kernel}"
+            if col not in cols:
+                cols[col] = block_mod.column_to_numpy(tbl, col)
+            vals = cols[col][start:end]
+            if fn == "count":
+                # Match arrow's count kernel: only VALID values (nulls in
+                # float columns arrive here as NaN).
+                v = np.asarray(vals)
+                row[name] = (int(np.sum(~np.isnan(v)))
+                             if np.issubdtype(v.dtype, np.floating)
+                             else len(v))
+            else:
+                row[name] = _agg_fn(fn, spec)(vals)
+        rows.append(row)
+    return block_from_rows(rows)
 
 
 def _agg_fn(name: str, spec=None):

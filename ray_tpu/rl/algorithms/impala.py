@@ -293,7 +293,9 @@ class IMPALA(Algorithm):
                         vals.append(float(r[k]))
                     except (KeyError, TypeError, ValueError):
                         pass
-                if len(vals) == len(per_batch):
+                if vals:
+                    # Mean of the batches that reported it — a metric
+                    # logged conditionally still averages, not "last wins".
                     results[k] = float(np.mean(vals))
                 else:
                     # Non-scalar metric (array/nested): pass the LAST value
