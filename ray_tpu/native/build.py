@@ -28,12 +28,14 @@ def _source_hash(sources) -> str:
     return h.hexdigest()[:16]
 
 
-def build_library(name: str, sources, extra_flags=()) -> str:
-    """Compile `sources` (paths relative to src/) into lib<name>-<hash>.so and
-    return its path. No-op when the cached artifact is current."""
+def _compile(prefix: str, suffix: str, sources, flags) -> str:
+    """Shared compile-with-cache path: <prefix><tag><suffix> in _build/,
+    double-checked in-process lock, pid-suffixed tmp + atomic replace (safe
+    under concurrent PROCESSES too), stale-artifact cleanup that never
+    touches another process's in-flight .tmp output."""
     srcs = [os.path.join(_SRC, s) for s in sources]
     tag = _source_hash(srcs)
-    out = os.path.join(_BUILD, f"lib{name}-{tag}.so")
+    out = os.path.join(_BUILD, f"{prefix}{tag}{suffix}")
     if os.path.exists(out):
         return out
     with _LOCK:
@@ -41,16 +43,13 @@ def build_library(name: str, sources, extra_flags=()) -> str:
             return out
         os.makedirs(_BUILD, exist_ok=True)
         tmp = out + f".tmp{os.getpid()}"
-        cmd = [
-            "g++", "-O2", "-g", "-std=c++17", "-shared", "-fPIC",
-            "-Wall", "-Werror", "-pthread",
-            *extra_flags, "-o", tmp, *srcs,
-        ]
+        cmd = ["g++", "-O2", "-g", "-std=c++17", "-Wall", "-Werror",
+               "-pthread", *flags, "-o", tmp, *srcs]
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, out)
-        # Drop stale builds of the same library.
         for f in os.listdir(_BUILD):
-            if f.startswith(f"lib{name}-") and f != os.path.basename(out):
+            if f.startswith(prefix) and f != os.path.basename(out) \
+                    and ".tmp" not in f:
                 try:
                     os.unlink(os.path.join(_BUILD, f))
                 except OSError:
@@ -58,5 +57,23 @@ def build_library(name: str, sources, extra_flags=()) -> str:
     return out
 
 
+def build_library(name: str, sources, extra_flags=()) -> str:
+    """Compile `sources` (paths relative to src/) into lib<name>-<hash>.so and
+    return its path. No-op when the cached artifact is current."""
+    return _compile(f"lib{name}-", ".so", sources,
+                    ("-shared", "-fPIC", *extra_flags))
+
+
+def build_executable(name: str, sources, extra_flags=()) -> str:
+    """Compile `sources` into a standalone binary (same caching scheme)."""
+    return _compile(f"{name}-", "", sources, tuple(extra_flags))
+
+
 def plasma_library() -> str:
     return build_library("tpuplasma", ["plasma.cc"])
+
+
+def cpp_client_binary() -> str:
+    """The C++ object-plane client demo binary (src/client.cc)."""
+    return build_executable("ray_tpu_cpp_client", ["client.cc"],
+                            extra_flags=("-DRAY_TPU_CLIENT_MAIN",))

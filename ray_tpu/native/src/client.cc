@@ -1,0 +1,289 @@
+// Minimal C++ client for the ray_tpu OBJECT PLANE.
+//
+// Scope (deliberate): connect to a runtime's object-transfer server and
+// put/get/contains byte-valued objects over the same binary protocol the
+// nodes use (ref framing: ray_tpu/_private/object_transfer.py — OP_PULL=1,
+// OP_CONTAINS=2, OP_PUSH=3; values are the flat serialized form:
+// u32 buffer_count, u64 data_len, [u64 sizes...], pickled data, buffers).
+//
+// For byte values the pickled payload is a tiny fixed shape this file emits
+// and parses directly (PROTO 5 + SHORT_BINBYTES/BINBYTES/BINBYTES8 + STOP,
+// tolerating FRAME/MEMOIZE) — no Python, no pickle library.  The full
+// task/actor C++ API (ref: cpp/include/ray/api/api.h) is descoped; see
+// README "Language frontends" for the rationale.
+//
+// Build (build.py cpp_client_binary() does this in-tree):
+//   g++ -O2 -std=c++17 -DRAY_TPU_CLIENT_MAIN -o ray_tpu_cpp_client client.cc
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray_tpu {
+
+namespace {
+
+constexpr uint8_t kOpPull = 1;
+constexpr uint8_t kOpContains = 2;
+constexpr uint8_t kOpPush = 3;
+
+constexpr uint8_t kStOk = 0;
+constexpr uint8_t kStNotFound = 1;
+constexpr uint8_t kStPending = 3;
+constexpr uint8_t kStFailed = 4;
+
+void write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::write(fd, p, n);
+    if (w <= 0) throw std::runtime_error("socket write failed");
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+}
+
+void read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) throw std::runtime_error("socket read failed / peer closed");
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+}
+
+template <typename T>
+void put_le(std::string* out, T v) {
+  for (size_t i = 0; i < sizeof(T); i++)
+    out->push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+}
+
+template <typename T>
+T get_le(const uint8_t* p) {
+  T v = 0;
+  for (size_t i = 0; i < sizeof(T); i++)
+    v |= static_cast<T>(p[i]) << (8 * i);
+  return v;
+}
+
+// op(1B) + u16 id_len + id — the request header every verb shares.
+std::string header(uint8_t op, const std::string& id) {
+  std::string out;
+  out.push_back(static_cast<char>(op));
+  put_le<uint16_t>(&out, static_cast<uint16_t>(id.size()));
+  out += id;
+  return out;
+}
+
+// pickle(bytes value): PROTO 5, (SHORT_)BINBYTES, STOP.
+std::string pickle_bytes(const std::string& data) {
+  std::string out("\x80\x05", 2);
+  if (data.size() < 256) {
+    out.push_back('C');
+    out.push_back(static_cast<char>(data.size()));
+  } else {
+    out.push_back('B');
+    put_le<uint32_t>(&out, static_cast<uint32_t>(data.size()));
+  }
+  out += data;
+  out.push_back('.');
+  return out;
+}
+
+// Inverse for the narrow bytes shape (FRAME/MEMOIZE tolerated: CPython's
+// pickler emits them around the payload).
+std::string unpickle_bytes(const uint8_t* p, size_t n) {
+  size_t i = 0;
+  std::string value;
+  bool have_value = false;
+  // All bounds checks use the "remaining = n - i" form: with i <= n it
+  // cannot wrap, so a hostile/corrupt u64 length fails cleanly instead of
+  // overflowing "i + len" and driving an out-of-bounds read.
+  auto need = [&](size_t k) {
+    if (n - i < k) throw std::runtime_error("truncated pickle");
+  };
+  while (i < n) {
+    uint8_t op = p[i++];
+    switch (op) {
+      case 0x80:  // PROTO <1B>
+        need(1);
+        i += 1;
+        break;
+      case 0x95:  // FRAME <8B length>
+        need(8);
+        i += 8;
+        break;
+      case 0x94:  // MEMOIZE
+        break;
+      case 'C': {  // SHORT_BINBYTES <1B len>
+        need(1);
+        size_t len = p[i++];
+        need(len);
+        value.assign(reinterpret_cast<const char*>(p + i), len);
+        have_value = true;
+        i += len;
+        break;
+      }
+      case 'B': {  // BINBYTES <u32 len>
+        need(4);
+        size_t len = get_le<uint32_t>(p + i);
+        i += 4;
+        need(len);
+        value.assign(reinterpret_cast<const char*>(p + i), len);
+        have_value = true;
+        i += len;
+        break;
+      }
+      case 0x8e: {  // BINBYTES8 <u64 len>
+        need(8);
+        uint64_t len = get_le<uint64_t>(p + i);
+        i += 8;
+        if (len > n - i) throw std::runtime_error("truncated pickle");
+        value.assign(reinterpret_cast<const char*>(p + i),
+                     static_cast<size_t>(len));
+        have_value = true;
+        i += static_cast<size_t>(len);
+        break;
+      }
+      case '.':  // STOP
+        if (!have_value)
+          throw std::runtime_error("object is not a plain bytes value");
+        return value;
+      default:
+        throw std::runtime_error(
+            "object is not a plain bytes value (opcode " +
+            std::to_string(op) + ")");
+    }
+  }
+  throw std::runtime_error("pickle ended without STOP");
+}
+
+}  // namespace
+
+// One connection to a runtime's object-transfer server.
+class ObjectClient {
+ public:
+  ObjectClient(const std::string& host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket() failed");
+    struct hostent* he = ::gethostbyname(host.c_str());
+    if (he == nullptr) throw std::runtime_error("cannot resolve " + host);
+    struct sockaddr_in addr;
+    std::memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    std::memcpy(&addr.sin_addr, he->h_addr, he->h_length);
+    if (::connect(fd_, reinterpret_cast<struct sockaddr*>(&addr),
+                  sizeof(addr)) != 0)
+      throw std::runtime_error("connect failed");
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, 1 /* TCP_NODELAY */, &one, sizeof(one));
+  }
+
+  ~ObjectClient() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  bool contains(const std::string& id) {
+    std::string req = header(kOpContains, id);
+    write_all(fd_, req.data(), req.size());
+    uint8_t st;
+    read_all(fd_, &st, 1);
+    return st == kStOk;
+  }
+
+  // Store a bytes value under `id`; Python readers see a `bytes` object.
+  void put_bytes(const std::string& id, const std::string& data,
+                 const std::string& owner = "") {
+    std::string pickled = pickle_bytes(data);
+    std::string flat;
+    put_le<uint32_t>(&flat, 0);  // no out-of-band buffers
+    put_le<uint64_t>(&flat, pickled.size());
+    flat += pickled;
+    std::string req = header(kOpPush, id);
+    put_le<uint16_t>(&req, static_cast<uint16_t>(owner.size()));
+    req += owner;
+    put_le<uint64_t>(&req, flat.size());
+    req += flat;
+    write_all(fd_, req.data(), req.size());
+    uint8_t st;
+    read_all(fd_, &st, 1);
+    if (st != kStOk) throw std::runtime_error("push rejected");
+  }
+
+  // Fetch the bytes value stored under `id` (retries while the producer is
+  // still running — ST_PENDING — up to `attempts`).
+  std::string get_bytes(const std::string& id, int attempts = 100) {
+    for (int k = 0; k < attempts; k++) {
+      std::string req = header(kOpPull, id);
+      write_all(fd_, req.data(), req.size());
+      uint8_t st;
+      read_all(fd_, &st, 1);
+      if (st == kStPending) {
+        ::usleep(100 * 1000);
+        continue;
+      }
+      if (st == kStNotFound) throw std::runtime_error("object not found");
+      uint8_t len8[8];
+      read_all(fd_, len8, 8);
+      uint64_t len = get_le<uint64_t>(len8);
+      std::vector<uint8_t> payload(len);
+      if (len > 0) read_all(fd_, payload.data(), len);
+      if (st == kStFailed)
+        throw std::runtime_error("producing task failed on the owner");
+      if (st != kStOk) throw std::runtime_error("unexpected status");
+      // Unwrap the flat form (overflow-safe: compare against remaining).
+      if (len < 12) throw std::runtime_error("short payload");
+      uint32_t nbuf = get_le<uint32_t>(payload.data());
+      uint64_t dlen = get_le<uint64_t>(payload.data() + 4);
+      if (nbuf != 0)
+        throw std::runtime_error(
+            "value carries out-of-band buffers (not a plain bytes object)");
+      if (dlen > len - 12) throw std::runtime_error("corrupt payload");
+      return unpickle_bytes(payload.data() + 12,
+                            static_cast<size_t>(dlen));
+    }
+    throw std::runtime_error("object still pending after retries");
+  }
+
+ private:
+  int fd_ = -1;
+};
+
+}  // namespace ray_tpu
+
+#ifdef RAY_TPU_CLIENT_MAIN
+#include <cstdio>
+
+// Demo/interop binary: pull one object, push one object, verify contains.
+//   ray_tpu_cpp_client <host> <port> <get_id> <put_id>
+int main(int argc, char** argv) {
+  if (argc != 5) {
+    std::fprintf(stderr, "usage: %s host port get_id put_id\n", argv[0]);
+    return 2;
+  }
+  try {
+    ray_tpu::ObjectClient client(argv[1], std::atoi(argv[2]));
+    std::string pulled = client.get_bytes(argv[3]);
+    std::printf("PULLED %zu %s\n", pulled.size(), pulled.c_str());
+    std::string payload = "hello-from-cpp-" + std::to_string(::getpid());
+    client.put_bytes(argv[4], payload, "cpp-client");
+    if (!client.contains(argv[4])) {
+      std::fprintf(stderr, "pushed object missing\n");
+      return 1;
+    }
+    std::printf("PUSHED %s %s\n", argv[4], payload.c_str());
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}
+#endif  // RAY_TPU_CLIENT_MAIN
