@@ -181,12 +181,18 @@ class ServeController:
         self._broadcast_routes()
 
     def _broadcast_routes(self) -> None:
-        routes = {
-            app["route_prefix"]: {"app_name": name, "ingress": app["ingress"],
-                                  "streaming": app.get("streaming", False)}
-            for name, app in self._apps.items()
-            if app["route_prefix"]
-        }
+        routes = {}
+        for name, app in self._apps.items():
+            entry = {"app_name": name, "ingress": app["ingress"],
+                     "streaming": app.get("streaming", False)}
+            if app["route_prefix"]:
+                routes[app["route_prefix"]] = entry
+            else:
+                # gRPC-only apps (route_prefix=None) still need to reach the
+                # gRPC proxy's app resolver and ListApplications (ref:
+                # serve apps with no HTTP route); the sentinel key can never
+                # match an HTTP path, and the HTTP proxy skips it.
+                routes[f"__app__:{name}"] = entry
         self._long_poll.notify_changed({"route_table": routes})
 
     # ---------------------------------------------------------- control loop

@@ -39,10 +39,31 @@ class GRPCRequest:
         return f"GRPCRequest(method={self.method}, {len(self.payload)}B)"
 
 
+def _pb_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _pb_len_field(field_num: int, payload: bytes) -> bytes:
+    """One LEN-typed protobuf field (tag, varint length, bytes) — enough to
+    emit the reference's tiny RayServeAPIService responses without
+    grpcio-tools (ref: src/ray/protobuf/serve.proto:309-322)."""
+    return bytes([(field_num << 3) | 2]) + _pb_varint(len(payload)) + payload
+
+
 class GRPCProxy:
     """grpc.server thread routing RPCs → ingress deployment handles."""
 
     BUILTIN_SERVICE = "ray_tpu.serve.RayServeAPIService"
+    #: The reference's fully-qualified service name — clients built from
+    #: the reference's serve.proto stubs call THIS path and get
+    #: wire-compatible ListApplicationsResponse/HealthzResponse bytes.
+    REFERENCE_BUILTIN_SERVICE = "ray.serve.RayServeAPIService"
 
     def __init__(self, controller_handle, options: GRPCOptions):
         self._controller = controller_handle
@@ -107,8 +128,10 @@ class GRPCProxy:
 
     def handle_rpc(self, service: str, method: str, payload: bytes,
                    metadata: Dict[str, str]) -> bytes:
-        if service == self.BUILTIN_SERVICE:
-            return self._handle_builtin(method)
+        if service in (self.BUILTIN_SERVICE,
+                       self.REFERENCE_BUILTIN_SERVICE):
+            return self._handle_builtin(method, proto=service
+                                        == self.REFERENCE_BUILTIN_SERVICE)
         handle = self._resolve_handle(metadata)
         req = GRPCRequest(payload, method, metadata)
         result = handle.remote(req).result(timeout_s=60.0)
@@ -126,10 +149,12 @@ class GRPCProxy:
         generator produces (ref: proxy.py:639 gRPC streaming entry).
         Clients opt in with the ``streaming: 1`` metadata key — a generic
         handler must pick the RPC arity before user code runs."""
-        if service == self.BUILTIN_SERVICE:
+        if service in (self.BUILTIN_SERVICE,
+                       self.REFERENCE_BUILTIN_SERVICE):
             # Builtins are unary; answer locally even if the client set
             # the streaming key (a one-message stream).
-            yield self._handle_builtin(method)
+            yield self._handle_builtin(method, proto=service
+                                       == self.REFERENCE_BUILTIN_SERVICE)
             return
         handle = self._resolve_handle(metadata)
         req = GRPCRequest(payload, method, metadata)
@@ -149,14 +174,23 @@ class GRPCProxy:
             # the replica-side iterator either way.
             gen.cancel(wait=False)
 
-    def _handle_builtin(self, method: str) -> bytes:
+    def _handle_builtin(self, method: str, proto: bool = False) -> bytes:
+        """Built-in API methods.  Under the reference's service name the
+        replies are protobuf-encoded serve.proto messages (hand-emitted —
+        both are single repeated/optional string fields), so stubs compiled
+        from the reference's schema interoperate; under the ray_tpu service
+        name they stay the original JSON/bytes forms."""
         import json
 
         if method == "Healthz":
+            if proto:  # HealthzResponse{message="success"}
+                return _pb_len_field(1, b"success")
             return b"success"
         if method == "ListApplications":
             apps = sorted({t["app_name"]
                            for t in self._route_table.values()})
+            if proto:  # ListApplicationsResponse{application_names=[...]}
+                return b"".join(_pb_len_field(1, a.encode()) for a in apps)
             return json.dumps(apps).encode()
         raise KeyError(f"unknown builtin method {method!r}")
 

@@ -109,6 +109,8 @@ class HTTPProxy:
         LongestPrefixRouter.match_route)."""
         best = None
         for prefix, target in self._route_table.items():
+            if not prefix.startswith("/"):
+                continue  # gRPC-only app sentinel (__app__:name): no route
             norm = prefix.rstrip("/") or ""
             if path == norm or path.startswith(norm + "/") or prefix == "/":
                 if best is None or len(prefix) > len(best[0]):
@@ -120,10 +122,12 @@ class HTTPProxy:
 
         match = self._match_route(request.path)
         if match is None:
+            http_routes = sorted(p for p in self._route_table
+                                 if p.startswith("/"))
             return web.Response(
                 status=404,
                 text=f"No application at {request.path}. "
-                     f"Routes: {sorted(self._route_table)}")
+                     f"Routes: {http_routes}")
         prefix, target = match
         app_name, ingress = target["app_name"], target["ingress"]
         handle = self._handles.get(app_name)
