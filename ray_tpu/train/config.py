@@ -67,3 +67,6 @@ class RunConfig:
     #: RunConfig.stop)
     stop: Optional[Dict[str, Any]] = None
     verbose: int = 0
+    #: Tune/experiment callbacks — logger integrations live here (ref: air
+    #: RunConfig.callbacks; `ray_tpu.air.integrations` wandb/mlflow/TBX).
+    callbacks: Optional[list] = None

@@ -110,6 +110,7 @@ class Tuner:
                 max_failures=self.run_config.failure_config.max_failures,
                 trial_resources=dict(tc.trial_resources),
                 time_budget_s=tc.time_budget_s,
+                callbacks=self.run_config.callbacks,
                 restore_checkpoints=_checkpoints_by_config(to_resume),
                 # A resumed run must itself stay crash-resumable.
                 snapshot_fn=lambda trials: self._save_experiment_state(
@@ -153,6 +154,7 @@ class Tuner:
             max_failures=self.run_config.failure_config.max_failures,
             trial_resources=resources,
             time_budget_s=tc.time_budget_s,
+            callbacks=self.run_config.callbacks,
             # Periodic snapshots make the experiment restorable after a crash
             # (ref: experiment_state.py periodic checkpointing).
             snapshot_fn=lambda trials: self._save_experiment_state(
