@@ -43,8 +43,8 @@ class _ClientRun:
             self._client.log_metric(self._run_id, k, v, timestamp=ts,
                                     step=step or 0)
 
-    def end_run(self) -> None:
-        self._client.set_terminated(self._run_id)
+    def end_run(self, status: str = "FINISHED") -> None:
+        self._client.set_terminated(self._run_id, status=status)
 
 
 class _OfflineMLflow:
@@ -63,8 +63,8 @@ class _OfflineMLflow:
         self._sink.write({"type": "metrics", "step": step,
                           "metrics": numeric_metrics(metrics)})
 
-    def end_run(self) -> None:
-        self._sink.close({"type": "end"})
+    def end_run(self, status: str = "FINISHED") -> None:
+        self._sink.close({"type": "end", "status": status})
 
 
 def _client_run(mlflow, experiment_name: str,
@@ -88,8 +88,11 @@ def setup_mlflow(config: Optional[Dict[str, Any]] = None, *,
         if config:
             run.log_params(config)
         return run
+    import uuid
+
+    run_id = experiment_name or f"run-{os.getpid()}-{uuid.uuid4().hex[:6]}"
     return _OfflineMLflow(os.path.join(os.getcwd(), "mlruns_offline"),
-                          experiment_name or "run", config)
+                          run_id, config)
 
 
 class MLflowLoggerCallback:
@@ -134,7 +137,9 @@ class MLflowLoggerCallback:
             run.end_run()
 
     def on_trial_error(self, trial=None, **kw) -> None:
-        self.on_trial_complete(trial=trial)
+        run = self._runs.pop(trial.trial_id, None)
+        if run is not None:
+            run.end_run(status="FAILED")
 
     def on_experiment_end(self, trials=None, **kw) -> None:
         for run in self._runs.values():

@@ -49,11 +49,13 @@ def setup_wandb(config: Optional[Dict[str, Any]] = None, *,
     module or a file-backed shim exposing ``log``/``finish``."""
     wandb = _wandb_module()
     if wandb is not None:
-        wandb.init(project=project, name=trial_name or None,
-                   id=trial_id or None, config=config, **kwargs)
-        return wandb
+        return wandb.init(project=project, name=trial_name or None,
+                          id=trial_id or None, config=config, **kwargs)
+    import uuid
+
+    run_id = trial_id or f"run-{os.getpid()}-{uuid.uuid4().hex[:6]}"
     return _OfflineRun(os.path.join(os.getcwd(), "wandb_offline"),
-                       trial_id or "run", config)
+                       run_id, config)
 
 
 class WandbLoggerCallback:
@@ -73,10 +75,14 @@ class WandbLoggerCallback:
         if run is None:
             wandb = _wandb_module()
             if wandb is not None:
+                # reinit="create_new" returns an INDEPENDENT Run object per
+                # trial (log/finish on the object, never the module) — the
+                # concurrent-trials pattern; plain reinit=True would finish
+                # the previous trial's run on every new start.
                 run = wandb.init(project=self.project, group=self.group,
                                  id=trial.trial_id, name=str(trial),
                                  config=dict(trial.config or {}),
-                                 reinit=True, dir=self.dir,
+                                 reinit="create_new", dir=self.dir,
                                  **self.init_kwargs)
             else:
                 base = self.dir or getattr(trial, "logdir", None) or "."
@@ -89,9 +95,10 @@ class WandbLoggerCallback:
         self._run_for(trial)
 
     def on_trial_result(self, trial=None, result=None, **kw) -> None:
+        # The sink/backend filters once: wandb logs rich values natively,
+        # the offline sink keeps numerics.
         self._run_for(trial).log(
-            numeric_metrics(result),
-            step=int(result.get("training_iteration", 0)))
+            dict(result or {}), step=int(result.get("training_iteration", 0)))
 
     def on_trial_complete(self, trial=None, **kw) -> None:
         run = self._runs.pop(trial.trial_id, None)

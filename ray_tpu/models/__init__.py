@@ -1,3 +1,3 @@
-from ray_tpu.models import gpt2, llama, moe
+from ray_tpu.models import gpt2, llama, moe, vit
 
-__all__ = ["gpt2", "llama", "moe"]
+__all__ = ["gpt2", "llama", "moe", "vit"]

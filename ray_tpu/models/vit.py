@@ -1,0 +1,214 @@
+"""ViT — Vision Transformer for image classification, TPU-first.
+
+Same functional contract as the other model families (gpt2.py/llama.py):
+``init_params / logical_axes / forward / loss_fn / make_train_step``.
+(Ref capability: the reference's vision training/serving examples run
+torchvision models through Train/Serve — e.g. doc/source/train torch
+image examples; here the vision family is a native JAX ViT, Dosovitskiy
+et al. 2020.)
+
+TPU notes: patch embedding is ONE big matmul (patches are unfolded
+host-free with reshape/transpose — no convolution layout surprises on the
+MXU), everything runs in ``config.dtype`` (bf16 by default) with fp32
+layernorms/softmax and an fp32 classifier head, and the logical axes
+("embed"/"heads"/"mlp") shard exactly like the language models so the
+same mesh rules apply.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    d_model: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    d_ff: int = 3072
+    dtype: Any = jnp.bfloat16
+    #: lax.scan over the stacked layer axis (O(1) compile depth); False
+    #: unrolls — the same trade the language models expose (gpt2
+    #: scan_layers: unrolled can win runtime at the cost of compile time).
+    scan_layers: bool = True
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return 3 * self.patch_size * self.patch_size
+
+    @classmethod
+    def tiny(cls) -> "ViTConfig":
+        return cls(image_size=32, patch_size=8, num_classes=10,
+                   d_model=64, n_layer=2, n_head=4, d_ff=128)
+
+    @classmethod
+    def base(cls) -> "ViTConfig":
+        return cls()  # ViT-B/16
+
+
+def init_params(config: ViTConfig, key) -> Dict[str, Any]:
+    D, L, F, H = config.d_model, config.n_layer, config.d_ff, config.n_head
+    P, C = config.patch_dim, config.num_classes
+    N = config.n_patches
+    k = iter(jax.random.split(key, 8))
+
+    def dense(key, shape, scale=0.02):
+        return (jax.random.normal(key, shape) * scale).astype(jnp.float32)
+
+    blocks = {
+        "ln1_scale": jnp.ones((L, D)), "ln1_bias": jnp.zeros((L, D)),
+        "wqkv": dense(next(k), (L, D, 3 * D)),
+        "wo": dense(next(k), (L, D, D)),
+        "ln2_scale": jnp.ones((L, D)), "ln2_bias": jnp.zeros((L, D)),
+        "w_up": dense(next(k), (L, D, F)), "b_up": jnp.zeros((L, F)),
+        "w_down": dense(next(k), (L, F, D)), "b_down": jnp.zeros((L, D)),
+    }
+    return {
+        "patch_embed": dense(next(k), (P, D)),
+        "patch_bias": jnp.zeros((D,)),
+        "pos_embed": dense(next(k), (N + 1, D)),
+        "cls_token": dense(next(k), (1, D)),
+        "blocks": blocks,
+        "lnf_scale": jnp.ones((D,)), "lnf_bias": jnp.zeros((D,)),
+        "head": dense(next(k), (D, C)), "head_bias": jnp.zeros((C,)),
+    }
+
+
+def logical_axes(config: ViTConfig) -> Dict[str, Any]:
+    L = "layers"
+    return {
+        "patch_embed": ("patch", "embed"),
+        "patch_bias": ("embed",),
+        "pos_embed": ("seq_pos", "embed"),
+        "cls_token": (None, "embed"),
+        "blocks": {
+            "ln1_scale": (L, "norm"), "ln1_bias": (L, "norm"),
+            "wqkv": (L, "embed", "heads"),
+            "wo": (L, "heads", "embed"),
+            "ln2_scale": (L, "norm"), "ln2_bias": (L, "norm"),
+            "w_up": (L, "embed", "mlp"), "b_up": (L, "mlp"),
+            "w_down": (L, "mlp", "embed"), "b_down": (L, "norm"),
+        },
+        "lnf_scale": ("norm",), "lnf_bias": ("norm",),
+        "head": ("embed", "vocab"), "head_bias": ("vocab",),
+    }
+
+
+def num_params(config: ViTConfig) -> int:
+    D, L, F = config.d_model, config.n_layer, config.d_ff
+    per_block = 4 * D + 3 * D * D + D * D + D * F + F + F * D + D
+    return (config.patch_dim * D + D + (config.n_patches + 1) * D + D
+            + L * per_block + 2 * D + D * config.num_classes
+            + config.num_classes)
+
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias).astype(
+        x.dtype)
+
+
+def patchify(images, config: ViTConfig):
+    """(B, H, W, 3) -> (B, N, patch_dim) with pure reshape/transpose — the
+    patch embed then runs as one (B*N, patch_dim) @ (patch_dim, D) matmul
+    on the MXU (no conv layout pass needed)."""
+    B = images.shape[0]
+    p = config.patch_size
+    g = config.image_size // p
+    x = images.reshape(B, g, p, g, p, 3)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # (B, g, g, p, p, 3)
+    return x.reshape(B, g * g, p * p * 3)
+
+
+def _block(x, blk, config: ViTConfig):
+    B, T, D = x.shape
+    H = config.n_head
+    h = _layernorm(x, blk["ln1_scale"], blk["ln1_bias"])
+    qkv = h @ blk["wqkv"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, D // H).transpose(0, 2, 1, 3)
+    k = k.reshape(B, T, H, D // H).transpose(0, 2, 1, 3)
+    v = v.reshape(B, T, H, D // H).transpose(0, 2, 1, 3)
+    scores = (q @ k.transpose(0, 1, 3, 2)).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(D // H))
+    attn = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = (attn @ v).transpose(0, 2, 1, 3).reshape(B, T, D)
+    x = x + out @ blk["wo"].astype(x.dtype)
+    h = _layernorm(x, blk["ln2_scale"], blk["ln2_bias"])
+    h = jax.nn.gelu(h @ blk["w_up"].astype(x.dtype)
+                    + blk["b_up"].astype(x.dtype))
+    return x + h @ blk["w_down"].astype(x.dtype) \
+        + blk["b_down"].astype(x.dtype)
+
+
+def forward(params: Dict[str, Any], images, config: ViTConfig):
+    """(B, H, W, 3) images -> (B, num_classes) logits (fp32)."""
+    x = patchify(images.astype(config.dtype), config)
+    x = x @ params["patch_embed"].astype(config.dtype) \
+        + params["patch_bias"].astype(config.dtype)
+    B = x.shape[0]
+    cls = jnp.broadcast_to(params["cls_token"].astype(config.dtype),
+                           (B, 1, config.d_model))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(config.dtype)
+    if config.scan_layers:
+        # Stacked block params scan on their leading layer axis: one traced
+        # block body regardless of depth.
+        def body(h, blk):
+            return _block(h, blk, config), None
+
+        x, _ = jax.lax.scan(body, x, params["blocks"])
+    else:
+        for i in range(config.n_layer):
+            blk = jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
+            x = _block(x, blk, config)
+    x = _layernorm(x, params["lnf_scale"], params["lnf_bias"])
+    cls_out = x[:, 0].astype(jnp.float32)
+    return cls_out @ params["head"] + params["head_bias"]
+
+
+def loss_fn(params, images, labels, config: ViTConfig):
+    logits = forward(params, images, config)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)
+    return nll.mean()
+
+
+def accuracy(params, images, labels, config: ViTConfig):
+    logits = forward(params, images, config)
+    return (jnp.argmax(logits, axis=-1) == labels).mean()
+
+
+def make_optimizer(learning_rate=3e-4, weight_decay=0.05, b1=0.9, b2=0.999):
+    import optax
+
+    return optax.adamw(learning_rate, b1=b1, b2=b2,
+                       weight_decay=weight_decay)
+
+
+def make_train_step(config: ViTConfig, optimizer):
+    """Same contract as gpt2.make_train_step: XLA derives all gradient
+    collectives from the shardings."""
+    import optax
+
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, images, labels,
+                                                  config)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

@@ -1,0 +1,127 @@
+"""ViT model family: shapes, patchify exactness, learning, and sharded
+training on the virtual 8-device mesh (same contract tests as the language
+families in test_models.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import vit
+from ray_tpu.parallel import MeshSpec, batch_sharding, make_mesh
+from ray_tpu.parallel.train_state import create_sharded_state, jit_train_step
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return vit.ViTConfig.tiny()
+
+
+def test_forward_shapes_and_dtype(tiny):
+    params = vit.init_params(tiny, jax.random.key(0))
+    images = jnp.zeros((2, tiny.image_size, tiny.image_size, 3))
+    logits = vit.forward(params, images, tiny)
+    assert logits.shape == (2, tiny.num_classes)
+    assert logits.dtype == jnp.float32
+
+
+def test_patchify_exact(tiny):
+    """Patch unfolding is a pure relayout: every pixel lands in exactly the
+    patch and position the (row-major patches, row-major pixels, RGB-last)
+    layout dictates."""
+    rng = np.random.default_rng(0)
+    img = rng.normal(size=(1, tiny.image_size, tiny.image_size, 3)) \
+        .astype(np.float32)
+    patches = np.asarray(vit.patchify(jnp.asarray(img), tiny))
+    g = tiny.image_size // tiny.patch_size
+    assert patches.shape == (1, g * g, tiny.patch_dim)
+    p = tiny.patch_size
+    expect = img[0, :p, :p, :].reshape(-1)  # first patch, row-major pixels
+    np.testing.assert_array_equal(patches[0, 0], expect)
+    expect_last = img[0, -p:, -p:, :].reshape(-1)
+    np.testing.assert_array_equal(patches[0, -1], expect_last)
+
+
+def test_num_params_matches(tiny):
+    params = vit.init_params(tiny, jax.random.key(0))
+    total = sum(int(np.prod(p.shape))
+                for p in jax.tree_util.tree_leaves(params))
+    assert total == vit.num_params(tiny)
+
+
+def test_learns_separable_classes(tiny):
+    """Constant-color images per class: a few steps reach high accuracy."""
+    rng = np.random.default_rng(0)
+    n, s = 64, tiny.image_size
+    labels = rng.integers(0, 4, n)
+    colors = np.eye(3)[labels % 3] * (1 + labels[:, None] // 3)
+    images = np.broadcast_to(
+        colors[:, None, None, :], (n, s, s, 3)).astype(np.float32)
+    images = images + rng.normal(0, 0.05, images.shape).astype(np.float32)
+    images_j, labels_j = jnp.asarray(images), jnp.asarray(labels)
+
+    optimizer = vit.make_optimizer(learning_rate=3e-3)
+    params = vit.init_params(tiny, jax.random.key(0))
+    opt_state = optimizer.init(params)
+    step = jax.jit(vit.make_train_step(tiny, optimizer))
+    first = None
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state, images_j, labels_j)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.2, (first, float(loss))
+    acc = float(jax.jit(
+        lambda p: vit.accuracy(p, images_j, labels_j, tiny))(params))
+    assert acc > 0.9, acc
+
+
+def test_sharded_train_step_dp_tp(tiny):
+    """Full ViT train step jitted over a (data=2, fsdp=2, tensor=2) mesh —
+    the language-model mesh rules apply unchanged to the vision family."""
+    from ray_tpu.parallel import logical_to_spec
+
+    spec = MeshSpec(data=2, fsdp=2, tensor=2)
+    mesh = make_mesh(spec)
+    optimizer = vit.make_optimizer(learning_rate=1e-3)
+    params, opt_state = create_sharded_state(
+        lambda k: vit.init_params(tiny, k), vit.logical_axes(tiny),
+        mesh, jax.random.key(0), optimizer)
+    assert params["blocks"]["wqkv"].sharding.spec == logical_to_spec(
+        ("layers", "embed", "heads"))
+    step = jit_train_step(vit.make_train_step(tiny, optimizer))
+    sh = batch_sharding(mesh)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    label_sh = NamedSharding(mesh, PartitionSpec(("data", "fsdp")))
+    rng = np.random.default_rng(0)
+    images = jax.device_put(jnp.asarray(rng.normal(
+        size=(8, tiny.image_size, tiny.image_size, 3)), jnp.float32), sh)
+    labels = jax.device_put(jnp.asarray(
+        rng.integers(0, tiny.num_classes, 8), jnp.int32), label_sh)
+    params, opt_state, loss = step(params, opt_state, images, labels)
+    assert np.isfinite(float(loss))
+
+
+def test_sharded_matches_single_device(tiny):
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.normal(
+        size=(4, tiny.image_size, tiny.image_size, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, tiny.num_classes, 4), jnp.int32)
+
+    params1 = vit.init_params(tiny, jax.random.key(0))
+    loss1 = float(vit.loss_fn(params1, images, labels, tiny))
+
+    mesh = make_mesh(MeshSpec(data=4, tensor=2))
+    params2, _ = create_sharded_state(
+        lambda k: vit.init_params(tiny, k), vit.logical_axes(tiny),
+        mesh, jax.random.key(0), None)
+    sh = batch_sharding(mesh)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    label_sh = NamedSharding(mesh, PartitionSpec(("data", "fsdp")))
+    loss2 = float(jax.jit(
+        lambda p, x, y: vit.loss_fn(p, x, y, tiny))(
+            params2, jax.device_put(images, sh),
+            jax.device_put(labels, label_sh)))
+    np.testing.assert_allclose(loss1, loss2, rtol=2e-3)
