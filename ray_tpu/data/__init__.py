@@ -141,6 +141,41 @@ def read_numpy(paths) -> Dataset:
     return Dataset(Read([make_task(f) for f in files]))
 
 
+def read_tfrecords(paths) -> Dataset:
+    """tf.train.Example TFRecord files -> one row per example (ref:
+    read_api.py read_tfrecords; framing + protos in data/tfrecords.py,
+    no TensorFlow dependency).  Directories match ``*.tfrecords`` AND
+    TensorFlow's ``*.tfrecord`` convention, falling back to every file in
+    the directory (TF shard names often have no extension at all — the
+    reference reads all files regardless of suffix)."""
+    files: List[str] = []
+    for p in ([paths] if isinstance(paths, str) else list(paths)):
+        if os.path.isdir(p):
+            matched = sorted(
+                f for suffix in (".tfrecords", ".tfrecord")
+                for f in _glob.glob(os.path.join(p, f"*{suffix}"))
+                if os.path.isfile(f))
+            if not matched:
+                matched = sorted(
+                    os.path.join(p, f) for f in os.listdir(p)
+                    if os.path.isfile(os.path.join(p, f)))
+            files.extend(matched)
+        else:
+            files.extend(_expand_paths(p, ".tfrecords"))
+    if not files:
+        raise FileNotFoundError(f"No TFRecord files matched: {paths}")
+
+    def make_task(f: str):
+        def read():
+            from ray_tpu.data.tfrecords import examples_to_block, read_records
+
+            return examples_to_block(read_records(f))
+
+        return read
+
+    return Dataset(Read([make_task(f) for f in files]))
+
+
 def read_text(paths) -> Dataset:
     """One row per line, column 'text' (ref: read_api.py read_text)."""
     files = _expand_paths(paths, ".txt")
@@ -234,7 +269,7 @@ __all__ = [
     "ActorPoolStrategy", "DataIterator", "Dataset", "aggregate", "from_arrow",
     "from_items", "from_numpy", "from_pandas", "preprocessors", "range",
     "read_binary_files", "read_csv", "read_images", "read_json", "read_numpy",
-    "read_parquet", "read_text",
+    "read_parquet", "read_text", "read_tfrecords",
 ]
 
 from ray_tpu.data import aggregate  # noqa: E402  (public submodule)

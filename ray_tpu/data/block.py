@@ -31,7 +31,23 @@ def block_from_rows(rows: List[Dict[str, Any]]) -> Block:
     for row in rows:
         for k in cols:
             cols[k].append(row.get(k))
-    return block_from_batch({k: np.asarray(v) for k, v in cols.items()})
+
+    def to_column(vals: list):
+        # Ragged/variable-length cells (or None mixed with lists) become an
+        # arrow LIST column — np.asarray would raise on inhomogeneous rows.
+        if any(isinstance(v, (list, tuple)) for v in vals):
+            try:
+                arr = np.asarray(vals)
+                if arr.dtype != object:
+                    return arr  # rectangular: keep the tensor-column path
+            except ValueError:
+                pass
+            return pa.array([None if v is None else list(v)
+                             if isinstance(v, (list, tuple)) else [v]
+                             for v in vals])
+        return np.asarray(vals)
+
+    return block_from_batch({k: to_column(v) for k, v in cols.items()})
 
 
 def block_from_batch(batch: Batch) -> Block:

@@ -287,6 +287,12 @@ class Dataset:
         """Newline-delimited JSON, one file per block (ref: write_json)."""
         self._write_blocks(path, "json", _write_block_json)
 
+    def write_tfrecords(self, path: str) -> None:
+        """tf.train.Example TFRecord files, one per block — TensorFlow-
+        readable framing + protos, no TF dependency (ref: write_tfrecords;
+        data/tfrecords.py)."""
+        self._write_blocks(path, "tfrecords", _write_block_tfrecords)
+
     def stats(self) -> str:
         return f"Dataset(plan={'->'.join(op.name for op in self._op.chain())})"
 
@@ -473,6 +479,15 @@ def _write_block_parquet(block, out_path):
 
     if block.num_rows:
         pq.write_table(block, out_path)
+
+
+def _write_block_tfrecords(block, out_path):
+    from ray_tpu.data.block import BlockAccessor
+    from ray_tpu.data.tfrecords import row_to_example, write_records
+
+    if block.num_rows:
+        write_records(out_path, (row_to_example(row) for row in
+                                 BlockAccessor(block).iter_rows()))
 
 
 def _write_block_csv(block, out_path):
