@@ -4,8 +4,9 @@ JaxTrainer places its worker group across REAL worker-node processes:
 rank 0 reserves the jax.distributed coordinator, every rank joins one
 multi-controller cluster, and `ray_tpu.collective.allreduce` inside the
 loop runs as a global SPMD psum across the processes (DCN tier on CPU
-here; ICI+DCN on real pods).  A mid-run node kill is recovered from the
-last checkpoint (elastic restart).
+here; ICI+DCN on real pods).  Elastic recovery from a mid-run node kill
+is exercised in tests/test_train_multihost.py (this example keeps to the
+happy path).
 
 Run: python examples/multihost_train.py
 """

@@ -38,15 +38,21 @@ class Algorithm(Trainable):
     supports_multi_agent: bool = False
 
     # -------------------------------------------------------------- setup
-    def setup(self, config: Dict[str, Any]) -> None:
+    @classmethod
+    def _coerce_config(cls, config) -> AlgorithmConfig:
+        """Tune passes plain dicts (param_space), users pass configs —
+        one resolution path shared by every algorithm's setup."""
         if isinstance(config, AlgorithmConfig):
-            cfg = config
-        else:
-            cfg = getattr(type(self), "config_class")()
-            base = config.pop("_base_config", None)
-            if base is not None:
-                cfg = base.copy()
-            cfg.update_from_dict(config)
+            return config
+        cfg = cls.config_class()
+        base = config.pop("_base_config", None)
+        if base is not None:
+            cfg = base.copy()
+        cfg.update_from_dict(config)
+        return cfg
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        cfg = self._coerce_config(config)
         self.algo_config = cfg
         self.metrics = MetricsLogger()
         self.learner_connector = self.build_learner_connector()

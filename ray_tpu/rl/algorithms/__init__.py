@@ -2,6 +2,7 @@ from ray_tpu.rl.algorithms.appo import APPO, APPOConfig, APPOLearner
 from ray_tpu.rl.algorithms.bc import BC, BCConfig, BCLearner
 from ray_tpu.rl.algorithms.cql import CQL, CQLConfig, CQLLearner
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, DQNLearner
+from ray_tpu.rl.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig, IMPALALearner
 from ray_tpu.rl.algorithms.marwil import MARWIL, MARWILConfig, MARWILLearner
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig, PPOLearner
@@ -12,4 +13,5 @@ __all__ = ["APPO", "APPOConfig", "APPOLearner",
            "IMPALA", "IMPALAConfig", "IMPALALearner",
            "SAC", "SACConfig", "SACLearner", "BC", "BCConfig", "BCLearner",
            "CQL", "CQLConfig", "CQLLearner",
-           "MARWIL", "MARWILConfig", "MARWILLearner"]
+           "MARWIL", "MARWILConfig", "MARWILLearner",
+           "DreamerV3", "DreamerV3Config"]

@@ -1,0 +1,596 @@
+"""DreamerV3 — model-based RL: an RSSM world model trained on replayed
+sequences, with actor and critic trained entirely in imagination.
+
+(ref: rllib/algorithms/dreamerv3/ — dreamerv3.py config/algorithm,
+torch/dreamerv3_torch_learner.py world-model + actor + critic losses,
+utils/summaries.py; Hafner et al. 2023.)
+
+Compact JAX redesign, same architecture spine, deliberate reductions
+(documented so the parity line is honest):
+
+* RSSM with categorical latents (S groups x C classes), straight-through
+  gradients, 1% unimix; GRU deterministic path.
+* World-model loss: symlog-MSE reconstruction + reward, Bernoulli
+  continue, KL balancing (beta_dyn 0.5 / beta_rep 0.1) with 1-nat free
+  bits.  The reference's twohot reward/critic targets are replaced by
+  symlog MSE (simpler, close in practice at these scales).
+* Actor-critic on imagined rollouts: lambda-returns (gamma 0.997,
+  lambda 0.95), critic regressed to sg(lambda-return) with a slow EMA
+  target for bootstrapping, REINFORCE actor with return-range
+  normalization (EMA of the 5th-95th percentile span) and entropy bonus.
+* Vector observations only (the CNN tier exists separately in
+  core/rl_module.py); single local env loop — DreamerV3's replay/train
+  ratio makes the model updates, not env stepping, the budget.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+
+
+class DreamerV3Config(AlgorithmConfig):
+    def __init__(self, algo_class=None):
+        super().__init__(algo_class or DreamerV3)
+        self.lr = 4e-4
+        self.ac_lr = 1e-4
+        self.grad_clip = 100.0
+        self.gamma = 0.997
+        self.lambda_ = 0.95
+        self.horizon = 10           # imagination length
+        self.batch_size = 8         # replayed sequences per update
+        self.batch_length = 16      # steps per replayed sequence
+        self.deter_dim = 128
+        self.stoch_groups = 8
+        self.stoch_classes = 8
+        self.hidden = 128
+        self.free_bits = 1.0
+        self.beta_dyn = 0.5
+        self.beta_rep = 0.1
+        self.entropy_coeff = 3e-3
+        self.critic_ema = 0.98
+        self.unimix = 0.01
+        self.env_steps_per_iteration = 200
+        self.updates_per_iteration = 20
+        self.min_buffer_steps = 300
+        self.train_batch_size = 128  # unused; base-config surface
+
+
+# ------------------------------------------------------------ math utils
+def symlog(x):
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def _mlp_params(key, sizes: List[int]) -> List[Dict[str, Any]]:
+    layers = []
+    for i in range(len(sizes) - 1):
+        key, k = jax.random.split(key)
+        scale = 1.0 / np.sqrt(sizes[i])
+        layers.append({
+            "w": jax.random.normal(k, (sizes[i], sizes[i + 1])) * scale,
+            "b": jnp.zeros(sizes[i + 1]),
+        })
+    return layers
+
+
+def _mlp(params: List[Dict[str, Any]], x, final_act=None):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.silu(x)
+        elif final_act is not None:
+            x = final_act(x)
+    return x
+
+
+def _unimix_logits(logits, unimix: float, classes: int):
+    probs = jax.nn.softmax(logits, -1)
+    probs = (1 - unimix) * probs + unimix / classes
+    return jnp.log(probs)
+
+
+def _sample_onehot(key, logits):
+    """Straight-through one-hot categorical sample (per latent group)."""
+    idx = jax.random.categorical(key, logits, axis=-1)
+    onehot = jax.nn.one_hot(idx, logits.shape[-1], dtype=logits.dtype)
+    probs = jax.nn.softmax(logits, -1)
+    return probs + jax.lax.stop_gradient(onehot - probs)
+
+
+def _kl_categorical(p_logits, q_logits):
+    """KL(p || q) summed over classes and groups, per batch element."""
+    p = jax.nn.softmax(p_logits, -1)
+    logp = jax.nn.log_softmax(p_logits, -1)
+    logq = jax.nn.log_softmax(q_logits, -1)
+    return jnp.sum(p * (logp - logq), axis=(-2, -1))
+
+
+class DreamerV3(Algorithm):
+    config_class = DreamerV3Config
+    learner_class = None  # self-contained: world model + AC live here
+
+    # ------------------------------------------------------------- setup
+    def setup(self, config) -> None:
+        cfg = self._coerce_config(config)
+        from ray_tpu.rl.utils.metrics import MetricsLogger
+
+        self.algo_config = cfg
+        self.metrics = MetricsLogger()
+        self._lifetime_steps = 0
+        self.env_runner_group = _NullRunnerGroup()
+
+        env = cfg.env
+        self._env = env() if callable(env) else __import__(
+            "gymnasium").make(env)
+        self._obs_dim = int(np.prod(self._env.observation_space.shape))
+        self._n_actions = int(self._env.action_space.n)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._key = jax.random.key(cfg.seed)
+        self._params = self._init_params()
+        self._target_critic = jax.tree_util.tree_map(
+            lambda x: x, self._params["critic"])
+        clip = optax.clip_by_global_norm(cfg.grad_clip)
+        self._wm_opt = optax.chain(clip, optax.adam(cfg.lr))
+        self._ac_opt = optax.chain(clip, optax.adam(cfg.ac_lr))
+        wm, ac = self._split(self._params)
+        self._wm_state = self._wm_opt.init(wm)
+        self._ac_state = self._ac_opt.init(ac)
+        self._retnorm = 1.0  # EMA of the imagined-return 5-95% span
+        self._buffer: List[Dict[str, np.ndarray]] = []  # episode segments
+        self._buffer_steps = 0
+        self._episode_returns: List[float] = []
+        self._obs = None
+        self._filter_state = None
+        self._wm_update = jax.jit(self._make_wm_update())
+        self._ac_update = jax.jit(self._make_ac_update())
+        self._policy_step = jax.jit(self._make_policy_step())
+
+    def _split(self, params):
+        wm = {k: v for k, v in params.items()
+              if k not in ("actor", "critic")}
+        ac = {"actor": params["actor"], "critic": params["critic"]}
+        return wm, ac
+
+    def _init_params(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        D, S, C, H = (cfg.deter_dim, cfg.stoch_groups, cfg.stoch_classes,
+                      cfg.hidden)
+        Z = S * C
+        O, A = self._obs_dim, self._n_actions
+        k = iter(jax.random.split(jax.random.key(cfg.seed + 1), 12))
+        feat = D + Z
+        return {
+            "encoder": _mlp_params(next(k), [O, H, H]),
+            "gru_in": _mlp_params(next(k), [Z + A, D]),
+            # GRU weights: update/reset/candidate over [input, state].
+            "gru": {"w": jax.random.normal(next(k), (2 * D, 3 * D)) * 0.02,
+                    "b": jnp.zeros(3 * D)},
+            "prior": _mlp_params(next(k), [D, H, Z]),
+            "post": _mlp_params(next(k), [D + H, H, Z]),
+            "decoder": _mlp_params(next(k), [feat, H, O]),
+            "reward": _mlp_params(next(k), [feat, H, 1]),
+            "cont": _mlp_params(next(k), [feat, H, 1]),
+            "actor": _mlp_params(next(k), [feat, H, A]),
+            "critic": _mlp_params(next(k), [feat, H, 1]),
+        }
+
+    # --------------------------------------------------------- RSSM core
+    def _gru(self, params, x, h):
+        gates = jnp.concatenate([x, h], -1) @ params["gru"]["w"] \
+            + params["gru"]["b"]
+        u, r, c = jnp.split(gates, 3, -1)
+        u = jax.nn.sigmoid(u)
+        r = jax.nn.sigmoid(r)
+        cand = jnp.tanh(r * c)
+        return u * cand + (1 - u) * h
+
+    def _prior_logits(self, params, h):
+        cfg = self.algo_config
+        logits = _mlp(params["prior"], h)
+        logits = logits.reshape(*h.shape[:-1], cfg.stoch_groups,
+                                cfg.stoch_classes)
+        return _unimix_logits(logits, cfg.unimix, cfg.stoch_classes)
+
+    def _post_logits(self, params, h, embed):
+        cfg = self.algo_config
+        logits = _mlp(params["post"], jnp.concatenate([h, embed], -1))
+        logits = logits.reshape(*h.shape[:-1], cfg.stoch_groups,
+                                cfg.stoch_classes)
+        return _unimix_logits(logits, cfg.unimix, cfg.stoch_classes)
+
+    def _step_deter(self, params, h, z_flat, action_onehot):
+        x = _mlp(params["gru_in"], jnp.concatenate([z_flat, action_onehot],
+                                                   -1))
+        return self._gru(params, x, h)
+
+    # ----------------------------------------------------- world-model loss
+    def _make_wm_update(self):
+        cfg = self.algo_config
+
+        def loss_fn(wm_params, batch, key):
+            obs = symlog(batch["obs"])              # (B, T, O)
+            acts = batch["actions"]                 # (B, T) int32
+            B, T = acts.shape
+            embed = _mlp(wm_params["encoder"], obs)
+            a_onehot = jax.nn.one_hot(acts, self._n_actions)
+            keys = jax.random.split(key, T)
+
+            def step(carry, t_in):
+                h, z_flat = carry
+                a_prev, e_t, k_t, first = t_in
+                # is_first masking (the reference's boundary handling):
+                # sequences pack ACROSS episode resets, so the recurrent
+                # state and previous action zero out at each episode start
+                # — no transition is ever learned across a reset.
+                keep = (1.0 - first)[:, None]
+                h = h * keep
+                z_flat = z_flat * keep
+                a_prev = a_prev * keep
+                h = self._step_deter(wm_params, h, z_flat, a_prev)
+                prior = self._prior_logits(wm_params, h)
+                post = self._post_logits(wm_params, h, e_t)
+                z = _sample_onehot(k_t, post)
+                z_flat = z.reshape(B, -1)
+                return (h, z_flat), (h, z_flat, prior, post)
+
+            h0 = jnp.zeros((B, cfg.deter_dim))
+            z0 = jnp.zeros((B, cfg.stoch_groups * cfg.stoch_classes))
+            # Inputs are time-major for the scan: a_prev[t] = action taken
+            # BEFORE observing obs[t] (shifted; first step gets zeros).
+            a_prev = jnp.concatenate(
+                [jnp.zeros((1, B, self._n_actions)),
+                 jnp.transpose(a_onehot, (1, 0, 2))[:-1]], 0)
+            e_tm = jnp.transpose(embed, (1, 0, 2))
+            firsts = jnp.transpose(batch["is_first"], (1, 0))
+            (_, _), (hs, zs, priors, posts) = jax.lax.scan(
+                step, (h0, z0), (a_prev, e_tm, keys, firsts))
+            feat = jnp.concatenate([hs, zs], -1)    # (T, B, feat)
+
+            recon = _mlp(wm_params["decoder"], feat)
+            rew = _mlp(wm_params["reward"], feat)[..., 0]
+            cont_logit = _mlp(wm_params["cont"], feat)[..., 0]
+            obs_tm = jnp.transpose(obs, (1, 0, 2))
+            rew_tm = symlog(jnp.transpose(batch["rewards"], (1, 0)))
+            cont_tm = jnp.transpose(1.0 - batch["terminateds"], (1, 0))
+
+            recon_loss = jnp.mean(jnp.sum((recon - obs_tm) ** 2, -1))
+            reward_loss = jnp.mean((rew - rew_tm) ** 2)
+            cont_loss = jnp.mean(
+                optax.sigmoid_binary_cross_entropy(cont_logit, cont_tm))
+            dyn = _kl_categorical(jax.lax.stop_gradient(posts), priors)
+            rep = _kl_categorical(posts, jax.lax.stop_gradient(priors))
+            kl = (cfg.beta_dyn * jnp.maximum(dyn, cfg.free_bits)
+                  + cfg.beta_rep * jnp.maximum(rep, cfg.free_bits))
+            total = recon_loss + reward_loss + cont_loss + jnp.mean(kl)
+            aux = {"recon_loss": recon_loss, "reward_loss": reward_loss,
+                   "cont_loss": cont_loss, "kl": jnp.mean(dyn),
+                   "feat": jax.lax.stop_gradient(feat)}
+            return total, aux
+
+        def update(wm_params, opt_state, batch, key):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(wm_params, batch, key)
+            updates, opt_state = self._wm_opt.update(grads, opt_state,
+                                                     wm_params)
+            wm_params = optax.apply_updates(wm_params, updates)
+            return wm_params, opt_state, loss, aux
+
+        return update
+
+    # ----------------------------------------------------- actor-critic loss
+    def _make_ac_update(self):
+        cfg = self.algo_config
+
+        def imagine(wm_params, actor_params, feat0, key):
+            """Roll the prior forward H steps with actor actions."""
+            B = feat0.shape[0]
+            h = feat0[:, :cfg.deter_dim]
+            z_flat = feat0[:, cfg.deter_dim:]
+            keys = jax.random.split(key, cfg.horizon)
+
+            def step(carry, k_t):
+                h, z_flat = carry
+                feat = jnp.concatenate([h, z_flat], -1)
+                ka, kz = jax.random.split(k_t)
+                logits = _mlp(actor_params, feat)
+                act = jax.random.categorical(ka, logits)
+                a_onehot = jax.nn.one_hot(act, self._n_actions)
+                h = self._step_deter(wm_params, h, z_flat, a_onehot)
+                prior = self._prior_logits(wm_params, h)
+                z = _sample_onehot(kz, prior)
+                z_flat = z.reshape(B, -1)
+                return (h, z_flat), (feat, act)
+
+            (_, _), (feats, acts) = jax.lax.scan(step, (h, z_flat), keys)
+            return feats, acts  # (H, B, feat), (H, B)
+
+        def loss_fn(ac_params, wm_params, target_critic, feat0, key,
+                    retnorm):
+            feats, acts = imagine(wm_params, ac_params["actor"], feat0, key)
+            rew = symexp(_mlp(wm_params["reward"], feats)[..., 0])
+            cont = jax.nn.sigmoid(_mlp(wm_params["cont"], feats)[..., 0])
+            disc = cfg.gamma * cont
+            v_target = symexp(_mlp(target_critic, feats)[..., 0])
+
+            def lam_step(nxt, t_in):
+                r_t, d_t, v_next = t_in
+                ret = r_t + d_t * ((1 - cfg.lambda_) * v_next
+                                   + cfg.lambda_ * nxt)
+                return ret, ret
+
+            v_next = jnp.concatenate([v_target[1:], v_target[-1:]], 0)
+            _, returns = jax.lax.scan(
+                lam_step, v_target[-1],
+                (rew, disc, v_next), reverse=True)
+            returns = jax.lax.stop_gradient(returns)      # (H, B)
+
+            v_pred = _mlp(ac_params["critic"], feats)[..., 0]
+            critic_loss = jnp.mean((v_pred - symlog(returns)) ** 2)
+
+            logits = _mlp(ac_params["actor"], feats)
+            logp = jax.nn.log_softmax(logits, -1)
+            act_logp = jnp.take_along_axis(
+                logp, acts[..., None], -1)[..., 0]
+            adv = (returns - symexp(jax.lax.stop_gradient(v_pred))) / retnorm
+            # Trajectory discount weights so late imagined steps (past
+            # predicted termination) contribute less.
+            weights = jax.lax.stop_gradient(jnp.cumprod(
+                jnp.concatenate([jnp.ones_like(disc[:1]), disc[:-1]], 0), 0))
+            entropy = -jnp.sum(jnp.exp(logp) * logp, -1)
+            actor_loss = -jnp.mean(
+                weights * (act_logp * jax.lax.stop_gradient(adv)
+                           + cfg.entropy_coeff * entropy))
+            total = actor_loss + critic_loss
+            span = jnp.percentile(returns, 95) - jnp.percentile(returns, 5)
+            aux = {"actor_loss": actor_loss, "critic_loss": critic_loss,
+                   "imagined_return": jnp.mean(returns),
+                   "return_span": span,
+                   "actor_entropy": jnp.mean(entropy)}
+            return total, aux
+
+        def update(ac_params, opt_state, wm_params, target_critic, feat0,
+                   key, retnorm):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(ac_params, wm_params, target_critic,
+                                       feat0, key, retnorm)
+            updates, opt_state = self._ac_opt.update(grads, opt_state,
+                                                     ac_params)
+            ac_params = optax.apply_updates(ac_params, updates)
+            new_target = jax.tree_util.tree_map(
+                lambda t, o: cfg.critic_ema * t + (1 - cfg.critic_ema) * o,
+                target_critic, ac_params["critic"])
+            return ac_params, opt_state, new_target, loss, aux
+
+        return update
+
+    # ------------------------------------------------------------- acting
+    def _make_policy_step(self):
+        cfg = self.algo_config
+
+        def step(params, h, z_flat, a_prev_onehot, obs, key, explore):
+            embed = _mlp(params["encoder"], symlog(obs))
+            h = self._step_deter(params, h, z_flat, a_prev_onehot)
+            post = self._post_logits(params, h, embed)
+            kz, ka = jax.random.split(key)
+            z = _sample_onehot(kz, post)
+            z_flat = z.reshape(z.shape[0], -1)
+            feat = jnp.concatenate([h, z_flat], -1)
+            logits = _mlp(params["actor"], feat)
+            act = jnp.where(explore,
+                            jax.random.categorical(ka, logits),
+                            jnp.argmax(logits, -1))
+            return h, z_flat, act
+
+        return step
+
+    def _act(self, obs: np.ndarray, explore: bool = True) -> int:
+        cfg = self.algo_config
+        if self._filter_state is None:
+            self._filter_state = (
+                jnp.zeros((1, cfg.deter_dim)),
+                jnp.zeros((1, cfg.stoch_groups * cfg.stoch_classes)),
+                jnp.zeros((1, self._n_actions)))
+        h, z_flat, a_prev = self._filter_state
+        self._key, k = jax.random.split(self._key)
+        h, z_flat, act = self._policy_step(
+            self._params, h, z_flat, a_prev,
+            jnp.asarray(obs, jnp.float32)[None], k, jnp.asarray(explore))
+        action = int(act[0])
+        self._filter_state = (h, z_flat,
+                              jax.nn.one_hot(act, self._n_actions))
+        return action
+
+    # ------------------------------------------------------- replay buffer
+    def _collect(self, n_steps: int) -> int:
+        env = self._env
+        seg: Dict[str, list] = {"obs": [], "actions": [], "rewards": [],
+                                "terminateds": [], "is_first": []}
+        collected = 0
+        if self._obs is None:
+            self._obs, _ = env.reset(seed=int(self._rng.integers(1 << 30)))
+            self._filter_state = None
+            self._ep_return = 0.0
+            self._ep_first = True
+        while collected < n_steps:
+            obs = np.asarray(self._obs, np.float32).ravel()
+            act = self._act(obs)
+            nxt, rew, term, trunc, _ = env.step(act)
+            seg["obs"].append(obs)
+            seg["actions"].append(act)
+            seg["rewards"].append(float(rew))
+            seg["terminateds"].append(1.0 if term else 0.0)
+            seg["is_first"].append(1.0 if self._ep_first else 0.0)
+            self._ep_first = False
+            self._ep_return += float(rew)
+            collected += 1
+            if term or trunc:
+                self._episode_returns.append(self._ep_return)
+                self._obs, _ = env.reset(
+                    seed=int(self._rng.integers(1 << 30)))
+                self._filter_state = None
+                self._ep_return = 0.0
+                self._ep_first = True
+            else:
+                self._obs = nxt
+        segment = {k: np.asarray(v, np.float32 if k != "actions"
+                                 else np.int32) for k, v in seg.items()}
+        self._buffer.append(segment)
+        self._buffer_steps += collected
+        # Bounded replay: drop oldest segments past ~50k steps.
+        while self._buffer_steps > 50_000 and len(self._buffer) > 1:
+            self._buffer_steps -= len(self._buffer[0]["actions"])
+            self._buffer.pop(0)
+        return collected
+
+    def _sample_sequences(self) -> Optional[Dict[str, jnp.ndarray]]:
+        cfg = self.algo_config
+        B, L = cfg.batch_size, cfg.batch_length
+        eligible = [s for s in self._buffer if len(s["actions"]) >= L]
+        if not eligible:
+            return None
+        batch: Dict[str, list] = {k: [] for k in
+                                  ("obs", "actions", "rewards",
+                                   "terminateds", "is_first")}
+        for _ in range(B):
+            seg = eligible[self._rng.integers(len(eligible))]
+            start = self._rng.integers(0, len(seg["actions"]) - L + 1)
+            for k in batch:
+                batch[k].append(seg[k][start:start + L])
+        return {k: jnp.asarray(np.stack(v)) for k, v in batch.items()}
+
+    # ------------------------------------------------------- training step
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.algo_config
+        stepped = self._collect(cfg.env_steps_per_iteration)
+        self._lifetime_steps += stepped
+        if self._buffer_steps < cfg.min_buffer_steps:
+            return {"learners": {}, "buffer_steps": self._buffer_steps}
+
+        wm, ac = self._split(self._params)
+        results: Dict[str, Any] = {}
+        for _ in range(cfg.updates_per_iteration):
+            batch = self._sample_sequences()
+            if batch is None:
+                break
+            self._key, k1, k2 = jax.random.split(self._key, 3)
+            wm, self._wm_state, wm_loss, wm_aux = self._wm_update(
+                wm, self._wm_state, batch, k1)
+            feat = wm_aux["feat"]
+            feat0 = feat.reshape(-1, feat.shape[-1])
+            ac, self._ac_state, self._target_critic, ac_loss, ac_aux = \
+                self._ac_update(ac, self._ac_state, wm,
+                                self._target_critic, feat0, k2,
+                                jnp.float32(max(self._retnorm, 1.0)))
+            self._retnorm = 0.99 * self._retnorm \
+                + 0.01 * float(ac_aux["return_span"])
+            results = {"world_model_loss": float(wm_loss),
+                       "recon_loss": float(wm_aux["recon_loss"]),
+                       "reward_loss": float(wm_aux["reward_loss"]),
+                       "kl": float(wm_aux["kl"]),
+                       "actor_loss": float(ac_aux["actor_loss"]),
+                       "critic_loss": float(ac_aux["critic_loss"]),
+                       "imagined_return": float(ac_aux["imagined_return"]),
+                       "actor_entropy": float(ac_aux["actor_entropy"])}
+        self._params = {**wm, **ac}
+        out = {"learners": results, "buffer_steps": self._buffer_steps}
+        if self._episode_returns:
+            recent = self._episode_returns[-20:]
+            out["episode_return_mean"] = float(np.mean(recent))
+        return out
+
+    # --------------------------------------------------------- evaluation
+    def evaluate(self) -> Dict[str, Any]:
+        """Greedy-policy episodes on a fresh env (the base Algorithm's
+        evaluate needs the learner-group machinery DreamerV3 replaces)."""
+        env_f = self.algo_config.env
+        env = env_f() if callable(env_f) else __import__(
+            "gymnasium").make(env_f)
+        returns = []
+        for ep in range(self.algo_config.evaluation_num_episodes
+                        if hasattr(self.algo_config,
+                                   "evaluation_num_episodes") else 5):
+            obs, _ = env.reset(seed=1000 + ep)
+            saved = self._filter_state
+            self._filter_state = None
+            total, done = 0.0, False
+            while not done:
+                act = self._act(np.asarray(obs, np.float32).ravel(),
+                                explore=False)
+                obs, rew, term, trunc, _ = env.step(act)
+                total += float(rew)
+                done = term or trunc
+            self._filter_state = saved
+            returns.append(total)
+        try:
+            env.close()
+        except Exception:
+            pass
+        return {"env_runners": {
+            "episode_return_mean": float(np.mean(returns)),
+            "num_episodes": len(returns)}}
+
+    # ------------------------------------------------------- checkpointing
+    def save_checkpoint(self, checkpoint_dir: str) -> Optional[Dict]:
+        import os
+        import pickle
+
+        state = {
+            "params": jax.device_get(self._params),
+            "target_critic": jax.device_get(self._target_critic),
+            "wm_state": jax.device_get(self._wm_state),
+            "ac_state": jax.device_get(self._ac_state),
+            "retnorm": self._retnorm,
+            "lifetime_steps": self._lifetime_steps,
+        }
+        with open(os.path.join(checkpoint_dir, "dreamer_state.pkl"),
+                  "wb") as f:
+            pickle.dump(state, f)
+        return None
+
+    def load_checkpoint(self, data, checkpoint_dir: str) -> None:
+        import os
+        import pickle
+
+        with open(os.path.join(checkpoint_dir, "dreamer_state.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        self._params = jax.tree_util.tree_map(jnp.asarray, state["params"])
+        self._target_critic = jax.tree_util.tree_map(
+            jnp.asarray, state["target_critic"])
+        self._wm_state = jax.tree_util.tree_map(jnp.asarray,
+                                                state["wm_state"])
+        self._ac_state = jax.tree_util.tree_map(jnp.asarray,
+                                                state["ac_state"])
+        self._retnorm = state["retnorm"]
+        self._lifetime_steps = state["lifetime_steps"]
+        self._filter_state = None
+
+    def cleanup(self) -> None:
+        try:
+            self._env.close()
+        except Exception:
+            pass
+
+
+class _NullRunnerGroup:
+    """Algorithm.step() surface for a self-contained env loop."""
+
+    def get_metrics(self) -> List[Dict[str, Any]]:
+        return []
+
+    def stop(self) -> None:
+        pass
+
+    def sync_weights(self, *a, **kw) -> None:
+        pass

@@ -1,0 +1,95 @@
+"""DreamerV3 (ref: rllib/algorithms/dreamerv3/) — world-model component
+checks and an end-to-end learning gate on a small control task: the actor
+is trained purely in IMAGINATION, so passing requires the RSSM's reward,
+continue and dynamics predictions to be good enough for planning."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl.algorithms import DreamerV3Config
+from ray_tpu.rl.algorithms.dreamerv3 import symexp, symlog
+
+
+class LineWalk:
+    """1-D walk: start at 0, reach +1 (reward 1, terminate) within 12
+    steps; step cost -0.02. Optimal return ~0.92; random is near 0 or
+    negative."""
+
+    class _Space:
+        def __init__(self, n=None, shape=None):
+            self.n = n
+            self.shape = shape
+
+    def __init__(self):
+        self.observation_space = self._Space(shape=(2,))
+        self.action_space = self._Space(n=2)
+        self._x = 0.0
+        self._t = 0
+
+    def reset(self, seed=None):
+        self._x, self._t = 0.0, 0
+        return self._obs(), {}
+
+    def _obs(self):
+        return np.array([self._x, self._t / 12.0], np.float32)
+
+    def step(self, action):
+        self._x += 0.25 if action == 1 else -0.25
+        self._t += 1
+        if self._x >= 1.0:
+            return self._obs(), 1.0, True, False, {}
+        trunc = self._t >= 12
+        return self._obs(), -0.02, False, trunc, {}
+
+
+def test_symlog_symexp_inverse():
+    x = np.array([-100.0, -1.0, 0.0, 0.5, 42.0, 1e4], np.float32)
+    np.testing.assert_allclose(np.asarray(symexp(symlog(x))), x, rtol=1e-4)
+
+
+def test_world_model_losses_decrease():
+    """The RSSM + heads fit replayed experience: reconstruction and reward
+    losses drop substantially over updates on a fixed buffer."""
+    config = (DreamerV3Config()
+              .environment(LineWalk)
+              .training(env_steps_per_iteration=300,
+                        updates_per_iteration=0, min_buffer_steps=200)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    algo.training_step()  # fill the buffer only (0 updates)
+    algo.algo_config.env_steps_per_iteration = 1
+    algo.algo_config.updates_per_iteration = 1
+    history = []
+    for _ in range(30):
+        r = algo.training_step()["learners"]
+        if r:
+            history.append(r["recon_loss"] + r["reward_loss"])
+    assert len(history) >= 20
+    first = np.mean(history[:3])
+    last = np.mean(history[-3:])
+    # Symlog-MSE starts small on this env; a sustained ~30%+ drop is the
+    # fitting signal (the learning gate below is the strong check — the
+    # actor can only succeed through accurate imagined dynamics).
+    assert last < first * 0.75, (first, last)
+    algo.stop()
+
+
+def test_dreamerv3_learns_linewalk():
+    """Learning gate: imagination-trained actor reaches near-optimal
+    return (optimal ~0.92; the gate is well above random)."""
+    import time
+
+    config = (DreamerV3Config()
+              .environment(LineWalk)
+              .debugging(seed=0))
+    algo = config.build_algo()
+    best = -10.0
+    deadline = time.time() + 240
+    for _ in range(60):
+        result = algo.train()
+        best = max(best, result.get("episode_return_mean", -10.0))
+        if best > 0.8 or time.time() > deadline:
+            break
+    assert best > 0.8, f"DreamerV3 failed to learn LineWalk (best {best})"
+    algo.stop()
