@@ -130,9 +130,7 @@ class DreamerV3(Algorithm):
         self._lifetime_steps = 0
         self.env_runner_group = _NullRunnerGroup()
 
-        env = cfg.env
-        self._env = env() if callable(env) else __import__(
-            "gymnasium").make(env)
+        self._env = self._make_env()
         self._obs_dim = int(np.prod(self._env.observation_space.shape))
         self._n_actions = int(self._env.action_space.n)
         self._rng = np.random.default_rng(cfg.seed)
@@ -509,17 +507,20 @@ class DreamerV3(Algorithm):
             out["episode_return_mean"] = float(np.mean(recent))
         return out
 
+    def _make_env(self):
+        env = self.algo_config.env
+        return env() if callable(env) else __import__(
+            "gymnasium").make(env)
+
     # --------------------------------------------------------- evaluation
     def evaluate(self) -> Dict[str, Any]:
         """Greedy-policy episodes on a fresh env (the base Algorithm's
         evaluate needs the learner-group machinery DreamerV3 replaces)."""
-        env_f = self.algo_config.env
-        env = env_f() if callable(env_f) else __import__(
-            "gymnasium").make(env_f)
+        env = self._make_env()
         returns = []
-        for ep in range(self.algo_config.evaluation_num_episodes
-                        if hasattr(self.algo_config,
-                                   "evaluation_num_episodes") else 5):
+        n_episodes = int(getattr(self.algo_config,
+                                 "evaluation_duration", 5) or 5)
+        for ep in range(n_episodes):
             obs, _ = env.reset(seed=1000 + ep)
             saved = self._filter_state
             self._filter_state = None

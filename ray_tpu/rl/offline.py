@@ -56,14 +56,22 @@ class OfflineData:
     (ref: rllib/offline/offline_data.py OfflineData / OfflinePreLearner).
 
     Accepts a path (parquet/json dir), a ray_tpu.data Dataset, or an
-    in-memory column dict.  Materializes to numpy columns once — offline
-    datasets for control tasks fit host memory; larger corpora can pass a
-    Dataset and stream via ``iter_batches`` instead.
+    in-memory column dict.  Two modes:
+
+    * **materialized** (default): numpy columns once, exact uniform
+      sampling — right for control-task corpora that fit host memory.
+    * **streaming=True**: the dataset-scale path (ref: offline_data.py's
+      streaming OfflinePreLearner) — blocks stream through the data
+      pipeline's distributed shuffle, and ``sample`` draws from a bounded
+      in-memory window that continuously refills, so the corpus never
+      materializes on one host.
     """
 
     def __init__(self, source: Union[str, Dict[str, np.ndarray], Any],
-                 *, format: str = "parquet", seed: int = 0):
+                 *, format: str = "parquet", seed: int = 0,
+                 streaming: bool = False, window_rows: int = 50_000):
         self._rng = np.random.default_rng(seed)
+        self._stream = None
         if isinstance(source, dict):
             self.columns = {k: np.asarray(v) for k, v in source.items()}
         else:
@@ -74,6 +82,9 @@ class OfflineData:
                       else rdata.read_json(source))
             else:
                 ds = source
+            if streaming:
+                self._init_streaming(ds, window_rows)
+                return
             rows = ds.take_all()
             if not rows:
                 raise ValueError("offline dataset is empty")
@@ -88,6 +99,66 @@ class OfflineData:
                         for k, v in self.columns.items()}
         self.size = len(self.columns[Columns.OBS])
 
+    # ------------------------------------------------------------ streaming
+    def _init_streaming(self, ds, window_rows: int) -> None:
+        self._base_ds = ds  # epochs reshuffle FROM HERE (chaining shuffle
+        #                     ops onto the shuffled result would re-execute
+        #                     every prior epoch's shuffle)
+        self._window_rows = window_rows
+        self._window: dict = {}
+        self._cursor = 0
+        self.size = None  # unknown without a full pass — by design
+        self._stream = self._batches()
+        self._refill(1)
+        for k in (Columns.OBS, Columns.ACTIONS):
+            if k not in self._window:
+                raise ValueError(f"offline data missing column {k!r}")
+
+    def _batches(self):
+        while True:  # epoch loop: a fresh shuffle of the BASE dataset
+            shuffled = self._base_ds.random_shuffle(
+                seed=int(self._rng.integers(1 << 30)))
+            got_any = False
+            for batch in shuffled.iter_batches(batch_size=4096):
+                got_any = True
+                yield batch
+            if not got_any:
+                raise ValueError("offline dataset is empty")
+
+    def _remaining(self) -> int:
+        if not self._window:
+            return 0
+        return len(next(iter(self._window.values()))) - self._cursor
+
+    def _refill(self, need: int) -> None:
+        """Compact the unconsumed tail, append stream batches up to the
+        window target, then shuffle ONCE — sample() just advances a cursor
+        (O(batch) per draw, not O(window))."""
+        target = max(self._window_rows, need)
+        parts: Dict[str, list] = {}
+        total = self._remaining()
+        for k, v in self._window.items():
+            parts[k] = [v[self._cursor:]]
+        while total < target:
+            batch = next(self._stream)
+            total += len(next(iter(batch.values())))
+            for k, v in batch.items():
+                v = np.asarray(v)
+                if v.dtype == np.float64:
+                    v = v.astype(np.float32)
+                parts.setdefault(k, []).append(v)
+        window = {k: np.concatenate(vs) if len(vs) > 1 else vs[0]
+                  for k, vs in parts.items()}
+        order = self._rng.permutation(total)
+        self._window = {k: v[order] for k, v in window.items()}
+        self._cursor = 0
+
     def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
-        idx = self._rng.integers(0, self.size, batch_size)
-        return {k: v[idx] for k, v in self.columns.items()}
+        if self._stream is None:
+            idx = self._rng.integers(0, self.size, batch_size)
+            return {k: v[idx] for k, v in self.columns.items()}
+        if self._remaining() < batch_size:
+            self._refill(batch_size)
+        start = self._cursor
+        self._cursor += batch_size
+        return {k: v[start:self._cursor] for k, v in self._window.items()}

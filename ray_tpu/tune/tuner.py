@@ -242,7 +242,8 @@ def run(trainable, *, config: Optional[Dict[str, Any]] = None,
         max_concurrent_trials: Optional[int] = None,
         time_budget_s: Optional[float] = None,
         storage_path: Optional[str] = None, name: Optional[str] = None,
-        max_failures: int = 0, verbose: int = 0) -> ResultGrid:
+        max_failures: int = 0, verbose: int = 0,
+        callbacks: Optional[list] = None) -> ResultGrid:
     """Legacy entry point (ref: tune/tune.py run)."""
     if not ray_tpu.is_initialized():
         ray_tpu.init()
@@ -259,6 +260,7 @@ def run(trainable, *, config: Optional[Dict[str, Any]] = None,
         experiment_path=experiment_path, experiment_name=name,
         metric=metric, mode=mode, stop=stop,
         max_concurrent_trials=max_concurrent_trials, max_failures=max_failures,
+        callbacks=callbacks,
         trial_resources=resources_per_trial or {"CPU": 1.0},
         time_budget_s=time_budget_s)
     trials = controller.run()
