@@ -18,9 +18,13 @@ Compact JAX redesign, same architecture spine, deliberate reductions
   lambda 0.95), critic regressed to sg(lambda-return) with a slow EMA
   target for bootstrapping, REINFORCE actor with return-range
   normalization (EMA of the 5th-95th percentile span) and entropy bonus.
-* Vector observations only (the CNN tier exists separately in
-  core/rl_module.py); single local env loop — DreamerV3's replay/train
-  ratio makes the model updates, not env stepping, the budget.
+* Vector observations use an MLP encoder; PIXEL observations
+  (``config.obs_shape=(H, W, C)``) route through the shared conv stack
+  (core/rl_module.py) with the DreamerV3 [-0.5, 0.5] scaling.  The
+  decoder is an MLP over flattened pixels — adequate at gridworld
+  scales, a documented reduction from the reference's deconv tower.
+* Single local env loop — DreamerV3's replay/train ratio makes the model
+  updates, not env stepping, the budget.
 """
 
 from __future__ import annotations
@@ -57,6 +61,13 @@ class DreamerV3Config(AlgorithmConfig):
         self.entropy_coeff = 3e-3
         self.critic_ema = 0.98
         self.unimix = 0.01
+        #: (H, W, C) to run the conv encoder on PIXEL observations (ref:
+        #: the reference's CNN encoder tier; None = vector obs, MLP
+        #: encoder).  The decoder stays an MLP over flattened pixels —
+        #: adequate at gridworld scales, a documented reduction from the
+        #: reference's deconv tower.
+        self.obs_shape = None
+        self.conv_filters = ((16, 4, 2), (32, 3, 1))
         self.env_steps_per_iteration = 200
         self.updates_per_iteration = 20
         self.min_buffer_steps = 300
@@ -133,6 +144,14 @@ class DreamerV3(Algorithm):
         self._env = self._make_env()
         self._obs_dim = int(np.prod(self._env.observation_space.shape))
         self._n_actions = int(self._env.action_space.n)
+        self._pixel = cfg.obs_shape is not None
+        env_shape = tuple(self._env.observation_space.shape)
+        if self._pixel and tuple(cfg.obs_shape) != env_shape:
+            # Compare SHAPES, not element counts: a permuted obs_shape
+            # (CHW vs HWC) has the same prod but scrambles every pixel.
+            raise ValueError(
+                f"obs_shape {tuple(cfg.obs_shape)} does not match the "
+                f"env's observation shape {env_shape}")
         self._rng = np.random.default_rng(cfg.seed)
         self._key = jax.random.key(cfg.seed)
         self._params = self._init_params()
@@ -166,10 +185,25 @@ class DreamerV3(Algorithm):
                       cfg.hidden)
         Z = S * C
         O, A = self._obs_dim, self._n_actions
-        k = iter(jax.random.split(jax.random.key(cfg.seed + 1), 12))
+        k = iter(jax.random.split(jax.random.key(cfg.seed + 1), 14))
         feat = D + Z
+        if self._pixel:
+            from ray_tpu.rl.core.rl_module import conv_out_dim, conv_stack_init
+
+            def init_kernel(kk, shape):
+                scale = 1.0 / np.sqrt(shape[0] * shape[1] * shape[2])
+                return jax.random.normal(kk, shape) * scale
+
+            convs = conv_stack_init(next(k), cfg.obs_shape,
+                                    cfg.conv_filters, init_kernel)
+            ch, cw, cc = conv_out_dim(cfg.obs_shape, cfg.conv_filters)
+            encoder: Any = {"convs": convs,
+                            "torso": _mlp_params(next(k),
+                                                 [ch * cw * cc, H])}
+        else:
+            encoder = _mlp_params(next(k), [O, H, H])
         return {
-            "encoder": _mlp_params(next(k), [O, H, H]),
+            "encoder": encoder,
             "gru_in": _mlp_params(next(k), [Z + A, D]),
             # GRU weights: update/reset/candidate over [input, state].
             "gru": {"w": jax.random.normal(next(k), (2 * D, 3 * D)) * 0.02,
@@ -184,6 +218,27 @@ class DreamerV3(Algorithm):
         }
 
     # --------------------------------------------------------- RSSM core
+    def _preprocess(self, obs):
+        """Observation normalization: pixels to [-0.5, 0.5] (the DreamerV3
+        convention), vectors through symlog.  The decoder reconstructs
+        THIS space."""
+        if self._pixel:
+            return obs / 255.0 - 0.5
+        return symlog(obs)
+
+    def _encode(self, params, obs_pre):
+        enc = params["encoder"]
+        if not self._pixel:
+            return _mlp(enc, obs_pre)
+        from ray_tpu.rl.core.rl_module import conv_stack_apply
+
+        cfg = self.algo_config
+        lead = obs_pre.shape[:-1]
+        x = obs_pre.reshape((-1, *cfg.obs_shape))
+        x = conv_stack_apply(enc["convs"], cfg.conv_filters, x, jax.nn.silu)
+        x = _mlp(enc["torso"], x, final_act=jax.nn.silu)
+        return x.reshape((*lead, x.shape[-1]))
+
     def _gru(self, params, x, h):
         gates = jnp.concatenate([x, h], -1) @ params["gru"]["w"] \
             + params["gru"]["b"]
@@ -217,10 +272,10 @@ class DreamerV3(Algorithm):
         cfg = self.algo_config
 
         def loss_fn(wm_params, batch, key):
-            obs = symlog(batch["obs"])              # (B, T, O)
+            obs = self._preprocess(batch["obs"])    # (B, T, O)
             acts = batch["actions"]                 # (B, T) int32
             B, T = acts.shape
-            embed = _mlp(wm_params["encoder"], obs)
+            embed = self._encode(wm_params, obs)
             a_onehot = jax.nn.one_hot(acts, self._n_actions)
             keys = jax.random.split(key, T)
 
@@ -377,7 +432,7 @@ class DreamerV3(Algorithm):
         cfg = self.algo_config
 
         def step(params, h, z_flat, a_prev_onehot, obs, key, explore):
-            embed = _mlp(params["encoder"], symlog(obs))
+            embed = self._encode(params, self._preprocess(obs))
             h = self._step_deter(params, h, z_flat, a_prev_onehot)
             post = self._post_logits(params, h, embed)
             kz, ka = jax.random.split(key)

@@ -221,6 +221,45 @@ class DefaultActorCritic(RLModule):
     forward_inference = forward_exploration
 
 
+def conv_out_dim(obs_shape, conv_filters) -> Tuple[int, int, int]:
+    """(H, W, C) after a VALID-padded conv stack, validated: a kernel
+    outgrowing the shrinking feature map fails HERE with the offending
+    layer named, not as an opaque negative-shape error downstream."""
+    h, w, c = obs_shape
+    for i, (out_c, k, s) in enumerate(conv_filters):
+        h = (h - k) // s + 1
+        w = (w - k) // s + 1
+        c = out_c
+        if h <= 0 or w <= 0:
+            raise ValueError(
+                f"conv_filters[{i}]=({out_c},{k},{s}) shrinks the feature "
+                f"map to {h}x{w} for obs_shape {tuple(obs_shape)} — reduce "
+                f"kernel/stride or the number of layers")
+    return h, w, c
+
+
+def conv_stack_init(key, obs_shape, conv_filters, init_fn) -> list:
+    """Per-layer conv params; ``init_fn(key, shape)`` builds each kernel."""
+    convs = []
+    in_c = obs_shape[-1]
+    for out_c, k, _s in conv_filters:
+        key, sub = jax.random.split(key)
+        convs.append({"w": init_fn(sub, (k, k, in_c, out_c)),
+                      "b": jnp.zeros((out_c,), jnp.float32)})
+        in_c = out_c
+    return convs
+
+
+def conv_stack_apply(convs, conv_filters, x, act):
+    """NHWC VALID conv stack; returns (N, flattened_features)."""
+    for (_out_c, _k, s), layer in zip(conv_filters, convs):
+        x = jax.lax.conv_general_dilated(
+            x, layer["w"], window_strides=(s, s), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + layer["b"]
+        x = act(x)
+    return x.reshape((x.shape[0], -1))
+
+
 class CNNActorCritic(RLModule):
     """Conv encoder + shared-torso actor-critic for PIXEL observations
     (ref: rllib/core/models/configs.py:653 CNNEncoderConfig — the new
@@ -254,25 +293,14 @@ class CNNActorCritic(RLModule):
         self.hiddens = tuple(hiddens)
 
     def _conv_out_dim(self) -> Tuple[int, int, int]:
-        h, w, c = self.obs_shape
-        for out_c, k, s in self.conv_filters:
-            h = (h - k) // s + 1
-            w = (w - k) // s + 1
-            c = out_c
-        return h, w, c
+        return conv_out_dim(self.obs_shape, self.conv_filters)
 
     def init_params(self, key) -> Params:
-        orth = jax.nn.initializers.orthogonal
-        convs = []
-        in_c = self.obs_shape[-1]
-        for out_c, k, s in self.conv_filters:
-            key, sub = jax.random.split(key)
-            convs.append({
-                "w": orth(scale=float(np.sqrt(2.0)))(
-                    sub, (k, k, in_c, out_c), jnp.float32),
-                "b": jnp.zeros((out_c,), jnp.float32),
-            })
-            in_c = out_c
+        orth = jax.nn.initializers.orthogonal(scale=float(np.sqrt(2.0)))
+        key, k_convs = jax.random.split(key)
+        convs = conv_stack_init(
+            k_convs, self.obs_shape, self.conv_filters,
+            lambda k, shape: orth(k, shape, jnp.float32))
         h, w, c = self._conv_out_dim()
         key, k_torso, k_pi, k_vf = jax.random.split(key, 4)
         torso = _mlp_init(k_torso, self.hiddens[:-1], self.hiddens[-1],
@@ -291,12 +319,8 @@ class CNNActorCritic(RLModule):
         # every leading dim into the conv batch, restore after the torso.
         lead = x.shape[:-1]
         x = x.reshape((-1, *self.obs_shape)) / 255.0
-        for (_out_c, _k, s), layer in zip(self.conv_filters, params["convs"]):
-            x = jax.lax.conv_general_dilated(
-                x, layer["w"], window_strides=(s, s), padding="VALID",
-                dimension_numbers=("NHWC", "HWIO", "NHWC")) + layer["b"]
-            x = jax.nn.relu(x)
-        x = x.reshape((x.shape[0], -1))
+        x = conv_stack_apply(params["convs"], self.conv_filters, x,
+                             jax.nn.relu)
         z = jax.nn.relu(_mlp_apply(params["torso"], x))
         return z.reshape((*lead, z.shape[-1]))
 
