@@ -60,6 +60,23 @@ class Config:
     #: Transient-failure retries for fire-and-forget dependency pulls before
     #: the waiting task is failed with ObjectTransferError.
     object_transfer_pull_retries: int = 3
+    #: SO_SNDBUF/SO_RCVBUF on transfer sockets (large windows keep the
+    #: zero-copy sendfile pipe full on fast links).
+    object_transfer_sockbuf_bytes: int = 4 << 20
+    #: Concurrent range streams per large-object pull (ref:
+    #: push_manager.h chunked parallel pushes).  1 = single stream — the
+    #: right default on a single-core host where extra streams just
+    #: timeshare; raise on multi-core hosts.
+    parallel_pull_streams: int = 1
+    #: Range size per stream request when a pull is split across streams.
+    parallel_pull_chunk_bytes: int = 32 << 20
+    #: Same-host arena handoff: a puller that can map the owner's tmpfs
+    #: arena file copies the payload with ONE memcpy and no socket bytes
+    #: (the analogue of the reference's same-node shared plasma — workers
+    #: on one host never stream objects through TCP).  Falls back to the
+    #: socket path automatically when the peer's arena isn't mappable
+    #: (true remote host).
+    same_host_handoff: bool = True
 
     #: Rendezvous bound for in-process collective ops: a lost/wedged rank
     #: fails the other participants after this long instead of holding

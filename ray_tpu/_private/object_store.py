@@ -44,6 +44,7 @@ class _Entry:
     __slots__ = (
         "state", "value", "has_value", "error", "shm", "in_plasma", "exported",
         "spill_path", "size", "event", "pinned", "last_access", "owner",
+        "backup_flat",
     )
 
     def __init__(self) -> None:
@@ -60,14 +61,55 @@ class _Entry:
         self.pinned = 0
         self.last_access = 0.0
         self.owner = ""
+        #: Duplicate wire bytes that arrived while a zero-copy landing of
+        #: the same object was mid-flight; promoted by abort(), cleared by
+        #: commit() — so an acknowledged duplicate can never be lost.
+        self.backup_flat = None
+
+
+_ARENA_SEQ = [0]
+
+
+def _sweep_dead_arenas() -> None:
+    """Unlink arena files left by hard-killed processes (the path embeds
+    the owning pid; a dead pid means nobody can map it again).  Keeps
+    /dev/shm from filling with orphans across chaos tests / node kills."""
+    import glob
+    import re
+
+    for root in ("/dev/shm", "/tmp"):
+        for path in glob.glob(os.path.join(root, "tpu_plasma_*")):
+            m = re.match(r"tpu_plasma_(\d+)_", os.path.basename(path))
+            if not m:
+                continue
+            pid = int(m.group(1))
+            if pid == os.getpid():
+                continue
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            except PermissionError:
+                pass  # pid alive under another uid
 
 
 def _try_plasma(capacity_bytes: int):
-    """Build + create the native arena; None if the toolchain is missing."""
+    """Build + create the native arena; None if the toolchain is missing.
+
+    The path carries pid + a per-process sequence number so two stores in
+    one process (tests, in-process multi-runtime) never unlink each
+    other's arena file out from under the same-host handoff path."""
     try:
         from ray_tpu.native.plasma import PlasmaClient, default_arena_path
 
-        path = default_arena_path(f"{os.getpid()}_{threading.get_native_id()}")
+        if _ARENA_SEQ[0] == 0:  # once per process
+            _sweep_dead_arenas()
+        _ARENA_SEQ[0] += 1
+        path = default_arena_path(
+            f"{os.getpid()}_{threading.get_native_id()}_{_ARENA_SEQ[0]}")
         if os.path.exists(path):
             os.unlink(path)
         return PlasmaClient(path, capacity=capacity_bytes, create=True)
@@ -116,6 +158,15 @@ class ObjectStore:
         """Store an object already in wire form (arrived from a process worker)."""
         with self._lock:
             entry = self._entries.setdefault(object_id, _Entry())
+            if entry.in_plasma and entry.state == ObjectState.PENDING:
+                # A zero-copy landing (create_for_receive) of the same bytes
+                # is mid-flight: its commit will seal and wake waiters —
+                # attaching the duplicate now would mark the entry READY
+                # while the arena object is still unsealed.  Park the bytes
+                # so abort() can promote them if the landing dies (an
+                # acknowledged delivery must never be lost).
+                entry.backup_flat = bytes(flat)
+                return
             self._attach_serialized(object_id, entry, flat)
             entry.state = ObjectState.READY
             entry.owner = owner
@@ -226,8 +277,9 @@ class ObjectStore:
                 raise entry.error  # type: ignore[misc]
             view = self._serialized_view(object_id, entry)
             if view is None and entry.spill_path is None:
-                flat = serialization.serialize(entry.value).to_bytes()
-                self._attach_serialized(object_id, entry, flat)
+                so = serialization.serialize(entry.value)
+                if not self._attach_serialized_obj(object_id, entry, so):
+                    self._attach_serialized(object_id, entry, so.to_bytes())
                 view = self._serialized_view(object_id, entry)
             if view is not None:
                 return view
@@ -239,10 +291,137 @@ class ObjectStore:
             e = self._entries.get(object_id)
             return e.shm.name if e and e.shm is not None else None
 
+    def serialized_region(self, object_id: ObjectID):
+        """(arena_fd, offset, size, release) of a READY arena-resident
+        object, with the entry pinned against spilling while held — lets
+        the object server ``os.sendfile`` payloads straight out of the
+        tmpfs arena with zero user-space copies (ref: the reference's
+        object_buffer_pool chunk reads, minus the copy).  None when the
+        object is not arena-resident (caller falls back to a view copy)."""
+        if self.plasma is None:
+            return None
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or entry.state != ObjectState.READY \
+                    or not entry.in_plasma:
+                return None
+            region = self.plasma.get_region(object_id, timeout=0)
+            if region is None:
+                return None
+            entry.pinned += 1
+            entry.last_access = time.monotonic()
+
+        released = threading.Event()
+
+        def release() -> None:
+            if released.is_set():
+                return
+            released.set()
+            with self._lock:
+                self.plasma.release(object_id)
+                entry.pinned = max(0, entry.pinned - 1)
+
+        return self.plasma.fd, region[0], entry.size, release
+
+    def create_for_receive(self, object_id: ObjectID, size: int,
+                           owner: str = ""):
+        """Writable arena buffer for landing a remote object's wire bytes
+        straight off a socket (zero-copy receive: the kernel's recv copy is
+        the ONLY copy).  Returns (buf, commit, abort) — fill ``buf``, then
+        ``commit()`` to seal + wake waiters, or ``abort()`` to unwind.
+        None when the arena can't take it (exists / OOM / no arena); the
+        caller falls back to put_serialized."""
+        if self.plasma is None or size <= 0:
+            return None
+        with self._lock:
+            entry = self._entries.setdefault(object_id, _Entry())
+            if entry.state != ObjectState.PENDING or entry.in_plasma \
+                    or object_id in self._plasma_graveyard:
+                return None
+            self._maybe_spill(size)
+            try:
+                buf = self.plasma.create(object_id, size)
+            except Exception:
+                return None
+            self._bytes_used += size
+            entry.in_plasma = True
+            entry.size = size
+            if owner:
+                entry.owner = owner
+
+        def commit() -> None:
+            try:
+                buf.release()
+            except BufferError:
+                pass
+            self.plasma.seal(object_id)
+            with self._lock:
+                entry.state = ObjectState.READY
+                entry.last_access = time.monotonic()
+                entry.backup_flat = None
+                self.stats["puts"] += 1
+            entry.event.set()
+
+        def abort() -> None:
+            try:
+                buf.release()
+            except BufferError:
+                pass
+            promoted = False
+            with self._lock:
+                self._bytes_used -= size
+                entry.in_plasma = False
+                entry.size = 0
+                try:
+                    self.plasma.release(object_id)
+                    self.plasma.delete(object_id)
+                except Exception:
+                    pass
+                backup = entry.backup_flat
+                entry.backup_flat = None
+                if backup is not None and entry.state == ObjectState.PENDING:
+                    # A duplicate delivery was acknowledged while this
+                    # landing was in flight — promote it now so waiters
+                    # wake with the data instead of hanging.
+                    self._attach_serialized(object_id, entry, backup)
+                    entry.state = ObjectState.READY
+                    self.stats["puts"] += 1
+                    promoted = True
+            if promoted:
+                entry.event.set()
+
+        return buf, commit, abort
+
     # --------------------------------------------------------------- lifecycle
     def _ensure(self, object_id: ObjectID) -> _Entry:
         with self._lock:
             return self._entries.setdefault(object_id, _Entry())
+
+    def _attach_serialized_obj(self, object_id: ObjectID, entry: _Entry,
+                               so) -> bool:
+        """Serialize-at-pull fast path: write a SerializedObject's wire form
+        straight into a fresh arena buffer (skipping the to_bytes() flat
+        copy).  Caller holds the lock.  False = arena unavailable; caller
+        falls back to the flat-bytes path."""
+        if self.plasma is None or entry.in_plasma:
+            return False
+        size = so.flat_size
+        self._maybe_spill(size)
+        if object_id in self._plasma_graveyard:
+            return False
+        try:
+            buf = self.plasma.create(object_id, max(size, 1))
+        except Exception:
+            return False
+        try:
+            so.write_into(buf)
+        finally:
+            buf.release()
+        self.plasma.seal(object_id)
+        self._bytes_used += size
+        entry.in_plasma = True
+        entry.size = size
+        return True
 
     def _attach_serialized(self, object_id: ObjectID, entry: _Entry, flat: bytes) -> None:
         size = len(flat)

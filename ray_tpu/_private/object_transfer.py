@@ -73,6 +73,22 @@ OP_BORROW_SESSION = 7
 OP_CHAN_PUSH = 8
 OP_CHAN_CLOSE = 9
 OP_CHAN_RECLAIM = 10
+#: Range pull (ref: object_manager.proto chunked ObjectChunk reads): request
+#: carries offset:u64 + len:u64 after the id; the response's size field is
+#: the object's TOTAL size, and the payload is the clamped
+#: ``[offset, offset+len)`` slice.  A pull of ``offset=0, len=2^63`` is a
+#: whole-object pull that tells the client the total up front, so the
+#: PullManager always uses this op: small objects land in one round trip and
+#: large ones keep this stream for chunk 0 while extra sockets range-pull
+#: the rest in parallel.
+OP_PULL_RANGE = 11
+#: Same-host arena handoff (the analogue of the reference's same-node
+#: shared plasma — ref: plasma/client.h mmap'd fd passing): the response
+#: carries (arena path, offset, size, content crcs) and the server HOLDS
+#: the region pinned until the client sends a done byte (or EOF).  A
+#: client that can map the path copies the payload with one memcpy and no
+#: socket bytes; anything else falls back to OP_PULL_RANGE.
+OP_REGION = 12
 
 ST_OK = 0
 ST_NOT_FOUND = 1
@@ -133,14 +149,21 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def _recv_into(sock: socket.socket, total: int) -> bytearray:
     buf = bytearray(total)
-    view = memoryview(buf)
+    _recv_into_view(sock, memoryview(buf), total)
+    return buf
+
+
+def _recv_into_view(sock: socket.socket, view: memoryview, total: int,
+                    offset: int = 0) -> None:
+    """Land exactly ``total`` bytes at ``view[offset:]`` — used to receive
+    payloads straight into a pre-created plasma arena buffer, making the
+    kernel's recv copy the only copy on the receive side."""
     got = 0
     while got < total:
-        r = sock.recv_into(view[got:], min(total - got, 1 << 20))
+        r = sock.recv_into(view[offset + got:offset + total], total - got)
         if r == 0:
             raise ConnectionError("peer closed mid-payload")
         got += r
-    return buf
 
 
 def _send_payload(sock: socket.socket, payload) -> None:
@@ -148,6 +171,71 @@ def _send_payload(sock: socket.socket, payload) -> None:
     view = memoryview(payload)
     for off in range(0, len(view), chunk):
         sock.sendall(view[off:off + chunk])
+
+
+def _sendfile_all(sock: socket.socket, fd: int, offset: int, count: int) -> int:
+    """Ship an arena-file region with zero user-space copies (tmpfs page →
+    socket buffer in the kernel).  On a socket with a timeout (internally
+    non-blocking) sendfile raises BlockingIOError once the send buffer
+    fills — wait for writability and continue, so a partial send NEVER
+    surfaces as an exception mid-stream.  Returns bytes sent; raises only
+    with the stream position == offset + return value."""
+    import errno
+    import os
+    import select
+
+    sent_total = 0
+    while sent_total < count:
+        try:
+            sent = os.sendfile(sock.fileno(), fd, offset + sent_total,
+                               count - sent_total)
+        except (BlockingIOError, InterruptedError):
+            timeout = sock.gettimeout()
+            r = select.select([], [sock], [], timeout)[1]
+            if not r:
+                e = socket.timeout(
+                    f"sendfile stalled after {sent_total}/{count} bytes")
+                e.partial = sent_total  # type: ignore[attr-defined]
+                raise e
+            continue
+        except OSError as e:
+            e.partial = sent_total  # type: ignore[attr-defined]
+            raise
+        if sent == 0:
+            raise ConnectionError("peer closed during sendfile")
+        sent_total += sent
+    return sent_total
+
+
+def _send_region(sock: socket.socket, store, fd: int, offset: int,
+                 count: int) -> None:
+    """sendfile an arena region, falling back to a zero-copy sendall from
+    the mapped view (the region's plasma refcount is held by the caller).
+    The fallback runs ONLY when sendfile failed before sending any bytes
+    (unsupported transport) — a mid-stream failure must propagate, never
+    restart the payload on the same connection (silent corruption)."""
+    import errno
+
+    try:
+        _sendfile_all(sock, fd, offset, count)
+    except OSError as e:
+        if getattr(e, "partial", 0) or e.errno not in (
+                errno.EINVAL, errno.ENOSYS, errno.EOPNOTSUPP, errno.ENOTSOCK):
+            raise
+        plasma = getattr(store, "plasma", None)
+        if plasma is None:
+            raise
+        _send_payload(sock, plasma.view_at(offset, count))
+
+
+def _tune_sock(sock: socket.socket) -> None:
+    buf = GLOBAL_CONFIG.object_transfer_sockbuf_bytes
+    if buf > 0:
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, buf)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, buf)
+        except OSError:
+            pass
 
 
 class ObjectTransferServer:
@@ -222,6 +310,7 @@ class ObjectTransferServer:
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _tune_sock(conn)
             while not self._stop.is_set():
                 head = conn.recv(1)
                 if not head:
@@ -231,6 +320,11 @@ class ObjectTransferServer:
                 oid = ObjectID(_recv_exact(conn, id_len).decode())
                 if op == OP_PULL:
                     self._handle_pull(conn, oid)
+                elif op == OP_PULL_RANGE:
+                    off, ln = struct.unpack("<QQ", _recv_exact(conn, 16))
+                    self._handle_pull(conn, oid, rng=(off, ln))
+                elif op == OP_REGION:
+                    self._handle_region(conn, oid)
                 elif op == OP_CONTAINS:
                     store = self._store_provider()
                     ok = store is not None and store.contains(oid)
@@ -325,11 +419,22 @@ class ObjectTransferServer:
         threading.Thread(target=waiter, name="objxfer-borrow-reap",
                          daemon=True).start()
 
-    def _handle_pull(self, conn: socket.socket, oid: ObjectID) -> None:
+    def _resolve_serialized(self, conn: socket.socket, oid: ObjectID):
+        """Shared OP_PULL/OP_PULL_RANGE/OP_REGION prologue: resolve the
+        object to a pinned arena region (preferred) or a serialized view,
+        answering the client directly (NOT_FOUND / PENDING / FAILED) when
+        it can't be served.  Returns (store, region, view) — exactly one of
+        region/view set — or None when a reply was already sent.
+
+        The PENDING dance: wait a bounded slice for a pending object to
+        seal (the owner may still be computing it); the borrower retries on
+        ST_PENDING, so a long-running producer never turns into a false
+        NOT_FOUND.  get_serialized also serializes thread-tier values into
+        the arena, so the region is retried after it."""
         store = self._store_provider()
         if store is None:
             conn.sendall(bytes([ST_NOT_FOUND]))
-            return
+            return None
         state = store.state_of(oid)
         known = state is not None or (
             self._is_pending is not None and self._is_pending(oid))
@@ -338,30 +443,101 @@ class ObjectTransferServer:
             # it: answer immediately — this is genuine loss, and waiting
             # would just stall the borrower.
             conn.sendall(bytes([ST_NOT_FOUND]))
-            return
+            return None
         if state == "FAILED":
             self._send_failed(conn, store, oid)
+            return None
+        region = store.serialized_region(oid) \
+            if hasattr(store, "serialized_region") else None
+        view = None
+        if region is None:
+            try:
+                view = store.get_serialized(
+                    oid, timeout=GLOBAL_CONFIG.object_transfer_serve_wait_s)
+            except Exception:
+                state_now = store.state_of(oid)
+                if state_now == "FAILED":
+                    # The producer failed while we were waiting for it.
+                    self._send_failed(conn, store, oid)
+                    return None
+                still_coming = state_now in (None, "PENDING") and known
+                conn.sendall(
+                    bytes([ST_PENDING if still_coming else ST_NOT_FOUND]))
+                return None
+            region = store.serialized_region(oid) \
+                if hasattr(store, "serialized_region") else None
+            if region is not None:
+                view.release()
+                view = None
+        return store, region, view
+
+    def _handle_pull(self, conn: socket.socket, oid: ObjectID,
+                     rng: Optional[Tuple[int, int]] = None) -> None:
+        resolved = self._resolve_serialized(conn, oid)
+        if resolved is None:
             return
-        try:
-            # Wait a bounded slice for a pending object to seal (the owner
-            # may still be computing it); the borrower retries on ST_PENDING
-            # so a long-running producer never turns into a false NOT_FOUND.
-            view = store.get_serialized(
-                oid, timeout=GLOBAL_CONFIG.object_transfer_serve_wait_s)
-            # Copy before sending: serialized views are only stable until the
-            # next store operation that may spill (see ObjectStore docstring).
-            payload = bytes(view)
-        except Exception:
-            state_now = store.state_of(oid)
-            if state_now == "FAILED":
-                # The producer failed while we were waiting for it.
-                self._send_failed(conn, store, oid)
-                return
-            still_coming = state_now in (None, "PENDING") and known
-            conn.sendall(bytes([ST_PENDING if still_coming else ST_NOT_FOUND]))
+        store, region, view = resolved
+        if region is not None:
+            # Fast path: arena-resident — sendfile the pinned region
+            # straight out of the tmpfs arena file, no user-space copy
+            # (ref: object_buffer_pool.h chunk reads, minus the copy).
+            fd, roff, size, release = region
+            try:
+                off, ln = rng if rng is not None else (0, size)
+                n = min(ln, max(0, size - off))
+                conn.sendall(bytes([ST_OK]) + struct.pack("<Q", size))
+                if n:
+                    _send_region(conn, store, fd, roff + off, n)
+            finally:
+                release()
             return
-        conn.sendall(bytes([ST_OK]) + struct.pack("<Q", len(payload)))
+        # Fallback (shm tier / spilled): copy before sending — serialized
+        # views are only stable until the next store operation that may
+        # spill (see ObjectStore docstring).
+        total = len(view)
+        off, ln = rng if rng is not None else (0, total)
+        n = min(ln, max(0, total - off))
+        payload = bytes(view[off:off + n])
+        conn.sendall(bytes([ST_OK]) + struct.pack("<Q", total))
         _send_payload(conn, payload)
+
+    def _handle_region(self, conn: socket.socket, oid: ObjectID) -> None:
+        """Same-host handoff: answer with the pinned arena region's
+        coordinates and hold the pin until the client is done copying."""
+        import zlib
+
+        resolved = self._resolve_serialized(conn, oid)
+        if resolved is None:
+            return
+        store, region, view = resolved
+        plasma = getattr(store, "plasma", None)
+        if region is None or plasma is None:
+            # Not arena-resident (shm tier / spilled): socket pull instead.
+            if region is not None:
+                region[3]()
+            conn.sendall(bytes([ST_ERROR]))
+            return
+        fd, roff, size, release = region
+        try:
+            n = min(4096, size)
+            crc_head = zlib.crc32(plasma.view_at(roff, n)) if n else 0
+            crc_tail = zlib.crc32(
+                plasma.view_at(roff + max(0, size - n), n)) if n else 0
+            pathb = plasma.path.encode()
+            conn.sendall(bytes([ST_OK])
+                         + struct.pack("<QQH", roff, size, len(pathb))
+                         + pathb + struct.pack("<II", crc_head, crc_tail))
+            # The pin lives as long as this wait: done byte or EOF releases.
+            prev = conn.gettimeout()
+            conn.settimeout(GLOBAL_CONFIG.object_transfer_pull_timeout_s)
+            try:
+                conn.recv(1)
+            except (socket.timeout, ConnectionError, OSError):
+                pass
+            finally:
+                conn.settimeout(prev)
+        finally:
+            release()
 
     @staticmethod
     def _send_failed(conn: socket.socket, store, oid: ObjectID) -> None:
@@ -472,8 +648,25 @@ class ObjectTransferServer:
         (owner_len,) = struct.unpack("<H", _recv_exact(conn, 2))
         owner = _recv_exact(conn, owner_len).decode() if owner_len else ""
         (size,) = struct.unpack("<Q", _recv_exact(conn, 8))
-        payload = _recv_into(conn, size)
         store = self._store_provider()
+        created = store.create_for_receive(oid, size, owner=owner) \
+            if store is not None and hasattr(store, "create_for_receive") \
+            else None
+        if created is not None:
+            # Zero-copy landing: the pushed payload goes straight from the
+            # socket into a pre-created arena buffer.
+            buf, commit, abort = created
+            try:
+                _recv_into_view(conn, buf, size)
+            except BaseException:
+                abort()
+                raise
+            commit()
+            if self._on_received is not None:
+                self._on_received(oid)
+            conn.sendall(bytes([ST_OK]))
+            return
+        payload = _recv_into(conn, size)
         if store is None:
             conn.sendall(bytes([ST_ERROR]))
             return
@@ -493,10 +686,63 @@ class ObjectTransferServer:
             _set_local_addr("")
 
 
+# Same-host handoff: cache of read-only mappings of peer arena files
+# (one per peer node process; page-table cost only).  Insertion-ordered for
+# LRU eviction — a dead peer's multi-GB (unlinked) arena must not stay
+# resident just because we once pulled from it.
+_ARENA_MAPS: Dict[str, Tuple[object, memoryview, int]] = {}
+_ARENA_MAPS_LOCK = threading.Lock()
+_ARENA_MAPS_MAX = 32
+
+
+def _drop_arena_map_locked(path: str) -> None:
+    old = _ARENA_MAPS.pop(path, None)
+    if old is not None:
+        try:
+            old[1].release()
+            old[0].close()
+        except (BufferError, OSError):
+            pass  # a handoff copy is mid-flight; the view keeps it alive
+
+
+def _map_peer_arena(path: str, refresh: bool = False) -> Optional[Tuple[memoryview, int]]:
+    """Read-only view over a peer node's arena file, or None when the path
+    isn't mappable here (true remote host)."""
+    import mmap as _mmap
+    import os as _os
+
+    with _ARENA_MAPS_LOCK:
+        if refresh or (path in _ARENA_MAPS and not _os.path.exists(path)):
+            # Explicit refresh, or the peer died and its file was swept:
+            # drop the stale mapping so the kernel can reclaim the pages.
+            _drop_arena_map_locked(path)
+        ent = _ARENA_MAPS.get(path)
+        if ent is not None:
+            _ARENA_MAPS[path] = _ARENA_MAPS.pop(path)  # LRU touch
+            return ent[1], ent[2]
+        try:
+            fd = _os.open(path, _os.O_RDONLY)
+        except OSError:
+            return None
+        try:
+            size = _os.fstat(fd).st_size
+            m = _mmap.mmap(fd, size, prot=_mmap.PROT_READ)
+        except (OSError, ValueError):
+            return None
+        finally:
+            _os.close(fd)
+        view = memoryview(m)
+        _ARENA_MAPS[path] = (m, view, size)
+        while len(_ARENA_MAPS) > _ARENA_MAPS_MAX:
+            _drop_arena_map_locked(next(iter(_ARENA_MAPS)))
+        return view, size
+
+
 def _request_sock(addr: str, timeout: float) -> socket.socket:
     host, port = addr.rsplit(":", 1)
     sock = socket.create_connection((host, int(port)), timeout=timeout)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    _tune_sock(sock)
     return sock
 
 
@@ -525,7 +771,13 @@ class PullManager:
         self._errors: Dict[ObjectID, str] = {}
         self._inflight_bytes = 0
         self._bytes_cv = threading.Condition(self._lock)
-        self.stats = {"pulls": 0, "pull_bytes": 0, "dedup_hits": 0, "failures": 0}
+        #: peers whose arena file we could not map (true remote hosts) —
+        #: skip the handoff round trip for them from then on.
+        self._no_handoff: set = set()
+        #: addr -> pooled idle connections to that peer's object server.
+        self._socks: Dict[str, list] = {}
+        self.stats = {"pulls": 0, "pull_bytes": 0, "dedup_hits": 0,
+                      "failures": 0, "handoffs": 0, "handoff_bytes": 0}
 
     # ------------------------------------------------------------------ async
     def request(self, oid: ObjectID, addr: str) -> None:
@@ -600,7 +852,7 @@ class PullManager:
             attempt = 0
             while True:
                 try:
-                    payload = self._fetch(oid, addr, timeout)
+                    tag, payload = self._fetch(oid, addr, timeout)
                     break
                 except _RemoteTaskFailed as rf:
                     # The producing task failed on the owner: land the
@@ -618,16 +870,20 @@ class PullManager:
                     import time
 
                     time.sleep(min(1.0, 0.1 * (2 ** attempt)))
+            size = payload if tag == "landed" else len(payload)
             if self._is_live is not None and not self._is_live(oid):
                 # Every local ref died while the pull was in flight: landing
                 # the payload now would park unreclaimable bytes in the store
-                # (the zero-refcount callback already fired).  Drop it.
+                # (the zero-refcount callback already fired).  Drop it — a
+                # direct-landed payload is already sealed, so free it.
+                if tag == "landed":
+                    self._store.free(oid)
                 return
-            if not self._store.contains(oid):
-                self._store.put_serialized(oid, payload)
+            if tag != "landed" and not self._store.contains(oid):
+                self._store.put_serialized(oid, bytes(payload))
             with self._lock:
                 self.stats["pulls"] += 1
-                self.stats["pull_bytes"] += len(payload)
+                self.stats["pull_bytes"] += size
                 self._errors.pop(oid, None)
             if self._on_complete is not None:
                 self._on_complete(oid)
@@ -649,28 +905,82 @@ class PullManager:
             ev.set()
 
     def _fetch(self, oid: ObjectID, addr: str,
-               timeout: Optional[float] = None) -> bytes:
+               timeout: Optional[float] = None) -> Tuple[str, object]:
         """One logical pull; retries while the owner answers ST_PENDING.
+
+        Returns ``("landed", size)`` when the payload was received straight
+        into a pre-created arena buffer (already sealed in the store — the
+        kernel's recv copy was the only copy), or ``("bytes", payload)``
+        when the arena couldn't take it and the caller should
+        ``put_serialized`` the payload.
 
         ``timeout=None`` = no deadline (the per-request socket timeout still
         bounds each round trip, so a dead owner raises promptly).
         """
         import time
 
+        streams = max(1, GLOBAL_CONFIG.parallel_pull_streams)
+        chunk = max(1 << 20, GLOBAL_CONFIG.parallel_pull_chunk_bytes)
+        first_len = (1 << 63) if streams <= 1 else chunk
         sock_timeout = GLOBAL_CONFIG.object_transfer_pull_timeout_s
         deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            if deadline is not None:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    raise TimeoutError(f"pull of {oid} from {addr} timed out")
-                sock_timeout = min(
-                    GLOBAL_CONFIG.object_transfer_pull_timeout_s,
-                    max(remaining, 0.05))
-            sock = _request_sock(addr, sock_timeout)
-            try:
-                sock.sendall(_req_header(OP_PULL, oid))
-                status = _recv_exact(sock, 1)[0]
+        handoff = GLOBAL_CONFIG.same_host_handoff and addr not in self._no_handoff
+        sock: Optional[socket.socket] = None
+        reused = False
+        stale = 0
+        ok = False
+        try:
+            while True:
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"pull of {oid} from {addr} timed out")
+                    sock_timeout = min(
+                        GLOBAL_CONFIG.object_transfer_pull_timeout_s,
+                        max(remaining, 0.05))
+                if sock is None:
+                    sock, reused = self._borrow_sock(addr, sock_timeout)
+                else:
+                    sock.settimeout(sock_timeout)
+                try:
+                    if handoff:
+                        outcome = self._region_attempt(sock, oid, addr,
+                                                       sock_timeout)
+                        if outcome == "pending":
+                            time.sleep(0.05)
+                            continue
+                        if outcome == "no-map":
+                            # Peer's arena isn't mappable here: a real
+                            # remote host.  Remember and use the socket path.
+                            self._no_handoff.add(addr)
+                            handoff = False
+                            continue
+                        if outcome == "socket":
+                            # This object isn't arena-resident on the owner
+                            # right now; socket-pull it (peer stays
+                            # eligible).
+                            handoff = False
+                            continue
+                        ok = True
+                        return outcome
+                    sock.sendall(_req_header(OP_PULL_RANGE, oid)
+                                 + struct.pack("<QQ", 0, first_len))
+                    status = _recv_exact(sock, 1)[0]
+                except (ConnectionError, OSError):
+                    # A pooled socket may have gone stale (peer restarted or
+                    # idle-closed); retry on a fresh connection before
+                    # declaring the pull failed.
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+                    if reused and stale < 4:
+                        stale += 1
+                        reused = False
+                        continue
+                    raise
                 if status == ST_PENDING:
                     # Producer still running on the owner — keep waiting.
                     time.sleep(0.05)
@@ -680,18 +990,224 @@ class PullManager:
                     from ray_tpu._private import serialization
 
                     err = serialization.loads(bytes(_recv_into(sock, size)))
+                    ok = True
                     raise _RemoteTaskFailed(err)
                 if status != ST_OK:
+                    ok = True
                     raise ObjectTransferError(
                         f"owner at {addr} has no object {oid} (status={status})")
-                (size,) = struct.unpack("<Q", _recv_exact(sock, 8))
-                self._acquire_budget(size, sock_timeout)
+                (total,) = struct.unpack("<Q", _recv_exact(sock, 8))
+                self._acquire_budget(total, sock_timeout)
                 try:
-                    return bytes(_recv_into(sock, size))
+                    created = self._store.create_for_receive(oid, total) \
+                        if hasattr(self._store, "create_for_receive") else None
+                    if created is not None:
+                        buf, commit, abort = created
+                    else:
+                        fallback = bytearray(total)
+                        buf, commit, abort = memoryview(fallback), None, None
+                    try:
+                        n0 = min(first_len, total)
+                        _recv_into_view(sock, buf, n0)
+                        if total > n0:
+                            self._fetch_ranges(oid, addr, sock, buf, n0,
+                                               total, chunk, streams,
+                                               sock_timeout)
+                    except BaseException:
+                        if abort is not None:
+                            abort()
+                        raise
+                    if commit is not None:
+                        commit()
+                        ok = True
+                        return ("landed", total)
+                    ok = True
+                    return ("bytes", fallback)
                 finally:
-                    self._release_budget(size)
-            finally:
-                sock.close()
+                    self._release_budget(total)
+        finally:
+            if sock is not None:
+                if ok:
+                    self._return_sock(addr, sock)
+                else:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+
+    def _borrow_sock(self, addr: str,
+                     timeout: float) -> Tuple[socket.socket, bool]:
+        """Pooled connection to a peer's object server (ref: the reference's
+        per-remote-node rpc client cache) — saves the connect + accept +
+        server-thread spawn per pull."""
+        with self._lock:
+            pool = self._socks.get(addr)
+            if pool:
+                s = pool.pop()
+                try:
+                    s.settimeout(timeout)
+                    return s, True
+                except OSError:
+                    pass
+        return _request_sock(addr, timeout), False
+
+    def _return_sock(self, addr: str, sock: socket.socket) -> None:
+        with self._lock:
+            pool = self._socks.setdefault(addr, [])
+            if len(pool) < 4:
+                pool.append(sock)
+                return
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _region_attempt(self, sock: socket.socket, oid: ObjectID, addr: str,
+                        sock_timeout: float):
+        """One same-host handoff attempt.  Returns a ``("landed"|"bytes",
+        ...)`` result, or "pending" / "no-map" / "socket" control strings
+        (see _fetch).  The server holds the region pinned until we send the
+        done byte (or the socket closes)."""
+        import zlib
+
+        sock.sendall(_req_header(OP_REGION, oid))
+        status = _recv_exact(sock, 1)[0]
+        if status == ST_PENDING:
+            return "pending"
+        if status == ST_FAILED:
+            (size,) = struct.unpack("<Q", _recv_exact(sock, 8))
+            from ray_tpu._private import serialization
+
+            err = serialization.loads(bytes(_recv_into(sock, size)))
+            raise _RemoteTaskFailed(err)
+        if status == ST_ERROR:
+            return "socket"
+        if status != ST_OK:
+            raise ObjectTransferError(
+                f"owner at {addr} has no object {oid} (status={status})")
+        roff, size, plen = struct.unpack("<QQH", _recv_exact(sock, 18))
+        path = _recv_exact(sock, plen).decode()
+        crc_head, crc_tail = struct.unpack("<II", _recv_exact(sock, 8))
+
+        def src_ok(view: memoryview, mapped: int) -> bool:
+            if roff + size > mapped:
+                return False
+            n = min(4096, size)
+            if n == 0:
+                return True
+            if zlib.crc32(view[roff:roff + n]) != crc_head:
+                return False
+            return zlib.crc32(
+                view[roff + max(0, size - n):roff + size]) == crc_tail
+
+        ent = _map_peer_arena(path)
+        if ent is not None and not src_ok(*ent):
+            ent = _map_peer_arena(path, refresh=True)  # stale map (path reuse)
+        if ent is None or not src_ok(*ent):
+            # Unmappable (remote host) vs mapped-but-mismatched: only the
+            # former disqualifies the peer.  Either way release the server's
+            # pin NOW — this connection is pooled and the server is parked
+            # in its done-byte wait until we answer.
+            try:
+                sock.sendall(b"\x01")
+            except OSError:
+                pass
+            return "no-map" if ent is None else "socket"
+        view, _ = ent
+        src = view[roff:roff + size]
+        self._acquire_budget(size, sock_timeout)
+        try:
+            created = self._store.create_for_receive(oid, size) \
+                if hasattr(self._store, "create_for_receive") else None
+            if created is not None:
+                buf, commit, abort = created
+                try:
+                    buf[:size] = src
+                except BaseException:
+                    abort()
+                    raise
+                commit()
+                result = ("landed", size)
+            else:
+                result = ("bytes", bytearray(src))
+        finally:
+            self._release_budget(size)
+        with self._lock:
+            self.stats["handoffs"] += 1
+            self.stats["handoff_bytes"] += size
+        try:
+            sock.sendall(b"\x01")  # release the server-side pin promptly
+        except OSError:
+            pass  # close() releases it anyway
+        return result
+
+    def _fetch_ranges(self, oid: ObjectID, addr: str, sock0: socket.socket,
+                      buf: memoryview, start: int, total: int, chunk: int,
+                      streams: int, sock_timeout: float) -> None:
+        """Pull the remainder of a large object as parallel range streams
+        (ref: push_manager.h chunked parallel transfer): the already-open
+        socket keeps pulling ranges while up to ``streams - 1`` extra
+        connections work the same offset queue into disjoint slices of the
+        destination buffer."""
+        offsets = list(range(start, total, chunk))
+        offsets.reverse()  # pop() from the low end first
+        qlock = threading.Lock()
+        errors: list = []
+
+        def pull_range(s: socket.socket, off: int) -> None:
+            ln = min(chunk, total - off)
+            s.sendall(_req_header(OP_PULL_RANGE, oid)
+                      + struct.pack("<QQ", off, ln))
+            status = _recv_exact(s, 1)[0]
+            if status != ST_OK:
+                raise ObjectTransferError(
+                    f"range pull of {oid} from {addr} failed (status={status})")
+            (tot,) = struct.unpack("<Q", _recv_exact(s, 8))
+            if tot != total:
+                raise ObjectTransferError(
+                    f"object {oid} changed size mid-pull ({tot} != {total})")
+            _recv_into_view(s, buf, ln, offset=off)
+
+        def worker(s: socket.socket) -> None:
+            while True:
+                with qlock:
+                    if errors or not offsets:
+                        return
+                    off = offsets.pop()
+                try:
+                    pull_range(s, off)
+                except BaseException as e:  # noqa: BLE001 — joined below
+                    with qlock:
+                        errors.append(e)
+                    return
+
+        extra = min(streams - 1, len(offsets) - 1)
+        socks, threads = [], []
+        try:
+            for _ in range(max(0, extra)):
+                try:
+                    socks.append(self._borrow_sock(addr, sock_timeout)[0])
+                except OSError:
+                    break  # fewer streams, not failure
+            for s in socks:
+                t = threading.Thread(target=worker, args=(s,),
+                                     name="objxfer-range", daemon=True)
+                t.start()
+                threads.append(t)
+            worker(sock0)
+            for t in threads:
+                t.join()
+        finally:
+            for s in socks:
+                if not errors:
+                    self._return_sock(addr, s)
+                else:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+        if errors:
+            raise errors[0]
 
     def _acquire_budget(self, size: int, timeout: float) -> None:
         cap = GLOBAL_CONFIG.max_inflight_pull_bytes
@@ -723,16 +1239,36 @@ def contains(addr: str, oid: ObjectID, timeout: float = 5.0) -> bool:
 
 def push(store, oid: ObjectID, addr: str, owner: str = "",
          timeout: Optional[float] = None) -> None:
-    """Proactively send a local object to a peer (ref: push_manager.h:30)."""
+    """Proactively send a local object to a peer (ref: push_manager.h:30).
+
+    Arena-resident objects ship via sendfile straight from the tmpfs arena
+    (no user-space copy); anything else falls back to a view copy."""
     timeout = timeout if timeout is not None \
         else GLOBAL_CONFIG.object_transfer_pull_timeout_s
-    payload = bytes(store.get_serialized(oid, timeout=timeout))
-    sock = _request_sock(addr, timeout)
+    sock = _request_sock(addr, timeout)  # connect BEFORE pinning the region
     try:
+        region = store.serialized_region(oid) \
+            if hasattr(store, "serialized_region") else None
+        payload = None
+        if region is None:
+            payload = bytes(store.get_serialized(oid, timeout=timeout))
+            region = store.serialized_region(oid) \
+                if hasattr(store, "serialized_region") else None
         ob = owner.encode()
-        sock.sendall(_req_header(OP_PUSH, oid) + struct.pack("<H", len(ob)) + ob
-                     + struct.pack("<Q", len(payload)))
-        _send_payload(sock, payload)
+        if region is not None:
+            fd, roff, size, release = region
+            try:
+                sock.sendall(_req_header(OP_PUSH, oid)
+                             + struct.pack("<H", len(ob)) + ob
+                             + struct.pack("<Q", size))
+                _send_region(sock, store, fd, roff, size)
+            finally:
+                release()
+        else:
+            sock.sendall(_req_header(OP_PUSH, oid)
+                         + struct.pack("<H", len(ob)) + ob
+                         + struct.pack("<Q", len(payload)))
+            _send_payload(sock, payload)
         status = _recv_exact(sock, 1)[0]
         if status != ST_OK:
             raise ObjectTransferError(f"push of {oid} to {addr} rejected ({status})")

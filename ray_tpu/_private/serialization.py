@@ -34,6 +34,12 @@ class SerializedObject:
     def total_bytes(self) -> int:
         return len(self.data) + sum(b.raw().nbytes for b in self.buffers)
 
+    @property
+    def flat_size(self) -> int:
+        """Exact byte length of the to_bytes()/write_into() wire form."""
+        return 12 + 8 * len(self.buffers) + len(self.data) + sum(
+            b.raw().nbytes for b in self.buffers)
+
     def to_bytes(self) -> bytes:
         """Flatten into one buffer (framing: u32 count, u64 sizes, payloads)."""
         out = io.BytesIO()
@@ -45,6 +51,28 @@ class SerializedObject:
         for b in self.buffers:
             out.write(b.raw())
         return out.getvalue()
+
+    def write_into(self, dest: memoryview) -> int:
+        """Write the to_bytes() form straight into ``dest`` (e.g. a plasma
+        arena buffer), skipping the intermediate flat copy.  Returns bytes
+        written.  ``dest`` must be at least ``flat_size`` long."""
+        off = 0
+
+        def put(b) -> None:
+            nonlocal off
+            n = len(b)
+            dest[off:off + n] = b
+            off += n
+
+        put(len(self.buffers).to_bytes(4, "little"))
+        put(len(self.data).to_bytes(8, "little"))
+        for b in self.buffers:
+            put(b.raw().nbytes.to_bytes(8, "little"))
+        put(self.data)
+        for b in self.buffers:
+            raw = b.raw()
+            put(raw.cast("B") if raw.format != "B" or raw.ndim != 1 else raw)
+        return off
 
 
 def _capture_ref(ref: Any) -> None:

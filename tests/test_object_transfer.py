@@ -170,3 +170,120 @@ def test_pull_unreachable_owner_raises(borrower):
                       owner_addr="127.0.0.1:1")  # nothing listens here
     with pytest.raises(ObjectLostError):
         ray_tpu.get(ghost, timeout=10)
+
+
+# --------------------------------------------------------------------------
+# r5 zero-copy plane: same-host arena handoff, sendfile socket path, range
+# streams, pooled connections (ref: object_buffer_pool.h zero-copy chunk
+# reads, push_manager.h parallel chunked transfer).
+# --------------------------------------------------------------------------
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.object_store import ObjectStore
+from ray_tpu._private.object_transfer import ObjectTransferServer, PullManager
+
+
+@pytest.fixture()
+def store_pair():
+    owner = ObjectStore(capacity_bytes=256 << 20)
+    puller = ObjectStore(capacity_bytes=256 << 20)
+    server = ObjectTransferServer(lambda: owner)
+    pm = PullManager(puller)
+    yield owner, puller, server, pm
+    server.stop()
+    owner.shutdown()
+    puller.shutdown()
+
+
+def _roundtrip(owner, puller, pm, addr, key, value):
+    oid = ObjectID(key)
+    owner.put(oid, value)
+    pm.pull_blocking(oid, addr, timeout=30)
+    got = puller.get(oid, timeout=5)
+    np.testing.assert_array_equal(got, value)
+    return oid
+
+
+def test_same_host_handoff_engages(store_pair):
+    # Same host: the puller maps the owner's arena file and lands the
+    # payload with one memcpy — no socket payload bytes at all.
+    owner, puller, server, pm = store_pair
+    _roundtrip(owner, puller, pm, server.addr, "h1",
+               np.arange(1 << 18, dtype=np.float64))
+    assert pm.stats["handoffs"] == 1
+    assert pm.stats["handoff_bytes"] > (1 << 21)
+
+
+def test_socket_path_with_handoff_disabled(store_pair):
+    # Socket path: server sendfiles from the arena, client lands the bytes
+    # straight into a pre-created arena buffer (create_for_receive).
+    owner, puller, server, pm = store_pair
+    prev = GLOBAL_CONFIG.same_host_handoff
+    GLOBAL_CONFIG.same_host_handoff = False
+    try:
+        _roundtrip(owner, puller, pm, server.addr, "s1",
+                   np.arange(1 << 18, dtype=np.float64))
+        assert pm.stats["handoffs"] == 0
+        assert pm.stats["pulls"] == 1
+    finally:
+        GLOBAL_CONFIG.same_host_handoff = prev
+
+
+def test_parallel_range_pull_streams(store_pair):
+    # A large object split across concurrent range streams arrives intact.
+    owner, puller, server, pm = store_pair
+    prev = (GLOBAL_CONFIG.same_host_handoff,
+            GLOBAL_CONFIG.parallel_pull_streams,
+            GLOBAL_CONFIG.parallel_pull_chunk_bytes)
+    GLOBAL_CONFIG.same_host_handoff = False
+    GLOBAL_CONFIG.parallel_pull_streams = 3
+    GLOBAL_CONFIG.parallel_pull_chunk_bytes = 1 << 20
+    try:
+        value = np.random.default_rng(0).integers(
+            0, 255, size=6 << 20, dtype=np.uint8)  # ~6 MiB -> 6 ranges
+        _roundtrip(owner, puller, pm, server.addr, "r1", value)
+    finally:
+        (GLOBAL_CONFIG.same_host_handoff,
+         GLOBAL_CONFIG.parallel_pull_streams,
+         GLOBAL_CONFIG.parallel_pull_chunk_bytes) = prev
+
+
+def test_pooled_connections_reused(store_pair):
+    owner, puller, server, pm = store_pair
+    for i in range(4):
+        _roundtrip(owner, puller, pm, server.addr, f"p{i}",
+                   np.full(1024, float(i)))
+    # After the pulls, at least one idle connection is parked in the pool
+    # and subsequent pulls keep working through it.
+    assert any(pool for pool in pm._socks.values())
+    _roundtrip(owner, puller, pm, server.addr, "p-again", np.zeros(8))
+
+
+def test_push_lands_in_receiver_arena(store_pair):
+    owner, puller, server, pm = store_pair
+    receiver_srv = ObjectTransferServer(lambda: puller)
+    try:
+        oid = ObjectID("pushed1")
+        value = np.arange(1 << 16, dtype=np.int64)
+        owner.put(oid, value)
+        object_transfer.push(owner, oid, receiver_srv.addr)
+        np.testing.assert_array_equal(puller.get(oid, timeout=5), value)
+    finally:
+        receiver_srv.stop()
+
+
+def test_push_large_object_partial_sendfile(store_pair):
+    # Larger than the socket send buffer: the client socket has a timeout
+    # (non-blocking under the hood), so sendfile hits EAGAIN mid-stream and
+    # must wait-and-continue — never restart the payload (which would land
+    # corrupt bytes).  Regression for the r5 review finding.
+    owner, puller, server, pm = store_pair
+    receiver_srv = ObjectTransferServer(lambda: puller)
+    try:
+        oid = ObjectID("pushed-big")
+        value = np.random.default_rng(7).integers(
+            0, 255, size=32 << 20, dtype=np.uint8)  # 32 MiB
+        owner.put(oid, value)
+        object_transfer.push(owner, oid, receiver_srv.addr)
+        np.testing.assert_array_equal(puller.get(oid, timeout=10), value)
+    finally:
+        receiver_srv.stop()
