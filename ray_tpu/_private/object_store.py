@@ -286,6 +286,26 @@ class ObjectStore:
             with open(entry.spill_path, "rb") as f:  # type: ignore[arg-type]
                 return memoryview(f.read())
 
+    def spilled_range(self, object_id: ObjectID, off: int, ln: int):
+        """(total_size, bytes) of [off, off+ln) seek-read straight from a
+        READY spilled object's file — None when not spilled.  Parallel
+        range streams would otherwise re-read the whole spill file once per
+        chunk via get_serialized (a 1 GiB object pulled as 32 MiB chunks =
+        32 GiB of disk reads)."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or e.spill_path is None or e.in_plasma \
+                    or e.shm is not None or e.has_value:
+                return None
+            path, total = e.spill_path, e.size
+            e.last_access = time.monotonic()
+        try:
+            with open(path, "rb") as f:
+                f.seek(off)
+                return total, f.read(max(0, min(ln, total - off)))
+        except OSError:
+            return None
+
     def shm_name(self, object_id: ObjectID) -> Optional[str]:
         with self._lock:
             e = self._entries.get(object_id)
@@ -415,8 +435,25 @@ class ObjectStore:
             return False
         try:
             so.write_into(buf)
-        finally:
+        except BaseException:
+            # A created-but-unsealed object would poison every later access:
+            # the retry's create hits PlasmaObjectExists, the dup-delivery
+            # handler marks it in_plasma, and plasma.get of the unsealed
+            # entry returns None forever.  Seal+delete the orphan; if the
+            # delete can't land, graveyard the key so nothing aliases it.
             buf.release()
+            try:
+                self.plasma.seal(object_id)
+            except Exception:
+                pass
+            try:
+                self.plasma.release(object_id)  # drop creator ref
+                if not self.plasma.delete(object_id):
+                    self._plasma_graveyard.add(object_id)
+            except Exception:
+                self._plasma_graveyard.add(object_id)
+            raise
+        buf.release()
         self.plasma.seal(object_id)
         self._bytes_used += size
         entry.in_plasma = True

@@ -324,7 +324,8 @@ class ObjectTransferServer:
                     off, ln = struct.unpack("<QQ", _recv_exact(conn, 16))
                     self._handle_pull(conn, oid, rng=(off, ln))
                 elif op == OP_REGION:
-                    self._handle_region(conn, oid)
+                    if not self._handle_region(conn, oid):
+                        return  # desynced/dead socket: must not be reused
                 elif op == OP_CONTAINS:
                     store = self._store_provider()
                     ok = store is not None and store.contains(oid)
@@ -473,6 +474,19 @@ class ObjectTransferServer:
 
     def _handle_pull(self, conn: socket.socket, oid: ObjectID,
                      rng: Optional[Tuple[int, int]] = None) -> None:
+        if rng is not None:
+            # Spilled objects: seek-read just the requested range — the
+            # generic path below would re-read the entire spill file for
+            # every parallel chunk stream.
+            store = self._store_provider()
+            sr = store.spilled_range(oid, *rng) \
+                if store is not None and hasattr(store, "spilled_range") \
+                else None
+            if sr is not None:
+                total, chunk = sr
+                conn.sendall(bytes([ST_OK]) + struct.pack("<Q", total))
+                _send_payload(conn, chunk)
+                return
         resolved = self._resolve_serialized(conn, oid)
         if resolved is None:
             return
@@ -501,14 +515,20 @@ class ObjectTransferServer:
         conn.sendall(bytes([ST_OK]) + struct.pack("<Q", total))
         _send_payload(conn, payload)
 
-    def _handle_region(self, conn: socket.socket, oid: ObjectID) -> None:
+    def _handle_region(self, conn: socket.socket, oid: ObjectID) -> bool:
         """Same-host handoff: answer with the pinned arena region's
-        coordinates and hold the pin until the client is done copying."""
+        coordinates and hold the pin until the client is done copying.
+
+        Returns False when the connection must be dropped: if the done-byte
+        wait times out while the client is still alive (stalled in its
+        budget gate or a long memcpy), its eventual done byte would be
+        parsed as the next request's opcode — a desynced pooled socket
+        poisons every later pull on it."""
         import zlib
 
         resolved = self._resolve_serialized(conn, oid)
         if resolved is None:
-            return
+            return True
         store, region, view = resolved
         plasma = getattr(store, "plasma", None)
         if region is None or plasma is None:
@@ -516,8 +536,9 @@ class ObjectTransferServer:
             if region is not None:
                 region[3]()
             conn.sendall(bytes([ST_ERROR]))
-            return
+            return True
         fd, roff, size, release = region
+        ok = True
         try:
             n = min(4096, size)
             crc_head = zlib.crc32(plasma.view_at(roff, n)) if n else 0
@@ -533,11 +554,12 @@ class ObjectTransferServer:
             try:
                 conn.recv(1)
             except (socket.timeout, ConnectionError, OSError):
-                pass
+                ok = False
             finally:
                 conn.settimeout(prev)
         finally:
             release()
+        return ok
 
     @staticmethod
     def _send_failed(conn: socket.socket, store, oid: ObjectID) -> None:
@@ -690,26 +712,49 @@ class ObjectTransferServer:
 # (one per peer node process; page-table cost only).  Insertion-ordered for
 # LRU eviction — a dead peer's multi-GB (unlinked) arena must not stay
 # resident just because we once pulled from it.
-_ARENA_MAPS: Dict[str, Tuple[object, memoryview, int]] = {}
+# path -> [mmap, view, size, refs, doomed].  refs counts in-flight handoff
+# copies holding slices of the view; doomed marks an evicted/refreshed entry
+# whose unmap must wait for the last ref (releasing the parent memoryview
+# invalidates every live slice — a concurrent LRU eviction would otherwise
+# kill a copy mid-flight).
+_ARENA_MAPS: Dict[str, list] = {}
 _ARENA_MAPS_LOCK = threading.Lock()
 _ARENA_MAPS_MAX = 32
 
 
+def _unmap_arena_entry(ent) -> None:
+    try:
+        ent[1].release()
+        ent[0].close()
+    except (BufferError, OSError):
+        pass
+
+
 def _drop_arena_map_locked(path: str) -> None:
     old = _ARENA_MAPS.pop(path, None)
-    if old is not None:
-        try:
-            old[1].release()
-            old[0].close()
-        except (BufferError, OSError):
-            pass  # a handoff copy is mid-flight; the view keeps it alive
+    if old is None:
+        return
+    if old[3] > 0:
+        old[4] = True  # last _arena_map_unref unmaps
+    else:
+        _unmap_arena_entry(old)
 
 
-def _map_peer_arena(path: str, refresh: bool = False) -> Optional[Tuple[memoryview, int]]:
+def _arena_map_unref(ent) -> None:
+    with _ARENA_MAPS_LOCK:
+        ent[3] -= 1
+        if ent[4] and ent[3] <= 0:
+            _unmap_arena_entry(ent)
+
+
+def _map_peer_arena(path: str, refresh: bool = False):
     """Read-only view over a peer node's arena file, or None when the path
-    isn't mappable here (true remote host)."""
+    isn't mappable here (true remote host).  Returns (view, size, unref);
+    the caller MUST call unref() once done copying — the mapping is only
+    unmapped when evicted AND unreferenced."""
     import mmap as _mmap
     import os as _os
+    from functools import partial as _partial
 
     with _ARENA_MAPS_LOCK:
         if refresh or (path in _ARENA_MAPS and not _os.path.exists(path)):
@@ -719,7 +764,8 @@ def _map_peer_arena(path: str, refresh: bool = False) -> Optional[Tuple[memoryvi
         ent = _ARENA_MAPS.get(path)
         if ent is not None:
             _ARENA_MAPS[path] = _ARENA_MAPS.pop(path)  # LRU touch
-            return ent[1], ent[2]
+            ent[3] += 1
+            return ent[1], ent[2], _partial(_arena_map_unref, ent)
         try:
             fd = _os.open(path, _os.O_RDONLY)
         except OSError:
@@ -731,11 +777,11 @@ def _map_peer_arena(path: str, refresh: bool = False) -> Optional[Tuple[memoryvi
             return None
         finally:
             _os.close(fd)
-        view = memoryview(m)
-        _ARENA_MAPS[path] = (m, view, size)
+        ent = [m, memoryview(m), size, 1, False]
+        _ARENA_MAPS[path] = ent
         while len(_ARENA_MAPS) > _ARENA_MAPS_MAX:
             _drop_arena_map_locked(next(iter(_ARENA_MAPS)))
-        return view, size
+        return ent[1], ent[2], _partial(_arena_map_unref, ent)
 
 
 def _request_sock(addr: str, timeout: float) -> socket.socket:
@@ -1101,37 +1147,43 @@ class PullManager:
                 view[roff + max(0, size - n):roff + size]) == crc_tail
 
         ent = _map_peer_arena(path)
-        if ent is not None and not src_ok(*ent):
+        if ent is not None and not src_ok(ent[0], ent[1]):
+            ent[2]()
             ent = _map_peer_arena(path, refresh=True)  # stale map (path reuse)
-        if ent is None or not src_ok(*ent):
+        if ent is None or not src_ok(ent[0], ent[1]):
             # Unmappable (remote host) vs mapped-but-mismatched: only the
             # former disqualifies the peer.  Either way release the server's
             # pin NOW — this connection is pooled and the server is parked
             # in its done-byte wait until we answer.
+            if ent is not None:
+                ent[2]()
             try:
                 sock.sendall(b"\x01")
             except OSError:
                 pass
             return "no-map" if ent is None else "socket"
-        view, _ = ent
-        src = view[roff:roff + size]
-        self._acquire_budget(size, sock_timeout)
+        view, _, unref = ent
         try:
-            created = self._store.create_for_receive(oid, size) \
-                if hasattr(self._store, "create_for_receive") else None
-            if created is not None:
-                buf, commit, abort = created
-                try:
-                    buf[:size] = src
-                except BaseException:
-                    abort()
-                    raise
-                commit()
-                result = ("landed", size)
-            else:
-                result = ("bytes", bytearray(src))
+            src = view[roff:roff + size]
+            self._acquire_budget(size, sock_timeout)
+            try:
+                created = self._store.create_for_receive(oid, size) \
+                    if hasattr(self._store, "create_for_receive") else None
+                if created is not None:
+                    buf, commit, abort = created
+                    try:
+                        buf[:size] = src
+                    except BaseException:
+                        abort()
+                        raise
+                    commit()
+                    result = ("landed", size)
+                else:
+                    result = ("bytes", bytearray(src))
+            finally:
+                self._release_budget(size)
         finally:
-            self._release_budget(size)
+            unref()
         with self._lock:
             self.stats["handoffs"] += 1
             self.stats["handoff_bytes"] += size

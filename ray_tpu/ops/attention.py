@@ -36,7 +36,7 @@ def _block_sizes(seq_len: int, block: int):
 
 
 def _splash_kernel(seq_len: int, n_heads: int, block_q: int, block_kv: int,
-                   fused_bwd: bool):
+                   fused_bwd: bool, causal: bool = True):
     # NOT cached: the kernel object built during one jit trace captures that
     # trace's context — reusing it from a later trace raises
     # UnexpectedTracerError.  Construction is cheap (lazy mask, no arrays).
@@ -45,8 +45,12 @@ def _splash_kernel(seq_len: int, n_heads: int, block_q: int, block_kv: int,
         splash_attention_mask as sm,
     )
 
+    import jax
+
+    mask_cls = sm.CausalMask if causal else sm.FullMask
     mask = sm.MultiHeadMask(
-        [sm.CausalMask((seq_len, seq_len)) for _ in range(n_heads)])
+        [mask_cls((seq_len, seq_len)) for _ in range(n_heads)])
+    interpret = jax.default_backend() != "tpu"
     bq = min(block_q, seq_len)
     bkv = min(block_kv, seq_len)
     bs = sk.BlockSizes(
@@ -57,27 +61,26 @@ def _splash_kernel(seq_len: int, n_heads: int, block_q: int, block_kv: int,
         use_fused_bwd_kernel=fused_bwd,
     )
     return sk.make_splash_mha(mask, head_shards=1, q_seq_shards=1,
-                              block_sizes=bs)
+                              block_sizes=bs, interpret=interpret)
 
 
 def splash_attention(q, k, v, causal: bool = True,
                      sm_scale: Optional[float] = None,
                      block_q: int = 512, block_kv: int = 512,
                      fused_bwd: bool = True):
-    """Production TPU causal attention (splash kernel): sparse over the
-    causal mask (no wasted upper-triangle work, unlike the stock flash
-    kernel) with a fused dq/dkv backward.
+    """Production TPU attention (splash kernel): sparse over the causal
+    mask when causal (no wasted upper-triangle work, unlike the stock flash
+    kernel), full-mask bidirectional (ViT-style) otherwise, with a fused
+    dq/dkv backward.
 
     q, k, v: (B, S, H, head_dim) — the model's native layout.
     """
     import jax
 
-    if not causal:
-        raise NotImplementedError("splash path is causal-only")
     B, S, H, hd = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(hd)
-    kernel = _splash_kernel(S, H, block_q, block_kv, fused_bwd)
+    kernel = _splash_kernel(S, H, block_q, block_kv, fused_bwd, causal)
     # Splash takes (H, S, hd) per example; scale q up front (no scale arg).
     qt = (q * sm_scale).transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
