@@ -206,30 +206,69 @@ def runtime_snapshot(runtime) -> dict:
     return snap
 
 
+# Node-detail fetches are bounded by a semaphore (max 8 concurrent daemon
+# threads, cluster-wide) with a short-TTL cache per runtime: the dashboard
+# page auto-refreshes every 5 s per viewer, and a wedged node's info_req
+# blocks ~3 s — a thread per node per request accumulated threads under
+# concurrent viewers on large clusters.  Daemon threads (not a pool) so
+# wedged fetches never block interpreter exit nor queue unboundedly: when
+# all 8 slots are taken a node's detail is simply omitted this round.
+import threading as _snap_threading
+import weakref as _snap_weakref
+
+_SNAP_BUDGET = _snap_threading.Semaphore(8)
+_SNAP_CACHE: "_snap_weakref.WeakKeyDictionary" = \
+    _snap_weakref.WeakKeyDictionary()  # runtime -> (expires, details)
+_SNAP_LOCK = _snap_threading.Lock()
+
+
+def _node_details(runtime, remote) -> dict:
+    import threading as _threading
+    import time as _time
+
+    now = _time.monotonic()
+    with _SNAP_LOCK:
+        ent = _SNAP_CACHE.get(runtime)
+        if ent is not None and ent[0] > now:
+            return ent[1]
+
+    details: dict = {}
+
+    def fetch(nid, rn):
+        try:
+            details[nid] = runtime.node_server.node_info(rn, detail="summary")
+        except Exception as e:  # noqa: BLE001
+            details[nid] = {"error": repr(e)}
+        finally:
+            _SNAP_BUDGET.release()
+
+    threads = []
+    for nid, rn in remote.items():
+        if not _SNAP_BUDGET.acquire(blocking=False):
+            break  # every slot wedged on slow nodes: omit the rest
+        t = _threading.Thread(target=fetch, args=(nid, rn),
+                              name="dash-snap", daemon=True)
+        t.start()
+        threads.append(t)
+    deadline = _time.monotonic() + 5.0
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - _time.monotonic()))
+    with _SNAP_LOCK:
+        _SNAP_CACHE[runtime] = (_time.monotonic() + 2.0, details)
+    return details
+
+
 def cluster_snapshot(runtime, with_details: bool = True) -> dict:
     """Aggregate the whole cluster: the head's scheduler/ledger view joined
     with each node's own agent report (ref: dashboard/head.py:65 — the
     aggregating head the per-runtime REST tier lacked)."""
-    import threading as _threading
     import time as _time
 
     head_id = str(runtime.head_node_id)
     remote = {str(n.node_id): n for n in runtime._remote_nodes_snapshot()}
     details: dict = {}
     if with_details and runtime.node_server is not None and remote:
-        def fetch(nid, rn):
-            try:
-                details[nid] = runtime.node_server.node_info(
-                    rn, detail="summary")
-            except Exception as e:  # noqa: BLE001
-                details[nid] = {"error": repr(e)}
-
-        threads = [_threading.Thread(target=fetch, args=item, daemon=True)
-                   for item in remote.items()]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join(timeout=5)
+        details = _node_details(runtime, remote)
     per_node = []
     for n in runtime.scheduler.nodes():
         nid = str(n.id)

@@ -78,12 +78,24 @@ class WandbLoggerCallback:
                 # reinit="create_new" returns an INDEPENDENT Run object per
                 # trial (log/finish on the object, never the module) — the
                 # concurrent-trials pattern; plain reinit=True would finish
-                # the previous trial's run on every new start.
-                run = wandb.init(project=self.project, group=self.group,
-                                 id=trial.trial_id, name=str(trial),
-                                 config=dict(trial.config or {}),
-                                 reinit="create_new", dir=self.dir,
-                                 **self.init_kwargs)
+                # the previous trial's run on every new start.  Older wandb
+                # releases reject the string value: fall back rather than
+                # kill the experiment from inside a logger.
+                kw = dict(project=self.project, group=self.group,
+                          id=trial.trial_id, name=str(trial),
+                          config=dict(trial.config or {}), dir=self.dir,
+                          **self.init_kwargs)
+                try:
+                    run = wandb.init(reinit="create_new", **kw)
+                except TypeError:
+                    run = wandb.init(reinit=True, **kw)
+                except ValueError as e:
+                    # Only the reinit-value rejection falls back: a config
+                    # ValueError re-raised here must not trigger a second
+                    # init (reinit=True finishes the previous trial's run).
+                    if "reinit" not in str(e).lower():
+                        raise
+                    run = wandb.init(reinit=True, **kw)
             else:
                 base = self.dir or getattr(trial, "logdir", None) or "."
                 run = _OfflineRun(os.path.join(base, "wandb_offline"),

@@ -141,6 +141,28 @@ def read_numpy(paths) -> Dataset:
     return Dataset(Read([make_task(f) for f in files]))
 
 
+def _looks_like_tfrecord(path: str) -> bool:
+    """Cheap framing sanity check: the first 12-byte header's masked
+    length-crc must verify (ref framing in data/tfrecords.py).  A 0-byte
+    file is a valid EMPTY TFRecord shard (partitioned writers emit them)."""
+    import struct
+
+    from ray_tpu.data.tfrecords import _masked_crc
+
+    try:
+        with open(path, "rb") as f:
+            header = f.read(12)
+    except OSError:
+        return False
+    if len(header) == 0:
+        return True
+    if len(header) < 12:
+        return False
+    (length,) = struct.unpack("<Q", header[:8])
+    (len_crc,) = struct.unpack("<I", header[8:])
+    return _masked_crc(header[:8]) == len_crc and length < (1 << 40)
+
+
 def read_tfrecords(paths) -> Dataset:
     """tf.train.Example TFRecord files -> one row per example (ref:
     read_api.py read_tfrecords; framing + protos in data/tfrecords.py,
@@ -156,9 +178,31 @@ def read_tfrecords(paths) -> Dataset:
                 for f in _glob.glob(os.path.join(p, f"*{suffix}"))
                 if os.path.isfile(f))
             if not matched:
-                matched = sorted(
+                # Extensionless TF shard names: accept only files whose
+                # first record header frames correctly — a stray README or
+                # _SUCCESS marker otherwise surfaces later as a confusing
+                # 'corrupt TFRecord length crc'.
+                candidates = sorted(
                     os.path.join(p, f) for f in os.listdir(p)
                     if os.path.isfile(os.path.join(p, f)))
+                matched = [f for f in candidates if _looks_like_tfrecord(f)]
+                if candidates and not matched:
+                    raise FileNotFoundError(
+                        f"No *.tfrecord(s) files in {p} and none of its "
+                        f"{len(candidates)} files frame as TFRecords "
+                        f"(checked first-record length crc)")
+                skipped = sorted(set(candidates) - set(matched))
+                if skipped:
+                    # Surface the skips: a junk marker (_SUCCESS/README) is
+                    # expected, but a CORRUPT shard silently dropped here
+                    # would be silent data loss.
+                    import warnings
+
+                    warnings.warn(
+                        f"read_tfrecords: skipping {len(skipped)} file(s) in "
+                        f"{p} that don't frame as TFRecords: "
+                        f"{[os.path.basename(s) for s in skipped[:5]]}",
+                        RuntimeWarning, stacklevel=2)
             files.extend(matched)
         else:
             files.extend(_expand_paths(p, ".tfrecords"))

@@ -87,6 +87,16 @@ class MARWIL(BC):
 
     def setup(self, config) -> None:
         super().setup(config)
+        if getattr(self.offline, "is_streaming", False):
+            # Whole-dataset returns-to-go needs every episode in memory; a
+            # streaming window can't provide that.  Precomputed returns
+            # stream through sample() fine.
+            if not self.offline.has_column("returns"):
+                raise ValueError(
+                    "MARWIL on a streaming OfflineData needs a precomputed "
+                    "'returns' column (returns-to-go derivation requires the "
+                    "full dataset in memory; use streaming=False)")
+            return
         cols = self.offline.columns
         if "returns" not in cols:
             if Columns.REWARDS not in cols:

@@ -89,23 +89,60 @@ class EnvRunnerGroup:
             ray_tpu.get(refs)
             return
         # Double-buffered: hold this broadcast's refs and settle the
-        # PREVIOUS one (surely done by now — mailbox order), so dropped
+        # PREVIOUS one (usually done by now — mailbox order), so dropped
         # refs never race their own result into an unfreeable store entry.
+        # Settling is non-blocking (wait timeout=0): one wedged runner must
+        # not stall the fire-and-forget learner loop; unfinished refs carry
+        # forward with a deadline instead.
+        import time as _time
+
         prev = getattr(self, "_pending_sync", None)
         self._pending_sync = refs
+        pend = getattr(self, "_unsettled", None)
+        if pend is None:
+            pend = self._unsettled = []
+            self.sync_failures = 0
         if prev:
-            self._settle_sync(prev)
+            pend.extend((r, _time.monotonic() + 10.0) for r in prev)
+        self._sweep_unsettled()
 
-    def _settle_sync(self, refs) -> None:
+    def _sweep_unsettled(self) -> None:
         import sys
+        import time as _time
 
-        try:
-            ray_tpu.get(refs, timeout=10)
-        except Exception as e:  # noqa: BLE001
-            # A runner that can't apply weights samples with STALE params
-            # forever — say so instead of silently eating it.
-            print(f"[env_runner_group] weight broadcast failed: {e!r}",
-                  file=sys.stderr, flush=True)
+        still = []
+        failed = 0
+        for ref, deadline in self._unsettled:
+            done, _ = ray_tpu.wait([ref], timeout=0)
+            if done:
+                try:
+                    ray_tpu.get(ref)
+                except Exception as e:  # noqa: BLE001
+                    failed += 1
+                    print(f"[env_runner_group] weight broadcast failed: "
+                          f"{e!r}", file=sys.stderr, flush=True)
+            elif _time.monotonic() > deadline:
+                # A runner that can't apply weights samples with STALE
+                # params forever — surface it instead of silently eating it.
+                failed += 1
+                print("[env_runner_group] weight broadcast unacknowledged "
+                      "for 10s (wedged runner?)", file=sys.stderr, flush=True)
+            else:
+                still.append((ref, deadline))
+        self._unsettled = still
+        if failed:
+            self.sync_failures += failed
+            if self.sync_failures >= 3 * max(1, len(self._remote_runners)):
+                raise RuntimeError(
+                    f"{self.sync_failures} weight broadcasts failed or went "
+                    "unacknowledged: runners are sampling with stale params "
+                    "(see stderr for per-runner causes)")
+        else:
+            # Any failure-free sweep resets the consecutive count — refs
+            # merely still in flight (sync interval < settle latency) must
+            # not let rare recovered blips accumulate into a spurious raise
+            # over a multi-day run.
+            self.sync_failures = 0
 
     def foreach_env_runner(self, fn_name: str, *args, **kwargs) -> List[Any]:
         if self._local_runner is not None:

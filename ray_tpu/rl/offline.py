@@ -51,6 +51,12 @@ def record_episodes(episodes, path: str, *, format: str = "parquet") -> str:
     return path
 
 
+class StreamingColumnsError(AttributeError, ValueError):
+    """`columns` was accessed on a streaming OfflineData.  AttributeError
+    ancestry keeps hasattr()/getattr(default) probes working; ValueError
+    ancestry keeps it catchable as the config error it really is."""
+
+
 class OfflineData:
     """Uniformly samples learner batches from a recorded dataset
     (ref: rllib/offline/offline_data.py OfflineData / OfflinePreLearner).
@@ -124,6 +130,29 @@ class OfflineData:
                 yield batch
             if not got_any:
                 raise ValueError("offline dataset is empty")
+
+    @property
+    def is_streaming(self) -> bool:
+        return self._stream is not None
+
+    def has_column(self, name: str) -> bool:
+        return name in (self._window if self._stream is not None
+                        else self.columns)
+
+    def __getattr__(self, name: str):
+        # Only reached when normal lookup fails — i.e. streaming mode, where
+        # `columns` is never materialized.  Algorithms that derive returns
+        # over the whole dataset (MARWIL) would otherwise die with an opaque
+        # AttributeError deep in setup.  The error subclasses AttributeError
+        # so hasattr()/getattr(..., default) feature probes keep working.
+        if name == "columns":
+            raise StreamingColumnsError(
+                "this OfflineData is streaming (streaming=True): full-dataset "
+                "columns are never materialized. Algorithms that need whole-"
+                "dataset returns derivation (e.g. MARWIL without a 'returns' "
+                "column) require streaming=False, or precompute 'returns' in "
+                "the dataset.")
+        raise AttributeError(name)
 
     def _remaining(self) -> int:
         if not self._window:

@@ -232,6 +232,15 @@ def test_offline_data_streaming_window(tmp_path):
     # 40*64 = 2560 draws over 2000 rows of a without-replacement window:
     # coverage must be broad (an unshuffled or stuck window would repeat).
     assert len(seen) > 1200, len(seen)
+    # ADVICE r4: columns access on a streaming OfflineData must raise a
+    # descriptive error, not an opaque AttributeError from MARWIL.setup.
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="streaming"):
+        _ = data_stream.columns
+    assert not hasattr(data_stream, "columns")  # probes must keep working
+    assert data_stream.is_streaming
+    assert data_stream.has_column("obs")
+    assert not data_stream.has_column("returns")
 
 
 def test_marwil_beta_zero_is_bc_with_baseline(tmp_path):

@@ -97,6 +97,18 @@ def test_tf_naming_convention_and_extensionless(rt, tmp_path):
     d2.mkdir()
     write_records(str(d2 / "shard-0"), recs)
     assert len(data.read_tfrecords(str(d2)).take_all()) == 5
+    # ADVICE r4: a stray non-TFRecord file (README/_SUCCESS marker) must be
+    # skipped by the extension-less fallback, not fail later with a
+    # confusing length-crc error.
+    (d2 / "_SUCCESS").write_text("")
+    (d2 / "README.md").write_text("this is not a tfrecord\n" * 4)
+    assert len(data.read_tfrecords(str(d2)).take_all()) == 5
+    d3 = tmp_path / "junk_only"
+    d3.mkdir()
+    (d3 / "notes.txt").write_text("nothing here frames as a record")
+    import pytest as _pytest
+    with _pytest.raises(FileNotFoundError, match="frame as TFRecords"):
+        data.read_tfrecords(str(d3))
 
 
 def test_dataset_write_read_roundtrip(rt, tmp_path):
