@@ -10,10 +10,33 @@ in-process/IPC, not cross-datacenter gRPC, so compactness matters less than clar
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 
 _NIL = "f" * 16
+
+# ID generation is on the task-submission hot path (one TaskID per call):
+# os.urandom is a syscall per draw (~13% of the n:n actor fan-out profile).
+# Instead: one urandom draw per process seeds a 4-byte prefix, and a
+# monotonic counter supplies the low 4 bytes — unique within a process by
+# construction, unique across processes by the prefix (same shape as the
+# reference's worker-id + task-counter packing, src/ray/common/id.h).
+_PROC_PREFIX = os.urandom(4).hex()
+_PROC_PID = os.getpid()
+_id_counter = itertools.count(1)
+
+
+def _next_id_hex() -> str:
+    global _PROC_PREFIX, _PROC_PID, _id_counter
+    pid = os.getpid()
+    if pid != _PROC_PID:  # forked child: re-seed so ids can't collide
+        _PROC_PREFIX = os.urandom(4).hex()
+        _PROC_PID = pid
+        _id_counter = itertools.count(1)
+    # No 32-bit mask: past 2^32 draws the hex simply grows a digit (ids are
+    # plain strings) — a wrap would alias a multi-day run's earliest ids.
+    return f"{_PROC_PREFIX}{next(_id_counter):08x}"
 
 
 class BaseID(str):
@@ -23,7 +46,7 @@ class BaseID(str):
 
     @classmethod
     def from_random(cls) -> "BaseID":
-        return cls(os.urandom(8).hex())
+        return cls(_next_id_hex())
 
     @classmethod
     def nil(cls) -> "BaseID":

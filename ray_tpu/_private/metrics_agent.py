@@ -246,15 +246,23 @@ def _node_details(runtime, remote) -> dict:
     for nid, rn in remote.items():
         if not _SNAP_BUDGET.acquire(blocking=False):
             break  # every slot wedged on slow nodes: omit the rest
-        t = _threading.Thread(target=fetch, args=(nid, rn),
-                              name="dash-snap", daemon=True)
-        t.start()
+        try:
+            t = _threading.Thread(target=fetch, args=(nid, rn),
+                                  name="dash-snap", daemon=True)
+            t.start()
+        except RuntimeError:
+            _SNAP_BUDGET.release()  # start failed: fetch's finally never runs
+            break
         threads.append(t)
     deadline = _time.monotonic() + 5.0
     for t in threads:
         t.join(timeout=max(0.0, deadline - _time.monotonic()))
-    with _SNAP_LOCK:
-        _SNAP_CACHE[runtime] = (_time.monotonic() + 2.0, details)
+    if threads:
+        # Never cache a zero-fetch round: a concurrent miss that lost every
+        # semaphore slot must not overwrite a just-cached complete snapshot
+        # with {} for the whole TTL.
+        with _SNAP_LOCK:
+            _SNAP_CACHE[runtime] = (_time.monotonic() + 2.0, details)
     return details
 
 

@@ -57,7 +57,10 @@ class _Entry:
         self.exported = False  # zero-copy views into the arena were handed out
         self.spill_path: Optional[str] = None
         self.size = 0
-        self.event = threading.Event()
+        # Lazy: most objects are put before anyone blocks on them, and a
+        # threading.Event costs two Condition allocations — measurable at
+        # task-throughput rates.  Waiters create it via _wait_entry.
+        self.event: Optional[threading.Event] = None
         self.pinned = 0
         self.last_access = 0.0
         self.owner = ""
@@ -136,6 +139,26 @@ class ObjectStore:
         self._plasma_graveyard: Set[ObjectID] = set()
         self.plasma = _try_plasma(capacity_bytes)
 
+    def _signal(self, entry: _Entry) -> None:
+        """Wake waiters after a state transition (transition made under the
+        lock; the event read here happens-after, so a waiter either saw the
+        new state or had already published its event)."""
+        ev = entry.event
+        if ev is not None:
+            ev.set()
+
+    def _wait_entry(self, entry: _Entry, timeout: Optional[float]) -> bool:
+        """Block until the entry leaves PENDING (True) or timeout (False)."""
+        ev = entry.event
+        if ev is None:
+            with self._lock:
+                if entry.state != ObjectState.PENDING:
+                    return True
+                ev = entry.event
+                if ev is None:
+                    ev = entry.event = threading.Event()
+        return ev.wait(timeout)
+
     @property
     def arena_path(self) -> Optional[str]:
         """Path process workers attach to for zero-copy arg/result handoff."""
@@ -152,7 +175,7 @@ class ObjectStore:
             entry.owner = owner
             entry.last_access = time.monotonic()
             self.stats["puts"] += 1
-        entry.event.set()
+        self._signal(entry)
 
     def put_serialized(self, object_id: ObjectID, flat: bytes, owner: str = "") -> None:
         """Store an object already in wire form (arrived from a process worker)."""
@@ -171,14 +194,14 @@ class ObjectStore:
             entry.state = ObjectState.READY
             entry.owner = owner
             self.stats["puts"] += 1
-        entry.event.set()
+        self._signal(entry)
 
     def put_error(self, object_id: ObjectID, error: BaseException) -> None:
         with self._lock:
             entry = self._entries.setdefault(object_id, _Entry())
             entry.error = error
             entry.state = ObjectState.FAILED
-        entry.event.set()
+        self._signal(entry)
 
     # ------------------------------------------------------------------ gets
     def size_of(self, object_id: ObjectID) -> int:
@@ -200,12 +223,12 @@ class ObjectStore:
 
     def wait_ready(self, object_id: ObjectID, timeout: Optional[float]) -> bool:
         entry = self._ensure(object_id)
-        return entry.event.wait(timeout)
+        return self._wait_entry(entry, timeout)
 
     def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> Any:
         """Blocking get of the deserialized value; raises stored errors."""
         entry = self._ensure(object_id)
-        if not entry.event.wait(timeout):
+        if not self._wait_entry(entry, timeout):
             from ray_tpu.exceptions import GetTimeoutError
 
             raise GetTimeoutError(f"Timed out getting object {object_id}")
@@ -268,7 +291,7 @@ class ObjectStore:
     def get_serialized(self, object_id: ObjectID, timeout: Optional[float] = None) -> memoryview:
         """Wire form for shipping to a process worker (arena-backed, zero-copy)."""
         entry = self._ensure(object_id)
-        if not entry.event.wait(timeout):
+        if not self._wait_entry(entry, timeout):
             from ray_tpu.exceptions import GetTimeoutError
 
             raise GetTimeoutError(f"Timed out getting object {object_id}")
@@ -380,7 +403,7 @@ class ObjectStore:
                 entry.last_access = time.monotonic()
                 entry.backup_flat = None
                 self.stats["puts"] += 1
-            entry.event.set()
+            self._signal(entry)
 
         def abort() -> None:
             try:
@@ -408,7 +431,7 @@ class ObjectStore:
                     self.stats["puts"] += 1
                     promoted = True
             if promoted:
-                entry.event.set()
+                self._signal(entry)
 
         return buf, commit, abort
 

@@ -48,8 +48,7 @@ def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
         from ray_tpu._private import runtime as _rt
 
         rt = _rt.get_runtime()
-        with rt._events_lock:
-            events = list(rt.task_events)
+        events = rt.list_task_events()
 
     out: List[dict] = []
     running: Dict[str, dict] = {}

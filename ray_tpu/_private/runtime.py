@@ -53,7 +53,10 @@ from ray_tpu._private.scheduling import (
     PlacementGroupSchedulingStrategy,
     SchedulingStrategy,
 )
-from ray_tpu._private.task_spec import ActorSpec, TaskSpec
+from ray_tpu._private.task_spec import (ActorSpec, TaskSpec,
+                                        EXEC_FN_METHOD)
+from ray_tpu._private import metrics_agent
+from ray_tpu.util import tracing
 from ray_tpu.exceptions import (
     ActorDiedError,
     ActorUnavailableError,
@@ -145,7 +148,10 @@ class _ActorState:
         self.spec = spec
         self.state = _ActorState.PENDING
         self.instance: Any = None
-        self.mailbox: "queue.Queue" = queue.Queue()
+        # SimpleQueue: C-implemented put/get — roughly half the wakeup cost
+        # of queue.Queue's pure-Python Condition dance on the actor-call
+        # hot path (same FIFO + blocking semantics; we never need join()).
+        self.mailbox: "queue.SimpleQueue" = queue.SimpleQueue()
         self.threads: List[threading.Thread] = []
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.node_id: Optional[NodeID] = None
@@ -355,7 +361,6 @@ class Runtime:
 
         # Task events for the state API (ref: gcs_task_manager.h:86).
         self.task_events: deque = deque(maxlen=self.config.max_task_events)
-        self._events_lock = threading.Lock()
 
         # Execution pool for the thread tier; resource accounting does the
         # real concurrency limiting, this is just a thread cache.
@@ -374,14 +379,14 @@ class Runtime:
 
     # ------------------------------------------------------------------ events
     def _emit_event(self, task_id: TaskID, name: str, state: str, **extra) -> None:
-        with self._events_lock:
-            self.task_events.append(
-                {"task_id": str(task_id), "name": name, "state": state,
-                 "time": time.time(), **extra}
-            )
+        # deque.append is GIL-atomic — no lock on the hot path (3 events per
+        # task at task-throughput rates); list_task_events' list(deque) is
+        # likewise safe against concurrent appends.
+        self.task_events.append(
+            {"task_id": str(task_id), "name": name, "state": state,
+             "time": time.time(), **extra}
+        )
         if state in ("FINISHED", "FAILED"):
-            from ray_tpu._private import metrics_agent
-
             metrics_agent.record_task_finished(state == "FINISHED")
 
     # ------------------------------------------------------------------- puts
@@ -447,8 +452,14 @@ class Runtime:
         return [n.snapshot() for n in self.scheduler.nodes()]
 
     def list_task_events(self) -> List[dict]:
-        with self._events_lock:
-            return list(self.task_events)
+        # Appends are lock-free (see _emit_event); list(deque) can raise if
+        # a GC-triggered thread switch lands an append mid-copy — retry.
+        for _ in range(16):
+            try:
+                return list(self.task_events)
+            except RuntimeError:
+                continue
+        return []
 
     # --------------------------------------------------------- object plane
     def start_object_server(self) -> str:
@@ -983,8 +994,6 @@ class Runtime:
 
     # ---------------------------------------------------------------- submits
     def submit_task(self, spec: TaskSpec) -> Any:
-        from ray_tpu.util import tracing
-
         if tracing.is_tracing_enabled():
             with tracing.span(f"submit::{spec.name}",
                               attributes={"task_id": str(spec.task_id)}):
@@ -1177,8 +1186,6 @@ class Runtime:
         self._running[spec.task_id] = ctx
         _task_ctx.ctx = ctx
         self._emit_event(spec.task_id, spec.name, "RUNNING")
-        from ray_tpu.util import tracing
-
         try:
             with tracing.task_execute_span(spec):
                 if self._chaos:
@@ -1625,14 +1632,10 @@ class Runtime:
         self._running[spec.task_id] = ctx
         _task_ctx.ctx = ctx
         self._emit_event(spec.task_id, spec.name, "RUNNING")
-        from ray_tpu.util import tracing
-
         worker = state.proc_worker
         try:
             with tracing.task_execute_span(spec):
                 args, kwargs = self._resolve_args(spec)
-                from ray_tpu._private.task_spec import EXEC_FN_METHOD
-
                 if spec.method_name == EXEC_FN_METHOD and spec.func is not None:
                     # Shipped-function actor task (compiled-DAG resident
                     # loops): run spec.func against the instance — the
@@ -1707,8 +1710,6 @@ class Runtime:
             self._fail_task(spec, TaskError(e, task_repr=spec.name), retry=False)
 
     def submit_actor_task(self, actor_id: ActorID, spec: TaskSpec) -> Any:
-        from ray_tpu.util import tracing
-
         if tracing.is_tracing_enabled():
             with tracing.span(f"submit::{spec.name}",
                               attributes={"task_id": str(spec.task_id),

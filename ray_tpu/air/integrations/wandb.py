@@ -87,12 +87,10 @@ class WandbLoggerCallback:
                           **self.init_kwargs)
                 try:
                     run = wandb.init(reinit="create_new", **kw)
-                except TypeError:
-                    run = wandb.init(reinit=True, **kw)
-                except ValueError as e:
-                    # Only the reinit-value rejection falls back: a config
-                    # ValueError re-raised here must not trigger a second
-                    # init (reinit=True finishes the previous trial's run).
+                except (TypeError, ValueError) as e:
+                    # Only the reinit rejection falls back: any OTHER config
+                    # error must not trigger a second init (reinit=True
+                    # finishes the previous concurrent trial's run).
                     if "reinit" not in str(e).lower():
                         raise
                     run = wandb.init(reinit=True, **kw)

@@ -40,8 +40,7 @@ def _apply_filters(rows: List[dict], filters: Optional[Sequence[Filter]],
 def _task_table() -> List[dict]:
     """Fold the event log into one row per task attempt (latest state wins)."""
     rt = _runtime()
-    with rt._events_lock:
-        events = list(rt.task_events)
+    events = rt.list_task_events()
     rows: Dict[str, dict] = {}
     for ev in events:
         if ev.get("state", "").startswith("PROFILE"):
