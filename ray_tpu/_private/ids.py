@@ -22,18 +22,22 @@ _NIL = "f" * 16
 # monotonic counter supplies the low 4 bytes — unique within a process by
 # construction, unique across processes by the prefix (same shape as the
 # reference's worker-id + task-counter packing, src/ray/common/id.h).
+# Forked children re-seed via the at-fork hook (single-threaded at that
+# point, so no draw can race the reseed).
 _PROC_PREFIX = os.urandom(4).hex()
-_PROC_PID = os.getpid()
 _id_counter = itertools.count(1)
 
 
+def _reseed_after_fork() -> None:
+    global _PROC_PREFIX, _id_counter
+    _PROC_PREFIX = os.urandom(4).hex()
+    _id_counter = itertools.count(1)
+
+
+os.register_at_fork(after_in_child=_reseed_after_fork)
+
+
 def _next_id_hex() -> str:
-    global _PROC_PREFIX, _PROC_PID, _id_counter
-    pid = os.getpid()
-    if pid != _PROC_PID:  # forked child: re-seed so ids can't collide
-        _PROC_PREFIX = os.urandom(4).hex()
-        _PROC_PID = pid
-        _id_counter = itertools.count(1)
     # No 32-bit mask: past 2^32 draws the hex simply grows a digit (ids are
     # plain strings) — a wrap would alias a multi-day run's earliest ids.
     return f"{_PROC_PREFIX}{next(_id_counter):08x}"

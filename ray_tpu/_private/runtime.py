@@ -453,13 +453,17 @@ class Runtime:
 
     def list_task_events(self) -> List[dict]:
         # Appends are lock-free (see _emit_event); list(deque) can raise if
-        # a GC-triggered thread switch lands an append mid-copy — retry.
-        for _ in range(16):
+        # a GC-triggered thread switch lands an append mid-copy — retry,
+        # backing off so the appenders drain.  Never fabricate emptiness:
+        # an operator debugging an overload must not see zero tasks.
+        for attempt in range(64):
             try:
                 return list(self.task_events)
             except RuntimeError:
-                continue
-        return []
+                if attempt > 8:
+                    time.sleep(0.001)
+        raise RuntimeError(
+            "task-event snapshot kept colliding with concurrent appends")
 
     # --------------------------------------------------------- object plane
     def start_object_server(self) -> str:

@@ -10,19 +10,22 @@ Compact JAX redesign, same architecture spine, deliberate reductions
 
 * RSSM with categorical latents (S groups x C classes), straight-through
   gradients, 1% unimix; GRU deterministic path.
-* World-model loss: symlog-MSE reconstruction + reward, Bernoulli
-  continue, KL balancing (beta_dyn 0.5 / beta_rep 0.1) with 1-nat free
-  bits.  The reference's twohot reward/critic targets are replaced by
-  symlog MSE (simpler, close in practice at these scales).
+* World-model loss: symlog-MSE reconstruction, TWOHOT symlog
+  distributional reward head (ref: tf/dreamerv3_tf_learner.py:398-405 +
+  reward_predictor_layer.py — 255 buckets over symlog [-20, 20],
+  zero-initialized output layer), Bernoulli continue, KL balancing
+  (beta_dyn 0.5 / beta_rep 0.1) with 1-nat free bits.
 * Actor-critic on imagined rollouts: lambda-returns (gamma 0.997,
-  lambda 0.95), critic regressed to sg(lambda-return) with a slow EMA
-  target for bootstrapping, REINFORCE actor with return-range
-  normalization (EMA of the 5th-95th percentile span) and entropy bonus.
+  lambda 0.95), TWOHOT distributional critic (cross-entropy to the
+  twohot-encoded symlog lambda-return) with a slow EMA target for
+  bootstrapping, REINFORCE actor with return-range normalization (EMA
+  of the 5th-95th percentile span) and entropy bonus.
 * Vector observations use an MLP encoder; PIXEL observations
   (``config.obs_shape=(H, W, C)``) route through the shared conv stack
-  (core/rl_module.py) with the DreamerV3 [-0.5, 0.5] scaling.  The
-  decoder is an MLP over flattened pixels — adequate at gridworld
-  scales, a documented reduction from the reference's deconv tower.
+  (core/rl_module.py) with the DreamerV3 [-0.5, 0.5] scaling, and decode
+  through a ConvTranspose tower mirroring the encoder (ref:
+  tf/models/components/conv_transpose_atari.py:25) whenever the conv
+  stack inverts exactly; otherwise an MLP decoder with a warning.
 * Single local env loop — DreamerV3's replay/train ratio makes the model
   updates, not env stepping, the budget.
 """
@@ -61,11 +64,17 @@ class DreamerV3Config(AlgorithmConfig):
         self.entropy_coeff = 3e-3
         self.critic_ema = 0.98
         self.unimix = 0.01
+        #: Twohot symlog distributional reward/value heads (ref:
+        #: reward_predictor_layer.py — K buckets spanning symlog
+        #: [-20, 20] covers env rewards/returns up to ±400M).
+        self.num_buckets = 255
+        self.bucket_low = -20.0
+        self.bucket_high = 20.0
         #: (H, W, C) to run the conv encoder on PIXEL observations (ref:
         #: the reference's CNN encoder tier; None = vector obs, MLP
-        #: encoder).  The decoder stays an MLP over flattened pixels —
-        #: adequate at gridworld scales, a documented reduction from the
-        #: reference's deconv tower.
+        #: encoder).  Pixel decoding mirrors the encoder through a
+        #: ConvTranspose tower (ref: conv_transpose_atari.py:25) whenever
+        #: the conv stack inverts exactly; an MLP decoder is the fallback.
         self.obs_shape = None
         self.conv_filters = ((16, 4, 2), (32, 3, 1))
         self.env_steps_per_iteration = 200
@@ -81,6 +90,46 @@ def symlog(x):
 
 def symexp(x):
     return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+# ------------------------------------------------- twohot symlog heads
+# (ref: rllib/algorithms/dreamerv3/tf/dreamerv3_tf_learner.py:398-405 —
+# reward/value are DISTRIBUTIONS over linearly-spaced symlog-space
+# buckets, not scalar regressions: the twohot cross-entropy is
+# scale-robust and its gradient does not vanish for rare large returns.)
+def _buckets(num: int, lo: float, hi: float):
+    return jnp.linspace(lo, hi, num)
+
+
+def twohot(x, buckets):
+    """Twohot encoding of symlog-space targets over `buckets` (K,): the
+    probability mass splits linearly between the two nearest buckets."""
+    K = buckets.shape[0]
+    x = jnp.clip(x, buckets[0], buckets[-1])
+    k1 = jnp.clip(jnp.searchsorted(buckets, x), 1, K - 1)
+    k0 = k1 - 1
+    b0 = buckets[k0]
+    b1 = buckets[k1]
+    w1 = (x - b0) / jnp.maximum(b1 - b0, 1e-8)
+    w0 = 1.0 - w1
+    out = (jax.nn.one_hot(k0, K) * w0[..., None]
+           + jax.nn.one_hot(k1, K) * w1[..., None])
+    return out
+
+
+def _head_mean(logits, buckets):
+    """symexp(E[bucket]) of a twohot head: the expectation is taken in
+    SYMLOG space over the linearly-spaced buckets, then inverse-symlog'd —
+    exactly the reference's decode (reward_predictor_layer.py computes
+    sum(probs * linspace) and dreamer_model.py applies inverse_symlog)."""
+    probs = jax.nn.softmax(logits, -1)
+    return symexp(jnp.sum(probs * buckets, -1))
+
+
+def _head_loss(logits, target_raw, buckets):
+    """Cross-entropy of twohot(symlog(target)) under the head's logits."""
+    tgt = twohot(symlog(target_raw), buckets)
+    return -jnp.sum(tgt * jax.nn.log_softmax(logits, -1), -1)
 
 
 def _mlp_params(key, sizes: List[int]) -> List[Dict[str, Any]]:
@@ -102,6 +151,54 @@ def _mlp(params: List[Dict[str, Any]], x, final_act=None):
             x = jax.nn.silu(x)
         elif final_act is not None:
             x = final_act(x)
+    return x
+
+
+def _zero_final(layers: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Zero the last layer: randomly initialized reward/critic heads emit
+    large early predictions that delay learning (Hafner et al. 2023; ref:
+    reward_predictor_layer.py kernel_initializer='zeros')."""
+    layers[-1]["w"] = jnp.zeros_like(layers[-1]["w"])
+    return layers
+
+
+def _deconv_invertible(obs_shape, conv_filters) -> bool:
+    """A VALID conv stack mirrors exactly through conv_transpose only when
+    no layer's floor-division drops rows ((in - k) % s == 0 throughout)."""
+    h, w, _ = obs_shape
+    for _out_c, k, s in conv_filters:
+        if (h - k) % s or (w - k) % s:
+            return False
+        h = (h - k) // s + 1
+        w = (w - k) // s + 1
+    return True
+
+
+def _deconv_init(key, obs_shape, conv_filters, init_fn) -> list:
+    """Transposed mirror of conv_stack_init: layer i maps encoder layer
+    -(i+1)'s output channels back to its input channels (ref:
+    conv_transpose_atari.py:25 — the ConvTranspose tower)."""
+    chain = [obs_shape[-1]] + [f[0] for f in conv_filters]
+    deconvs = []
+    for i in range(len(conv_filters) - 1, -1, -1):
+        _out_c, k, _s = conv_filters[i]
+        key, sub = jax.random.split(key)
+        deconvs.append({"w": init_fn(sub, (k, k, chain[i + 1], chain[i])),
+                        "b": jnp.zeros((chain[i],), jnp.float32)})
+    return deconvs
+
+
+def _deconv_apply(deconvs, conv_filters, x, act):
+    """NHWC VALID conv_transpose stack; final layer linear (predicts pixels
+    in the [-0.5, 0.5] preprocessing space)."""
+    n = len(deconvs)
+    for j, layer in enumerate(deconvs):
+        _out_c, k, s = conv_filters[n - 1 - j]
+        x = jax.lax.conv_transpose(
+            x, layer["w"], strides=(s, s), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + layer["b"]
+        if j < n - 1:
+            x = act(x)
     return x
 
 
@@ -152,6 +249,18 @@ class DreamerV3(Algorithm):
             raise ValueError(
                 f"obs_shape {tuple(cfg.obs_shape)} does not match the "
                 f"env's observation shape {env_shape}")
+        self._deconv = self._pixel and _deconv_invertible(cfg.obs_shape,
+                                                          cfg.conv_filters)
+        if self._pixel and not self._deconv:
+            import warnings
+
+            warnings.warn(
+                "DreamerV3: conv_filters do not invert exactly on "
+                f"obs_shape {tuple(cfg.obs_shape)} ((in-k) % s != 0 at some "
+                "layer); pixel decoder falls back to an MLP",
+                RuntimeWarning, stacklevel=2)
+        self._head_buckets = _buckets(cfg.num_buckets, cfg.bucket_low,
+                                      cfg.bucket_high)
         self._rng = np.random.default_rng(cfg.seed)
         self._key = jax.random.key(cfg.seed)
         self._params = self._init_params()
@@ -200,8 +309,18 @@ class DreamerV3(Algorithm):
             encoder: Any = {"convs": convs,
                             "torso": _mlp_params(next(k),
                                                  [ch * cw * cc, H])}
+            if self._deconv:
+                decoder: Any = {
+                    "torso": _mlp_params(next(k), [feat, ch * cw * cc]),
+                    "deconvs": _deconv_init(next(k), cfg.obs_shape,
+                                            cfg.conv_filters, init_kernel),
+                }
+            else:
+                decoder = _mlp_params(next(k), [feat, H, O])
         else:
             encoder = _mlp_params(next(k), [O, H, H])
+            decoder = _mlp_params(next(k), [feat, H, O])
+        K = cfg.num_buckets
         return {
             "encoder": encoder,
             "gru_in": _mlp_params(next(k), [Z + A, D]),
@@ -210,11 +329,11 @@ class DreamerV3(Algorithm):
                     "b": jnp.zeros(3 * D)},
             "prior": _mlp_params(next(k), [D, H, Z]),
             "post": _mlp_params(next(k), [D + H, H, Z]),
-            "decoder": _mlp_params(next(k), [feat, H, O]),
-            "reward": _mlp_params(next(k), [feat, H, 1]),
+            "decoder": decoder,
+            "reward": _zero_final(_mlp_params(next(k), [feat, H, K])),
             "cont": _mlp_params(next(k), [feat, H, 1]),
             "actor": _mlp_params(next(k), [feat, H, A]),
-            "critic": _mlp_params(next(k), [feat, H, 1]),
+            "critic": _zero_final(_mlp_params(next(k), [feat, H, K])),
         }
 
     # --------------------------------------------------------- RSSM core
@@ -238,6 +357,24 @@ class DreamerV3(Algorithm):
         x = conv_stack_apply(enc["convs"], cfg.conv_filters, x, jax.nn.silu)
         x = _mlp(enc["torso"], x, final_act=jax.nn.silu)
         return x.reshape((*lead, x.shape[-1]))
+
+    def _decode(self, params, feat):
+        """feat (..., F) -> reconstruction in preprocessing space, flat
+        (..., O).  Pixels run the ConvTranspose mirror of the encoder when
+        it inverts exactly; everything else the MLP decoder."""
+        dec = params["decoder"]
+        if not self._deconv:
+            return _mlp(dec, feat)
+        from ray_tpu.rl.core.rl_module import conv_out_dim
+
+        cfg = self.algo_config
+        ch, cw, cc = conv_out_dim(cfg.obs_shape, cfg.conv_filters)
+        lead = feat.shape[:-1]
+        x = _mlp(dec["torso"], feat.reshape((-1, feat.shape[-1])),
+                 final_act=jax.nn.silu)
+        x = x.reshape((-1, ch, cw, cc))
+        x = _deconv_apply(dec["deconvs"], cfg.conv_filters, x, jax.nn.silu)
+        return x.reshape((*lead, self._obs_dim))
 
     def _gru(self, params, x, h):
         gates = jnp.concatenate([x, h], -1) @ params["gru"]["w"] \
@@ -310,15 +447,16 @@ class DreamerV3(Algorithm):
                 step, (h0, z0), (a_prev, e_tm, keys, firsts))
             feat = jnp.concatenate([hs, zs], -1)    # (T, B, feat)
 
-            recon = _mlp(wm_params["decoder"], feat)
-            rew = _mlp(wm_params["reward"], feat)[..., 0]
+            recon = self._decode(wm_params, feat)
+            rew_logits = _mlp(wm_params["reward"], feat)   # (T, B, K)
             cont_logit = _mlp(wm_params["cont"], feat)[..., 0]
             obs_tm = jnp.transpose(obs, (1, 0, 2))
-            rew_tm = symlog(jnp.transpose(batch["rewards"], (1, 0)))
+            rew_tm = jnp.transpose(batch["rewards"], (1, 0))
             cont_tm = jnp.transpose(1.0 - batch["terminateds"], (1, 0))
 
             recon_loss = jnp.mean(jnp.sum((recon - obs_tm) ** 2, -1))
-            reward_loss = jnp.mean((rew - rew_tm) ** 2)
+            reward_loss = jnp.mean(
+                _head_loss(rew_logits, rew_tm, self._head_buckets))
             cont_loss = jnp.mean(
                 optax.sigmoid_binary_cross_entropy(cont_logit, cont_tm))
             dyn = _kl_categorical(jax.lax.stop_gradient(posts), priors)
@@ -371,10 +509,11 @@ class DreamerV3(Algorithm):
         def loss_fn(ac_params, wm_params, target_critic, feat0, key,
                     retnorm):
             feats, acts = imagine(wm_params, ac_params["actor"], feat0, key)
-            rew = symexp(_mlp(wm_params["reward"], feats)[..., 0])
+            bk = self._head_buckets
+            rew = _head_mean(_mlp(wm_params["reward"], feats), bk)
             cont = jax.nn.sigmoid(_mlp(wm_params["cont"], feats)[..., 0])
             disc = cfg.gamma * cont
-            v_target = symexp(_mlp(target_critic, feats)[..., 0])
+            v_target = _head_mean(_mlp(target_critic, feats), bk)
 
             def lam_step(nxt, t_in):
                 r_t, d_t, v_next = t_in
@@ -388,14 +527,15 @@ class DreamerV3(Algorithm):
                 (rew, disc, v_next), reverse=True)
             returns = jax.lax.stop_gradient(returns)      # (H, B)
 
-            v_pred = _mlp(ac_params["critic"], feats)[..., 0]
-            critic_loss = jnp.mean((v_pred - symlog(returns)) ** 2)
+            v_logits = _mlp(ac_params["critic"], feats)
+            critic_loss = jnp.mean(_head_loss(v_logits, returns, bk))
+            v_mean = _head_mean(jax.lax.stop_gradient(v_logits), bk)
 
             logits = _mlp(ac_params["actor"], feats)
             logp = jax.nn.log_softmax(logits, -1)
             act_logp = jnp.take_along_axis(
                 logp, acts[..., None], -1)[..., 0]
-            adv = (returns - symexp(jax.lax.stop_gradient(v_pred))) / retnorm
+            adv = (returns - v_mean) / retnorm
             # Trajectory discount weights so late imagined steps (past
             # predicted termination) contribute less.
             weights = jax.lax.stop_gradient(jnp.cumprod(

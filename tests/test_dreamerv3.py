@@ -64,14 +64,18 @@ def test_world_model_losses_decrease():
     for _ in range(30):
         r = algo.training_step()["learners"]
         if r:
-            history.append(r["recon_loss"] + r["reward_loss"])
+            history.append((r["recon_loss"], r["reward_loss"]))
     assert len(history) >= 20
-    first = np.mean(history[:3])
-    last = np.mean(history[-3:])
-    # Symlog-MSE starts small on this env; a sustained ~30%+ drop is the
-    # fitting signal (the learning gate below is the strong check — the
-    # actor can only succeed through accurate imagined dynamics).
-    assert last < first * 0.75, (first, last)
+    recon_first = np.mean([h[0] for h in history[:3]])
+    recon_last = np.mean([h[0] for h in history[-3:]])
+    rew_first = np.mean([h[1] for h in history[:3]])
+    rew_last = np.mean([h[1] for h in history[-3:]])
+    # Symlog-MSE recon starts small on this env; a sustained ~30%+ drop is
+    # the fitting signal.  The twohot reward head starts at the uniform
+    # log(K) ~ 5.5 nats (zero-init output layer) and must shed a solid
+    # margin in 30 updates (the learning gate below is the strong check).
+    assert recon_last < recon_first * 0.75, (recon_first, recon_last)
+    assert rew_last < rew_first - 0.25, (rew_first, rew_last)
     algo.stop()
 
 
@@ -87,13 +91,18 @@ def test_dreamerv3_pixel_conv_encoder():
     config = (DreamerV3Config()
               .environment(make_env)
               .training(obs_shape=(8, 8, 3),
-                        conv_filters=((8, 3, 2), (16, 3, 1)),
+                        conv_filters=((8, 2, 2), (16, 2, 1)),
                         deter_dim=64, hidden=64, stoch_groups=4,
                         stoch_classes=4, batch_size=4, batch_length=8,
                         env_steps_per_iteration=120,
-                        updates_per_iteration=2, min_buffer_steps=120)
+                        updates_per_iteration=5, min_buffer_steps=120)
               .debugging(seed=0))
     algo = config.build_algo()
+    # (8,2,2)/(2,1) inverts exactly (and keeps a 3x3 spatial bottleneck):
+    # the decoder must be the ConvTranspose tower, not the MLP fallback
+    # (ref: conv_transpose_atari.py:25).
+    assert algo._deconv
+    assert "deconvs" in algo._params["decoder"]
     history = []
     for _ in range(10):
         r = algo.training_step()["learners"]
