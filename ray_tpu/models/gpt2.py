@@ -45,6 +45,11 @@ class GPTConfig:
     #: logits tensor (3.3 GB for GPT-2-small at B=16) never hits HBM in
     #: either pass.  0 = single unchunked einsum.
     loss_chunk: int = 0
+    #: LM-head loss implementation: "auto" flips to the fused pallas CE
+    #: kernel (ops/fused_ce.py — logits never in HBM) when its roofline
+    #: cost model predicts a win (small d_model / large-vocab regime;
+    #: D=768 stays on the dense/chunked path), "fused"/"dense" force it.
+    loss_impl: str = "auto"
     #: Dtype the (B, S, V) logits MATERIALIZE in.  bf16 halves the step's
     #: single biggest HBM tensor (fwd logits + bwd dlogits, ~1.6 GB each at
     #: B=16 fp32) for ~+1 MFU point on v5e; the loss reductions (logsumexp /
@@ -408,6 +413,23 @@ def loss_fn(params, tokens, targets, config: GPTConfig):
     wte = params["wte"].astype(config.dtype)
     B, S, D = x.shape
     C = config.loss_chunk
+    impl = config.loss_impl
+    if impl not in ("auto", "fused", "dense"):
+        raise ValueError(f"loss_impl must be auto|fused|dense, got {impl!r}")
+    if impl == "auto":
+        from ray_tpu.ops.fused_ce import fused_ce_wins
+
+        # TPU-only flip (same gating as attn_impl): the roofline constants
+        # are v5e's, and interpret-mode pallas off-TPU would be a silent
+        # orders-of-magnitude slowdown.
+        import jax as _jax
+
+        impl = "fused" if (_jax.default_backend() == "tpu" and fused_ce_wins(
+            D, jnp.dtype(config.logits_dtype).itemsize)) else "dense"
+    if impl == "fused":
+        from ray_tpu.ops.fused_ce import fused_lm_head_ce
+
+        return fused_lm_head_ce(x, wte, targets)
     if not C or C >= S:
         logits = jnp.einsum("bsd,vd->bsv", x, wte,
                             preferred_element_type=config.logits_dtype)

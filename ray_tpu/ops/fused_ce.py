@@ -8,15 +8,22 @@ blocks through an online logsumexp (same trick flash attention plays over
 keys), and the backward recomputes each logits tile to form
 `softmax - onehot` on the fly.
 
-Cost model (why this is a FLAG, not the default, for GPT-2-small): the
-fully-fused backward recomputes logits twice (once per dx / dW pass), an
-extra 4·N·D·V FLOPs.  At d_model=768 the head matmul runs at ~50% of peak
-(PERF.md), so for GPT-2-small the recompute (~25 ms) exceeds the ~8 ms of
-HBM traffic it saves — the dense bf16-logits path stays the default there.
-The fusion WINS when V/D is large or HBM is the binding constraint (long
-sequences, small heads, memory-limited configs); `bwd_impl="xla"` gives a
-middle point (fused forward, one XLA recompute + materialized dlogits in
-the backward).  All three paths are equivalence-tested.
+Cost model (why this is auto-gated, not the default, for GPT-2-small):
+the fully-fused backward recomputes logits twice (once per dx / dW pass),
+so the fused step runs 5 head-matmul passes against dense's 3 — and XLA
+overlaps dense's logits HBM traffic with those matmuls, so the traffic is
+only the binding cost when it EXCEEDS the matmul time.  Measured on v5e
+(BENCH_FUSED_CE.json): at GPT-2-small's D=768 dense wins outright
+(fused 0.48x); at D=128/V=64k the fusion wins 1.81x against dense-fp32
+(exact softmax, traffic-bound) and 1.39x even against dense-bf16; and
+when the logits tensor cannot materialize at all (64k tokens x 128k
+vocab) the fusion is the only path that runs.  The cost model keeps a
+conservative bf16 boundary (~D<120) — the D=128/bf16 row shows a
+measured win just past it, deliberately left on dense by `auto`.
+`fused_ce_wins` is this model made executable; models/gpt2.py's
+loss_impl="auto" flips on it.  `bwd_impl="xla"` gives a middle point
+(fused forward, one XLA recompute + materialized dlogits in the
+backward).  All paths are equivalence-tested.
 
 Ref: the reference has no analogue (torch materializes logits and calls
 cross_entropy); this is a TPU-roofline-driven design, same family as
@@ -181,9 +188,23 @@ def _fused_ce(x2, w, t2, block_rows: int, bwd_impl: str):
 
 
 def _blocks(x2, w, block_rows: int) -> Tuple[int, int]:
-    bn = _pick_block(x2.shape[0], (block_rows, 512, 256, 128, 64, 32, 16, 8))
-    bv = _pick_block(w.shape[0], (512, 256, 128, 64, 32, 16, 8))
-    return bn, bv
+    # Largest legal (bn, bv) under a ~6 MiB working-set budget: the fp32
+    # logits tile (bn*bv) plus the x/w tiles ((bn+bv)*d).  (1024, 1024)
+    # measured fastest on v5e at d<=256; at d=512 that pair overflows VMEM
+    # at compile (r5 sweep) and the budget steps bv down to 512.
+    n, d = x2.shape
+    v = w.shape[0]
+    budget = 6 << 20
+    for bn in (block_rows, 1024, 512, 256, 128, 64, 32, 16, 8):
+        if bn > n or n % bn:
+            continue
+        for bv in (1024, 512, 256, 128, 64, 32, 16, 8):
+            if bv > v or v % bv:
+                continue
+            if bn * bv * 4 + (bn + bv) * d * 4 <= budget:
+                return bn, bv
+    return (_pick_block(n, (128, 64, 32, 16, 8)),
+            _pick_block(v, (128, 64, 32, 16, 8)))
 
 
 def _interpret() -> bool:
@@ -225,7 +246,27 @@ def _fused_ce_bwd(block_rows: int, bwd_impl: str, res, g):
 _fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
 
 
-def fused_lm_head_ce(x, wte, targets, block_rows: int = 256,
+def fused_ce_wins(d_model: int, logits_dtype_bytes: int = 2,
+                  matmul_eff: float = 0.5, peak_flops: float = 197e12,
+                  hbm_bw: float = 819e9) -> bool:
+    """Roofline cost model, overlap-aware (measured r5, BENCH_FUSED_CE):
+    XLA overlaps the dense path's logits traffic with its matmuls, so per
+    (token, vocab) element dense costs max(3 matmul passes, ~5
+    bytes-per-logit of HBM) while fused costs 5 matmul passes (fwd + 2x
+    bwd recompute + dx/dW) with zero logits traffic.  Fused therefore
+    wins only when dense is TRAFFIC-bound and D is small enough:
+    ~D<120 for bf16 logits, ~D<240 for fp32 — i.e. the exact-softmax
+    (fp32) regime on small heads (measured 1.81x at D=128/V=64k), plus
+    the absolute win when logits cannot materialize at all.
+    GPT-2-small's D=768 correctly stays dense.  `auto` loss dispatch
+    (models/gpt2.py loss_fn) flips on this."""
+    per_elem = 2.0 * d_model / (matmul_eff * peak_flops)  # one matmul pass
+    dense_s = max(3.0 * per_elem, 5.0 * logits_dtype_bytes / hbm_bw)
+    fused_s = 5.0 * per_elem
+    return fused_s < dense_s
+
+
+def fused_lm_head_ce(x, wte, targets, block_rows: int = 1024,
                      bwd_impl: str = "pallas"):
     """Mean token cross-entropy of a tied LM head, logits never in HBM.
 

@@ -51,6 +51,44 @@ def test_fused_ce_bf16_close_to_fp32_dense():
     assert abs(float(fused) - float(ref)) < 0.05
 
 
+def test_cost_model_and_gpt2_auto_dispatch():
+    """loss_impl='auto' flips to the fused kernel exactly when the
+    roofline model predicts a win (small D / fp32 logits), and the fused
+    GPT-2 loss matches the dense path."""
+    from ray_tpu.models import gpt2
+    from ray_tpu.ops.fused_ce import fused_ce_wins
+
+    # The model's documented regime boundaries (v5e constants).
+    assert not fused_ce_wins(768, 2)   # GPT-2-small bf16: dense
+    assert not fused_ce_wins(768, 4)   # GPT-2-small fp32: dense
+    assert fused_ce_wins(128, 4)       # small head, exact softmax: fused
+    assert not fused_ce_wins(512, 2)
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 128, (2, 32)), jnp.int32)
+    targets = jnp.asarray(rng.integers(0, 128, (2, 32)), jnp.int32)
+    base = dict(vocab_size=128, n_layer=1, n_head=2, d_model=32,
+                seq_len=32, dtype=jnp.float32, remat=False,
+                logits_dtype=jnp.float32)
+    cfg_fused = gpt2.GPTConfig(**base, loss_impl="fused")
+    cfg_dense = gpt2.GPTConfig(**base, loss_impl="dense")
+    # auto is additionally gated on default_backend()=='tpu' (interpret-
+    # mode pallas off-TPU would be a silent slowdown), so on this CPU
+    # mesh it must resolve to dense; forced 'fused' still runs (interpret).
+    cfg_auto = gpt2.GPTConfig(**base)
+    assert cfg_auto.loss_impl == "auto"
+    params = gpt2.init_params(cfg_dense, jax.random.key(0))
+    l_dense = gpt2.loss_fn(params, tokens, targets, cfg_dense)
+    for cfg in (cfg_fused, cfg_auto):
+        l = gpt2.loss_fn(params, tokens, targets, cfg)
+        np.testing.assert_allclose(float(l), float(l_dense),
+                                   rtol=1e-5, atol=1e-5)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="loss_impl"):
+        gpt2.loss_fn(params, tokens, targets,
+                     gpt2.GPTConfig(**base, loss_impl="Fused"))
+
+
 def test_fused_ce_under_jit_and_odd_blocks():
     key = jax.random.PRNGKey(2)
     B, S, D, V = 1, 24, 16, 96  # deliberately non-power-of-two row count
