@@ -9,11 +9,21 @@ applied inside *process-tier* workers — a task carrying a runtime_env is
 automatically routed to the process pool, whose leases are keyed by the env
 hash exactly like the reference's runtime-env-keyed worker caching.
 
-Supported fields (this image is offline — installer plugins are gated):
+Supported fields:
   env_vars:    {str: str} exported in the worker
   working_dir: local directory staged into the cache and chdir'd into
   py_modules:  list of local module/package paths prepended to sys.path
-  pip/conda/uv: rejected with a clear error (no network in this image)
+  pip / uv:    list of requirements, materialized OFFLINE into a real
+               content-keyed virtualenv from a local wheel cache
+               (``pip install --no-index --find-links``; ref: pip.py:122
+               _install_pip_packages + uri_cache.py).  The wheel source is
+               runtime_env["config"]["pip_find_links"] or
+               $RAY_TPU_WHEEL_CACHE; TRUE network installs (no local
+               wheel source) remain gated with a clear error.  Workers
+               activate the venv by site-dir injection (packages shadow
+               the host's), not interpreter re-exec — the process pool
+               spawns via multiprocessing, whose executable is global.
+  conda:       rejected (no conda toolchain in this image)
 """
 
 from __future__ import annotations
@@ -42,7 +52,7 @@ class RuntimeEnv(dict):
 
     _ALLOWED = {"env_vars", "working_dir", "py_modules", "pip", "conda",
                 "uv", "config"}
-    _GATED = ("pip", "conda", "uv")
+    _GATED = ("conda",)
 
     def __init__(self, **kwargs):
         super().__init__()
@@ -72,6 +82,18 @@ class RuntimeEnv(dict):
                     f"runtime_env[{gated!r}] needs package installation, "
                     "which is unavailable in this offline image; pre-bake "
                     "dependencies or use py_modules/working_dir")
+        if self.get("pip") and self.get("uv"):
+            raise ValueError("runtime_env: specify pip OR uv, not both")
+        for field in ("pip", "uv"):
+            spec = self.get(field)
+            if spec is None:
+                continue
+            pkgs = spec.get("packages") if isinstance(spec, dict) else spec
+            if not (isinstance(pkgs, list)
+                    and all(isinstance(p, str) for p in pkgs)):
+                raise ValueError(
+                    f"runtime_env[{field!r}] must be a list of requirement "
+                    "strings (or {'packages': [...]})")
         ev = self.get("env_vars", {})
         if not isinstance(ev, dict) or not all(
                 isinstance(k, str) and isinstance(v, str)
@@ -93,7 +115,16 @@ class RuntimeEnv(dict):
     # ------------------------------------------------------------- staging
     def stage(self) -> dict:
         """Materialize (driver side): copy working_dir into the session cache
-        once per content key; return the payload shipped to workers."""
+        once per content key; return the payload shipped to workers.
+        Memoized per instance with a 5 s TTL: stage() sits on the
+        task-submission hot path (the pip/uv content key re-walks the wheel
+        cache), while the TTL keeps the content-fingerprint freshness that
+        lets an edited working_dir produce a new lease key mid-session."""
+        import time as _time
+
+        cached = getattr(self, "_staged", None)
+        if cached is not None and _time.monotonic() < cached[0]:
+            return cached[1]
         payload: Dict[str, Any] = {"env_vars": dict(self.get("env_vars", {}))}
         wd = self.get("working_dir")
         if wd:
@@ -108,7 +139,86 @@ class RuntimeEnv(dict):
                 _dir_fingerprint(p) if os.path.isdir(p) else _file_fingerprint(p)
                 for p in mods
             ]
+        for installer in ("pip", "uv"):
+            if self.get(installer):
+                payload.update(
+                    _materialize_venv(self[installer], installer,
+                                      self.get("config") or {}))
+                break
+        self._staged = (_time.monotonic() + 5.0, payload)
         return payload
+
+
+def _find_links_dir(config: dict) -> Optional[str]:
+    d = config.get("pip_find_links") or os.environ.get("RAY_TPU_WHEEL_CACHE")
+    return os.path.abspath(d) if d else None
+
+
+def _materialize_venv(spec, installer: str, config: dict) -> dict:
+    """Build (once) a real virtualenv holding `spec`'s requirements from a
+    LOCAL wheel cache, content-keyed by (installer, requirements, wheel-dir
+    fingerprint) — the uri_cache.py role.  Returns payload fields; workers
+    activate via site-dir injection (apply_in_worker)."""
+    import subprocess
+    import venv as venv_mod
+
+    pkgs = sorted(spec.get("packages") if isinstance(spec, dict) else spec)
+    find_links = _find_links_dir(config)
+    if find_links is None or not os.path.isdir(find_links):
+        raise RuntimeError(
+            f"runtime_env[{installer!r}] would need a NETWORK package "
+            "install, which is unavailable in this offline image.  Provide "
+            "a local wheel cache via runtime_env['config']"
+            "['pip_find_links'] or $RAY_TPU_WHEEL_CACHE "
+            f"(got {find_links!r}), or pre-bake dependencies.")
+    key = hashlib.sha1(
+        f"{installer}:{json.dumps(pkgs)}:{_dir_fingerprint(find_links)}"
+        .encode()).hexdigest()[:16]
+    venv_dir = os.path.join(_cache_root(), "venvs", key)
+    py = os.path.join(venv_dir, "bin", "python")
+    with _CACHE_LOCK:
+        if not os.path.isdir(venv_dir):
+            tmp = venv_dir + ".tmp"
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(os.path.dirname(venv_dir), exist_ok=True)
+            uv_bin = shutil.which("uv") if installer == "uv" else None
+            try:
+                if uv_bin:
+                    subprocess.run([uv_bin, "venv", "--python",
+                                    sys.executable,
+                                    "--system-site-packages", tmp],
+                                   check=True, capture_output=True,
+                                   text=True, timeout=120)
+                    cmd = [uv_bin, "pip", "install", "--offline",
+                           "--no-index", "--find-links", find_links,
+                           "--python", os.path.join(tmp, "bin", "python"),
+                           *pkgs]
+                else:
+                    # venv without pip (ensurepip is slow); drive the HOST
+                    # pip against the venv interpreter (pip >= 22.3).
+                    venv_mod.create(tmp, system_site_packages=True,
+                                    with_pip=False, symlinks=True)
+                    cmd = [sys.executable, "-m", "pip", "--python",
+                           os.path.join(tmp, "bin", "python"), "install",
+                           "--no-index", "--find-links", find_links, *pkgs]
+                subprocess.run(cmd, check=True, capture_output=True,
+                               text=True, timeout=300)
+            except (subprocess.CalledProcessError,
+                    subprocess.TimeoutExpired, OSError) as e:
+                shutil.rmtree(tmp, ignore_errors=True)
+                detail = (getattr(e, "stderr", "") or str(e))[-800:]
+                raise RuntimeError(
+                    f"runtime_env[{installer!r}] install failed for {pkgs} "
+                    f"from {find_links}: {detail}") from e
+            os.replace(tmp, venv_dir)
+    site_dirs = [
+        os.path.join(venv_dir, "lib", d, "site-packages")
+        for d in os.listdir(os.path.join(venv_dir, "lib"))
+        if d.startswith("python")
+    ] if os.path.isdir(os.path.join(venv_dir, "lib")) else []
+    return {"venv_dir": venv_dir, "venv_python": py,
+            "venv_site": site_dirs[0] if site_dirs else None,
+            "venv_key": key}
 
 
 def _file_fingerprint(path: str) -> str:
@@ -162,6 +272,16 @@ def apply_in_worker(payload: dict) -> None:
     """Apply a staged env inside a (process-tier) worker."""
     for k, v in payload.get("env_vars", {}).items():
         os.environ[k] = v
+    vs = payload.get("venv_site")
+    if vs:
+        import site
+
+        prev = set(sys.path)
+        site.addsitedir(vs)  # honors .pth files, unlike a bare insert
+        fresh = [p for p in sys.path if p not in prev]
+        # Venv packages must SHADOW same-named host packages.
+        sys.path[:] = fresh + [p for p in sys.path if p not in fresh]
+        os.environ["VIRTUAL_ENV"] = payload.get("venv_dir", "")
     for p in reversed(payload.get("py_modules", [])):
         if p not in sys.path:
             sys.path.insert(0, p)

@@ -1,0 +1,86 @@
+"""r5 focused MFU sweep: splash blocks x optimizer-moment dtype on the
+current best config (attn_outside remat, unrolled layers, bf16 logits).
+
+Run: python scripts/mfu_sweep_r5.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def run(tag, config, mu_dtype=None, n_steps=10):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.parallel import MeshSpec, batch_sharding, make_mesh
+    from ray_tpu.parallel.train_state import create_sharded_state, jit_train_step
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    B = 16 * n_dev
+    mesh = make_mesh(MeshSpec(data=n_dev), devices)
+    if mu_dtype is not None:
+        clip = optax.clip_by_global_norm(1.0)
+        opt = optax.chain(clip, optax.adamw(3e-4, b1=0.9, b2=0.95,
+                                            weight_decay=0.1,
+                                            mu_dtype=mu_dtype))
+    else:
+        opt = gpt2.make_optimizer(learning_rate=3e-4)
+    try:
+        params, opt_state = create_sharded_state(
+            lambda key: gpt2.init_params(config, key),
+            gpt2.logical_axes(config), mesh, jax.random.key(0), opt)
+        step = jit_train_step(gpt2.make_train_step(config, opt))
+        batch_sh = batch_sharding(mesh)
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, config.vocab_size, (B, config.seq_len + 1),
+                            dtype=np.int64)
+        t = jnp.asarray(toks, jnp.int32)
+        tokens = jax.device_put(t[:, :-1], batch_sh)
+        targets = jax.device_put(t[:, 1:], batch_sh)
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, tokens, targets)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            params, opt_state, loss = step(params, opt_state, tokens, targets)
+        final_loss = float(loss)
+        dt = time.perf_counter() - t0
+    except Exception as e:  # noqa: BLE001
+        print(f"{tag:45s}  FAILED: {type(e).__name__}: {str(e)[:100]}",
+              flush=True)
+        return
+    tok_s = n_steps * B * config.seq_len / dt
+    mfu = gpt2.flops_per_token(config) * tok_s / (197e12 * n_dev)
+    print(f"{tag:45s}  {dt/n_steps*1e3:7.1f} ms  {tok_s:9,.0f} tok/s  "
+          f"MFU {mfu*100:5.2f}%  loss {final_loss:.3f}", flush=True)
+
+
+def main():
+    from ray_tpu.models import gpt2
+
+    def cfg(**kw):
+        return gpt2.GPTConfig(remat_policy="attn_outside",
+                              scan_layers=False, **kw)
+
+    import jax.numpy as jnp
+
+    run("base (512,512)", cfg())
+    run("blocks (1024,512)", cfg(attn_block_q=1024, attn_block_kv=512))
+    run("blocks (512,1024)", cfg(attn_block_q=512, attn_block_kv=1024))
+    run("blocks (1024,1024)", cfg(attn_block_q=1024, attn_block_kv=1024))
+    run("blocks (256,512)", cfg(attn_block_q=256, attn_block_kv=512))
+    run("base + mu bf16", cfg(), mu_dtype=jnp.bfloat16)
+    run("blocks(1024,512) + mu bf16",
+        cfg(attn_block_q=1024, attn_block_kv=512), mu_dtype=jnp.bfloat16)
+
+
+if __name__ == "__main__":
+    main()

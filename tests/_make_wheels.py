@@ -1,0 +1,31 @@
+"""Hand-crafted pure-python wheels for offline runtime-env tests.
+
+A wheel is just a zip with a module and a dist-info; building them here
+(deterministically, no setuptools invocation, no binaries in the repo)
+gives the pip/uv materializer a real local wheel cache to install from.
+"""
+
+import zipfile
+
+
+def make_wheel(dest_dir, name: str, version: str, code: str,
+               requires=()) -> str:
+    """Write ``{name}-{version}-py3-none-any.whl`` into dest_dir."""
+    dist = name.replace("-", "_")
+    di = f"{dist}-{version}.dist-info"
+    metadata = (f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n"
+                + "".join(f"Requires-Dist: {r}\n" for r in requires))
+    wheel_meta = ("Wheel-Version: 1.0\nGenerator: ray_tpu-tests\n"
+                  "Root-Is-Purelib: true\nTag: py3-none-any\n")
+    files = {
+        f"{dist}.py": code,
+        f"{di}/METADATA": metadata,
+        f"{di}/WHEEL": wheel_meta,
+    }
+    record = "".join(f"{p},,\n" for p in files) + f"{di}/RECORD,,\n"
+    files[f"{di}/RECORD"] = record
+    path = f"{dest_dir}/{dist}-{version}-py3-none-any.whl"
+    with zipfile.ZipFile(path, "w") as z:
+        for p, content in files.items():
+            z.writestr(p, content)
+    return path
