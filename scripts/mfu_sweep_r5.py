@@ -16,7 +16,6 @@ import numpy as np
 def run(tag, config, mu_dtype=None, n_steps=10):
     import jax
     import jax.numpy as jnp
-    import optax
 
     from ray_tpu.models import gpt2
     from ray_tpu.parallel import MeshSpec, batch_sharding, make_mesh
@@ -26,13 +25,11 @@ def run(tag, config, mu_dtype=None, n_steps=10):
     n_dev = len(devices)
     B = 16 * n_dev
     mesh = make_mesh(MeshSpec(data=n_dev), devices)
-    if mu_dtype is not None:
-        clip = optax.clip_by_global_norm(1.0)
-        opt = optax.chain(clip, optax.adamw(3e-4, b1=0.9, b2=0.95,
-                                            weight_decay=0.1,
-                                            mu_dtype=mu_dtype))
-    else:
-        opt = gpt2.make_optimizer(learning_rate=3e-4)
+    # Explicit fp32 baseline: make_optimizer now DEFAULTS to bf16 mu (the
+    # winner of this sweep), so the comparison must pin both sides.
+    opt = gpt2.make_optimizer(
+        learning_rate=3e-4,
+        mu_dtype=mu_dtype if mu_dtype is not None else jnp.float32)
     try:
         params, opt_state = create_sharded_state(
             lambda key: gpt2.init_params(config, key),
