@@ -4,13 +4,22 @@ from ray_tpu.autoscaler.autoscaler import (
     Monitor,
     NodeTypeConfig,
 )
+from ray_tpu.autoscaler.instance_manager import (
+    Instance,
+    InstanceManager,
+    InstanceState,
+    InstanceStorage,
+)
 from ray_tpu.autoscaler.node_provider import (
     FakeNodeProvider,
     NodeProvider,
+    SubprocessNodeProvider,
     TPUPodProvider,
 )
 
 __all__ = [
     "Autoscaler", "AutoscalerConfig", "Monitor", "NodeTypeConfig",
-    "NodeProvider", "FakeNodeProvider", "TPUPodProvider",
+    "NodeProvider", "FakeNodeProvider", "SubprocessNodeProvider",
+    "TPUPodProvider", "Instance", "InstanceManager", "InstanceState",
+    "InstanceStorage",
 ]

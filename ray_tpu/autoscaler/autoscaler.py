@@ -11,7 +11,12 @@ and terminates nodes idle past the timeout (respecting min_workers).  The
 
 State machine is deliberately reconciler-shaped (observe → diff → act), not
 event-driven: the same pass works from a cold start, after a crash, or with
-externally added nodes — the v2 design's point.
+externally added nodes — the v2 design's point.  Every node the autoscaler
+requests is an `Instance` with an explicit per-instance FSM and failure log
+persisted to the session dir (instance_manager.py; ref: the v2
+instance-storage reconciler, reconciler.py:53) — observed drift (a
+provider node dying under a RUNNING instance) fails the instance, whose
+freed slot the same pass's demand/min_workers arithmetic then replaces.
 """
 
 from __future__ import annotations
@@ -22,6 +27,10 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ray_tpu.autoscaler.instance_manager import (ACTIVE_STATES, Instance,
+                                                 InstanceManager,
+                                                 InstanceState,
+                                                 InstanceStorage)
 from ray_tpu.autoscaler.node_provider import NodeProvider
 
 Resources = Dict[str, float]
@@ -41,6 +50,10 @@ class NodeTypeConfig:
 class AutoscalerConfig:
     node_types: Dict[str, NodeTypeConfig] = field(default_factory=dict)
     idle_timeout_s: float = 60.0
+    #: Names the persisted instance table (ref: the v2 storage is
+    #: per-cluster); two clusters in one session must not clobber or
+    #: mis-adopt each other's instances.
+    cluster_name: str = "default"
     #: Max nodes launched per reconcile pass (ref: upscaling_speed).
     max_launches_per_round: int = 100
     #: Cluster-wide worker cap across ALL node types (ref: the top-level
@@ -50,7 +63,8 @@ class AutoscalerConfig:
 
 class Autoscaler:
     def __init__(self, config: AutoscalerConfig, provider: NodeProvider,
-                 scheduler=None):
+                 scheduler=None, storage_path: Optional[str] = "auto"):
+        from ray_tpu._private.config import GLOBAL_CONFIG
         from ray_tpu._private.runtime import get_runtime
 
         self.config = config
@@ -59,30 +73,73 @@ class Autoscaler:
         self.scheduler.autoscaling_enabled = True
         self.scheduler.autoscaler_node_shapes = [
             dict(cfg.resources) for cfg in config.node_types.values()]
-        #: provider node id -> node type name
-        self._owned: Dict[str, str] = {}
-        self._lock = threading.Lock()
+        if storage_path == "auto":
+            import os
+
+            storage_path = os.path.join(
+                GLOBAL_CONFIG.session_dir,
+                f"autoscaler-{config.cluster_name}-instances.json")
+        self.im = InstanceManager(InstanceStorage(storage_path))
+        # Adoption: a restarted autoscaler keeps persisted instances whose
+        # provider nodes still exist, and immediately fails the rest — a
+        # stale table (crashed run, earlier cluster in the same session)
+        # must not count against caps or block min_workers launches.
+        # (If the provider is unreachable here, update()'s stale-REQUESTED
+        # sweep and reconcile_drift finish the job on the first pass.)
+        try:
+            live = set(self.provider.non_terminated_nodes())
+        except Exception:  # noqa: BLE001
+            live = None
+        if live is not None:
+            for inst in self.im.instances(*ACTIVE_STATES):
+                if inst.state == InstanceState.REQUESTED:
+                    self.im.transition(inst, InstanceState.ALLOCATION_FAILED,
+                                       "lost before allocation (restart)")
+                elif inst.provider_node_id not in live:
+                    self.im.transition(inst, InstanceState.FAILED,
+                                       "provider node not found at adoption")
 
     # ------------------------------------------------------------ reconcile
     def update(self) -> dict:
-        """One reconcile pass; returns {"launched": [...], "terminated": [...]}."""
+        """One reconcile pass; returns {"launched": [...], "terminated":
+        [...], "failed": [...]} (provider node ids / instance ids)."""
         launched: List[str] = []
         terminated: List[str] = []
 
-        # 1. Observe: drop provider nodes that vanished out from under us.
+        # 1. Observe: cloud truth vs instance intent vs scheduler truth.
         live = set(self.provider.non_terminated_nodes())
-        with self._lock:
-            for pid in list(self._owned):
-                if pid not in live:
-                    del self._owned[pid]
+        # update() is the only requester and _launch is synchronous, so any
+        # REQUESTED instance visible here is a prior run's in-flight create
+        # that never landed (crash between persist and allocate).
+        for inst in self.im.instances(InstanceState.REQUESTED):
+            self.im.transition(inst, InstanceState.ALLOCATION_FAILED,
+                               "lost before allocation")
+        failed = self.im.reconcile_drift(live, self.scheduler)
+        # ALLOCATED instances whose scheduler node came alive -> RUNNING.
+        # The scheduler id can bind LATE: some providers only learn it once
+        # the worker joins, so refresh the mapping each pass until it lands.
+        for inst in self.im.instances(InstanceState.ALLOCATED):
+            if inst.scheduler_node_id is None:
+                sid = getattr(self.provider, "scheduler_node_id",
+                              lambda _: None)(inst.provider_node_id)
+                if sid is not None:
+                    inst.scheduler_node_id = str(sid)
+                    self.im.storage.upsert(inst)
+            node = (self.scheduler.get_node(inst.scheduler_node_id)
+                    if inst.scheduler_node_id is not None else None)
+            if node is not None and node.alive:
+                self.im.transition(inst, InstanceState.RUNNING,
+                                   "scheduler node registered")
 
         # 2. min_workers floor (still subject to the cluster-wide cap).
-        counts = self._counts()
+        counts = self.im.active_counts()
         for type_name, cfg in self.config.node_types.items():
             for _ in range(cfg.min_workers - counts.get(type_name, 0)):
                 if self._at_total_cap():
                     break
-                launched.append(self._launch(type_name))
+                pid = self._launch(type_name)
+                if pid:
+                    launched.append(pid)
 
         # 3. Unmet demand -> more nodes (simple first-fit-decreasing binpack
         # onto hypothetical new nodes, the v2 scheduler.py role).
@@ -91,7 +148,7 @@ class Autoscaler:
             demand.extend(bundles)
         for type_name, n in self._binpack(demand).items():
             cfg = self.config.node_types[type_name]
-            counts = self._counts()
+            counts = self.im.active_counts()
             room = cfg.max_workers - counts.get(type_name, 0)
             if self.config.max_total_workers is not None:
                 # Cluster-wide cap binds across all types together.
@@ -99,56 +156,74 @@ class Autoscaler:
                            - sum(counts.values()))
             for _ in range(min(n, room,
                                self.config.max_launches_per_round - len(launched))):
-                launched.append(self._launch(type_name))
+                pid = self._launch(type_name)
+                if pid:
+                    launched.append(pid)
 
         # 4. Idle nodes past timeout -> terminate (never below min_workers,
         # never a node with resources in use).
         now = time.time()
-        counts = self._counts()
-        with self._lock:
-            owned = dict(self._owned)
-        for pid, type_name in owned.items():
-            cfg = self.config.node_types.get(type_name)
-            if cfg is None or counts.get(type_name, 0) <= cfg.min_workers:
+        counts = self.im.active_counts()
+        for inst in self.im.instances(InstanceState.RUNNING):
+            cfg = self.config.node_types.get(inst.node_type)
+            if cfg is None or counts.get(inst.node_type, 0) <= cfg.min_workers:
                 continue
-            node = self._scheduler_node(pid)
+            node = self._scheduler_node(inst)
             if node is None:
                 continue
             busy = any(node.available.get(k, 0.0) < v
                        for k, v in node.total.items())
             if not busy and now - node.last_busy > self.config.idle_timeout_s:
-                self.provider.terminate_node(pid)
-                with self._lock:
-                    self._owned.pop(pid, None)
-                counts[type_name] -= 1
-                terminated.append(pid)
-        return {"launched": launched, "terminated": terminated}
+                self.im.transition(inst, InstanceState.TERMINATING,
+                                   f"idle > {self.config.idle_timeout_s}s")
+                counts[inst.node_type] -= 1
+        # TERMINATING instances (this pass's AND earlier stuck ones): call
+        # the provider; a failed call stays TERMINATING so the NEXT pass
+        # retries — transitioning to terminal FAILED would leak a live,
+        # billing cloud node that nothing references.
+        for inst in self.im.instances(InstanceState.TERMINATING):
+            try:
+                self.provider.terminate_node(inst.provider_node_id)
+                self.im.transition(inst, InstanceState.TERMINATED, "")
+                terminated.append(inst.provider_node_id)
+            except Exception as e:  # noqa: BLE001 — retried next pass
+                inst.history.append(
+                    [inst.state, time.time(), f"terminate failed: {e!r}"])
+                self.im.storage.upsert(inst)
+        self.im.storage.prune_terminal()
+        return {"launched": launched, "terminated": terminated,
+                "failed": [i.instance_id for i in failed]}
 
     # -------------------------------------------------------------- helpers
     def _at_total_cap(self) -> bool:
         cap = self.config.max_total_workers
         if cap is None:
             return False
-        with self._lock:
-            return len(self._owned) >= cap
+        return sum(self.im.active_counts().values()) >= cap
 
-    def _launch(self, type_name: str) -> str:
+    def _launch(self, type_name: str) -> Optional[str]:
         cfg = self.config.node_types[type_name]
-        pid = self.provider.create_node(type_name, dict(cfg.resources),
-                                        dict(cfg.labels))
-        with self._lock:
-            self._owned[pid] = type_name
+        inst = self.im.request(type_name)
+        try:
+            pid = self.provider.create_node(type_name, dict(cfg.resources),
+                                            dict(cfg.labels))
+        except Exception as e:  # noqa: BLE001 — tracked per instance
+            self.im.transition(inst, InstanceState.ALLOCATION_FAILED,
+                               f"create_node: {e!r}")
+            return None
+        sched_id = getattr(self.provider, "scheduler_node_id",
+                           lambda _: None)(pid)
+        self.im.transition(inst, InstanceState.ALLOCATED, "provider created",
+                           provider_node_id=pid,
+                           scheduler_node_id=(str(sched_id)
+                                              if sched_id else None))
         return pid
 
-    def _counts(self) -> Dict[str, int]:
-        with self._lock:
-            counts: Dict[str, int] = {}
-            for type_name in self._owned.values():
-                counts[type_name] = counts.get(type_name, 0) + 1
-            return counts
-
-    def _scheduler_node(self, pid: str):
-        node_id = getattr(self.provider, "scheduler_node_id", lambda _: None)(pid)
+    def _scheduler_node(self, inst: Instance):
+        node_id = inst.scheduler_node_id
+        if node_id is None:
+            node_id = getattr(self.provider, "scheduler_node_id",
+                              lambda _: None)(inst.provider_node_id)
         if node_id is None:
             return None
         return self.scheduler.get_node(node_id)

@@ -1,0 +1,198 @@
+"""Instance manager: the autoscaler v2 per-instance FSM + persisted storage.
+
+Counterpart of the reference's v2 instance manager (ref:
+python/ray/autoscaler/v2/instance_manager/reconciler.py:53 Reconciler,
+instance_storage.py, instance_manager.py): every node the autoscaler ever
+requested is an Instance with an explicit lifecycle
+
+    REQUESTED -> ALLOCATED -> RUNNING -> TERMINATING -> TERMINATED
+         \\-> ALLOCATION_FAILED          RUNNING -> FAILED (died under us)
+
+a per-instance failure log, and durable storage (JSON snapshot in the
+session dir, atomic replace) so a restarted autoscaler reconciles against
+what it already owns instead of double-launching.  The reconciler compares
+three views every pass — the instance table (intent), the provider's live
+nodes (cloud truth), and the scheduler's node states (cluster truth) — and
+drives each instance toward its goal state; observed drift (a provider node
+vanishing under a RUNNING instance) transitions the instance to FAILED,
+which frees its slot so demand/min_workers relaunch a replacement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional
+
+
+class InstanceState:
+    REQUESTED = "REQUESTED"
+    ALLOCATED = "ALLOCATED"
+    RUNNING = "RUNNING"
+    TERMINATING = "TERMINATING"
+    TERMINATED = "TERMINATED"
+    ALLOCATION_FAILED = "ALLOCATION_FAILED"
+    FAILED = "FAILED"
+
+
+#: Legal transitions (ref: reconciler.py's state machine, reduced to the
+#: states this runtime distinguishes).
+_TRANSITIONS = {
+    InstanceState.REQUESTED: {InstanceState.ALLOCATED,
+                              InstanceState.ALLOCATION_FAILED},
+    InstanceState.ALLOCATED: {InstanceState.RUNNING,
+                              InstanceState.TERMINATING,
+                              InstanceState.FAILED},
+    InstanceState.RUNNING: {InstanceState.TERMINATING, InstanceState.FAILED},
+    InstanceState.TERMINATING: {InstanceState.TERMINATED,
+                                InstanceState.FAILED},
+    InstanceState.TERMINATED: set(),
+    InstanceState.ALLOCATION_FAILED: set(),
+    InstanceState.FAILED: set(),
+}
+
+#: States that still occupy a cluster slot (count against caps/min_workers).
+ACTIVE_STATES = frozenset({InstanceState.REQUESTED, InstanceState.ALLOCATED,
+                           InstanceState.RUNNING})
+TERMINAL_STATES = frozenset({InstanceState.TERMINATED,
+                             InstanceState.ALLOCATION_FAILED,
+                             InstanceState.FAILED})
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    state: str = InstanceState.REQUESTED
+    provider_node_id: Optional[str] = None
+    scheduler_node_id: Optional[str] = None
+    created_at: float = field(default_factory=time.time)
+    #: [(state, unix_time, message)] — the per-instance audit/failure log.
+    history: List[List] = field(default_factory=list)
+    launch_attempt: int = 1
+
+    def transition(self, new_state: str, message: str = "") -> None:
+        if new_state not in _TRANSITIONS.get(self.state, set()):
+            raise ValueError(
+                f"instance {self.instance_id}: illegal transition "
+                f"{self.state} -> {new_state}")
+        self.state = new_state
+        self.history.append([new_state, time.time(), message])
+
+
+class InstanceStorage:
+    """Durable instance table: one JSON snapshot, atomic replace on every
+    mutation batch (the instance_storage.py role; a snapshot rather than a
+    WAL because the table is small and the write is one syscall)."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self._instances: Dict[str, Instance] = {}
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    raw = json.load(f)
+                for d in raw:
+                    self._instances[d["instance_id"]] = Instance(**d)
+            except (OSError, ValueError, KeyError, TypeError):
+                pass  # corrupt snapshot: start empty (provider is truth)
+
+    def upsert(self, *instances: Instance) -> None:
+        for inst in instances:
+            self._instances[inst.instance_id] = inst
+        self._flush()
+
+    def get(self, instance_id: str) -> Optional[Instance]:
+        return self._instances.get(instance_id)
+
+    def all(self) -> List[Instance]:
+        return list(self._instances.values())
+
+    def prune_terminal(self, keep: int = 64) -> None:
+        """Bound the table: keep only the newest `keep` terminal records."""
+        terminal = sorted(
+            (i for i in self._instances.values() if i.state in TERMINAL_STATES),
+            key=lambda i: i.created_at)
+        for inst in terminal[:-keep] if keep else terminal:
+            del self._instances[inst.instance_id]
+        self._flush()
+
+    def _flush(self) -> None:
+        if not self.path:
+            return
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump([asdict(i) for i in self._instances.values()], f)
+        os.replace(tmp, self.path)
+
+
+class InstanceManager:
+    """Owns the instance table and the FSM transitions; the Autoscaler's
+    reconcile pass is written against this, not raw provider ids."""
+
+    def __init__(self, storage: InstanceStorage):
+        self.storage = storage
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ mutation
+    def request(self, node_type: str) -> Instance:
+        inst = Instance(instance_id=f"inst-{uuid.uuid4().hex[:10]}",
+                        node_type=node_type)
+        inst.history.append([inst.state, time.time(), "requested"])
+        with self._lock:
+            self.storage.upsert(inst)
+        return inst
+
+    def transition(self, inst: Instance, state: str, message: str = "",
+                   **fields) -> None:
+        with self._lock:
+            inst.transition(state, message)
+            for k, v in fields.items():
+                setattr(inst, k, v)
+            self.storage.upsert(inst)
+
+    # ------------------------------------------------------------- queries
+    def instances(self, *states: str) -> List[Instance]:
+        with self._lock:
+            if not states:
+                return self.storage.all()
+            wanted = set(states)
+            return [i for i in self.storage.all() if i.state in wanted]
+
+    def active_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for inst in self.instances(*ACTIVE_STATES):
+            counts[inst.node_type] = counts.get(inst.node_type, 0) + 1
+        return counts
+
+    # ----------------------------------------------------------- reconcile
+    def reconcile_drift(self, provider_live: set, scheduler) -> List[Instance]:
+        """Compare intent vs cloud truth vs cluster truth; returns the
+        instances newly marked FAILED (the caller's signal to replace)."""
+        failed = []
+        for inst in self.instances(InstanceState.ALLOCATED,
+                                   InstanceState.RUNNING):
+            if inst.provider_node_id not in provider_live:
+                self.transition(
+                    inst, InstanceState.FAILED,
+                    "provider node vanished (killed / preempted)")
+                failed.append(inst)
+                continue
+            if inst.state == InstanceState.RUNNING and scheduler is not None \
+                    and inst.scheduler_node_id is not None:
+                node = scheduler.get_node(inst.scheduler_node_id)
+                if node is not None and not node.alive:
+                    self.transition(
+                        inst, InstanceState.FAILED,
+                        "scheduler marked the node dead")
+                    failed.append(inst)
+        # TERMINATING instances whose provider node is already gone landed.
+        for inst in self.instances(InstanceState.TERMINATING):
+            if inst.provider_node_id not in provider_live:
+                self.transition(inst, InstanceState.TERMINATED, "")
+        return failed

@@ -23,12 +23,16 @@ from ray_tpu.autoscaler.autoscaler import (Autoscaler, AutoscalerConfig,
                                            Monitor, NodeTypeConfig)
 from ray_tpu.autoscaler.gce import GCETPUNodeProvider
 from ray_tpu.autoscaler.node_provider import (FakeNodeProvider, NodeProvider,
+                                              SubprocessNodeProvider,
                                               TPUPodProvider)
 
 _BUILTIN_PROVIDERS = {
     "fake": FakeNodeProvider,
     "local": FakeNodeProvider,
     "tpu_pod": TPUPodProvider,
+    # Real worker-node processes joined over the node protocol — the
+    # loopback analogue of the SSH command_runner bootstrap.
+    "subprocess": SubprocessNodeProvider,
     # Real worker-node processes behind a (mockable) GCE TPU API client
     # (ref: autoscaler/_private/gcp/node_provider.py).
     "gce_tpu": GCETPUNodeProvider,
@@ -161,7 +165,8 @@ def launch_cluster(source: Any, *, autoscale: bool = True) -> ClusterHandle:
     ray_tpu.init(ignore_reinit_error=True, resources=config.head_resources)
     as_config = AutoscalerConfig(node_types=config.node_types,
                                  idle_timeout_s=config.idle_timeout_s,
-                                 max_total_workers=config.max_workers)
+                                 max_total_workers=config.max_workers,
+                                 cluster_name=config.cluster_name)
     autoscaler = Autoscaler(as_config, config.provider)
     worker_ids: List[str] = []
     for tname, tcfg in config.node_types.items():

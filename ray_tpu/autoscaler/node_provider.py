@@ -73,6 +73,88 @@ class FakeNodeProvider(NodeProvider):
             return self._nodes.get(provider_node_id)
 
 
+class SubprocessNodeProvider(NodeProvider):
+    """Launches REAL worker-node processes on this host — the loopback
+    analogue of the reference's SSH `command_runner` bootstrap (ref:
+    autoscaler/_private/command_runner.py + commands.py `ray up`): the
+    provider's "cloud API" is subprocess.Popen, its bootstrap command is
+    the same `python -m ray_tpu worker --address ...` a remote SSH
+    provider would run, and the launched node JOINS the head over the node
+    protocol exactly like a cross-host worker.  `up/down` against this
+    provider exercises live nodes, not mocks."""
+
+    def __init__(self, address: Optional[str] = None):
+        self._procs: Dict[str, object] = {}   # provider id -> Popen
+        self._node_ids: Dict[str, object] = {}  # provider id -> NodeID
+        self._lock = threading.Lock()
+        self._address = address
+
+    def _head_address(self) -> str:
+        if self._address:
+            return self._address
+        from ray_tpu._private.runtime import get_runtime
+
+        self._address = get_runtime().start_node_server()
+        return self._address
+
+    def create_node(self, node_type, resources, labels) -> str:
+        import subprocess
+
+        from ray_tpu._private.ids import NodeID
+        from ray_tpu.cluster_utils import worker_node_cmd, worker_node_env
+
+        node_id = NodeID.from_random()
+        res = dict(resources)
+        cpus = res.pop("CPU", 1.0)
+        cmd = worker_node_cmd(self._head_address(), cpus, res,
+                              {**labels, "node-type": node_type},
+                              str(node_id))
+        proc = subprocess.Popen(cmd, env=worker_node_env(),
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        pid = f"proc-{proc.pid}"
+        with self._lock:
+            self._procs[pid] = proc
+            self._node_ids[pid] = node_id
+        return pid
+
+    def terminate_node(self, provider_node_id: str) -> None:
+        with self._lock:
+            proc = self._procs.pop(provider_node_id, None)
+            self._node_ids.pop(provider_node_id, None)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            # Cloud truth is the OS process table: an externally killed
+            # worker (chaos, OOM) is observed here, which is what lets the
+            # reconciler mark its instance FAILED and replace it.  Dead
+            # entries are reaped on observation (poll() already collected
+            # the exit status) so a churning cluster doesn't accumulate
+            # Popen handles nor re-poll every historical corpse.
+            live = []
+            for pid, proc in list(self._procs.items()):
+                if proc.poll() is None:
+                    live.append(pid)
+                else:
+                    del self._procs[pid]
+                    self._node_ids.pop(pid, None)
+            return live
+
+    def scheduler_node_id(self, provider_node_id: str):
+        with self._lock:
+            return self._node_ids.get(provider_node_id)
+
+    def shutdown(self) -> None:
+        for pid in list(self._procs):
+            self.terminate_node(pid)
+
+
 class TPUPodProvider(FakeNodeProvider):
     """Slice-aware provider: every `hosts_per_slice` nodes created for a TPU
     node type share an ici-slice label, so STRICT_PACK placement groups land

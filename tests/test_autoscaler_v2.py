@@ -1,0 +1,164 @@
+"""Autoscaler v2: per-instance FSM + persisted storage + a provider that
+launches REAL worker-node processes (VERDICT r4 #7).
+
+(ref: python/ray/autoscaler/v2/instance_manager/reconciler.py Reconciler
+tests + _private/command_runner.py — here the "cloud" is subprocess.Popen
+and the bootstrap command is the real `ray_tpu worker` join.)
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (Autoscaler, AutoscalerConfig, Instance,
+                                InstanceState, InstanceStorage,
+                                NodeTypeConfig, SubprocessNodeProvider)
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _wait(pred, timeout=60.0, interval=0.1, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+def test_instance_fsm_and_storage_roundtrip(tmp_path):
+    path = str(tmp_path / "instances.json")
+    storage = InstanceStorage(path)
+    inst = Instance(instance_id="inst-1", node_type="w")
+    inst.transition(InstanceState.ALLOCATED, "created")
+    inst.transition(InstanceState.RUNNING, "joined")
+    storage.upsert(inst)
+    with pytest.raises(ValueError):
+        inst.transition(InstanceState.ALLOCATED, "backwards")
+    # Reload from disk: state + history survive.
+    reloaded = InstanceStorage(path).get("inst-1")
+    assert reloaded.state == InstanceState.RUNNING
+    assert [h[0] for h in reloaded.history] == ["ALLOCATED", "RUNNING"]
+
+
+def test_subprocess_provider_kill_and_replace(ray_init, tmp_path):
+    """The v2 'done' gate: a provider-launched REAL worker process is
+    SIGKILLed mid-test; the reconciler marks its instance FAILED (with the
+    cause in the per-instance log) and launches a live replacement."""
+    provider = SubprocessNodeProvider()
+    config = AutoscalerConfig(
+        node_types={"w": NodeTypeConfig(resources={"CPU": 1, "w": 1},
+                                        min_workers=1, max_workers=2)},
+        idle_timeout_s=1e9)
+    scaler = Autoscaler(config, provider,
+                        storage_path=str(tmp_path / "instances.json"))
+    try:
+        r = scaler.update()
+        assert len(r["launched"]) == 1
+        inst = scaler.im.instances(InstanceState.ALLOCATED)[0]
+
+        def joined():
+            scaler.update()
+            return bool(scaler.im.instances(InstanceState.RUNNING))
+
+        _wait(joined, timeout=90, interval=0.5, msg="worker join")
+
+        # The node is real: a task needing its custom resource runs there.
+        @ray_tpu.remote(resources={"w": 1})
+        def where():
+            return os.getpid()
+
+        worker_pid = ray_tpu.get(where.remote(), timeout=60)
+        assert worker_pid != os.getpid()
+
+        # Chaos: SIGKILL the provider-launched process out from under the
+        # autoscaler (the cloud "preempted" it).
+        os.kill(worker_pid, signal.SIGKILL)
+        _wait(lambda: provider.non_terminated_nodes() == [], timeout=30,
+              msg="provider observes death")
+
+        r = scaler.update()
+        assert r["failed"], "reconciler must fail the dead instance"
+        dead = scaler.im.storage.get(r["failed"][0])
+        assert dead.state == InstanceState.FAILED
+        assert "vanished" in dead.history[-1][2]
+        # Same pass (or the next) relaunches the min_workers floor.
+        assert r["launched"] or scaler.update()["launched"]
+        _wait(joined, timeout=90, interval=0.5, msg="replacement join")
+        assert ray_tpu.get(where.remote(), timeout=60) != worker_pid
+    finally:
+        provider.shutdown()
+
+
+def test_persisted_instances_survive_autoscaler_restart(ray_init, tmp_path):
+    """A NEW Autoscaler over the same storage adopts the live instance
+    instead of double-launching (the reconciler-vs-storage diff)."""
+    provider = SubprocessNodeProvider()
+    path = str(tmp_path / "instances.json")
+    config = AutoscalerConfig(
+        node_types={"w": NodeTypeConfig(resources={"CPU": 1, "r": 1},
+                                        min_workers=1, max_workers=2)},
+        idle_timeout_s=1e9)
+    scaler = Autoscaler(config, provider, storage_path=path)
+    try:
+        scaler.update()
+
+        def joined():
+            scaler.update()
+            return bool(scaler.im.instances(InstanceState.RUNNING))
+
+        _wait(joined, timeout=90, interval=0.5, msg="worker join")
+
+        # "Restart" the autoscaler process: same storage, same provider.
+        scaler2 = Autoscaler(config, provider, storage_path=path)
+        r = scaler2.update()
+        assert r["launched"] == [], "adopted instance must not be relaunched"
+        assert len(scaler2.im.instances(InstanceState.RUNNING)) == 1
+    finally:
+        provider.shutdown()
+
+
+def test_up_down_with_subprocess_provider(tmp_path):
+    """`ray_tpu up` on a subprocess-provider YAML: live worker-node
+    processes come up for min_workers and `down` terminates them."""
+    ray_tpu.shutdown()
+    from ray_tpu.autoscaler.launcher import launch_cluster
+
+    yaml = """
+cluster_name: loopback
+max_workers: 3
+provider:
+  type: subprocess
+head_node_type: head
+available_node_types:
+  head:
+    resources: {CPU: 2}
+    min_workers: 0
+  worker:
+    resources: {CPU: 1}
+    min_workers: 1
+    max_workers: 3
+"""
+    handle = launch_cluster(yaml, autoscale=False)
+    try:
+        provider = handle.config.provider
+        _wait(lambda: len(provider.non_terminated_nodes()) == 1,
+              timeout=60, msg="min_workers live process")
+        pid = provider.non_terminated_nodes()[0]
+        sched_id = provider.scheduler_node_id(pid)
+        from ray_tpu._private.runtime import get_runtime
+        _wait(lambda: (get_runtime().scheduler.get_node(sched_id) or
+                       type("N", (), {"alive": False})).alive,
+              timeout=90, msg="worker joined the head")
+    finally:
+        handle.teardown()
+    assert provider.non_terminated_nodes() == []
+    ray_tpu.shutdown()

@@ -29,8 +29,17 @@ def test_runtime_env_validation():
         RuntimeEnv(env_vars={"A": 1})  # non-str value
     with pytest.raises(ValueError):
         RuntimeEnv(bogus_field=True)
+    # pip/uv VALIDATE now (r5: offline wheel-cache materialization); the
+    # network gate moved to stage() — see test_process_tier's env tests.
+    env = RuntimeEnv(pip=["requests"])
     with pytest.raises(RuntimeError):
-        RuntimeEnv(pip=["requests"])  # offline image: gated
+        env.stage()  # no local wheel source: still gated
+    with pytest.raises(RuntimeError):
+        RuntimeEnv(conda={"dependencies": ["x"]})  # conda stays rejected
+    with pytest.raises(ValueError):
+        RuntimeEnv(pip=["a"], uv=["b"])  # one installer at a time
+    with pytest.raises(ValueError):
+        RuntimeEnv(pip=[1, 2])  # requirements must be strings
     env = RuntimeEnv(env_vars={"A": "1"})
     assert env.env_key() == RuntimeEnv(env_vars={"A": "1"}).env_key()
     assert env.env_key() != RuntimeEnv(env_vars={"A": "2"}).env_key()
