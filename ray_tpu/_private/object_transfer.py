@@ -89,6 +89,15 @@ OP_PULL_RANGE = 11
 #: client that can map the path copies the payload with one memcpy and no
 #: socket bytes; anything else falls back to OP_PULL_RANGE.
 OP_REGION = 12
+#: Cross-language task submission: invoke a DRIVER-REGISTERED function by
+#: name with a raw-bytes argument; the reply carries the result ObjectID,
+#: which the caller then pulls like any object.  Name-based registration is
+#: how the reference's cross-language calls work too — a foreign client
+#: cannot produce a Python closure, so the driver publishes the callable
+#: (ref: cross_language.java_function / the C++ entry points in
+#: cpp/include/ray/api/ — reduced to the registry model our pickle-framed
+#: control plane admits).
+OP_INVOKE = 13
 
 ST_OK = 0
 ST_NOT_FOUND = 1
@@ -253,8 +262,10 @@ class ObjectTransferServer:
                  on_borrow_release: Optional[Callable[[ObjectID, str], None]] = None,
                  may_free: Optional[Callable[[ObjectID], bool]] = None,
                  on_borrower_lost: Optional[Callable[[str], None]] = None,
+                 on_invoke: Optional[Callable[[str, bytes], str]] = None,
                  host: str = "127.0.0.1", port: int = 0):
         self._store_provider = store_provider
+        self._on_invoke = on_invoke
         self._on_received = on_received
         self._is_pending = is_pending
         self._on_borrow = on_borrow
@@ -326,6 +337,12 @@ class ObjectTransferServer:
                 elif op == OP_REGION:
                     if not self._handle_region(conn, oid):
                         return  # desynced/dead socket: must not be reused
+                elif op == OP_INVOKE:
+                    (nlen,) = struct.unpack("<H", _recv_exact(conn, 2))
+                    name = _recv_exact(conn, nlen).decode()
+                    (plen,) = struct.unpack("<Q", _recv_exact(conn, 8))
+                    payload = bytes(_recv_into(conn, plen)) if plen else b""
+                    self._handle_invoke(conn, name, payload)
                 elif op == OP_CONTAINS:
                     store = self._store_provider()
                     ok = store is not None and store.contains(oid)
@@ -560,6 +577,25 @@ class ObjectTransferServer:
         finally:
             release()
         return ok
+
+    def _handle_invoke(self, conn: socket.socket, name: str,
+                       payload: bytes) -> None:
+        """Cross-language task submission (OP_INVOKE): run the registered
+        function as a normal task and answer with the result's ObjectID —
+        the caller pulls it with OP_PULL like any other object."""
+        if self._on_invoke is None:
+            conn.sendall(bytes([ST_ERROR]))
+            return
+        try:
+            result_id = self._on_invoke(name, payload)
+        except KeyError:
+            conn.sendall(bytes([ST_NOT_FOUND]))
+            return
+        except Exception:  # noqa: BLE001 — submission (not task) failure
+            conn.sendall(bytes([ST_ERROR]))
+            return
+        idb = str(result_id).encode()
+        conn.sendall(bytes([ST_OK]) + struct.pack("<H", len(idb)) + idb)
 
     @staticmethod
     def _send_failed(conn: socket.socket, store, oid: ObjectID) -> None:

@@ -300,6 +300,10 @@ class Runtime:
         from ray_tpu._private.borrowing import BorrowLedger
 
         self._borrows = BorrowLedger()
+        #: Cross-language registry + a bounded pin window for results the
+        #: foreign caller hasn't pulled yet (see register_cross_lang).
+        self._cross_lang_fns: Dict[str, Any] = {}
+        self._cross_lang_results: deque = deque(maxlen=256)
 
         # OOM defense over busy process workers (ref: memory_monitor.h:52).
         self._leased_workers: Dict[int, "_LeasedWorker"] = {}
@@ -476,6 +480,7 @@ class Runtime:
                 is_pending=self._object_is_pending,
                 on_borrow=self._on_remote_borrow,
                 on_borrow_release=self._on_remote_borrow_release,
+                on_invoke=self._cross_lang_invoke,
                 may_free=lambda oid: (
                     self.refcounter.count(oid) == 0
                     and not self._borrow_ledger().is_borrowed(oid)),
@@ -483,6 +488,30 @@ class Runtime:
                 host=self.config.object_transfer_host)
         self._pull_manager()  # pulls and serves share a lifetime
         return self.object_server.addr
+
+    # ---------------------------------------------------- cross-language
+    def register_cross_lang(self, name: str, fn) -> None:
+        """Publish `fn` for name-based invocation by non-Python clients
+        over the object plane (OP_INVOKE; the registry model of the
+        reference's cross-language calls — a C++ caller cannot produce a
+        Python closure, so the driver registers the callable).  `fn`
+        receives the caller's raw bytes payload and should return bytes
+        (the shape the C++ client's pickle codec speaks)."""
+        self._cross_lang_fns[name] = fn
+
+    def _cross_lang_invoke(self, name: str, payload: bytes) -> str:
+        fn = self._cross_lang_fns.get(name)
+        if fn is None:
+            raise KeyError(name)
+        import ray_tpu
+
+        ref = ray_tpu.remote(fn).remote(payload)
+        # Pin: the driver drops its reference immediately, but the foreign
+        # caller still has to pull the result — keep a bounded window of
+        # recent results alive (the caller cannot participate in the
+        # borrower protocol).
+        self._cross_lang_results.append(ref)
+        return str(ref.id)
 
     # Borrowing protocol (owner side) — a borrowed object survives the local
     # refcount hitting zero until every borrower releases

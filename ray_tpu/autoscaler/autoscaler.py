@@ -80,6 +80,11 @@ class Autoscaler:
                 GLOBAL_CONFIG.session_dir,
                 f"autoscaler-{config.cluster_name}-instances.json")
         self.im = InstanceManager(InstanceStorage(storage_path))
+        #: Serializes update()/_launch: the stale-REQUESTED sweep assumes
+        #: no create_node is in flight, which only holds when reconcile
+        #: passes (Monitor thread + any direct caller) are mutually
+        #: exclusive.  RLock because update() calls _launch.
+        self._reconcile_lock = threading.RLock()
         # Adoption: a restarted autoscaler keeps persisted instances whose
         # provider nodes still exist, and immediately fails the rest — a
         # stale table (crashed run, earlier cluster in the same session)
@@ -103,6 +108,10 @@ class Autoscaler:
     def update(self) -> dict:
         """One reconcile pass; returns {"launched": [...], "terminated":
         [...], "failed": [...]} (provider node ids / instance ids)."""
+        with self._reconcile_lock:
+            return self._update_locked()
+
+    def _update_locked(self) -> dict:
         launched: List[str] = []
         terminated: List[str] = []
 
@@ -202,6 +211,10 @@ class Autoscaler:
         return sum(self.im.active_counts().values()) >= cap
 
     def _launch(self, type_name: str) -> Optional[str]:
+        with self._reconcile_lock:
+            return self._launch_locked(type_name)
+
+    def _launch_locked(self, type_name: str) -> Optional[str]:
         cfg = self.config.node_types[type_name]
         inst = self.im.request(type_name)
         try:

@@ -117,7 +117,10 @@ class InstanceStorage:
         terminal = sorted(
             (i for i in self._instances.values() if i.state in TERMINAL_STATES),
             key=lambda i: i.created_at)
-        for inst in terminal[:-keep] if keep else terminal:
+        doomed = terminal[:-keep] if keep else terminal
+        if not doomed:
+            return  # nothing changed: skip the snapshot rewrite
+        for inst in doomed:
             del self._instances[inst.instance_id]
         self._flush()
 

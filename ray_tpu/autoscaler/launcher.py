@@ -173,7 +173,9 @@ def launch_cluster(source: Any, *, autoscale: bool = True) -> ClusterHandle:
         for _ in range(tcfg.min_workers):
             if autoscaler._at_total_cap():
                 break
-            worker_ids.append(autoscaler._launch(tname))
+            pid = autoscaler._launch(tname)
+            if pid:
+                worker_ids.append(pid)
     monitor = Monitor(autoscaler).start() if autoscale else None
     return ClusterHandle(config, autoscaler, monitor, worker_ids)
 

@@ -33,6 +33,7 @@ namespace {
 constexpr uint8_t kOpPull = 1;
 constexpr uint8_t kOpContains = 2;
 constexpr uint8_t kOpPush = 3;
+constexpr uint8_t kOpInvoke = 13;
 
 constexpr uint8_t kStOk = 0;
 constexpr uint8_t kStNotFound = 1;
@@ -253,6 +254,31 @@ class ObjectClient {
     throw std::runtime_error("object still pending after retries");
   }
 
+  // Cross-language task submission: run a DRIVER-REGISTERED function by
+  // name with a raw-bytes payload (ref: the reference's C++ task API,
+  // cpp/include/ray/api/ — reduced to the name-registry model a
+  // pickle-framed control plane admits).  Returns the result's ObjectID;
+  // pull it with get_bytes (which retries while the task runs).
+  std::string invoke(const std::string& fn_name, const std::string& payload) {
+    std::string req = header(kOpInvoke, "");
+    put_le<uint16_t>(&req, static_cast<uint16_t>(fn_name.size()));
+    req += fn_name;
+    put_le<uint64_t>(&req, payload.size());
+    req += payload;
+    write_all(fd_, req.data(), req.size());
+    uint8_t st;
+    read_all(fd_, &st, 1);
+    if (st == kStNotFound)
+      throw std::runtime_error("no function registered under that name");
+    if (st != kStOk) throw std::runtime_error("invoke rejected");
+    uint8_t len2[2];
+    read_all(fd_, len2, 2);
+    uint16_t n = get_le<uint16_t>(len2);
+    std::string rid(n, '\0');
+    if (n > 0) read_all(fd_, &rid[0], n);
+    return rid;
+  }
+
  private:
   int fd_ = -1;
 };
@@ -262,11 +288,13 @@ class ObjectClient {
 #ifdef RAY_TPU_CLIENT_MAIN
 #include <cstdio>
 
-// Demo/interop binary: pull one object, push one object, verify contains.
-//   ray_tpu_cpp_client <host> <port> <get_id> <put_id>
+// Demo/interop binary: pull one object, push one object, verify contains;
+// optionally submit a registered function as a task and print its result.
+//   ray_tpu_cpp_client <host> <port> <get_id> <put_id> [fn_name payload]
 int main(int argc, char** argv) {
-  if (argc != 5) {
-    std::fprintf(stderr, "usage: %s host port get_id put_id\n", argv[0]);
+  if (argc != 5 && argc != 7) {
+    std::fprintf(stderr, "usage: %s host port get_id put_id [fn payload]\n",
+                 argv[0]);
     return 2;
   }
   try {
@@ -280,6 +308,11 @@ int main(int argc, char** argv) {
       return 1;
     }
     std::printf("PUSHED %s %s\n", argv[4], payload.c_str());
+    if (argc == 7) {
+      std::string rid = client.invoke(argv[5], argv[6]);
+      std::string result = client.get_bytes(rid);
+      std::printf("INVOKED %s %s\n", rid.c_str(), result.c_str());
+    }
     return 0;
   } catch (const std::exception& e) {
     std::fprintf(stderr, "error: %s\n", e.what());

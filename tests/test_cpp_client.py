@@ -69,3 +69,36 @@ def test_cpp_client_large_value_and_missing_object(rt):
         capture_output=True, text=True, timeout=60)
     assert out.returncode == 1
     assert "not found" in out.stderr
+
+
+def test_cpp_client_invokes_registered_task(rt):
+    """Cross-language task submission (VERDICT r4 #10): the C++ binary
+    submits a DRIVER-REGISTERED function by name over OP_INVOKE, the owner
+    runs it as a real task, and the C++ side pulls the result bytes."""
+    from ray_tpu._private.runtime import get_runtime
+    from ray_tpu.native.build import cpp_client_binary
+
+    binary = cpp_client_binary()
+    runtime = get_runtime()
+    addr = runtime.start_object_server()
+    host, _, port = addr.rpartition(":")
+
+    def shout(payload: bytes) -> bytes:
+        return payload.upper() + b"!"
+
+    runtime.register_cross_lang("shout", shout)
+    ref = ray_tpu.put(b"seed")
+    out = subprocess.run(
+        [binary, host, port, str(ref.id), "cppinv:0", "shout", "from-cpp"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    invoked = [ln for ln in out.stdout.splitlines()
+               if ln.startswith("INVOKED")]
+    assert invoked and invoked[0].endswith("FROM-CPP!"), out.stdout
+
+    # Unregistered name: clean error, not a hang or desync.
+    out = subprocess.run(
+        [binary, host, port, str(ref.id), "cppinv:1", "nosuch", "x"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 1
+    assert "no function registered" in out.stderr
