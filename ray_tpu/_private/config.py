@@ -77,6 +77,17 @@ class Config:
     #: socket path automatically when the peer's arena isn't mappable
     #: (true remote host).
     same_host_handoff: bool = True
+    #: Broadcast fan-out tree (ref: the reference's 1 GiB x 50-node broadcast
+    #: anchor): when N nodes pull the same large object, the owner serves at
+    #: most ``broadcast_tree_fanout`` concurrent direct streams and redirects
+    #: later pullers to peers that already hold a complete copy, so owner
+    #: egress grows with the fanout, not with N.
+    broadcast_tree_enabled: bool = True
+    #: Objects below this size skip the tree (the extra negotiation
+    #: round-trip isn't worth it; the owner just serves them directly).
+    broadcast_tree_min_bytes: int = 32 << 20
+    #: Concurrent direct-from-owner streams before redirecting to peers.
+    broadcast_tree_fanout: int = 2
 
     #: Rendezvous bound for in-process collective ops: a lost/wedged rank
     #: fails the other participants after this long instead of holding

@@ -217,9 +217,29 @@ import threading as _snap_threading
 import weakref as _snap_weakref
 
 _SNAP_BUDGET = _snap_threading.Semaphore(8)
+_SNAP_DEADLINE_S = 5.0  # hard per-round deadline on node_info fan-out
 _SNAP_CACHE: "_snap_weakref.WeakKeyDictionary" = \
     _snap_weakref.WeakKeyDictionary()  # runtime -> (expires, details)
+_SNAP_INFLIGHT: "_snap_weakref.WeakKeyDictionary" = \
+    _snap_weakref.WeakKeyDictionary()  # runtime -> {node_id: fetch wedged}
 _SNAP_LOCK = _snap_threading.Lock()
+
+
+def _release_token():
+    """One-shot semaphore release shared between a fetch thread and the
+    round's deadline sweep: whoever fires first releases the slot, the
+    other call is a no-op.  Without this, a node_info wedged in conn.send
+    (full pipe to a stalled node — the ONLY unbounded block in that stack;
+    the reply wait is Event-bounded) held its slot forever, and 8 wedged
+    nodes silently zeroed the dashboard's node-detail budget for the rest
+    of the process lifetime."""
+    once = _snap_threading.Lock()
+
+    def release():
+        if once.acquire(blocking=False):
+            _SNAP_BUDGET.release()
+
+    return release
 
 
 def _node_details(runtime, remote) -> dict:
@@ -231,32 +251,52 @@ def _node_details(runtime, remote) -> dict:
         ent = _SNAP_CACHE.get(runtime)
         if ent is not None and ent[0] > now:
             return ent[1]
+        inflight = _SNAP_INFLIGHT.setdefault(runtime, set())
 
     details: dict = {}
 
-    def fetch(nid, rn):
+    def fetch(nid, rn, release):
         try:
             details[nid] = runtime.node_server.node_info(rn, detail="summary")
         except Exception as e:  # noqa: BLE001
             details[nid] = {"error": repr(e)}
         finally:
-            _SNAP_BUDGET.release()
+            release()
+            with _SNAP_LOCK:
+                inflight.discard(nid)
 
     threads = []
     for nid, rn in remote.items():
+        with _SNAP_LOCK:
+            if nid in inflight:
+                # A previous round's fetch never returned: don't stack a
+                # second thread behind the same wedged node.
+                details[nid] = {"error": "previous info fetch still wedged"}
+                continue
         if not _SNAP_BUDGET.acquire(blocking=False):
             break  # every slot wedged on slow nodes: omit the rest
-        try:
-            t = _threading.Thread(target=fetch, args=(nid, rn),
+        release = _release_token()
+        with _SNAP_LOCK:
+            inflight.add(nid)  # BEFORE start: a fast fetch must not discard
+        try:                   # first and leave a phantom inflight entry
+            t = _threading.Thread(target=fetch, args=(nid, rn, release),
                                   name="dash-snap", daemon=True)
             t.start()
         except RuntimeError:
-            _SNAP_BUDGET.release()  # start failed: fetch's finally never runs
+            release()  # start failed: fetch's finally never runs
+            with _SNAP_LOCK:
+                inflight.discard(nid)
             break
-        threads.append(t)
-    deadline = _time.monotonic() + 5.0
-    for t in threads:
+        threads.append((t, release))
+    deadline = _time.monotonic() + _SNAP_DEADLINE_S
+    for t, release in threads:
         t.join(timeout=max(0.0, deadline - _time.monotonic()))
+        if t.is_alive():
+            # Hard deadline: reclaim the slot NOW (the fetch's own release
+            # becomes a no-op).  The node stays marked inflight until its
+            # thread actually finishes, so later rounds skip it instead of
+            # leaking one thread per refresh.
+            release()
     if threads:
         # Never cache a zero-fetch round: a concurrent miss that lost every
         # semaphore slot must not overwrite a just-cached complete snapshot

@@ -193,6 +193,14 @@ class ReferenceCounter:
         with self._lock:
             self._counts[object_id] = self._counts.get(object_id, 0) + n
 
+    def add_many(self, object_ids) -> None:
+        """One lock round-trip for a batch of new handles (multi-return
+        submits, 10k-ref arg lists)."""
+        with self._lock:
+            counts = self._counts
+            for oid in object_ids:
+                counts[oid] = counts.get(oid, 0) + 1
+
     def remove(self, object_id: ObjectID, n: int = 1) -> None:
         cb = None
         with self._lock:

@@ -210,10 +210,44 @@ class ObjectStore:
             e = self._entries.get(object_id)
             return e.size if e is not None else 0
 
+    def size_hint(self, object_id: ObjectID) -> int:
+        """Best-effort byte size WITHOUT serializing: the recorded size
+        when known, else a cheap len/nbytes probe of a value-tier entry.
+        The broadcast-tree gate needs this — a 1 GiB value put()'s size is
+        otherwise unknown until its first pull serializes it, which would
+        let every concurrent cold puller bypass the tree."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None:
+                return 0
+            if e.size:
+                return e.size
+            if not e.has_value:
+                return 0
+            v = e.value
+        n = getattr(v, "nbytes", None)
+        if isinstance(n, int):
+            return n
+        if isinstance(v, (bytes, bytearray, memoryview)):
+            return len(v)
+        return 0
+
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
             e = self._entries.get(object_id)
             return e is not None and e.state in (ObjectState.READY, ObjectState.SPILLED, ObjectState.FAILED)
+
+    def contains_many(self, object_ids) -> List[bool]:
+        """One lock pass over a batch (10k-arg calls would otherwise pay
+        one lock round-trip per ref)."""
+        resolved = (ObjectState.READY, ObjectState.SPILLED, ObjectState.FAILED)
+        with self._lock:
+            entries = self._entries
+            out = []
+            for oid in object_ids:
+                e = entries.get(oid)
+                out.append(e is not None and e.state in resolved)
+            return out
 
     def state_of(self, object_id: ObjectID) -> Optional[str]:
         """Entry state without creating an entry (None = never seen)."""
@@ -233,6 +267,51 @@ class ObjectStore:
 
             raise GetTimeoutError(f"Timed out getting object {object_id}")
         return self._materialize(object_id, entry)
+
+    def try_get_many(self, object_ids) -> Tuple[List[Any], List[int]]:
+        """Vectorized non-blocking get: ``(values, missing_indexes)``.
+
+        One lock pass resolves every entry whose deserialized value is
+        already in the primary tier (the overwhelmingly common in-process
+        case); entries that need deserialization or a spill restore are
+        materialized after the pass, and anything unresolved (pending,
+        failed, freed, lost) is reported in ``missing_indexes`` for the
+        caller's per-object slow path.  Never raises and never blocks —
+        the slow path owns error/reconstruction semantics."""
+        n = len(object_ids)
+        values: List[Any] = [None] * n
+        missing: List[int] = []
+        slow: List[int] = []
+        now = time.monotonic()
+        with self._lock:
+            entries = self._entries
+            hits = 0
+            for i in range(n):
+                e = entries.get(object_ids[i])
+                if e is not None and e.state == ObjectState.READY and e.has_value:
+                    values[i] = e.value
+                    e.last_access = now
+                    hits += 1
+                elif e is not None and e.state in (ObjectState.READY,
+                                                   ObjectState.SPILLED):
+                    slow.append(i)
+                else:
+                    missing.append(i)
+            self.stats["gets"] += hits
+        for i in slow:
+            oid = object_ids[i]
+            with self._lock:
+                e = self._entries.get(oid)
+            if e is None:
+                missing.append(i)
+                continue
+            try:
+                values[i] = self._materialize(oid, e)
+            except BaseException:  # noqa: BLE001 — lost/freed mid-batch
+                missing.append(i)
+        if slow and missing:
+            missing.sort()
+        return values, missing
 
     def get_error(self, object_id: ObjectID) -> Optional[BaseException]:
         with self._lock:

@@ -43,6 +43,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Dict, Optional, Tuple
 
 from ray_tpu._private.config import GLOBAL_CONFIG
@@ -98,6 +99,20 @@ OP_REGION = 12
 #: cpp/include/ray/api/ — reduced to the registry model our pickle-framed
 #: control plane admits).
 OP_INVOKE = 13
+#: Broadcast fan-out tree (ref: the reference's 1-GiB-to-50-nodes broadcast;
+#: object_manager location subscriptions): before pulling a LARGE object, a
+#: node asks the owner where to pull FROM, carrying its own object-server
+#: address.  The owner serves at most ``broadcast_tree_fanout`` direct
+#: streams; once peers complete (they OP_ANNOUNCE), later requesters are
+#: redirected to those peers — so an N-node broadcast forms a pull tree and
+#: owner egress stays O(fanout), not O(N).  Request: id + alen:u16 + addr.
+#: Reply: status:u8 [ok: alen:u16 addr] — an empty/own address means "pull
+#: from me"; ST_PENDING means every slot is busy and no holder exists yet
+#: (retry shortly).
+OP_PULL_LOC = 14
+#: Completion report for the tree: "requester at <addr> now holds <id>"
+#: (frees its grant slot and registers it as a redirect target).
+OP_ANNOUNCE = 15
 
 ST_OK = 0
 ST_NOT_FOUND = 1
@@ -287,6 +302,22 @@ class ObjectTransferServer:
         #: floor and wedge the channel in ST_FULL forever.
         self._chan_next: Dict[str, int] = {}
         self._chan_lock = threading.Lock()
+        #: Broadcast-tree coordination state, per object id:
+        #:   grants: requester addr -> (source addr or "" for owner-direct,
+        #:           grant timestamp) — outstanding transfers this owner
+        #:           handed out; stale grants (requester died mid-pull)
+        #:           expire lazily.
+        #:   holders: requester addrs that announced a complete copy —
+        #:           redirect targets for later pullers.
+        self._bcast: Dict[ObjectID, dict] = {}
+        self._bcast_lock = threading.Lock()
+        #: Egress accounting (proves the tree works: owner egress must grow
+        #: sub-linearly in node count).  Socket sends AND same-host region
+        #: handoffs both count — a handoff moves the bytes out of this
+        #: node's arena just like a send would.
+        self.egress = {"pull_bytes": 0, "handoff_bytes": 0,
+                       "by_object": {}, "redirects": 0}
+        self._egress_lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -347,6 +378,13 @@ class ObjectTransferServer:
                     store = self._store_provider()
                     ok = store is not None and store.contains(oid)
                     conn.sendall(bytes([ST_OK if ok else ST_NOT_FOUND]))
+                elif op in (OP_PULL_LOC, OP_ANNOUNCE):
+                    (alen,) = struct.unpack("<H", _recv_exact(conn, 2))
+                    requester = _recv_exact(conn, alen).decode() if alen else ""
+                    if op == OP_PULL_LOC:
+                        self._handle_pull_loc(conn, oid, requester)
+                    else:
+                        self._handle_announce(conn, oid, requester)
                 elif op == OP_PUSH:
                     self._handle_push(conn, oid)
                 elif op == OP_FREE:
@@ -503,6 +541,7 @@ class ObjectTransferServer:
                 total, chunk = sr
                 conn.sendall(bytes([ST_OK]) + struct.pack("<Q", total))
                 _send_payload(conn, chunk)
+                self._account_egress(oid, len(chunk), handoff=False)
                 return
         resolved = self._resolve_serialized(conn, oid)
         if resolved is None:
@@ -519,6 +558,7 @@ class ObjectTransferServer:
                 conn.sendall(bytes([ST_OK]) + struct.pack("<Q", size))
                 if n:
                     _send_region(conn, store, fd, roff + off, n)
+                    self._account_egress(oid, n, handoff=False)
             finally:
                 release()
             return
@@ -531,6 +571,7 @@ class ObjectTransferServer:
         payload = bytes(view[off:off + n])
         conn.sendall(bytes([ST_OK]) + struct.pack("<Q", total))
         _send_payload(conn, payload)
+        self._account_egress(oid, n, handoff=False)
 
     def _handle_region(self, conn: socket.socket, oid: ObjectID) -> bool:
         """Same-host handoff: answer with the pinned arena region's
@@ -576,7 +617,123 @@ class ObjectTransferServer:
                 conn.settimeout(prev)
         finally:
             release()
+        if ok:
+            self._account_egress(oid, size, handoff=True)
         return ok
+
+    # ------------------------------------------------- broadcast tree
+    def _account_egress(self, oid: ObjectID, n: int, handoff: bool) -> None:
+        if n <= 0:
+            return
+        with self._egress_lock:
+            key = "handoff_bytes" if handoff else "pull_bytes"
+            self.egress[key] += n
+            per = self.egress["by_object"]
+            if str(oid) in per or len(per) < 1024:
+                per[str(oid)] = per.get(str(oid), 0) + n
+
+    def stats(self) -> dict:
+        """Egress snapshot (bench/observability; see BENCH_ENVELOPE)."""
+        with self._egress_lock:
+            out = dict(self.egress)
+            out["by_object"] = dict(out["by_object"])
+            return out
+
+    def _bcast_state(self, oid: ObjectID) -> dict:
+        st = self._bcast.get(oid)
+        if st is None:
+            if len(self._bcast) >= 1024:
+                # Best-effort state: evicting just means the evicted
+                # object's later pullers go owner-direct again.
+                self._bcast.pop(next(iter(self._bcast)))
+            st = self._bcast[oid] = {"grants": {}, "holders": []}
+        else:
+            # Lazily expire grants whose requester died mid-pull (no
+            # announce ever comes) so their owner slots aren't leaked.
+            ttl = 2 * GLOBAL_CONFIG.object_transfer_pull_timeout_s
+            now = time.monotonic()
+            stale = [a for a, (_, t0) in st["grants"].items()
+                     if now - t0 > ttl]
+            for a in stale:
+                del st["grants"][a]
+        return st
+
+    def _handle_pull_loc(self, conn: socket.socket, oid: ObjectID,
+                         requester: str) -> None:
+        """Tree negotiation: tell the requester where to pull ``oid`` from.
+
+        Owner-direct grants are capped at ``broadcast_tree_fanout``
+        concurrent streams; beyond that, requesters are redirected to the
+        least-loaded peer that already announced a complete copy, or told
+        ST_PENDING to retry when no such peer exists yet.  Small or
+        not-yet-serialized objects short-circuit to owner-direct — the
+        tree only pays off on large payloads.
+
+        Reply: ST_OK + tree:u8 + alen:u16 + addr.  ``tree=0`` means the
+        tree is not engaged (small object): pull directly and do NOT
+        announce; ``tree=1`` means the requester holds a grant and must
+        OP_ANNOUNCE when its copy lands.  An empty addr = "pull from me"."""
+        def reply(addr: str, tree: bool) -> None:
+            ab = addr.encode()
+            conn.sendall(bytes([ST_OK, 1 if tree else 0])
+                         + struct.pack("<H", len(ab)) + ab)
+
+        store = self._store_provider()
+        if store is None or not store.contains(oid):
+            pending = self._is_pending is not None and self._is_pending(oid)
+            conn.sendall(bytes([ST_PENDING if pending else ST_NOT_FOUND]))
+            return
+        size = store.size_hint(oid) if hasattr(store, "size_hint") else 0
+        if (not GLOBAL_CONFIG.broadcast_tree_enabled or not requester
+                or size < GLOBAL_CONFIG.broadcast_tree_min_bytes):
+            # size == 0 means "not yet serialized" — the first direct pull
+            # serializes into the arena, after which later negotiations see
+            # the real size and the tree engages.
+            reply("", False)
+            return
+        with self._bcast_lock:
+            st = self._bcast_state(oid)
+            grants = st["grants"]
+            if requester in grants:
+                # Re-negotiation (retry after a failed pull): re-issue as
+                # owner-direct so one bad peer can't wedge the requester.
+                grants[requester] = ("", time.monotonic())
+                reply("", True)
+                return
+            holders = [h for h in st["holders"] if h != requester]
+            if holders:
+                load = {h: 0 for h in holders}
+                for src, _ in grants.values():
+                    if src in load:
+                        load[src] += 1
+                pick = min(holders, key=lambda h: load[h])
+                grants[requester] = (pick, time.monotonic())
+                with self._egress_lock:
+                    self.egress["redirects"] += 1
+                reply(pick, True)
+                return
+            active = sum(1 for src, _ in grants.values() if not src)
+            if active < max(1, GLOBAL_CONFIG.broadcast_tree_fanout):
+                grants[requester] = ("", time.monotonic())
+                reply("", True)
+                return
+        # Every owner slot busy and nobody complete yet: retry shortly —
+        # by then either a slot freed or a holder announced.
+        conn.sendall(bytes([ST_PENDING]))
+
+    def _handle_announce(self, conn: socket.socket, oid: ObjectID,
+                         requester: str) -> None:
+        """A granted puller completed: free its slot, register it as a
+        redirect target for later pullers."""
+        with self._bcast_lock:
+            st = self._bcast.get(oid)
+            if st is not None:
+                st["grants"].pop(requester, None)
+                if requester and requester not in st["holders"]:
+                    st["holders"].append(requester)
+            elif requester and len(self._bcast) < 1024:
+                self._bcast[oid] = {"grants": {}, "holders": [requester]}
+        conn.sendall(bytes([ST_OK]))
 
     def _handle_invoke(self, conn: socket.socket, name: str,
                        payload: bytes) -> None:
@@ -859,7 +1016,10 @@ class PullManager:
         #: addr -> pooled idle connections to that peer's object server.
         self._socks: Dict[str, list] = {}
         self.stats = {"pulls": 0, "pull_bytes": 0, "dedup_hits": 0,
-                      "failures": 0, "handoffs": 0, "handoff_bytes": 0}
+                      "failures": 0, "handoffs": 0, "handoff_bytes": 0,
+                      #: source addr -> bytes pulled from it (broadcast-tree
+                      #: evidence: followers' bytes spread across peers).
+                      "sources": {}}
 
     # ------------------------------------------------------------------ async
     def request(self, oid: ObjectID, addr: str) -> None:
@@ -988,6 +1148,120 @@ class PullManager:
 
     def _fetch(self, oid: ObjectID, addr: str,
                timeout: Optional[float] = None) -> Tuple[str, object]:
+        """Tree-aware pull (ref: the reference's location-directed pulls):
+        ask the owner where to pull from first, so an N-node broadcast of a
+        large object forms a fan-out tree instead of N direct streams.  A
+        failed peer pull falls back to the owner; per-source byte counts
+        land in ``stats["sources"]`` (the bench's sub-linearity evidence).
+        """
+        src, engaged = addr, False
+        me = local_server_addr()
+        if (GLOBAL_CONFIG.broadcast_tree_enabled and addr and me
+                and me != addr):
+            got = self._negotiate_source(oid, addr, timeout)
+            if got is not None:
+                peer, engaged = got
+                if peer:
+                    src = peer
+        try:
+            result = self._fetch_direct(oid, src, timeout)
+        except _RemoteTaskFailed:
+            raise
+        except Exception:
+            if src == addr:
+                raise
+            # The peer we were redirected to failed us: the owner still
+            # holds the primary copy — pull it directly.
+            src = addr
+            result = self._fetch_direct(oid, addr, timeout)
+        if engaged:
+            self._announce(oid, addr)
+        size = result[1] if result[0] == "landed" else len(result[1])
+        with self._lock:
+            srcs = self.stats.setdefault("sources", {})
+            srcs[src] = srcs.get(src, 0) + size
+        return result
+
+    def _negotiate_source(self, oid: ObjectID, owner: str,
+                          timeout: Optional[float]):
+        """OP_PULL_LOC round-trips with the owner until it names a source.
+
+        Returns ``(source_addr, tree_engaged)`` — empty source means pull
+        from the owner itself — or ``None`` when negotiation can't be used
+        (owner unreachable / predates the op / object unknown there) and
+        the caller should just pull directly without announcing."""
+        me = local_server_addr().encode()
+        req = _req_header(OP_PULL_LOC, oid) \
+            + struct.pack("<H", len(me)) + me
+        deadline = None if timeout is None else time.monotonic() + timeout
+        stale = 0
+        while True:
+            sock_timeout = GLOBAL_CONFIG.object_transfer_pull_timeout_s
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"pull of {oid} from {owner} timed out (negotiation)")
+                sock_timeout = min(sock_timeout, max(remaining, 0.05))
+            try:
+                sock, reused = self._borrow_sock(owner, sock_timeout)
+            except OSError:
+                return None
+            ok = False
+            try:
+                sock.sendall(req)
+                status = _recv_exact(sock, 1)[0]
+                if status == ST_OK:
+                    tree = _recv_exact(sock, 1)[0] != 0
+                    (alen,) = struct.unpack("<H", _recv_exact(sock, 2))
+                    srcb = _recv_exact(sock, alen) if alen else b""
+                    ok = True
+                    return (srcb.decode(), tree)
+                if status != ST_PENDING:
+                    return None
+                ok = True
+            except (ConnectionError, OSError):
+                if reused and stale < 4:
+                    stale += 1
+                    continue
+                return None
+            finally:
+                if ok:
+                    self._return_sock(owner, sock)
+                else:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            time.sleep(0.05)
+
+    def _announce(self, oid: ObjectID, owner: str) -> None:
+        """Fire-and-forget completion report: frees our grant slot on the
+        owner and registers us as a redirect target for later pullers."""
+        me = local_server_addr().encode()
+        try:
+            sock, _ = self._borrow_sock(
+                owner, GLOBAL_CONFIG.object_transfer_pull_timeout_s)
+        except OSError:
+            return
+        ok = False
+        try:
+            sock.sendall(_req_header(OP_ANNOUNCE, oid)
+                         + struct.pack("<H", len(me)) + me)
+            ok = _recv_exact(sock, 1)[0] == ST_OK
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            if ok:
+                self._return_sock(owner, sock)
+            else:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _fetch_direct(self, oid: ObjectID, addr: str,
+                      timeout: Optional[float] = None) -> Tuple[str, object]:
         """One logical pull; retries while the owner answers ST_PENDING.
 
         Returns ``("landed", size)`` when the payload was received straight

@@ -50,8 +50,10 @@ from ray_tpu._private.object_store import ObjectStore
 from ray_tpu._private.process_pool import ProcessPool
 from ray_tpu._private.scheduling import (
     ClusterScheduler,
+    DefaultStrategy,
     PlacementGroupSchedulingStrategy,
     SchedulingStrategy,
+    SpreadStrategy,
 )
 from ray_tpu._private.task_spec import (ActorSpec, TaskSpec,
                                         EXEC_FN_METHOD)
@@ -858,16 +860,23 @@ class Runtime:
         for r in ref_list:
             if not isinstance(r, ObjectRef):
                 raise TypeError(f"get() expects ObjectRef(s), got {type(r)}")
+        # Vectorized fast path: one store pass resolves every ref whose
+        # value is already local (the 10k-object get anchor); only the
+        # stragglers take the per-ref slow path (pulls, reconstruction,
+        # inflight waits).
+        values, missing = self.store.try_get_many([r.id for r in ref_list])
+        if not missing:
+            return values[0] if single else values
         ctx = current_task_context()
         released = False
         if ctx is not None and ctx.lease_release is not None:
             # Release this task's resources while blocked (the reference
             # releases CPU while a worker blocks in ray.get).
-            if not all(self.store.contains(r.id) for r in ref_list):
-                ctx.lease_release()
-                released = True
+            ctx.lease_release()
+            released = True
         try:
-            values = [self._get_one(r, timeout) for r in ref_list]
+            for i in missing:
+                values[i] = self._get_one(ref_list[i], timeout)
         finally:
             if released:
                 ctx.lease_reacquire()
@@ -1035,10 +1044,13 @@ class Runtime:
         return self._submit_task_inner(spec)
 
     def _submit_task_inner(self, spec: TaskSpec) -> Any:
-        refs = [
-            ObjectRef(ObjectID.for_task_return(spec.task_id, i), owner=self.worker_id)
-            for i in range(spec.num_returns)
-        ]
+        # Batched ownership bookkeeping: one refcounter pass for all return
+        # handles instead of one lock round-trip per ref.
+        oids = [ObjectID.for_task_return(spec.task_id, i)
+                for i in range(spec.num_returns)]
+        self.refcounter.add_many(oids)
+        refs = [ObjectRef(oid, owner=self.worker_id, _add_ref=False)
+                for oid in oids]
         with self._lineage_lock:
             for ref in refs:
                 self._lineage[ref.id] = spec
@@ -1054,9 +1066,15 @@ class Runtime:
         return refs[0] if spec.num_returns == 1 else refs
 
     def _enqueue_after_deps(self, spec: TaskSpec) -> None:
+        ref_args = [a for a in list(spec.args) + list(spec.kwargs.values())
+                    if isinstance(a, ObjectRef)]
+        if not ref_args:
+            self._ready.put(spec)
+            return
         deps = set()
-        for a in list(spec.args) + list(spec.kwargs.values()):
-            if isinstance(a, ObjectRef) and not self.store.contains(a.id):
+        present = self.store.contains_many([a.id for a in ref_args])
+        for a, here in zip(ref_args, present):
+            if not here:
                 if self.location_of(a.id):
                     # Produced, held by a worker node: the EXECUTING side
                     # pulls it on demand (it may be dispatched right back
@@ -1074,7 +1092,9 @@ class Runtime:
             self._ready.put(spec)
             return
         with self._deps_lock:
-            still = {d for d in deps if not self.store.contains(d)}
+            dep_list = list(deps)
+            landed = self.store.contains_many(dep_list)
+            still = {d for d, here in zip(dep_list, landed) if not here}
             if not still:
                 self._ready.put(spec)
                 return
@@ -1126,14 +1146,45 @@ class Runtime:
             self._retry_pending = True
             self._ready.put(_RETRY_BLOCKED)
 
+    @staticmethod
+    def _placement_shape(spec: TaskSpec) -> tuple:
+        """Bucket key under which blocked specs are interchangeable for
+        placement feasibility: same resource demand + same strategy
+        semantics.  Stateless strategies collapse into one bucket per
+        demand shape; parameterized strategies (affinity, labels, PGs)
+        bucket per instance — correct, and they are never the 1M-task
+        storm case."""
+        res = tuple(sorted(spec.resources.items())) if spec.resources else ()
+        strat = spec.strategy
+        if strat is None or type(strat) is DefaultStrategy:
+            return (res, "DEFAULT")
+        if type(strat) is SpreadStrategy:
+            return (res, "SPREAD")
+        return (res, id(strat))
+
     def _dispatch_loop(self) -> None:
-        blocked: List[TaskSpec] = []
+        # Blocked tasks live in per-placement-shape FIFO queues: a capacity
+        # event probes one head per shape instead of rescanning every
+        # blocked spec.  The old flat list retried O(blocked) specs per
+        # release and removed with O(blocked) list scans — quadratic once
+        # a 1M-task backlog forms behind a busy cluster; this is
+        # O(shapes + dispatched) per release.
+        blocked: Dict[tuple, deque] = {}
+        blocked_n = 0
 
         def retry_blocked() -> None:
-            for spec in list(blocked):
-                if self._try_dispatch(spec):
-                    blocked.remove(spec)
-            self._blocked_count = len(blocked)
+            nonlocal blocked_n
+            for key in list(blocked):
+                q = blocked.get(key)
+                while q:
+                    if self._try_dispatch(q[0]):
+                        q.popleft()
+                        blocked_n -= 1
+                    else:
+                        break  # shape doesn't fit now; next bucket
+                if not q:
+                    blocked.pop(key, None)
+            self._blocked_count = blocked_n
 
         while not self._dispatcher_stop.is_set():
             try:
@@ -1149,9 +1200,21 @@ class Runtime:
                 self._retry_pending = False
                 retry_blocked()
                 continue
-            if not self._try_dispatch(spec):
-                blocked.append(spec)
-                self._blocked_count = len(blocked)
+            key = self._placement_shape(spec)
+            q = blocked.get(key)
+            if q:
+                # FIFO fairness: same-shape work already waits; dispatching
+                # around it would starve the backlog's head forever.  Still
+                # report demand — the autoscaler sizes off the full backlog,
+                # not one probe per shape.
+                self.scheduler.report_task_demand(spec.task_id, spec.resources)
+                q.append(spec)
+                blocked_n += 1
+                self._blocked_count = blocked_n
+            elif not self._try_dispatch(spec):
+                blocked.setdefault(key, deque()).append(spec)
+                blocked_n += 1
+                self._blocked_count = blocked_n
 
     def _try_dispatch(self, spec: TaskSpec) -> bool:
         if spec.task_id in self._cancelled:
@@ -1262,8 +1325,24 @@ class Runtime:
         return self._get_one(v, None)
 
     def _resolve_args(self, spec: TaskSpec):
-        args = tuple(self._resolve_ref(a) for a in spec.args)
-        kwargs = {k: self._resolve_ref(v) for k, v in spec.kwargs.items()}
+        args = spec.args
+        kwargs = spec.kwargs
+        ref_idx = [i for i, a in enumerate(args) if isinstance(a, ObjectRef)]
+        if ref_idx:
+            # One store pass for every ref arg (a 10k-arg call would
+            # otherwise pay two lock round-trips per ref); stragglers take
+            # the pull/reconstruction slow path individually.
+            vals, missing = self.store.try_get_many(
+                [args[i].id for i in ref_idx])
+            resolved = dict(zip(ref_idx, vals))
+            for j in missing:
+                i = ref_idx[j]
+                resolved[i] = self._resolve_ref(args[i])
+            args = tuple(resolved.get(i, a) if isinstance(a, ObjectRef) else a
+                         for i, a in enumerate(args))
+        else:
+            args = tuple(args)
+        kwargs = {k: self._resolve_ref(v) for k, v in kwargs.items()}
         return args, kwargs
 
     def _lease_env_worker(self, spec: TaskSpec):

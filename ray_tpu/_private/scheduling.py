@@ -262,13 +262,19 @@ class ClusterScheduler:
 
     def _make_release(self, node_id: NodeID, request: Resources,
                       strategy: SchedulingStrategy) -> Callable[[], None]:
-        released = threading.Event()
+        # Idempotence flag is a plain mutable cell, not a threading.Event —
+        # an Event allocates a Condition + lock per lease, measurable at
+        # task-throughput rates.  The authoritative test-and-set happens
+        # under self._lock, so concurrent double-releases can't double-add.
+        released = [False]
 
         def release() -> None:
-            if released.is_set():
+            if released[0]:
                 return
-            released.set()
             with self._lock:
+                if released[0]:
+                    return
+                released[0] = True
                 if isinstance(strategy, PlacementGroupSchedulingStrategy):
                     pg = self._pgs.get(strategy.placement_group.id)
                     if pg is not None:

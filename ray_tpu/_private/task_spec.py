@@ -7,7 +7,6 @@ request, scheduling strategy, retry budget.
 
 from __future__ import annotations
 
-import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private.ids import ActorID, TaskID
@@ -23,10 +22,13 @@ EXEC_FN_METHOD = "__ray_tpu_exec_fn__"
 
 
 class TaskSpec:
+    # Kept lean on purpose: a spec is built on every .remote() call, so
+    # anything not needed to execute (wall-clock stamps, derived display
+    # strings) is materialized lazily by whoever needs it, not here.
     __slots__ = (
         "task_id", "name", "func", "args", "kwargs", "num_returns",
         "resources", "strategy", "max_retries", "retry_exceptions",
-        "actor_id", "method_name", "isolation", "attempt", "submit_time",
+        "actor_id", "method_name", "isolation", "attempt",
         "generator", "parent_task_id", "runtime_env", "trace_ctx",
     )
 
@@ -63,7 +65,6 @@ class TaskSpec:
         self.method_name = method_name
         self.isolation = isolation
         self.attempt = 0
-        self.submit_time = time.time()
         self.generator = generator
         self.parent_task_id = parent_task_id
         self.runtime_env = runtime_env

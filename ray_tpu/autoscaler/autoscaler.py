@@ -124,6 +124,22 @@ class Autoscaler:
             self.im.transition(inst, InstanceState.ALLOCATION_FAILED,
                                "lost before allocation")
         failed = self.im.reconcile_drift(live, self.scheduler)
+        # Leaked provider nodes: the cloud reports them alive but NO active
+        # instance references them (a crash between create_node and the
+        # ALLOCATED persist, or an instance failed at adoption while its
+        # node survived).  Nothing else will ever terminate such a node —
+        # it bills forever — so sweep it here.  Safe against racing
+        # launches: _launch runs under the same reconcile lock, so every
+        # in-flight create is already persisted by the time we observe.
+        referenced = {inst.provider_node_id
+                      for inst in self.im.instances(*ACTIVE_STATES)
+                      if inst.provider_node_id}
+        for pid in sorted(live - referenced):
+            try:
+                self.provider.terminate_node(pid)
+                terminated.append(pid)
+            except Exception:  # noqa: BLE001 — reappears next pass, resweep
+                pass
         # ALLOCATED instances whose scheduler node came alive -> RUNNING.
         # The scheduler id can bind LATE: some providers only learn it once
         # the worker joins, so refresh the mapping each pass until it lands.

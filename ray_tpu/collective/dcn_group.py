@@ -134,7 +134,7 @@ class DCNCollectiveGroup:
     # --------------------------------------------------------- collectives
     def allreduce(self, rank: int, array: Any, op: str = ReduceOp.SUM) -> Any:
         import jax
-        from jax import shard_map
+        from ray_tpu._private.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         self._check_rank(rank)
@@ -156,7 +156,8 @@ class DCNCollectiveGroup:
 
     def allgather(self, rank: int, array: Any) -> Any:
         import jax
-        from jax import lax, shard_map
+        from jax import lax
+        from ray_tpu._private.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         self._check_rank(rank)
@@ -176,7 +177,8 @@ class DCNCollectiveGroup:
 
     def reducescatter(self, rank: int, array: Any, op: str = ReduceOp.SUM) -> Any:
         import jax
-        from jax import lax, shard_map
+        from jax import lax
+        from ray_tpu._private.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         self._check_rank(rank)
@@ -211,7 +213,8 @@ class DCNCollectiveGroup:
     def broadcast(self, rank: int, array: Any, src_rank: int = 0) -> Any:
         import jax
         import jax.numpy as jnp
-        from jax import lax, shard_map
+        from jax import lax
+        from ray_tpu._private.jax_compat import shard_map
         from jax.sharding import PartitionSpec as P
 
         self._check_rank(rank)

@@ -182,7 +182,7 @@ class XLACollectiveGroup:
             key = ("allreduce", op, inputs[0].shape, str(inputs[0].dtype))
 
             def build():
-                from jax import shard_map
+                from ray_tpu._private.jax_compat import shard_map
                 from jax.sharding import PartitionSpec as P
 
                 def body(x):
@@ -227,7 +227,8 @@ class XLACollectiveGroup:
             key = ("allgather", inputs[0].shape, str(inputs[0].dtype))
 
             def build():
-                from jax import lax, shard_map
+                from jax import lax
+                from ray_tpu._private.jax_compat import shard_map
                 from jax.sharding import PartitionSpec as P
 
                 def body(x):
@@ -270,7 +271,8 @@ class XLACollectiveGroup:
             key = ("reducescatter", op, inputs[0].shape, str(inputs[0].dtype))
 
             def build():
-                from jax import lax, shard_map
+                from jax import lax
+                from ray_tpu._private.jax_compat import shard_map
                 from jax.sharding import PartitionSpec as P
 
                 def body(x):
@@ -309,7 +311,8 @@ class XLACollectiveGroup:
             key = ("broadcast", src_rank, inputs[0].shape, str(inputs[0].dtype))
 
             def build():
-                from jax import lax, shard_map
+                from jax import lax
+                from ray_tpu._private.jax_compat import shard_map
                 from jax.sharding import PartitionSpec as P
 
                 def body(x):
@@ -370,7 +373,8 @@ class XLACollectiveGroup:
             key = ("sendrecv", tuple(perm), template.shape, str(template.dtype))
 
             def build():
-                from jax import lax, shard_map
+                from jax import lax
+                from ray_tpu._private.jax_compat import shard_map
                 from jax.sharding import PartitionSpec as P
 
                 def body(x):

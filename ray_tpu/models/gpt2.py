@@ -379,7 +379,9 @@ def forward_hidden(params: Dict[str, Any], tokens, config: GPTConfig):
                 f"n_layer {config.n_layer} % pp_stages {config.pp_stages} != 0")
         # The mesh is authoritative for the stage count: a mismatched config
         # would silently run a different schedule than requested.
-        amesh = jax.sharding.get_abstract_mesh()
+        from ray_tpu._private.jax_compat import get_abstract_mesh
+
+        amesh = get_abstract_mesh()
         if amesh is not None and "pipe" in getattr(amesh, "shape", {}) \
                 and amesh.shape["pipe"] not in (1, config.pp_stages):
             raise ValueError(

@@ -64,6 +64,34 @@ def _splash_kernel(seq_len: int, n_heads: int, block_q: int, block_kv: int,
                               block_sizes=bs, interpret=interpret)
 
 
+_LANE_HEAD_REQUIRED: Optional[bool] = None
+
+
+def _head_pad_target(head_dim: int) -> int:
+    """Older splash kernels refuse head_dim % 128 != 0 (the lane tile) at
+    trace time; newer ones handle it internally.  Probe once with a shape
+    eval — when the restriction exists, callers zero-pad the head axis up
+    to the tile and slice the output back (zero k/v columns contribute
+    nothing to scores or outputs, so the math is unchanged)."""
+    global _LANE_HEAD_REQUIRED
+    if head_dim % 128 == 0:
+        return head_dim
+    if _LANE_HEAD_REQUIRED is None:
+        import jax
+        import jax.numpy as jnp
+
+        try:
+            kern = _splash_kernel(128, 1, 128, 128, True, True)
+            s = jax.ShapeDtypeStruct((1, 128, 64), jnp.float32)
+            jax.eval_shape(kern, s, s, s)
+            _LANE_HEAD_REQUIRED = False
+        except Exception:  # noqa: BLE001 — padding is always safe, just wider
+            _LANE_HEAD_REQUIRED = True
+    if not _LANE_HEAD_REQUIRED:
+        return head_dim
+    return -(-head_dim // 128) * 128
+
+
 def splash_attention(q, k, v, causal: bool = True,
                      sm_scale: Optional[float] = None,
                      block_q: int = 512, block_kv: int = 512,
@@ -85,7 +113,15 @@ def splash_attention(q, k, v, causal: bool = True,
     qt = (q * sm_scale).transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = jax.vmap(kernel)(qt, kt, vt)  # (B, H, S, hd)
+    hp = _head_pad_target(hd)
+    if hp != hd:
+        import jax.numpy as jnp
+
+        pad = ((0, 0), (0, 0), (0, 0), (0, hp - hd))
+        qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
+    out = jax.vmap(kernel)(qt, kt, vt)  # (B, H, S, hp)
+    if hp != hd:
+        out = out[..., :hd]
     return out.transpose(0, 2, 1, 3)
 
 

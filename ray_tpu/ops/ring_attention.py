@@ -39,6 +39,14 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _axis_size(axis_name: str) -> int:
+    """lax.axis_size is a recent addition; psum of a constant 1 is the
+    long-standing spelling and folds to a static int on every version."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
 def _ring_block(seq_len: int) -> Optional[int]:
     """Largest multiple-of-128 divisor of seq_len, capped at the v5e-tuned
     512 (ops/attention.py) — None when no legal splash block exists."""
@@ -48,20 +56,92 @@ def _ring_block(seq_len: int) -> Optional[int]:
     return None
 
 
+_FUSED_PROBE: Optional[bool] = None
+
+
 def _fused_available() -> bool:
     """The fused backward reaches into jax's splash internals (the public
     custom-VJP can't merge per-block lse across ring steps); probe the
     private surface so a jax upgrade degrades impl='auto' to the einsum
-    body instead of breaking every gradient at trace time."""
+    body instead of breaking every gradient at trace time.
+
+    hasattr checks aren't enough — a surface can survive by name while its
+    shape changes (BlockSizes growing a required ctor arg, kwargs keys
+    renamed, bwd params reshuffled).  So this CONSTRUCTS a tiny kernel via
+    the same ``_block_kernel`` path the real fwd/bwd use and touches every
+    attribute/key/parameter ``_fused_ring_bwd`` reads.  Probed once per
+    process; failure downgrades impl='auto' with a one-time loud warning.
+    """
+    global _FUSED_PROBE
+    if _FUSED_PROBE is None:
+        _FUSED_PROBE = _probe_fused_surfaces()
+    return _FUSED_PROBE
+
+
+def _bwd_dkv_leading_params(sk) -> list:
+    """Names of _splash_attention_bwd_dkv's positional-or-keyword params
+    (everything before the keyword-only marker), in order."""
+    import inspect
+
+    out = []
+    for name, p in inspect.signature(
+            sk._splash_attention_bwd_dkv).parameters.items():
+        if p.kind is not inspect.Parameter.POSITIONAL_OR_KEYWORD:
+            break
+        out.append(name)
+    return out
+
+
+def _probe_fused_surfaces() -> bool:
+    import inspect
+    import warnings
+
     try:
         from jax.experimental.pallas.ops.tpu.splash_attention import (
             splash_attention_kernel as sk,
         )
-        return all(hasattr(sk, n) for n in (
-            "_make_splash_attention", "_splash_attention_bwd_dkv",
-            "BlockSizes", "DEFAULT_MASK_VALUE")) \
-            and hasattr(sk.BlockSizes, "q_layout")
-    except ImportError:
+        # Construction exercises the 9-kwarg BlockSizes ctor and
+        # _make_splash_attention's full signature (head_shards,
+        # save_residuals, interpret, ...) exactly as the ring body does.
+        kern = _block_kernel(128, 1, 128, "diag", True)
+        # Surfaces read by _fused_ring_bwd:
+        if kern.dkv_mask_info is None:
+            raise AttributeError("kernel lost its dkv mask_info (was "
+                                 "use_fused_bwd_kernel dropped?)")
+        bs = kern.kwargs["block_sizes"]
+        _ = (bs.q_layout, bs.k_layout, bs.v_layout)
+        _ = kern.kwargs["mask_function"]  # key must exist (value may be None)
+        _ = sk.DEFAULT_MASK_VALUE
+        # The bwd helper is called entirely with keyword args: every name we
+        # pass must still be a parameter (or a **kwargs catch-all), and the
+        # tensor args we bind by name must still be leading params.
+        params = inspect.signature(sk._splash_attention_bwd_dkv).parameters
+        needed = {"bq", "bkv", "bkv_compute", "is_mqa", "mask_info",
+                  "mask_value", "attn_logits_soft_cap",
+                  "use_fused_bwd_kernel", "q_layout", "k_layout", "v_layout",
+                  "mask_function", "interpret"}
+        has_var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                         for p in params.values())
+        missing = needed - set(params)
+        if missing and not has_var_kw:
+            raise TypeError(
+                f"_splash_attention_bwd_dkv lost parameters: {sorted(missing)}")
+        lead = _bwd_dkv_leading_params(sk)
+        tensor_args = {"q", "k", "v", "logsumexp", "do", "di"}
+        if not tensor_args <= set(lead):
+            raise TypeError(
+                "_splash_attention_bwd_dkv renamed leading params: "
+                f"{sorted(tensor_args - set(lead))} missing from {lead}")
+        return True
+    except Exception as e:  # noqa: BLE001 — ANY probe failure means einsum
+        warnings.warn(
+            "ray_tpu.ops.ring_attention: the fused splash ring-attention "
+            f"path is unavailable ({type(e).__name__}: {e}); impl='auto' "
+            "falls back to the einsum body, which materializes per-block "
+            "(B,H,S,S) scores — expect higher HBM traffic. Pin a jax "
+            "version with the splash_attention private surfaces, or pass "
+            "impl='einsum' to silence this.",
+            RuntimeWarning, stacklevel=2)
         return False
 
 
@@ -118,7 +198,7 @@ def _fused_ring_core(q, k, v, axis_name: str, causal: bool, block: int):
 
 
 def _fused_ring_fwd(q, k, v, axis_name: str, causal: bool, block: int):
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, H, S, D = q.shape
     interp = _interpret()
@@ -165,7 +245,7 @@ def _fused_ring_bwd(axis_name: str, causal: bool, block: int, res, do):
     )
 
     q, k, v, o, lse = res
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, H, S, D = q.shape
     interp = _interpret()
@@ -177,10 +257,18 @@ def _fused_ring_bwd(axis_name: str, causal: bool, block: int, res, do):
     do = do.astype(q.dtype)
     di = jnp.sum(o * do.astype(jnp.float32), axis=-1)  # (B, H, S) global
 
+    # The leading (positional-or-keyword) params drift across jax versions
+    # (segment_ids grew neighbours): bind q/k/v/logsumexp/do/di BY NAME and
+    # default every other leading param to None.  _probe_fused_surfaces
+    # guarantees the names exist before impl='auto' ever routes here.
+    lead = _bwd_dkv_leading_params(sk)
+
     def run(kern):
         def per_ex(q1, k1, v1, lse1, do1, di1):
+            vals = dict.fromkeys(lead)
+            vals.update(q=q1, k=k1, v=v1, logsumexp=lse1, do=do1, di=di1)
             return sk._splash_attention_bwd_dkv(
-                q1, k1, v1, None, None, lse1, do1, di1,
+                **vals,
                 bq=block, bkv=block, bkv_compute=block, is_mqa=False,
                 mask_info=kern.dkv_mask_info,
                 mask_value=sk.DEFAULT_MASK_VALUE,
@@ -248,7 +336,18 @@ def fused_ring_attention_local(q, k, v, *, axis_name: str = "seq",
     qt = (q * scale).transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
+    # Old splash kernels require head_dim % 128 == 0: zero-pad the head
+    # axis (padding is exact — zero k/v columns add nothing) and slice
+    # back.  Outside the custom VJP, so the backward sees padded shapes too.
+    from ray_tpu.ops.attention import _head_pad_target
+
+    hp = _head_pad_target(D)
+    if hp != D:
+        pad = ((0, 0), (0, 0), (0, 0), (0, hp - D))
+        qt, kt, vt = (jnp.pad(x, pad) for x in (qt, kt, vt))
     out = _fused_ring_core(qt, kt, vt, axis_name, causal, block)
+    if hp != D:
+        out = out[..., :D]
     return out.transpose(0, 2, 1, 3)
 
 
@@ -275,7 +374,7 @@ def ring_attention_local(q, k, v, *, axis_name: str = "seq",
     if impl == "fused":
         return fused_ring_attention_local(q, k, v, axis_name=axis_name,
                                           causal=causal, sm_scale=sm_scale)
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, S, H, D = q.shape
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
@@ -330,7 +429,7 @@ def ulysses_attention_local(q, k, v, *, axis_name: str = "seq",
                             attn_fn=None):
     """Body for shard_map: all_to_all (B, S/w, H, D) -> (B, S, H/w, D),
     full-sequence attention per head shard, then the inverse reshard."""
-    world = lax.axis_size(axis_name)
+    world = _axis_size(axis_name)
     H = q.shape[2]
     if H % world != 0:
         raise ValueError(f"Ulysses needs heads ({H}) % seq axis ({world}) == 0")
@@ -361,6 +460,16 @@ def _xla_attention(q, k, v, causal: bool = True,
 
 
 # ------------------------------------------------------------ shard_map APIs
+def _shard_map(fn, *, mesh, in_specs, out_specs, check_vma=True):
+    """shard_map moved (jax.experimental.shard_map → jax.shard_map) and
+    renamed its replication-check kwarg (check_rep → check_vma) across jax
+    releases; jax_compat resolves whichever spelling this jax ships."""
+    from ray_tpu._private.jax_compat import shard_map as _sm
+
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_vma=check_vma)
+
+
 def _specs(axis_name: str, batch_axes):
     P = jax.sharding.PartitionSpec
     return P(batch_axes, axis_name, "tensor", None)
@@ -379,8 +488,8 @@ def ring_attention(q, k, v, *, mesh=None, axis_name: str = "seq",
                  sm_scale=sm_scale, impl=impl)
     # check_vma off: the splash pallas_call inside the fused body does not
     # declare vma on its output avals, which the vma checker rejects.
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    return _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec, check_vma=False)(q, k, v)
 
 
 def ulysses_attention(q, k, v, *, mesh=None, axis_name: str = "seq",
@@ -390,5 +499,5 @@ def ulysses_attention(q, k, v, *, mesh=None, axis_name: str = "seq",
     spec = _specs(axis_name, batch_axes)
     fn = partial(ulysses_attention_local, axis_name=axis_name, causal=causal,
                  sm_scale=sm_scale, attn_fn=attn_fn)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)(q, k, v)
+    return _shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                      out_specs=spec)(q, k, v)

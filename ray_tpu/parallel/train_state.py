@@ -31,7 +31,9 @@ def create_sharded_state(
     trip: init runs under jit with out_shardings so each device materializes
     only its shard) and derive optimizer state with propagated shardings."""
     shardings = pytree_sharding(logical, mesh, rules)
-    with jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else nullcontext():
+    from ray_tpu._private.jax_compat import set_mesh as _set_mesh
+
+    with _set_mesh(mesh):
         params = jax.jit(init_fn, out_shardings=shardings)(key)
         opt_state = None
         if optimizer is not None:
@@ -52,8 +54,10 @@ def jit_train_step(step_fn, donate_state: bool = True, mesh=None):
     if mesh is None:
         return jitted
 
+    from ray_tpu._private.jax_compat import set_mesh as _set_mesh
+
     def call(*args, **kwargs):
-        with jax.set_mesh(mesh):
+        with _set_mesh(mesh):
             return jitted(*args, **kwargs)
 
     return call

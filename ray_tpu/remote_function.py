@@ -28,6 +28,9 @@ class RemoteFunction:
         # resolve_task_options reads GLOBAL_CONFIG defaults.
         self._resolved_opts = None
         self._resolved_gen = -1
+        # Static per function — probing inspect flags on every .remote()
+        # call costs ~10µs each at task-storm rates.
+        self._is_generator_fn = inspect.isgeneratorfunction(func)
         self.__name__ = getattr(func, "__name__", "remote_function")
         self.__doc__ = getattr(func, "__doc__", None)
 
@@ -58,7 +61,7 @@ class RemoteFunction:
     def _remote_resolved(self, args, kwargs, opts):
         runtime = get_runtime()
         parent = current_task_context()
-        generator = inspect.isgeneratorfunction(self._function) or opts["num_returns"] in (
+        generator = self._is_generator_fn or opts["num_returns"] in (
             "dynamic",
             "streaming",
         )

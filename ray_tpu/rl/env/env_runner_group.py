@@ -83,6 +83,17 @@ class EnvRunnerGroup:
         if self._local_runner is not None:
             self._local_runner.set_state({"params": params})
             return
+        # Snapshot ONCE per broadcast before fan-out: the learner's jitted
+        # update donates its param/opt buffers (donate_argnums), so the live
+        # tree handed to us is INVALIDATED the moment the learner steps
+        # again — but actor-task args are held by reference until each
+        # runner's set_state actually serializes/copies them.  Host-side
+        # numpy copies are immune to donation (and serialize cheaply).
+        import jax
+
+        params = jax.tree.map(
+            lambda x: jax.device_get(x) if hasattr(x, "dtype") else x,
+            params)
         refs = [r.set_state.remote({"params": params})
                 for r in self._remote_runners]
         if block:
@@ -160,10 +171,15 @@ class EnvRunnerGroup:
         return self._remote_runners
 
     def stop(self) -> None:
+        # Settle the final broadcast so its refs don't leak store entries;
+        # bounded wait — a wedged runner must not block shutdown.
         pending = getattr(self, "_pending_sync", None)
         if pending:
             self._pending_sync = None
-            self._settle_sync(pending)  # last broadcast must not leak refs
+            try:
+                ray_tpu.wait(pending, num_returns=len(pending), timeout=2.0)
+            except Exception:
+                pass
         if self._local_runner is not None:
             self._local_runner.stop()
         for r in self._remote_runners:

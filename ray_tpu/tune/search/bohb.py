@@ -120,15 +120,25 @@ class HyperBandForBOHB(TrialScheduler):
         self.max_t = max_t
         self.rf = reduction_factor
         self.time_attr = time_attr
-        n_rungs = max(1, int(math.log(max_t, reduction_factor)))
-        self._rungs = sorted(
-            {int(max_t / reduction_factor ** i) for i in range(n_rungs)})
+        # Integer division, not int(math.log(max_t, rf)): the float log of an
+        # exact power (log(9, 3)) can land just under the integer and silently
+        # drop the lowest rung.
+        rungs = set()
+        r = max_t
+        while r > 1:
+            rungs.add(r)
+            r //= reduction_factor
+        self._rungs = sorted(rungs or {max_t})
         self._rung_scores: Dict[int, List[float]] = {r: [] for r in self._rungs}
         #: (trial identity, rung) -> signed score recorded ONCE per rung;
         #: later reports re-evaluate against the (growing) rung population,
         #: so an early reporter that snuck past a not-yet-quorate rung is
         #: still cut on its next report once the cutoff exists.
         self._recorded: Dict[tuple, float] = {}
+        #: id()-keyed trials pinned alive: a freed trial's id can be reused
+        #: by a NEW trial, which would then inherit the dead one's rung
+        #: records and dodge the cutoff.
+        self._anon_trials: Dict[int, Any] = {}
 
     def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
         t = result.get(self.time_attr, 0)
@@ -141,7 +151,10 @@ class HyperBandForBOHB(TrialScheduler):
         rung = max((r for r in self._rungs if r <= t), default=None)
         if rung is None:
             return TrialScheduler.CONTINUE
-        tid = getattr(trial, "trial_id", None) or id(trial)
+        tid = getattr(trial, "trial_id", None)
+        if tid is None:
+            tid = id(trial)
+            self._anon_trials[tid] = trial
         key = (tid, rung)
         if key not in self._recorded:
             self._recorded[key] = sign * score

@@ -1,0 +1,223 @@
+"""Core-runtime scalability envelope: the four reference-scale anchors.
+
+The reference's release tests pin four numbers this runtime must be able
+to reproduce without quadratic blowups (ref:
+release/benchmarks/single_node tests — 1M queued tasks in 186.3 s, one
+call taking 10k object-ref args, ray.get of 10k objects, and a 1 GiB
+broadcast to 50 nodes in 16.1 s):
+
+  1. queued_tasks      — submit 1M no-op tasks onto a 2-CPU head (so
+                         ~all of them queue) and drain them.
+  2. wide_call         — one task invoked with 10k ObjectRef args.
+  3. vector_get        — ray_tpu.get of 10k distinct small objects.
+  4. broadcast         — 1 GiB from the driver to N real worker-node
+                         processes on this host, at each N in
+                         ``--nodes``; per-node pull-source stats and the
+                         owner's egress bytes prove the broadcast tree
+                         keeps owner egress sub-linear in N.
+
+Run: JAX_PLATFORMS=cpu python scripts/bench_envelope.py
+Writes BENCH_ENVELOPE.json at the repo root.  Reduced-scale versions of
+every anchor run as slow-marked tests (tests/test_scalability_envelope.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def bench_queued_tasks(n: int = 1_000_000) -> dict:
+    """Anchor 1: n no-op tasks queued behind a 2-CPU head, then drained."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+
+    def _noop():
+        return None
+
+    noop = ray_tpu.remote(_noop)
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    submit_s = time.perf_counter() - t0
+    ray_tpu.get(refs, timeout=3600)
+    total_s = time.perf_counter() - t0
+    del refs
+    return {
+        "tasks": n,
+        "submit_s": round(submit_s, 2),
+        "total_s": round(total_s, 2),
+        "tasks_per_s": round(n / total_s, 1),
+    }
+
+
+def bench_wide_call(n_args: int = 10_000) -> dict:
+    """Anchor 2: one call with n_args ObjectRef arguments."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    refs = [ray_tpu.put(i) for i in range(n_args)]
+
+    def _arg_count(*xs):
+        return len(xs)
+
+    fn = ray_tpu.remote(_arg_count)
+    t0 = time.perf_counter()
+    out = ray_tpu.get(fn.remote(*refs), timeout=600)
+    dt = time.perf_counter() - t0
+    assert out == n_args, out
+    return {"args": n_args, "call_s": round(dt, 4)}
+
+
+def bench_vector_get(n_objects: int = 10_000) -> dict:
+    """Anchor 3: vectorized ray_tpu.get of n distinct objects."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    refs = [ray_tpu.put(i) for i in range(n_objects)]
+    t0 = time.perf_counter()
+    vals = ray_tpu.get(refs, timeout=600)
+    dt = time.perf_counter() - t0
+    assert vals[0] == 0 and vals[-1] == n_objects - 1
+    return {"objects": n_objects, "get_s": round(dt, 4)}
+
+
+def bench_broadcast(n_nodes: int, payload_bytes: int = 1 << 30,
+                    rounds: int = 2) -> dict:
+    """Anchor 4: broadcast ``payload_bytes`` to n real worker nodes.
+
+    Returns timing plus the owner's (head's) egress for the broadcast
+    object and every node's pull-source byte counts — with the fan-out
+    tree, owner egress stays ~``broadcast_tree_fanout`` copies while the
+    cluster as a whole receives N copies.
+    """
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private.runtime import get_runtime
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    c = Cluster(initialize_head=True, real=True,
+                head_node_args={"num_cpus": 1})
+    names = [f"n{i}" for i in range(n_nodes)]
+    for name in names:
+        c.add_node(num_cpus=2, resources={name: 100_000.0})
+    # Shipped to nodes: defined here so cloudpickle serializes them by
+    # VALUE (worker-node processes cannot import this script).
+    def _touch(arr):
+        return float(arr[0])
+
+    def _xfer_stats():
+        from ray_tpu._private.runtime import get_runtime
+
+        rt = get_runtime()
+        pm = rt._pull_manager()
+        with pm._lock:
+            pull = {k: (dict(v) if isinstance(v, dict) else v)
+                    for k, v in pm.stats.items()}
+        srv = rt.object_server
+        return {"pull": pull,
+                "egress": srv.stats() if srv is not None else {}}
+
+    try:
+        touch = ray_tpu.remote(_touch)
+        stats = ray_tpu.remote(_xfer_stats)
+        # Warm the dispatch plane (imports, connections) with a tiny task.
+        ray_tpu.get([touch.options(resources={r: 1.0}).remote(
+            np.ones(4)) for r in names], timeout=300)
+        payload = np.ones(payload_bytes // 8)
+        times = []
+        for _ in range(rounds):
+            big = ray_tpu.put(payload)
+            t0 = time.perf_counter()
+            outs = [touch.options(resources={r: 1.0}).remote(big)
+                    for r in names]
+            assert ray_tpu.get(outs, timeout=1800) == [1.0] * n_nodes
+            times.append(round(time.perf_counter() - t0, 2))
+            oid = str(big.id)
+            del big, outs
+        per_node = ray_tpu.get(
+            [stats.options(resources={r: 1.0}).remote() for r in names],
+            timeout=300)
+        rt = get_runtime()
+        head_egress = rt.object_server.stats() \
+            if rt.object_server is not None else {}
+        owner_bytes = head_egress.get("by_object", {}).get(oid, 0)
+        total_pulled = sum(
+            sum(n["pull"].get("sources", {}).values()) for n in per_node)
+        return {
+            "nodes": n_nodes,
+            "payload_gib": round(payload_bytes / (1 << 30), 3),
+            "rounds": times,
+            "cold_s": times[0],
+            "warm_s": times[-1],
+            "owner_egress_last_round_bytes": owner_bytes,
+            "owner_egress_total": {
+                k: v for k, v in head_egress.items() if k != "by_object"},
+            "cluster_pulled_bytes": total_pulled,
+            "per_node": [
+                {"node": name,
+                 "sources": node["pull"].get("sources", {}),
+                 "served_bytes": (node["egress"].get("pull_bytes", 0)
+                                  + node["egress"].get("handoff_bytes", 0))}
+                for name, node in zip(names, per_node)],
+        }
+    finally:
+        c.shutdown()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--tasks", type=int, default=1_000_000)
+    p.add_argument("--args", type=int, default=10_000, dest="n_args")
+    p.add_argument("--objects", type=int, default=10_000)
+    p.add_argument("--nodes", type=str, default="4,8",
+                   help="comma-separated node counts for the broadcast")
+    p.add_argument("--gib", type=float, default=1.0)
+    p.add_argument("--out", type=str,
+                   default=os.path.join(REPO, "BENCH_ENVELOPE.json"))
+    args = p.parse_args()
+
+    import ray_tpu
+
+    results: dict = {"host_cpus": os.cpu_count()}
+
+    results["wide_call_10k_args"] = bench_wide_call(args.n_args)
+    print("wide_call:", results["wide_call_10k_args"], flush=True)
+    results["vector_get_10k"] = bench_vector_get(args.objects)
+    print("vector_get:", results["vector_get_10k"], flush=True)
+    results["queued_tasks_1m"] = bench_queued_tasks(args.tasks)
+    print("queued_tasks:", results["queued_tasks_1m"], flush=True)
+    ray_tpu.shutdown()
+
+    results["broadcast_1gib"] = []
+    for n in [int(x) for x in args.nodes.split(",") if x]:
+        r = bench_broadcast(n, payload_bytes=int(args.gib * (1 << 30)))
+        results["broadcast_1gib"].append(r)
+        print(f"broadcast x{n}:", json.dumps(r), flush=True)
+
+    # Sub-linearity evidence: owner egress per broadcast round must not
+    # scale with node count (the tree redirects followers to peers).
+    if len(results["broadcast_1gib"]) >= 2:
+        a, b = results["broadcast_1gib"][0], results["broadcast_1gib"][-1]
+        if a["owner_egress_last_round_bytes"]:
+            results["owner_egress_growth"] = round(
+                b["owner_egress_last_round_bytes"]
+                / a["owner_egress_last_round_bytes"], 3)
+            results["node_growth"] = round(b["nodes"] / a["nodes"], 3)
+
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,9 +13,19 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", int(os.environ.get("CHILD_DEVICES", "2")))
+if hasattr(jax.config, "jax_num_cpu_devices"):
+    jax.config.update("jax_num_cpu_devices", int(os.environ.get("CHILD_DEVICES", "2")))
+else:
+    # Older jax: virtual CPU device count comes from XLA_FLAGS (the backend
+    # has not initialized yet — config.update above precedes any device query).
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count="
+        + os.environ.get("CHILD_DEVICES", "2")
+    ).strip()
 # Cross-process CPU collectives ride gloo (the CPU stand-in for the DCN tier).
-jax.config.update("jax_cpu_collectives_implementation", "gloo")
+if hasattr(jax.config, "jax_cpu_collectives_implementation"):
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
 
 import numpy as np  # noqa: E402
 

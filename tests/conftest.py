@@ -27,6 +27,13 @@ assert jax.device_count() == 8, (
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: reference-scale envelope benchmarks (excluded from tier-1 "
+        "runs via -m 'not slow')")
+
+
 @pytest.fixture
 def ray_start_regular():
     """(ref: python/ray/tests/conftest.py:532 ray_start_regular)"""

@@ -162,3 +162,33 @@ available_node_types:
         handle.teardown()
     assert provider.non_terminated_nodes() == []
     ray_tpu.shutdown()
+
+
+def test_leaked_provider_node_is_swept(ray_init, tmp_path):
+    """A provider node no ACTIVE instance references (crash between
+    create_node and the ALLOCATED persist) must be terminated by the next
+    reconcile pass — nothing else will ever reclaim it."""
+    from ray_tpu.autoscaler import FakeNodeProvider
+
+    provider = FakeNodeProvider()
+    config = AutoscalerConfig(
+        node_types={"w": NodeTypeConfig(resources={"CPU": 1},
+                                        min_workers=0, max_workers=2)},
+        idle_timeout_s=1e9)
+    scaler = Autoscaler(config, provider,
+                        storage_path=str(tmp_path / "instances.json"))
+    # Simulate the crash window: the cloud allocated a node but the
+    # instance record never made it past REQUESTED (here: no record).
+    leaked = provider.create_node("w", {"CPU": 1}, {})
+    assert leaked in provider.non_terminated_nodes()
+    r = scaler.update()
+    assert leaked in r["terminated"]
+    assert leaked not in provider.non_terminated_nodes()
+    # Tracked nodes survive the sweep.
+    scaler.scheduler.report_task_demand("t1", {"CPU": 1})
+    r = scaler.update()
+    assert len(r["launched"]) == 1
+    tracked = r["launched"][0]
+    r = scaler.update()
+    assert tracked not in r["terminated"]
+    assert tracked in provider.non_terminated_nodes()

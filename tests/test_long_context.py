@@ -159,6 +159,42 @@ def test_ring_auto_picks_fused_for_tileable_shards(seq_mesh):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_fused_probe_passes_on_current_jax():
+    from ray_tpu.ops import ring_attention as ra
+    assert ra._probe_fused_surfaces() is True
+
+
+def test_auto_downgrades_loudly_when_splash_surface_breaks(
+        seq_mesh, monkeypatch):
+    """If a jax upgrade breaks the private splash surfaces, impl='auto'
+    must fall back to the einsum body (still correct) with ONE loud
+    RuntimeWarning — not explode at trace time."""
+    import warnings
+
+    from ray_tpu.ops import ring_attention as ra
+
+    def broken_kernel(*a, **kw):
+        raise AttributeError("simulated splash surface rename")
+
+    monkeypatch.setattr(ra, "_block_kernel", broken_kernel)
+    monkeypatch.setattr(ra, "_FUSED_PROBE", None)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert ra._fused_available() is False
+        assert ra._fused_available() is False  # cached: no second probe
+    loud = [w for w in rec if issubclass(w.category, RuntimeWarning)]
+    assert len(loud) == 1 and "einsum" in str(loud[0].message)
+
+    # auto now routes tileable shards through einsum and still matches.
+    q, k, v = _qkv(jax.random.key(10), B=1, S=1024, H=2, D=64)
+    expected = _xla_attention(q, k, v, causal=True)
+    qs, ks, vs = _place(seq_mesh, (q, k, v))
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, mesh=seq_mesh, causal=True))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
 @pytest.mark.parametrize("causal", [True, False])
 def test_splash_attention_matches_dense(causal):
     """Single-device splash kernel (interpret on CPU): causal AND the

@@ -16,12 +16,27 @@ REPO = os.path.join(os.path.dirname(__file__), "..")
 CHILD = os.path.join(os.path.dirname(__file__), "_multihost_child.py")
 
 
+def _cpu_multiprocess_supported() -> bool:
+    """Cross-process CPU SPMD needs the gloo collectives backend; jaxlib
+    builds without it fail with 'Multiprocess computations aren't
+    implemented on the CPU backend'."""
+    import jax
+
+    return hasattr(jax.config, "jax_cpu_collectives_implementation")
+
+
+requires_cpu_collectives = pytest.mark.skipif(
+    not _cpu_multiprocess_supported(),
+    reason="this jaxlib has no CPU cross-process collectives (gloo)")
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
+@requires_cpu_collectives
 def test_two_process_train_step_gradient_sync():
     port = _free_port()
     procs = []

@@ -1,0 +1,78 @@
+"""_node_details semaphore hygiene: a node wedged inside node_info (e.g.
+conn.send on a full pipe — the one unbounded block in that stack) must not
+eat a _SNAP_BUDGET slot forever, and later rounds must not stack more
+threads behind the same wedged node."""
+
+import threading
+
+from ray_tpu._private import metrics_agent as ma
+
+
+class _FakeServer:
+    def __init__(self, wedged):
+        self.wedged = wedged
+        self.unblock = threading.Event()
+        self.calls = []
+
+    def node_info(self, rn, timeout=3.0, detail="full"):
+        self.calls.append(rn)
+        if rn in self.wedged:
+            self.unblock.wait()  # hangs until the test releases it
+        return {"node": rn}
+
+
+class _FakeRuntime:
+    pass
+
+
+def _drain_budget():
+    got = 0
+    while ma._SNAP_BUDGET.acquire(blocking=False):
+        got += 1
+    for _ in range(got):
+        ma._SNAP_BUDGET.release()
+    return got
+
+
+def test_wedged_node_info_releases_budget_and_is_skipped(monkeypatch):
+    monkeypatch.setattr(ma, "_SNAP_DEADLINE_S", 0.3)
+    rt = _FakeRuntime()
+    rt.node_server = srv = _FakeServer(wedged={"bad"})
+    remote = {"bad": "bad", "good": "good"}
+
+    baseline = _drain_budget()
+    assert baseline == 8, "another test leaked snapshot budget slots"
+
+    try:
+        details = ma._node_details(rt, remote)
+        assert details.get("good") == {"node": "good"}
+        assert "bad" not in details  # wedged past the deadline: omitted
+        # The deadline sweep reclaimed the wedged fetch's slot.
+        assert _drain_budget() == baseline
+
+        # Round 2 (cache cleared): the wedged node is skipped outright —
+        # no second thread queues behind it — and the budget stays intact.
+        with ma._SNAP_LOCK:
+            ma._SNAP_CACHE.pop(rt, None)
+        details = ma._node_details(rt, remote)
+        assert "wedged" in details["bad"]["error"]
+        assert details["good"] == {"node": "good"}
+        assert srv.calls.count("bad") == 1
+        assert _drain_budget() == baseline
+    finally:
+        srv.unblock.set()
+
+    # Once the wedged fetch finally returns, its late release is a no-op
+    # (the deadline sweep already released) and the node is fetchable again.
+    deadline = threading.Event()
+    for _ in range(100):
+        with ma._SNAP_LOCK:
+            free = "bad" not in ma._SNAP_INFLIGHT.get(rt, set())
+        if free:
+            break
+        deadline.wait(0.05)
+    assert _drain_budget() == baseline
+    with ma._SNAP_LOCK:
+        ma._SNAP_CACHE.pop(rt, None)
+    details = ma._node_details(rt, remote)
+    assert details["bad"] == {"node": "bad"}

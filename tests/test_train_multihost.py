@@ -28,6 +28,7 @@ from ray_tpu.train import (
     RunConfig,
     ScalingConfig,
 )
+from tests.test_multihost import requires_cpu_collectives
 
 
 @pytest.fixture()
@@ -41,6 +42,7 @@ def two_node_cluster():
     c.shutdown()
 
 
+@requires_cpu_collectives
 def test_jax_trainer_spans_nodes_gradient_sync(two_node_cluster):
     """Two ranks on two different node processes; the allreduced gradient
     step must match the sequential same-math reference exactly."""
@@ -94,6 +96,7 @@ def test_jax_trainer_spans_nodes_gradient_sync(two_node_cluster):
     np.testing.assert_allclose(m["w"], w, rtol=1e-6)
 
 
+@requires_cpu_collectives
 def test_jax_trainer_elastic_node_kill_restores(two_node_cluster, tmp_path):
     """Kill the node under rank 1 mid-run: the attempt fails, the controller
     restarts the group on surviving capacity from the last checkpoint, and
