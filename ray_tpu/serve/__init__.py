@@ -10,9 +10,12 @@ requests from one event loop.
 from ray_tpu.serve.api import (Application, Deployment, delete, deployment,
                                get_app_handle, get_deployment_handle, run,
                                shutdown, start, status)
+from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import (AutoscalingConfig, DeploymentConfig,
                                   GRPCOptions, HTTPOptions)
 from ray_tpu.serve.context import get_multiplexed_model_id
+from ray_tpu.serve.continuous import EOS, SequenceSlot, continuous_batch
+from ray_tpu.serve.exceptions import BackPressureError
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import multiplexed
 from ray_tpu.serve.proxy import Request
@@ -22,5 +25,6 @@ __all__ = [
     "delete", "status", "get_app_handle", "get_deployment_handle",
     "AutoscalingConfig", "DeploymentConfig", "GRPCOptions", "HTTPOptions",
     "DeploymentHandle", "DeploymentResponse", "Request", "multiplexed",
-    "get_multiplexed_model_id",
+    "get_multiplexed_model_id", "batch", "continuous_batch", "EOS",
+    "SequenceSlot", "BackPressureError",
 ]

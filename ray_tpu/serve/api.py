@@ -33,6 +33,7 @@ class Deployment:
     def options(self, *, name: Optional[str] = None,
                 num_replicas: Optional[Union[int, str]] = None,
                 max_ongoing_requests: Optional[int] = None,
+                max_queued_requests: Optional[int] = None,
                 user_config: Optional[Any] = None,
                 autoscaling_config: Optional[Union[AutoscalingConfig, Dict]] = None,
                 ray_actor_options: Optional[Dict] = None) -> "Deployment":
@@ -43,6 +44,8 @@ class Deployment:
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
         if user_config is not None:
             cfg.user_config = user_config
         if autoscaling_config is not None:
@@ -76,6 +79,7 @@ def deployment(_func_or_class: Optional[Any] = None, *,
                name: Optional[str] = None,
                num_replicas: Union[int, str, None] = None,
                max_ongoing_requests: int = 5,
+               max_queued_requests: int = -1,
                user_config: Optional[Any] = None,
                autoscaling_config: Optional[Union[AutoscalingConfig, Dict]] = None,
                ray_actor_options: Optional[Dict] = None,
@@ -90,6 +94,7 @@ def deployment(_func_or_class: Optional[Any] = None, *,
         cfg = DeploymentConfig(
             num_replicas=(num_replicas if isinstance(num_replicas, int) else 1),
             max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
             user_config=user_config,
             autoscaling_config=asc,
             health_check_period_s=health_check_period_s,

@@ -30,6 +30,10 @@ class DeploymentConfig:
 
     num_replicas: int = 1
     max_ongoing_requests: int = 5
+    #: Queue allowance beyond the replicas' combined max_ongoing_requests
+    #: before routers shed with BackPressureError (HTTP 503 at the proxy).
+    #: -1 (default) = unbounded: excess requests queue in replica mailboxes.
+    max_queued_requests: int = -1
     user_config: Optional[Any] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
     health_check_period_s: float = 10.0

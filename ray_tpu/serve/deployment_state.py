@@ -80,7 +80,8 @@ class ReplicaWrapper:
         self.actor = ray_tpu.remote(actor_cls).options(**opts).remote(
             info.name, self.replica_id, info.deployment_def,
             info.init_args, dict(info.init_kwargs),
-            user_config=info.config.user_config)
+            user_config=info.config.user_config,
+            max_ongoing_requests=info.config.max_ongoing_requests)
         self._ready_ref = self.actor.initialize_and_get_metadata.remote()
         self._stop_ref = None
 
@@ -208,7 +209,8 @@ class DeploymentState:
     # -------------------------------------------------------------- queries
     def running_replicas(self) -> List[Dict[str, Any]]:
         return [{"replica_id": r.replica_id, "actor": r.actor,
-                 "max_ongoing_requests": self.info.config.max_ongoing_requests}
+                 "max_ongoing_requests": self.info.config.max_ongoing_requests,
+                 "max_queued_requests": self.info.config.max_queued_requests}
                 for r in self.replicas if r.state == ReplicaState.RUNNING]
 
     @property

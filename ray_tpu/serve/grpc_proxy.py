@@ -210,12 +210,18 @@ class _GenericHandler:
         metadata = {k: v for k, v in
                     (handler_call_details.invocation_metadata or ())}
 
+        from ray_tpu.serve.exceptions import BackPressureError
+
         def unary_unary(request: bytes, context):
             try:
                 return self._proxy.handle_rpc(service, method, request,
                                               metadata)
             except KeyError as e:
                 context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            except BackPressureError as e:
+                # Deployment at capacity: shed, don't queue (the gRPC
+                # analogue of the HTTP proxy's 503 + Retry-After).
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
             except Exception as e:  # noqa: BLE001 — surfaced to the client
                 context.abort(grpc.StatusCode.INTERNAL, repr(e))
 
@@ -225,6 +231,8 @@ class _GenericHandler:
                     service, method, request, metadata)
             except KeyError as e:
                 context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+            except BackPressureError as e:
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
             except Exception as e:  # noqa: BLE001 — mid-stream errors end
                 # the stream with INTERNAL status (reference parity).
                 context.abort(grpc.StatusCode.INTERNAL, repr(e))

@@ -27,7 +27,7 @@ class DeploymentResponse:
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
         import ray_tpu
-        from ray_tpu.exceptions import ActorDiedError
+        from ray_tpu.exceptions import ActorDiedError, TaskError
 
         attempts = 3 if self._retry is not None else 1
         for attempt in range(attempts):
@@ -40,6 +40,17 @@ class DeploymentResponse:
 
                 time.sleep(0.2 * (attempt + 1))  # let the long-poll catch up
                 self._ref = self._retry()
+            except TaskError as e:
+                from ray_tpu.serve.exceptions import BackPressureError
+
+                # A DOWNSTREAM deployment shed this request (composition:
+                # an inner handle call hit capacity).  Surface the
+                # BackPressureError itself, not a generic task failure, so
+                # callers/proxies can shed gracefully (503) instead of
+                # reporting an internal error.
+                if isinstance(getattr(e, "cause", None), BackPressureError):
+                    raise e.cause from None
+                raise
 
     def __await__(self):
         import ray_tpu

@@ -5,6 +5,11 @@ LRU of loaded models keyed by model id, load via the user's @serve.multiplexed
 function, evict least-recently-used above max_num_models_per_replica.
 Loaded ids are recorded in replica metadata; warm-replica routing preference
 is future work — requests currently route queue-aware only.)
+
+Interplay with @serve.batch: the batching decorator keys its queues by the
+request's multiplexed model id (serve_context.get_multiplexed_model_id()),
+so requests for different models never share a micro-batch — one vectorized
+call always targets a single loaded model.
 """
 
 from __future__ import annotations
@@ -46,6 +51,11 @@ class _ModelMultiplexWrapper:
             self._models[model_id] = model
             self._push_model_ids()
             return model
+
+    @property
+    def loaded_model_ids(self) -> list:
+        """Currently loaded ids, LRU order (least-recent first)."""
+        return list(self._models)
 
     def _push_model_ids(self) -> None:
         """Record loaded ids on the hosting replica's metadata

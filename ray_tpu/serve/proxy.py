@@ -147,8 +147,38 @@ class HTTPProxy:
             result = await asyncio.get_running_loop().run_in_executor(
                 None, lambda: response.result(timeout_s=60.0))
         except Exception as e:  # noqa: BLE001
+            shed = self._as_backpressure(e)
+            if shed is not None:
+                return self._overloaded_response(shed)
             return web.Response(status=500, text=f"Internal error: {e!r}")
         return self._to_http_response(result)
+
+    @staticmethod
+    def _as_backpressure(e: BaseException):
+        """BackPressureError, raised directly by this proxy's router or
+        wrapped in a TaskError by a downstream deployment's handle call
+        (composition), means overload — both map to 503, not 500."""
+        from ray_tpu.exceptions import TaskError
+        from ray_tpu.serve.exceptions import BackPressureError
+
+        if isinstance(e, BackPressureError):
+            return e
+        if isinstance(e, TaskError) and isinstance(
+                getattr(e, "cause", None), BackPressureError):
+            return e.cause
+        return None
+
+    @staticmethod
+    def _overloaded_response(shed):
+        """503 + Retry-After: overload degrades by shedding, and clients
+        are told when to come back (ref: the reference returns 503 on
+        BackPressureError in proxy request handling)."""
+        from aiohttp import web
+
+        return web.Response(
+            status=503,
+            headers={"Retry-After": str(max(1, int(shed.retry_after_s)))},
+            text=f"Service overloaded: {shed}")
 
     async def _handle_streaming(self, request, handle, req):
         """Drive a replica stream into a chunked HTTP response.
@@ -173,6 +203,9 @@ class HTTPProxy:
             gen = await loop.run_in_executor(
                 None, lambda: handle.options(stream=True).remote(req))
         except Exception as e:  # noqa: BLE001
+            shed = self._as_backpressure(e)
+            if shed is not None:
+                return self._overloaded_response(shed)
             return web.Response(status=500, text=f"Internal error: {e!r}")
         sse = "text/event-stream" in request.headers.get("Accept", "")
         resp = web.StreamResponse()

@@ -12,17 +12,67 @@ import inspect
 import time
 from typing import Any, Dict, Optional
 
+from ray_tpu.serve._sync import run_in_executor
+
+#: StopIteration cannot cross an executor future back into a coroutine
+#: (it would surface as RuntimeError), so sync-iterator pulls return this.
+_STREAM_DONE = object()
+
+
+def _is_async_callable(target: Any) -> bool:
+    """Is this target's body a coroutine/async-generator function?"""
+    fn = target if (inspect.isfunction(target) or inspect.ismethod(target)) \
+        else getattr(target, "__call__", None)
+    return fn is not None and (inspect.iscoroutinefunction(fn)
+                               or inspect.isasyncgenfunction(fn))
+
+
+def _invoke_sync_unary(target: Any, args: tuple, kwargs: dict) -> Any:
+    """Runs fully on an executor thread: the call AND the generator drain
+    (a sync generator's body executes during the drain)."""
+    result = target(*args, **kwargs)
+    if inspect.isgenerator(result):
+        result = list(result)
+    return result
+
+
+def _next_or_done(it: Any) -> Any:
+    try:
+        return next(it)
+    except StopIteration:
+        return _STREAM_DONE
+
 
 class UserCallableWrapper:
-    """Builds and invokes the user callable (ref: replica.py:1017)."""
+    """Builds and invokes the user callable (ref: replica.py:1017).
+
+    Sync (non-async) callables and sync-generator pulls are dispatched to a
+    per-replica thread executor: replica request handlers are asyncio tasks
+    on one loop, and a blocking user callable executed inline would stall
+    every concurrent request on the replica (ref: the reference runs sync
+    user code through its own executor the same way).
+    """
 
     def __init__(self, deployment_def: Any, init_args: tuple,
-                 init_kwargs: Dict[str, Any]):
+                 init_kwargs: Dict[str, Any], max_ongoing_requests: int = 0):
         self._is_class = inspect.isclass(deployment_def)
         if self._is_class:
             self._callable = deployment_def(*init_args, **init_kwargs)
         else:
             self._callable = deployment_def
+        self._max_ongoing = int(max_ongoing_requests)
+        self._pool = None
+
+    def _executor(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # Sized to the replica's concurrency bound so max_ongoing sync
+            # requests really overlap instead of queueing on the pool.
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(8, self._max_ongoing),
+                thread_name_prefix="serve-replica-sync")
+        return self._pool
 
     def _target(self, method_name: str):
         if self._is_class:
@@ -32,9 +82,15 @@ class UserCallableWrapper:
         return self._callable
 
     async def call(self, method_name: str, args: tuple, kwargs: dict) -> Any:
-        result = self._target(method_name)(*args, **kwargs)
+        target = self._target(method_name)
+        if not _is_async_callable(target):
+            return await run_in_executor(_invoke_sync_unary, target, args,
+                                         kwargs, executor=self._executor())
+        result = target(*args, **kwargs)
         if inspect.isawaitable(result):
             result = await result
+        if hasattr(result, "__anext__"):  # unary endpoint: drain async gen
+            return [item async for item in result]
         if inspect.isgenerator(result):  # unary endpoint: drain to a list
             result = list(result)
         return result
@@ -43,9 +99,17 @@ class UserCallableWrapper:
                              kwargs: dict):
         """Invoke WITHOUT draining; returns a sync or async iterator
         (ref: replica.py streaming via Ray streaming generators)."""
-        result = self._target(method_name)(*args, **kwargs)
-        if inspect.isawaitable(result):
-            result = await result
+        target = self._target(method_name)
+        if _is_async_callable(target):
+            result = target(*args, **kwargs)
+            if inspect.isawaitable(result):
+                result = await result
+        else:
+            # Creating a sync generator is lazy, but a plain sync function
+            # may do real work before returning its iterator — off-loop.
+            result = await run_in_executor(target, *args,
+                                           executor=self._executor(),
+                                           **kwargs)
         if inspect.isgenerator(result) or hasattr(result, "__anext__"):
             return result
         raise TypeError(
@@ -73,11 +137,12 @@ class ReplicaActor:
     def __init__(self, deployment_name: str, replica_id: str,
                  deployment_def: Any, init_args: tuple,
                  init_kwargs: Dict[str, Any],
-                 user_config: Any = None):
+                 user_config: Any = None, max_ongoing_requests: int = 0):
         self.deployment_name = deployment_name
         self.replica_id = replica_id
-        self._wrapper = UserCallableWrapper(deployment_def, init_args,
-                                            init_kwargs or {})
+        self._wrapper = UserCallableWrapper(
+            deployment_def, init_args, init_kwargs or {},
+            max_ongoing_requests=max_ongoing_requests)
         self._num_ongoing = 0
         self._num_processed = 0
         self._user_config = user_config
@@ -158,11 +223,16 @@ class ReplicaActor:
                 except StopAsyncIteration:
                     self._end_stream(stream_id)
                     return ("done", None)
-            try:
-                return ("item", next(it))
-            except StopIteration:
+            # Sync iterator: its body executes during next() — pull on the
+            # executor so a blocking generator cannot stall the loop's
+            # other streams/requests.  Pulls are sequential per stream, so
+            # the generator is never advanced from two threads at once.
+            value = await run_in_executor(_next_or_done, it,
+                                          executor=self._wrapper._executor())
+            if value is _STREAM_DONE:
                 self._end_stream(stream_id)
                 return ("done", None)
+            return ("item", value)
         except Exception:
             self._end_stream(stream_id)
             raise

@@ -16,6 +16,17 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.util import metrics as _metrics
+
+INFLIGHT_GAUGE = _metrics.Gauge(
+    "serve_router_inflight",
+    "Requests in flight to a deployment, as observed by one router",
+    tag_keys=("deployment",))
+SHED_COUNTER = _metrics.Counter(
+    "serve_router_shed_total",
+    "Requests rejected with BackPressureError (deployment at capacity)",
+    tag_keys=("deployment",))
+
 
 class PowerOfTwoChoicesReplicaScheduler:
     """Locally-observed queue lengths: +1 on dispatch, -1 on completion.
@@ -23,6 +34,12 @@ class PowerOfTwoChoicesReplicaScheduler:
     The local view is exact for a single router and approximate across many
     routers — the same trade the reference makes with its cached queue
     lengths (pow_2_scheduler queue-len cache).
+
+    Capacity-aware: each replica entry carries its max_ongoing_requests, so
+    the two-choice comparison prefers a replica with a spare slot over one
+    already at capacity (the reference's scheduler filters candidates the
+    same way), and the router can tell when the WHOLE deployment is
+    saturated and shed instead of queueing unboundedly.
     """
 
     def __init__(self) -> None:
@@ -45,6 +62,12 @@ class PowerOfTwoChoicesReplicaScheduler:
         with self._lock:
             return sum(self._inflight.values())
 
+    def total_capacity(self) -> int:
+        """Sum of replica max_ongoing_requests over the live replica set."""
+        with self._lock:
+            return sum(int(r.get("max_ongoing_requests") or 0)
+                       for r in self._replicas)
+
     def on_request_sent(self, replica_id: str) -> None:
         with self._lock:
             self._inflight[replica_id] = self._inflight.get(replica_id, 0) + 1
@@ -64,6 +87,13 @@ class PowerOfTwoChoicesReplicaScheduler:
             a, b = random.sample(replicas, 2)
             qa = self._inflight.get(a["replica_id"], 0)
             qb = self._inflight.get(b["replica_id"], 0)
+            ca = int(a.get("max_ongoing_requests") or 0)
+            cb = int(b.get("max_ongoing_requests") or 0)
+            # A replica with a spare slot beats one at/over capacity.
+            a_spare = ca <= 0 or qa < ca
+            b_spare = cb <= 0 or qb < cb
+            if a_spare != b_spare:
+                return a if a_spare else b
             return a if qa <= qb else b
 
     def drop_replica(self, replica_id: str) -> bool:
@@ -88,6 +118,9 @@ class Router:
         self._controller = controller_handle
         self._scheduler = PowerOfTwoChoicesReplicaScheduler()
         self._replicas_populated = threading.Event()
+        #: Deployment-level queue allowance beyond capacity; -1 = unbounded
+        #: (the reference's default).  Refreshed with the replica set.
+        self._max_queued_requests = -1
         from ray_tpu.serve.long_poll import LongPollClient
 
         self._long_poll = LongPollClient(
@@ -103,6 +136,8 @@ class Router:
     def _update_replicas(self, replicas: List[Dict[str, Any]]) -> None:
         self._scheduler.update_replicas(replicas or [])
         if replicas:
+            self._max_queued_requests = int(
+                replicas[0].get("max_queued_requests", -1))
             self._replicas_populated.set()
         else:
             self._replicas_populated.clear()
@@ -114,15 +149,41 @@ class Router:
         from ray_tpu.exceptions import ActorDiedError
 
         while not self._stopped.wait(METRICS_PUSH_INTERVAL_S):
+            inflight = self._scheduler.total_inflight()
+            INFLIGHT_GAUGE.set(inflight,
+                               tags={"deployment": self.deployment_id})
             try:
                 self._controller.record_handle_metrics.remote(
-                    self.deployment_id, self.router_id,
-                    self._scheduler.total_inflight())
+                    self.deployment_id, self.router_id, inflight)
             except ActorDiedError:
                 self._stopped.set()  # controller gone: stop reporting
                 return
             except Exception:
                 pass
+
+    def _check_capacity(self) -> None:
+        """Shed when the deployment is saturated (ref: the reference's
+        handle-side max_queued_requests rejection).
+
+        With max_queued_requests unset (-1), excess requests queue in the
+        replicas' actor mailboxes as before.  With it set >= 0, at most
+        that many requests may wait beyond the replicas' combined
+        max_ongoing_requests capacity; the rest fail fast with
+        BackPressureError so overload sheds instead of collapsing latency.
+        """
+        max_queued = self._max_queued_requests
+        if max_queued < 0:
+            return
+        capacity = self._scheduler.total_capacity()
+        if capacity <= 0:
+            return  # no replicas yet: the startup wait path handles this
+        inflight = self._scheduler.total_inflight()
+        if inflight >= capacity + max_queued:
+            from ray_tpu.serve.exceptions import BackPressureError
+
+            SHED_COUNTER.inc(tags={"deployment": self.deployment_id})
+            raise BackPressureError(self.deployment_id, inflight, capacity,
+                                    max_queued)
 
     def _dispatch(self, send):
         """Shared choose-replica/retry core (ref: Router.assign_request):
@@ -154,6 +215,7 @@ class Router:
 
     def assign_request(self, method_name: str, *args, **kwargs):
         """Pick a replica and dispatch; returns the ObjectRef."""
+        self._check_capacity()
         _, rid, ref = self._dispatch(
             lambda r: r["actor"].handle_request.remote(
                 method_name, *args, **kwargs))
@@ -171,6 +233,7 @@ class Router:
         async replica never stalls its event loop.  All pulls stay pinned
         to the opening replica (a streaming response is served end-to-end
         by one replica)."""
+        self._check_capacity()
         replica, rid, sid_ref = self._dispatch(
             lambda r: r["actor"].start_stream.remote(
                 method_name, *args, **kwargs))

@@ -85,6 +85,13 @@ class Counter(Metric):
         with self._lock:
             self._values[k] = self._values.get(k, 0.0) + value
 
+    def get(self, tags: Optional[TagMap] = None) -> float:
+        """Current count for a tag set (0.0 if never incremented) — for
+        tests and in-process introspection; scraping goes through samples()."""
+        k = _tag_key(self._check_tags(tags))
+        with self._lock:
+            return self._values.get(k, 0.0)
+
     def samples(self):
         with self._lock:
             return [("", dict(k), v) for k, v in self._values.items()]
@@ -103,6 +110,13 @@ class Gauge(Metric):
         merged = self._check_tags(tags)
         with self._lock:
             self._values[_tag_key(merged)] = float(value)
+
+    def get(self, tags: Optional[TagMap] = None) -> float:
+        """Last set value for a tag set (0.0 if never set) — for tests and
+        in-process introspection."""
+        k = _tag_key(self._check_tags(tags))
+        with self._lock:
+            return self._values.get(k, 0.0)
 
     def clear(self) -> None:
         """Drop all tagged series (for samplers that rebuild state counts —

@@ -1,27 +1,46 @@
 """Serve benchmark artifact (VERDICT r2 item 9): router latency + HTTP
-streaming throughput, written to BENCH_SERVE.json (ref:
-release/microbenchmark/run_microbenchmark.py pattern).
+streaming throughput, plus the data-plane batching anchors, written to
+BENCH_SERVE.json (ref: release/microbenchmark/run_microbenchmark.py pattern).
 
-Usage: python scripts/bench_serve.py [--requests 300]
+Modes:
+  --mode latency  (default) unary router latency + streaming throughput
+  --mode batch    @serve.batch micro-batching vs per-request inference, and
+                  @serve.continuous_batch vs per-request streaming
+
+The batch mode simulates ONE accelerator per deployment with a lock + sleep:
+forward passes serialize, so unbatched requests pay the full forward each
+while batched/continuous requests share one pass per wave/iteration — the
+same reason real TPU serving batches.  Results merge into the existing
+artifact file so both modes accumulate into one BENCH_SERVE.json.
+
+Usage: python scripts/bench_serve.py [--mode batch] [--requests 300]
 """
 
 import argparse
 import http.client
 import json
+import os
 import sys
 import time
 
 sys.path.insert(0, ".")
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--requests", type=int, default=300)
-    ap.add_argument("--stream-tokens", type=int, default=2000)
-    ap.add_argument("--concurrent-streams", type=int, default=8)
-    ap.add_argument("--out", default="BENCH_SERVE.json")
-    args = ap.parse_args()
+def _merge_artifact(out_path: str, fields: dict) -> dict:
+    artifact = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                artifact = json.load(f)
+        except Exception:
+            artifact = {}
+    artifact.update(fields)
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+    return artifact
 
+
+def run_latency_mode(args) -> dict:
     import numpy as np
 
     import ray_tpu
@@ -73,51 +92,15 @@ def main():
 
     # ---- N CONCURRENT streams (the LLM-serving shape, VERDICT r3 weak
     # #6): aggregate tok/s across streams + p99 inter-chunk gap per stream.
-    import threading
-
     n_streams = args.concurrent_streams
     per_stream_tokens = max(100, args.stream_tokens // 4)
-    gaps: list = []
-    counts: list = [0] * n_streams
-    errors: list = []
-
-    def stream_client(idx: int):
-        try:
-            c = http.client.HTTPConnection(opts.host, opts.port, timeout=120)
-            c.request("GET", f"/bstream?n={per_stream_tokens}")
-            resp = c.getresponse()
-            local_gaps = []
-            last = None  # first read is TTFB, not an inter-chunk gap
-            total = 0
-            while True:
-                chunk = resp.read(64)
-                if not chunk:
-                    break
-                now = time.perf_counter()
-                if last is not None:
-                    local_gaps.append(now - last)
-                last = now
-                total += chunk.count(b" ")
-            counts[idx] = total
-            gaps.extend(local_gaps)
-            c.close()
-        except Exception as e:  # noqa: BLE001
-            errors.append(repr(e))
-
-    threads = [threading.Thread(target=stream_client, args=(i,))
-               for i in range(n_streams)]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout=300)
-    concurrent_s = time.perf_counter() - t0
-    assert not any(t.is_alive() for t in threads), \
-        "hung stream: artifact would be corrupt"
+    counts, gaps, errors = _concurrent_http_streams(
+        opts, "/bstream", n_streams, per_stream_tokens)
     assert not errors, errors
-    total_tokens = sum(counts)
+    total_tokens, concurrent_s = sum(c for c, _ in counts), max(
+        s for _, s in counts)
 
-    artifact = {
+    fields = {
         "router_unary_p50_ms": round(float(np.percentile(lat, 50)), 3),
         "router_unary_p99_ms": round(float(np.percentile(lat, 99)), 3),
         "router_unary_qps": round(args.requests / (lat.sum() / 1000), 1),
@@ -132,8 +115,232 @@ def main():
     }
     serve.shutdown()
     ray_tpu.shutdown()
-    with open(args.out, "w") as f:
-        json.dump(artifact, f, indent=2)
+    return fields
+
+
+def _concurrent_http_streams(opts, path: str, n_streams: int,
+                             tokens_per_stream: int):
+    """Drive n_streams concurrent HTTP streaming requests; returns
+    ([(token_count, wall_s)], inter-chunk gaps, errors)."""
+    import threading
+
+    counts: list = [(0, 0.0)] * n_streams
+    gaps: list = []
+    errors: list = []
+    barrier = threading.Barrier(n_streams + 1)
+
+    def client(idx: int):
+        try:
+            c = http.client.HTTPConnection(opts.host, opts.port, timeout=300)
+            barrier.wait()
+            t0 = time.perf_counter()
+            c.request("GET", f"{path}?n={tokens_per_stream}")
+            resp = c.getresponse()
+            local_gaps = []
+            last = None  # first read is TTFB, not an inter-chunk gap
+            total = 0
+            while True:
+                chunk = resp.read(64)
+                if not chunk:
+                    break
+                now = time.perf_counter()
+                if last is not None:
+                    local_gaps.append(now - last)
+                last = now
+                total += chunk.count(b" ")
+            counts[idx] = (total, time.perf_counter() - t0)
+            gaps.extend(local_gaps)
+            c.close()
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(n_streams)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    for t in threads:
+        t.join(timeout=600)
+    assert not any(t.is_alive() for t in threads), \
+        "hung stream: artifact would be corrupt"
+    return counts, gaps, errors
+
+
+def run_batch_mode(args) -> dict:
+    """Micro-batching + continuous-batching anchors (ISSUE 2 acceptance:
+    batched unary >= 3x unbatched at 32 concurrent; continuous streaming
+    >= 2x per-request at 8 streams)."""
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    FORWARD_S = 0.005  # one unary forward pass on the simulated device
+    STEP_S = 0.01      # one decode iteration on the simulated device
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start(http_options={"port": 0})
+
+    # ---------------------------------------------------------- unary side
+    def make_unary_app(batched: bool):
+        lock = threading.Lock()  # the deployment's single accelerator
+
+        def forward():
+            with lock:
+                time.sleep(FORWARD_S)
+
+        if batched:
+            @serve.deployment(max_ongoing_requests=64)
+            class Model:
+                @serve.batch(max_batch_size=32, batch_wait_timeout_s=0.01)
+                async def infer(self, items):
+                    forward()  # ONE shared pass for the whole micro-batch
+                    return [x * 2 for x in items]
+
+                async def __call__(self, x):
+                    return await self.infer(x)
+        else:
+            @serve.deployment(max_ongoing_requests=64)
+            class Model:
+                def __call__(self, x):
+                    forward()  # one full pass per request
+                    return x * 2
+
+        return Model.bind()
+
+    def measure_qps(handle, concurrency: int, per_client: int = 12) -> float:
+        barrier = threading.Barrier(concurrency + 1)
+        errors: list = []
+
+        def worker():
+            try:
+                barrier.wait()
+                for i in range(per_client):
+                    assert handle.remote(i).result(timeout_s=120) == i * 2
+            except Exception as e:  # noqa: BLE001
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(concurrency)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join(timeout=600)
+        elapsed = time.perf_counter() - t0
+        assert not errors, errors
+        return concurrency * per_client / elapsed
+
+    fields = {}
+    handles = {}
+    for kind, batched in (("unbatched", False), ("batched", True)):
+        h = serve.run(make_unary_app(batched), name=f"bench_{kind}",
+                      route_prefix=None)
+        h.remote(0).result(timeout_s=60)  # warm
+        handles[kind] = h
+        for c in (1, 8, 32):
+            fields[f"batch_unary_{kind}_qps_c{c}"] = round(
+                measure_qps(h, c), 1)
+    fields["batch_unary_speedup_c32"] = round(
+        fields["batch_unary_batched_qps_c32"]
+        / fields["batch_unary_unbatched_qps_c32"], 2)
+
+    # ------------------------------------------------------ streaming side
+    n_streams = args.concurrent_streams
+    tokens = 30
+
+    def make_per_request_stream():
+        lock = threading.Lock()
+
+        @serve.deployment(max_ongoing_requests=64)
+        class PerRequestLM:
+            def __call__(self, request):
+                n = int(request.query_params.get("n", "30"))
+                for i in range(n):
+                    with lock:  # each stream decodes alone on the device
+                        time.sleep(STEP_S)
+                    yield f"tok{i} "
+
+        return PerRequestLM.bind()
+
+    def make_continuous_stream():
+        lock = threading.Lock()
+
+        @serve.deployment(max_ongoing_requests=64)
+        class ContinuousLM:
+            @serve.continuous_batch(max_batch_size=32)
+            def __call__(self, slots):
+                with lock:  # ONE decode iteration for every live sequence
+                    time.sleep(STEP_S)
+                outs = []
+                for s in slots:
+                    st = s.state
+                    if "n" not in st:
+                        st["n"] = int(
+                            s.request.query_params.get("n", "30"))
+                        st["i"] = 0
+                    i, st["i"] = st["i"], st["i"] + 1
+                    outs.append(serve.EOS if i >= st["n"] - 1
+                                else f"tok{i} ")
+                return outs
+
+        return ContinuousLM.bind()
+
+    serve.run(make_per_request_stream(), name="bench_pstream",
+              route_prefix="/pstream")
+    serve.run(make_continuous_stream(), name="bench_cstream",
+              route_prefix="/cstream")
+    from ray_tpu.serve.api import _state
+
+    opts = _state["proxy"]._options
+    for path in ("/pstream", "/cstream"):  # warm both stream paths
+        c = http.client.HTTPConnection(opts.host, opts.port, timeout=120)
+        c.request("GET", f"{path}?n=3")
+        c.getresponse().read()
+        c.close()
+
+    for key, path in (("per_request", "/pstream"),
+                      ("continuous", "/cstream")):
+        counts, gaps, errors = _concurrent_http_streams(
+            opts, path, n_streams, tokens)
+        assert not errors, errors
+        total = sum(cnt for cnt, _ in counts)
+        wall = max(s for _, s in counts)
+        assert total >= n_streams * (tokens - 1), (key, counts)
+        fields[f"stream_{key}_tokens_per_s_{n_streams}"] = round(
+            total / wall, 1)
+        fields[f"stream_{key}_gap_p99_ms_{n_streams}"] = round(
+            float(np.percentile(np.asarray(gaps) * 1000, 99)), 3)
+    fields[f"stream_continuous_speedup_{n_streams}"] = round(
+        fields[f"stream_continuous_tokens_per_s_{n_streams}"]
+        / fields[f"stream_per_request_tokens_per_s_{n_streams}"], 2)
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+    # Acceptance anchors (ISSUE 2): fail loudly rather than record a
+    # regressed artifact.
+    assert fields["batch_unary_speedup_c32"] >= 3.0, fields
+    assert fields[f"stream_continuous_speedup_{n_streams}"] >= 2.0, fields
+    return fields
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("latency", "batch"),
+                    default="latency")
+    ap.add_argument("--requests", type=int, default=300)
+    ap.add_argument("--stream-tokens", type=int, default=2000)
+    ap.add_argument("--concurrent-streams", type=int, default=8)
+    ap.add_argument("--out", default="BENCH_SERVE.json")
+    args = ap.parse_args()
+
+    fields = (run_batch_mode(args) if args.mode == "batch"
+              else run_latency_mode(args))
+    artifact = _merge_artifact(args.out, fields)
     print(json.dumps(artifact))
 
 

