@@ -14,6 +14,12 @@ Each entry is <point>=<probability>[:<max_failures>]; max_failures caps how
 many times the point fires (unbounded if omitted).  Delays:
     testing_delay_us = 500   # every point sleeps 500us before evaluating
 
+Serve data/control-plane points (exercised by tests/test_serve_chaos.py):
+    serve_route          router dispatch (handle/proxy -> replica pick)
+    serve_replica_handle replica request entry (unary handle_request)
+    serve_health_probe   replica check_health (drives UNHEALTHY recovery)
+    serve_long_poll      controller listen_for_change (client must retry)
+
 Deterministic across runs for a fixed RAY_TPU_TESTING_CHAOS_SEED.
 """
 

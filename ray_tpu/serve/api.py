@@ -36,7 +36,12 @@ class Deployment:
                 max_queued_requests: Optional[int] = None,
                 user_config: Optional[Any] = None,
                 autoscaling_config: Optional[Union[AutoscalingConfig, Dict]] = None,
-                ray_actor_options: Optional[Dict] = None) -> "Deployment":
+                ray_actor_options: Optional[Dict] = None,
+                health_check_period_s: Optional[float] = None,
+                health_check_timeout_s: Optional[float] = None,
+                graceful_shutdown_wait_loop_s: Optional[float] = None,
+                graceful_shutdown_timeout_s: Optional[float] = None,
+                max_unavailable: Optional[int] = None) -> "Deployment":
         import copy
 
         cfg = copy.deepcopy(self.config)
@@ -54,6 +59,16 @@ class Deployment:
             cfg.autoscaling_config = autoscaling_config
         if ray_actor_options is not None:
             cfg.ray_actor_options = dict(ray_actor_options)
+        if health_check_period_s is not None:
+            cfg.health_check_period_s = health_check_period_s
+        if health_check_timeout_s is not None:
+            cfg.health_check_timeout_s = health_check_timeout_s
+        if graceful_shutdown_wait_loop_s is not None:
+            cfg.graceful_shutdown_wait_loop_s = graceful_shutdown_wait_loop_s
+        if graceful_shutdown_timeout_s is not None:
+            cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
+        if max_unavailable is not None:
+            cfg.max_unavailable = max_unavailable
         return Deployment(self.func_or_class, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> "Application":
@@ -83,7 +98,11 @@ def deployment(_func_or_class: Optional[Any] = None, *,
                user_config: Optional[Any] = None,
                autoscaling_config: Optional[Union[AutoscalingConfig, Dict]] = None,
                ray_actor_options: Optional[Dict] = None,
-               health_check_period_s: float = 10.0) -> Any:
+               health_check_period_s: float = 10.0,
+               health_check_timeout_s: float = 30.0,
+               graceful_shutdown_wait_loop_s: float = 2.0,
+               graceful_shutdown_timeout_s: float = 5.0,
+               max_unavailable: int = 0) -> Any:
     """@serve.deployment (ref: serve/api.py:deployment)."""
 
     def decorate(obj):
@@ -98,6 +117,10 @@ def deployment(_func_or_class: Optional[Any] = None, *,
             user_config=user_config,
             autoscaling_config=asc,
             health_check_period_s=health_check_period_s,
+            health_check_timeout_s=health_check_timeout_s,
+            graceful_shutdown_wait_loop_s=graceful_shutdown_wait_loop_s,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+            max_unavailable=max_unavailable,
             ray_actor_options=dict(ray_actor_options or {}))
         return Deployment(obj, name or obj.__name__, cfg)
 

@@ -36,9 +36,22 @@ class DeploymentConfig:
     max_queued_requests: int = -1
     user_config: Optional[Any] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
+    #: Interval between controller-driven check_health() probes on RUNNING
+    #: replicas (the first probe fires as soon as the replica is RUNNING).
     health_check_period_s: float = 10.0
+    #: A probe outstanding longer than this counts as one failure.
     health_check_timeout_s: float = 30.0
+    #: Consecutive probe failures before RUNNING -> UNHEALTHY (actor death
+    #: short-circuits the threshold — a corpse is unhealthy immediately).
+    health_check_failure_threshold: int = 3
+    #: How long a DRAINING replica waits for its in-flight requests and
+    #: streams to finish before prepare_for_shutdown returns.
+    graceful_shutdown_wait_loop_s: float = 2.0
+    #: Hard-kill deadline counted from when draining began.
     graceful_shutdown_timeout_s: float = 5.0
+    #: During a rolling update, how many replicas below target the healthy
+    #: count may drop; 0 = never lose capacity (surge-then-drain).
+    max_unavailable: int = 0
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
 
 

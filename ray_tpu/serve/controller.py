@@ -284,13 +284,27 @@ class ServeController:
         callers that query before any deploy/long-poll touched it."""
         await self._ensure_loop()
         out = {}
+        now = time.time()
         for dep_id, state in self._manager.deployments.items():
             running = state.num_running()
+            unhealthy = state.num_unhealthy()
+            if running >= state.target_num:
+                status = "HEALTHY"
+            elif unhealthy or state.consecutive_start_failures:
+                # Short of target because replicas are failing (probes or
+                # starts) — distinct from a rolling update in progress.
+                status = "UNHEALTHY"
+            else:
+                status = "UPDATING"
             out[dep_id] = {
                 "target_num_replicas": state.target_num,
                 "running_replicas": running,
-                "status": ("HEALTHY" if running >= state.target_num
-                           else "UPDATING"),
+                "unhealthy_replicas": unhealthy,
+                "replica_restarts": state.num_restarts,
+                "consecutive_start_failures": state.consecutive_start_failures,
+                "backoff_remaining_s": round(
+                    max(0.0, state.backoff_until - now), 3),
+                "status": status,
             }
         return out
 

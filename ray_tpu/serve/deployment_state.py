@@ -1,8 +1,15 @@
-"""Deployment & replica state machines + reconciler.
+"""Deployment & replica state machines + self-healing reconciler.
 
 (ref: python/ray/serve/_private/deployment_state.py — DeploymentState:1248
 replica FSM with STARTING/RUNNING/STOPPING sets, rolling updates on version
-change; DeploymentStateManager:2339 reconciles every control-loop tick.)
+change; DeploymentStateManager:2339 reconciles every control-loop tick;
+health checks driven by health_check_period_s/health_check_timeout_s and
+graceful drain by graceful_shutdown_* in the deployment config.)
+
+Recovery is an always-on reconciliation loop, not an error path (Wang et
+al., NSDI '21): every tick the reconciler probes RUNNING replicas, replaces
+dead/unhealthy ones, and pushes the shrunken routing table immediately —
+the router never has to discover a corpse per-request.
 """
 
 from __future__ import annotations
@@ -15,8 +22,31 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu.exceptions import ActorDiedError
 from ray_tpu.serve.config import DeploymentConfig
 from ray_tpu.serve.replica import ReplicaActor
+from ray_tpu.util import metrics as _metrics
+
+#: Exponential crash-loop backoff for replica replacement after failed
+#: starts: base * 2**(consecutive_failures - 1), capped (ref: the
+#: reference's EXPONENTIAL_BACKOFF_FACTOR on repeated replica failures —
+#: a bad __init__ must not hot-loop the cluster).
+CRASH_LOOP_BACKOFF_BASE_S = 1.0
+CRASH_LOOP_BACKOFF_MAX_S = 32.0
+
+HEALTHY_GAUGE = _metrics.Gauge(
+    "serve_num_healthy_replicas",
+    "RUNNING replicas per deployment, as seen by the reconciler",
+    tag_keys=("deployment",))
+UNHEALTHY_GAUGE = _metrics.Gauge(
+    "serve_num_unhealthy_replicas",
+    "Replicas failing health checks (UNHEALTHY or draining after one)",
+    tag_keys=("deployment",))
+RESTARTS_COUNTER = _metrics.Counter(
+    "serve_replica_restarts",
+    "Replica replacements scheduled after a failed start, death, or "
+    "failed health checks",
+    tag_keys=("deployment",))
 
 
 @dataclass
@@ -50,7 +80,14 @@ class DeploymentInfo:
 class ReplicaState:
     STARTING = "STARTING"
     RUNNING = "RUNNING"
-    STOPPING = "STOPPING"
+    #: Failed health checks / died; removed from routing, about to drain.
+    UNHEALTHY = "UNHEALTHY"
+    #: Removed from routing; in-flight requests+streams get
+    #: graceful_shutdown_wait_loop_s to finish, hard kill at
+    #: graceful_shutdown_timeout_s.
+    DRAINING = "DRAINING"
+    #: Back-compat alias (pre-health-check FSM called draining "stopping").
+    STOPPING = DRAINING
 
 
 class ReplicaWrapper:
@@ -62,6 +99,23 @@ class ReplicaWrapper:
         self.version = info.version()
         self.state = ReplicaState.STARTING
         self.started_at = time.time()
+        self.stopping_since: Optional[float] = None
+        #: Why this replica left RUNNING ("unhealthy", "dead") — feeds the
+        #: unhealthy gauge while it drains.
+        self.unhealthy_reason: Optional[str] = None
+        # Health-probe FSM (controller side).  The FIRST probe runs while
+        # still STARTING: a replica enters RUNNING (and the routing table)
+        # only after initialize + one successful check_health, which is
+        # what gates old-version teardown during rolling updates.
+        self._health_ref = None
+        self._init_health_ref = None
+        self._health_started = 0.0
+        self._last_probe_time = 0.0
+        self.consecutive_failures = 0
+        self.passed_first_health = False
+        self._config = info.config
+        self._drain_wait_loop_s = info.config.graceful_shutdown_wait_loop_s
+        self._drain_timeout_s = info.config.graceful_shutdown_timeout_s
         opts = dict(info.config.ray_actor_options)
         if opts.get("isolation") == "process" or opts.get("runtime_env"):
             # Process-tier replica: sync actor class (async actors cannot
@@ -74,9 +128,11 @@ class ReplicaWrapper:
             actor_cls = ReplicaActor
         # Real per-replica concurrency on BOTH tiers: thread replicas via
         # mailbox threads; process replicas via the seq-multiplexed worker
-        # pipe + in-worker threads (process_pool.py).
+        # pipe + in-worker threads (process_pool.py).  +3 headroom keeps
+        # control-plane calls (check_health, prepare_for_shutdown,
+        # cancel_stream) from starving behind a data-saturated semaphore.
         opts.setdefault("max_concurrency",
-                        max(1, info.config.max_ongoing_requests))
+                        max(1, info.config.max_ongoing_requests) + 3)
         self.actor = ray_tpu.remote(actor_cls).options(**opts).remote(
             info.name, self.replica_id, info.deployment_def,
             info.init_args, dict(info.init_kwargs),
@@ -86,32 +142,102 @@ class ReplicaWrapper:
         self._stop_ref = None
 
     def check_ready(self) -> Optional[bool]:
-        """True ready / False failed / None still starting."""
-        ready, _ = ray_tpu.wait([self._ready_ref], num_returns=1, timeout=0)
-        if not ready:
-            return None
-        try:
-            ray_tpu.get(self._ready_ref)
-            return True
-        except Exception:
-            return False
+        """True ready / False failed / None still starting.
 
-    def begin_stop(self) -> None:
-        self.state = ReplicaState.STOPPING
+        Two phases: initialize_and_get_metadata, then the replica's first
+        check_health() — it is not routable until both succeed, so a
+        deployment reported HEALTHY has probed healthy at least once."""
+        if self._init_health_ref is None:
+            ready, _ = ray_tpu.wait([self._ready_ref], num_returns=1,
+                                    timeout=0)
+            if not ready:
+                return None
+            try:
+                ray_tpu.get(self._ready_ref)
+            except Exception:
+                return False
+            self._init_health_ref = self.actor.check_health.remote()
+            self._health_started = time.time()
+            return None
+        done, _ = ray_tpu.wait([self._init_health_ref], num_returns=1,
+                               timeout=0)
+        if done:
+            try:
+                ray_tpu.get(self._init_health_ref)
+            except Exception:
+                return False
+            self.passed_first_health = True
+            self._last_probe_time = time.time()
+            return True
+        if time.time() - self._health_started > self._config.health_check_timeout_s:
+            return False  # initial probe wedged: a failed start
+        return None
+
+    # ------------------------------------------------------------- health
+    def probe_health(self, now: float, config: DeploymentConfig) -> Optional[str]:
+        """Drive the periodic check_health() probe for a RUNNING replica.
+
+        Returns "dead" the moment the actor is observed dead, "unhealthy"
+        once consecutive failures (probe raised, or outstanding past
+        health_check_timeout_s) reach the threshold, else None.
+        """
+        if self._health_ref is None:
+            if now - self._last_probe_time >= config.health_check_period_s:
+                self._health_ref = self.actor.check_health.remote()
+                self._health_started = now
+            return None
+        done, _ = ray_tpu.wait([self._health_ref], num_returns=1, timeout=0)
+        if done:
+            ref, self._health_ref = self._health_ref, None
+            self._last_probe_time = now
+            try:
+                ray_tpu.get(ref)
+            except ActorDiedError:
+                return "dead"
+            except Exception:
+                self.consecutive_failures += 1
+            else:
+                self.consecutive_failures = 0
+                self.passed_first_health = True
+                return None
+        elif now - self._health_started > config.health_check_timeout_s:
+            # Probe wedged: count it and let the next period re-probe.
+            self._health_ref = None
+            self._last_probe_time = now
+            self.consecutive_failures += 1
+        if self.consecutive_failures >= config.health_check_failure_threshold:
+            return "unhealthy"
+        return None
+
+    # -------------------------------------------------------------- drain
+    def begin_drain(self, reason: Optional[str] = None) -> None:
+        """DRAINING: out of routing, in-flight work gets
+        graceful_shutdown_wait_loop_s, hard kill at
+        graceful_shutdown_timeout_s (both from the deployment config)."""
+        self.state = ReplicaState.DRAINING
         self.stopping_since = time.time()
-        self._stop_ref = self.actor.prepare_for_shutdown.remote()
+        if reason is not None:
+            self.unhealthy_reason = reason
+        self._stop_ref = self.actor.prepare_for_shutdown.remote(
+            self._drain_wait_loop_s)
+
+    # Back-compat name (pre-health-check FSM).
+    begin_stop = begin_drain
+
+    def hard_kill(self) -> None:
+        try:
+            ray_tpu.kill(self.actor)
+        except Exception:
+            pass
 
     def check_stopped(self) -> bool:
         if self._stop_ref is None:
             return True
         done, _ = ray_tpu.wait([self._stop_ref], num_returns=1, timeout=0)
-        # Hard-kill deadline counts from when stopping BEGAN, not creation —
+        # Hard-kill deadline counts from when draining BEGAN, not creation —
         # else any replica older than the deadline loses its graceful drain.
-        if done or time.time() - self.stopping_since > 60:
-            try:
-                ray_tpu.kill(self.actor)
-            except Exception:
-                pass
+        if done or time.time() - self.stopping_since > self._drain_timeout_s:
+            self.hard_kill()
             return True
         return False
 
@@ -129,6 +255,10 @@ class DeploymentState:
         self.replicas: List[ReplicaWrapper] = []
         self.deleting = False
         self._changed = True
+        # Crash-loop backoff (consecutive failed starts gate replacements).
+        self.consecutive_start_failures = 0
+        self.backoff_until = 0.0
+        self.num_restarts = 0  # mirror of the counter, for status()
 
     # ------------------------------------------------------------- targets
     def set_target(self, info: DeploymentInfo) -> None:
@@ -143,6 +273,10 @@ class DeploymentState:
         self.info = info
         if info.version() != old_version:
             self._changed = True
+            # New code/config gets a fresh chance immediately: the backoff
+            # guarded the OLD version's crash loop.
+            self.consecutive_start_failures = 0
+            self.backoff_until = 0.0
 
     def set_target_num(self, n: int) -> None:
         """Autoscaler entry point."""
@@ -154,55 +288,131 @@ class DeploymentState:
         self.deleting = True
         self.target_num = 0
 
+    # ----------------------------------------------------------- internals
+    def _record_failure(self, now: float) -> None:
+        """One replica start failed: grow the crash-loop backoff window."""
+        self.consecutive_start_failures += 1
+        backoff = min(
+            CRASH_LOOP_BACKOFF_BASE_S * 2 ** (self.consecutive_start_failures - 1),
+            CRASH_LOOP_BACKOFF_MAX_S)
+        self.backoff_until = max(self.backoff_until, now + backoff)
+
+    def _record_restart(self) -> None:
+        self.num_restarts += 1
+        RESTARTS_COUNTER.inc(tags={"deployment": self.info.id})
+
+    def _start_replica(self) -> None:
+        self.replicas.append(ReplicaWrapper(self.info))
+
+    def _can_start(self, now: float) -> bool:
+        return now >= self.backoff_until
+
     # ------------------------------------------------------------ reconcile
     def reconcile(self) -> bool:
         """One tick; returns True if the running-replica set changed."""
         changed = False
+        now = time.time()
+        config = self.info.config
         target_version = self.info.version()
 
-        # STARTING → RUNNING / failed
+        # STARTING → RUNNING / failed (failed starts feed the crash-loop
+        # backoff so a bad __init__ can't hot-loop replacements).
         for r in list(self.replicas):
             if r.state == ReplicaState.STARTING:
                 ready = r.check_ready()
                 if ready is True:
                     r.state = ReplicaState.RUNNING
+                    self.consecutive_start_failures = 0
+                    self.backoff_until = 0.0
                     changed = True
                 elif ready is False:
-                    self.replicas.remove(r)  # failed start; next tick re-adds
+                    self.replicas.remove(r)
+                    r.hard_kill()
+                    self._record_failure(now)
+                    self._record_restart()
 
-        # STOPPING → gone
+        # RUNNING → UNHEALTHY on failed probes / observed death.  The
+        # transition leaves running_replicas() immediately, so the changed
+        # flag pushes the shrunken routing table this same tick.
+        for r in self.replicas:
+            if r.state != ReplicaState.RUNNING:
+                continue
+            verdict = r.probe_health(now, config)
+            if verdict is not None:
+                r.state = ReplicaState.UNHEALTHY
+                r.unhealthy_reason = verdict
+                if not r.passed_first_health:
+                    # Crashed before ever probing healthy: treat like a
+                    # failed start so an init-OK-then-instant-crash loop
+                    # still backs off.
+                    self._record_failure(now)
+                self._record_restart()
+                changed = True
+
+        # UNHEALTHY → DRAINING (dead actors skip the drain — nothing to
+        # wait for) — the replacement starts below via the scale-up path.
         for r in list(self.replicas):
-            if r.state == ReplicaState.STOPPING and r.check_stopped():
+            if r.state == ReplicaState.UNHEALTHY:
+                if r.unhealthy_reason == "dead":
+                    r.hard_kill()
+                    self.replicas.remove(r)
+                else:
+                    r.begin_drain()
+
+        # DRAINING → gone
+        for r in list(self.replicas):
+            if r.state == ReplicaState.DRAINING and r.check_stopped():
                 self.replicas.remove(r)
 
-        live = [r for r in self.replicas if r.state != ReplicaState.STOPPING]
+        live = [r for r in self.replicas
+                if r.state in (ReplicaState.STARTING, ReplicaState.RUNNING)]
 
-        # Rolling update: stop one outdated replica per tick once a same-or-
-        # newer replacement is running (ref: deployment_state rolling update
-        # with max surge).
+        # Rolling update: drain outdated replicas once a same-or-newer
+        # replacement is RUNNING and has passed its FIRST health check, and
+        # never let the healthy count drop below target - max_unavailable
+        # (ref: deployment_state rolling update with max surge).
         outdated = [r for r in live if r.version != target_version]
-        if outdated:
+        if outdated and not self.deleting:
             current = [r for r in live if r.version == target_version]
             if len(current) < self.target_num and \
-                    len(live) <= self.target_num:
-                self.replicas.append(ReplicaWrapper(self.info))
-            running_current = [r for r in current
-                               if r.state == ReplicaState.RUNNING]
-            if running_current or self.target_num == 0:
-                victim = outdated[0]
-                victim.begin_stop()
-                changed = True
-            return changed or bool(outdated)
+                    len(live) <= self.target_num and self._can_start(now):
+                self._start_replica()  # surge of one while updating
+            healthy_current = [r for r in current
+                               if r.state == ReplicaState.RUNNING
+                               and r.passed_first_health]
+            num_healthy = sum(1 for r in live
+                              if r.state == ReplicaState.RUNNING
+                              and r.passed_first_health)
+            floor = max(0, self.target_num - max(0, config.max_unavailable))
+            if healthy_current or self.target_num == 0:
+                # Prefer a victim that is not serving (STARTING) — it costs
+                # no capacity; else drain one RUNNING outdated replica only
+                # if the floor survives it.
+                victims = sorted(outdated,
+                                 key=lambda r: r.state == ReplicaState.RUNNING)
+                for victim in victims:
+                    serving = (victim.state == ReplicaState.RUNNING
+                               and victim.passed_first_health)
+                    if serving and num_healthy - 1 < floor \
+                            and self.target_num > 0:
+                        continue  # would violate the availability floor
+                    victim.begin_drain()
+                    changed = True
+                    break  # one per tick, as before
+            return True  # keep reconciling until the update converges
 
-        # Scale up/down to target.
+        # Scale up/down to target (auto-recovery lands here: a removed
+        # dead/unhealthy replica leaves live < target), gated by the
+        # crash-loop backoff.
         if len(live) < self.target_num:
-            for _ in range(self.target_num - len(live)):
-                self.replicas.append(ReplicaWrapper(self.info))
+            if self._can_start(now):
+                for _ in range(self.target_num - len(live)):
+                    self._start_replica()
         elif len(live) > self.target_num:
-            # Prefer stopping replicas that are still starting.
+            # Prefer draining replicas that are still starting.
             victims = sorted(live, key=lambda r: r.state == ReplicaState.RUNNING)
             for r in victims[: len(live) - self.target_num]:
-                r.begin_stop()
+                r.begin_drain()
                 changed = True
         return changed
 
@@ -219,6 +429,9 @@ class DeploymentState:
 
     def num_running(self) -> int:
         return sum(1 for r in self.replicas if r.state == ReplicaState.RUNNING)
+
+    def num_unhealthy(self) -> int:
+        return sum(1 for r in self.replicas if r.unhealthy_reason is not None)
 
 
 class DeploymentStateManager:
@@ -250,4 +463,13 @@ class DeploymentStateManager:
             if state.is_deleted:
                 del self.deployments[dep_id]
                 updates[dep_id] = []
+        # Rebuild the health gauges from scratch each tick so a deleted
+        # deployment's series doesn't report its stale last value forever.
+        HEALTHY_GAUGE.clear()
+        UNHEALTHY_GAUGE.clear()
+        for dep_id, state in self.deployments.items():
+            HEALTHY_GAUGE.set(state.num_running(),
+                              tags={"deployment": dep_id})
+            UNHEALTHY_GAUGE.set(state.num_unhealthy(),
+                                tags={"deployment": dep_id})
         return updates

@@ -38,6 +38,11 @@ class LongPollHost:
                                 timeout_s: float = 30.0) -> Dict[str, Tuple[int, Any]]:
         """Return keys whose snapshot advanced past the client's; park until
         one does (ref: LongPollHost.listen_for_change)."""
+        from ray_tpu._private import fault_injection
+
+        # Chaos point: an injected failure here surfaces as a failed listen
+        # on the client, which must retry without losing its snapshot ids.
+        fault_injection.check("serve_long_poll")
         out = {
             key: self._snapshots[key]
             for key, sid in keys_to_snapshot_ids.items()

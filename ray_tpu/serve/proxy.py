@@ -150,6 +150,8 @@ class HTTPProxy:
             shed = self._as_backpressure(e)
             if shed is not None:
                 return self._overloaded_response(shed)
+            if self._is_replica_died(e):
+                return self._recovering_response(e)
             return web.Response(status=500, text=f"Internal error: {e!r}")
         return self._to_http_response(result)
 
@@ -167,6 +169,23 @@ class HTTPProxy:
                 getattr(e, "cause", None), BackPressureError):
             return e.cause
         return None
+
+    @staticmethod
+    def _is_replica_died(e: BaseException) -> bool:
+        """Replica death that survived the handle's retries: the deployment
+        is mid-recovery (the reconciler is already starting a replacement),
+        so answer 503 retryable, not 500 internal error."""
+        from ray_tpu.exceptions import ActorDiedError
+
+        return isinstance(e, ActorDiedError)
+
+    @staticmethod
+    def _recovering_response(e: BaseException):
+        from aiohttp import web
+
+        return web.Response(
+            status=503, headers={"Retry-After": "1"},
+            text=f"Replica died; recovery in progress: {e!r}")
 
     @staticmethod
     def _overloaded_response(shed):
@@ -206,6 +225,8 @@ class HTTPProxy:
             shed = self._as_backpressure(e)
             if shed is not None:
                 return self._overloaded_response(shed)
+            if self._is_replica_died(e):
+                return self._recovering_response(e)
             return web.Response(status=500, text=f"Internal error: {e!r}")
         sse = "text/event-stream" in request.headers.get("Accept", "")
         resp = web.StreamResponse()

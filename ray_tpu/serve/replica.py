@@ -123,8 +123,17 @@ class UserCallableWrapper:
                 await out
 
     async def call_health_check(self) -> None:
+        """User-overridable probe: a deployment class may define
+        check_health() (sync or async); raising marks the probe failed
+        (ref: replica.py check_health / the deployment's user health
+        check).  Sync checks run on the executor — a blocking probe must
+        not stall the replica's event loop."""
         if self._is_class and hasattr(self._callable, "check_health"):
-            out = self._callable.check_health()
+            fn = self._callable.check_health
+            if not _is_async_callable(fn):
+                await run_in_executor(fn, executor=self._executor())
+                return
+            out = fn()
             if inspect.isawaitable(out):
                 await out
 
@@ -156,6 +165,9 @@ class ReplicaActor:
 
     # ------------------------------------------------------------- requests
     async def handle_request(self, method_name: str, *args, **kwargs) -> Any:
+        from ray_tpu._private import fault_injection
+
+        fault_injection.check("serve_replica_handle")
         self._num_ongoing += 1
         try:
             from ray_tpu.serve import context as serve_context
@@ -289,13 +301,18 @@ class ReplicaActor:
         await self._wrapper.call_reconfigure(user_config)
 
     async def check_health(self) -> bool:
+        from ray_tpu._private import fault_injection
+
+        fault_injection.check("serve_health_probe")
         await self._wrapper.call_health_check()
         return True
 
-    async def prepare_for_shutdown(self) -> None:
-        """Drain: wait for in-flight requests (ref: replica graceful
+    async def prepare_for_shutdown(self, wait_loop_s: float = 5.0) -> None:
+        """Drain: in-flight requests AND streams (both count in
+        _num_ongoing) get wait_loop_s to finish; the controller hard-kills
+        at graceful_shutdown_timeout_s regardless (ref: replica graceful
         shutdown loop)."""
-        deadline = time.time() + 5.0
+        deadline = time.time() + wait_loop_s
         while self._num_ongoing > 0 and time.time() < deadline:
             await asyncio.sleep(0.02)
 
@@ -317,6 +334,9 @@ class SyncReplicaActor(ReplicaActor):
         return {"replica_id": self.replica_id}
 
     def handle_request(self, method_name: str, *args, **kwargs) -> Any:
+        from ray_tpu._private import fault_injection
+
+        fault_injection.check("serve_replica_handle")
         self._num_ongoing += 1
         try:
             from ray_tpu.serve import context as serve_context
@@ -362,10 +382,13 @@ class SyncReplicaActor(ReplicaActor):
         asyncio.run(self._wrapper.call_reconfigure(user_config))
 
     def check_health(self) -> bool:
+        from ray_tpu._private import fault_injection
+
+        fault_injection.check("serve_health_probe")
         asyncio.run(self._wrapper.call_health_check())
         return True
 
-    def prepare_for_shutdown(self) -> None:
-        deadline = time.time() + 5.0
+    def prepare_for_shutdown(self, wait_loop_s: float = 5.0) -> None:
+        deadline = time.time() + wait_loop_s
         while self._num_ongoing > 0 and time.time() < deadline:
             time.sleep(0.02)

@@ -190,8 +190,10 @@ class Router:
         replicas dead at dispatch (rolling update raced the long-poll) are
         dropped locally and the request re-assigned.  ``send(replica)``
         performs the actual (non-blocking) submit and returns its result."""
+        from ray_tpu._private import fault_injection
         from ray_tpu.exceptions import ActorDiedError
 
+        fault_injection.check("serve_route")
         deadline = time.time() + 30.0
         while True:
             replica = self._scheduler.choose_replica()
@@ -219,11 +221,22 @@ class Router:
         _, rid, ref = self._dispatch(
             lambda r: r["actor"].handle_request.remote(
                 method_name, *args, **kwargs))
-        # Decrement the local queue estimate when the reply lands.
+        # Decrement the local queue estimate when the reply lands — and if
+        # the reply is the replica's death, drop it from the local set
+        # immediately so retries and later requests can't re-pick the
+        # corpse while the reconciler's long-poll push is in flight.
         from ray_tpu._private import runtime as _rt
+        from ray_tpu.exceptions import ActorDiedError
+
+        def _on_reply(f):
+            self._scheduler.on_request_done(rid)
+            exc = f.exception()
+            if isinstance(exc, ActorDiedError):
+                if not self._scheduler.drop_replica(rid):
+                    self._replicas_populated.clear()
 
         fut = _rt.get_runtime().as_future(ref)
-        fut.add_done_callback(lambda _f: self._scheduler.on_request_done(rid))
+        fut.add_done_callback(_on_reply)
         return ref
 
     def assign_stream(self, method_name: str, *args, **kwargs):

@@ -6,6 +6,8 @@ Modes:
   --mode latency  (default) unary router latency + streaming throughput
   --mode batch    @serve.batch micro-batching vs per-request inference, and
                   @serve.continuous_batch vs per-request streaming
+  --mode chaos    kill a replica under load; records time back to the
+                  target healthy count + error rate during recovery
 
 The batch mode simulates ONE accelerator per deployment with a lock + sleep:
 forward passes serialize, so unbatched requests pay the full forward each
@@ -328,18 +330,112 @@ def run_batch_mode(args) -> dict:
     return fields
 
 
+def run_chaos_mode(args) -> dict:
+    """Chaos recovery anchors (ISSUE 3): kill one replica while clients
+    hammer the deployment; record the time from the kill until the
+    reconciler is back at the target healthy count, and the client-observed
+    error rate during that recovery window (the router drops the corpse on
+    the first death it observes, so most requests never notice)."""
+    import threading
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start(http_options={"port": 0})
+
+    n_replicas = args.chaos_replicas
+
+    @serve.deployment(num_replicas=n_replicas, health_check_period_s=0.25)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind(), name="bench_chaos", route_prefix=None)
+    handle.remote(0).result(timeout_s=60)  # warm
+    dep = "bench_chaos#Echo"
+    deadline = time.time() + 30
+    while time.time() < deadline and \
+            serve.status()[dep]["running_replicas"] < n_replicas:
+        time.sleep(0.05)
+    assert serve.status()[dep]["running_replicas"] >= n_replicas
+
+    stop = threading.Event()
+    recovering = threading.Event()
+    lock = threading.Lock()
+    window = {"ok": 0, "err": 0}
+
+    def client():
+        while not stop.is_set():
+            try:
+                ok = handle.remote(1).result(timeout_s=10) == 1
+            except Exception:  # noqa: BLE001
+                ok = False
+            if recovering.is_set():
+                with lock:
+                    window["ok" if ok else "err"] += 1
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(args.chaos_clients)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)  # steady state before the kill
+
+    from ray_tpu._private.runtime import get_runtime
+
+    runtime = get_runtime()
+    victims = [aid for aid, st in runtime._actors.items()
+               if "Replica" in st.spec.cls.__name__ and st.state == "ALIVE"]
+    assert victims
+    restarts_before = serve.status()[dep]["replica_restarts"]
+    recovering.set()
+    t_kill = time.perf_counter()
+    runtime.kill_actor(victims[0], no_restart=True)
+
+    recovery_s = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        st = serve.status()[dep]
+        if (st["running_replicas"] >= n_replicas
+                and st["replica_restarts"] > restarts_before):
+            recovery_s = time.perf_counter() - t_kill
+            break
+        time.sleep(0.02)
+    recovering.clear()
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert recovery_s is not None, f"never recovered: {serve.status()[dep]}"
+
+    total = window["ok"] + window["err"]
+    fields = {
+        "chaos_replicas": n_replicas,
+        "chaos_kill_to_target_healthy_s": round(recovery_s, 3),
+        "chaos_error_rate_during_recovery": round(
+            window["err"] / total, 4) if total else 0.0,
+        "chaos_requests_during_recovery": total,
+    }
+    serve.shutdown()
+    ray_tpu.shutdown()
+    return fields
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=("latency", "batch"),
+    ap.add_argument("--mode", choices=("latency", "batch", "chaos"),
                     default="latency")
     ap.add_argument("--requests", type=int, default=300)
     ap.add_argument("--stream-tokens", type=int, default=2000)
     ap.add_argument("--concurrent-streams", type=int, default=8)
+    ap.add_argument("--chaos-replicas", type=int, default=3)
+    ap.add_argument("--chaos-clients", type=int, default=4)
     ap.add_argument("--out", default="BENCH_SERVE.json")
     args = ap.parse_args()
 
-    fields = (run_batch_mode(args) if args.mode == "batch"
-              else run_latency_mode(args))
+    modes = {"latency": run_latency_mode, "batch": run_batch_mode,
+             "chaos": run_chaos_mode}
+    fields = modes[args.mode](args)
     artifact = _merge_artifact(args.out, fields)
     print(json.dumps(artifact))
 

@@ -16,7 +16,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 def build_app():
     from ray_tpu import serve
 
-    @serve.deployment
+    # Two replicas + fast health checks: the restore phase kills one and
+    # asserts the restored controller's reconciler replaces it.
+    @serve.deployment(num_replicas=2, health_check_period_s=0.2)
     class Echo:
         def __call__(self, request):
             return {"echo": "alive"}
@@ -87,6 +89,30 @@ def main() -> None:
     out = json.load(urllib.request.urlopen(f"{addr}/persist", timeout=30))
     assert out == {"echo": "alive"}, out
     print("SERVE-OK", flush=True)
+
+    # Restored controller x replica recovery: kill one of the restored
+    # app's replicas and assert the reconciler replaces it (back to the
+    # target healthy count) and requests keep working.
+    from ray_tpu._private.runtime import get_runtime
+
+    runtime = get_runtime()
+    replica_aids = [aid for aid, st in runtime._actors.items()
+                    if "Replica" in st.spec.cls.__name__
+                    and st.state == "ALIVE"]
+    assert len(replica_aids) >= 2, replica_aids
+    runtime.kill_actor(replica_aids[0], no_restart=True)
+    deadline = time.time() + 30
+    recovered = False
+    while time.time() < deadline:
+        st = serve.status().get("persist_app#Echo", {})
+        if st.get("running_replicas", 0) >= 2 and st.get("replica_restarts"):
+            recovered = True
+            break
+        time.sleep(0.1)
+    assert recovered, serve.status()
+    out = json.load(urllib.request.urlopen(f"{addr}/persist", timeout=30))
+    assert out == {"echo": "alive"}, out
+    print("SERVE-RECOVER-OK", flush=True)
 
     # Workflow resume: step1's checkpoint is reused (step2 now succeeds).
     @ray_tpu.remote

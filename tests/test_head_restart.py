@@ -61,5 +61,6 @@ def test_head_kill9_then_restore():
     restore = _run_phase("restore", session_dir, wait_ready=False)
     out, err = restore.communicate(timeout=240)
     assert restore.returncode == 0, f"restore failed:\n{out}\n{err}"
-    for marker in ("KV-OK", "SERVE-OK", "WORKFLOW-OK", "RESTORE-DONE"):
+    for marker in ("KV-OK", "SERVE-OK", "SERVE-RECOVER-OK", "WORKFLOW-OK",
+                   "RESTORE-DONE"):
         assert marker in out, f"missing {marker}:\n{out}\n{err}"

@@ -1,0 +1,245 @@
+"""Serve self-healing: health checks, graceful drain, rolling-update floor
+(ref test strategy: python/ray/serve/tests/test_healthcheck.py,
+test_graceful_shutdown — user-overridable check_health drives replacement;
+drain lets in-flight work finish; rolling updates keep an availability
+floor of target - max_unavailable)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start(http_options={"port": 0})
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_user_health_check_failure_triggers_replacement(serve_instance):
+    """A replica whose check_health starts raising is replaced by a fresh
+    one with zero manual intervention; the restart counter and the
+    unhealthy gauge record the event."""
+    from ray_tpu.serve.deployment_state import RESTARTS_COUNTER
+
+    @serve.deployment(num_replicas=1, health_check_period_s=0.1,
+                      health_check_timeout_s=2.0)
+    class Flaky:
+        def __init__(self):
+            self.broken = False
+
+        def break_health(self):
+            self.broken = True
+            return "broken"
+
+        def check_health(self):
+            if self.broken:
+                raise RuntimeError("user health check failing")
+
+        def __call__(self):
+            from ray_tpu.serve.context import get_internal_replica_context
+
+            return get_internal_replica_context().replica_id
+
+    handle = serve.run(Flaky.bind(), name="flaky", route_prefix=None)
+    first = handle.remote().result(timeout_s=10)
+    restarts_before = RESTARTS_COUNTER.get(tags={"deployment": "flaky#Flaky"})
+
+    assert handle.break_health.remote().result(timeout_s=10) == "broken"
+
+    # 3 consecutive failed probes at 0.1s → UNHEALTHY → drained → replaced.
+    deadline = time.time() + 20
+    second = first
+    while time.time() < deadline:
+        try:
+            second = handle.remote().result(timeout_s=10)
+            if second != first:
+                break
+        except Exception:
+            pass
+        time.sleep(0.1)
+    assert second != first, "unhealthy replica was never replaced"
+    assert RESTARTS_COUNTER.get(
+        tags={"deployment": "flaky#Flaky"}) > restarts_before
+    st = serve.status()["flaky#Flaky"]
+    assert st["replica_restarts"] >= 1
+
+
+def test_health_gauges_track_replica_states(serve_instance):
+    """serve_num_healthy_replicas reflects RUNNING replicas; the unhealthy
+    gauge spikes while a probe-failing replica drains."""
+    from ray_tpu.serve.deployment_state import HEALTHY_GAUGE, UNHEALTHY_GAUGE
+
+    @serve.deployment(num_replicas=2, health_check_period_s=0.1,
+                      graceful_shutdown_wait_loop_s=1.0)
+    class Pair:
+        def __init__(self):
+            self.broken = False
+
+        def break_health(self):
+            self.broken = True
+            return "broken"
+
+        def check_health(self):
+            if self.broken:
+                raise RuntimeError("failing")
+
+        def __call__(self):
+            return "ok"
+
+    handle = serve.run(Pair.bind(), name="pair", route_prefix=None)
+    dep = "pair#Pair"
+    deadline = time.time() + 10
+    while time.time() < deadline and HEALTHY_GAUGE.get(
+            tags={"deployment": dep}) < 2:
+        time.sleep(0.05)
+    assert HEALTHY_GAUGE.get(tags={"deployment": dep}) == 2
+
+    # Break ONE replica (pow-2 routing: call until one breaks; the broken
+    # one answers "broken" so one call is enough).
+    handle.break_health.remote().result(timeout_s=10)
+    saw_unhealthy = False
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if UNHEALTHY_GAUGE.get(tags={"deployment": dep}) >= 1:
+            saw_unhealthy = True
+            break
+        time.sleep(0.02)
+    assert saw_unhealthy, "unhealthy gauge never observed the failing replica"
+    # Self-heals back to 2 healthy.
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        st = serve.status()[dep]
+        if st["running_replicas"] == 2 and st["unhealthy_replicas"] == 0:
+            break
+        time.sleep(0.1)
+    st = serve.status()[dep]
+    assert st["running_replicas"] == 2 and st["status"] == "HEALTHY", st
+
+
+def test_graceful_drain_lets_inflight_finish(serve_instance):
+    """serve.delete drains: an in-flight unary call and an in-flight stream
+    both complete within graceful_shutdown_wait_loop_s instead of dying
+    with the replica."""
+
+    @serve.deployment(graceful_shutdown_wait_loop_s=5.0,
+                      graceful_shutdown_timeout_s=10.0)
+    class Slow:
+        def __call__(self, delay):
+            time.sleep(delay)
+            return "finished"
+
+        def stream(self, n):
+            for i in range(n):
+                time.sleep(0.15)
+                yield i
+
+    handle = serve.run(Slow.bind(), name="drain", route_prefix=None)
+    assert handle.remote(0).result(timeout_s=10) == "finished"
+
+    inflight = handle.remote(1.5)
+    gen = handle.options(method_name="stream", stream=True).remote(8)
+    time.sleep(0.3)  # both are mid-flight on the replica
+    serve.delete("drain")
+
+    assert inflight.result(timeout_s=30) == "finished"
+    assert [x for x in gen] == list(range(8))
+
+    deadline = time.time() + 15
+    while time.time() < deadline and "drain#Slow" in serve.status():
+        time.sleep(0.1)
+    assert "drain#Slow" not in serve.status()
+
+
+def test_hard_kill_after_graceful_timeout(serve_instance):
+    """A replica wedged past graceful_shutdown_timeout_s is hard-killed —
+    delete converges even when in-flight work never finishes."""
+
+    @serve.deployment(graceful_shutdown_wait_loop_s=0.2,
+                      graceful_shutdown_timeout_s=0.5)
+    class Wedged:
+        def __call__(self):
+            time.sleep(60)
+            return "never"
+
+    handle = serve.run(Wedged.bind(), name="wedged", route_prefix=None)
+    resp = handle.remote()  # pins _num_ongoing > 0 forever
+    time.sleep(0.2)
+    t0 = time.time()
+    serve.delete("wedged")
+    deadline = time.time() + 15
+    while time.time() < deadline and "wedged#Wedged" in serve.status():
+        time.sleep(0.05)
+    assert "wedged#Wedged" not in serve.status()
+    assert time.time() - t0 < 10, "hard-kill deadline was not enforced"
+    del resp
+
+
+def test_rolling_update_respects_availability_floor(serve_instance):
+    """During a rolling update with max_unavailable=1 the healthy count
+    never drops below target - 1, and old replicas only drain after a new
+    replica has passed its first health check."""
+
+    @serve.deployment(num_replicas=3, max_unavailable=1,
+                      health_check_period_s=0.1,
+                      user_config={"version": 1})
+    class Versioned:
+        def __init__(self):
+            self.version = None
+
+        def reconfigure(self, config):
+            # Slow startup widens the update window the floor must cover.
+            time.sleep(0.3)
+            self.version = config["version"]
+
+        def __call__(self):
+            return self.version
+
+    handle = serve.run(Versioned.bind(), name="floor", route_prefix=None)
+    assert handle.remote().result(timeout_s=10) == 1
+    dep = "floor#Versioned"
+
+    serve.run(Versioned.options(user_config={"version": 2}).bind(),
+              name="floor", route_prefix=None)
+
+    min_running = 99
+    deadline = time.time() + 40
+    converged = False
+    while time.time() < deadline:
+        st = serve.status()[dep]
+        min_running = min(min_running, st["running_replicas"])
+        vals = {handle.remote().result(timeout_s=10) for _ in range(6)}
+        if vals == {2}:
+            converged = True
+            break
+        time.sleep(0.05)
+    assert converged, f"rolling update never converged: {serve.status()}"
+    assert min_running >= 2, (
+        f"availability floor violated: running dropped to {min_running}")
+
+
+def test_health_check_config_knobs_via_options(serve_instance):
+    """The new per-deployment knobs round-trip through .options()."""
+
+    @serve.deployment
+    class Plain:
+        def __call__(self):
+            return "ok"
+
+    d = Plain.options(health_check_period_s=0.5, health_check_timeout_s=3.0,
+                      graceful_shutdown_wait_loop_s=1.5,
+                      graceful_shutdown_timeout_s=4.0, max_unavailable=2)
+    cfg = d.config
+    assert cfg.health_check_period_s == 0.5
+    assert cfg.health_check_timeout_s == 3.0
+    assert cfg.graceful_shutdown_wait_loop_s == 1.5
+    assert cfg.graceful_shutdown_timeout_s == 4.0
+    assert cfg.max_unavailable == 2
+
+    handle = serve.run(d.bind(), name="knobs", route_prefix=None)
+    assert handle.remote().result(timeout_s=10) == "ok"
