@@ -187,7 +187,22 @@ def runtime_summary(runtime) -> dict:
         "actors": _local_actor_states(runtime),
         "num_running_tasks": len(runtime._running),
         "num_inflight_tasks": len(runtime._inflight),
+        "serve_totals": _serve_totals(),
     }
+
+
+def _serve_totals() -> dict:
+    """Per-deployment request/error totals seen by this process's serve
+    routers — {} when serve was never imported (the import is the signal:
+    no serve module, no serve metrics)."""
+    import sys
+
+    if "ray_tpu.serve.metrics" not in sys.modules:
+        return {}
+    try:
+        return sys.modules["ray_tpu.serve.metrics"].process_totals()
+    except Exception:
+        return {}
 
 
 def runtime_snapshot(runtime) -> dict:
@@ -378,6 +393,11 @@ def _api_payload(runtime, path: str):
         return payload
     if path.startswith("/api/node/"):
         return node_detail(runtime, path[len("/api/node/"):])
+    if path == "/api/serve":
+        # Serve observability rollup (ref: dashboard serve head —
+        # modules/serve/serve_head.py): controller state joined with the
+        # routers' RED metric snapshots, one JSON document.
+        return _serve_payload()
     listings = {
         "/api/tasks": state_api.list_tasks,
         "/api/actors": state_api.list_actors,
@@ -408,6 +428,23 @@ def _api_payload(runtime, path: str):
                      entrypoint=j.entrypoint, log_path=j.log_path)
                 for j in mgr.list_jobs()]
     return None
+
+
+def _serve_payload() -> dict:
+    """Everything the serve dashboard view needs in one fetch: deployment
+    rows (status + p50/p95/p99 rollups), replica FSM rows, applications."""
+    from ray_tpu.util import state as state_api
+
+    deployments = state_api.list_deployments()
+    replicas = state_api.list_replicas()
+    apps = sorted({d["app"] for d in deployments})
+    return {
+        "applications": apps,
+        "num_deployments": len(deployments),
+        "num_replicas": len(replicas),
+        "deployments": deployments,
+        "replicas": replicas,
+    }
 
 
 def _status_page(runtime) -> str:

@@ -37,13 +37,52 @@ def _rt_driver_id(rt):
     return rt.job_id
 
 
-def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
+def spans_to_chrome_events(spans: List[dict]) -> List[dict]:
+    """Fold util.tracing spans into chrome-tracing "X" (complete) events.
+
+    Rows group by trace: ``pid`` is the trace id (Perfetto renders one
+    process lane per trace — a whole serve request reads top-to-bottom),
+    ``tid`` is the span's name so sibling spans of the same kind share a
+    track.  Unfinished spans (end=None) are skipped — an open span has no
+    duration yet."""
+    out: List[dict] = []
+    for s in spans:
+        if s.get("end") is None:
+            continue
+        args = {"span_id": s.get("span_id"),
+                "parent_id": s.get("parent_id"),
+                "status": s.get("status", "OK")}
+        args.update(s.get("attributes") or {})
+        ev = {
+            "ph": "X", "cat": "trace",
+            "name": s.get("name", ""),
+            "pid": f"trace:{s.get('trace_id', '')[:8]}",
+            "tid": s.get("name", ""),
+            "ts": s["start"] * 1e6,
+            "dur": max(0.0, (s["end"] - s["start"]) * 1e6),
+            "args": args,
+        }
+        if s.get("status", "OK") != "OK":
+            ev["cname"] = "terrible"
+        out.append(ev)
+    return out
+
+
+def chrome_trace(events: Optional[List[dict]] = None,
+                 include_spans: bool = True) -> List[dict]:
     """Fold the task-event log into chrome-tracing events.
 
     Execution spans: RUNNING→FINISHED/FAILED pairs per task attempt.
     Profile spans: PROFILE_BEGIN/PROFILE_END pairs.  Instant events for
-    submits/retries.
+    submits/retries.  When tracing is on (util.tracing), the exported
+    distributed-trace spans — serve request timelines included — fold in
+    as their own per-trace lanes (``include_spans=False`` to opt out).
     """
+    span_events: List[dict] = []
+    if include_spans:
+        from ray_tpu.util import tracing as _tracing
+
+        span_events = spans_to_chrome_events(_tracing.exported_spans())
     if events is None:
         from ray_tpu._private import runtime as _rt
 
@@ -84,6 +123,7 @@ def chrome_trace(events: Optional[List[dict]] = None) -> List[dict]:
                 "ph": "i", "cat": "sched", "name": f"{ev.get('name','')}:{state}",
                 "pid": ev.get("node_id", "node"), "tid": tid, "ts": us, "s": "t",
             })
+    out.extend(span_events)
     return out
 
 

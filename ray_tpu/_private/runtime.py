@@ -1038,7 +1038,7 @@ class Runtime:
     def submit_task(self, spec: TaskSpec) -> Any:
         if tracing.is_tracing_enabled():
             with tracing.span(f"submit::{spec.name}",
-                              attributes={"task_id": str(spec.task_id)}):
+                              attributes={"task_id": spec.task_id}):
                 tracing.inject_task_spec(spec)
                 return self._submit_task_inner(spec)
         return self._submit_task_inner(spec)
@@ -1808,11 +1808,12 @@ class Runtime:
     async def _execute_actor_task_async(self, state: _ActorState, spec: TaskSpec) -> None:
         self._emit_event(spec.task_id, spec.name, "RUNNING")
         try:
-            args, kwargs = self._resolve_args(spec)
-            method = getattr(state.instance, spec.method_name)
-            result = method(*args, **kwargs)
-            if inspect.isawaitable(result):
-                result = await result
+            with tracing.task_execute_span(spec):
+                args, kwargs = self._resolve_args(spec)
+                method = getattr(state.instance, spec.method_name)
+                result = method(*args, **kwargs)
+                if inspect.isawaitable(result):
+                    result = await result
             self._store_results(spec, result)
             self._emit_event(spec.task_id, spec.name, "FINISHED")
         except _ActorExit:
@@ -1824,8 +1825,8 @@ class Runtime:
     def submit_actor_task(self, actor_id: ActorID, spec: TaskSpec) -> Any:
         if tracing.is_tracing_enabled():
             with tracing.span(f"submit::{spec.name}",
-                              attributes={"task_id": str(spec.task_id),
-                                          "actor_id": str(actor_id)}):
+                              attributes={"task_id": spec.task_id,
+                                          "actor_id": actor_id}):
                 tracing.inject_task_spec(spec)
                 return self._submit_actor_task_inner(actor_id, spec)
         return self._submit_actor_task_inner(actor_id, spec)

@@ -8,7 +8,8 @@ requests from one event loop.
 """
 
 from ray_tpu.serve.api import (Application, Deployment, delete, deployment,
-                               get_app_handle, get_deployment_handle, run,
+                               get_app_handle, get_deployment_handle,
+                               list_deployments, list_replicas, run,
                                shutdown, start, status)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import (AutoscalingConfig, DeploymentConfig,
@@ -23,6 +24,7 @@ from ray_tpu.serve.proxy import Request
 __all__ = [
     "Application", "Deployment", "deployment", "run", "start", "shutdown",
     "delete", "status", "get_app_handle", "get_deployment_handle",
+    "list_deployments", "list_replicas",
     "AutoscalingConfig", "DeploymentConfig", "GRPCOptions", "HTTPOptions",
     "DeploymentHandle", "DeploymentResponse", "Request", "multiplexed",
     "get_multiplexed_model_id", "batch", "continuous_batch", "EOS",

@@ -260,8 +260,25 @@ def get_deployment_handle(deployment_name: str,
 
 
 def status() -> Dict[str, Any]:
+    """Per-deployment status INCLUDING the RED latency rollup: replica
+    counts/health plus requests/errors and p50/p95/p99/mean end-to-end
+    latency (ms) aggregated from every router's pushed snapshots."""
     controller = _get_controller()
     return ray_tpu.get(controller.get_deployment_status.remote())
+
+
+def list_deployments() -> list:
+    """Deployment observability rows (status + route + inflight + RED
+    rollups) — same data as /api/serve and
+    ray_tpu.util.state.list_deployments()."""
+    controller = _get_controller()
+    return ray_tpu.get(controller.list_deployments.remote())
+
+
+def list_replicas() -> list:
+    """Per-replica FSM rows (state, version, uptime, health counters)."""
+    controller = _get_controller()
+    return ray_tpu.get(controller.list_replicas.remote())
 
 
 def delete(name: str, _blocking: bool = True) -> None:

@@ -30,10 +30,12 @@ from __future__ import annotations
 import asyncio
 import functools
 import inspect
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.serve._sync import run_in_executor
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
 
 #: Batch sizes per vectorized call (pow-2 buckets up to a v5e-sized 128).
 BATCH_SIZE_HISTOGRAM = _metrics.Histogram(
@@ -78,7 +80,11 @@ class _BatchQueue:
 
     def submit(self, item: Any) -> asyncio.Future:
         fut = self._loop.create_future()
-        self._queue.put_nowait((item, fut))
+        # Entries carry their enqueue time + the request's trace context so
+        # the consumer can attribute queue wait vs. execute per request —
+        # the split Orca-style schedulers make essential.
+        self._queue.put_nowait(
+            (item, fut, time.time(), _tracing.active_span()))
         return fut
 
     # ------------------------------------------------------------ internals
@@ -88,7 +94,8 @@ class _BatchQueue:
 
     async def _consume_loop(self) -> None:
         while True:
-            batch: List[Tuple[Any, asyncio.Future]] = [await self._queue.get()]
+            batch: List[Tuple[Any, asyncio.Future, float, Optional[dict]]] \
+                = [await self._queue.get()]
             max_size = int(self._cfg["max_batch_size"])
             timeout = (self.effective_timeout_s if self._cfg["adaptive"]
                        else float(self._cfg["batch_wait_timeout_s"]))
@@ -104,9 +111,29 @@ class _BatchQueue:
                 except asyncio.TimeoutError:
                     break
             self._adapt(len(batch), max_size)
-            QUEUE_DEPTH_GAUGE.set(self._queue.qsize(), tags=self._tags)
-            BATCH_SIZE_HISTOGRAM.observe(len(batch), tags=self._tags)
+            self._record_batch_formed(batch)
             await self._invoke(batch)
+
+    def _record_batch_formed(
+            self, batch: List[Tuple[Any, asyncio.Future, float,
+                                    Optional[dict]]]) -> None:
+        """Queue-wait attribution at batch formation: per request, the time
+        from enqueue to now is queue wait (batch assembly included)."""
+        from ray_tpu.serve import metrics as serve_metrics
+
+        now = time.time()
+        QUEUE_DEPTH_GAUGE.set(self._queue.qsize(), tags=self._tags)
+        first_ctx = next((ctx for _, _, _, ctx in batch if ctx), None)
+        BATCH_SIZE_HISTOGRAM.observe(
+            len(batch), tags=self._tags,
+            exemplar=serve_metrics.trace_exemplar(first_ctx))
+        serve_metrics.QUEUE_WAIT.observe_batch(
+            [now - enq_t for _, _, enq_t, _ in batch], tags=self._tags,
+            exemplar=serve_metrics.trace_exemplar(first_ctx))
+        _tracing.record_span_batch(
+            "serve.queue_wait",
+            [(enq_t, now, ctx) for _, _, enq_t, ctx in batch],
+            attributes=dict(self._tags, batch_size=len(batch)))
 
     def _adapt(self, batch_len: int, max_size: int) -> None:
         if not self._cfg["adaptive"]:
@@ -124,10 +151,29 @@ class _BatchQueue:
             self.effective_timeout_s = min(
                 base, max(self.effective_timeout_s * 2.0, base / 32.0))
 
-    async def _invoke(self, batch: List[Tuple[Any, asyncio.Future]]) -> None:
-        items = [item for item, _ in batch]
-        futs = [fut for _, fut in batch]
+    def _record_executed(self, ctxs: List[Optional[dict]], exec_start: float,
+                         serve_metrics) -> None:
+        """Execution attribution: one histogram observation per vectorized
+        call, plus a per-request execute span in each request's trace."""
+        exec_end = time.time()
+        first_ctx = next((c for c in ctxs if c), None)
+        serve_metrics.EXECUTION.observe(
+            exec_end - exec_start, tags=self._tags,
+            exemplar=serve_metrics.trace_exemplar(first_ctx))
+        _tracing.record_span_batch(
+            "serve.batch_execute",
+            [(exec_start, exec_end, ctx) for ctx in ctxs],
+            attributes=dict(self._tags, batch_size=len(ctxs)))
+
+    async def _invoke(self, batch: List[Tuple[Any, asyncio.Future, float,
+                                              Optional[dict]]]) -> None:
+        from ray_tpu.serve import metrics as serve_metrics
+
+        items = [item for item, _, _, _ in batch]
+        futs = [fut for _, fut, _, _ in batch]
+        ctxs = [ctx for _, _, _, ctx in batch]
         args = (items,) if self._self_arg is None else (self._self_arg, items)
+        exec_start = time.time()
         try:
             if inspect.iscoroutinefunction(self._func):
                 results = await self._func(*args)
@@ -135,6 +181,7 @@ class _BatchQueue:
                 # Sync batch functions (the common JAX forward pass) run on
                 # a worker thread so the replica loop keeps serving.
                 results = await run_in_executor(self._func, *args)
+            self._record_executed(ctxs, exec_start, serve_metrics)
             if (not isinstance(results, (list, tuple))
                     or len(results) != len(items)):
                 got = (f"length {len(results)}"

@@ -34,10 +34,12 @@ from __future__ import annotations
 import asyncio
 import functools
 import inspect
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu.serve._sync import run_in_executor
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
 
 INFLIGHT_SEQUENCES_GAUGE = _metrics.Gauge(
     "serve_continuous_inflight_sequences",
@@ -73,7 +75,8 @@ class SequenceSlot:
     tokens-emitted counter, ...).  The engine never touches ``state``.
     """
 
-    __slots__ = ("request", "state", "_out", "_live", "_cancelled")
+    __slots__ = ("request", "state", "_out", "_live", "_cancelled",
+                 "_enq_t", "_trace_ctx", "_started")
 
     def __init__(self, request: Any):
         self.request = request
@@ -81,6 +84,11 @@ class SequenceSlot:
         self._out: asyncio.Queue = asyncio.Queue()
         self._live = True
         self._cancelled = False
+        #: admit-wait attribution: set at submit, consumed when the slot is
+        #: first stepped (queue-wait span + histogram).
+        self._enq_t = time.time()
+        self._trace_ctx = _tracing.current_context()
+        self._started = False
 
     def __repr__(self) -> str:
         return f"SequenceSlot({self.request!r}, live={self._live})"
@@ -111,6 +119,30 @@ class _Engine:
         slot = SequenceSlot(request)
         self._admit.put_nowait(slot)
         return slot
+
+    def _record_admitted(self, steppable: List[SequenceSlot]) -> None:
+        """Admit-wait per sequence: submit -> first step inclusion."""
+        from ray_tpu.serve import metrics as serve_metrics
+
+        now = time.time()
+        for slot in steppable:
+            if slot._started:
+                continue
+            slot._started = True
+            serve_metrics.QUEUE_WAIT.observe(
+                now - slot._enq_t, tags=self._tags,
+                exemplar=serve_metrics.trace_exemplar(slot._trace_ctx))
+            if slot._trace_ctx is not None:
+                _tracing.record_span("serve.queue_wait", slot._enq_t, now,
+                                     parent=slot._trace_ctx,
+                                     attributes=dict(self._tags))
+
+    def _record_step(self, step_start: float, batch_size: int) -> None:
+        from ray_tpu.serve import metrics as serve_metrics
+
+        serve_metrics.EXECUTION.observe(
+            time.time() - step_start, tags=self._tags,
+            exemplar=None)
 
     # ------------------------------------------------------------ the loop
     @staticmethod
@@ -143,8 +175,10 @@ class _Engine:
                 await asyncio.sleep(0.005)
                 continue
             # --- one shared forward pass for every steppable sequence
+            self._record_admitted(steppable)
             args = ((steppable,) if self._self_arg is None
                     else (self._self_arg, steppable))
+            step_start = time.time()
             try:
                 if inspect.iscoroutinefunction(self._step):
                     outs = await self._step(*args)
@@ -152,6 +186,7 @@ class _Engine:
                     # Sync steps (the jitted forward pass) run on a worker
                     # thread; the loop keeps admitting and serving pulls.
                     outs = await run_in_executor(self._step, *args)
+                self._record_step(step_start, len(steppable))
                 if not isinstance(outs, (list, tuple)) \
                         or len(outs) != len(steppable):
                     got = (f"length {len(outs)}"

@@ -30,6 +30,11 @@ class ServeController:
         #: dep_id -> router_id -> (total_inflight, ts); handle-reported
         #: (ref: autoscaling_state.py — queue metrics come from handles)
         self._handle_metrics: Dict[str, Dict[str, tuple]] = {}
+        #: dep_id -> pid -> (RED snapshot, ts).  Snapshots are CUMULATIVE
+        #: per process (routers in one process share the process-global
+        #: histograms), so rollups keep the latest per pid and sum across
+        #: pids — never across routers.
+        self._metric_snaps: Dict[str, Dict[int, tuple]] = {}
         self._loop_task: Optional[asyncio.Task] = None
         self._shutdown = False
 
@@ -214,11 +219,26 @@ class ServeController:
             await asyncio.sleep(CONTROL_LOOP_INTERVAL_S)
 
     def record_handle_metrics(self, deployment_id: str, router_id: str,
-                              total_inflight: int) -> None:
+                              total_inflight: int,
+                              snapshot: Optional[Dict[str, Any]] = None,
+                              pid: Optional[int] = None) -> None:
         """Handle-side queue report (ref: autoscaling_state.py
-        record_request_metrics_for_handle)."""
+        record_request_metrics_for_handle).  Routers additionally attach a
+        cumulative per-process RED snapshot for the status/dashboard
+        rollups; old-style reports without one still feed autoscaling."""
+        now = time.time()
         self._handle_metrics.setdefault(deployment_id, {})[router_id] = (
-            int(total_inflight), time.time())
+            int(total_inflight), now)
+        if snapshot is not None and pid is not None:
+            self._metric_snaps.setdefault(deployment_id, {})[int(pid)] = (
+                snapshot, now)
+
+    def _latency_rollup(self, deployment_id: str) -> Dict[str, Any]:
+        from ray_tpu.serve import metrics as serve_metrics
+
+        snaps = [snap for snap, _ in
+                 self._metric_snaps.get(deployment_id, {}).values()]
+        return serve_metrics.rollup(snaps)
 
     async def _autoscale_tick(self) -> None:
         """Queue-based autoscaling off handle-reported metrics (ref:
@@ -305,8 +325,44 @@ class ServeController:
                 "backoff_remaining_s": round(
                     max(0.0, state.backoff_until - now), 3),
                 "status": status,
+                # RED rollup from router-pushed snapshots (p50/p95/p99
+                # latency + request/error totals) — serve.status() answers
+                # "where did the latency go" without scraping /metrics.
+                **self._latency_rollup(dep_id),
             }
         return out
+
+    async def list_deployments(self) -> List[Dict[str, Any]]:
+        """Deployment rows joining controller state with live RED rollups
+        (ref: the reference's serve state API / dashboard deployments
+        view)."""
+        status = await self.get_deployment_status()
+        rows = []
+        for dep_id, st in sorted(status.items()):
+            state = self._manager.deployments.get(dep_id)
+            app, _, name = dep_id.partition("#")
+            inflight = sum(
+                n for n, ts in
+                self._handle_metrics.get(dep_id, {}).values()
+                if time.time() - ts < 2.0)
+            rows.append({
+                "deployment_id": dep_id, "app": app, "name": name,
+                "route_prefix": (state.info.route_prefix
+                                 if state is not None else None),
+                "num_replicas": (len(state.replicas)
+                                 if state is not None else 0),
+                "inflight_requests": inflight,
+                **st,
+            })
+        return rows
+
+    async def list_replicas(self) -> List[Dict[str, Any]]:
+        """Per-replica FSM rows (ref: serve state API replicas view)."""
+        await self._ensure_loop()
+        rows: List[Dict[str, Any]] = []
+        for state in self._manager.deployments.values():
+            rows.extend(state.replica_rows())
+        return rows
 
     async def graceful_shutdown(self) -> None:
         self._shutdown = True

@@ -433,6 +433,22 @@ class DeploymentState:
     def num_unhealthy(self) -> int:
         return sum(1 for r in self.replicas if r.unhealthy_reason is not None)
 
+    def replica_rows(self) -> List[Dict[str, Any]]:
+        """Observability rows for list_replicas() / /api/serve — FSM state
+        per replica, joined with controller-side health bookkeeping."""
+        now = time.time()
+        return [{
+            "replica_id": r.replica_id,
+            "deployment": self.info.name,
+            "app": self.info.app_name,
+            "deployment_id": self.info.id,
+            "state": r.state,
+            "version": r.version,
+            "uptime_s": round(now - r.started_at, 3),
+            "unhealthy_reason": r.unhealthy_reason,
+            "consecutive_health_failures": r.consecutive_failures,
+        } for r in self.replicas]
+
 
 class DeploymentStateManager:
     """(ref: deployment_state.py:2339 DeploymentStateManager)"""

@@ -8,13 +8,25 @@ the app's ingress deployment via a handle; here aiohttp replaces uvicorn.)
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 import threading
+import time
 from typing import Any, Dict, Optional
 
+from ray_tpu.serve import metrics as serve_metrics
 from ray_tpu.serve.config import HTTPOptions
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve.long_poll import LongPollClient
+from ray_tpu.util import tracing as _tracing
+
+
+async def _run_in_executor_ctx(loop, fn):
+    """Executor hop that KEEPS the caller's contextvars — a raw
+    ``loop.run_in_executor`` drops them, which would orphan the router's
+    route span from the proxy's root span."""
+    ctx = contextvars.copy_context()
+    return await loop.run_in_executor(None, lambda: ctx.run(fn))
 
 
 class Request:
@@ -129,6 +141,33 @@ class HTTPProxy:
                 text=f"No application at {request.path}. "
                      f"Routes: {http_routes}")
         prefix, target = match
+        # Root span of the request's trace: every downstream span (route,
+        # queue wait, execute) shares its trace_id (ref: the reference
+        # opens its proxy-level span the same way via tracing_helper).
+        serve_metrics.HTTP_INFLIGHT.set(
+            self._inflight_delta(prefix, +1), tags={"route": prefix})
+        try:
+            with _tracing.span("serve.http_request",
+                               attributes={"route": prefix,
+                                           "method": request.method,
+                                           "path": request.path,
+                                           "app": target["app_name"]}):
+                return await self._handle_matched(request, target)
+        finally:
+            serve_metrics.HTTP_INFLIGHT.set(
+                self._inflight_delta(prefix, -1), tags={"route": prefix})
+
+    def _inflight_delta(self, route: str, delta: int) -> int:
+        counts = getattr(self, "_inflight_counts", None)
+        if counts is None:
+            counts = self._inflight_counts = {}
+        n = max(0, counts.get(route, 0) + delta)
+        counts[route] = n
+        return n
+
+    async def _handle_matched(self, request, target):
+        from aiohttp import web
+
         app_name, ingress = target["app_name"], target["ingress"]
         handle = self._handles.get(app_name)
         if handle is None:
@@ -142,10 +181,10 @@ class HTTPProxy:
             # per yielded item — tokens reach the client as they are
             # produced (ref: proxy.py:532 streaming ASGI send).
             return await self._handle_streaming(request, handle, req)
+        loop = asyncio.get_running_loop()
         try:
-            response = handle.remote(req)
-            result = await asyncio.get_running_loop().run_in_executor(
-                None, lambda: response.result(timeout_s=60.0))
+            result = await _run_in_executor_ctx(
+                loop, lambda: handle.remote(req).result(timeout_s=60.0))
         except Exception as e:  # noqa: BLE001
             shed = self._as_backpressure(e)
             if shed is not None:
@@ -219,8 +258,8 @@ class HTTPProxy:
             # Stream assignment can block (replica-set wait during a
             # rolling update) — keep it off the event loop, like the
             # unary path's executor hop.
-            gen = await loop.run_in_executor(
-                None, lambda: handle.options(stream=True).remote(req))
+            gen = await _run_in_executor_ctx(
+                loop, lambda: handle.options(stream=True).remote(req))
         except Exception as e:  # noqa: BLE001
             shed = self._as_backpressure(e)
             if shed is not None:
@@ -234,6 +273,8 @@ class HTTPProxy:
                              else "application/octet-stream")
         resp.headers["Cache-Control"] = "no-cache"
         started = False
+        emit_start = None
+        num_items = 0
         try:
             while True:
                 try:
@@ -247,6 +288,8 @@ class HTTPProxy:
                 if not started:
                     await resp.prepare(request)
                     started = True
+                    emit_start = time.time()
+                num_items += 1
                 if isinstance(item, bytes):
                     chunk = item
                 elif isinstance(item, str):
@@ -272,6 +315,12 @@ class HTTPProxy:
             if not started:
                 return web.Response(status=500, text=f"Internal error: {e!r}")
             # Headers already sent: nothing to do but end the body early.
+        if emit_start is not None:
+            # One span covering the emission window (first chunk -> EOF),
+            # with the token count — the per-iteration timings live in the
+            # continuous-batching engine's execute spans.
+            _tracing.record_span("serve.stream_emit", emit_start, time.time(),
+                                 attributes={"items": num_items})
         if not started:
             await resp.prepare(request)  # empty stream: headers + EOF
         await resp.write_eof()

@@ -13,6 +13,7 @@ import time
 from typing import Any, Dict, Optional
 
 from ray_tpu.serve._sync import run_in_executor
+from ray_tpu.util import tracing as _tracing
 
 #: StopIteration cannot cross an executor future back into a coroutine
 #: (it would surface as RuntimeError), so sync-iterator pulls return this.
@@ -157,6 +158,9 @@ class ReplicaActor:
         self._user_config = user_config
         self._multiplexed_model_ids: list = []
         self._streams: Dict[str, Any] = {}
+        # Per-method (span attributes, metric tags) — invariant per method,
+        # cached so the request hot path allocates neither dict.
+        self._method_meta: Dict[str, tuple] = {}
 
     async def initialize_and_get_metadata(self) -> Dict[str, Any]:
         if self._user_config is not None:
@@ -169,14 +173,31 @@ class ReplicaActor:
 
         fault_injection.check("serve_replica_handle")
         self._num_ongoing += 1
+        t0 = time.time()
+        meta = self._method_meta.get(method_name)
+        if meta is None:
+            meta = self._method_meta[method_name] = (
+                {"deployment": self.deployment_name,
+                 "replica": self.replica_id, "method": method_name},
+                {"deployment": self.deployment_name, "method": method_name})
+        span_attrs, metric_tags = meta
         try:
             from ray_tpu.serve import context as serve_context
 
             serve_context._set_internal_replica_context(
                 deployment=self.deployment_name, replica_id=self.replica_id,
                 replica=self)
-            return await self._wrapper.call(method_name, args, kwargs)
+            # Nests under the runtime's task-execute span (which carries
+            # the submitter's trace context from the TaskSpec), so the
+            # replica-side work joins the request's trace.
+            with _tracing.span("serve.replica", attributes=span_attrs):
+                return await self._wrapper.call(method_name, args, kwargs)
         finally:
+            from ray_tpu.serve import metrics as serve_metrics
+
+            serve_metrics.EXECUTION.observe(
+                time.time() - t0, tags=metric_tags,
+                exemplar=serve_metrics.trace_exemplar())
             self._num_ongoing -= 1
             self._num_processed += 1
 
@@ -338,14 +359,27 @@ class SyncReplicaActor(ReplicaActor):
 
         fault_injection.check("serve_replica_handle")
         self._num_ongoing += 1
+        t0 = time.time()
         try:
             from ray_tpu.serve import context as serve_context
 
             serve_context._set_internal_replica_context(
                 deployment=self.deployment_name, replica_id=self.replica_id,
                 replica=self)
-            return asyncio.run(self._wrapper.call(method_name, args, kwargs))
+            with _tracing.span("serve.replica",
+                               attributes={"deployment": self.deployment_name,
+                                           "replica": self.replica_id,
+                                           "method": method_name}):
+                return asyncio.run(
+                    self._wrapper.call(method_name, args, kwargs))
         finally:
+            from ray_tpu.serve import metrics as serve_metrics
+
+            serve_metrics.EXECUTION.observe(
+                time.time() - t0,
+                tags={"deployment": self.deployment_name,
+                      "method": method_name},
+                exemplar=serve_metrics.trace_exemplar())
             self._num_ongoing -= 1
             self._num_processed += 1
 

@@ -10,13 +10,16 @@ replicas — a saturated replica couldn't answer the probe anyway.)
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from ray_tpu.serve import metrics as serve_metrics
 from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
 
 INFLIGHT_GAUGE = _metrics.Gauge(
     "serve_router_inflight",
@@ -117,6 +120,12 @@ class Router:
         self.router_id = uuid.uuid4().hex[:8]
         self._controller = controller_handle
         self._scheduler = PowerOfTwoChoicesReplicaScheduler()
+        # Per-request metric tags / span attributes are invariant per
+        # (deployment, method) — cache the dicts instead of rebuilding them
+        # on every assign (spans and observe() never mutate them).
+        self._metric_tags = {"deployment": deployment_id}
+        self._span_attrs: Dict[str, dict] = {}
+        self._stream_span_attrs: Dict[str, dict] = {}
         self._replicas_populated = threading.Event()
         #: Deployment-level queue allowance beyond capacity; -1 = unbounded
         #: (the reference's default).  Refreshed with the replica set.
@@ -153,8 +162,16 @@ class Router:
             INFLIGHT_GAUGE.set(inflight,
                                tags={"deployment": self.deployment_id})
             try:
+                # Cumulative RED snapshot rides along, keyed by pid: routers
+                # in one process share the process-global histograms, so the
+                # controller keeps the LATEST snapshot per (deployment, pid)
+                # and sums across pids — summing per-router would double
+                # count.
                 self._controller.record_handle_metrics.remote(
-                    self.deployment_id, self.router_id, inflight)
+                    self.deployment_id, self.router_id, inflight,
+                    snapshot=serve_metrics.deployment_snapshot(
+                        self.deployment_id),
+                    pid=os.getpid())
             except ActorDiedError:
                 self._stopped.set()  # controller gone: stop reporting
                 return
@@ -218,9 +235,19 @@ class Router:
     def assign_request(self, method_name: str, *args, **kwargs):
         """Pick a replica and dispatch; returns the ObjectRef."""
         self._check_capacity()
-        _, rid, ref = self._dispatch(
-            lambda r: r["actor"].handle_request.remote(
-                method_name, *args, **kwargs))
+        t0 = time.time()
+        # Route span: child of the caller's span (the proxy's root span or
+        # an enclosing handle call), parent of the replica-side execute
+        # span via the TaskSpec's trace context.
+        attrs = self._span_attrs.get(method_name)
+        if attrs is None:
+            attrs = self._span_attrs[method_name] = {
+                "deployment": self.deployment_id, "method": method_name}
+        with _tracing.span("serve.route", attributes=attrs):
+            trace_ctx = _tracing.active_span()
+            _, rid, ref = self._dispatch(
+                lambda r: r["actor"].handle_request.remote(
+                    method_name, *args, **kwargs))
         # Decrement the local queue estimate when the reply lands — and if
         # the reply is the replica's death, drop it from the local set
         # immediately so retries and later requests can't re-pick the
@@ -228,9 +255,17 @@ class Router:
         from ray_tpu._private import runtime as _rt
         from ray_tpu.exceptions import ActorDiedError
 
+        tags = self._metric_tags
+        exemplar = serve_metrics.trace_exemplar(trace_ctx)
+
         def _on_reply(f):
             self._scheduler.on_request_done(rid)
+            serve_metrics.REQUEST_LATENCY.observe(
+                time.time() - t0, tags=tags, exemplar=exemplar)
+            serve_metrics.REQUESTS_TOTAL.inc(tags=tags)
             exc = f.exception()
+            if exc is not None:
+                serve_metrics.ERRORS_TOTAL.inc(tags=tags)
             if isinstance(exc, ActorDiedError):
                 if not self._scheduler.drop_replica(rid):
                     self._replicas_populated.clear()
@@ -247,10 +282,28 @@ class Router:
         to the opening replica (a streaming response is served end-to-end
         by one replica)."""
         self._check_capacity()
-        replica, rid, sid_ref = self._dispatch(
-            lambda r: r["actor"].start_stream.remote(
-                method_name, *args, **kwargs))
-        done = lambda: self._scheduler.on_request_done(rid)
+        t0 = time.time()
+        attrs = self._stream_span_attrs.get(method_name)
+        if attrs is None:
+            attrs = self._stream_span_attrs[method_name] = {
+                "deployment": self.deployment_id, "method": method_name,
+                "stream": True}
+        with _tracing.span("serve.route", attributes=attrs):
+            trace_ctx = _tracing.active_span()
+            replica, rid, sid_ref = self._dispatch(
+                lambda r: r["actor"].start_stream.remote(
+                    method_name, *args, **kwargs))
+        tags = self._metric_tags
+        exemplar = serve_metrics.trace_exemplar(trace_ctx)
+
+        def done():
+            # For streams, "latency" is assign -> stream end (last pull,
+            # cancellation, or error) — the whole response window.
+            self._scheduler.on_request_done(rid)
+            serve_metrics.REQUEST_LATENCY.observe(
+                time.time() - t0, tags=tags, exemplar=exemplar)
+            serve_metrics.REQUESTS_TOTAL.inc(tags=tags)
+
         return replica["actor"], sid_ref, done
 
     def stop(self) -> None:

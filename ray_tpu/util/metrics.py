@@ -17,6 +17,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 TagMap = Dict[str, str]
 _key = Tuple[Tuple[str, str], ...]
+#: (labels, observed value, unix ts) attached to one histogram bucket —
+#: OpenMetrics exemplars (the reference attaches trace-id exemplars to its
+#: Prometheus histograms the same way).
+Exemplar = Tuple[TagMap, float, float]
 
 
 def _tag_key(tags: Optional[TagMap]) -> _key:
@@ -37,6 +41,7 @@ class Metric:
         self._tag_keys = tuple(tag_keys or ())
         self._default_tags: TagMap = {}
         self._lock = threading.Lock()
+        self._declared_at = _declaration_site()
         _REGISTRY.register(self)
 
     @property
@@ -146,9 +151,12 @@ class Histogram(Metric):
         self._counts: Dict[_key, List[int]] = {}
         self._sums: Dict[_key, float] = {}
         self._totals: Dict[_key, int] = {}
+        #: tag set -> bucket index -> last exemplar landing in that bucket
+        self._exemplars: Dict[_key, Dict[int, Exemplar]] = {}
         super().__init__(name, description, tag_keys)
 
-    def observe(self, value: float, tags: Optional[TagMap] = None) -> None:
+    def observe(self, value: float, tags: Optional[TagMap] = None,
+                exemplar: Optional[TagMap] = None) -> None:
         merged = self._check_tags(tags)
         k = _tag_key(merged)
         with self._lock:
@@ -159,6 +167,77 @@ class Histogram(Metric):
             counts[i] += 1
             self._sums[k] = self._sums.get(k, 0.0) + value
             self._totals[k] = self._totals.get(k, 0) + 1
+            if exemplar:
+                # Last exemplar per bucket (the Prometheus client keeps one
+                # per bucket the same way) — e.g. {"trace_id": ...} linking
+                # this observation back to its distributed trace.  Takes
+                # ownership of the dict (hot path: no defensive copy).
+                self._exemplars.setdefault(k, {})[i] = (
+                    exemplar, float(value), time.time())
+
+    def observe_batch(self, values: Sequence[float],
+                      tags: Optional[TagMap] = None,
+                      exemplar: Optional[TagMap] = None) -> None:
+        """Record many observations for ONE tag set under a single lock
+        round-trip — the serve batching layer records a whole micro-batch's
+        queue waits this way so per-item locking stays off the replica
+        event loop.  `exemplar` (if any) is attached to the first value's
+        bucket."""
+        if not values:
+            return
+        merged = self._check_tags(tags)
+        k = _tag_key(merged)
+        bounds = self.boundaries
+        nb = len(bounds)
+        with self._lock:
+            counts = self._counts.setdefault(k, [0] * (nb + 1))
+            total = 0.0
+            for value in values:
+                i = 0
+                while i < nb and value > bounds[i]:
+                    i += 1
+                counts[i] += 1
+                total += value
+            self._sums[k] = self._sums.get(k, 0.0) + total
+            self._totals[k] = self._totals.get(k, 0) + len(values)
+            if exemplar:
+                value = values[0]
+                i = 0
+                while i < nb and value > bounds[i]:
+                    i += 1
+                self._exemplars.setdefault(k, {})[i] = (
+                    exemplar, float(value), time.time())
+
+    def get(self, tags: Optional[TagMap] = None) -> dict:
+        """Snapshot for one tag set: count/sum/per-bucket counts — the
+        in-process view tests and the serve rollups read (Counter/Gauge
+        grew .get in PR 2; this is the Histogram counterpart)."""
+        k = _tag_key(self._check_tags(tags))
+        with self._lock:
+            counts = list(self._counts.get(k, ()))
+            return {
+                "boundaries": list(self.boundaries),
+                "counts": counts or [0] * (len(self.boundaries) + 1),
+                "count": self._totals.get(k, 0),
+                "sum": self._sums.get(k, 0.0),
+            }
+
+    def percentile(self, q: float, tags: Optional[TagMap] = None) -> float:
+        """Estimate the q-th percentile (q in [0, 100]) for a tag set from
+        the bucket counts; 0.0 if nothing was observed."""
+        snap = self.get(tags)
+        return percentile_from_buckets(snap["boundaries"], snap["counts"], q)
+
+    def exemplars(self) -> Dict[Tuple[_key, str], Exemplar]:
+        """{(tag set, le label) -> exemplar} for the scrape path."""
+        out: Dict[Tuple[_key, str], Exemplar] = {}
+        with self._lock:
+            for k, per_bucket in self._exemplars.items():
+                for i, ex in per_bucket.items():
+                    le = ("+Inf" if i >= len(self.boundaries)
+                          else repr(float(self.boundaries[i])))
+                    out[(k, le)] = ex
+        return out
 
     def samples(self):
         out = []
@@ -206,7 +285,13 @@ class MetricsRegistry:
             return [list(g) for g in self._metrics.values()]
 
     def prometheus_text(self) -> str:
-        """Prometheus text exposition format (what /metrics serves)."""
+        """Prometheus text exposition format (what /metrics serves).
+
+        Histogram ``_bucket`` lines carry OpenMetrics-style exemplars
+        (``# {trace_id="..."} value ts``) when observations recorded them —
+        the hook Grafana/Tempo use to jump from a latency bucket straight
+        to one exemplifying distributed trace.
+        """
         lines: List[str] = []
         for group in self.collect():
             lead = group[0]
@@ -220,15 +305,75 @@ class MetricsRegistry:
                         merged[k] = value
                     else:
                         merged[k] = merged.get(k, 0.0) + value
+            exemplars: Dict[Tuple[_key, str], Exemplar] = {}
+            for m in group:
+                if isinstance(m, Histogram):
+                    exemplars.update(m.exemplars())
             for (suffix, tag_items), value in merged.items():
                 if tag_items:
                     body = ",".join(
                         f'{k}="{_escape(v)}"' for k, v in tag_items)
-                    lines.append(
-                        f"{lead.name}{suffix}{{{body}}} {_fmt(value)}")
+                    line = f"{lead.name}{suffix}{{{body}}} {_fmt(value)}"
                 else:
-                    lines.append(f"{lead.name}{suffix} {_fmt(value)}")
+                    line = f"{lead.name}{suffix} {_fmt(value)}"
+                if suffix == "_bucket":
+                    tags = dict(tag_items)
+                    le = tags.pop("le", None)
+                    ex = exemplars.get((_tag_key(tags), le))
+                    if ex is not None:
+                        ex_labels, ex_value, ex_ts = ex
+                        ex_body = ",".join(
+                            f'{k}="{_escape(v)}"'
+                            for k, v in sorted(ex_labels.items()))
+                        line += (f" # {{{ex_body}}} {_fmt(ex_value)}"
+                                 f" {ex_ts:.3f}")
+                lines.append(line)
         return "\n".join(lines) + "\n"
+
+
+def percentile_from_buckets(boundaries: Sequence[float],
+                            counts: Sequence[int], q: float) -> float:
+    """Estimate the q-th percentile (q in [0, 100]) from per-bucket counts.
+
+    ``counts`` has one entry per boundary plus the overflow bucket, exactly
+    as Histogram records them.  Linear interpolation inside the target
+    bucket (the same estimate Prometheus's histogram_quantile makes); the
+    overflow bucket clamps to the top boundary — a bucketed histogram
+    cannot resolve beyond its largest bound.
+    """
+    if not 0 <= q <= 100:
+        raise ValueError(f"q must be in [0, 100], got {q}")
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    rank = (q / 100.0) * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        prev_cum = cum
+        cum += c
+        if cum >= rank and c > 0:
+            if i >= len(boundaries):  # overflow: clamp to the top bound
+                return float(boundaries[-1])
+            lo = boundaries[i - 1] if i > 0 else 0.0
+            hi = boundaries[i]
+            frac = (rank - prev_cum) / c
+            return float(lo + (hi - lo) * min(1.0, max(0.0, frac)))
+    return float(boundaries[-1])
+
+
+def _declaration_site() -> str:
+    """``file:line`` of the code declaring a metric (skipping this module)
+    — lets scripts/check_metrics.py tell internal declarations from user
+    ones sharing the process registry."""
+    import sys
+
+    f = sys._getframe(1)
+    here = __file__
+    while f is not None and f.f_code.co_filename == here:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    return f"{f.f_code.co_filename}:{f.f_lineno}"
 
 
 def _escape(v: str) -> str:

@@ -140,6 +140,44 @@ def list_nodes(filters: Optional[Sequence[Filter]] = None,
     return _apply_filters(rows, filters, limit)
 
 
+# -------------------------------------------------------------------- serve
+def _serve_controller():
+    """The detached serve controller, or None when serve never started."""
+    import ray_tpu
+
+    try:
+        return ray_tpu.get_actor("SERVE_CONTROLLER")
+    except Exception:
+        return None
+
+
+def list_deployments(filters: Optional[Sequence[Filter]] = None,
+                     limit: int = 10_000) -> List[dict]:
+    """Deployment rows (controller state + RED rollups) — the serve
+    counterpart of list_actors (ref: `ray list deployments` via the serve
+    state API).  Empty when serve is not running."""
+    import ray_tpu
+
+    controller = _serve_controller()
+    if controller is None:
+        return []
+    rows = ray_tpu.get(controller.list_deployments.remote(), timeout=30.0)
+    return _apply_filters(rows, filters, limit)
+
+
+def list_replicas(filters: Optional[Sequence[Filter]] = None,
+                  limit: int = 10_000) -> List[dict]:
+    """Per-replica FSM rows (state, version, uptime, health bookkeeping).
+    Empty when serve is not running."""
+    import ray_tpu
+
+    controller = _serve_controller()
+    if controller is None:
+        return []
+    rows = ray_tpu.get(controller.list_replicas.remote(), timeout=30.0)
+    return _apply_filters(rows, filters, limit)
+
+
 # --------------------------------------------------------- placement groups
 def list_placement_groups(filters: Optional[Sequence[Filter]] = None,
                           limit: int = 10_000) -> List[dict]:
@@ -161,4 +199,5 @@ __all__ = [
     "list_actors", "get_actor", "summarize_actors",
     "list_objects", "summarize_objects",
     "list_nodes", "list_placement_groups",
+    "list_deployments", "list_replicas",
 ]

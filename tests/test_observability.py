@@ -189,6 +189,102 @@ def test_tracing_spans_parented_across_submit(ray_init):
         tracing.disable_tracing()
 
 
+def test_tracing_spans_parented_across_actor_calls(ray_init):
+    """Trace context must ride actor handle calls exactly like plain task
+    submits: the execute span of a SYNC actor method AND of an ASYNC actor
+    method (the serve replica path) parents on the submit span."""
+    tracing.clear_spans()
+    tracing.enable_tracing()
+    try:
+        @ray_tpu.remote
+        class SyncActor:
+            def work(self):
+                return 1
+
+        @ray_tpu.remote
+        class AsyncActor:
+            async def work(self):
+                return 2
+
+        sa, aa = SyncActor.remote(), AsyncActor.remote()
+        with tracing.span("driver_root"):
+            r1 = sa.work.remote()
+            r2 = aa.work.remote()
+        assert ray_tpu.get(r1) == 1 and ray_tpu.get(r2) == 2
+        want = {"driver_root", "submit::SyncActor.work",
+                "task::SyncActor.work", "submit::AsyncActor.work",
+                "task::AsyncActor.work"}
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if want <= {s["name"] for s in tracing.exported_spans()}:
+                break
+            time.sleep(0.01)
+        spans = {s["name"]: s for s in tracing.exported_spans()}
+        assert want <= set(spans)
+        root = spans["driver_root"]
+        for cls in ("SyncActor", "AsyncActor"):
+            submit = spans[f"submit::{cls}.work"]
+            execute = spans[f"task::{cls}.work"]
+            assert submit["parent_id"] == root["span_id"]
+            assert execute["parent_id"] == submit["span_id"], cls
+            assert execute["trace_id"] == root["trace_id"], cls
+            assert execute["end"] is not None
+    finally:
+        tracing.disable_tracing()
+
+
+def test_tracing_record_span_retroactive():
+    """record_span exports an already-timed span (the batching queue-wait
+    path) with explicit parent/trace linkage."""
+    tracing.clear_spans()
+    tracing.enable_tracing()
+    try:
+        with tracing.span("outer") as outer:
+            ctx = tracing.current_context()
+        t0 = time.time() - 0.5
+        s = tracing.record_span("waited", t0, t0 + 0.25, parent=ctx,
+                                attributes={"k": "v"})
+        assert s["trace_id"] == outer["trace_id"]
+        assert s["parent_id"] == outer["span_id"]
+        assert abs((s["end"] - s["start"]) - 0.25) < 1e-6
+        assert any(x["name"] == "waited" for x in tracing.exported_spans())
+    finally:
+        tracing.disable_tracing()
+    assert tracing.record_span("off", 0.0, 1.0) is None
+
+
+def test_histogram_get_percentile_and_prometheus_sum():
+    """Histogram.get()/percentile() accessors + _sum in the scrape text
+    (Counter/Gauge grew .get in PR 2; Histogram was skipped)."""
+    h = um.Histogram("test_hist_accessors", "seconds", boundaries=(0.1, 1.0),
+                     tag_keys=("k",))
+    for v in (0.05, 0.5, 0.7, 5.0):
+        h.observe(v, tags={"k": "a"})
+    snap = h.get(tags={"k": "a"})
+    assert snap["count"] == 4
+    assert abs(snap["sum"] - 6.25) < 1e-9
+    assert snap["counts"] == [1, 2, 1]
+    assert 0.1 <= h.percentile(50, tags={"k": "a"}) <= 1.0
+    assert h.percentile(0, tags={"k": "a"}) == 0.0
+    # untouched tag set: zeros, not KeyError
+    assert h.get(tags={"k": "zz"})["count"] == 0
+    assert h.percentile(99, tags={"k": "zz"}) == 0.0
+    text = um.registry().prometheus_text()
+    assert 'test_hist_accessors_sum{k="a"} 6.25' in text
+    assert 'test_hist_accessors_count{k="a"} 4' in text
+
+
+def test_percentile_from_buckets_estimator():
+    # empty
+    assert um.percentile_from_buckets((1.0, 2.0), (0, 0, 0), 50) == 0.0
+    # all in first bucket: linear interpolation from 0
+    assert um.percentile_from_buckets((1.0, 2.0), (10, 0, 0), 50) == 0.5
+    # overflow clamps to the top boundary
+    assert um.percentile_from_buckets((1.0, 2.0), (0, 0, 5), 99) == 2.0
+    with pytest.raises(ValueError):
+        um.percentile_from_buckets((1.0,), (1, 0), 101)
+
+
 def test_tracing_disabled_is_noop(ray_init):
     tracing.clear_spans()
     with tracing.span("nothing") as s:
