@@ -20,6 +20,13 @@ Serve data/control-plane points (exercised by tests/test_serve_chaos.py):
     serve_health_probe   replica check_health (drives UNHEALTHY recovery)
     serve_long_poll      controller listen_for_change (client must retry)
 
+Checkpoint subsystem points (exercised by tests/test_checkpoint_chaos.py):
+    ckpt_shard_write     shard persist (writer background thread) — kills a
+                         save mid-flight; the pending step aborts
+    ckpt_commit          coordinator commit phase, before the atomic rename
+                         — the step stays uncommitted, restore skips it
+    ckpt_restore         restore entry (restore_pytree) — retryable
+
 Deterministic across runs for a fixed RAY_TPU_TESTING_CHAOS_SEED.
 """
 

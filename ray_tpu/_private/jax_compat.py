@@ -63,6 +63,22 @@ def set_mesh(mesh):
     return mesh
 
 
+def reshard(x, mesh, spec):
+    """Host array -> device array sharded over ``mesh`` by ``spec`` — the
+    shard round-trip the checkpoint subsystem's elastic restore uses.
+    jax.device_put with an explicit NamedSharding is the one placement
+    spelling stable across every jax this repo supports; NamedSharding
+    itself moved modules over time, so resolve it defensively."""
+    try:
+        from jax.sharding import NamedSharding
+    except ImportError:  # ancient spelling
+        from jax.experimental.sharding import NamedSharding  # type: ignore
+
+    import numpy as np
+
+    return jax.device_put(np.asarray(x), NamedSharding(mesh, spec))
+
+
 def axis_size(axis_name):
     """lax.axis_size is recent; psum of a constant 1 folds to a static int
     under every version's shard_map/pmap."""

@@ -1,0 +1,104 @@
+"""ray_tpu.checkpoint — asynchronous distributed checkpointing.
+
+The subsystem (see docs/checkpointing.md):
+
+* ``ShardWriter.save_async``   — device->host snapshot on the step
+  boundary, persist + commit on a background thread (Check-N-Run).
+* ``CheckpointCoordinator``    — sharded two-phase commit: every shard
+  lands under ``checkpoint_NNNNNN.tmp/`` with its manifest, then one
+  atomic rename + ``COMMIT`` marker makes the step visible.
+* in-memory replica tier       — last-k step snapshots pinned in the
+  object store and mirrored to a peer node (Gemini) for fast recovery.
+* ``restore_pytree`` / ``reshard_tree`` — restore from any committed
+  step, elastically resharding onto a different mesh/world size.
+
+Chaos fault points: ``ckpt_shard_write``, ``ckpt_commit``,
+``ckpt_restore`` (ray_tpu._private.fault_injection).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.checkpoint import layout
+from ray_tpu.checkpoint import metrics as ckpt_metrics
+from ray_tpu.checkpoint.coordinator import CheckpointCoordinator
+from ray_tpu.checkpoint.elastic import reshard_tree
+from ray_tpu.checkpoint.layout import (
+    is_committed_dir,
+    latest_committed_step,
+    list_committed_steps,
+)
+from ray_tpu.checkpoint.replica import ReplicaHolder
+from ray_tpu.checkpoint.writer import SaveHandle, ShardWriter, snapshot_to_host
+
+__all__ = [
+    "CheckpointCoordinator", "ReplicaHolder", "SaveHandle", "ShardWriter",
+    "is_committed_dir", "latest_committed_step", "list_committed_steps",
+    "materialize_from_payloads", "reshard_tree", "restore_latest",
+    "restore_pytree", "snapshot_to_host",
+]
+
+
+def restore_pytree(path: str, template: Optional[Any] = None, *,
+                   mesh=None, pspec=None, pspec_fn=None,
+                   _source: str = "disk") -> Any:
+    """Restore the full pytree from one *committed* checkpoint directory.
+
+    With ``mesh`` (and optionally ``pspec``/``pspec_fn``) the leaves are
+    device_put with shardings for that mesh — the elastic-restore path; a
+    mesh of any shape/world size works because the host assembly already
+    reconciled the writer's sharding.  Without a mesh the leaves stay
+    host numpy arrays.  ``template`` only validates structure.
+    """
+    from ray_tpu._private import fault_injection
+    from ray_tpu.util import tracing
+
+    t0 = time.monotonic()
+    with tracing.span("checkpoint.restore",
+                      attributes={"path": path, "source": _source}):
+        fault_injection.check("ckpt_restore")
+        if not layout.is_committed_dir(path):
+            raise ValueError(
+                f"{path} is not a committed checkpoint (missing COMMIT "
+                "marker or non-final name) — refusing to restore a "
+                "potentially torn directory")
+        tree = layout.assemble_tree(path)
+        if template is not None:
+            _check_template(tree, template)
+        if mesh is not None:
+            tree = reshard_tree(tree, mesh, pspec=pspec, pspec_fn=pspec_fn)
+    ckpt_metrics.RESTORES.inc(tags={"source": _source})
+    ckpt_metrics.RESTORE_SECONDS.observe(time.monotonic() - t0,
+                                         tags={"source": _source})
+    return tree
+
+
+def restore_latest(root: str, template: Optional[Any] = None, *,
+                   mesh=None, pspec=None, pspec_fn=None) -> Optional[Any]:
+    """Restore from the latest committed step under ``root`` (e.g. a serve
+    deployment loading model weights); None when nothing is committed."""
+    step = layout.latest_committed_step(root)
+    if step is None:
+        return None
+    return restore_pytree(layout.final_dir(root, step), template,
+                          mesh=mesh, pspec=pspec, pspec_fn=pspec_fn)
+
+
+def materialize_from_payloads(root: str, step: int,
+                              payloads: Dict[int, dict]) -> str:
+    """Write a committed checkpoint dir from in-memory replica payloads
+    (fast restore without touching the original storage); returns the
+    committed path."""
+    return layout.write_committed_from_payloads(root, step, payloads)
+
+
+def _check_template(tree: Any, template: Any) -> None:
+    import jax
+
+    got = jax.tree.structure(tree)
+    want = jax.tree.structure(template)
+    if got != want:
+        raise ValueError(
+            f"restored pytree structure {got} does not match template {want}")

@@ -1,0 +1,415 @@
+"""On-disk layout + shard format for the distributed checkpoint subsystem.
+
+Layout of one checkpoint step under a storage root::
+
+    root/
+      checkpoint_000042.tmp/            # phase 1: shards write here
+        shard_00000/
+          leaves.npz                    # this shard's leaf slices
+          skeleton.json | skeleton.pkl  # tree structure (shard 0 only)
+          MANIFEST.json                 # per-shard manifest
+        shard_00001/ ...
+      checkpoint_000042/                # phase 2: atomic rename = commit
+        ... same files ...
+        MANIFEST.json                   # global manifest (coordinator)
+        COMMIT                          # commit marker (written pre-rename)
+
+The *commit point* is the directory rename: the coordinator writes the
+global manifest and the ``COMMIT`` marker inside the ``.tmp`` directory,
+fsyncs, then ``os.replace``s it to the final name.  A reader therefore
+never sees a partially written checkpoint under a committed name, and a
+crash at any point leaves either the previous committed step intact or a
+``.tmp`` directory that restore ignores.  ``is_committed_dir`` requires
+BOTH the final name and the marker, so a torn directory produced by any
+other writer is never selected either.
+
+Leaf partitioning is deterministic from (tree, world_size): leaves whose
+axis-0 extent divides evenly across the world are split along axis 0, one
+slice per shard; everything else is "replicated" and written by shard 0
+only.  The skeleton records the choice, so restore reassembles full host
+arrays from any number of shard files — which is what makes restore
+*elastic*: the new job's mesh/world size never has to match the writer's
+(see elastic.py for the device placement half).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import pickle
+import re
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+COMMIT_MARKER = "COMMIT"
+GLOBAL_MANIFEST = "MANIFEST.json"
+SHARD_MANIFEST = "MANIFEST.json"
+TMP_SUFFIX = ".tmp"
+
+_STEP_RE = re.compile(r"^checkpoint_(\d{6,})$")
+
+
+def step_dirname(step: int) -> str:
+    return f"checkpoint_{step:06d}"
+
+
+def shard_dirname(shard_id: int) -> str:
+    return f"shard_{shard_id:05d}"
+
+
+def tmp_dir(root: str, step: int) -> str:
+    return os.path.join(root, step_dirname(step) + TMP_SUFFIX)
+
+
+def final_dir(root: str, step: int) -> str:
+    return os.path.join(root, step_dirname(step))
+
+
+def parse_step(dirname: str) -> Optional[int]:
+    m = _STEP_RE.match(dirname)
+    return int(m.group(1)) if m else None
+
+
+def is_committed_dir(path: str) -> bool:
+    """Committed = final (non-.tmp) name AND the COMMIT marker exists."""
+    name = os.path.basename(os.path.normpath(path))
+    if parse_step(name) is None:
+        return False
+    return os.path.exists(os.path.join(path, COMMIT_MARKER))
+
+
+def list_committed_steps(root: str) -> List[int]:
+    if not os.path.isdir(root):
+        return []
+    steps = []
+    for name in os.listdir(root):
+        step = parse_step(name)
+        if step is None:
+            continue
+        if os.path.exists(os.path.join(root, name, COMMIT_MARKER)):
+            steps.append(step)
+    return sorted(steps)
+
+
+def latest_committed_step(root: str) -> Optional[int]:
+    steps = list_committed_steps(root)
+    return steps[-1] if steps else None
+
+
+def list_stale_tmp_dirs(root: str) -> List[str]:
+    """Leftover ``checkpoint_*.tmp`` dirs (crashed/aborted saves)."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        if name.endswith(TMP_SUFFIX) and parse_step(name[: -len(TMP_SUFFIX)]) is not None:
+            out.append(os.path.join(root, name))
+    return sorted(out)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a directory entry so a rename survives power loss (best
+    effort — some filesystems refuse O_RDONLY dir fds)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_json(path: str, obj: Any) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+# --------------------------------------------------------------- skeleton
+
+def _is_leaf(node: Any) -> bool:
+    return not isinstance(node, (dict, list, tuple))
+
+
+def _encode_json(node: Any, leaves: List[Any]):
+    """JSON skeleton for plain containers; raises TypeError on anything
+    fancier (namedtuples, dataclasses, custom pytree nodes) so the caller
+    falls back to the pickle skeleton."""
+    if isinstance(node, dict):
+        if type(node) is not dict or not all(isinstance(k, str) for k in node):
+            raise TypeError("non-plain dict")
+        return {"t": "d", "k": list(node.keys()),
+                "v": [_encode_json(v, leaves) for v in node.values()]}
+    if type(node) is list:
+        return {"t": "l", "v": [_encode_json(v, leaves) for v in node]}
+    if type(node) is tuple:
+        return {"t": "t", "v": [_encode_json(v, leaves) for v in node]}
+    if isinstance(node, (dict, list, tuple)):
+        # Container *subclass* (namedtuple, OrderedDict, flax FrozenDict
+        # lookalikes): not a leaf — force the pickled-treedef path.
+        raise TypeError("container subclass")
+    i = len(leaves)
+    leaves.append(node)
+    return {"t": "x", "i": i}
+
+
+def _decode_json(node: dict, leaves: List[Any]):
+    t = node["t"]
+    if t == "d":
+        return {k: _decode_json(v, leaves) for k, v in zip(node["k"], node["v"])}
+    if t == "l":
+        return [_decode_json(v, leaves) for v in node["v"]]
+    if t == "t":
+        return tuple(_decode_json(v, leaves) for v in node["v"])
+    return leaves[node["i"]]
+
+
+class _LeafMarker:
+    """Placeholder leaf inside the pickle-fallback skeleton."""
+
+    __slots__ = ("i",)
+
+    def __init__(self, i: int):
+        self.i = i
+
+
+def flatten_tree(tree: Any) -> Tuple[Any, List[Any], str]:
+    """-> (skeleton_obj, leaves, kind) where kind is 'json' or 'pkl'.
+
+    The json path covers plain dict/list/tuple pytrees; everything else
+    (flax structs, namedtuples, optax states) goes through jax's registry
+    with a pickled treedef."""
+    leaves: List[Any] = []
+    try:
+        skeleton = _encode_json(tree, leaves)
+        return skeleton, leaves, "json"
+    except TypeError:
+        pass
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    return pickle.dumps(treedef), leaves, "pkl"
+
+
+def unflatten_tree(skeleton: Any, kind: str, leaves: List[Any]) -> Any:
+    if kind == "json":
+        return _decode_json(skeleton, leaves)
+    import jax
+
+    return jax.tree.unflatten(pickle.loads(skeleton), leaves)
+
+
+# ----------------------------------------------------------- partitioning
+
+def partition_for(shape: Tuple[int, ...], world_size: int) -> Dict[str, Any]:
+    """Deterministic leaf partition: split axis 0 across shards when it
+    divides evenly, else replicate (shard 0 owns the write)."""
+    if world_size > 1 and len(shape) >= 1 and shape[0] >= world_size \
+            and shape[0] % world_size == 0:
+        return {"kind": "sharded", "axis": 0, "count": world_size}
+    return {"kind": "replicated", "owner": 0}
+
+
+def build_shard(host_tree: Any, shard_id: int, world_size: int):
+    """Split a *host* pytree into this shard's piece.
+
+    Returns (skeleton_doc, arrays) where arrays maps ``leaf_<i>`` to the
+    numpy slice this shard owns (possibly empty for replicated leaves on
+    shard_id > 0), and skeleton_doc fully describes the tree + global leaf
+    metadata (identical on every shard — only shard 0 writes it).
+    """
+    skeleton, leaves, kind = flatten_tree(host_tree)
+    leaf_meta = []
+    arrays: Dict[str, np.ndarray] = {}
+    for i, leaf in enumerate(leaves):
+        a = np.asarray(leaf)
+        part = partition_for(a.shape, world_size)
+        leaf_meta.append({"dtype": str(a.dtype), "shape": list(a.shape),
+                          "partition": part})
+        if part["kind"] == "sharded":
+            rows = a.shape[0] // part["count"]
+            arrays[f"leaf_{i}"] = a[shard_id * rows:(shard_id + 1) * rows]
+        elif shard_id == part["owner"]:
+            arrays[f"leaf_{i}"] = a
+    doc = {"format": 1, "world_size": world_size, "kind": kind,
+           "num_leaves": len(leaves), "leaves": leaf_meta}
+    if kind == "json":
+        doc["skeleton"] = skeleton
+    return doc, skeleton, kind, arrays
+
+
+def write_shard(step_dir: str, shard_id: int, doc: dict, skeleton: Any,
+                kind: str, arrays: Dict[str, np.ndarray], step: int,
+                extra_manifest: Optional[dict] = None) -> dict:
+    """Write one shard's files under ``step_dir`` and return its manifest."""
+    sdir = os.path.join(step_dir, shard_dirname(shard_id))
+    os.makedirs(sdir, exist_ok=True)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    blob = buf.getvalue()
+    npz_path = os.path.join(sdir, "leaves.npz")
+    with open(npz_path + ".tmp", "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(npz_path + ".tmp", npz_path)
+    total = len(blob)
+    if shard_id == 0:
+        if kind == "json":
+            atomic_write_json(os.path.join(sdir, "skeleton.json"), doc)
+        else:
+            pkl_doc = dict(doc)
+            with open(os.path.join(sdir, "skeleton.pkl"), "wb") as f:
+                pickle.dump({"doc": pkl_doc, "treedef": skeleton}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            total += os.path.getsize(os.path.join(sdir, "skeleton.pkl"))
+    manifest = {
+        "step": step,
+        "shard_id": shard_id,
+        "world_size": doc["world_size"],
+        "arrays": sorted(arrays.keys()),
+        "bytes": total,
+        "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+    }
+    if extra_manifest:
+        manifest.update(extra_manifest)
+    atomic_write_json(os.path.join(sdir, SHARD_MANIFEST), manifest)
+    return manifest
+
+
+def commit_step_dir(root: str, step: int, shard_manifests: Dict[int, dict],
+                    extra: Optional[dict] = None) -> str:
+    """Phase 2: global manifest + COMMIT marker inside the tmp dir, fsync,
+    then the atomic rename that IS the commit point.  Returns the final
+    committed path."""
+    import time as _time
+
+    tmp = tmp_dir(root, step)
+    final = final_dir(root, step)
+    manifest = {
+        "step": step,
+        "num_shards": len(shard_manifests),
+        "shards": {str(sid): m for sid, m in sorted(shard_manifests.items())},
+        "total_bytes": sum(m.get("bytes", 0) for m in shard_manifests.values()),
+        "time": _time.time(),
+    }
+    if extra:
+        manifest.update(extra)
+    atomic_write_json(os.path.join(tmp, GLOBAL_MANIFEST), manifest)
+    atomic_write_json(os.path.join(tmp, COMMIT_MARKER), {
+        "step": step, "num_shards": len(shard_manifests),
+        "time": manifest["time"]})
+    fsync_dir(tmp)
+    if os.path.isdir(final):
+        # A same-step committed dir already exists (re-commit after a
+        # partial retention race); replace it via a sibling swap.
+        import shutil
+
+        trash = final + ".old"
+        shutil.rmtree(trash, ignore_errors=True)
+        os.replace(final, trash)
+        os.replace(tmp, final)
+        shutil.rmtree(trash, ignore_errors=True)
+    else:
+        os.replace(tmp, final)
+    fsync_dir(root)
+    return final
+
+
+def write_committed_from_payloads(root: str, step: int,
+                                  payloads: Dict[int, dict]) -> str:
+    """Materialize a committed checkpoint dir from in-memory replica
+    payloads (the Gemini-style fast restore path: peers hand back their
+    shard payloads and we rebuild a committed step locally without
+    touching the original storage)."""
+    import shutil
+
+    tmp = tmp_dir(root, step)
+    shutil.rmtree(tmp, ignore_errors=True)
+    os.makedirs(tmp, exist_ok=True)
+    manifests = {}
+    for sid, p in payloads.items():
+        manifests[sid] = write_shard(tmp, sid, p["doc"], p["skeleton"],
+                                     p["kind"], p["arrays"], step)
+    return commit_step_dir(root, step, manifests, extra={"source": "replica"})
+
+
+def assemble_from_payloads(payloads: Dict[int, dict]) -> Any:
+    """Reassemble the full host pytree purely from in-memory replica
+    payloads — no disk involved."""
+    p0 = payloads[0]
+    doc, skeleton, kind = p0["doc"], p0["skeleton"], p0["kind"]
+    leaves: List[Any] = []
+    for i, meta in enumerate(doc["leaves"]):
+        key = f"leaf_{i}"
+        part = meta["partition"]
+        if part["kind"] == "sharded":
+            pieces = [np.asarray(payloads[s]["arrays"][key])
+                      for s in range(part["count"])]
+            leaves.append(np.concatenate(pieces, axis=part["axis"]))
+        else:
+            leaves.append(np.asarray(payloads[part.get("owner", 0)]["arrays"][key]))
+    return unflatten_tree(skeleton, kind, leaves)
+
+
+def read_skeleton(step_dir: str) -> Tuple[dict, Any, str]:
+    """-> (doc, skeleton, kind) from shard 0."""
+    sdir = os.path.join(step_dir, shard_dirname(0))
+    jpath = os.path.join(sdir, "skeleton.json")
+    if os.path.exists(jpath):
+        with open(jpath) as f:
+            doc = json.load(f)
+        return doc, doc["skeleton"], "json"
+    with open(os.path.join(sdir, "skeleton.pkl"), "rb") as f:
+        payload = pickle.load(f)
+    return payload["doc"], payload["treedef"], "pkl"
+
+
+def assemble_tree(step_dir: str,
+                  shard_arrays: Optional[Dict[int, Dict[str, np.ndarray]]] = None) -> Any:
+    """Reassemble the full host pytree from a checkpoint step directory.
+
+    ``shard_arrays`` (shard_id -> {leaf_i: array}) lets the in-memory
+    replica tier bypass disk: any shard present there is used as-is and
+    its files are never opened.
+    """
+    doc, skeleton, kind = read_skeleton(step_dir)
+    shard_arrays = shard_arrays or {}
+
+    opened: Dict[int, Any] = {}
+
+    def shard_data(sid: int):
+        if sid in shard_arrays:
+            return shard_arrays[sid]
+        if sid not in opened:
+            opened[sid] = np.load(
+                os.path.join(step_dir, shard_dirname(sid), "leaves.npz"))
+        return opened[sid]
+
+    leaves: List[Any] = []
+    for i, meta in enumerate(doc["leaves"]):
+        key = f"leaf_{i}"
+        part = meta["partition"]
+        if part["kind"] == "sharded":
+            pieces = [np.asarray(shard_data(s)[key]) for s in range(part["count"])]
+            leaves.append(np.concatenate(pieces, axis=part["axis"]))
+        else:
+            leaves.append(np.asarray(shard_data(part.get("owner", 0))[key]))
+    try:
+        return unflatten_tree(skeleton, kind, leaves)
+    finally:
+        for z in opened.values():
+            try:
+                z.close()
+            except Exception:
+                pass

@@ -1,0 +1,72 @@
+"""In-memory peer replica tier (Gemini, SOSP '23 §4).
+
+The coordinator already pins each shard snapshot in the object store; the
+piece that survives a *node* failure is the ``ReplicaHolder`` — an actor
+scheduled onto a different node than the writers that materializes its
+own copy of every registered shard payload.  Recovery then reads from
+whichever tier is still alive instead of walking back to (slow, possibly
+remote) checkpoint storage.
+
+On a single-node cluster there is no peer to place the holder on;
+``start_peer_holder`` returns None and the tier degrades to the object
+store copy alone — still enough for worker-death (not node-death)
+recovery, which is what the single-host tests exercise.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class ReplicaHolder:
+    """Holds materialized shard payloads: (step, shard_id) -> payload."""
+
+    def __init__(self):
+        self._shards: Dict[tuple, dict] = {}
+
+    def hold(self, step: int, shard_id: int, wrapped_ref: dict) -> None:
+        import ray_tpu
+
+        # Materialize NOW: the point is a copy that outlives the writer's
+        # node, not another pointer into its object store.
+        self._shards[(step, shard_id)] = ray_tpu.get(wrapped_ref["ref"])
+
+    def trim(self, keep_steps: List[int]) -> None:
+        keep = set(keep_steps)
+        for key in [k for k in self._shards if k[0] not in keep]:
+            del self._shards[key]
+
+    def fetch(self, step: int) -> Dict[int, dict]:
+        """All held shard payloads for a step (possibly partial)."""
+        return {sid: p for (s, sid), p in self._shards.items() if s == step}
+
+    def held(self) -> List[tuple]:
+        return sorted(self._shards)
+
+
+def _pick_peer_node() -> Optional[str]:
+    """A live node other than this one (head, where the coordinator runs
+    by default); None on single-node clusters."""
+    from ray_tpu._private.runtime import get_runtime
+
+    runtime = get_runtime()
+    head = str(runtime.head_node_id)
+    for n in runtime.scheduler.nodes():
+        if n.alive and str(n.id) != head:
+            return str(n.id)
+    return None
+
+
+def start_peer_holder():
+    """Start a ReplicaHolder on a peer node, or return None when the
+    cluster has nowhere else to put it."""
+    import ray_tpu
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    node_id = _pick_peer_node()
+    if node_id is None:
+        return None
+    return (ray_tpu.remote(ReplicaHolder)
+            .options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id, soft=True))
+            .remote())

@@ -1,0 +1,176 @@
+"""Per-worker shard writer: async device->host snapshot + background persist.
+
+Check-N-Run's decomposition (NSDI '22): the only work on the training
+step's critical path is the device->host snapshot (a copy); serializing,
+writing to storage, registering the in-memory replica and the two-phase
+commit all happen on a dedicated background thread.  ``save_async``
+returns a SaveHandle the moment the snapshot lands on host, and a serial
+executor preserves step order per shard.
+
+The writer talks to a CheckpointCoordinator that may be a plain local
+object (single-process) or an actor handle (multi-worker) — ``_invoke``
+papers over the difference.
+
+Chaos: the persist path consults the ``ckpt_shard_write`` fault point; an
+injected (or real) failure aborts the pending step at the coordinator so
+the commit can never include a half-written shard.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import fault_injection
+from ray_tpu.checkpoint import layout
+from ray_tpu.checkpoint import metrics as ckpt_metrics
+from ray_tpu.util import tracing
+
+logger = logging.getLogger(__name__)
+
+
+def _invoke(coordinator, method: str, *args):
+    """Call a coordinator method whether it is local or an actor handle."""
+    m = getattr(coordinator, method)
+    remote = getattr(m, "remote", None)
+    if remote is None:
+        return m(*args)
+    import ray_tpu
+
+    return ray_tpu.get(remote(*args))
+
+
+def snapshot_to_host(tree: Any) -> Any:
+    """Device arrays -> host numpy (the only step-blocking work)."""
+    import jax
+
+    return jax.device_get(tree)
+
+
+class SaveHandle:
+    """Future-ish handle for one async save."""
+
+    def __init__(self, future: Future, step: int, block_seconds: float):
+        self._future = future
+        self.step = step
+        #: seconds the caller was blocked (snapshot time)
+        self.block_seconds = block_seconds
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def result(self, timeout: Optional[float] = None) -> dict:
+        """Waits for the persist; raises if the shard write failed."""
+        return self._future.result(timeout)
+
+    def exception(self, timeout: Optional[float] = None):
+        return self._future.exception(timeout)
+
+
+class ShardWriter:
+    def __init__(self, coordinator, shard_id: int = 0, world_size: int = 1,
+                 epoch: int = 0, replicate: bool = True):
+        self.coordinator = coordinator
+        self.shard_id = int(shard_id)
+        self.world_size = int(world_size)
+        self.epoch = int(epoch)
+        self.replicate = replicate
+        self._aborted = threading.Event()
+        self._exec = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"ckpt-shard-{shard_id}")
+
+    # ----------------------------------------------------------- save API
+    def save_async(self, step: int, tree: Any) -> SaveHandle:
+        """Snapshot now, persist in the background; blocks only for the
+        device->host copy."""
+        t0 = time.monotonic()
+        with tracing.span("checkpoint.save",
+                          attributes={"step": step, "shard": self.shard_id,
+                                      "phase": "snapshot"}):
+            host_tree = snapshot_to_host(tree)
+        block = time.monotonic() - t0
+        ckpt_metrics.SAVE_BLOCK_SECONDS.observe(block, tags={"mode": "async"})
+        future = self._exec.submit(self._persist, step, host_tree)
+        return SaveHandle(future, step, block)
+
+    def save_sync(self, step: int, tree: Any) -> dict:
+        """Snapshot + persist inline (the baseline async saves beat)."""
+        t0 = time.monotonic()
+        with tracing.span("checkpoint.save",
+                          attributes={"step": step, "shard": self.shard_id,
+                                      "phase": "sync"}):
+            host_tree = snapshot_to_host(tree)
+            manifest = self._persist(step, host_tree)
+        ckpt_metrics.SAVE_BLOCK_SECONDS.observe(time.monotonic() - t0,
+                                                tags={"mode": "sync"})
+        return manifest
+
+    # ------------------------------------------------------------ persist
+    def _persist(self, step: int, host_tree: Any) -> dict:
+        if self._aborted.is_set():
+            raise RuntimeError("shard writer aborted")
+        t0 = time.monotonic()
+        try:
+            with tracing.span("checkpoint.save",
+                              attributes={"step": step, "shard": self.shard_id,
+                                          "phase": "persist"}):
+                fault_injection.check("ckpt_shard_write")
+                doc, skeleton, kind, arrays = layout.build_shard(
+                    host_tree, self.shard_id, self.world_size)
+                tmp = _invoke(self.coordinator, "begin_save", step,
+                              self.world_size, self.epoch)
+                manifest = layout.write_shard(tmp, self.shard_id, doc,
+                                              skeleton, kind, arrays, step)
+                ckpt_metrics.BYTES_WRITTEN.inc(max(1, manifest["bytes"]))
+                self._put_replica(step, doc, skeleton, kind, arrays)
+                _invoke(self.coordinator, "shard_complete", step,
+                        self.shard_id, manifest, self.epoch)
+        except BaseException as e:
+            try:
+                _invoke(self.coordinator, "shard_failed", step, self.shard_id,
+                        repr(e), self.epoch)
+            except Exception:
+                pass
+            logger.warning("checkpoint shard %s step %s failed: %r",
+                           self.shard_id, step, e)
+            raise
+        ckpt_metrics.SAVE_SECONDS.observe(time.monotonic() - t0)
+        return manifest
+
+    def _put_replica(self, step: int, doc, skeleton, kind, arrays) -> None:
+        if not self.replicate:
+            return
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            return
+        try:
+            payload = {"doc": doc, "skeleton": skeleton, "kind": kind,
+                       "arrays": arrays, "shard_id": self.shard_id,
+                       "step": step}
+            ref = ray_tpu.put(payload)
+            _invoke(self.coordinator, "put_replica", step, self.shard_id,
+                    {"ref": ref})
+        except Exception as e:  # replica tier is best-effort
+            logger.debug("replica put failed for step %s shard %s: %r",
+                         step, self.shard_id, e)
+
+    # ---------------------------------------------------------- lifecycle
+    def drain(self, timeout: Optional[float] = 60.0) -> None:
+        """Wait until every queued persist has finished (commit included).
+        Failures of individual saves do not raise here — the next commit
+        supersedes them; inspect SaveHandles for per-save outcomes."""
+        self._exec.submit(lambda: None).result(timeout)
+
+    def abort(self) -> None:
+        """Tear down: queued-but-unstarted persists become no-ops.  The
+        persist already in flight (if any) may still complete — committing
+        a fully written step is never wrong."""
+        self._aborted.set()
+
+    def close(self) -> None:
+        self.abort()
+        self._exec.shutdown(wait=False)

@@ -36,6 +36,15 @@ class Checkpoint:
         return cls(path)
 
     def to_pytree(self, template: Optional[Any] = None) -> Any:
+        # A committed sharded checkpoint (ray_tpu.checkpoint two-phase
+        # commit layout, COMMIT marker present) restores through the
+        # subsystem; the orbax single-dir layout stays the default — one
+        # handle type works for both, which is what lets Trainer
+        # auto-resume hand either kind to train.get_checkpoint().
+        from ray_tpu.checkpoint import is_committed_dir, restore_pytree
+
+        if is_committed_dir(self.path):
+            return restore_pytree(self.path, template)
         return load_pytree(os.path.join(self.path, "pytree"), template)
 
     def as_directory(self):
@@ -62,14 +71,34 @@ class Checkpoint:
         return f"Checkpoint({self.path})"
 
 
-def save_pytree(tree: Any, path: str) -> None:
+def _orbax_save(tree: Any, path: str) -> None:
+    """The raw orbax write (factored out so tests can fail it mid-save)."""
     import orbax.checkpoint as ocp
 
-    path = os.path.abspath(path)
-    if os.path.exists(path):
-        shutil.rmtree(path)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, tree)
+
+
+def save_pytree(tree: Any, path: str) -> None:
+    """Atomic pytree save: write a ``*.tmp`` sibling, then rename into
+    place.  The previous rmtree-then-save ordering meant a crash mid-save
+    destroyed the PREVIOUS checkpoint too; now the old directory survives
+    until the new one is fully on disk."""
+    path = os.path.abspath(path)
+    tmp = path + ".tmp"
+    if os.path.exists(tmp):  # stale leftover from a crashed save
+        shutil.rmtree(tmp)
+    _orbax_save(tree, tmp)
+    if os.path.exists(path):
+        # os.replace cannot clobber a non-empty dir: swap via a sibling so
+        # there is never a moment with no complete checkpoint on disk.
+        old = path + ".old"
+        shutil.rmtree(old, ignore_errors=True)
+        os.replace(path, old)
+        os.replace(tmp, path)
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.replace(tmp, path)
 
 
 def load_pytree(path: str, template: Optional[Any] = None) -> Any:
@@ -128,6 +157,38 @@ class CheckpointManager:
         self._counter = 0
         self._lock = threading.Lock()
         os.makedirs(storage_path, exist_ok=True)
+        # Restart-safe: rebuild the registry from what is already on disk,
+        # so latest_checkpoint()/best_checkpoint() survive a driver restart
+        # instead of returning None while the directories sit right there.
+        self._rescan()
+
+    def _rescan(self) -> None:
+        from ray_tpu.checkpoint.layout import COMMIT_MARKER, parse_step
+
+        for name in sorted(os.listdir(self.storage_path)):
+            path = os.path.join(self.storage_path, name)
+            if not os.path.isdir(path) or name.endswith(".tmp") \
+                    or name.endswith(".old"):
+                continue
+            if not name.startswith("checkpoint_"):
+                continue
+            has_shards = any(e.startswith("shard_") for e in os.listdir(path))
+            if has_shards and not os.path.exists(os.path.join(path, COMMIT_MARKER)):
+                continue  # torn sharded save — never register it
+            ckpt = Checkpoint(path)
+            meta = ckpt.get_metadata()
+            idx = meta.get("index")
+            if idx is None:
+                idx = parse_step(name)
+            if idx is None:
+                continue
+            metrics = meta.get("metrics", {})
+            if self.score_attribute and self.score_attribute in metrics:
+                score = float(metrics[self.score_attribute])
+            else:
+                score = float(idx)
+            self._checkpoints.append((score, ckpt, metrics))
+            self._counter = max(self._counter, int(idx))
 
     def register(self, checkpoint: Checkpoint, metrics: Dict[str, Any]) -> Checkpoint:
         """Move/copy the checkpoint into managed storage and apply retention."""
@@ -177,7 +238,18 @@ class CheckpointManager:
         with self._lock:
             if not self._checkpoints:
                 return None
-            return max(self._checkpoints, key=lambda t: t[1].get_metadata().get("index", 0))[1]
+            return max(self._checkpoints, key=lambda t: _ckpt_index(t[1]))[1]
+
+
+def _ckpt_index(ckpt: Checkpoint) -> int:
+    """Recency index: metadata wins, else the checkpoint_NNNNNN name
+    (coordinator-committed dirs carry no metadata.json)."""
+    idx = ckpt.get_metadata().get("index")
+    if idx is not None:
+        return int(idx)
+    from ray_tpu.checkpoint.layout import parse_step
+
+    return parse_step(os.path.basename(os.path.normpath(ckpt.path))) or 0
 
 
 def _json_safe(d: Dict[str, Any]) -> Dict[str, Any]:

@@ -48,11 +48,21 @@ class FailureConfig:
 
 @dataclass
 class CheckpointConfig:
-    """(ref: air/config.py CheckpointConfig) top-K retention."""
+    """(ref: air/config.py CheckpointConfig) top-K retention.
+
+    async_save routes ``train.report(..., checkpoint=<pytree>)`` through
+    the ray_tpu.checkpoint subsystem: the step blocks only for the
+    device->host snapshot, shards persist in background threads and a
+    CheckpointCoordinator two-phase-commits each step (see
+    docs/checkpointing.md).  replica_memory_steps controls how many
+    committed steps the in-memory replica tier keeps for fast recovery.
+    """
 
     num_to_keep: Optional[int] = None
     checkpoint_score_attribute: Optional[str] = None
     checkpoint_score_order: str = "max"
+    async_save: bool = False
+    replica_memory_steps: int = 2
 
 
 @dataclass

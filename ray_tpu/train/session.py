@@ -54,15 +54,34 @@ class TrainContext:
 class TrainSession:
     def __init__(self, context: TrainContext,
                  checkpoint_to_restore: Optional[Checkpoint] = None,
-                 dataset_shards: Optional[Dict[str, Any]] = None):
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 shard_writer=None, start_step: int = 0):
         self.context = context
         self.results: "queue.Queue" = queue.Queue()
         self.checkpoint_to_restore = checkpoint_to_restore
         self.dataset_shards = dataset_shards or {}
         self.stop_requested = threading.Event()
+        #: ray_tpu.checkpoint.ShardWriter when async checkpointing is on
+        #: (CheckpointConfig.async_save) — report(checkpoint=<pytree>) then
+        #: goes through the coordinator's two-phase commit instead of the
+        #: in-band queue, blocking only for the device->host snapshot.
+        self.shard_writer = shard_writer
+        #: next coordinator step id; starts past the latest committed step
+        #: so a resumed attempt never collides with history.
+        self._ckpt_step = start_step
 
     def report(self, metrics: Dict[str, Any],
-               checkpoint: Optional[Checkpoint] = None) -> None:
+               checkpoint: Optional[Any] = None) -> None:
+        if checkpoint is not None and not isinstance(checkpoint, Checkpoint):
+            # A raw pytree: async sharded save when wired, else wrap it in
+            # a directory checkpoint so the legacy path still works.
+            if self.shard_writer is not None:
+                step = self._ckpt_step
+                self._ckpt_step += 1
+                self.shard_writer.save_async(step, checkpoint)
+                checkpoint = None
+            else:
+                checkpoint = Checkpoint.from_pytree(checkpoint)
         self.results.put({"metrics": metrics, "checkpoint": checkpoint,
                           "rank": self.context.world_rank})
         if self.stop_requested.is_set():

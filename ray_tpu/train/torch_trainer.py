@@ -137,7 +137,10 @@ class TorchTrainer(DataParallelTrainer):
                 "train_loop or use JaxTrainer")
 
     def _run_with_pg(self, pg, run_name: str, group_name: str,
-                     manager: CheckpointManager, restore_ckpt) -> Dict:
+                     manager: CheckpointManager, restore_ckpt,
+                     coordinator=None) -> Dict:
+        # coordinator (async sharded checkpointing) is thread-tier only;
+        # torch workers are process-tier, so it is always None here.
         from ray_tpu.exceptions import RayTpuError, TaskError
         from ray_tpu.util.queue import Empty, Queue
 

@@ -269,43 +269,108 @@ class DataParallelTrainer:
             score_order=ckpt_conf.checkpoint_score_order,
         )
 
+        # Async checkpointing (CheckpointConfig.async_save): a
+        # CheckpointCoordinator actor owns the same checkpoints dir and
+        # two-phase-commits sharded saves flowing out of report(checkpoint=
+        # <pytree>); restarts restore from its latest committed step.
+        coordinator = None
+        if ckpt_conf.async_save:
+            from ray_tpu.checkpoint import CheckpointCoordinator
+
+            coordinator = ray_tpu.remote(CheckpointCoordinator).remote(
+                os.path.join(experiment_path, "checkpoints"),
+                keep=ckpt_conf.num_to_keep,
+                replica_steps=ckpt_conf.replica_memory_steps)
+
         max_failures = self.run_config.failure_config.max_failures
         failures = 0
         restore_ckpt = self.resume_from_checkpoint
         last_error: Optional[BaseException] = None
         history: List[Dict[str, Any]] = []
 
-        while True:
-            outcome = self._run_attempt(run_name, manager, restore_ckpt, experiment_path)
-            history.extend(outcome["history"])
-            if outcome["status"] == "finished":
-                return Result(
-                    metrics=outcome["last_metrics"],
-                    checkpoint=manager.latest_checkpoint(),
-                    path=experiment_path,
-                    metrics_history=history,
-                )
-            last_error = outcome["error"]
-            failures += 1
-            exhausted = max_failures >= 0 and failures > max_failures
-            # "fatal" = retrying cannot help (e.g. infeasible resources):
-            # return even under max_failures=-1 instead of spinning forever.
-            if exhausted or outcome["status"] == "fatal":
-                return Result(
-                    metrics=outcome["last_metrics"],
-                    checkpoint=manager.latest_checkpoint(),
-                    path=experiment_path,
-                    error=last_error,
-                    metrics_history=history,
-                )
-            time.sleep(min(2.0 ** min(failures, 5) * 0.1, 5.0))  # restart backoff
-            # Elastic restart from the latest checkpoint (ref: v2 controller
-            # RESTARTING state).
-            restore_ckpt = manager.latest_checkpoint() or self.resume_from_checkpoint
+        try:
+            while True:
+                outcome = self._run_attempt(run_name, manager, restore_ckpt,
+                                            experiment_path, coordinator)
+                history.extend(outcome["history"])
+                if outcome["status"] == "finished":
+                    return Result(
+                        metrics=outcome["last_metrics"],
+                        checkpoint=(manager.latest_checkpoint()
+                                    or self._coordinator_checkpoint(
+                                        coordinator, from_memory=False)),
+                        path=experiment_path,
+                        metrics_history=history,
+                    )
+                last_error = outcome["error"]
+                failures += 1
+                exhausted = max_failures >= 0 and failures > max_failures
+                # "fatal" = retrying cannot help (e.g. infeasible resources):
+                # return even under max_failures=-1 instead of spinning forever.
+                if exhausted or outcome["status"] == "fatal":
+                    return Result(
+                        metrics=outcome["last_metrics"],
+                        checkpoint=(manager.latest_checkpoint()
+                                    or self._coordinator_checkpoint(
+                                        coordinator, from_memory=False)),
+                        path=experiment_path,
+                        error=last_error,
+                        metrics_history=history,
+                    )
+                time.sleep(min(2.0 ** min(failures, 5) * 0.1, 5.0))  # restart backoff
+                # Elastic restart from the latest checkpoint (ref: v2
+                # controller RESTARTING state).  The coordinator's committed
+                # step wins — its replica tier restores without re-reading
+                # storage; the legacy manager path is the fallback.
+                restore_ckpt = (self._coordinator_checkpoint(coordinator)
+                                or manager.latest_checkpoint()
+                                or self.resume_from_checkpoint)
+        finally:
+            if coordinator is not None:
+                try:
+                    ray_tpu.kill(coordinator)
+                except Exception:
+                    pass
+
+    # ------------------------------------------------ coordinator restore
+    def _coordinator_checkpoint(self, coordinator,
+                                from_memory: bool = True) -> Optional[Checkpoint]:
+        """Checkpoint handle for the coordinator's latest committed step.
+
+        Prefers the in-memory replica tier (full shard set resident):
+        payloads are materialized into a fresh local committed dir, so the
+        handle's to_pytree() never touches the original storage — the
+        Gemini-style fast recovery path."""
+        if coordinator is None:
+            return None
+        try:
+            src = ray_tpu.get(coordinator.restore_source.remote(), timeout=30)
+        except Exception:
+            return None
+        if src is None:
+            return None
+        if from_memory and src.get("replicas"):
+            try:
+                from ray_tpu.checkpoint import materialize_from_payloads
+
+                refs = src["replicas"]["refs"]
+                payloads = {int(sid): ray_tpu.get(w["ref"])
+                            for sid, w in refs.items()}
+                local_root = tempfile.mkdtemp(prefix="ray_tpu_ckpt_mem_")
+                path = materialize_from_payloads(local_root, src["step"],
+                                                 payloads)
+                from ray_tpu.checkpoint import metrics as _ckpt_metrics
+
+                _ckpt_metrics.RESTORES.inc(tags={"source": "memory"})
+                return Checkpoint(path)
+            except Exception:
+                pass  # fall back to the committed dir on storage
+        return Checkpoint(src["path"])
 
     # ---------------------------------------------------------- one attempt
     def _run_attempt(self, run_name: str, manager: CheckpointManager,
-                     restore_ckpt: Optional[Checkpoint], experiment_path: str) -> Dict:
+                     restore_ckpt: Optional[Checkpoint], experiment_path: str,
+                     coordinator=None) -> Dict:
         scfg = self.scaling_config
         world = scfg.num_workers
         DataParallelTrainer._collective_counter += 1
@@ -335,7 +400,8 @@ class DataParallelTrainer:
                             f"Could not reserve {world}x{scfg.worker_resources()} "
                             f"for the worker group within 60s (cluster: {total}). "
                             f"Reduce num_workers/resources_per_worker or add nodes.")}
-            return self._run_with_pg(pg, run_name, group_name, manager, restore_ckpt)
+            return self._run_with_pg(pg, run_name, group_name, manager,
+                                     restore_ckpt, coordinator)
         finally:
             collective.destroy_collective_group(group_name)
             remove_placement_group(pg)
@@ -357,13 +423,31 @@ class DataParallelTrainer:
         ) else "threads"
 
     def _run_with_pg(self, pg, run_name: str, group_name: str,
-                     manager: CheckpointManager, restore_ckpt) -> Dict:
+                     manager: CheckpointManager, restore_ckpt,
+                     coordinator=None) -> Dict:
         if self._worker_mode(pg) == "processes":
+            # Process-tier workers ship checkpoints by value through the
+            # report queue; the async sharded path is thread-tier only.
             return self._run_distributed(pg, run_name, group_name, manager,
                                          restore_ckpt)
         scfg = self.scaling_config
         world = scfg.num_workers
         dataset_shards = self._split_datasets(world)
+        writers: List = []
+        epoch = 0
+        start_step = 0
+        if coordinator is not None:
+            from ray_tpu.checkpoint import ShardWriter
+
+            # New attempt = new epoch: shards from a crashed attempt's
+            # in-flight saves can no longer mix into this attempt's steps.
+            epoch = ray_tpu.get(coordinator.new_epoch.remote(), timeout=30)
+            latest = ray_tpu.get(coordinator.latest_committed.remote(),
+                                 timeout=30)
+            start_step = (latest + 1) if latest is not None else 0
+            writers = [ShardWriter(coordinator, shard_id=rank,
+                                   world_size=world, epoch=epoch)
+                       for rank in range(world)]
         sessions: List[TrainSession] = []
         workers = []
         for rank in range(world):
@@ -371,7 +455,9 @@ class DataParallelTrainer:
                                trial_name=run_name, experiment_name=run_name,
                                group_name=group_name)
             session = TrainSession(ctx, checkpoint_to_restore=restore_ckpt,
-                                   dataset_shards=dataset_shards[rank])
+                                   dataset_shards=dataset_shards[rank],
+                                   shard_writer=writers[rank] if writers else None,
+                                   start_step=start_step)
             sessions.append(session)
             workers.append(
                 TrainWorker.options(
@@ -399,6 +485,14 @@ class DataParallelTrainer:
             # Final drain after workers exit.
             last_metrics, new_rows = self._drain_sessions(sessions, manager, last_metrics)
             history.extend(new_rows)
+            # Async saves still persisting in the background belong to this
+            # run: let them land (and commit) before declaring it finished.
+            for wtr in writers:
+                try:
+                    wtr.drain(timeout=120)
+                except Exception:
+                    pass
+                wtr.close()
             return {"status": "finished", "last_metrics": last_metrics,
                     "history": history, "error": None}
         except (TaskError, RayTpuError) as e:  # worker failed
@@ -413,6 +507,11 @@ class DataParallelTrainer:
                 pass
             for w in workers:
                 ray_tpu.kill(w)
+            # Queued-but-unstarted async saves die with the attempt (their
+            # epoch is stale anyway); an in-flight persist may still commit,
+            # which is always safe — the step is fully written.
+            for wtr in writers:
+                wtr.close()
             # Keep results reported before the crash (checkpoints especially —
             # the restart resumes from the last one registered).
             last_metrics, new_rows = self._drain_sessions(sessions, manager, last_metrics)

@@ -1,0 +1,141 @@
+"""Checkpoint benchmark artifact (ISSUE 5 acceptance): sync-vs-async
+step-blocking time, two-phase commit latency, and restore time from the
+disk and memory tiers, written to BENCH_CKPT.json (same accumulate-merge
+pattern as scripts/bench_serve.py).
+
+The async path (Check-N-Run decomposition) keeps only the device->host
+snapshot on the training step's critical path; the acceptance gate is
+async blocking <= 25% of the sync save's wall time at a multi-MB state.
+
+Usage: python scripts/bench_checkpoint.py [--steps 5] [--payload-mb 64]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, ".")
+
+
+def _merge_artifact(out_path: str, fields: dict) -> dict:
+    artifact = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                artifact = json.load(f)
+        except Exception:
+            artifact = {}
+    artifact.update(fields)
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+    return artifact
+
+
+def _device_tree(payload_mb: int):
+    """A two-leaf device pytree totalling ~payload_mb MB of fp32."""
+    import jax.numpy as jnp
+
+    n = payload_mb * (1 << 20) // 8  # two equal fp32 leaves
+    return {"w": jnp.arange(n, dtype=jnp.float32),
+            "m": jnp.ones((n,), jnp.float32)}
+
+
+def measure_blocking(root: str, steps: int = 5, payload_mb: int = 64) -> dict:
+    """Mean seconds the caller is blocked per save, sync vs async."""
+    from ray_tpu.checkpoint import CheckpointCoordinator, ShardWriter
+
+    tree = _device_tree(payload_mb)
+    means = {}
+    for mode in ("sync", "async"):
+        mroot = os.path.join(root, mode)
+        coord = CheckpointCoordinator(mroot, keep=2, replicate_to_peer=False)
+        w = ShardWriter(coord, shard_id=0, world_size=1, replicate=False)
+        # Warm step: first save pays fs/allocator warmup in both modes.
+        if mode == "sync":
+            w.save_sync(0, tree)
+        else:
+            w.save_async(0, tree).result(timeout=600)
+        blocks = []
+        for step in range(1, steps + 1):
+            t0 = time.perf_counter()
+            if mode == "sync":
+                w.save_sync(step, tree)
+            else:
+                w.save_async(step, tree)
+            blocks.append(time.perf_counter() - t0)
+        w.drain(timeout=600)
+        w.close()
+        assert coord.latest_committed() == steps, mode
+        means[mode] = sum(blocks) / len(blocks)
+    return {
+        "sync_block_mean_s": round(means["sync"], 5),
+        "async_block_mean_s": round(means["async"], 5),
+        "async_vs_sync_block_ratio": round(means["async"] / means["sync"], 4),
+        "steps": steps,
+        "payload_mb": payload_mb,
+    }
+
+
+def measure_commit_and_restore(root: str, payload_mb: int = 64) -> dict:
+    """Commit latency (phase 2 alone, shard files already on disk) and
+    restore wall time from the disk tier vs in-memory replica payloads."""
+    import numpy as np
+
+    from ray_tpu.checkpoint import (CheckpointCoordinator, layout,
+                                    restore_latest)
+
+    n = payload_mb * (1 << 20) // 4
+    tree = {"w": np.arange(n, dtype=np.float32)}
+    croot = os.path.join(root, "commit")
+    coord = CheckpointCoordinator(croot, replicate_to_peer=False)
+    doc, skeleton, kind, arrays = layout.build_shard(tree, 0, 1)
+    tmp = coord.begin_save(0, num_shards=1, epoch=0)
+    manifest = layout.write_shard(tmp, 0, doc, skeleton, kind, arrays, 0)
+    t0 = time.perf_counter()
+    assert coord.shard_complete(0, 0, manifest, epoch=0)
+    commit_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    restored = restore_latest(croot)
+    restore_disk_s = time.perf_counter() - t0
+    assert restored["w"].shape == tree["w"].shape
+
+    payloads = {0: {"doc": doc, "skeleton": skeleton, "kind": kind,
+                    "arrays": arrays}}
+    t0 = time.perf_counter()
+    layout.assemble_from_payloads(payloads)
+    restore_memory_s = time.perf_counter() - t0
+    return {
+        "commit_latency_s": round(commit_s, 5),
+        "restore_disk_s": round(restore_disk_s, 5),
+        "restore_memory_s": round(restore_memory_s, 5),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--payload-mb", type=int, default=64)
+    ap.add_argument("--out", default="BENCH_CKPT.json")
+    args = ap.parse_args()
+
+    root = tempfile.mkdtemp(prefix="bench_ckpt_")
+    try:
+        fields = measure_blocking(root, args.steps, args.payload_mb)
+        fields.update(measure_commit_and_restore(root, args.payload_mb))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    # Acceptance anchor (ISSUE 5): fail loudly rather than record a
+    # regressed artifact.
+    assert fields["async_vs_sync_block_ratio"] <= 0.25, fields
+    artifact = _merge_artifact(args.out, fields)
+    print(json.dumps(artifact))
+
+
+if __name__ == "__main__":
+    main()

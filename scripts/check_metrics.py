@@ -38,6 +38,7 @@ METRIC_MODULES = (
     "ray_tpu.serve.batching",
     "ray_tpu.serve.continuous",
     "ray_tpu.serve.deployment_state",
+    "ray_tpu.checkpoint.metrics",
 )
 
 

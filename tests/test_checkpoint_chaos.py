@@ -1,0 +1,209 @@
+"""Checkpoint chaos: injected failures at the subsystem's fault points
+(ckpt_shard_write, ckpt_commit, ckpt_restore) and a worker kill under
+load.  The invariant under every fault: restore always returns the last
+*committed* step, and a torn directory is never selected (ref: the serve
+chaos suite drives the same injector — tests/test_serve_chaos.py)."""
+
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.fault_injection import InjectedFailure, reset_injector
+from ray_tpu.checkpoint import (
+    CheckpointCoordinator,
+    ShardWriter,
+    latest_committed_step,
+    restore_latest,
+)
+from ray_tpu.checkpoint import layout
+
+
+def _set_chaos(spec: str) -> None:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    GLOBAL_CONFIG.testing_rpc_failure = spec
+    reset_injector()
+
+
+@pytest.fixture
+def chaos():
+    """Yields a setter for the fault-injection spec; always cleans up."""
+    yield _set_chaos
+    _set_chaos("")
+
+
+def _tree(scale: float):
+    return {"w": np.full((8, 2), float(scale), np.float32),
+            "step": np.int32(scale)}
+
+
+def _assert_no_torn_dirs(root: str) -> None:
+    """Every final-named checkpoint dir must carry the COMMIT marker —
+    chaos may leave .tmp litter, never a torn committed-looking dir."""
+    for name in os.listdir(root):
+        if layout.parse_step(name) is not None:
+            assert os.path.exists(
+                os.path.join(root, name, layout.COMMIT_MARKER)), name
+
+
+def test_shard_writer_killed_mid_save(chaos, tmp_path):
+    """Kill one shard's persist mid-save: the step aborts, restore still
+    returns the previous committed step, and the writers keep working
+    once the fault clears."""
+    root = str(tmp_path)
+    coord = CheckpointCoordinator(root, replicate_to_peer=False)
+    writers = [ShardWriter(coord, shard_id=i, world_size=2, replicate=False)
+               for i in range(2)]
+    # Step 0 commits cleanly.
+    for h in [w.save_async(0, _tree(0)) for w in writers]:
+        h.result(timeout=30)
+    assert coord.latest_committed() == 0
+    # One persist dies at step 1 (budget 1: exactly one kill).
+    chaos("ckpt_shard_write=1:1")
+    handles = [w.save_async(1, _tree(1)) for w in writers]
+    excs = [h.exception(timeout=30) for h in handles]
+    assert any(isinstance(e, InjectedFailure) for e in excs), excs
+    # The half-written step never becomes visible anywhere.
+    assert coord.latest_committed() == 0
+    assert latest_committed_step(root) == 0
+    _assert_no_torn_dirs(root)
+    np.testing.assert_allclose(restore_latest(root)["w"], 0.0)
+    # Fault budget exhausted: the next step commits and supersedes.
+    for h in [w.save_async(2, _tree(2)) for w in writers]:
+        h.result(timeout=30)
+    assert coord.latest_committed() == 2
+    _assert_no_torn_dirs(root)
+    np.testing.assert_allclose(restore_latest(root)["w"], 2.0)
+    for w in writers:
+        w.close()
+
+
+def test_coordinator_killed_mid_commit(chaos, tmp_path):
+    """Kill the commit phase after every shard landed: the rename never
+    happens, so the step stays invisible and the previous one keeps
+    winning selection."""
+    root = str(tmp_path)
+    coord = CheckpointCoordinator(root, replicate_to_peer=False)
+    w = ShardWriter(coord, 0, 1, replicate=False)
+    w.save_async(0, _tree(0)).result(timeout=30)
+    chaos("ckpt_commit=1:1")
+    h = w.save_async(1, _tree(1))
+    assert isinstance(h.exception(timeout=30), InjectedFailure)
+    assert coord.latest_committed() == 0
+    assert latest_committed_step(root) == 0
+    assert not os.path.exists(layout.final_dir(root, 1))
+    _assert_no_torn_dirs(root)
+    np.testing.assert_allclose(restore_latest(root)["w"], 0.0)
+    # Transient fault: the following save commits normally.
+    w.save_async(2, _tree(2)).result(timeout=30)
+    assert coord.latest_committed() == 2
+    np.testing.assert_allclose(restore_latest(root)["w"], 2.0)
+    w.close()
+
+
+def test_restore_failure_is_transient_and_retryable(chaos, tmp_path):
+    root = str(tmp_path)
+    coord = CheckpointCoordinator(root, replicate_to_peer=False)
+    w = ShardWriter(coord, 0, 1, replicate=False)
+    w.save_async(0, _tree(4)).result(timeout=30)
+    w.close()
+    chaos("ckpt_restore=1:1")
+    with pytest.raises(InjectedFailure):
+        restore_latest(root)
+    # InjectedFailure subclasses WorkerCrashedError — retryable; the
+    # retry reads the same committed step.
+    np.testing.assert_allclose(restore_latest(root)["w"], 4.0)
+
+
+def test_trainer_worker_killed_under_load_auto_resumes(tmp_path):
+    """Acceptance (ISSUE 5): kill a train worker mid-run with async saves
+    in flight — Trainer.fit() restarts the attempt and resumes from the
+    coordinator's latest committed checkpoint, never a torn one."""
+    from ray_tpu import train
+    from ray_tpu.train import (CheckpointConfig, FailureConfig, JaxTrainer,
+                               RunConfig, ScalingConfig)
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    try:
+        storage = str(tmp_path)
+        attempts = {"n": 0}
+
+        def loop(config):
+            ckpt = train.get_checkpoint()
+            start = 0
+            if ckpt is not None:
+                start = int(np.asarray(ckpt.to_pytree()["step"])) + 1
+            for it in range(start, 5):
+                train.report(
+                    {"step": it},
+                    checkpoint={"step": jnp.asarray(it),
+                                "w": jnp.full((8,), float(it))})
+                if it == 2 and attempts["n"] == 0:
+                    attempts["n"] += 1
+                    time.sleep(0.5)  # let the async persist race the crash
+                    raise RuntimeError("simulated worker death under load")
+
+        trainer = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(
+                name="chaos_resume", storage_path=storage,
+                checkpoint_config=CheckpointConfig(num_to_keep=3,
+                                                   async_save=True),
+                failure_config=FailureConfig(max_failures=1)))
+        result = trainer.fit()
+        assert result.error is None
+        assert result.metrics["step"] == 4
+        steps = [m["step"] for m in result.metrics_history]
+        assert steps.count(0) == 1  # resumed from a checkpoint, not scratch
+        root = os.path.join(storage, "chaos_resume", "checkpoints")
+        _assert_no_torn_dirs(root)
+        assert result.checkpoint is not None
+        restored = result.checkpoint.to_pytree()
+        assert int(np.asarray(restored["step"])) == 4
+        np.testing.assert_allclose(np.asarray(restored["w"]), 4.0)
+    finally:
+        ray_tpu.shutdown()
+        _set_chaos("")
+
+
+def test_trainer_survives_injected_shard_write_faults(tmp_path):
+    """Probabilistic ckpt_shard_write faults during training: some saves
+    abort, training itself never fails, and whatever step restore returns
+    is a fully committed one."""
+    from ray_tpu import train
+    from ray_tpu.train import (CheckpointConfig, JaxTrainer, RunConfig,
+                               ScalingConfig)
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True,
+                 _system_config={"testing_rpc_failure":
+                                 "ckpt_shard_write=0.4:3"})
+    try:
+        storage = str(tmp_path)
+
+        def loop(config):
+            for it in range(6):
+                train.report({"step": it},
+                             checkpoint={"step": jnp.asarray(it)})
+
+        trainer = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(
+                name="flaky_saves", storage_path=storage,
+                checkpoint_config=CheckpointConfig(async_save=True)))
+        result = trainer.fit()
+        assert result.error is None  # save faults never fail training
+        root = os.path.join(storage, "flaky_saves", "checkpoints")
+        _assert_no_torn_dirs(root)
+        committed = layout.list_committed_steps(root)
+        assert committed, "every save aborted — budget should cap at 3"
+        restored = restore_latest(root)
+        assert int(np.asarray(restored["step"])) == committed[-1]
+    finally:
+        ray_tpu.shutdown()
+        _set_chaos("")
