@@ -51,6 +51,10 @@ class CheckpointCoordinator:
         #: (step, epoch) pairs whose save aborted: a sibling shard arriving
         #: after the abort must not resurrect the pending entry.
         self._aborted: set = set()
+        #: steps whose phase-2 commit is in flight (pending entry already
+        #: removed, rename not yet done): the stale-tmp sweep and
+        #: shard_failed must treat their .tmp dirs as live.
+        self._committing: set = set()
         # Restart-safe: rebuild committed state from disk (the same scan
         # CheckpointManager does) so a driver restart resumes seamlessly.
         self._committed: List[int] = layout.list_committed_steps(self.root)
@@ -73,7 +77,7 @@ class CheckpointCoordinator:
 
     def begin_save(self, step: int, num_shards: int, epoch: int = 0) -> str:
         with self._lock:
-            if step in self._committed:
+            if step in self._committed or step in self._committing:
                 raise ValueError(f"step {step} is already committed")
             if (step, epoch) in self._aborted:
                 raise RuntimeError(
@@ -107,8 +111,16 @@ class CheckpointCoordinator:
             pending["done"][shard_id] = manifest
             if len(pending["done"]) < pending["num_shards"]:
                 return False
+            # Hand the step from _pending to _committing without a gap:
+            # a concurrent begin_save's stale-tmp sweep must keep seeing
+            # this step as owned, or it rmtrees the .tmp dir mid-commit.
             del self._pending[step]
-        self._commit(step, pending)
+            self._committing.add(step)
+        try:
+            self._commit(step, pending)
+        finally:
+            with self._lock:
+                self._committing.discard(step)
         return True
 
     def shard_failed(self, step: int, shard_id: int, error: str = "",
@@ -116,6 +128,10 @@ class CheckpointCoordinator:
         """Abort a pending save: the step can never commit with a missing
         shard, so drop it and reclaim the tmp dir."""
         with self._lock:
+            if step in self._committing:
+                # Every shard already landed and phase 2 owns the tmp dir;
+                # a duplicate/stale failure report must not rmtree it.
+                return
             pending = self._pending.get(step)
             if pending is not None and pending["epoch"] != epoch:
                 return
@@ -139,7 +155,8 @@ class CheckpointCoordinator:
         except BaseException:
             ckpt_metrics.SAVE_FAILURES.inc(tags={"phase": "commit"})
             shutil.rmtree(layout.tmp_dir(self.root, step), ignore_errors=True)
-            self._replicas.pop(step, None)
+            with self._lock:
+                self._replicas.pop(step, None)
             raise
         now = time.time()
         with self._lock:
@@ -148,6 +165,11 @@ class CheckpointCoordinator:
             if self._last_commit_time is not None:
                 ckpt_metrics.STALENESS_SECONDS.set(now - self._last_commit_time)
             self._last_commit_time = now
+            # Aborted steps at or below the new latest can never be retried
+            # (writers allocate monotonically increasing step ids), so the
+            # poison set stays bounded in a long-lived coordinator.
+            latest = self._committed[-1]
+            self._aborted = {(s, e) for (s, e) in self._aborted if s > latest}
             self._apply_retention()
             self._trim_replicas()
         ckpt_metrics.COMMITS.inc()
@@ -168,7 +190,7 @@ class CheckpointCoordinator:
         for path in layout.list_stale_tmp_dirs(self.root):
             name = os.path.basename(path)
             step = layout.parse_step(name[: -len(layout.TMP_SUFFIX)])
-            if step not in self._pending:
+            if step not in self._pending and step not in self._committing:
                 shutil.rmtree(path, ignore_errors=True)
 
     # --------------------------------------------------------- inspection
@@ -211,6 +233,7 @@ class CheckpointCoordinator:
         # pending (its commit may be in flight).
         keep = set(self._committed[-self.replica_steps:]) if self.replica_steps else set()
         keep |= set(self._pending)
+        keep |= self._committing
         for step in [s for s in self._replicas if s not in keep]:
             del self._replicas[step]
         committed_resident = [s for s in self._replicas if s in set(self._committed)]
@@ -278,6 +301,8 @@ class CheckpointCoordinator:
             return {
                 "committed_steps": list(self._committed),
                 "pending_steps": sorted(self._pending),
+                "committing_steps": sorted(self._committing),
+                "aborted_entries": len(self._aborted),
                 "replica_steps": sorted(self._replicas),
                 "epoch": self._epoch,
                 "peer_replication": self._peer is not None,

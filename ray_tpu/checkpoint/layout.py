@@ -231,7 +231,22 @@ def build_shard(host_tree: Any, shard_id: int, world_size: int):
     leaf_meta = []
     arrays: Dict[str, np.ndarray] = {}
     for i, leaf in enumerate(leaves):
+        if leaf is None:
+            # None is a leaf on the json-skeleton path; np.asarray(None)
+            # is an object array that npz would *pickle* — the save would
+            # commit but allow_pickle=False restore could never load it.
+            # Inline it in the skeleton doc instead of the npz.
+            leaf_meta.append({"dtype": "none", "shape": [],
+                              "partition": {"kind": "inline", "value": None}})
+            continue
         a = np.asarray(leaf)
+        if a.dtype == object:
+            raise TypeError(
+                f"checkpoint leaf {i} ({type(leaf).__name__}) is not "
+                "numeric/string data: saving it would pickle an object "
+                "array that restore (allow_pickle=False) can never load — "
+                "a committed-but-unrestorable checkpoint. Convert the leaf "
+                "to an array or drop it from the checkpointed tree.")
         part = partition_for(a.shape, world_size)
         leaf_meta.append({"dtype": str(a.dtype), "shape": list(a.shape),
                           "partition": part})
@@ -353,7 +368,9 @@ def assemble_from_payloads(payloads: Dict[int, dict]) -> Any:
     for i, meta in enumerate(doc["leaves"]):
         key = f"leaf_{i}"
         part = meta["partition"]
-        if part["kind"] == "sharded":
+        if part["kind"] == "inline":
+            leaves.append(part.get("value"))
+        elif part["kind"] == "sharded":
             pieces = [np.asarray(payloads[s]["arrays"][key])
                       for s in range(part["count"])]
             leaves.append(np.concatenate(pieces, axis=part["axis"]))
@@ -400,7 +417,9 @@ def assemble_tree(step_dir: str,
     for i, meta in enumerate(doc["leaves"]):
         key = f"leaf_{i}"
         part = meta["partition"]
-        if part["kind"] == "sharded":
+        if part["kind"] == "inline":
+            leaves.append(part.get("value"))
+        elif part["kind"] == "sharded":
             pieces = [np.asarray(shard_data(s)[key]) for s in range(part["count"])]
             leaves.append(np.concatenate(pieces, axis=part["axis"]))
         else:

@@ -192,9 +192,18 @@ class CheckpointManager:
 
     def register(self, checkpoint: Checkpoint, metrics: Dict[str, Any]) -> Checkpoint:
         """Move/copy the checkpoint into managed storage and apply retention."""
+        from ray_tpu.checkpoint.layout import COMMIT_MARKER
+
         with self._lock:
-            self._counter += 1
-            dest = os.path.join(self.storage_path, f"checkpoint_{self._counter:06d}")
+            while True:
+                self._counter += 1
+                dest = os.path.join(self.storage_path,
+                                    f"checkpoint_{self._counter:06d}")
+                # Never clobber a coordinator-committed sharded step that
+                # landed after our rescan (the two sides number dirs from
+                # independent counters): seed the counter past it instead.
+                if not os.path.exists(os.path.join(dest, COMMIT_MARKER)):
+                    break
             if os.path.abspath(checkpoint.path) != dest:
                 if os.path.exists(dest):
                     shutil.rmtree(dest)
@@ -221,9 +230,16 @@ class CheckpointManager:
     def _apply_retention(self) -> None:
         if self.num_to_keep is None or len(self._checkpoints) <= self.num_to_keep:
             return
+        from ray_tpu.checkpoint.layout import COMMIT_MARKER
+
         reverse = self.score_order == "max"
         self._checkpoints.sort(key=lambda t: t[0], reverse=reverse)
         for _, ckpt, _ in self._checkpoints[self.num_to_keep:]:
+            # Coordinator-committed sharded dirs are the coordinator's to
+            # retire (its own keep= policy): evict from this registry but
+            # leave the directory alone.
+            if os.path.exists(os.path.join(ckpt.path, COMMIT_MARKER)):
+                continue
             shutil.rmtree(ckpt.path, ignore_errors=True)
         self._checkpoints = self._checkpoints[: self.num_to_keep]
 

@@ -69,6 +69,12 @@ class TrainSession:
         #: next coordinator step id; starts past the latest committed step
         #: so a resumed attempt never collides with history.
         self._ckpt_step = start_step
+        #: how many async saves this session handed to the shard writer,
+        #: and the newest SaveHandle — the trainer checks these after the
+        #: run so an every-save-failed run cannot finish silently with no
+        #: checkpoint and no error.
+        self.async_saves_reported = 0
+        self.last_save_handle = None
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Any] = None) -> None:
@@ -78,7 +84,9 @@ class TrainSession:
             if self.shard_writer is not None:
                 step = self._ckpt_step
                 self._ckpt_step += 1
-                self.shard_writer.save_async(step, checkpoint)
+                self.last_save_handle = self.shard_writer.save_async(
+                    step, checkpoint)
+                self.async_saves_reported += 1
                 checkpoint = None
             else:
                 checkpoint = Checkpoint.from_pytree(checkpoint)

@@ -277,8 +277,12 @@ class DataParallelTrainer:
         if ckpt_conf.async_save:
             from ray_tpu.checkpoint import CheckpointCoordinator
 
+            # The coordinator owns its own subdirectory: it and the legacy
+            # CheckpointManager assign checkpoint_NNNNNN names from
+            # independent counters, so sharing one directory would let
+            # either side clobber or retention-delete the other's dirs.
             coordinator = ray_tpu.remote(CheckpointCoordinator).remote(
-                os.path.join(experiment_path, "checkpoints"),
+                os.path.join(experiment_path, "checkpoints", "sharded"),
                 keep=ckpt_conf.num_to_keep,
                 replica_steps=ckpt_conf.replica_memory_steps)
 
@@ -300,6 +304,9 @@ class DataParallelTrainer:
                                     or self._coordinator_checkpoint(
                                         coordinator, from_memory=False)),
                         path=experiment_path,
+                        # Surfaces e.g. "every async save failed": training
+                        # succeeded but the run has no usable checkpoint.
+                        error=outcome["error"],
                         metrics_history=history,
                     )
                 last_error = outcome["error"]
@@ -494,7 +501,8 @@ class DataParallelTrainer:
                     pass
                 wtr.close()
             return {"status": "finished", "last_metrics": last_metrics,
-                    "history": history, "error": None}
+                    "history": history,
+                    "error": self._check_async_saves(sessions, coordinator)}
         except (TaskError, RayTpuError) as e:  # worker failed
             for s in sessions:
                 s.stop_requested.set()
@@ -618,6 +626,42 @@ class DataParallelTrainer:
                 report_queue.shutdown()
             except Exception:
                 pass
+
+    def _check_async_saves(self, sessions: List[TrainSession],
+                           coordinator) -> Optional[BaseException]:
+        """Async saves fail out-of-band (drain deliberately swallows them so
+        a later commit can supersede); a run where NO save ever committed
+        must not finish silently with checkpoint=None and no error."""
+        reported = sum(getattr(s, "async_saves_reported", 0) for s in sessions)
+        if not reported or coordinator is None:
+            return None
+        from ray_tpu.checkpoint.writer import _invoke
+
+        try:
+            latest = _invoke(coordinator, "latest_committed")
+        except Exception:
+            return None
+        if latest is not None:
+            return None
+        causes = []
+        for s in sessions:
+            handle = getattr(s, "last_save_handle", None)
+            if handle is None:
+                continue
+            try:
+                exc = handle.exception(timeout=0)
+            except Exception:
+                exc = None
+            if exc is not None:
+                causes.append(repr(exc))
+        import logging
+
+        err = RuntimeError(
+            f"{reported} async checkpoint save(s) were reported but no step "
+            "ever committed — the run finished without a usable checkpoint"
+            + (f"; last shard errors: {causes}" if causes else ""))
+        logging.getLogger(__name__).warning("%s", err)
+        return err
 
     def _drain_sessions(self, sessions: List[TrainSession], manager: CheckpointManager,
                         last_metrics: Optional[Dict[str, Any]]):

@@ -160,12 +160,49 @@ def test_trainer_worker_killed_under_load_auto_resumes(tmp_path):
         assert result.metrics["step"] == 4
         steps = [m["step"] for m in result.metrics_history]
         assert steps.count(0) == 1  # resumed from a checkpoint, not scratch
-        root = os.path.join(storage, "chaos_resume", "checkpoints")
+        root = os.path.join(storage, "chaos_resume", "checkpoints", "sharded")
         _assert_no_torn_dirs(root)
         assert result.checkpoint is not None
         restored = result.checkpoint.to_pytree()
         assert int(np.asarray(restored["step"])) == 4
         np.testing.assert_allclose(np.asarray(restored["w"]), 4.0)
+    finally:
+        ray_tpu.shutdown()
+        _set_chaos("")
+
+
+def test_all_async_saves_failing_surfaces_result_error(tmp_path):
+    """Regression: a run whose EVERY async save failed used to finish with
+    checkpoint=None and no surfaced error (report() discards SaveHandles
+    and drain() swallows failures by design).  Result.error now says so."""
+    from ray_tpu import train
+    from ray_tpu.train import (CheckpointConfig, JaxTrainer, RunConfig,
+                               ScalingConfig)
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True,
+                 _system_config={"testing_rpc_failure":
+                                 "ckpt_shard_write=1:1000"})
+    try:
+        storage = str(tmp_path)
+
+        def loop(config):
+            for it in range(3):
+                train.report({"step": it},
+                             checkpoint={"step": jnp.asarray(it)})
+
+        trainer = JaxTrainer(
+            loop,
+            scaling_config=ScalingConfig(num_workers=2),
+            run_config=RunConfig(
+                name="all_fail", storage_path=storage,
+                checkpoint_config=CheckpointConfig(async_save=True)))
+        result = trainer.fit()
+        assert result.metrics["step"] == 2  # training itself succeeded
+        assert result.error is not None
+        assert "no step ever committed" in str(result.error)
+        assert result.checkpoint is None
+        root = os.path.join(storage, "all_fail", "checkpoints", "sharded")
+        assert layout.list_committed_steps(root) == []
     finally:
         ray_tpu.shutdown()
         _set_chaos("")
@@ -198,7 +235,7 @@ def test_trainer_survives_injected_shard_write_faults(tmp_path):
                 checkpoint_config=CheckpointConfig(async_save=True)))
         result = trainer.fit()
         assert result.error is None  # save faults never fail training
-        root = os.path.join(storage, "flaky_saves", "checkpoints")
+        root = os.path.join(storage, "flaky_saves", "checkpoints", "sharded")
         _assert_no_torn_dirs(root)
         committed = layout.list_committed_steps(root)
         assert committed, "every save aborted — budget should cap at 3"

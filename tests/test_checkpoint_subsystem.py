@@ -190,6 +190,77 @@ def test_aborted_step_cannot_be_resurrected_by_sibling(tmp_path):
     assert coord.latest_committed() == 3
 
 
+def test_commit_survives_concurrent_begin_save_sweep(tmp_path, monkeypatch):
+    """Regression: shard_complete used to drop the step from _pending
+    before running phase 2, so a concurrent begin_save's stale-tmp sweep
+    saw the committing step's .tmp dir as unowned and rmtree'd it
+    mid-commit.  The step must stay registered until the rename."""
+    root = str(tmp_path)
+    coord = CheckpointCoordinator(root, replicate_to_peer=False)
+    real_commit = layout.commit_step_dir
+
+    def commit_with_interleaved_save(c_root, step, manifests, extra=None):
+        # Another writer begins the NEXT step exactly while phase 2 runs —
+        # its sweep must not reclaim the committing step's tmp dir.
+        coord.begin_save(step + 1, num_shards=1, epoch=0)
+        assert os.path.isdir(layout.tmp_dir(c_root, step)), \
+            "stale-tmp sweep reclaimed a committing step's tmp dir"
+        return real_commit(c_root, step, manifests, extra=extra)
+
+    monkeypatch.setattr(layout, "commit_step_dir", commit_with_interleaved_save)
+    w = ShardWriter(coord, 0, 1, replicate=False)
+    w.save_async(0, _tree(1.0)).result(timeout=30)
+    w.close()
+    monkeypatch.undo()
+    assert coord.latest_committed() == 0
+    final = layout.final_dir(root, 0)
+    assert is_committed_dir(final)
+    _assert_trees_equal(restore_pytree(final), _tree(1.0))
+
+
+def test_none_leaf_roundtrips_and_object_leaf_rejected(tmp_path):
+    """Regression: a None leaf became an object-dtype array that np.savez
+    pickled — the save committed, but allow_pickle=False restore could
+    never load it.  None now rides inline in the skeleton doc; any other
+    non-numeric leaf fails the save loudly instead of committing an
+    unrestorable checkpoint."""
+    root = str(tmp_path / "none_ok")
+    coord = CheckpointCoordinator(root, replicate_to_peer=False)
+    tree = {"w": np.ones((4, 2), np.float32), "extra": None,
+            "opt": [None, np.float32(2.0)]}
+    w = ShardWriter(coord, 0, 1, replicate=False)
+    w.save_async(0, tree).result(timeout=30)
+    w.close()
+    restored = restore_latest(root)
+    assert restored["extra"] is None and restored["opt"][0] is None
+    np.testing.assert_allclose(restored["w"], 1.0)
+    np.testing.assert_allclose(restored["opt"][1], 2.0)
+
+    coord2 = CheckpointCoordinator(str(tmp_path / "obj"),
+                                   replicate_to_peer=False)
+    w2 = ShardWriter(coord2, 0, 1, replicate=False)
+    h = w2.save_async(0, {"bad": object()})
+    assert isinstance(h.exception(timeout=30), TypeError)
+    w2.close()
+    assert coord2.latest_committed() is None
+
+
+def test_aborted_set_pruned_after_commit(tmp_path):
+    """Regression: _aborted grew one poison entry per failed save forever.
+    A commit prunes every entry at/below it — writers allocate step ids
+    monotonically, so those steps can never be retried anyway."""
+    root = str(tmp_path)
+    coord = CheckpointCoordinator(root, replicate_to_peer=False)
+    coord.begin_save(0, num_shards=2, epoch=0)
+    coord.shard_failed(0, 0, "disk full", epoch=0)
+    assert coord.stats()["aborted_entries"] == 1
+    w = ShardWriter(coord, 0, 1, replicate=False)
+    w.save_async(1, _tree(1.0)).result(timeout=30)
+    w.close()
+    assert coord.latest_committed() == 1
+    assert coord.stats()["aborted_entries"] == 0
+
+
 TrainState = collections.namedtuple("TrainState", ["w", "count"])
 
 
@@ -367,6 +438,34 @@ def test_checkpoint_manager_rescan_skips_torn_sharded_dirs(tmp_path):
     _assert_trees_equal(latest.to_pytree(), _tree(1.0))
 
 
+def test_manager_register_never_clobbers_committed_sharded_dir(tmp_path):
+    """Regression: manager.register rmtree'd a colliding coordinator-
+    committed dir (the two sides number checkpoint_NNNNNN from independent
+    counters).  It must skip past the committed step instead, and manager
+    retention must leave COMMIT-marked dirs to the coordinator."""
+    from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+
+    storage = str(tmp_path)
+    m = CheckpointManager(storage, num_to_keep=5)
+    # The coordinator commits step 1 into the same path AFTER the
+    # manager's rescan, so the manager's counter is still 0.
+    coord = CheckpointCoordinator(storage, replicate_to_peer=False)
+    w = ShardWriter(coord, 0, 1, replicate=False)
+    w.save_async(1, _tree(7.0)).result(timeout=30)
+    w.close()
+    committed = layout.final_dir(storage, 1)
+    assert is_committed_dir(committed)
+
+    src = tempfile.mkdtemp()
+    with open(os.path.join(src, "data.json"), "w") as f:
+        json.dump({}, f)
+    managed = m.register(Checkpoint(src), {"score": 1.0})
+    # The committed dir survived; the legacy checkpoint landed past it.
+    assert is_committed_dir(committed)
+    _assert_trees_equal(restore_pytree(committed), _tree(7.0))
+    assert managed.path.endswith("checkpoint_000002")
+
+
 @requires_orbax
 def test_save_pytree_crash_mid_save_preserves_previous(tmp_path, monkeypatch):
     """Satellite regression: save_pytree used to rmtree the old checkpoint
@@ -422,7 +521,7 @@ def test_trainer_async_save_commits_and_resumes(ray_start_regular, tmp_path):
                                                async_save=True)))
     result = trainer.fit()
     assert result.error is None
-    root = os.path.join(storage, "async_ckpt", "checkpoints")
+    root = os.path.join(storage, "async_ckpt", "checkpoints", "sharded")
     assert latest_committed_step(root) == 3
     committed = layout.list_committed_steps(root)
     assert committed == [2, 3]  # retention kept the last 2
