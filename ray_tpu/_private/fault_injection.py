@@ -12,7 +12,9 @@ Enable via config (env RAY_TPU_TESTING_RPC_FAILURE or _system_config):
     testing_rpc_failure = "execute=0.3,process_exec=0.5:4,store_put=0.1"
 Each entry is <point>=<probability>[:<max_failures>]; max_failures caps how
 many times the point fires (unbounded if omitted).  Delays:
-    testing_delay_us = 500   # every point sleeps 500us before evaluating
+    testing_delay_us = 500   # every CONFIGURED point sleeps 500us
+(points with no spec entry skip the delay — unconfigured points on hot
+paths must stay a cheap dict miss).
 
 Serve data/control-plane points (exercised by tests/test_serve_chaos.py):
     serve_route          router dispatch (handle/proxy -> replica pick)
@@ -26,6 +28,16 @@ Checkpoint subsystem points (exercised by tests/test_checkpoint_chaos.py):
     ckpt_commit          coordinator commit phase, before the atomic rename
                          — the step stays uncommitted, restore skips it
     ckpt_restore         restore entry (restore_pytree) — retryable
+
+Elastic-training points (exercised by tests/test_train_elastic.py and
+scripts/bench_elastic.py):
+    train_worker_run     train worker step boundary (run entry + every
+                         report()) — crashes one worker; the elastic
+                         controller shrinks the group and resumes
+    preempt_node         trainer controller tick — when it fires, a whole
+                         worker-group node is preempted (all its actors
+                         killed + the node removed), simulating a TPU
+                         slice vanishing (autoscaler.elastic.simulate_preemption)
 
 Deterministic across runs for a fixed RAY_TPU_TESTING_CHAOS_SEED.
 """
@@ -65,22 +77,28 @@ class FaultInjector:
         return bool(self._points) or self._delay_us > 0
 
     def fires(self, point: str) -> bool:
-        """Evaluate a failure point (consumes budget when it fires)."""
-        if self._delay_us:
-            time.sleep(self._delay_us / 1e6)
-        entry = self._points.get(point)
-        if entry is None:
-            return False
-        prob, budget = entry
+        """Evaluate a failure point (consumes budget when it fires).
+
+        One locked read-evaluate-update; the injected delay applies only
+        to CONFIGURED points (an unconfigured point on a hot path must
+        stay a dict miss, not a sleep) and happens outside the lock so a
+        slow point cannot serialize every other thread's evaluation.
+        """
+        fired = False
+        configured = False
         with self._lock:
-            prob, budget = self._points.get(point, (0.0, 0))
-            if budget is not None and budget <= 0:
-                return False
-            if self._rng.random() >= prob:
-                return False
-            if budget is not None:
-                self._points[point] = (prob, budget - 1)
-            return True
+            entry = self._points.get(point)
+            if entry is not None:
+                configured = True
+                prob, budget = entry
+                if (budget is None or budget > 0) \
+                        and self._rng.random() < prob:
+                    fired = True
+                    if budget is not None:
+                        self._points[point] = (prob, budget - 1)
+        if configured and self._delay_us:
+            time.sleep(self._delay_us / 1e6)
+        return fired
 
     def check(self, point: str) -> None:
         """Raise InjectedFailure if the point fires."""

@@ -4,6 +4,11 @@ from ray_tpu.autoscaler.autoscaler import (
     Monitor,
     NodeTypeConfig,
 )
+from ray_tpu.autoscaler.elastic import (
+    capacity_available,
+    simulate_preemption,
+    worker_capacity,
+)
 from ray_tpu.autoscaler.instance_manager import (
     Instance,
     InstanceManager,
@@ -21,5 +26,6 @@ __all__ = [
     "Autoscaler", "AutoscalerConfig", "Monitor", "NodeTypeConfig",
     "NodeProvider", "FakeNodeProvider", "SubprocessNodeProvider",
     "TPUPodProvider", "Instance", "InstanceManager", "InstanceState",
-    "InstanceStorage",
+    "InstanceStorage", "capacity_available", "simulate_preemption",
+    "worker_capacity",
 ]

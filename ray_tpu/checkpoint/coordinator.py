@@ -63,7 +63,12 @@ class CheckpointCoordinator:
         #: step -> {shard_id: ObjectRef} (refs held here pin the objects)
         self._replicas: Dict[int, Dict[int, Any]] = {}
         self._peer = None
-        self._peer_unavailable = not replicate_to_peer
+        #: monotonic time before which no peer (re)start is attempted —
+        #: inf disables peer replication, 0 means "try on next use".  A
+        #: dead holder (its node preempted) schedules a RETRY instead of
+        #: latching unavailable forever: elastic training outlives any
+        #: one peer node.
+        self._peer_retry_at = float("inf") if not replicate_to_peer else 0.0
         self._sweep_stale_tmp()
 
     # ------------------------------------------------------------ phase 1
@@ -226,7 +231,7 @@ class CheckpointCoordinator:
             try:
                 peer.hold.remote(step, shard_id, {"ref": ref})
             except Exception:
-                self._peer, self._peer_unavailable = None, True
+                self._drop_peer()
 
     def _trim_replicas(self) -> None:
         # Keep the last replica_steps *committed* steps plus anything still
@@ -245,9 +250,35 @@ class CheckpointCoordinator:
             except Exception:
                 pass
 
+    def _drop_peer(self, retry_after_s: float = 5.0) -> None:
+        """Forget a failed/dead peer and schedule a revival attempt."""
+        self._peer = None
+        if self._peer_retry_at != float("inf"):
+            self._peer_retry_at = time.monotonic() + retry_after_s
+
+    def _peer_alive(self) -> bool:
+        """Best-effort liveness of the holder actor (fire-and-forget
+        ``hold`` calls never surface a dead peer on their own)."""
+        peer = self._peer
+        if peer is None:
+            return False
+        try:
+            from ray_tpu._private.runtime import get_runtime
+
+            state = get_runtime().get_actor_state(peer._ray_actor_id)
+        except Exception:
+            return True  # cannot tell — assume alive
+        return state is not None and state.state != "DEAD"
+
     def _ensure_peer(self):
-        if self._peer is not None or self._peer_unavailable:
-            return self._peer
+        if self._peer is not None:
+            if self._peer_alive():
+                return self._peer
+            # The holder's node was preempted out from under it: drop it
+            # and fall through into the revival path immediately.
+            self._drop_peer(retry_after_s=0.0)
+        if time.monotonic() < self._peer_retry_at:
+            return None
         try:
             from ray_tpu.checkpoint.replica import start_peer_holder
 
@@ -255,8 +286,51 @@ class CheckpointCoordinator:
         except Exception:
             self._peer = None
         if self._peer is None:
-            self._peer_unavailable = True
+            # No peer node available right now (single-node cluster, or
+            # capacity preempted away) — retry later, don't latch off.
+            self._drop_peer(retry_after_s=15.0)
+            return None
+        self._mirror_to_peer(self._peer)
         return self._peer
+
+    def _mirror_to_peer(self, peer) -> None:
+        """Seed a fresh holder with every resident replica shard so a
+        revived peer is immediately useful for recovery."""
+        with self._lock:
+            resident = [(step, sid, ref)
+                        for step, shards in self._replicas.items()
+                        for sid, ref in shards.items()]
+        for step, sid, ref in resident:
+            try:
+                peer.hold.remote(step, sid, {"ref": ref})
+            except Exception:
+                self._drop_peer()
+                return
+
+    def peer_payloads(self, step: Optional[int] = None) -> Optional[dict]:
+        """Fetch a full shard-payload set for ``step`` (default: latest
+        committed) from the peer holder — the recovery tier that survives
+        the WRITERS' node dying.  Bounded wait; None when there is no
+        peer, it died, or it holds only a partial set (caller falls back
+        to disk — never hangs)."""
+        peer = self._peer
+        if peer is None:
+            return None
+        if step is None:
+            step = self.latest_committed()
+        if step is None:
+            return None
+        try:
+            import ray_tpu
+
+            payloads = ray_tpu.get(peer.fetch.remote(step), timeout=20)
+        except Exception:
+            self._drop_peer()
+            return None
+        want = self._num_shards_of(step)
+        if want is None or len(payloads) < want:
+            return None
+        return {"step": step, "payloads": payloads}
 
     def replica_refs(self, step: Optional[int] = None) -> Optional[dict]:
         """{"step", "refs": {shard_id: {"ref": ObjectRef}}} for the newest

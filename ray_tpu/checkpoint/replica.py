@@ -28,8 +28,12 @@ class ReplicaHolder:
         import ray_tpu
 
         # Materialize NOW: the point is a copy that outlives the writer's
-        # node, not another pointer into its object store.
-        self._shards[(step, shard_id)] = ray_tpu.get(wrapped_ref["ref"])
+        # node, not another pointer into its object store.  Bounded: if
+        # the writer's node died between register and mirror, fail this
+        # mirror (the coordinator tolerates it) instead of wedging the
+        # holder's mailbox.
+        self._shards[(step, shard_id)] = ray_tpu.get(wrapped_ref["ref"],
+                                                     timeout=30)
 
     def trim(self, keep_steps: List[int]) -> None:
         keep = set(keep_steps)
@@ -46,15 +50,24 @@ class ReplicaHolder:
 
 def _pick_peer_node() -> Optional[str]:
     """A live node other than this one (head, where the coordinator runs
-    by default); None on single-node clusters."""
+    by default), preferring the node hosting the fewest live actors: a
+    holder colocated with a train worker dies in the very preemption it
+    exists to survive, so spread away from the busy worker nodes.  None
+    on single-node clusters."""
     from ray_tpu._private.runtime import get_runtime
 
     runtime = get_runtime()
     head = str(runtime.head_node_id)
-    for n in runtime.scheduler.nodes():
-        if n.alive and str(n.id) != head:
-            return str(n.id)
-    return None
+    load: Dict[str, int] = {}
+    for st in list(runtime._actors.values()):
+        if st.state == "ALIVE" and st.node_id is not None:
+            nid = str(st.node_id)
+            load[nid] = load.get(nid, 0) + 1
+    candidates = [str(n.id) for n in runtime.scheduler.nodes()
+                  if n.alive and str(n.id) != head]
+    if not candidates:
+        return None
+    return min(candidates, key=lambda nid: load.get(nid, 0))
 
 
 def start_peer_holder():

@@ -89,6 +89,28 @@ class GroupManager:
             for key in [k for k in self._actor_ranks if k[0] == group_name]:
                 del self._actor_ranks[key]
 
+    def reform_group(self, group_name: str, world_size: int,
+                     backend: str = "xla",
+                     timeout_s=None) -> XLACollectiveGroup:
+        """Re-form a group at a NEW world size (elastic shrink/grow).
+
+        Atomic under the manager lock: the old group (any size) is
+        destroyed — waking every rank blocked in one of its rendezvous
+        with a destroyed-group error — its stale actor-rank bindings are
+        dropped, and a fresh group of ``world_size`` takes its name.
+        Surviving workers re-bind via init_collective_group with their
+        new ranks.  A no-op create when the name was never materialized,
+        so the trainer can call it unconditionally at attempt start.
+        """
+        with self._lock:
+            group = self._groups.pop(group_name, None)
+            if group is not None:
+                group.destroy()
+            for key in [k for k in self._actor_ranks if k[0] == group_name]:
+                del self._actor_ranks[key]
+        return self.create_group(group_name, world_size, timeout_s=timeout_s,
+                                 backend=backend)
+
 
 _manager = GroupManager()
 
@@ -163,6 +185,17 @@ def destroy_collective_group(group_name: str = "default") -> None:
     _manager.destroy_group(group_name)
 
 
+def reform_collective_group(world_size: int, group_name: str = "default",
+                            backend: str = "xla", timeout_s=None) -> None:
+    """Re-form ``group_name`` at a new world size (ref: elastic training's
+    dynamic world — there is no reference analogue; NCCL groups are
+    fixed-size, XLA groups here are control-plane state we can rebuild).
+    Blocked ranks of the old group are woken with an error; membership
+    re-binds through init_collective_group at the new size."""
+    _manager.reform_group(group_name, world_size, backend=backend,
+                          timeout_s=timeout_s)
+
+
 def get_collective_group(group_name: str = "default") -> XLACollectiveGroup:
     return _manager.get_group(group_name)
 
@@ -228,6 +261,7 @@ def barrier(group_name: str = "default", rank: Optional[int] = None) -> None:
 
 __all__ = [
     "ReduceOp", "init_collective_group", "create_collective_group",
-    "destroy_collective_group", "get_collective_group", "allreduce", "reduce",
+    "destroy_collective_group", "reform_collective_group",
+    "get_collective_group", "allreduce", "reduce",
     "broadcast", "allgather", "reducescatter", "send", "recv", "barrier",
 ]

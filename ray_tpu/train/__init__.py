@@ -1,7 +1,14 @@
 """ray_tpu.train — distributed training library (ref: python/ray/train)."""
 
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager, load_pytree, save_pytree
-from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    ElasticConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.elastic import ElasticDatasetShard, SampleLedger
 from ray_tpu.train.session import (
     get_checkpoint,
     get_context,
@@ -13,7 +20,8 @@ from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
 
 __all__ = [
     "Checkpoint", "CheckpointManager", "CheckpointConfig", "DataParallelTrainer",
-    "FailureConfig", "JaxTrainer", "Result", "RunConfig", "ScalingConfig",
+    "ElasticConfig", "ElasticDatasetShard", "FailureConfig", "JaxTrainer",
+    "Result", "RunConfig", "SampleLedger", "ScalingConfig",
     "get_checkpoint", "get_context", "get_dataset_shard", "load_pytree",
     "report", "save_pytree", "TorchTrainer",
 ]

@@ -29,6 +29,9 @@ class ScalingConfig:
     #: worker actors across nodes); "auto" — processes iff the placement
     #: group's bundles land on worker nodes beyond the head.
     worker_mode: str = "auto"
+    #: Dynamic world size (preemption-tolerant training); None = a lost
+    #: worker restarts the attempt at the SAME world size (legacy).
+    elastic: Optional["ElasticConfig"] = None
 
     def worker_resources(self) -> Dict[str, float]:
         if self.resources_per_worker is not None:
@@ -37,6 +40,31 @@ class ScalingConfig:
         if self.use_tpu:
             res = {"TPU": self.tpus_per_worker}
         return res
+
+
+@dataclass
+class ElasticConfig:
+    """Preemption-tolerant dynamic world size (ROADMAP item 3 — the
+    training-side twin of serve self-healing).
+
+    With ``ScalingConfig(elastic=ElasticConfig(...))`` the Trainer treats
+    ``num_workers`` as a *target*, not a contract: on worker/node loss it
+    shrinks the collective group and mesh to surviving capacity (never
+    below ``min_workers``), elastic-restores the last committed step —
+    preferring the in-memory replica tier — reshards the sample ledger so
+    every not-yet-trained sample lands on exactly one surviving worker,
+    and resumes inside the same ``fit()`` call.  Capacity is re-checked
+    every ``grow_check_period_s``; when it supports more workers again the
+    group grows back at the next checkpoint boundary (never above
+    ``max_workers``, which defaults to ``num_workers``).
+    """
+
+    min_workers: int = 1
+    max_workers: Optional[int] = None
+    grow_check_period_s: float = 2.0
+
+    def resolve_max(self, num_workers: int) -> int:
+        return self.max_workers if self.max_workers is not None else num_workers
 
 
 @dataclass

@@ -76,8 +76,20 @@ class TrainSession:
         self.async_saves_reported = 0
         self.last_save_handle = None
 
+    def current_checkpoint_step(self) -> int:
+        """The checkpoint step the NEXT report() will save as — the step
+        currently being trained.  The elastic sample ledger tags claims
+        with it so a restore knows exactly which claims rolled back."""
+        return self._ckpt_step
+
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Any] = None) -> None:
+        # Chaos: the per-step worker-crash point (also consulted at run()
+        # entry by TrainWorker) — an InjectedFailure here is a worker
+        # dying mid-training, which the elastic controller must survive.
+        from ray_tpu._private import fault_injection
+
+        fault_injection.check("train_worker_run")
         if checkpoint is not None and not isinstance(checkpoint, Checkpoint):
             # A raw pytree: async sharded save when wired, else wrap it in
             # a directory checkpoint so the legacy path still works.

@@ -138,13 +138,22 @@ class TorchTrainer(DataParallelTrainer):
 
     def _run_with_pg(self, pg, run_name: str, group_name: str,
                      manager: CheckpointManager, restore_ckpt,
-                     coordinator=None) -> Dict:
+                     coordinator=None, world=None, ledgers=None) -> Dict:
         # coordinator (async sharded checkpointing) is thread-tier only;
-        # torch workers are process-tier, so it is always None here.
+        # torch workers are process-tier, so it is always None here —
+        # likewise the elastic world/ledgers plumbing (no datasets=, and
+        # ScalingConfig.elastic is rejected for process-tier groups).
         from ray_tpu.exceptions import RayTpuError, TaskError
         from ray_tpu.util.queue import Empty, Queue
 
         scfg = self.scaling_config
+        if scfg.elastic is not None:
+            return {"status": "fatal", "last_metrics": None, "history": [],
+                    "error": ValueError(
+                        "elastic training requires thread-tier workers; "
+                        "TorchTrainer ranks are process-tier (use "
+                        "JaxTrainer with ScalingConfig(worker_mode="
+                        "'threads'))")}
         world = scfg.num_workers
         report_queue = Queue()
         workers = []

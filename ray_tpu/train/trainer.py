@@ -11,7 +11,14 @@ mode) or inside a pjit'd step the user writes against the mesh (mesh mode).
 
 Elastic recovery (ref: v2 FailurePolicy): a worker failure tears down the
 group, and the whole group restarts from the latest registered checkpoint —
-delivered to workers via train.get_checkpoint().
+delivered to workers via train.get_checkpoint().  With
+``ScalingConfig(elastic=ElasticConfig(...))`` the world size itself is
+dynamic: a preemption shrinks the group to surviving capacity, restores the
+last committed step from the in-memory replica tier (disk as the floor),
+reshards the data through the exactly-once sample ledger
+(train/elastic.py) and resumes inside the same fit(); when capacity comes
+back the group grows again at the next checkpoint boundary
+(docs/elastic-training.md).
 
 NOTE on thread workers + JAX: calls into *jitted* functions are thread-safe
 and release the GIL; concurrent *eager* jax ops from many worker threads can
@@ -31,9 +38,12 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu import collective
+from ray_tpu._private import fault_injection
 from ray_tpu.exceptions import RayTpuError, TaskError
+from ray_tpu.train import metrics as train_metrics
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.elastic import ElasticDatasetShard, SampleLedger
 from ray_tpu.train.session import TrainContext, TrainSession, clear_session, init_session
 from ray_tpu.util.placement_group import (
     PlacementGroupSchedulingStrategy,
@@ -47,12 +57,17 @@ class Result:
 
     def __init__(self, metrics: Optional[Dict[str, Any]], checkpoint: Optional[Checkpoint],
                  path: str, error: Optional[BaseException] = None,
-                 metrics_history: Optional[List[Dict[str, Any]]] = None):
+                 metrics_history: Optional[List[Dict[str, Any]]] = None,
+                 elastic_events: Optional[List[Dict[str, Any]]] = None):
         self.metrics = metrics
         self.checkpoint = checkpoint
         self.path = path
         self.error = error
         self.metrics_history = metrics_history or []
+        #: shrink/grow/recovery records from elastic training (empty unless
+        #: ScalingConfig.elastic): type, from_world/to_world, restore_step,
+        #: lost_steps, requeued_samples, recovery_seconds.
+        self.elastic_events = elastic_events or []
 
     def __repr__(self) -> str:
         return f"Result(metrics={self.metrics}, checkpoint={self.checkpoint}, error={self.error})"
@@ -89,6 +104,9 @@ class TrainWorker:
 
     def run(self, train_loop: Callable, loop_config: Optional[Dict[str, Any]],
             session: TrainSession) -> str:
+        # Chaos: a worker dying right at run entry (the other half of the
+        # per-report() consultation in TrainSession.report).
+        fault_injection.check("train_worker_run")
         init_session(session)
         try:
             invoke_train_loop(train_loop, loop_config)
@@ -251,6 +269,11 @@ class DataParallelTrainer:
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
         self.resume_from_checkpoint = resume_from_checkpoint
+        # Elastic recovery clock: set at failure/grow time, observed by
+        # _drain_sessions when the first report of the resumed attempt
+        # lands (kill -> training-resumed latency).
+        self._recovery_t0: Optional[float] = None
+        self._recovery_event: Optional[Dict[str, Any]] = None
 
     # ------------------------------------------------------------------ fit
     def fit(self) -> Result:
@@ -275,29 +298,65 @@ class DataParallelTrainer:
         # <pytree>); restarts restore from its latest committed step.
         coordinator = None
         if ckpt_conf.async_save:
+            from ray_tpu._private.runtime import get_runtime
             from ray_tpu.checkpoint import CheckpointCoordinator
+            from ray_tpu.util.scheduling_strategies import (
+                NodeAffinitySchedulingStrategy,
+            )
 
             # The coordinator owns its own subdirectory: it and the legacy
             # CheckpointManager assign checkpoint_NNNNNN names from
             # independent counters, so sharing one directory would let
             # either side clobber or retention-delete the other's dirs.
-            coordinator = ray_tpu.remote(CheckpointCoordinator).remote(
+            # Pinned to the head node (where this controller lives): a
+            # preempted worker node must never take the commit authority
+            # with it — elastic recovery asks it what step to restore.
+            coordinator = ray_tpu.remote(CheckpointCoordinator).options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    str(get_runtime().head_node_id), soft=True),
+            ).remote(
                 os.path.join(experiment_path, "checkpoints", "sharded"),
                 keep=ckpt_conf.num_to_keep,
                 replica_steps=ckpt_conf.replica_memory_steps)
 
+        scfg = self.scaling_config
+        elastic = scfg.elastic
+        cur_world = scfg.num_workers
+        elastic_events: List[Dict[str, Any]] = []
+        self._recovery_t0 = None
+        self._recovery_event = None
+        # Elastic data plane: every sized dataset becomes a shared
+        # exactly-once ledger that outlives individual attempts — exclusive
+        # claiming IS the reshard (see train/elastic.py).  Streaming
+        # datasets keep the legacy per-world split.
+        ledgers: Dict[str, SampleLedger] = {}
+        if elastic is not None:
+            for name, ds in self.datasets.items():
+                if (not hasattr(ds, "streaming_split")
+                        and hasattr(ds, "__len__")
+                        and hasattr(ds, "__getitem__")):
+                    ledgers[name] = SampleLedger(
+                        ds, seal_on_claim=coordinator is None)
+        #: exposed for inspection (chaos tests assert the per-sample
+        #: exactly-once ledger after fit() returns)
+        self.sample_ledgers = ledgers
+
         max_failures = self.run_config.failure_config.max_failures
         failures = 0
         restore_ckpt = self.resume_from_checkpoint
+        last_restore_step: Optional[int] = None
         last_error: Optional[BaseException] = None
         history: List[Dict[str, Any]] = []
 
         try:
             while True:
                 outcome = self._run_attempt(run_name, manager, restore_ckpt,
-                                            experiment_path, coordinator)
+                                            experiment_path, coordinator,
+                                            world=cur_world, ledgers=ledgers)
                 history.extend(outcome["history"])
                 if outcome["status"] == "finished":
+                    for ledger in ledgers.values():
+                        ledger.seal_all()  # clean finish: nothing rolls back
                     return Result(
                         metrics=outcome["last_metrics"],
                         checkpoint=(manager.latest_checkpoint()
@@ -308,13 +367,79 @@ class DataParallelTrainer:
                         # succeeded but the run has no usable checkpoint.
                         error=outcome["error"],
                         metrics_history=history,
+                        elastic_events=elastic_events,
                     )
+                if outcome["status"] == "grow":
+                    # Capacity came back and every worker stopped cleanly at
+                    # a checkpoint boundary: restore from the committed step
+                    # (its save drained before we got here) and restart the
+                    # attempt at the bigger world.  Not a failure.
+                    new_world = outcome["new_world"]
+                    restore_ckpt, step = self._elastic_restore_point(
+                        coordinator, manager)
+                    for ledger in ledgers.values():
+                        ledger.rollback(step)
+                    train_metrics.GROW_EVENTS.inc()
+                    event = {"type": "grow", "from_world": cur_world,
+                             "to_world": new_world, "restore_step": step,
+                             "time": time.time()}
+                    elastic_events.append(event)
+                    self._recovery_t0 = time.monotonic()
+                    self._recovery_event = event
+                    cur_world = new_world
+                    if step is not None:
+                        last_restore_step = step
+                    continue
                 last_error = outcome["error"]
-                failures += 1
+                fatal = outcome["status"] == "fatal"
+                handled = False
+                if not fatal and elastic is not None:
+                    from ray_tpu.autoscaler.elastic import worker_capacity
+
+                    # Shrink (or hold) the world to what the live cluster
+                    # can host, restore the last committed step — memory
+                    # replicas first — and requeue every rolled-back claim.
+                    cap = worker_capacity(scfg.worker_resources())
+                    target = max(elastic.min_workers,
+                                 min(cap, elastic.resolve_max(scfg.num_workers)))
+                    restore_ckpt, step = self._elastic_restore_point(
+                        coordinator, manager)
+                    if restore_ckpt is None:
+                        restore_ckpt = self.resume_from_checkpoint
+                    requeued = sum(ledger.rollback(step)
+                                   for ledger in ledgers.values())
+                    last_step = outcome.get("last_step")
+                    lost = 0
+                    if last_step is not None:
+                        lost = max(0, last_step
+                                   - (step if step is not None else -1))
+                    if lost:
+                        train_metrics.LOST_STEPS.inc(lost)
+                    if target < cur_world:
+                        train_metrics.SHRINK_EVENTS.inc()
+                    event = {"type": "shrink" if target < cur_world else "recover",
+                             "from_world": cur_world, "to_world": target,
+                             "restore_step": step, "lost_steps": lost,
+                             "requeued_samples": requeued, "time": time.time()}
+                    elastic_events.append(event)
+                    self._recovery_t0 = outcome.get("failed_at") or time.monotonic()
+                    self._recovery_event = event
+                    cur_world = target
+                    # A recovery only "handles" the failure when the cluster
+                    # can still run AND the restore point advanced since the
+                    # last one — repeated failures pinned to the same step
+                    # burn max_failures like any other crash loop.
+                    progressed = step is not None and (
+                        last_restore_step is None or step > last_restore_step)
+                    if step is not None:
+                        last_restore_step = step
+                    handled = cap >= elastic.min_workers and progressed
+                if not handled:
+                    failures += 1
                 exhausted = max_failures >= 0 and failures > max_failures
                 # "fatal" = retrying cannot help (e.g. infeasible resources):
                 # return even under max_failures=-1 instead of spinning forever.
-                if exhausted or outcome["status"] == "fatal":
+                if exhausted or fatal:
                     return Result(
                         metrics=outcome["last_metrics"],
                         checkpoint=(manager.latest_checkpoint()
@@ -323,15 +448,19 @@ class DataParallelTrainer:
                         path=experiment_path,
                         error=last_error,
                         metrics_history=history,
+                        elastic_events=elastic_events,
                     )
-                time.sleep(min(2.0 ** min(failures, 5) * 0.1, 5.0))  # restart backoff
-                # Elastic restart from the latest checkpoint (ref: v2
-                # controller RESTARTING state).  The coordinator's committed
-                # step wins — its replica tier restores without re-reading
-                # storage; the legacy manager path is the fallback.
-                restore_ckpt = (self._coordinator_checkpoint(coordinator)
-                                or manager.latest_checkpoint()
-                                or self.resume_from_checkpoint)
+                if elastic is not None:
+                    time.sleep(0.05)  # resume fast — recovery latency is the product
+                else:
+                    time.sleep(min(2.0 ** min(failures, 5) * 0.1, 5.0))  # restart backoff
+                    # Restart from the latest checkpoint (ref: v2 controller
+                    # RESTARTING state).  The coordinator's committed step
+                    # wins — its replica tier restores without re-reading
+                    # storage; the legacy manager path is the fallback.
+                    restore_ckpt = (self._coordinator_checkpoint(coordinator)
+                                    or manager.latest_checkpoint()
+                                    or self.resume_from_checkpoint)
         finally:
             if coordinator is not None:
                 try:
@@ -347,7 +476,9 @@ class DataParallelTrainer:
         Prefers the in-memory replica tier (full shard set resident):
         payloads are materialized into a fresh local committed dir, so the
         handle's to_pytree() never touches the original storage — the
-        Gemini-style fast recovery path."""
+        Gemini-style fast recovery path.  When the writers' node died WITH
+        its object store, the peer ReplicaHolder's copies are next; the
+        committed dir on storage is the floor."""
         if coordinator is None:
             return None
         try:
@@ -356,32 +487,131 @@ class DataParallelTrainer:
             return None
         if src is None:
             return None
-        if from_memory and src.get("replicas"):
-            try:
-                from ray_tpu.checkpoint import materialize_from_payloads
-
-                refs = src["replicas"]["refs"]
-                payloads = {int(sid): ray_tpu.get(w["ref"])
-                            for sid, w in refs.items()}
-                local_root = tempfile.mkdtemp(prefix="ray_tpu_ckpt_mem_")
-                path = materialize_from_payloads(local_root, src["step"],
-                                                 payloads)
-                from ray_tpu.checkpoint import metrics as _ckpt_metrics
-
-                _ckpt_metrics.RESTORES.inc(tags={"source": "memory"})
-                return Checkpoint(path)
-            except Exception:
-                pass  # fall back to the committed dir on storage
+        if from_memory:
+            ckpt = (self._materialize_memory(src)
+                    or self._materialize_peer(coordinator, src["step"]))
+            if ckpt is not None:
+                return ckpt
         return Checkpoint(src["path"])
+
+    def _elastic_restore_point(self, coordinator, manager: CheckpointManager):
+        """(checkpoint, step) to resume from after a preemption or grow:
+        memory replicas -> peer holder payloads -> committed dir on disk ->
+        legacy manager checkpoints (step unknown there).  Every remote
+        fetch is bounded, so a dead holder or a lost object-store ref
+        falls through to the next tier instead of hanging the recovery."""
+        if coordinator is not None:
+            try:
+                src = ray_tpu.get(coordinator.restore_source.remote(),
+                                  timeout=30)
+            except Exception:
+                src = None
+            if src is not None:
+                step = src["step"]
+                ckpt = (self._materialize_memory(src)
+                        or self._materialize_peer(coordinator, step))
+                return (ckpt if ckpt is not None
+                        else Checkpoint(src["path"])), step
+        ckpt = manager.latest_checkpoint()
+        return (ckpt, None) if ckpt is not None else (None, None)
+
+    def _materialize_memory(self, src: Dict) -> Optional[Checkpoint]:
+        """Local committed dir built from the object-store replica refs;
+        None when the set is absent or any ref is unfetchable (its pinning
+        node died) within the bound."""
+        if not src.get("replicas"):
+            return None
+        try:
+            from ray_tpu.checkpoint import materialize_from_payloads
+            from ray_tpu.checkpoint import metrics as _ckpt_metrics
+
+            refs = src["replicas"]["refs"]
+            payloads = {int(sid): ray_tpu.get(w["ref"], timeout=20)
+                        for sid, w in refs.items()}
+            local_root = tempfile.mkdtemp(prefix="ray_tpu_ckpt_mem_")
+            path = materialize_from_payloads(local_root, src["step"], payloads)
+            _ckpt_metrics.RESTORES.inc(tags={"source": "memory"})
+            return Checkpoint(path)
+        except Exception:
+            return None
+
+    def _materialize_peer(self, coordinator, step: int) -> Optional[Checkpoint]:
+        """Same, from the ReplicaHolder actor on a peer node — the tier
+        that survives the writers' own node being preempted."""
+        try:
+            res = ray_tpu.get(coordinator.peer_payloads.remote(step),
+                              timeout=30)
+        except Exception:
+            return None
+        if not res:
+            return None
+        try:
+            from ray_tpu.checkpoint import materialize_from_payloads
+            from ray_tpu.checkpoint import metrics as _ckpt_metrics
+
+            payloads = {int(sid): p for sid, p in res["payloads"].items()}
+            local_root = tempfile.mkdtemp(prefix="ray_tpu_ckpt_peer_")
+            path = materialize_from_payloads(local_root, res["step"], payloads)
+            _ckpt_metrics.RESTORES.inc(tags={"source": "peer"})
+            return Checkpoint(path)
+        except Exception:
+            return None
+
+    def _dead_workers(self, workers) -> List[int]:
+        """Ranks whose worker actor is no longer ALIVE (killed or its node
+        preempted)."""
+        from ray_tpu._private.runtime import get_runtime
+
+        runtime = get_runtime()
+        dead = []
+        for rank, w in enumerate(workers):
+            try:
+                state = runtime.get_actor_state(w._ray_actor_id)
+            except Exception:
+                continue
+            if state is None or state.state == "DEAD":
+                # PENDING_CREATION/ALIVE are healthy; RESTARTING resolves
+                # through the actor's own restart FSM, not ours.
+                dead.append(rank)
+        return dead
+
+    def _committed_step(self, coordinator) -> Optional[int]:
+        if coordinator is None:
+            return None
+        try:
+            return ray_tpu.get(coordinator.latest_committed.remote(),
+                               timeout=10)
+        except Exception:
+            return None
+
+    def _preempt_worker_node(self, pg) -> Optional[str]:
+        """The preempt_node chaos hook: take out a whole node hosting
+        worker-group bundles (never the head — the controller lives there)."""
+        from ray_tpu._private.runtime import get_runtime
+        from ray_tpu.autoscaler.elastic import simulate_preemption
+
+        head = str(get_runtime().head_node_id)
+        victim = next((str(n) for n in pg.bundle_node_ids()
+                       if n is not None and str(n) != head), None)
+        return simulate_preemption(victim)
 
     # ---------------------------------------------------------- one attempt
     def _run_attempt(self, run_name: str, manager: CheckpointManager,
                      restore_ckpt: Optional[Checkpoint], experiment_path: str,
-                     coordinator=None) -> Dict:
+                     coordinator=None, world: Optional[int] = None,
+                     ledgers: Optional[Dict[str, SampleLedger]] = None) -> Dict:
         scfg = self.scaling_config
-        world = scfg.num_workers
-        DataParallelTrainer._collective_counter += 1
-        group_name = f"train-{run_name}-{DataParallelTrainer._collective_counter}"
+        if world is None:
+            world = scfg.num_workers
+        if scfg.elastic is not None:
+            # Stable group name + atomic reform: any rank of a preempted
+            # attempt still blocked in a rendezvous wakes with an error,
+            # and the group's world size tracks the elastic world.
+            group_name = f"train-{run_name}"
+            collective.reform_collective_group(world, group_name=group_name)
+        else:
+            DataParallelTrainer._collective_counter += 1
+            group_name = f"train-{run_name}-{DataParallelTrainer._collective_counter}"
 
         # Gang-schedule the worker group via a placement group
         # (ref: backend_executor.py placement group per worker group).
@@ -408,7 +638,8 @@ class DataParallelTrainer:
                             f"for the worker group within 60s (cluster: {total}). "
                             f"Reduce num_workers/resources_per_worker or add nodes.")}
             return self._run_with_pg(pg, run_name, group_name, manager,
-                                     restore_ckpt, coordinator)
+                                     restore_ckpt, coordinator, world=world,
+                                     ledgers=ledgers)
         finally:
             collective.destroy_collective_group(group_name)
             remove_placement_group(pg)
@@ -431,15 +662,27 @@ class DataParallelTrainer:
 
     def _run_with_pg(self, pg, run_name: str, group_name: str,
                      manager: CheckpointManager, restore_ckpt,
-                     coordinator=None) -> Dict:
+                     coordinator=None, world: Optional[int] = None,
+                     ledgers: Optional[Dict[str, SampleLedger]] = None) -> Dict:
         if self._worker_mode(pg) == "processes":
+            if self.scaling_config.elastic is not None:
+                return {"status": "fatal", "last_metrics": None, "history": [],
+                        "error": ValueError(
+                            "elastic training requires thread-tier workers "
+                            "(the sample ledger and replica restore live in "
+                            "the controller's process); use ScalingConfig("
+                            "worker_mode='threads')")}
             # Process-tier workers ship checkpoints by value through the
             # report queue; the async sharded path is thread-tier only.
             return self._run_distributed(pg, run_name, group_name, manager,
                                          restore_ckpt)
         scfg = self.scaling_config
-        world = scfg.num_workers
-        dataset_shards = self._split_datasets(world)
+        elastic = scfg.elastic
+        if world is None:
+            world = scfg.num_workers
+        ledgers = ledgers or {}
+        train_metrics.WORLD_SIZE.set(world)
+        dataset_shards = self._split_datasets(world, exclude=set(ledgers))
         writers: List = []
         epoch = 0
         start_step = 0
@@ -465,6 +708,10 @@ class DataParallelTrainer:
                                    dataset_shards=dataset_shards[rank],
                                    shard_writer=writers[rank] if writers else None,
                                    start_step=start_step)
+            # Elastic datasets are views onto the shared ledger, bound to
+            # THIS session so claims carry its next checkpoint step.
+            for name, ledger in ledgers.items():
+                session.dataset_shards[name] = ElasticDatasetShard(ledger, session)
             sessions.append(session)
             workers.append(
                 TrainWorker.options(
@@ -482,28 +729,124 @@ class DataParallelTrainer:
         history: List[Dict[str, Any]] = []
         last_metrics: Optional[Dict[str, Any]] = None
         pending = list(refs)
+        statuses: List[str] = []
+        injector = fault_injection.get_injector()
+        grow_target: Optional[int] = None
+        desired_max = (elastic.resolve_max(scfg.num_workers)
+                       if elastic is not None else world)
+        last_seal = 0.0
+        last_health = 0.0
+        last_grow_check = time.monotonic()
+        grow_first_exit: Optional[float] = None
+        grow_woke = False
         try:
             while pending:
                 ready, pending = ray_tpu.wait(pending, num_returns=len(pending), timeout=0.05)
                 last_metrics, new_rows = self._drain_sessions(sessions, manager, last_metrics)
                 history.extend(new_rows)
+                now = time.monotonic()
+                # Liveness: a preempted thread-tier worker's actor dies but
+                # its in-flight run() thread does NOT — the ref would never
+                # resolve, so the controller polls actor health itself (the
+                # same signal serve's health machinery uses).
+                if now - last_health >= 0.25:
+                    last_health = now
+                    dead = self._dead_workers(workers)
+                    if dead:
+                        from ray_tpu.exceptions import WorkerCrashedError
+
+                        raise WorkerCrashedError(
+                            f"{len(dead)} train worker(s) died "
+                            f"(ranks {sorted(dead)}; node preempted?)")
+                # Seal provisional ledger claims as the coordinator commits
+                # their steps: sealed samples never requeue on a rollback.
+                if ledgers and coordinator is not None and now - last_seal >= 0.25:
+                    last_seal = now
+                    committed = self._committed_step(coordinator)
+                    if committed is not None:
+                        for ledger in ledgers.values():
+                            ledger.seal(committed)
+                # Chaos: a whole worker node vanishes (TPU slice preempted).
+                if injector.enabled and injector.fires("preempt_node"):
+                    self._preempt_worker_node(pg)
+                # Grow back toward the target world at a checkpoint boundary
+                # once capacity returns (and there is a step to restore —
+                # growing without one would mean training from scratch).
+                if (elastic is not None and grow_target is None
+                        and world < desired_max
+                        and now - last_grow_check >= elastic.grow_check_period_s):
+                    last_grow_check = now
+                    from ray_tpu.autoscaler.elastic import worker_capacity
+
+                    target = min(worker_capacity(scfg.worker_resources()),
+                                 desired_max)
+                    has_restore = (self._committed_step(coordinator) is not None
+                                   or manager.latest_checkpoint() is not None)
+                    if target > world and has_restore:
+                        grow_target = target
+                        # report() IS the checkpoint boundary: each worker
+                        # raises StopIteration there and returns "stopped".
+                        for s in sessions:
+                            s.stop_requested.set()
                 for r in ready:
-                    ray_tpu.get(r)  # raise worker errors here
+                    try:
+                        statuses.append(ray_tpu.get(r))  # raise worker errors
+                    except (TaskError, RayTpuError):
+                        if grow_target is None:
+                            raise
+                        # Interrupted mid-rendezvous by the boundary wake
+                        # below: its uncommitted claims roll back with the
+                        # grow restore, so this is a clean stop.
+                        statuses.append("stopped")
+                # Grow-stop liveness: workers observe the stop at different
+                # lockstep points — one can exit at its report() while a
+                # peer already entered the next collective and now waits on
+                # a partner that will never arrive.  Once anyone has exited,
+                # give the rest a grace window to reach their own boundary,
+                # then wake them by destroying the group (their collective
+                # raises; swallowed as "stopped" above).
+                if grow_target is not None and statuses and pending:
+                    if grow_first_exit is None:
+                        grow_first_exit = now
+                    elif not grow_woke and now - grow_first_exit >= 1.0:
+                        grow_woke = True
+                        try:
+                            collective.get_collective_group(
+                                group_name).destroy()
+                        except ValueError:
+                            pass
             # Final drain after workers exit.
             last_metrics, new_rows = self._drain_sessions(sessions, manager, last_metrics)
             history.extend(new_rows)
             # Async saves still persisting in the background belong to this
-            # run: let them land (and commit) before declaring it finished.
+            # run: let them land (and commit) before declaring it finished —
+            # and, on a grow, before the restore point is chosen.
             for wtr in writers:
                 try:
                     wtr.drain(timeout=120)
                 except Exception:
                     pass
                 wtr.close()
+            if ledgers and coordinator is not None:
+                committed = self._committed_step(coordinator)
+                if committed is not None:
+                    for ledger in ledgers.values():
+                        ledger.seal(committed)
+            # A grow stop can surface two ways: workers that hit report()
+            # raise StopIteration ("stopped"), but workers whose user loop
+            # exits because the ledger fence returned None come back
+            # "finished" — the ledger still holding work distinguishes that
+            # from a genuine end-of-dataset finish.
+            work_left = any(not led.exhausted() for led in ledgers.values())
+            if grow_target is not None and ("stopped" in statuses or work_left):
+                return {"status": "grow", "new_world": grow_target,
+                        "last_metrics": last_metrics, "history": history,
+                        "error": None}
             return {"status": "finished", "last_metrics": last_metrics,
                     "history": history,
                     "error": self._check_async_saves(sessions, coordinator)}
         except (TaskError, RayTpuError) as e:  # worker failed
+            failed_at = time.monotonic()
             for s in sessions:
                 s.stop_requested.set()
             # Wake any worker blocked in a collective rendezvous NOW (the
@@ -524,8 +867,14 @@ class DataParallelTrainer:
             # the restart resumes from the last one registered).
             last_metrics, new_rows = self._drain_sessions(sessions, manager, last_metrics)
             history.extend(new_rows)
+            # Highest step any session reported (its save may or may not
+            # have committed) — the elastic controller's lost-step count is
+            # this minus the restore step.
+            last_step = max((s._ckpt_step - 1 for s in sessions), default=-1)
             return {"status": "failed", "last_metrics": last_metrics,
-                    "history": history, "error": e}
+                    "history": history, "error": e,
+                    "failed_at": failed_at,
+                    "last_step": last_step if last_step >= 0 else None}
 
     # ------------------------------------------------- multi-host attempt
     def _run_distributed(self, pg, run_name: str, group_name: str,
@@ -666,12 +1015,14 @@ class DataParallelTrainer:
     def _drain_sessions(self, sessions: List[TrainSession], manager: CheckpointManager,
                         last_metrics: Optional[Dict[str, Any]]):
         history = []
+        drained = False
         for session in sessions:
             while True:
                 try:
                     item = session.results.get_nowait()
                 except queue.Empty:
                     break
+                drained = True
                 # Metrics history follows rank 0 (the reference's convention),
                 # but checkpoints from ANY rank are registered — a loop where a
                 # non-zero rank carries the checkpoint must not lose progress.
@@ -680,13 +1031,25 @@ class DataParallelTrainer:
                 if item["rank"] == 0:
                     last_metrics = item["metrics"]
                     history.append(item["metrics"])
+        # First report after an elastic recovery = training resumed: close
+        # the kill->resumed clock.
+        if drained and self._recovery_t0 is not None:
+            dt = time.monotonic() - self._recovery_t0
+            train_metrics.RECOVERY_SECONDS.observe(dt)
+            if self._recovery_event is not None:
+                self._recovery_event["recovery_seconds"] = dt
+            self._recovery_t0 = None
+            self._recovery_event = None
         return last_metrics, history
 
-    def _split_datasets(self, world: int) -> List[Dict[str, Any]]:
+    def _split_datasets(self, world: int, exclude=()) -> List[Dict[str, Any]]:
         """Per-rank dataset shards (ref: StreamSplitDataIterator coordinated
-        split for Train ingest, data/_internal/iterator/stream_split_iterator.py:31)."""
+        split for Train ingest, data/_internal/iterator/stream_split_iterator.py:31).
+        Names in ``exclude`` are served by the elastic sample ledger instead."""
         shards: List[Dict[str, Any]] = [{} for _ in range(world)]
         for name, ds in self.datasets.items():
+            if name in exclude:
+                continue
             if hasattr(ds, "streaming_split"):
                 its = ds.streaming_split(world)
                 for rank in range(world):

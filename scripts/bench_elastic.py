@@ -1,0 +1,183 @@
+"""Elastic-training benchmark artifact (ISSUE 6 acceptance): preemption
+recovery latency, lost-step accounting, and the exactly-once reshard
+check, written to BENCH_ELASTIC.json (same accumulate-merge pattern as
+the other scripts/bench_*.py artifacts).
+
+The run drives a JaxTrainer fit() on a virtual cluster (0-CPU head +
+1-CPU worker nodes, thread-tier workers) through a full
+shrink -> grow -> shrink gauntlet of simulated node preemptions, then
+reports:
+
+  * kill -> training-resumed latency per recovery (the elastic event's
+    recovery_seconds: restore + group reform + data reshard up to the
+    first report of the resumed attempt),
+  * lost steps per recovery — **gate: max lost steps <=
+    CheckpointConfig.replica_memory_steps** (the in-memory replica tier
+    bounds rollback; exceeding it means restores fell behind the
+    commit pipeline),
+  * zero-double-train / zero-dropped sample ledger totals and the
+    final-state sum check (exactly-once observed through the model).
+
+Usage: python scripts/bench_elastic.py [--samples 1440] [--kills 3]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+REPLICA_MEMORY_STEPS = 2
+
+
+def _merge_artifact(out_path: str, fields: dict) -> dict:
+    artifact = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                artifact = json.load(f)
+        except Exception:
+            artifact = {}
+    artifact.update(fields)
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+    return artifact
+
+
+def _loop(config):
+    """Lockstep sum loop over the elastic shard (see docs/elastic-training.md):
+    the allreduced claim count ends the loop globally, and the final w is
+    the dataset sum iff every sample contributed exactly once."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ray_tpu import collective, train
+
+    ctx = train.get_context()
+    ckpt = train.get_checkpoint()
+    w, step = 0.0, -1
+    if ckpt is not None:
+        t = ckpt.to_pytree()
+        w, step = float(t["w"]), int(t["step"])
+    shard = train.get_dataset_shard("train")
+    while True:
+        batch = shard.next_batch(2)
+        n = 0 if batch is None else len(batch[0])
+        contrib = 0.0 if batch is None else float(np.sum(batch[1]))
+        vec = np.asarray(collective.allreduce(
+            jnp.asarray([float(n), contrib]),
+            group_name=ctx.collective_group))
+        if vec[0] == 0:
+            break
+        w, step = w + float(vec[1]), step + 1
+        train.report({"step": step, "w": w, "world": ctx.world_size},
+                     checkpoint={"w": jnp.asarray(np.float64(w)),
+                                 "step": jnp.asarray(np.int64(step))})
+        time.sleep(config.get("sleep", 0.04))
+
+
+def run_elastic_gauntlet(samples: int, kills: int) -> dict:
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.autoscaler.elastic import simulate_preemption
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train import (CheckpointConfig, ElasticConfig, FailureConfig,
+                               JaxTrainer, RunConfig, ScalingConfig)
+
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
+    for _ in range(3):
+        cluster.add_node(num_cpus=1)
+
+    data = np.arange(1, samples + 1, dtype=np.float64)
+    storage = tempfile.mkdtemp(prefix="bench_elastic_")
+    trainer = JaxTrainer(
+        _loop,
+        scaling_config=ScalingConfig(
+            num_workers=3, worker_mode="threads",
+            elastic=ElasticConfig(min_workers=1, grow_check_period_s=0.3)),
+        datasets={"train": data},
+        run_config=RunConfig(
+            name="bench", storage_path=storage,
+            checkpoint_config=CheckpointConfig(
+                async_save=True,
+                replica_memory_steps=REPLICA_MEMORY_STEPS),
+            failure_config=FailureConfig(max_failures=2 * kills)))
+
+    box = {}
+    t = threading.Thread(target=lambda: box.update(r=trainer.fit()),
+                         daemon=True)
+    t0 = time.perf_counter()
+    t.start()
+    killed = 0
+    for _ in range(kills):
+        time.sleep(1.4)
+        if simulate_preemption(None) is not None:
+            killed += 1
+        time.sleep(1.0)
+        cluster.add_node(num_cpus=1)
+    t.join(timeout=600)
+    wall_s = time.perf_counter() - t0
+    assert not t.is_alive(), "fit() hung during the preemption gauntlet"
+    r = box["r"]
+    assert r.error is None, r.error
+
+    events = r.elastic_events
+    recoveries = [e for e in events if e["type"] in ("shrink", "recover")]
+    grows = [e for e in events if e["type"] == "grow"]
+    resume = [e["recovery_seconds"] for e in events
+              if e.get("recovery_seconds") is not None]
+    lost = [e.get("lost_steps", 0) for e in recoveries]
+    led = trainer.sample_ledgers["train"]
+    fields = {
+        "elastic_node_kills": killed,
+        "elastic_recoveries": len(recoveries),
+        "elastic_grow_events": len(grows),
+        "elastic_kill_to_resume_mean_s": round(sum(resume) / len(resume), 4)
+        if resume else None,
+        "elastic_kill_to_resume_max_s": round(max(resume), 4)
+        if resume else None,
+        "elastic_lost_steps_max": max(lost) if lost else 0,
+        "elastic_lost_steps_gate": REPLICA_MEMORY_STEPS,
+        "elastic_double_trained": len(led.double_trained()),
+        "elastic_untrained": len(led.untrained()),
+        "elastic_sum_exact": bool(
+            abs(r.metrics["w"] - float(np.sum(data))) < 1e-6),
+        "elastic_final_world": r.metrics["world"],
+        "elastic_total_steps": r.metrics["step"],
+        "elastic_wall_s": round(wall_s, 2),
+        "elastic_samples": samples,
+    }
+    ray_tpu.shutdown()
+
+    # Acceptance gates (ISSUE 6).
+    assert killed >= kills, fields
+    assert recoveries, "no recovery events recorded"
+    assert fields["elastic_lost_steps_max"] <= REPLICA_MEMORY_STEPS, fields
+    assert fields["elastic_double_trained"] == 0, fields
+    assert fields["elastic_untrained"] == 0, fields
+    assert fields["elastic_sum_exact"], fields
+    return fields
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--samples", type=int, default=1440)
+    parser.add_argument("--kills", type=int, default=3)
+    parser.add_argument("--out", default="BENCH_ELASTIC.json")
+    args = parser.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    fields = run_elastic_gauntlet(args.samples, args.kills)
+    artifact = _merge_artifact(args.out, fields)
+    print(json.dumps(artifact, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

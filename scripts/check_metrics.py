@@ -39,6 +39,7 @@ METRIC_MODULES = (
     "ray_tpu.serve.continuous",
     "ray_tpu.serve.deployment_state",
     "ray_tpu.checkpoint.metrics",
+    "ray_tpu.train.metrics",
 )
 
 

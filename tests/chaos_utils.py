@@ -1,0 +1,57 @@
+"""Shared chaos helpers for the serve, checkpoint and train chaos suites.
+
+Two granularities of simulated failure:
+
+* ``kill_one_replica()`` / ``kill_actor_matching()`` — SIGKILL-equivalent
+  on a single actor: one serve replica or one train worker dies, its node
+  survives (the serve self-healing and single-worker-restart paths).
+* ``kill_node()`` — a whole (virtual) node is preempted: every actor
+  hosted there dies no-restart and the node leaves the scheduler in the
+  same stroke, the way a spot TPU slice vanishes.  Backed by
+  ``ray_tpu.autoscaler.elastic.simulate_preemption`` — the same hook the
+  ``preempt_node`` fault point fires inside the elastic trainer.
+"""
+
+from typing import List, Optional
+
+
+def kill_actor_matching(substr: str):
+    """Kill (no restart) the first live actor whose class name contains
+    ``substr``; returns the killed actor id."""
+    from ray_tpu._private.runtime import get_runtime
+
+    runtime = get_runtime()
+    victims = [aid for aid, st in runtime._actors.items()
+               if substr in st.spec.cls.__name__ and st.state == "ALIVE"]
+    assert victims, f"no live actor matching {substr!r} to kill"
+    runtime.kill_actor(victims[0], no_restart=True)
+    return victims[0]
+
+
+def kill_one_replica():
+    """SIGKILL-equivalent: destroy one serve replica actor out from under
+    the controller; returns the killed actor id."""
+    return kill_actor_matching("Replica")
+
+
+def kill_node(node_id: Optional[str] = None,
+              exclude_head: bool = True) -> Optional[str]:
+    """Preempt a whole node (all hosted actors killed + node removed from
+    the scheduler).  ``node_id=None`` picks any live non-head node.
+    Returns the preempted node id, or None when no candidate exists."""
+    from ray_tpu.autoscaler.elastic import simulate_preemption
+
+    return simulate_preemption(node_id, exclude_head=exclude_head)
+
+
+def pg_worker_nodes(pg) -> List[str]:
+    """Non-head node ids hosting the placement group's bundles — the
+    candidate victims for a worker-group preemption."""
+    from ray_tpu._private.runtime import get_runtime
+
+    head = str(get_runtime().head_node_id)
+    out: List[str] = []
+    for n in pg.bundle_node_ids():
+        if n is not None and str(n) != head and str(n) not in out:
+            out.append(str(n))
+    return out

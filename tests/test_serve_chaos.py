@@ -35,17 +35,8 @@ def serve_chaos(request):
     _teardown_chaos()
 
 
-def _kill_one_replica():
-    """SIGKILL-equivalent: destroy one replica actor out from under the
-    controller; returns the killed actor id."""
-    from ray_tpu._private.runtime import get_runtime
+from chaos_utils import kill_one_replica as _kill_one_replica  # noqa: E402
 
-    runtime = get_runtime()
-    replica_ids = [aid for aid, st in runtime._actors.items()
-                   if "Replica" in st.spec.cls.__name__ and st.state == "ALIVE"]
-    assert replica_ids, "no live replica actors to kill"
-    runtime.kill_actor(replica_ids[0], no_restart=True)
-    return replica_ids[0]
 
 
 def test_kill_replica_under_load_recovers_to_target(serve_chaos):
