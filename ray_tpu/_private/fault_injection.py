@@ -9,35 +9,20 @@ runtime's retry machinery treats it as a system fault, not an app error) or
 sleep an injected delay.
 
 Enable via config (env RAY_TPU_TESTING_RPC_FAILURE or _system_config):
-    testing_rpc_failure = "execute=0.3,process_exec=0.5:4,store_put=0.1"
+    testing_rpc_failure = "execute=0.3,process_exec=0.5:4,serve_route=0.1"
 Each entry is <point>=<probability>[:<max_failures>]; max_failures caps how
 many times the point fires (unbounded if omitted).  Delays:
     testing_delay_us = 500   # every CONFIGURED point sleeps 500us
 (points with no spec entry skip the delay — unconfigured points on hot
 paths must stay a cheap dict miss).
 
-Serve data/control-plane points (exercised by tests/test_serve_chaos.py):
-    serve_route          router dispatch (handle/proxy -> replica pick)
-    serve_replica_handle replica request entry (unary handle_request)
-    serve_health_probe   replica check_health (drives UNHEALTHY recovery)
-    serve_long_poll      controller listen_for_change (client must retry)
-
-Checkpoint subsystem points (exercised by tests/test_checkpoint_chaos.py):
-    ckpt_shard_write     shard persist (writer background thread) — kills a
-                         save mid-flight; the pending step aborts
-    ckpt_commit          coordinator commit phase, before the atomic rename
-                         — the step stays uncommitted, restore skips it
-    ckpt_restore         restore entry (restore_pytree) — retryable
-
-Elastic-training points (exercised by tests/test_train_elastic.py and
-scripts/bench_elastic.py):
-    train_worker_run     train worker step boundary (run entry + every
-                         report()) — crashes one worker; the elastic
-                         controller shrinks the group and resumes
-    preempt_node         trainer controller tick — when it fires, a whole
-                         worker-group node is preempted (all its actors
-                         killed + the node removed), simulating a TPU
-                         slice vanishing (autoscaler.elastic.simulate_preemption)
+Every framework failure point is declared in :data:`FAULT_POINTS` below —
+the canonical table cross-referenced by the static analyzer
+(``scripts/analyze.py``, registry-consistency checker): a ``check("x")``
+call site naming an undeclared point fails CI, as does a declared point no
+call site consults.  Tests may still use ad-hoc points against a local
+``FaultInjector`` instance; the registry governs call sites inside
+``ray_tpu/`` only.
 
 Deterministic across runs for a fixed RAY_TPU_TESTING_CHAOS_SEED.
 """
@@ -57,10 +42,39 @@ class InjectedFailure(WorkerCrashedError):
     """Raised by a chaos failure point (transient, retryable)."""
 
 
+#: Canonical registry of framework failure points: name -> where it fires /
+#: what failure it simulates.  The static analyzer enforces consistency both
+#: ways (call site <-> registry); tests/chaos_utils.py and the chaos suites
+#: pick points from this table.
+FAULT_POINTS: Dict[str, str] = {
+    # core runtime (tests/test_chaos.py)
+    "execute": "task execution entry on the worker — generic task crash",
+    "process_exec": "process-actor subprocess exec — actor process dies",
+    # serve data/control plane (tests/test_serve_chaos.py)
+    "serve_route": "router dispatch (handle/proxy -> replica pick)",
+    "serve_replica_handle": "replica request entry (unary handle_request)",
+    "serve_health_probe": "replica check_health (drives UNHEALTHY recovery)",
+    "serve_long_poll": "controller listen_for_change (client must retry)",
+    # checkpoint subsystem (tests/test_checkpoint_chaos.py)
+    "ckpt_shard_write": "shard persist in the writer thread — kills a save "
+                        "mid-flight; the pending step aborts",
+    "ckpt_commit": "coordinator commit phase, before the atomic rename — "
+                   "the step stays uncommitted, restore skips it",
+    "ckpt_restore": "restore entry (restore_pytree) — retryable",
+    # elastic training (tests/test_train_elastic.py, scripts/bench_elastic.py)
+    "train_worker_run": "train worker step boundary (run entry + every "
+                        "report()) — the elastic controller shrinks and "
+                        "resumes",
+    "preempt_node": "trainer controller tick — a whole worker-group node is "
+                    "preempted (actors killed + node removed), simulating a "
+                    "TPU slice vanishing",
+}
+
+
 class FaultInjector:
     def __init__(self, spec: str, delay_us: int = 0, seed: Optional[int] = None):
         #: point -> (probability, remaining_budget or None)
-        self._points: Dict[str, Tuple[float, Optional[int]]] = {}
+        self._points: Dict[str, Tuple[float, Optional[int]]] = {}  # guarded_by: _lock
         self._lock = threading.Lock()
         self._delay_us = delay_us
         if seed is None:
@@ -71,10 +85,15 @@ class FaultInjector:
             prob_s, _, budget_s = rest.partition(":")
             self._points[point.strip()] = (
                 float(prob_s), int(budget_s) if budget_s else None)
+        # The set of configured points is fixed after construction (budgets
+        # decrement but entries never appear/disappear), so enabled-ness is
+        # immutable — precompute it instead of reading _points unlocked on
+        # every hot-path enabled check.
+        self._enabled = bool(self._points) or self._delay_us > 0
 
     @property
     def enabled(self) -> bool:
-        return bool(self._points) or self._delay_us > 0
+        return self._enabled
 
     def fires(self, point: str) -> bool:
         """Evaluate a failure point (consumes budget when it fires).
@@ -106,7 +125,7 @@ class FaultInjector:
             raise InjectedFailure(f"chaos: injected failure at '{point}'")
 
 
-_injector: Optional[FaultInjector] = None
+_injector: Optional[FaultInjector] = None  # guarded_by: _injector_lock
 _injector_lock = threading.Lock()
 
 

@@ -184,6 +184,7 @@ def _materialize_venv(spec, installer: str, config: dict) -> dict:
             uv_bin = shutil.which("uv") if installer == "uv" else None
             try:
                 if uv_bin:
+                    # blocking_ok: build-once cache; the lock exists to serialize concurrent builders
                     subprocess.run([uv_bin, "venv", "--python",
                                     sys.executable,
                                     "--system-site-packages", tmp],
@@ -201,7 +202,7 @@ def _materialize_venv(spec, installer: str, config: dict) -> dict:
                     cmd = [sys.executable, "-m", "pip", "--python",
                            os.path.join(tmp, "bin", "python"), "install",
                            "--no-index", "--find-links", find_links, *pkgs]
-                subprocess.run(cmd, check=True, capture_output=True,
+                subprocess.run(cmd, check=True, capture_output=True,  # blocking_ok: build-once cache, see above
                                text=True, timeout=300)
             except (subprocess.CalledProcessError,
                     subprocess.TimeoutExpired, OSError) as e:

@@ -47,21 +47,21 @@ class CheckpointCoordinator:
         self.replicate_to_peer = replicate_to_peer
         self._lock = threading.RLock()
         #: step -> {"num_shards", "epoch", "done": {shard: manifest}, "t0"}
-        self._pending: Dict[int, Dict[str, Any]] = {}
+        self._pending: Dict[int, Dict[str, Any]] = {}  # guarded_by: _lock
         #: (step, epoch) pairs whose save aborted: a sibling shard arriving
         #: after the abort must not resurrect the pending entry.
-        self._aborted: set = set()
+        self._aborted: set = set()  # guarded_by: _lock
         #: steps whose phase-2 commit is in flight (pending entry already
         #: removed, rename not yet done): the stale-tmp sweep and
         #: shard_failed must treat their .tmp dirs as live.
-        self._committing: set = set()
+        self._committing: set = set()  # guarded_by: _lock
         # Restart-safe: rebuild committed state from disk (the same scan
         # CheckpointManager does) so a driver restart resumes seamlessly.
-        self._committed: List[int] = layout.list_committed_steps(self.root)
-        self._last_commit_time: Optional[float] = None
-        self._epoch = 0
+        self._committed: List[int] = layout.list_committed_steps(self.root)  # guarded_by: _lock
+        self._last_commit_time: Optional[float] = None  # guarded_by: _lock
+        self._epoch = 0  # guarded_by: _lock
         #: step -> {shard_id: ObjectRef} (refs held here pin the objects)
-        self._replicas: Dict[int, Dict[int, Any]] = {}
+        self._replicas: Dict[int, Dict[int, Any]] = {}  # guarded_by: _lock
         self._peer = None
         #: monotonic time before which no peer (re)start is attempted —
         #: inf disables peer replication, 0 means "try on next use".  A
@@ -180,7 +180,7 @@ class CheckpointCoordinator:
         ckpt_metrics.COMMITS.inc()
         ckpt_metrics.COMMIT_SECONDS.observe(time.monotonic() - t0)
 
-    def _apply_retention(self) -> None:
+    def _apply_retention(self) -> None:  # requires_lock: _lock
         if self.keep is None or self.keep <= 0:
             return
         while len(self._committed) > self.keep:
@@ -189,7 +189,7 @@ class CheckpointCoordinator:
                           ignore_errors=True)
             self._replicas.pop(victim, None)
 
-    def _sweep_stale_tmp(self) -> None:
+    def _sweep_stale_tmp(self) -> None:  # requires_lock: _lock
         """Reclaim ``.tmp`` dirs no live pending save owns (crashed saves
         from this or a previous process)."""
         for path in layout.list_stale_tmp_dirs(self.root):
@@ -233,7 +233,7 @@ class CheckpointCoordinator:
             except Exception:
                 self._drop_peer()
 
-    def _trim_replicas(self) -> None:
+    def _trim_replicas(self) -> None:  # requires_lock: _lock
         # Keep the last replica_steps *committed* steps plus anything still
         # pending (its commit may be in flight).
         keep = set(self._committed[-self.replica_steps:]) if self.replica_steps else set()

@@ -15,14 +15,22 @@ recovery, which is what the single-host tests exercise.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional
 
 
 class ReplicaHolder:
-    """Holds materialized shard payloads: (step, shard_id) -> payload."""
+    """Holds materialized shard payloads: (step, shard_id) -> payload.
+
+    Nothing pins this actor to a single-threaded mailbox (tests also use
+    the class directly), so ``_shards`` is lock-protected; the (slow, up
+    to 30s) payload materialization happens *before* taking the lock so a
+    wedged fetch from a dying writer node can't stall every other call.
+    """
 
     def __init__(self):
-        self._shards: Dict[tuple, dict] = {}
+        self._shards: Dict[tuple, dict] = {}  # guarded_by: _lock
+        self._lock = threading.Lock()
 
     def hold(self, step: int, shard_id: int, wrapped_ref: dict) -> None:
         import ray_tpu
@@ -32,20 +40,25 @@ class ReplicaHolder:
         # the writer's node died between register and mirror, fail this
         # mirror (the coordinator tolerates it) instead of wedging the
         # holder's mailbox.
-        self._shards[(step, shard_id)] = ray_tpu.get(wrapped_ref["ref"],
-                                                     timeout=30)
+        payload = ray_tpu.get(wrapped_ref["ref"], timeout=30)
+        with self._lock:
+            self._shards[(step, shard_id)] = payload
 
     def trim(self, keep_steps: List[int]) -> None:
         keep = set(keep_steps)
-        for key in [k for k in self._shards if k[0] not in keep]:
-            del self._shards[key]
+        with self._lock:
+            for key in [k for k in self._shards if k[0] not in keep]:
+                del self._shards[key]
 
     def fetch(self, step: int) -> Dict[int, dict]:
         """All held shard payloads for a step (possibly partial)."""
-        return {sid: p for (s, sid), p in self._shards.items() if s == step}
+        with self._lock:
+            return {sid: p for (s, sid), p in self._shards.items()
+                    if s == step}
 
     def held(self) -> List[tuple]:
-        return sorted(self._shards)
+        with self._lock:
+            return sorted(self._shards)
 
 
 def _pick_peer_node() -> Optional[str]:

@@ -1,0 +1,4 @@
+"""Developer tooling that ships inside the package but never runs on a
+hot path: the static analyzer (``ray_tpu.devtools.analysis``) lives here
+so its checkers can be imported by tests and the ``scripts/analyze.py``
+CLI without a separate install."""

@@ -1,0 +1,30 @@
+"""Framework-aware static analyzer for ray_tpu (``scripts/analyze.py``).
+
+Pure AST + tokenize — never imports the code it analyzes.  Five
+framework-aware checkers (lock-discipline, atomicity,
+blocking-in-handler, registry-consistency, lockstep-divergence) run over
+the package in tier-1 CI; accepted findings live in
+``analysis_baseline.json`` with one-line justifications.  See
+docs/static-analysis.md for the checker catalog and the ``guarded_by``
+annotation convention.
+"""
+
+from ray_tpu.devtools.analysis import baseline, core
+from ray_tpu.devtools.analysis.checkers import (
+    ALL_CHECKERS,
+    CHECKERS_BY_NAME,
+    make_checkers,
+)
+from ray_tpu.devtools.analysis.core import (
+    AnalysisContext,
+    Checker,
+    Finding,
+    analyze_source,
+    run,
+)
+
+__all__ = [
+    "ALL_CHECKERS", "CHECKERS_BY_NAME", "make_checkers",
+    "AnalysisContext", "Checker", "Finding", "analyze_source", "run",
+    "baseline", "core",
+]

@@ -1,0 +1,90 @@
+"""Baseline handling — accepted findings that don't fail the build.
+
+The baseline file (``analysis_baseline.json``) is a JSON list of
+entries::
+
+    [{"key": "lock-discipline:ray_tpu/x.py:Cls.meth:_attr",
+      "reason": "double-checked locking; second read is under the lock"},
+     ...]
+
+Keys are :attr:`core.Finding.key` values — ``check:path:symbol:detail``
+with **no line numbers**, so a baseline survives unrelated edits to the
+file.  Every entry must carry a non-empty ``reason``: the baseline is a
+list of *explained* exceptions, not a dumping ground.  Entries whose key
+no longer matches any finding are *stale* and reported so the file can't
+silently rot.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+from ray_tpu.devtools.analysis import core
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (bad JSON, missing key/reason)."""
+
+
+@dataclass(frozen=True)
+class BaselineEntry:
+    key: str
+    reason: str
+
+
+def load(path: str) -> List[BaselineEntry]:
+    with open(path, "r", encoding="utf-8") as fh:
+        try:
+            raw = json.load(fh)
+        except json.JSONDecodeError as exc:
+            raise BaselineError(f"{path}: invalid JSON: {exc}") from exc
+    if not isinstance(raw, list):
+        raise BaselineError(f"{path}: expected a JSON list of entries")
+    entries = []
+    for i, item in enumerate(raw):
+        if not isinstance(item, dict) or "key" not in item:
+            raise BaselineError(f"{path}: entry {i} missing 'key'")
+        reason = str(item.get("reason", "")).strip()
+        if not reason:
+            raise BaselineError(
+                f"{path}: entry {i} ({item['key']}) has no reason — every "
+                f"baselined finding must be justified")
+        entries.append(BaselineEntry(key=str(item["key"]), reason=reason))
+    return entries
+
+
+def apply(findings: List[core.Finding], entries: List[BaselineEntry]
+          ) -> Tuple[List[core.Finding], List[core.Finding],
+                     List[BaselineEntry]]:
+    """Split findings into (new, baselined) and return stale entries."""
+    by_key: Dict[str, BaselineEntry] = {e.key: e for e in entries}
+    new: List[core.Finding] = []
+    baselined: List[core.Finding] = []
+    matched = set()
+    for f in findings:
+        if f.key in by_key:
+            baselined.append(f)
+            matched.add(f.key)
+        else:
+            new.append(f)
+    stale = [e for e in entries if e.key not in matched]
+    return new, baselined, stale
+
+
+def write(path: str, findings: List[core.Finding],
+          reason: str = "TODO: justify or fix") -> None:
+    """Write a baseline covering ``findings`` (dev convenience; each entry
+    still needs a human-written reason before it should be committed)."""
+    seen = set()
+    entries = []
+    for f in findings:
+        if f.key in seen:
+            continue
+        seen.add(f.key)
+        entries.append({"key": f.key, "reason": reason,
+                        "message": f.message})
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(entries, fh, indent=2, sort_keys=False)
+        fh.write("\n")

@@ -1,0 +1,52 @@
+"""Checker plugin registry.
+
+A checker is any subclass of :class:`ray_tpu.devtools.analysis.core.
+Checker` registered here.  ``scripts/analyze.py --list-checks`` prints
+this table; ``--only``/``--skip`` select by ``name``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+from ray_tpu.devtools.analysis import core
+from ray_tpu.devtools.analysis.checkers.atomicity import AtomicityChecker
+from ray_tpu.devtools.analysis.checkers.blocking import BlockingChecker
+from ray_tpu.devtools.analysis.checkers.lock_discipline import (
+    LockDisciplineChecker,
+)
+from ray_tpu.devtools.analysis.checkers.lockstep import LockstepChecker
+from ray_tpu.devtools.analysis.checkers.registry_consistency import (
+    RegistryConsistencyChecker,
+)
+
+ALL_CHECKERS: List[Type[core.Checker]] = [
+    LockDisciplineChecker,
+    AtomicityChecker,
+    BlockingChecker,
+    RegistryConsistencyChecker,
+    LockstepChecker,
+]
+
+CHECKERS_BY_NAME: Dict[str, Type[core.Checker]] = {
+    c.name: c for c in ALL_CHECKERS
+}
+
+
+def make_checkers(only=None, skip=None) -> List[core.Checker]:
+    """Instantiate the selected checkers (all by default)."""
+    selected = []
+    for cls in ALL_CHECKERS:
+        if only and cls.name not in only:
+            continue
+        if skip and cls.name in skip:
+            continue
+        selected.append(cls())
+    return selected
+
+
+__all__ = [
+    "ALL_CHECKERS", "CHECKERS_BY_NAME", "make_checkers",
+    "LockDisciplineChecker", "AtomicityChecker", "BlockingChecker",
+    "RegistryConsistencyChecker", "LockstepChecker",
+]

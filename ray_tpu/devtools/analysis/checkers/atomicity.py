@@ -1,0 +1,73 @@
+"""Checker 2 — atomicity.
+
+The exact ``FaultInjector.fires()`` bug shape from PR 6: a guarded
+attribute is READ under one lock acquisition and WRITTEN under a *later,
+separate* acquisition in the same method.  Between the two critical
+sections another thread can interleave, so the write clobbers state the
+read no longer describes — a read-modify-write torn across lock windows.
+
+Only read→write across regions is flagged (write/write is a plain
+last-writer-wins publish, and write→read is not an RMW); mutator calls
+(``.pop``/``.add``/...) count as writes only, so the deliberate
+handoff-in-two-sections idiom (add under lock A, discard under lock B,
+as in ``CheckpointCoordinator.shard_complete``) stays clean.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Tuple
+
+from ray_tpu.devtools.analysis import core, locks
+
+
+class AtomicityChecker(core.Checker):
+    name = "atomicity"
+    description = ("read-modify-write of guarded state split across "
+                   "separate lock acquisitions in one method")
+
+    def check_module(self, module: core.SourceModule,
+                     ctx: core.AnalysisContext) -> Iterator[core.Finding]:
+        guards = core.collect_guards(module)
+        if not guards.class_guards and not guards.module_guards:
+            return
+        for scan in locks.iter_function_scans(module.tree,
+                                              guards.requires_lock):
+            if scan.is_init:
+                continue
+            cls = scan.symbol.rsplit(".", 2)[0] if "." in scan.symbol else None
+            attr_guards = guards.class_guards.get(cls, {}) if cls else {}
+            #: (owner, name) -> (reads: [(region, line)], writes: [...])
+            per_attr: Dict[Tuple[str, str],
+                           Tuple[List[Tuple[int, int]],
+                                 List[Tuple[int, int]]]] = {}
+            for acc in scan.accesses:
+                if acc.owner == "self" and acc.name in attr_guards:
+                    token = ("self", attr_guards[acc.name])
+                elif acc.owner == "global" and acc.name in guards.module_guards:
+                    token = ("global", guards.module_guards[acc.name])
+                else:
+                    continue
+                region = acc.region(token)
+                if region is None:
+                    continue  # unlocked access: lock-discipline's finding
+                reads, writes = per_attr.setdefault(
+                    (acc.owner, acc.name), ([], []))
+                (writes if acc.write else reads).append((region, acc.line))
+            for (owner, name), (reads, writes) in per_attr.items():
+                hit = None
+                for r_region, r_line in reads:
+                    for w_region, w_line in writes:
+                        if w_region > r_region:
+                            hit = (r_line, w_line)
+                            break
+                    if hit:
+                        break
+                if hit:
+                    yield core.Finding(
+                        check=self.name, path=module.path, line=hit[1],
+                        symbol=scan.symbol, detail=name,
+                        message=(
+                            f"'{name}' read under one lock acquisition "
+                            f"(line {hit[0]}) and written under a later, "
+                            f"separate one (line {hit[1]}) in {scan.symbol} "
+                            f"— the read-evaluate-update is not atomic"))

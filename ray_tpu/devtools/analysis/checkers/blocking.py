@@ -1,0 +1,85 @@
+"""Checker 3 — blocking-in-handler.
+
+Two disciplines, both learned the hard way:
+
+* **No blocking while holding a lock** — a ``time.sleep`` / ``ray_tpu.get``
+  under ``with self._lock`` serializes every other thread through the
+  sleeper (the reason ``FaultInjector.fires()`` sleeps *outside* its lock
+  and ``ReplicaHolder`` materializes payloads before touching its map).
+* **No sync blocking inside ``async def``** — serve replica handlers run
+  as asyncio tasks on the replica's event loop; a blocking call there
+  stalls every concurrent request on that replica.  Sync user code must
+  ride ``serve/_sync.run_in_executor`` (which this checker deliberately
+  does not flag: handing a *callable* to an executor is the fix, calling
+  it inline is the bug).
+
+``# blocking_ok: <reason>`` on the call line suppresses intentional cases
+(e.g. a bounded get that is the whole point of the method).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ray_tpu.devtools.analysis import core, locks
+
+#: dotted call names that block the calling thread
+BLOCKING_CALLS = frozenset({
+    "time.sleep",
+    "ray_tpu.get", "ray_tpu.wait",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+    "socket.create_connection",
+    "requests.get", "requests.post", "requests.request",
+    "urllib.request.urlopen",
+})
+
+
+def _dotted(func: ast.expr) -> Optional[str]:
+    parts = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class BlockingChecker(core.Checker):
+    name = "blocking-in-handler"
+    description = ("blocking call while holding a lock or inside an "
+                   "async handler")
+
+    def check_module(self, module: core.SourceModule,
+                     ctx: core.AnalysisContext) -> Iterator[core.Finding]:
+        guards = core.collect_guards(module)
+        for scan in locks.iter_function_scans(module.tree,
+                                              guards.requires_lock):
+            for call in scan.calls:
+                name = _dotted(call.node.func)
+                if name is None or name not in BLOCKING_CALLS:
+                    continue
+                if module.marker_near(call.line, "blocking_ok"):
+                    continue
+                if call.holds_any_lock():
+                    held = ", ".join(
+                        (f"self.{n}" if owner == "self" else n)
+                        for (owner, n), _ in call.held)
+                    yield core.Finding(
+                        check=self.name, path=module.path, line=call.line,
+                        symbol=scan.symbol, detail=f"lock:{name}",
+                        message=(f"{scan.symbol} calls blocking {name}() "
+                                 f"while holding {held} — every other "
+                                 f"thread on that lock stalls behind it"))
+                elif scan.is_async:
+                    yield core.Finding(
+                        check=self.name, path=module.path, line=call.line,
+                        symbol=scan.symbol, detail=f"async:{name}",
+                        message=(f"async {scan.symbol} calls blocking "
+                                 f"{name}() inline — it stalls the event "
+                                 f"loop; dispatch via serve/_sync."
+                                 f"run_in_executor or await an async "
+                                 f"equivalent"))

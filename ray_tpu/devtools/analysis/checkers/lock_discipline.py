@@ -1,0 +1,80 @@
+"""Checker 1 — lock-discipline.
+
+Every attribute annotated ``# guarded_by: <lock>`` (and every module
+global annotated the same way) must only be read or written while the
+named lock is held: inside ``with self.<lock>:`` / ``with <lock>:``, or
+between ``<lock>.acquire()`` and ``<lock>.release()``, or in a method
+marked ``# requires_lock: <lock>`` (``*_locked`` names get this for
+free), or in ``__init__`` (the object is not shared yet).
+
+Also enforces the dual: a ``requires_lock`` method must only be *called*
+with the lock held — ``self._foo_locked()`` from an unlocked scope is the
+PR 5 commit/sweep shape (state escaping its lock window through a helper).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_tpu.devtools.analysis import core, locks
+
+
+class LockDisciplineChecker(core.Checker):
+    name = "lock-discipline"
+    description = ("guarded_by-annotated state accessed outside its lock")
+
+    def check_module(self, module: core.SourceModule,
+                     ctx: core.AnalysisContext) -> Iterator[core.Finding]:
+        guards = core.collect_guards(module)
+        if not guards.class_guards and not guards.module_guards:
+            return
+        for scan in locks.iter_function_scans(module.tree,
+                                              guards.requires_lock):
+            if scan.is_init:
+                continue
+            cls = scan.symbol.rsplit(".", 2)[0] if "." in scan.symbol else None
+            attr_guards = guards.class_guards.get(cls, {}) if cls else {}
+            req = guards.requires_lock.get(cls, {}) if cls else {}
+            seen = set()
+            for acc in scan.accesses:
+                if acc.owner == "self" and acc.name in attr_guards:
+                    lock = attr_guards[acc.name]
+                    token = ("self", lock)
+                elif acc.owner == "global" and acc.name in guards.module_guards:
+                    lock = guards.module_guards[acc.name]
+                    token = ("global", lock)
+                else:
+                    continue
+                if acc.holds(token):
+                    continue
+                verb = "written" if acc.write else "read"
+                dedup = (acc.name, acc.line)
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                yield core.Finding(
+                    check=self.name, path=module.path, line=acc.line,
+                    symbol=scan.symbol, detail=acc.name,
+                    message=(f"'{acc.name}' (guarded_by {lock}) {verb} in "
+                             f"{scan.symbol} without holding {lock}"))
+            # Dual: calling a requires_lock helper from an unlocked scope.
+            if not req:
+                continue
+            for call in scan.calls:
+                func = call.node.func
+                if not (isinstance(func, ast.Attribute)
+                        and isinstance(func.value, ast.Name)
+                        and func.value.id == "self"
+                        and func.attr in req):
+                    continue
+                lock = req[func.attr]
+                if call.holds_any_lock() and any(
+                        t == ("self", lock) for t, _ in call.held):
+                    continue
+                yield core.Finding(
+                    check=self.name, path=module.path, line=call.line,
+                    symbol=scan.symbol, detail=f"call:{func.attr}",
+                    message=(f"{scan.symbol} calls {func.attr}() "
+                             f"(requires_lock {lock}) without holding "
+                             f"{lock}"))

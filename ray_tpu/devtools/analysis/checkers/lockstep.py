@@ -1,0 +1,253 @@
+"""Checker 5 — collective lockstep divergence.
+
+Collective ops (``allreduce``/``broadcast``/``barrier``/...) are
+rendezvous points: every rank in the group must reach the same call in
+the same order or the whole group deadlocks.  The dangerous shape is a
+collective reachable under a conditional on *per-worker* state — a
+stop-event, a rank test, an exhausted local shard — with no matching
+collective on the other branch: ranks that take the other branch leave
+their peers blocked in the collective forever (the elastic wind-down
+hang that ``ElasticTrainer`` avoids by fencing at step boundaries and
+destroying the group to wake blocked ranks).
+
+Two shapes are flagged:
+
+* **branch divergence** — ``if <per-worker cond>:`` where the two
+  branches call different (multi)sets of collectives;
+* **loop-exit divergence** — a loop whose body calls a collective and
+  also contains ``break``/``return`` guarded by a per-worker condition
+  placed so the exiting rank skips the collective its peers will sit in.
+
+"Per-worker" is a heuristic on the condition expression: names
+mentioning ``rank``/``stop``/``fence``/``preempt``, ``Event.is_set()``
+calls, or ``x is None`` tests on locally-claimed work (``batch`` /
+``claim`` / ``sample`` names).  Deliberate divergence (e.g. a
+rank-0-only broadcast *source* pattern where the op itself is symmetric)
+is suppressed with ``# lockstep_ok: <reason>`` on the ``if`` line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from ray_tpu.devtools.analysis import core
+
+#: symmetric rendezvous ops — every rank must participate
+COLLECTIVE_OPS = frozenset({
+    "allreduce", "reduce", "broadcast", "allgather",
+    "reducescatter", "reduce_scatter", "barrier",
+})
+
+_PER_WORKER_NAME_HINTS = ("rank", "stop", "fence", "preempt", "shutdown",
+                          "draining", "wind_down")
+_CLAIM_NAME_HINTS = ("batch", "claim", "sample", "item", "work")
+
+
+def _collective_aliases(tree: ast.AST) -> Set[str]:
+    """Receiver names that refer to the ray_tpu.collective module, plus
+    bare op names imported from it."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in ("ray_tpu.collective", "collective"):
+                    aliases.add(alias.asname
+                                or alias.name.split(".")[-1])
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "ray_tpu" or mod.endswith("collective"):
+                for alias in node.names:
+                    if alias.name == "collective":
+                        aliases.add(alias.asname or "collective")
+                    elif mod.endswith("collective") \
+                            and alias.name in COLLECTIVE_OPS:
+                        aliases.add(f"<bare>{alias.asname or alias.name}")
+    return aliases
+
+
+def _collective_op(call: ast.Call, aliases: Set[str]) -> Optional[str]:
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in COLLECTIVE_OPS \
+            and isinstance(func.value, ast.Name) \
+            and func.value.id in aliases:
+        return func.attr
+    if isinstance(func, ast.Name) and f"<bare>{func.id}" in aliases:
+        return func.id
+    return None
+
+
+def _collectives_in(stmts, aliases: Set[str]) -> List[ast.Call]:
+    """Collective calls in a statement list, not descending into nested
+    function/class definitions (those run on their own schedule)."""
+    out: List[ast.Call] = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                continue
+            if isinstance(child, ast.Call) \
+                    and _collective_op(child, aliases) is not None:
+                out.append(child)
+            walk(child)
+
+    for stmt in stmts:
+        if isinstance(stmt, ast.Call) \
+                and _collective_op(stmt, aliases) is not None:
+            out.append(stmt)
+        walk(stmt)
+    return out
+
+
+def _is_per_worker(cond: ast.expr) -> bool:
+    for node in ast.walk(cond):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name is not None \
+                and any(h in name.lower() for h in _PER_WORKER_NAME_HINTS):
+            return True
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "is_set":
+            return True
+        if isinstance(node, ast.Compare) \
+                and any(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in node.ops) \
+                and any(isinstance(c, ast.Constant) and c.value is None
+                        for c in node.comparators):
+            for side in [node.left, *node.comparators]:
+                sname = None
+                if isinstance(side, ast.Name):
+                    sname = side.id
+                elif isinstance(side, ast.Attribute):
+                    sname = side.attr
+                if sname is not None and any(
+                        h in sname.lower() for h in _CLAIM_NAME_HINTS):
+                    return True
+    return False
+
+
+def _exits_in(stmts) -> List[ast.stmt]:
+    """break/return statements in a statement list, not crossing into
+    nested defs or nested loops (an inner loop's break exits that loop)."""
+    out: List[ast.stmt] = []
+
+    def walk(body):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.For, ast.AsyncFor,
+                                 ast.While)):
+                continue
+            if isinstance(stmt, (ast.Break, ast.Return)):
+                out.append(stmt)
+            for attr in ("body", "orelse", "finalbody"):
+                child = getattr(stmt, attr, None)
+                if child:
+                    walk(child)
+            for handler in getattr(stmt, "handlers", ()) or ():
+                walk(handler.body)
+
+    walk(stmts)
+    return out
+
+
+class LockstepChecker(core.Checker):
+    name = "lockstep-divergence"
+    description = ("collective call reachable under per-worker conditional "
+                   "with no matching collective on the other branch")
+
+    def check_module(self, module: core.SourceModule,
+                     ctx: core.AnalysisContext) -> Iterator[core.Finding]:
+        aliases = _collective_aliases(module.tree)
+        if not aliases:
+            return
+        for fn, symbol in self._functions(module.tree):
+            yield from self._check_function(fn, symbol, module, aliases)
+
+    @staticmethod
+    def _functions(tree):
+        def walk(body, prefix):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    symbol = f"{prefix}{stmt.name}" if prefix else stmt.name
+                    yield stmt, symbol
+                    yield from walk(stmt.body, symbol + ".")
+                elif isinstance(stmt, ast.ClassDef):
+                    yield from walk(stmt.body, stmt.name + ".")
+                else:
+                    for attr in ("body", "orelse", "finalbody"):
+                        child = getattr(stmt, attr, None)
+                        if child:
+                            yield from walk(child, prefix)
+                    for handler in getattr(stmt, "handlers", ()) or ():
+                        yield from walk(handler.body, prefix)
+
+        yield from walk(tree.body, "")
+
+    def _check_function(self, fn, symbol: str, module: core.SourceModule,
+                        aliases: Set[str]) -> Iterator[core.Finding]:
+        # ---- branch divergence -------------------------------------------
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                continue
+            if not isinstance(node, ast.If):
+                continue
+            if not _is_per_worker(node.test):
+                continue
+            if module.marker_near(node.lineno, "lockstep_ok"):
+                continue
+            body_ops = sorted(_collective_op(c, aliases)
+                              for c in _collectives_in(node.body, aliases))
+            else_ops = sorted(_collective_op(c, aliases)
+                              for c in _collectives_in(node.orelse, aliases))
+            if body_ops == else_ops or not (body_ops or else_ops):
+                continue
+            taken, skipped = (("then", "else") if body_ops else
+                              ("else", "then"))
+            ops = body_ops or else_ops
+            yield core.Finding(
+                check=self.name, path=module.path, line=node.lineno,
+                symbol=symbol, detail=f"branch:{','.join(sorted(set(ops)))}",
+                message=(f"{symbol}: collective {'/'.join(sorted(set(ops)))} "
+                         f"on the {taken}-branch of a per-worker conditional "
+                         f"(line {node.lineno}) has no matching collective "
+                         f"on the {skipped}-branch — ranks taking the "
+                         f"{skipped}-branch leave peers blocked in the "
+                         f"rendezvous"))
+        # ---- loop-exit divergence ----------------------------------------
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            loop_colls = _collectives_in(loop.body, aliases)
+            if not loop_colls:
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.If) \
+                        or not _is_per_worker(node.test):
+                    continue
+                if module.marker_near(node.lineno, "lockstep_ok"):
+                    continue
+                exits = _exits_in(node.body)
+                if not exits:
+                    continue
+                # Exits that themselves follow a matching collective inside
+                # the guarded branch are the fenced wind-down idiom: every
+                # rank reaches the same collective, then exits together.
+                if _collectives_in(node.body, aliases):
+                    continue
+                coll_line = loop_colls[0].lineno
+                op = _collective_op(loop_colls[0], aliases)
+                yield core.Finding(
+                    check=self.name, path=module.path, line=node.lineno,
+                    symbol=symbol, detail=f"loop-exit:{op}",
+                    message=(f"{symbol}: a rank can exit the loop under a "
+                             f"per-worker condition (line {node.lineno}) "
+                             f"while peers continue into {op}() at line "
+                             f"{coll_line} — exiting rank never joins the "
+                             f"rendezvous; fence the exit at a step "
+                             f"boundary all ranks agree on"))

@@ -1,0 +1,250 @@
+"""Lock-scope tracking shared by the lock-discipline, atomicity and
+blocking-in-handler checkers.
+
+A :class:`FunctionScan` walks one function body tracking which locks are
+held at every expression:
+
+* ``with self._lock:`` / ``with _POOL_LOCK:`` (every ``with`` item whose
+  terminal name contains ``lock``) opens a new *region* — an integer id
+  unique per acquisition, so the atomicity checker can tell two separate
+  critical sections apart;
+* ``self._lock.acquire()`` marks the rest of the enclosing block held,
+  ``release()`` unmarks (the try/finally idiom resolves conservatively:
+  statements after the ``try`` stay "held", which only ever under-reports).
+
+Accesses are classified read vs write: plain ``Store``/``Del`` contexts,
+stores through a subscript (``self._d[k] = v`` writes ``_d``), and calls
+to known container mutators (``.append``/``.pop``/``.add``/...) all count
+as writes; everything else is a read.  Nested ``def``/``class`` bodies are
+scanned as separate functions with *no* inherited locks — a closure
+created under a lock typically runs after it is released (callbacks), so
+inheriting the scope would hide real races.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+#: method names that mutate their receiver container in place
+MUTATORS = frozenset({
+    "append", "appendleft", "add", "clear", "discard", "extend", "insert",
+    "pop", "popleft", "popitem", "remove", "setdefault", "sort", "update",
+    "__setitem__", "__delitem__",
+})
+
+LockToken = Tuple[str, str]  # ("self"|"global", lock name)
+
+
+def _lock_token(expr: ast.expr) -> Optional[LockToken]:
+    """("self", "_lock") for ``self._lock``, ("global", "_POOL_LOCK") for a
+    bare name — only when the terminal name smells like a lock."""
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                and "lock" in expr.attr.lower():
+            return ("self", expr.attr)
+        return None
+    if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+        return ("global", expr.id)
+    return None
+
+
+@dataclass(frozen=True)
+class Access:
+    owner: str  # "self" | "global"
+    name: str   # attribute / global name
+    write: bool
+    line: int
+    #: lock token -> region id for every lock held at this access
+    held: Tuple[Tuple[LockToken, int], ...]
+
+    def holds(self, token: LockToken) -> bool:
+        return any(t == token for t, _ in self.held)
+
+    def region(self, token: LockToken) -> Optional[int]:
+        for t, r in self.held:
+            if t == token:
+                return r
+        return None
+
+
+@dataclass(frozen=True)
+class CallSite:
+    node: ast.Call
+    line: int
+    held: Tuple[Tuple[LockToken, int], ...]
+
+    def holds_any_lock(self) -> bool:
+        return bool(self.held)
+
+
+@dataclass
+class FunctionScan:
+    symbol: str               # "Class.method" or bare function name
+    node: ast.AST
+    is_async: bool
+    is_init: bool
+    entry_lock: Optional[str]  # requires_lock lock name (held at entry)
+    accesses: List[Access] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+
+
+#: entry-region id for requires_lock functions (held before any with-block)
+ENTRY_REGION = 0
+
+
+class _Walker:
+    def __init__(self, scan: FunctionScan):
+        self.scan = scan
+        self._next_region = ENTRY_REGION + 1
+
+    # ------------------------------------------------------------- blocks
+    def walk_function(self) -> None:
+        held: Dict[LockToken, int] = {}
+        if self.scan.entry_lock:
+            held[("self", self.scan.entry_lock)] = ENTRY_REGION
+        self.walk_block(self.scan.node.body, held)
+
+    def walk_block(self, stmts, held: Dict[LockToken, int]) -> None:
+        held = dict(held)
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes are scanned separately, lock-free
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                inner = dict(held)
+                for item in stmt.items:
+                    self.visit_expr(item.context_expr, held)
+                    if item.optional_vars is not None:
+                        self.visit_expr(item.optional_vars, held)
+                    token = _lock_token(item.context_expr)
+                    if token is not None:
+                        inner[token] = self._next_region
+                        self._next_region += 1
+                self.walk_block(stmt.body, inner)
+                continue
+            token_toggle = self._acquire_release(stmt)
+            if token_toggle is not None:
+                token, acquired = token_toggle
+                if acquired:
+                    held[token] = self._next_region
+                    self._next_region += 1
+                else:
+                    held.pop(token, None)
+                continue
+            self._visit_stmt_exprs(stmt, held)
+            for attr in ("body", "orelse", "finalbody"):
+                child = getattr(stmt, attr, None)
+                if child:
+                    self.walk_block(child, held)
+            for handler in getattr(stmt, "handlers", ()) or ():
+                self.walk_block(handler.body, held)
+
+    @staticmethod
+    def _acquire_release(stmt) -> Optional[Tuple[LockToken, bool]]:
+        if not (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)):
+            return None
+        func = stmt.value.func
+        if isinstance(func, ast.Attribute) and func.attr in ("acquire", "release"):
+            token = _lock_token(func.value)
+            if token is not None:
+                return token, func.attr == "acquire"
+        return None
+
+    def _visit_stmt_exprs(self, stmt, held) -> None:
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self.visit_expr(child, held)
+
+    # -------------------------------------------------------- expressions
+    def _emit(self, owner: str, name: str, write: bool, node, held) -> None:
+        self.scan.accesses.append(Access(
+            owner=owner, name=name, write=write, line=node.lineno,
+            held=tuple(sorted(held.items()))))
+
+    def visit_expr(self, node: ast.expr, held: Dict[LockToken, int],
+                   write: bool = False) -> None:
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                self._emit("self", node.attr,
+                           write or isinstance(node.ctx, (ast.Store, ast.Del)),
+                           node, held)
+                return
+            self.visit_expr(node.value, held)
+            return
+        if isinstance(node, ast.Subscript):
+            container_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self.visit_expr(node.value, held, write=container_write)
+            self.visit_expr(node.slice, held)
+            return
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in MUTATORS:
+                self.visit_expr(func.value, held, write=True)
+            else:
+                self.visit_expr(func, held)
+            for arg in node.args:
+                self.visit_expr(arg, held)
+            for kw in node.keywords:
+                self.visit_expr(kw.value, held)
+            self.scan.calls.append(CallSite(
+                node=node, line=node.lineno, held=tuple(sorted(held.items()))))
+            return
+        if isinstance(node, ast.Name):
+            self._emit("global", node.id,
+                       write or isinstance(node.ctx, (ast.Store, ast.Del)),
+                       node, held)
+            return
+        if isinstance(node, ast.Lambda):
+            self.visit_expr(node.body, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.visit_expr(child, held)
+            elif isinstance(child, ast.comprehension):
+                self.visit_expr(child.target, held)
+                self.visit_expr(child.iter, held)
+                for cond in child.ifs:
+                    self.visit_expr(cond, held)
+
+
+def iter_function_scans(tree: ast.AST, requires_lock=None
+                        ) -> Iterator[FunctionScan]:
+    """Scan every function in a module (methods get "Class.method" symbols,
+    nested defs "outer.inner").  ``requires_lock``: {class -> {method ->
+    lock}} from core.collect_guards — those methods start with the lock
+    held (region ENTRY_REGION)."""
+    requires_lock = requires_lock or {}
+
+    def scan_one(fn, symbol: str, cls: Optional[str]) -> Iterator[FunctionScan]:
+        entry = None
+        if cls is not None:
+            entry = requires_lock.get(cls, {}).get(fn.name)
+        scan = FunctionScan(
+            symbol=symbol, node=fn,
+            is_async=isinstance(fn, ast.AsyncFunctionDef),
+            is_init=fn.name in ("__init__", "__new__"),
+            entry_lock=entry)
+        _Walker(scan).walk_function()
+        yield scan
+
+    def walk_body(body, prefix: str, cls: Optional[str]):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                symbol = f"{prefix}{stmt.name}" if prefix else stmt.name
+                yield from scan_one(stmt, symbol, cls)
+                # nested functions inside this one
+                yield from walk_body(stmt.body, symbol + ".", None)
+            elif isinstance(stmt, ast.ClassDef):
+                yield from walk_body(stmt.body, stmt.name + ".", stmt.name)
+            else:
+                # functions defined under if/try at module level
+                for attr in ("body", "orelse", "finalbody"):
+                    child = getattr(stmt, attr, None)
+                    if child:
+                        yield from walk_body(child, prefix, cls)
+                for handler in getattr(stmt, "handlers", ()) or ():
+                    yield from walk_body(handler.body, prefix, cls)
+
+    yield from walk_body(tree.body, "", None)

@@ -45,6 +45,7 @@ def _compile(prefix: str, suffix: str, sources, flags) -> str:
         tmp = out + f".tmp{os.getpid()}"
         cmd = ["g++", "-O2", "-g", "-std=c++17", "-Wall", "-Werror",
                "-pthread", *flags, "-o", tmp, *srcs]
+        # blocking_ok: compile-once cache; the lock exists to serialize builders
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         os.replace(tmp, out)
         for f in os.listdir(_BUILD):

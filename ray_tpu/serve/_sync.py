@@ -20,7 +20,7 @@ from typing import Any, Callable, Optional
 
 #: Fallback pool for call sites with no per-replica executor (e.g. the
 #: batching consumer on a bare event loop in unit tests).
-_DEFAULT_POOL: Optional[ThreadPoolExecutor] = None
+_DEFAULT_POOL: Optional[ThreadPoolExecutor] = None  # guarded_by: _POOL_LOCK
 _POOL_LOCK = threading.Lock()
 
 

@@ -15,7 +15,7 @@ import random
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.serve import metrics as serve_metrics
 from ray_tpu.util import metrics as _metrics
@@ -46,8 +46,8 @@ class PowerOfTwoChoicesReplicaScheduler:
     """
 
     def __init__(self) -> None:
-        self._replicas: List[Dict[str, Any]] = []
-        self._inflight: Dict[str, int] = {}
+        self._replicas: List[Dict[str, Any]] = []  # guarded_by: _lock
+        self._inflight: Dict[str, int] = {}  # guarded_by: _lock
         self._lock = threading.Lock()
 
     def update_replicas(self, replicas: List[Dict[str, Any]]) -> None:
@@ -59,7 +59,8 @@ class PowerOfTwoChoicesReplicaScheduler:
 
     @property
     def num_replicas(self) -> int:
-        return len(self._replicas)
+        with self._lock:
+            return len(self._replicas)
 
     def total_inflight(self) -> int:
         with self._lock:
@@ -70,6 +71,16 @@ class PowerOfTwoChoicesReplicaScheduler:
         with self._lock:
             return sum(int(r.get("max_ongoing_requests") or 0)
                        for r in self._replicas)
+
+    def load(self) -> Tuple[int, int]:
+        """(total inflight, total capacity) as ONE consistent snapshot —
+        reading them through separate acquisitions lets a replica-set
+        update land in between, pairing new capacity with old inflight."""
+        with self._lock:
+            inflight = sum(self._inflight.values())
+            capacity = sum(int(r.get("max_ongoing_requests") or 0)
+                           for r in self._replicas)
+            return inflight, capacity
 
     def on_request_sent(self, replica_id: str) -> None:
         with self._lock:
@@ -191,10 +202,9 @@ class Router:
         max_queued = self._max_queued_requests
         if max_queued < 0:
             return
-        capacity = self._scheduler.total_capacity()
+        inflight, capacity = self._scheduler.load()
         if capacity <= 0:
             return  # no replicas yet: the startup wait path handles this
-        inflight = self._scheduler.total_inflight()
         if inflight >= capacity + max_queued:
             from ray_tpu.serve.exceptions import BackPressureError
 
@@ -221,15 +231,27 @@ class Router:
                         f"No running replicas for {self.deployment_id} after 30s")
                 continue
             rid = replica["replica_id"]
+            # Count the request in flight BEFORE the submit: the reply
+            # callback decrements on completion, and with the increment
+            # after send() a fast reply could decrement first (clamped at
+            # 0), leaving a permanent +1 leak in the queue estimate that
+            # skews replica choice and capacity shedding forever.
+            self._scheduler.on_request_sent(rid)
             try:
                 out = send(replica)
             except ActorDiedError:
+                self._scheduler.on_request_done(rid)  # undo: never sent
                 if not self._scheduler.drop_replica(rid):
                     self._replicas_populated.clear()
                 if time.time() > deadline:
                     raise
                 continue
-            self._scheduler.on_request_sent(rid)
+            except BaseException:
+                # Any other submit failure (injected fault, serialization
+                # error, ...) propagates — but the request was never sent,
+                # so the pre-send count must not leak into the estimate.
+                self._scheduler.on_request_done(rid)
+                raise
             return replica, rid, out
 
     def assign_request(self, method_name: str, *args, **kwargs):

@@ -37,11 +37,11 @@ class SampleLedger:
     def __init__(self, dataset: Sequence, seal_on_claim: bool = False):
         self._dataset = dataset
         self._lock = threading.Lock()
-        self._pending: deque = deque(range(len(dataset)))
+        self._pending: deque = deque(range(len(dataset)))  # guarded_by: _lock
         #: provisional claims in claim order: (step, (idx, ...))
-        self._inflight: List[Tuple[int, Tuple[int, ...]]] = []
+        self._inflight: List[Tuple[int, Tuple[int, ...]]] = []  # guarded_by: _lock
         #: idx -> times sealed (>1 would mean a double-train)
-        self._trained: Dict[int, int] = {}
+        self._trained: Dict[int, int] = {}  # guarded_by: _lock
         self.seal_on_claim = seal_on_claim
 
     def __len__(self) -> int:

@@ -45,6 +45,27 @@ _MASK64 = (1 << 64) - 1
 _GOLDEN = 0x9E3779B97F4A7C15
 
 
+#: Canonical registry of span names the framework opens: name -> what the
+#: span covers.  Entries ending in ``::`` are prefixes for dynamic names
+#: (``f"task::{name}"``).  The static analyzer (registry-consistency
+#: checker) enforces that every span()/record_span call site uses a
+#: registered name and that no registered name is dead — dashboards and
+#: trace queries key on these strings, so a typo'd name is an invisible gap.
+SPAN_REGISTRY: Dict[str, str] = {
+    "submit::": "driver-side task submission (suffix: task name)",
+    "task::": "worker-side task execution (suffix: task name)",
+    "serve.http_request": "proxy: full HTTP request lifetime",
+    "serve.route": "router: replica pick + dispatch",
+    "serve.replica": "replica: user-handler execution",
+    "serve.queue_wait": "batching: enqueue -> batch formation, per request",
+    "serve.batch_execute": "batching: vectorized user call, per request",
+    "serve.stream_emit": "proxy: one streamed chunk emission",
+    "checkpoint.save": "writer: shard serialize + persist",
+    "checkpoint.commit": "coordinator: commit phase up to atomic rename",
+    "checkpoint.restore": "restore_pytree entry",
+}
+
+
 def _new_id64() -> str:
     return f"{_ID_BASE ^ (next(_id_counter) * _GOLDEN & _MASK64):016x}"
 

@@ -1,94 +1,33 @@
 #!/usr/bin/env python
-"""Static lint for ray_tpu's internal metric declarations.
+"""Back-compat shim: the metrics lint now lives in the analyzer.
 
-Imports every module that declares metrics, then walks the process
-registry and fails (exit 1) on:
-
-  * duplicate metric names declared at two different source sites,
-  * metrics with missing/blank help text,
-  * internal metrics whose names are not ``ray_tpu_``/``serve_`` prefixed.
-
-Only metrics declared inside the ray_tpu package are linted (the
-registry is process-global, so user/test metrics share it); the
-declaration site recorded on each Metric tells them apart.
-
-Run directly (``python scripts/check_metrics.py``) or through the
-tier-1 wrapper ``tests/test_metrics_lint.py``.
+The runtime metric lint moved to
+``ray_tpu.devtools.analysis.checkers.registry_consistency``
+(:func:`collect_runtime_metric_violations`), alongside the static
+registry-consistency checker that covers the AST-visible half.  This
+entry point keeps ``python scripts/check_metrics.py`` (and anything
+importing ``collect_violations`` from here) working unchanged.
 """
 
 from __future__ import annotations
 
 import os
 import sys
-from typing import Dict, List
+from typing import List
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO_ROOT not in sys.path:  # runnable from any cwd without installing
     sys.path.insert(0, _REPO_ROOT)
 
-ALLOWED_PREFIXES = ("ray_tpu_", "serve_")
-
-#: Every module that declares internal metrics at import time (module-level
-#: Counter/Gauge/Histogram instances).  Keep in sync with new declarations —
-#: a metric declared in a module not imported here is invisible to the lint.
-METRIC_MODULES = (
-    "ray_tpu._private.metrics_agent",
-    "ray_tpu.serve.metrics",
-    "ray_tpu.serve.router",
-    "ray_tpu.serve.batching",
-    "ray_tpu.serve.continuous",
-    "ray_tpu.serve.deployment_state",
-    "ray_tpu.checkpoint.metrics",
-    "ray_tpu.train.metrics",
+from ray_tpu.devtools.analysis.checkers.registry_consistency import (  # noqa: E402,F401
+    ALLOWED_PREFIXES,
+    METRIC_MODULES,
+    collect_runtime_metric_violations,
 )
 
 
-def _import_metric_modules() -> None:
-    import importlib
-
-    for mod in METRIC_MODULES:
-        importlib.import_module(mod)
-    # The runtime gauges are created lazily on first scrape; force them so
-    # their names/help get linted too.
-    from ray_tpu._private import metrics_agent
-
-    metrics_agent._internal_gauges()
-
-
 def collect_violations() -> List[str]:
-    _import_metric_modules()
-
-    import ray_tpu
-    from ray_tpu.util import metrics as um
-
-    pkg_root = os.path.realpath(os.path.dirname(ray_tpu.__file__))
-    violations: List[str] = []
-    # name -> {declaration file:line} for duplicate detection.  Multiple
-    # *instances* from one site (e.g. a metric built per replica in a loop)
-    # are legal; the same name from two different lines is a conflict.
-    sites_by_name: Dict[str, set] = {}
-
-    for group in um.registry().collect():
-        for metric in group:
-            declared_at = getattr(metric, "_declared_at", "<unknown>")
-            decl_file = declared_at.rsplit(":", 1)[0]
-            if not os.path.realpath(decl_file).startswith(pkg_root + os.sep):
-                continue  # user/test metric sharing the process registry
-            sites_by_name.setdefault(metric.name, set()).add(declared_at)
-            if not (metric._description or "").strip():
-                violations.append(
-                    f"{metric.name}: missing help text ({declared_at})")
-            if not metric.name.startswith(ALLOWED_PREFIXES):
-                violations.append(
-                    f"{metric.name}: internal metric not prefixed with one "
-                    f"of {ALLOWED_PREFIXES} ({declared_at})")
-
-    for name, sites in sorted(sites_by_name.items()):
-        if len(sites) > 1:
-            violations.append(
-                f"{name}: declared at {len(sites)} sites: "
-                + ", ".join(sorted(sites)))
-    return violations
+    return collect_runtime_metric_violations()
 
 
 def main() -> int:

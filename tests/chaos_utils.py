@@ -10,9 +10,22 @@ Two granularities of simulated failure:
   same stroke, the way a spot TPU slice vanishes.  Backed by
   ``ray_tpu.autoscaler.elastic.simulate_preemption`` — the same hook the
   ``preempt_node`` fault point fires inside the elastic trainer.
+
+Probability-driven chaos (``testing_rpc_failure`` specs) should target
+points from the canonical registry — ``fault_point_names()`` below
+re-exports ``ray_tpu._private.fault_injection.FAULT_POINTS``, the one
+table every framework ``check()``/``fires()`` call site is validated
+against by ``scripts/analyze.py`` (registry-consistency checker).
 """
 
 from typing import List, Optional
+
+
+def fault_point_names() -> List[str]:
+    """Registered framework fault points, from the canonical table."""
+    from ray_tpu._private.fault_injection import FAULT_POINTS
+
+    return sorted(FAULT_POINTS)
 
 
 def kill_actor_matching(substr: str):

@@ -1,0 +1,572 @@
+"""Per-checker bad/good fixture pairs for ray_tpu.devtools.analysis.
+
+Every checker gets at least one fixture that MUST flag (the bug shape,
+including the historical ``FaultInjector.fires()`` race from PR 6 and
+the PR 5 commit/sweep helper-escape shape) and a corrected twin that
+MUST stay clean — so a regression in either direction (checker goes
+blind, or checker starts crying wolf on the blessed idiom) fails here.
+"""
+
+import textwrap
+
+import pytest
+
+from ray_tpu.devtools.analysis import analyze_source, core
+from ray_tpu.devtools.analysis.checkers import (
+    AtomicityChecker,
+    BlockingChecker,
+    LockDisciplineChecker,
+    LockstepChecker,
+    RegistryConsistencyChecker,
+)
+
+
+def _run(checker, src, ctx=None):
+    return analyze_source(textwrap.dedent(src), [checker], ctx=ctx)
+
+
+def _checks(findings):
+    return [(f.check, f.detail) for f in findings]
+
+
+# --------------------------------------------------------------------------
+# lock-discipline
+# --------------------------------------------------------------------------
+
+class TestLockDiscipline:
+    def test_unlocked_read_flagged(self):
+        findings = _run(LockDisciplineChecker(), """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._items = []  # guarded_by: _lock
+                    self._lock = threading.Lock()
+
+                def size(self):
+                    return len(self._items)
+            """)
+        assert _checks(findings) == [("lock-discipline", "_items")]
+        assert "without holding _lock" in findings[0].message
+
+    def test_locked_access_clean(self):
+        findings = _run(LockDisciplineChecker(), """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._items = []  # guarded_by: _lock
+                    self._lock = threading.Lock()
+
+                def size(self):
+                    with self._lock:
+                        return len(self._items)
+
+                def add(self, x):
+                    self._lock.acquire()
+                    self._items.append(x)
+                    self._lock.release()
+            """)
+        assert findings == []
+
+    def test_init_exempt_and_requires_lock_honored(self):
+        findings = _run(LockDisciplineChecker(), """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._items = []  # guarded_by: _lock
+                    self._lock = threading.Lock()
+                    self._items.append(0)  # not shared yet
+
+                def _grow_locked(self):
+                    self._items.append(1)
+
+                def _shrink(self):  # requires_lock: _lock
+                    self._items.pop()
+            """)
+        assert findings == []
+
+    def test_unlocked_write_through_subscript_flagged(self):
+        findings = _run(LockDisciplineChecker(), """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._d = {}  # guarded_by: _lock
+                    self._lock = threading.Lock()
+
+                def put(self, k, v):
+                    self._d[k] = v
+            """)
+        assert _checks(findings) == [("lock-discipline", "_d")]
+        assert "written" in findings[0].message
+
+    def test_module_global_guard(self):
+        findings = _run(LockDisciplineChecker(), """
+            import threading
+
+            _CACHE = None  # guarded_by: _CACHE_LOCK
+            _CACHE_LOCK = threading.Lock()
+
+            def get():
+                global _CACHE
+                if _CACHE is None:
+                    with _CACHE_LOCK:
+                        _CACHE = object()
+                return _CACHE
+            """)
+        # Both unlocked reads share one stable key (no line numbers).
+        assert {f.key for f in findings} == {
+            "lock-discipline:<fixture>.py:get:_CACHE"}
+
+    def test_pr5_commit_sweep_shape_helper_called_unlocked(self):
+        # The PR 5 shape: a requires_lock helper (the stale-tmp sweep)
+        # reachable without the lock, so state escapes its lock window.
+        findings = _run(LockDisciplineChecker(), """
+            import threading
+
+            class Coordinator:
+                def __init__(self):
+                    self._pending = {}  # guarded_by: _lock
+                    self._lock = threading.Lock()
+
+                def _sweep(self):  # requires_lock: _lock
+                    self._pending.clear()
+
+                def begin(self):
+                    self._sweep()
+            """)
+        assert ("lock-discipline", "call:_sweep") in _checks(findings)
+
+    def test_pr5_shape_fixed_is_clean(self):
+        findings = _run(LockDisciplineChecker(), """
+            import threading
+
+            class Coordinator:
+                def __init__(self):
+                    self._pending = {}  # guarded_by: _lock
+                    self._lock = threading.Lock()
+
+                def _sweep(self):  # requires_lock: _lock
+                    self._pending.clear()
+
+                def begin(self):
+                    with self._lock:
+                        self._sweep()
+            """)
+        assert findings == []
+
+    def test_inline_ignore_suppresses(self):
+        findings = _run(LockDisciplineChecker(), """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._items = []  # guarded_by: _lock
+                    self._lock = threading.Lock()
+
+                def size(self):
+                    return len(self._items)  # analysis: ignore[lock-discipline] snapshot len is fine
+            """)
+        assert findings == []
+
+    def test_nested_callback_does_not_inherit_lock(self):
+        # A closure created under the lock typically runs after release
+        # (callbacks): its guarded access must still be flagged.
+        findings = _run(LockDisciplineChecker(), """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._n = 0  # guarded_by: _lock
+                    self._lock = threading.Lock()
+
+                def schedule(self, loop):
+                    with self._lock:
+                        def cb():
+                            self._n += 1
+                        loop.call_soon(cb)
+            """)
+        assert ("lock-discipline", "_n") in _checks(findings)
+
+
+# --------------------------------------------------------------------------
+# atomicity — the PR 6 fires() race shape
+# --------------------------------------------------------------------------
+
+FIRES_RACY = """
+    import threading
+
+    class Injector:
+        def __init__(self):
+            self._points = {}  # guarded_by: _lock
+            self._lock = threading.Lock()
+
+        def fires(self, point):
+            with self._lock:
+                entry = self._points.get(point)
+            if entry is None:
+                return False
+            prob, budget = entry
+            fired = budget is None or budget > 0
+            with self._lock:
+                self._points[point] = (prob, budget - 1)
+            return fired
+    """
+
+FIRES_FIXED = """
+    import threading
+
+    class Injector:
+        def __init__(self):
+            self._points = {}  # guarded_by: _lock
+            self._lock = threading.Lock()
+
+        def fires(self, point):
+            with self._lock:
+                entry = self._points.get(point)
+                if entry is None:
+                    return False
+                prob, budget = entry
+                fired = budget is None or budget > 0
+                self._points[point] = (prob, budget - 1)
+            return fired
+    """
+
+
+class TestAtomicity:
+    def test_pr6_fires_race_shape_flagged(self):
+        findings = _run(AtomicityChecker(), FIRES_RACY)
+        assert _checks(findings) == [("atomicity", "_points")]
+        assert "not atomic" in findings[0].message
+
+    def test_fixed_fires_is_clean(self):
+        assert _run(AtomicityChecker(), FIRES_FIXED) == []
+
+    def test_two_section_handoff_idiom_clean(self):
+        # coordinator.shard_complete: add under one acquisition, discard
+        # under a later one — mutator calls are writes only, so this
+        # deliberate handoff must NOT be flagged.
+        findings = _run(AtomicityChecker(), """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._committing = set()  # guarded_by: _lock
+                    self._lock = threading.Lock()
+
+                def handoff(self, step):
+                    with self._lock:
+                        self._committing.add(step)
+                    try:
+                        pass
+                    finally:
+                        with self._lock:
+                            self._committing.discard(step)
+            """)
+        assert findings == []
+
+    def test_read_then_write_same_region_clean(self):
+        findings = _run(AtomicityChecker(), """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._n = 0  # guarded_by: _lock
+                    self._lock = threading.Lock()
+
+                def bump(self):
+                    with self._lock:
+                        self._n = self._n + 1
+            """)
+        assert findings == []
+
+
+# --------------------------------------------------------------------------
+# blocking-in-handler
+# --------------------------------------------------------------------------
+
+class TestBlocking:
+    def test_sleep_under_lock_flagged(self):
+        findings = _run(BlockingChecker(), """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def slow(self):
+                    with self._lock:
+                        time.sleep(1)
+            """)
+        assert _checks(findings) == [("blocking-in-handler",
+                                      "lock:time.sleep")]
+
+    def test_sleep_outside_lock_clean(self):
+        findings = _run(BlockingChecker(), """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def slow(self):
+                    with self._lock:
+                        pass
+                    time.sleep(1)
+            """)
+        assert findings == []
+
+    def test_blocking_get_in_async_handler_flagged(self):
+        findings = _run(BlockingChecker(), """
+            import ray_tpu
+
+            class Replica:
+                async def handle_request(self, ref):
+                    return ray_tpu.get(ref)
+            """)
+        assert _checks(findings) == [("blocking-in-handler",
+                                      "async:ray_tpu.get")]
+        assert "run_in_executor" in findings[0].message
+
+    def test_blocking_ok_marker_suppresses(self):
+        findings = _run(BlockingChecker(), """
+            import threading
+            import subprocess
+
+            _LOCK = threading.Lock()
+
+            def build():
+                with _LOCK:
+                    # blocking_ok: compile-once cache
+                    subprocess.run(["make"])
+            """)
+        assert findings == []
+
+
+# --------------------------------------------------------------------------
+# registry-consistency
+# --------------------------------------------------------------------------
+
+def _registry_ctx():
+    return core.AnalysisContext(
+        fault_points={"execute", "serve_route"},
+        span_names={"serve.route"},
+        span_prefixes=("task::",))
+
+
+class TestRegistryConsistency:
+    def test_undeclared_fault_point_flagged(self):
+        findings = _run(RegistryConsistencyChecker(), """
+            from ray_tpu._private import fault_injection
+
+            def go():
+                fault_injection.check("store_put")
+            """, ctx=_registry_ctx())
+        assert ("registry-consistency", "fault:store_put") in _checks(findings)
+
+    def test_declared_fault_point_clean(self):
+        findings = _run(RegistryConsistencyChecker(), """
+            from ray_tpu._private import fault_injection
+
+            def go():
+                fault_injection.check("execute")
+            """, ctx=_registry_ctx())
+        assert findings == []
+
+    def test_unregistered_span_flagged(self):
+        findings = _run(RegistryConsistencyChecker(), """
+            from ray_tpu.util import tracing
+
+            def go():
+                with tracing.span("serve.rout"):
+                    pass
+            """, ctx=_registry_ctx())
+        assert ("registry-consistency", "span:serve.rout") in _checks(findings)
+
+    def test_fstring_span_needs_prefix_entry(self):
+        ctx = _registry_ctx()
+        bad = _run(RegistryConsistencyChecker(), """
+            from ray_tpu.util import tracing
+
+            def go(name):
+                with tracing.span(f"submit::{name}"):
+                    pass
+            """, ctx=ctx)
+        assert ("registry-consistency", "span:submit::") in _checks(bad)
+        good = _run(RegistryConsistencyChecker(), """
+            from ray_tpu.util import tracing
+
+            def go(name):
+                with tracing.span(f"task::{name}"):
+                    pass
+            """, ctx=_registry_ctx())
+        assert good == []
+
+    def test_metric_prefix_and_duplicates(self):
+        ctx = core.AnalysisContext()
+        findings = _run(RegistryConsistencyChecker(), """
+            from ray_tpu.util.metrics import Counter
+
+            BAD = Counter("my_counter", "help text")
+            OK = Counter("ray_tpu_good_total", "help text")
+            """, ctx=ctx)
+        assert ("registry-consistency",
+                "metric-prefix:my_counter") in _checks(findings)
+        assert all("ray_tpu_good_total" not in d for _, d in _checks(findings))
+
+    def test_runtime_lint_exports_back_compat(self):
+        # scripts/check_metrics.py keeps working as a thin shim.
+        import importlib
+        import os
+        import sys
+
+        scripts_dir = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts")
+        sys.path.insert(0, scripts_dir)
+        try:
+            shim = importlib.import_module("check_metrics")
+            assert callable(shim.collect_violations)
+            assert shim.ALLOWED_PREFIXES == ("ray_tpu_", "serve_")
+            assert "ray_tpu.serve.metrics" in shim.METRIC_MODULES
+        finally:
+            sys.path.remove(scripts_dir)
+
+
+# --------------------------------------------------------------------------
+# lockstep-divergence
+# --------------------------------------------------------------------------
+
+class TestLockstep:
+    def test_branch_divergence_flagged(self):
+        findings = _run(LockstepChecker(), """
+            from ray_tpu import collective
+
+            def step(grads, rank):
+                if rank == 0:
+                    return collective.allreduce(grads, group_name="g")
+                return grads
+            """)
+        assert _checks(findings) == [("lockstep-divergence",
+                                      "branch:allreduce")]
+
+    def test_symmetric_branches_clean(self):
+        findings = _run(LockstepChecker(), """
+            from ray_tpu import collective
+
+            def step(grads, rank):
+                if rank == 0:
+                    return collective.allreduce(grads, group_name="g")
+                else:
+                    return collective.allreduce(grads, group_name="g")
+            """)
+        assert findings == []
+
+    def test_elastic_wind_down_loop_exit_flagged(self):
+        # Mirrors the elastic trainer's grow/stop wind-down: a worker that
+        # sees stop_requested (or an exhausted local shard) leaves the
+        # step loop while surviving peers head into the gradient
+        # allreduce — without a fence they block forever.
+        findings = _run(LockstepChecker(), """
+            from ray_tpu import collective
+
+            def worker_loop(shard, stop_requested, group):
+                while True:
+                    batch = shard.next_batch(32)
+                    if stop_requested.is_set():
+                        break
+                    if batch is None:
+                        break
+                    grads = compute(batch)
+                    collective.allreduce(grads, group_name=group)
+            """)
+        details = [d for c, d in _checks(findings)]
+        assert "loop-exit:allreduce" in details
+
+    def test_fenced_wind_down_clean(self):
+        # The trainer's actual discipline: the exit branch itself runs the
+        # matching collective (all ranks agree at the fence), then leaves.
+        findings = _run(LockstepChecker(), """
+            from ray_tpu import collective
+
+            def worker_loop(shard, stop_requested, group):
+                while True:
+                    batch = shard.next_batch(32)
+                    if stop_requested.is_set():
+                        collective.barrier(group_name=group)
+                        break
+                    grads = compute(batch)
+                    collective.allreduce(grads, group_name=group)
+            """)
+        assert all(d != "loop-exit:allreduce" for _, d in _checks(findings))
+
+    def test_lockstep_ok_marker_suppresses(self):
+        findings = _run(LockstepChecker(), """
+            from ray_tpu import collective
+
+            def broadcast_init(params, rank):
+                # lockstep_ok: source-only fast path; receivers call broadcast via recv helper
+                if rank == 0:
+                    collective.broadcast(params, src_rank=0, group_name="g")
+            """)
+        assert findings == []
+
+    def test_non_collective_receiver_not_flagged(self):
+        # group.allreduce(...) inside the collective package itself (or a
+        # same-named method on some other object) is not a call site of
+        # the module API.
+        findings = _run(LockstepChecker(), """
+            from ray_tpu import collective
+
+            def internal(group, data, rank):
+                if rank == 0:
+                    return group.allreduce(data)
+                return data
+            """)
+        assert findings == []
+
+
+# --------------------------------------------------------------------------
+# stable keys / baseline mechanics
+# --------------------------------------------------------------------------
+
+class TestBaseline:
+    def test_keys_are_line_free(self):
+        src1 = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._items = []  # guarded_by: _lock
+                    self._lock = threading.Lock()
+
+                def size(self):
+                    return len(self._items)
+            """
+        # Same code shifted by unrelated edits above the class.
+        src2 = "\n# a new comment\n\nX = 1\n" + textwrap.dedent(src1)
+        k1 = [f.key for f in _run(LockDisciplineChecker(), src1)]
+        k2 = [f.key for f in analyze_source(src2, [LockDisciplineChecker()])]
+        assert k1 == k2
+
+    def test_baseline_requires_reason(self, tmp_path):
+        from ray_tpu.devtools.analysis import baseline
+
+        p = tmp_path / "b.json"
+        p.write_text('[{"key": "a:b:c:d"}]')
+        with pytest.raises(baseline.BaselineError):
+            baseline.load(str(p))
+
+    def test_baseline_apply_splits_and_detects_stale(self):
+        from ray_tpu.devtools.analysis import baseline
+
+        f = core.Finding(check="c", path="p.py", line=3, symbol="s",
+                         message="m", detail="d")
+        entries = [baseline.BaselineEntry(key=f.key, reason="ok"),
+                   baseline.BaselineEntry(key="gone:x:y:z", reason="old")]
+        new, based, stale = baseline.apply([f], entries)
+        assert new == [] and based == [f]
+        assert [e.key for e in stale] == ["gone:x:y:z"]

@@ -1,0 +1,171 @@
+"""Deterministic regression tests for the races the analyzer surfaced.
+
+Each test pins a concrete fix from the concurrency audit:
+
+* ``Router._dispatch`` counts a request in flight BEFORE the submit (a
+  fast reply's decrement could otherwise run first, clamp at 0, and leak
+  a permanent +1 into the queue estimate) and undoes the count when the
+  submit itself fails on a dead replica.
+* ``ReplicaHolder`` guards its shard map with a lock and materializes
+  the (up to 30s) payload fetch OUTSIDE it, so a wedged hold() cannot
+  stall trim()/fetch()/held().
+* ``PowerOfTwoChoicesReplicaScheduler.num_replicas`` reads under the
+  lock, and ``load()`` returns (inflight, capacity) as one consistent
+  snapshot.
+"""
+
+import threading
+
+import pytest
+
+from ray_tpu.serve.router import PowerOfTwoChoicesReplicaScheduler, Router
+
+
+def _bare_router(scheduler):
+    # Router.__init__ spins up long-poll + metrics machinery against a
+    # controller; the dispatch core under test needs none of it.
+    r = object.__new__(Router)
+    r.deployment_id = "dep"
+    r._scheduler = scheduler
+    r._replicas_populated = threading.Event()
+    r._replicas_populated.set()
+    return r
+
+
+def _replicas(*rids):
+    return [{"replica_id": rid, "max_ongoing_requests": 4, "actor": None}
+            for rid in rids]
+
+
+class TestDispatchInflightAccounting:
+    def test_inflight_counted_before_send(self):
+        sched = PowerOfTwoChoicesReplicaScheduler()
+        sched.update_replicas(_replicas("r1"))
+        router = _bare_router(sched)
+        seen = []
+
+        def send(replica):
+            # The reply callback may run the instant send() returns; the
+            # count must already be there.
+            seen.append(sched.total_inflight())
+            return "ref"
+
+        _, rid, out = router._dispatch(send)
+        assert out == "ref" and rid == "r1"
+        assert seen == [1]
+        assert sched.total_inflight() == 1
+
+    def test_fast_reply_cannot_leak_inflight(self):
+        # The old ordering (increment after send) let the reply's
+        # decrement run first: clamp at 0, then +1 -> permanent leak.
+        sched = PowerOfTwoChoicesReplicaScheduler()
+        sched.update_replicas(_replicas("r1"))
+        router = _bare_router(sched)
+
+        def send(replica):
+            # Simulate the reply landing synchronously inside send —
+            # the most extreme "fast reply" interleaving.
+            sched.on_request_done(replica["replica_id"])
+            return "ref"
+
+        router._dispatch(send)
+        assert sched.total_inflight() == 0  # was 1 with the old ordering
+
+    def test_dead_replica_send_undoes_count_and_retries(self):
+        from ray_tpu.exceptions import ActorDiedError
+
+        sched = PowerOfTwoChoicesReplicaScheduler()
+        sched.update_replicas(_replicas("dead", "live"))
+        # Pre-load "live" so power-of-two-choices deterministically tries
+        # the (less loaded) dead replica first, whatever the sample order.
+        sched.on_request_sent("live")
+        router = _bare_router(sched)
+        attempts = []
+
+        def send(replica):
+            attempts.append(replica["replica_id"])
+            if replica["replica_id"] == "dead":
+                raise ActorDiedError("dead")
+            return "ref"
+
+        _, rid, _ = router._dispatch(send)
+        assert rid == "live"
+        assert attempts == ["dead", "live"]
+        # Only successful dispatches are counted; the dead replica's
+        # aborted send left no residue and the corpse was dropped.
+        assert sched.total_inflight() == 2  # pre-load + this dispatch
+        with sched._lock:
+            assert sched._inflight.get("dead", 0) == 0
+        assert sched.num_replicas == 1
+
+
+class TestSchedulerSnapshots:
+    def test_num_replicas_locked_read(self):
+        sched = PowerOfTwoChoicesReplicaScheduler()
+        assert sched.num_replicas == 0
+        sched.update_replicas(_replicas("a", "b", "c"))
+        assert sched.num_replicas == 3
+
+    def test_load_is_one_consistent_snapshot(self):
+        sched = PowerOfTwoChoicesReplicaScheduler()
+        sched.update_replicas(_replicas("a", "b"))
+        sched.on_request_sent("a")
+        sched.on_request_sent("b")
+        assert sched.load() == (2, 8)
+
+
+class TestReplicaHolderLocking:
+    def test_hold_materializes_outside_lock(self, monkeypatch):
+        """A hold() wedged in the payload fetch must not block readers:
+        the fetch happens before the lock is taken."""
+        import ray_tpu
+        from ray_tpu.checkpoint.replica import ReplicaHolder
+
+        holder = ReplicaHolder()
+        fetch_started = threading.Event()
+        fetch_release = threading.Event()
+
+        def fake_get(ref, timeout=None):
+            fetch_started.set()
+            assert fetch_release.wait(10), "test hung"
+            return {"payload": ref}
+
+        monkeypatch.setattr(ray_tpu, "get", fake_get)
+        t = threading.Thread(target=holder.hold, args=(1, 0, {"ref": "x"}),
+                             daemon=True)
+        t.start()
+        assert fetch_started.wait(10)
+        # While hold() is stuck in the (pre-lock) fetch, every reader and
+        # trim proceeds immediately.
+        assert holder.fetch(1) == {}
+        assert holder.held() == []
+        holder.trim([])
+        fetch_release.set()
+        t.join(10)
+        assert not t.is_alive()
+        assert holder.fetch(1) == {0: {"payload": "x"}}
+
+    def test_concurrent_holds_both_land(self, monkeypatch):
+        import ray_tpu
+        from ray_tpu.checkpoint.replica import ReplicaHolder
+
+        holder = ReplicaHolder()
+        monkeypatch.setattr(ray_tpu, "get",
+                            lambda ref, timeout=None: {"payload": ref})
+        barrier = threading.Barrier(2)
+
+        def hold(shard):
+            barrier.wait(timeout=10)
+            holder.hold(7, shard, {"ref": shard})
+
+        threads = [threading.Thread(target=hold, args=(i,), daemon=True)
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert holder.held() == [(7, 0), (7, 1)]
+        holder.trim([7])
+        assert holder.held() == [(7, 0), (7, 1)]
+        holder.trim([])
+        assert holder.held() == []

@@ -48,6 +48,7 @@ def test_symlog_symexp_inverse():
     np.testing.assert_allclose(np.asarray(symexp(symlog(x))), x, rtol=1e-4)
 
 
+@pytest.mark.slow  # learning soak: minutes-scale on a contended 1-cpu box; cheaper siblings keep tier-1 coverage
 def test_world_model_losses_decrease():
     """The RSSM + heads fit replayed experience: reconstruction and reward
     losses drop substantially over updates on a fixed buffer."""
@@ -114,6 +115,7 @@ def test_dreamerv3_pixel_conv_encoder():
     algo.stop()
 
 
+@pytest.mark.slow  # learning soak: minutes-scale on a contended 1-cpu box; cheaper siblings keep tier-1 coverage
 def test_dreamerv3_learns_linewalk():
     """Learning gate: imagination-trained actor reaches near-optimal
     return (optimal ~0.92; the gate is well above random)."""

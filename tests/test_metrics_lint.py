@@ -1,9 +1,14 @@
-"""Tier-1 wrapper around scripts/check_metrics.py.
+"""Tier-1 wrapper around the runtime metrics lint.
 
-The lint imports every metric-declaring module and fails on duplicate
-metric names, missing help text, or internal metrics that are not
-``ray_tpu_``/``serve_`` prefixed — so a bad declaration breaks CI, not
-the first operator to scrape /metrics.
+The lint lives in ``ray_tpu.devtools.analysis.checkers.
+registry_consistency`` (``collect_runtime_metric_violations``; the
+AST-visible half is the registry-consistency checker run by
+``scripts/analyze.py``): it imports every metric-declaring module and
+fails on duplicate metric names, missing help text, or internal metrics
+that are not ``ray_tpu_``/``serve_`` prefixed — so a bad declaration
+breaks CI, not the first operator to scrape /metrics.
+``scripts/check_metrics.py`` stays as a thin shim; the tests here drive
+the lint through it so the back-compat surface is covered too.
 """
 
 import os
@@ -28,6 +33,18 @@ def _lint():
 def test_internal_metrics_pass_lint():
     check_metrics = _lint()
     assert check_metrics.collect_violations() == []
+
+
+def test_shim_delegates_to_analyzer():
+    from ray_tpu.devtools.analysis.checkers import registry_consistency
+
+    check_metrics = _lint()
+    assert check_metrics.collect_violations \
+        .__module__ == "check_metrics"
+    assert check_metrics.METRIC_MODULES \
+        is registry_consistency.METRIC_MODULES
+    assert check_metrics.collect_violations() == \
+        registry_consistency.collect_runtime_metric_violations()
 
 
 def test_lint_catches_bad_declarations():

@@ -281,6 +281,7 @@ def test_impala_aggregator_actors_pipeline(rt):
     algo.stop()
 
 
+@pytest.mark.slow  # learning soak: minutes-scale on a contended 1-cpu box; cheaper siblings keep tier-1 coverage
 def test_impala_aggregated_learning_improves(rt):
     """The aggregator pipeline must still LEARN (same math, different
     plumbing): CartPole return rises clearly above the ~20 random baseline
@@ -387,6 +388,7 @@ def test_multi_learner_grad_sync_equivalence(rt):
     np.testing.assert_allclose(w1, w2, atol=5e-5)
 
 
+@pytest.mark.slow  # learning soak: minutes-scale on a contended 1-cpu box; cheaper siblings keep tier-1 coverage
 def test_impala_multi_learner_trains(rt):
     """IMPALA with 2 collective-synced learners completes updates and
     improves (ref: impala.py:135-197 multi-learner + BASELINE config 5)."""

@@ -312,6 +312,7 @@ def test_cql_offline_runs_and_penalty_is_conservative(tmp_path):
 
 
 # ---------------------------------------------------------------------- APPO
+@pytest.mark.slow  # learning soak: minutes-scale on a contended 1-cpu box; cheaper siblings keep tier-1 coverage
 def test_appo_learns_cartpole():
     """APPO = IMPALA architecture + PPO clipped surrogate; must learn on
     CartPole within a small budget (ref: appo tuned examples)."""

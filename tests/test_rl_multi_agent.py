@@ -69,6 +69,7 @@ def test_multi_agent_env_runner_routes_by_policy():
     runner.stop()
 
 
+@pytest.mark.slow  # learning soak: minutes-scale on a contended 1-cpu box; cheaper siblings keep tier-1 coverage
 def test_multi_agent_ppo_learns_both_policies():
     algo = _two_policy_config().build_algo()
     best = 0.0

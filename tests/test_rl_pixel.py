@@ -71,6 +71,7 @@ def rt():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow  # learning soak: minutes-scale on a contended 1-cpu box; cheaper siblings keep tier-1 coverage
 def test_pixel_impala_learns(rt):
     """Learning gate: IMPALA with the conv encoder must beat the random
     policy on the (shaped) pixel gridworld — random scores ~0.0-0.07;

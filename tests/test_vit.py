@@ -50,6 +50,7 @@ def test_num_params_matches(tiny):
     assert total == vit.num_params(tiny)
 
 
+@pytest.mark.slow  # learning soak: minutes-scale on a contended 1-cpu box; cheaper siblings keep tier-1 coverage
 def test_learns_separable_classes(tiny):
     """Constant-color images per class: a few steps reach high accuracy."""
     rng = np.random.default_rng(0)
