@@ -68,6 +68,12 @@ FAULT_POINTS: Dict[str, str] = {
     "preempt_node": "trainer controller tick — a whole worker-group node is "
                     "preempted (actors killed + node removed), simulating a "
                     "TPU slice vanishing",
+    # streaming ingest (tests/test_data_ingest.py)
+    "data_ingest_fetch": "block materialization in the ingest stream — the "
+                         "fetch retries (bounded) before surfacing to the "
+                         "training loop",
+    "data_ingest_prefetch": "host->device batch transfer dispatch — retried "
+                            "once before surfacing",
 }
 
 

@@ -79,6 +79,44 @@ def reshard(x, mesh, spec):
     return jax.device_put(np.asarray(x), NamedSharding(mesh, spec))
 
 
+def device_put_batch(batch, sharding=None):
+    """Transfer a dict-of-columns batch host->device, asynchronously.
+
+    jax.device_put dispatches and returns immediately, so a caller can
+    overlap the copy with the step running on the previous batch (the
+    ingest double buffer relies on that).  With a ``sharding`` (a
+    NamedSharding, e.g. ``parallel.mesh.batch_sharding``) numeric columns
+    land already laid out for the step; non-numeric columns (strings,
+    objects) stay on host untouched.  A column of lower rank than the
+    sharding spec (1-D labels next to 2-D tokens) shards its leading
+    axes and replicates the rest — the spec is truncated per column."""
+    import numpy as np
+
+    out = {}
+    for key, col in batch.items():
+        try:
+            arr = col if hasattr(col, "dtype") else np.asarray(col)
+        except Exception:
+            out[key] = col
+            continue
+        if not hasattr(arr, "dtype") or arr.dtype.kind not in "biufc":
+            out[key] = col
+            continue
+        out[key] = jax.device_put(arr, _fit_sharding(sharding, arr.ndim)) \
+            if sharding is not None else jax.device_put(arr)
+    return out
+
+
+def _fit_sharding(sharding, ndim):
+    """Truncate a NamedSharding's PartitionSpec to ``ndim`` axes so one
+    batch sharding serves every column rank in a dict batch."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None or len(spec) <= ndim:
+        return sharding
+    return jax.sharding.NamedSharding(
+        sharding.mesh, jax.sharding.PartitionSpec(*spec[:ndim]))
+
+
 def axis_size(axis_name):
     """lax.axis_size is recent; psum of a constant 1 folds to a static int
     under every version's shard_map/pmap."""

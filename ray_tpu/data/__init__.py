@@ -65,30 +65,48 @@ def from_arrow(table) -> Dataset:
 
 
 def _expand_paths(paths, suffix: str) -> List[str]:
+    """Expand dirs/globs/files into one globally sorted, deduplicated
+    list.  Sorting the final list (not per input) makes the read-task
+    order — and with it block order, splits and shard claims — a pure
+    function of the matched file set: glob order is filesystem-dependent,
+    and overlapping inputs (a dir plus a glob into it) must not read a
+    file twice."""
     if isinstance(paths, str):
         paths = [paths]
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            out.extend(sorted(
+            out.extend(
                 f for f in _glob.glob(os.path.join(p, f"*{suffix}"))
-                if os.path.isfile(f)))
+                if os.path.isfile(f))
         elif "*" in p:
-            out.extend(sorted(f for f in _glob.glob(p) if os.path.isfile(f)))
+            out.extend(f for f in _glob.glob(p) if os.path.isfile(f))
         else:
             if not os.path.exists(p):
                 raise FileNotFoundError(f"Path does not exist: {p}")
             out.append(p)
     if not out:
         raise FileNotFoundError(f"No files matched {paths}")
-    return out
+    return sorted(dict.fromkeys(out))
 
 
-def read_parquet(paths, *, columns: Optional[List[str]] = None) -> Dataset:
-    """(ref: read_api.py:602 read_parquet)"""
+def read_parquet(paths, *, columns: Optional[List[str]] = None,
+                 shards_per_file: int = 1) -> Dataset:
+    """(ref: read_api.py:602 read_parquet)
+
+    ``shards_per_file > 1`` splits each file into that many row-group
+    ranges (data/ingest/readers.py) — reader parallelism beyond file
+    count, clamped to each file's actual row-group count."""
     import pyarrow.parquet as pq
 
     files = _expand_paths(paths, ".parquet")
+    if shards_per_file > 1:
+        from ray_tpu.data.ingest.readers import parquet_range_tasks
+
+        tasks = [t for f in files
+                 for t in parquet_range_tasks(f, shards_per_file,
+                                              columns=columns)]
+        return Dataset(Read(tasks))
 
     def make_task(f: str):
         def read():
@@ -163,13 +181,17 @@ def _looks_like_tfrecord(path: str) -> bool:
     return _masked_crc(header[:8]) == len_crc and length < (1 << 40)
 
 
-def read_tfrecords(paths) -> Dataset:
+def read_tfrecords(paths, *, shards_per_file: int = 1) -> Dataset:
     """tf.train.Example TFRecord files -> one row per example (ref:
     read_api.py read_tfrecords; framing + protos in data/tfrecords.py,
     no TensorFlow dependency).  Directories match ``*.tfrecords`` AND
     TensorFlow's ``*.tfrecord`` convention, falling back to every file in
     the directory (TF shard names often have no extension at all — the
-    reference reads all files regardless of suffix)."""
+    reference reads all files regardless of suffix).
+
+    ``shards_per_file > 1`` splits each file into that many byte ranges
+    resynced at CRC-verified record boundaries (data/ingest/readers.py) —
+    one giant shard no longer serializes the pipeline."""
     files: List[str] = []
     for p in ([paths] if isinstance(paths, str) else list(paths)):
         if os.path.isdir(p):
@@ -206,6 +228,7 @@ def read_tfrecords(paths) -> Dataset:
             files.extend(matched)
         else:
             files.extend(_expand_paths(p, ".tfrecords"))
+    files = sorted(dict.fromkeys(files))  # same determinism as _expand_paths
     if not files:
         raise FileNotFoundError(f"No TFRecord files matched: {paths}")
 
@@ -217,6 +240,12 @@ def read_tfrecords(paths) -> Dataset:
 
         return read
 
+    if shards_per_file > 1:
+        from ray_tpu.data.ingest.readers import tfrecord_range_tasks
+
+        return Dataset(Read([t for f in files
+                             for t in tfrecord_range_tasks(
+                                 f, shards_per_file)]))
     return Dataset(Read([make_task(f) for f in files]))
 
 

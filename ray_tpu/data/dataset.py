@@ -137,12 +137,27 @@ class Dataset:
         return ex.execute(self._op)
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
-                     batch_format: str = "numpy") -> Iterator[Any]:
-        """(ref: iterator.py:94 iter_batches) — streaming, overlaps execution."""
+                     batch_format: str = "numpy",
+                     prefetch_batches: int = 0) -> Iterator[Any]:
+        """(ref: iterator.py:94 iter_batches) — streaming, overlaps execution.
+
+        ``prefetch_batches > 0`` pulls ahead on a background thread
+        (data/ingest/prefetch.py) so block fetch + rebatch latency overlaps
+        the consumer's work."""
         from ray_tpu.data.block import rebatch
 
         blocks = (ray_tpu.get(ref) for ref in self.iter_block_refs())
-        yield from rebatch(blocks, batch_size, batch_format)
+        batches = rebatch(blocks, batch_size, batch_format)
+        if prefetch_batches > 0:
+            from ray_tpu.data.ingest.prefetch import HostPrefetcher
+
+            prefetcher = HostPrefetcher(batches, depth=prefetch_batches)
+            try:
+                yield from prefetcher
+            finally:
+                prefetcher.close()
+            return
+        yield from batches
 
     def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
                            dtypes=None, device: str = "cpu") -> Iterator[Any]:
@@ -436,7 +451,8 @@ class DataIterator:
         self._epoch = 0
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
-                     batch_format: str = "numpy") -> Iterator[Any]:
+                     batch_format: str = "numpy",
+                     prefetch_batches: int = 0) -> Iterator[Any]:
         from ray_tpu.data.block import rebatch
 
         epoch = self._epoch
@@ -455,7 +471,17 @@ class DataIterator:
             finally:
                 self._coord.finished(self._index, epoch)
 
-        yield from rebatch(block_stream(), batch_size, batch_format)
+        batches = rebatch(block_stream(), batch_size, batch_format)
+        if prefetch_batches > 0:
+            from ray_tpu.data.ingest.prefetch import HostPrefetcher
+
+            prefetcher = HostPrefetcher(batches, depth=prefetch_batches)
+            try:
+                yield from prefetcher
+            finally:
+                prefetcher.close()
+            return
+        yield from batches
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for batch in self.iter_batches(batch_size=None):

@@ -58,8 +58,15 @@ class ResourceBudget:
     cap * avg_block regardless of consumer speed."""
 
     def __init__(self, task_cap: int = MAX_IN_FLIGHT,
-                 mem_fraction: float = 0.25):
+                 mem_fraction: float = 0.25,
+                 mem_budget: Optional[int] = None):
         self._task_cap = max(1, task_cap)
+        if mem_budget is not None:
+            # Explicit byte budget (streaming ingest passes its window
+            # budget) — skip the store-capacity heuristic entirely.
+            self._mem_budget = max(1 << 20, int(mem_budget))
+            self._avg_block = 0.0
+            return
         store_cap = 0
         try:
             from ray_tpu._private.runtime import runtime_or_none

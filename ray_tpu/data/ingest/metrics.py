@@ -1,0 +1,56 @@
+"""Streaming-ingest metrics.
+
+Declared at import time like the serve/checkpoint/train metric modules so
+``scripts/check_metrics.py`` lints them; exported on ``/metrics`` through
+the process registry (util/metrics.py).
+
+The anchor set is what an operator tuning an input pipeline needs: how
+fast rows flow into training, whether the prefetch buffer is keeping the
+step fed (occupancy), and how much step time the pipeline is costing when
+it is not (starved seconds — the number that says "your input pipeline is
+the bottleneck, raise prefetch_batches / reader parallelism").
+"""
+
+from __future__ import annotations
+
+from ray_tpu.util.metrics import Counter, Gauge
+
+ROWS = Counter(
+    "ray_tpu_data_ingest_rows_total",
+    "Rows streamed into training by the ingest pipeline (rate = rows/s)",
+)
+
+BYTES = Counter(
+    "ray_tpu_data_ingest_bytes_total",
+    "Bytes of block data fetched from the object store by the ingest "
+    "pipeline",
+)
+
+SHARDS = Counter(
+    "ray_tpu_data_ingest_shards_total",
+    "Source shards claimed and fully streamed by ingest workers",
+)
+
+FETCH_RETRIES = Counter(
+    "ray_tpu_data_ingest_fetch_retries_total",
+    "Block fetches retried after a transient failure (lost object, "
+    "injected chaos) before training observed anything",
+)
+
+PREFETCH_OCCUPANCY = Gauge(
+    "ray_tpu_data_ingest_prefetch_occupancy",
+    "Batches currently buffered in the host prefetcher (0 while the "
+    "training loop is outrunning the pipeline)",
+)
+
+WINDOW_BYTES = Gauge(
+    "ray_tpu_data_ingest_window_bytes",
+    "Bytes of block data currently resident in the shuffle window + "
+    "fetch-ahead buffer (bounded by DatasetConfig.window_bytes)",
+)
+
+STARVED_SECONDS = Counter(
+    "ray_tpu_data_ingest_starved_seconds_total",
+    "Seconds the training loop spent blocked on an empty prefetch buffer "
+    "(step starvation caused by the input pipeline)",
+)

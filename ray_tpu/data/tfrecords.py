@@ -15,6 +15,7 @@ example protos, so both layers are implemented directly:
 
 from __future__ import annotations
 
+import os
 import struct
 from typing import Any, Dict, Iterable, List, Optional
 
@@ -84,6 +85,84 @@ def read_records(path: str, *, verify: bool = True) -> Iterable[bytes]:
             if verify and _masked_crc(data) != data_crc:
                 raise ValueError(f"corrupt TFRecord data crc in {path}")
             yield data
+
+
+def read_records_range(path: str, start: int, end: int, *,
+                       verify: bool = True) -> Iterable[bytes]:
+    """Records whose HEADER offset lies in ``[start, end)`` — the
+    offset-shard read unit (data/ingest/readers.py): disjoint byte ranges
+    covering a file read disjoint, exactly-covering record sets, because a
+    record belongs to whichever range holds its header byte (its data may
+    extend past ``end``; that is fine, the next shard skips it while
+    scanning for its own first boundary).
+
+    TFRecord has no index, so a range starting mid-record resyncs by
+    scanning forward one byte at a time until a candidate 12-byte header's
+    masked length-crc verifies AND the record body's data-crc verifies —
+    the double check makes a false sync on record payload bytes a ~2^-64
+    event rather than a plausible one."""
+    size = os.path.getsize(path)
+    end = min(end, size)
+    if start >= end:
+        return
+    with open(path, "rb") as f:
+        pos = 0 if start == 0 else _next_frame_offset(f, start, end, size)
+        if pos is None:
+            return
+        f.seek(pos)
+        while pos < end:
+            header = f.read(12)
+            if len(header) < 12:
+                if header and pos + len(header) < size:
+                    raise ValueError(f"truncated TFRecord header in {path}")
+                return
+            (length,) = struct.unpack("<Q", header[:8])
+            (len_crc,) = struct.unpack("<I", header[8:])
+            if verify and _masked_crc(header[:8]) != len_crc:
+                raise ValueError(f"corrupt TFRecord length crc in {path}")
+            data = f.read(length)
+            footer = f.read(4)
+            if len(data) < length or len(footer) < 4:
+                raise ValueError(f"truncated TFRecord data in {path}")
+            (data_crc,) = struct.unpack("<I", footer)
+            if verify and _masked_crc(data) != data_crc:
+                raise ValueError(f"corrupt TFRecord data crc in {path}")
+            yield data
+            pos = f.tell()
+
+
+def _next_frame_offset(f, start: int, limit: int,
+                       size: int) -> Optional[int]:
+    """First CRC-verified record-header offset in ``[start, limit)``, or
+    None when the range holds no header (it was entirely inside a record
+    owned by the previous shard).  The scan buffers the candidate range in
+    one read — ranges are shard-sized (file/shards_per_file), i.e. already
+    chosen to be memory-friendly."""
+    f.seek(start)
+    buf = f.read(limit - start + 12)
+    span = len(buf) - 12
+    for off in range(max(span, 0) + 1):
+        if start + off >= limit:
+            break
+        header = buf[off:off + 12]
+        if len(header) < 12:
+            break
+        (length,) = struct.unpack("<Q", header[:8])
+        (len_crc,) = struct.unpack("<I", header[8:])
+        if _masked_crc(header[:8]) != len_crc:
+            continue
+        body_end = start + off + 12 + length + 4
+        if body_end > size:
+            continue
+        f.seek(start + off + 12)
+        data = f.read(length)
+        footer = f.read(4)
+        if len(data) < length or len(footer) < 4:
+            continue
+        (data_crc,) = struct.unpack("<I", footer)
+        if _masked_crc(data) == data_crc:
+            return start + off
+    return None
 
 
 def write_records(path: str, records: Iterable[bytes]) -> int:

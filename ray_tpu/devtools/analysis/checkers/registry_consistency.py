@@ -207,6 +207,7 @@ METRIC_MODULES = (
     "ray_tpu.serve.deployment_state",
     "ray_tpu.checkpoint.metrics",
     "ray_tpu.train.metrics",
+    "ray_tpu.data.ingest.metrics",
 )
 
 ALLOWED_PREFIXES = ("ray_tpu_", "serve_")

@@ -3,6 +3,7 @@
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager, load_pytree, save_pytree
 from ray_tpu.train.config import (
     CheckpointConfig,
+    DatasetConfig,
     ElasticConfig,
     FailureConfig,
     RunConfig,
@@ -12,6 +13,7 @@ from ray_tpu.train.elastic import ElasticDatasetShard, SampleLedger
 from ray_tpu.train.session import (
     get_checkpoint,
     get_context,
+    get_dataset_config,
     get_dataset_shard,
     report,
 )
@@ -20,8 +22,9 @@ from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
 
 __all__ = [
     "Checkpoint", "CheckpointManager", "CheckpointConfig", "DataParallelTrainer",
-    "ElasticConfig", "ElasticDatasetShard", "FailureConfig", "JaxTrainer",
+    "DatasetConfig", "ElasticConfig", "ElasticDatasetShard", "FailureConfig", "JaxTrainer",
     "Result", "RunConfig", "SampleLedger", "ScalingConfig",
-    "get_checkpoint", "get_context", "get_dataset_shard", "load_pytree",
+    "get_checkpoint", "get_context", "get_dataset_config",
+    "get_dataset_shard", "load_pytree",
     "report", "save_pytree", "TorchTrainer",
 ]

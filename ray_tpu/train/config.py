@@ -68,6 +68,37 @@ class ElasticConfig:
 
 
 @dataclass
+class DatasetConfig:
+    """How the Trainer feeds ``datasets=`` to workers
+    (docs/data-ingestion.md).
+
+    With ``streaming=True`` (the default) every lazy Dataset becomes a
+    :class:`~ray_tpu.data.ingest.StreamingIngest`: workers claim source
+    shards through a per-epoch ledger and stream them through a
+    backpressured executor, a windowed shuffle (O(window) memory, never a
+    full-epoch materialization), rebatching and host prefetch —
+    ``get_dataset_shard()`` then returns an ``IngestShard``.  With
+    ``streaming=False`` the legacy path applies: ``streaming_split`` into
+    per-worker ``DataIterator``s (row-balanced, but the whole epoch's
+    blocks flow through a central coordinator).
+    """
+
+    streaming: bool = True
+    #: Shuffle window, in blocks, per worker.  1 disables shuffling beyond
+    #: the epoch's shard-order permutation.
+    shuffle_window_blocks: int = 16
+    #: Epoch shuffles derive from (seed, epoch); None = fresh per process.
+    shuffle_seed: Optional[int] = None
+    #: Host-side prefetch depth, in batches.  0 disables the pump thread.
+    prefetch_batches: int = 2
+    #: In-flight byte budget per worker: fetch-ahead + shuffle window.
+    window_bytes: int = 128 << 20
+    #: Reserved for device double-buffering via
+    #: ``IngestShard.iter_batches(device_sharding=...)``.
+    device_prefetch: bool = False
+
+
+@dataclass
 class FailureConfig:
     """(ref: air/config.py FailureConfig) max_failures=-1 retries forever."""
 

@@ -30,6 +30,14 @@ import threading
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+#: Claim tag for work whose final training step is not known at claim time
+#: (streaming ingest claims a whole source shard up front and only learns
+#: the step its last batch trained at once the shard drains).  Larger than
+#: any real checkpoint step, so seal(committed) never seals it by accident;
+#: ``retag()`` replaces it once the true step is known, and ``rollback()``
+#: requeues anything still provisional, exactly like a normal claim.
+PROVISIONAL_STEP = 1 << 62
+
 
 class SampleLedger:
     """Controller-owned exactly-once dispenser over a sized dataset."""
@@ -82,6 +90,35 @@ class SampleLedger:
             return self._dataset[list(indices)]
         except TypeError:
             return [self._dataset[i] for i in indices]
+
+    def retag(self, indices: Tuple[int, ...], step: Optional[int]) -> int:
+        """Replace the claim step of in-flight ``indices`` (claimed at
+        ``PROVISIONAL_STEP``) with the step they actually finished training
+        at — the streaming-ingest path, where a shard's step is only known
+        once its last batch has been consumed.  ``step=None`` seals the
+        indices immediately (no coordinator to commit against).  Indices no
+        longer in flight (already requeued by a rollback) are skipped;
+        returns how many were retagged/sealed."""
+        want = set(indices)
+        with self._lock:
+            moved = 0
+            keep: List[Tuple[int, Tuple[int, ...]]] = []
+            for s, idxs in self._inflight:
+                hit = [i for i in idxs if i in want]
+                if not hit:
+                    keep.append((s, idxs))
+                    continue
+                moved += len(hit)
+                rest = tuple(i for i in idxs if i not in want)
+                if rest:
+                    keep.append((s, rest))
+                if step is None:
+                    for i in hit:
+                        self._trained[i] = self._trained.get(i, 0) + 1
+                else:
+                    keep.append((step, tuple(hit)))
+            self._inflight = keep
+            return moved
 
     # ------------------------------------------------- commit/rollback
     def seal(self, committed_step: int) -> int:

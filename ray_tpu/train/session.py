@@ -55,11 +55,15 @@ class TrainSession:
     def __init__(self, context: TrainContext,
                  checkpoint_to_restore: Optional[Checkpoint] = None,
                  dataset_shards: Optional[Dict[str, Any]] = None,
-                 shard_writer=None, start_step: int = 0):
+                 shard_writer=None, start_step: int = 0,
+                 dataset_config=None):
         self.context = context
         self.results: "queue.Queue" = queue.Queue()
         self.checkpoint_to_restore = checkpoint_to_restore
         self.dataset_shards = dataset_shards or {}
+        #: the Trainer's DatasetConfig — user loops read it through
+        #: train.get_dataset_config() for prefetch/shuffle tuning knobs.
+        self.dataset_config = dataset_config
         self.stop_requested = threading.Event()
         #: ray_tpu.checkpoint.ShardWriter when async checkpointing is on
         #: (CheckpointConfig.async_save) — report(checkpoint=<pytree>) then
@@ -148,3 +152,9 @@ def get_checkpoint() -> Optional[Checkpoint]:
 def get_dataset_shard(name: str = "train"):
     """(ref: train.get_dataset_shard) — the worker's split of a Dataset."""
     return _require_session().dataset_shards.get(name)
+
+
+def get_dataset_config():
+    """The Trainer's :class:`~ray_tpu.train.DatasetConfig` (or None when
+    the run was launched without one)."""
+    return _require_session().dataset_config

@@ -138,11 +138,13 @@ class TorchTrainer(DataParallelTrainer):
 
     def _run_with_pg(self, pg, run_name: str, group_name: str,
                      manager: CheckpointManager, restore_ckpt,
-                     coordinator=None, world=None, ledgers=None) -> Dict:
+                     coordinator=None, world=None, ledgers=None,
+                     ingests=None) -> Dict:
         # coordinator (async sharded checkpointing) is thread-tier only;
         # torch workers are process-tier, so it is always None here —
-        # likewise the elastic world/ledgers plumbing (no datasets=, and
-        # ScalingConfig.elastic is rejected for process-tier groups).
+        # likewise the elastic world/ledgers/ingests plumbing (no
+        # datasets=, and ScalingConfig.elastic is rejected for
+        # process-tier groups).
         from ray_tpu.exceptions import RayTpuError, TaskError
         from ray_tpu.util.queue import Empty, Queue
 

@@ -42,7 +42,7 @@ from ray_tpu._private import fault_injection
 from ray_tpu.exceptions import RayTpuError, TaskError
 from ray_tpu.train import metrics as train_metrics
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
-from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.config import DatasetConfig, RunConfig, ScalingConfig
 from ray_tpu.train.elastic import ElasticDatasetShard, SampleLedger
 from ray_tpu.train.session import TrainContext, TrainSession, clear_session, init_session
 from ray_tpu.util.placement_group import (
@@ -261,6 +261,7 @@ class DataParallelTrainer:
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
         datasets: Optional[Dict[str, Any]] = None,
+        dataset_config: Optional[DatasetConfig] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
     ):
         self.train_loop = train_loop_per_worker
@@ -268,6 +269,7 @@ class DataParallelTrainer:
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
+        self.dataset_config = dataset_config or DatasetConfig()
         self.resume_from_checkpoint = resume_from_checkpoint
         # Elastic recovery clock: set at failure/grow time, observed by
         # _drain_sessions when the first report of the resumed attempt
@@ -340,6 +342,29 @@ class DataParallelTrainer:
         #: exposed for inspection (chaos tests assert the per-sample
         #: exactly-once ledger after fit() returns)
         self.sample_ledgers = ledgers
+        # Streaming data plane (docs/data-ingestion.md): with
+        # DatasetConfig(streaming=True) — the default — every lazy Dataset
+        # becomes a StreamingIngest shared across attempts: workers claim
+        # source shards through a per-epoch ledger (claiming IS the
+        # resplit under elastic world changes) and stream them through
+        # backpressure -> windowed shuffle -> rebatch -> prefetch.
+        dcfg = self.dataset_config
+        ingests: Dict[str, Any] = {}
+        if dcfg.streaming:
+            from ray_tpu.data.ingest import StreamingIngest
+
+            for name, ds in self.datasets.items():
+                if name not in ledgers and hasattr(ds, "_op"):
+                    ingests[name] = StreamingIngest(
+                        ds,
+                        window_blocks=dcfg.shuffle_window_blocks,
+                        window_bytes=dcfg.window_bytes,
+                        seed=dcfg.shuffle_seed,
+                        prefetch_batches=dcfg.prefetch_batches,
+                        seal_on_claim=coordinator is None)
+        #: exposed for inspection (tests audit per-shard exactly-once
+        #: accounting after fit() returns)
+        self.streaming_ingests = ingests
 
         max_failures = self.run_config.failure_config.max_failures
         failures = 0
@@ -352,11 +377,14 @@ class DataParallelTrainer:
             while True:
                 outcome = self._run_attempt(run_name, manager, restore_ckpt,
                                             experiment_path, coordinator,
-                                            world=cur_world, ledgers=ledgers)
+                                            world=cur_world, ledgers=ledgers,
+                                            ingests=ingests)
                 history.extend(outcome["history"])
                 if outcome["status"] == "finished":
                     for ledger in ledgers.values():
                         ledger.seal_all()  # clean finish: nothing rolls back
+                    for ingest in ingests.values():
+                        ingest.seal_all()
                     return Result(
                         metrics=outcome["last_metrics"],
                         checkpoint=(manager.latest_checkpoint()
@@ -379,6 +407,8 @@ class DataParallelTrainer:
                         coordinator, manager)
                     for ledger in ledgers.values():
                         ledger.rollback(step)
+                    for ingest in ingests.values():
+                        ingest.rollback(step)
                     train_metrics.GROW_EVENTS.inc()
                     event = {"type": "grow", "from_world": cur_world,
                              "to_world": new_world, "restore_step": step,
@@ -408,6 +438,8 @@ class DataParallelTrainer:
                         restore_ckpt = self.resume_from_checkpoint
                     requeued = sum(ledger.rollback(step)
                                    for ledger in ledgers.values())
+                    requeued += sum(ingest.rollback(step)
+                                    for ingest in ingests.values())
                     last_step = outcome.get("last_step")
                     lost = 0
                     if last_step is not None:
@@ -461,6 +493,10 @@ class DataParallelTrainer:
                     restore_ckpt = (self._coordinator_checkpoint(coordinator)
                                     or manager.latest_checkpoint()
                                     or self.resume_from_checkpoint)
+                    # The restarted attempt re-runs the user loop from its
+                    # own epoch 0: ingest epochs must start fresh too.
+                    for ingest in ingests.values():
+                        ingest.reset()
         finally:
             if coordinator is not None:
                 try:
@@ -599,7 +635,8 @@ class DataParallelTrainer:
     def _run_attempt(self, run_name: str, manager: CheckpointManager,
                      restore_ckpt: Optional[Checkpoint], experiment_path: str,
                      coordinator=None, world: Optional[int] = None,
-                     ledgers: Optional[Dict[str, SampleLedger]] = None) -> Dict:
+                     ledgers: Optional[Dict[str, SampleLedger]] = None,
+                     ingests: Optional[Dict[str, Any]] = None) -> Dict:
         scfg = self.scaling_config
         if world is None:
             world = scfg.num_workers
@@ -639,7 +676,7 @@ class DataParallelTrainer:
                             f"Reduce num_workers/resources_per_worker or add nodes.")}
             return self._run_with_pg(pg, run_name, group_name, manager,
                                      restore_ckpt, coordinator, world=world,
-                                     ledgers=ledgers)
+                                     ledgers=ledgers, ingests=ingests)
         finally:
             collective.destroy_collective_group(group_name)
             remove_placement_group(pg)
@@ -663,7 +700,8 @@ class DataParallelTrainer:
     def _run_with_pg(self, pg, run_name: str, group_name: str,
                      manager: CheckpointManager, restore_ckpt,
                      coordinator=None, world: Optional[int] = None,
-                     ledgers: Optional[Dict[str, SampleLedger]] = None) -> Dict:
+                     ledgers: Optional[Dict[str, SampleLedger]] = None,
+                     ingests: Optional[Dict[str, Any]] = None) -> Dict:
         if self._worker_mode(pg) == "processes":
             if self.scaling_config.elastic is not None:
                 return {"status": "fatal", "last_metrics": None, "history": [],
@@ -681,8 +719,10 @@ class DataParallelTrainer:
         if world is None:
             world = scfg.num_workers
         ledgers = ledgers or {}
+        ingests = ingests or {}
         train_metrics.WORLD_SIZE.set(world)
-        dataset_shards = self._split_datasets(world, exclude=set(ledgers))
+        dataset_shards = self._split_datasets(
+            world, exclude=set(ledgers) | set(ingests))
         writers: List = []
         epoch = 0
         start_step = 0
@@ -707,11 +747,16 @@ class DataParallelTrainer:
             session = TrainSession(ctx, checkpoint_to_restore=restore_ckpt,
                                    dataset_shards=dataset_shards[rank],
                                    shard_writer=writers[rank] if writers else None,
-                                   start_step=start_step)
+                                   start_step=start_step,
+                                   dataset_config=self.dataset_config)
             # Elastic datasets are views onto the shared ledger, bound to
             # THIS session so claims carry its next checkpoint step.
             for name, ledger in ledgers.items():
                 session.dataset_shards[name] = ElasticDatasetShard(ledger, session)
+            # Streaming datasets: a per-session view onto the shared
+            # ingest — shard claims carry this session's checkpoint step.
+            for name, ingest in ingests.items():
+                session.dataset_shards[name] = ingest.make_shard(session)
             sessions.append(session)
             workers.append(
                 TrainWorker.options(
@@ -760,12 +805,15 @@ class DataParallelTrainer:
                             f"(ranks {sorted(dead)}; node preempted?)")
                 # Seal provisional ledger claims as the coordinator commits
                 # their steps: sealed samples never requeue on a rollback.
-                if ledgers and coordinator is not None and now - last_seal >= 0.25:
+                if ((ledgers or ingests) and coordinator is not None
+                        and now - last_seal >= 0.25):
                     last_seal = now
                     committed = self._committed_step(coordinator)
                     if committed is not None:
                         for ledger in ledgers.values():
                             ledger.seal(committed)
+                        for ingest in ingests.values():
+                            ingest.seal(committed)
                 # Chaos: a whole worker node vanishes (TPU slice preempted).
                 if injector.enabled and injector.fires("preempt_node"):
                     self._preempt_worker_node(pg)
@@ -827,17 +875,20 @@ class DataParallelTrainer:
                 except Exception:
                     pass
                 wtr.close()
-            if ledgers and coordinator is not None:
+            if (ledgers or ingests) and coordinator is not None:
                 committed = self._committed_step(coordinator)
                 if committed is not None:
                     for ledger in ledgers.values():
                         ledger.seal(committed)
+                    for ingest in ingests.values():
+                        ingest.seal(committed)
             # A grow stop can surface two ways: workers that hit report()
             # raise StopIteration ("stopped"), but workers whose user loop
             # exits because the ledger fence returned None come back
             # "finished" — the ledger still holding work distinguishes that
             # from a genuine end-of-dataset finish.
-            work_left = any(not led.exhausted() for led in ledgers.values())
+            work_left = any(not led.exhausted() for led in ledgers.values()) \
+                or any(not ing.exhausted() for ing in ingests.values())
             if grow_target is not None and ("stopped" in statuses or work_left):
                 return {"status": "grow", "new_world": grow_target,
                         "last_metrics": last_metrics, "history": history,

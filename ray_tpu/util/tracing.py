@@ -63,6 +63,8 @@ SPAN_REGISTRY: Dict[str, str] = {
     "checkpoint.save": "writer: shard serialize + persist",
     "checkpoint.commit": "coordinator: commit phase up to atomic rename",
     "checkpoint.restore": "restore_pytree entry",
+    "data.ingest": "ingest: one source shard, first pull -> last block out",
+    "data.prefetch": "ingest: host->device transfer dispatch, per batch",
 }
 
 

@@ -155,25 +155,37 @@ def test_to_pandas_schema(ray_start_regular):
 
 
 def test_dataset_with_train_ingest(ray_start_regular):
-    """streaming_split feeding JaxTrainer workers via get_dataset_shard."""
+    """The default streaming ingest feeding JaxTrainer workers via
+    get_dataset_shard.  Workers claim source shards (not row-balanced
+    slices), so the assertion allreduces the per-worker totals: every row
+    must reach exactly one worker."""
     from ray_tpu import train
     from ray_tpu.train import JaxTrainer, ScalingConfig
 
     ds = data.range(64).map_batches(lambda b: {"x": b["id"].astype(np.float32)})
 
     def loop(config):
+        import jax.numpy as jnp
+
+        from ray_tpu import collective
+
+        ctx = train.get_context()
         it = train.get_dataset_shard("train")
         total = 0.0
         count = 0
         for batch in it.iter_batches(batch_size=8):
             total += float(batch["x"].sum())
             count += len(batch["x"])
-        train.report({"total": total, "count": count})
+        vec = np.asarray(collective.allreduce(
+            jnp.asarray([float(count), total]),
+            group_name=ctx.collective_group))
+        train.report({"total": float(vec[1]), "count": int(vec[0])})
 
     result = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=2),
                         datasets={"train": ds}).fit()
     assert result.error is None
-    assert result.metrics["count"] > 0
+    assert result.metrics["count"] == 64
+    assert result.metrics["total"] == sum(range(64))
 
 
 # ------------------------- regression tests (round-1 code review findings) ---

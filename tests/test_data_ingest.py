@@ -1,0 +1,720 @@
+"""Streaming train-ingestion subsystem (ray_tpu/data/ingest/).
+
+Covers the four pieces end to end: backpressured plan execution
+(shard_plans/stream_blocks), the per-epoch windowed shuffle, host/device
+prefetch, offset-sharded file readers — plus the elastic interaction:
+shard-level exactly-once accounting under shrink mid-epoch and grow at an
+epoch boundary, and chaos-injected fetch failures.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+from ray_tpu._private.fault_injection import reset_injector
+from ray_tpu.autoscaler.elastic import simulate_preemption
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.data.ingest import (
+    DeviceBatchIterator,
+    HostPrefetcher,
+    StreamingIngest,
+    epoch_rng,
+    shard_plans,
+    shardable,
+    window_shuffle,
+)
+from ray_tpu.data.ingest import metrics as ingest_metrics
+from ray_tpu.data.plan import Read
+from ray_tpu.train import (
+    CheckpointConfig,
+    DatasetConfig,
+    ElasticConfig,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.elastic import PROVISIONAL_STEP, SampleLedger
+
+
+def _set_chaos(spec: str) -> None:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    GLOBAL_CONFIG.testing_rpc_failure = spec
+    reset_injector()
+
+
+# --------------------------------------------------------------------------
+# windowed shuffle
+# --------------------------------------------------------------------------
+class TestWindowShuffle:
+    def test_exactly_once_and_deterministic(self):
+        out = list(window_shuffle(iter(range(100)), 8, epoch_rng(3, 0)))
+        assert sorted(out) == list(range(100))
+        assert out != list(range(100)), "window shuffle was a no-op"
+        again = list(window_shuffle(iter(range(100)), 8, epoch_rng(3, 0)))
+        assert out == again, "same (seed, epoch) must replay the same order"
+
+    def test_epoch_changes_order(self):
+        e0 = list(window_shuffle(iter(range(100)), 8, epoch_rng(3, 0)))
+        e1 = list(window_shuffle(iter(range(100)), 8, epoch_rng(3, 1)))
+        assert e0 != e1
+        assert sorted(e1) == list(range(100))
+
+    def test_bounded_lookahead(self):
+        """out[k] can only come from the first window+k inputs — the
+        O(window) memory guarantee, observable from the outside."""
+        window = 8
+        out = list(window_shuffle(iter(range(200)), window, epoch_rng(1, 0)))
+        for k, item in enumerate(out):
+            assert item <= window + k, (k, item)
+
+    def test_window_one_is_passthrough(self):
+        out = list(window_shuffle(iter(range(50)), 1, epoch_rng(1, 0)))
+        assert out == list(range(50))
+
+    def test_byte_cap_tightens_window(self):
+        """With max_bytes smaller than window items, the buffer drains
+        early — bounded delay gets tighter, coverage stays exact."""
+        out = list(window_shuffle(
+            iter(range(60)), 32, epoch_rng(5, 0),
+            size_of=lambda _x: 10, max_bytes=50))
+        assert sorted(out) == list(range(60))
+        for k, item in enumerate(out):
+            assert item <= 32 + k
+
+
+# --------------------------------------------------------------------------
+# plan sharding + backpressure
+# --------------------------------------------------------------------------
+class TestShardPlans:
+    def test_read_splits_per_task(self, ray_start_regular):
+        ds = data.range(40, parallelism=4).map_batches(
+            lambda b: {"id": b["id"] * 2})
+        assert shardable(ds._op)
+        plans = shard_plans(ds._op)
+        assert len(plans) == 4
+        # Each sub-plan executes independently and keeps the map chain.
+        from ray_tpu.data.dataset import Dataset
+
+        rows = [v for p in plans for b in Dataset(p).iter_batches(
+            batch_size=None) for v in b["id"].tolist()]
+        assert sorted(rows) == sorted(v * 2 for v in range(40))
+
+    def test_all_to_all_falls_back_to_single_shard(self, ray_start_regular):
+        ds = data.range(40, parallelism=4).random_shuffle()
+        assert not shardable(ds._op)
+        assert len(shard_plans(ds._op)) == 1
+
+    def test_actor_compute_falls_back(self, ray_start_regular):
+        class Add:
+            def __call__(self, b):
+                return {"id": b["id"] + 1}
+
+        ds = data.range(40, parallelism=4).map_batches(Add, concurrency=1)
+        assert not shardable(ds._op)
+        assert len(shard_plans(ds._op)) == 1
+
+    def test_backpressure_is_lazy(self, ray_start_regular):
+        """Consuming the first batch must not have executed the whole
+        epoch: read tasks launch only as the bounded budget frees up."""
+        import pyarrow as pa
+
+        executed = []  # thread-tier tasks share this process
+
+        def make_task(i):
+            def read():
+                executed.append(i)
+                return pa.table({"id": np.arange(8, dtype=np.int64) + 8 * i})
+
+            return read
+
+        from ray_tpu.data.dataset import Dataset
+
+        ds = Dataset(Read([make_task(i) for i in range(32)]))
+        ing = StreamingIngest(ds, window_blocks=4, seed=0,
+                              prefetch_batches=0)
+        it = ing.make_shard().iter_batches(batch_size=8)
+        next(iter([next(iter(it))]))  # pull exactly one batch
+        assert 0 < len(executed) < 32, (
+            f"{len(executed)}/32 read tasks ran for the first batch — "
+            "the stream is not backpressured")
+
+
+# --------------------------------------------------------------------------
+# StreamingIngest epochs + accounting
+# --------------------------------------------------------------------------
+class TestStreamingIngest:
+    def test_epoch_exactly_once_and_reshuffled(self, ray_start_regular):
+        ds = data.range(64, parallelism=8).map_batches(lambda b: b)
+        ing = StreamingIngest(ds, window_blocks=4, seed=11)
+        shard = ing.make_shard()
+        e0 = [v for b in shard.iter_batches(batch_size=6)
+              for v in b["id"].tolist()]
+        e1 = [v for b in shard.iter_batches(batch_size=6)
+              for v in b["id"].tolist()]
+        assert sorted(e0) == sorted(e1) == list(range(64))
+        assert e0 != e1, "epochs must reshuffle"
+        for epoch in (0, 1):
+            audit = ing.audit(epoch)
+            assert audit["double_trained"] == []
+            assert audit["untrained"] == []
+        assert ing.exhausted()
+
+    def test_two_shards_partition_the_epoch(self, ray_start_regular):
+        ds = data.range(64, parallelism=8)
+        ing = StreamingIngest(ds, window_blocks=2, seed=1)
+        a, b = ing.make_shard(), ing.make_shard()
+        got = {"a": [], "b": []}
+        ita = iter(a.iter_batches(batch_size=4))
+        itb = iter(b.iter_batches(batch_size=4))
+        # Interleave two consumers of the SAME epoch: claims partition it.
+        done_a = done_b = False
+        while not (done_a and done_b):
+            if not done_a:
+                batch = next(ita, None)
+                if batch is None:
+                    done_a = True
+                else:
+                    got["a"].extend(batch["id"].tolist())
+            if not done_b:
+                batch = next(itb, None)
+                if batch is None:
+                    done_b = True
+                else:
+                    got["b"].extend(batch["id"].tolist())
+        assert sorted(got["a"] + got["b"]) == list(range(64))
+        assert not set(got["a"]) & set(got["b"])
+
+    def test_reset_replays_from_scratch(self, ray_start_regular):
+        ds = data.range(32, parallelism=4)
+        ing = StreamingIngest(ds, window_blocks=2, seed=2)
+        rows = [v for b in ing.make_shard().iter_batches(batch_size=8)
+                for v in b["id"].tolist()]
+        assert sorted(rows) == list(range(32))
+        ing.reset()
+        rows = [v for b in ing.make_shard().iter_batches(batch_size=8)
+                for v in b["id"].tolist()]
+        assert sorted(rows) == list(range(32))
+
+    @pytest.mark.slow
+    def test_larger_than_budget_epoch_bounded_memory(self, ray_start_regular):
+        """An epoch ~10x the window budget streams through with resident
+        bytes bounded by (shuffle window + fetch-ahead), not dataset size."""
+        budget = 1 << 20  # 1 MiB (the floor StreamingIngest clamps to)
+        n = 1_250_000  # ~10 MiB of int64 ids
+        ds = data.range(n, parallelism=200)
+        ing = StreamingIngest(ds, window_blocks=8, window_bytes=budget,
+                              seed=3, prefetch_batches=2)
+        count = 0
+        for batch in ing.make_shard().iter_batches(batch_size=4096):
+            count += len(batch["id"])
+        assert count == n
+        peak = ing.peak_window_bytes
+        assert peak <= 3 * budget, (
+            f"peak resident {peak} bytes vs {budget} window budget")
+        audit = ing.audit(0)
+        assert audit["double_trained"] == [] and audit["untrained"] == []
+
+
+# --------------------------------------------------------------------------
+# SampleLedger.retag (provisional shard claims)
+# --------------------------------------------------------------------------
+class TestRetag:
+    def test_retag_then_seal(self):
+        led = SampleLedger(list(range(4)))
+        got = led.claim(2, step=PROVISIONAL_STEP)
+        assert got == (0, 1)
+        assert led.retag(got, step=5) == 2
+        led.seal(4)
+        assert led.trained_counts() == {}
+        led.seal(5)
+        assert led.trained_counts() == {0: 1, 1: 1}
+
+    def test_retag_none_seals_immediately(self):
+        led = SampleLedger(list(range(4)))
+        got = led.claim(2, step=PROVISIONAL_STEP)
+        assert led.retag(got, step=None) == 2
+        assert led.trained_counts() == {0: 1, 1: 1}
+
+    def test_retag_after_rollback_is_noop(self):
+        led = SampleLedger(list(range(4)))
+        got = led.claim(2, step=PROVISIONAL_STEP)
+        led.rollback(None)  # requeues the provisional claim
+        assert led.retag(got, step=7) == 0
+        assert led.remaining() == 4
+
+    def test_rollback_requeues_provisional_claims(self):
+        led = SampleLedger(list(range(6)))
+        a = led.claim(3, step=PROVISIONAL_STEP)
+        led.retag(a, step=2)
+        led.claim(2, step=PROVISIONAL_STEP)  # still provisional
+        led.seal(2)  # commit covers the retagged claim only
+        led.rollback(2)
+        assert led.trained_counts() == {0: 1, 1: 1, 2: 1}
+        assert led.remaining() == 3  # 3,4 requeued alongside untouched 5
+
+
+# --------------------------------------------------------------------------
+# host + device prefetch
+# --------------------------------------------------------------------------
+class TestPrefetch:
+    def test_host_prefetcher_order_and_close(self):
+        src = ({"i": np.asarray([i])} for i in range(20))
+        pf = HostPrefetcher(src, depth=3)
+        got = [int(b["i"][0]) for b in pf]
+        assert got == list(range(20))
+        pf.close()  # idempotent
+
+    def test_host_prefetcher_propagates_errors_in_order(self):
+        def src():
+            yield {"i": 0}
+            yield {"i": 1}
+            raise ValueError("pipeline exploded")
+
+        pf = HostPrefetcher(src(), depth=2)
+        it = iter(pf)
+        assert next(it)["i"] == 0
+        assert next(it)["i"] == 1
+        with pytest.raises(ValueError, match="pipeline exploded"):
+            next(it)
+
+    def test_host_prefetcher_abandoned_consumer_unblocks_pump(self):
+        produced = []
+
+        def src():
+            for i in range(1000):
+                produced.append(i)
+                yield {"i": i}
+
+        pf = HostPrefetcher(src(), depth=2)
+        it = iter(pf)
+        next(it)
+        pf.close()
+        time.sleep(0.3)
+        n = len(produced)
+        time.sleep(0.2)
+        assert len(produced) == n, "pump thread kept producing after close()"
+        assert n < 1000
+
+    def test_starved_seconds_counter_moves(self):
+        before = ingest_metrics.STARVED_SECONDS.get()
+
+        def slow():
+            for i in range(3):
+                time.sleep(0.05)
+                yield {"i": i}
+
+        assert len(list(HostPrefetcher(slow(), depth=2))) == 3
+        assert ingest_metrics.STARVED_SECONDS.get() > before
+
+    def test_device_iterator_values_and_lookahead(self):
+        pulled = []
+
+        def src():
+            for i in range(6):
+                pulled.append(i)
+                yield {"x": np.full(4, i, dtype=np.float32)}
+
+        it = iter(DeviceBatchIterator(src()))
+        first = next(it)
+        # Double buffer: batch 1's transfer was dispatched before batch 0
+        # was handed out.
+        assert len(pulled) == 2
+        assert float(first["x"][0]) == 0.0
+        import jax
+
+        assert isinstance(first["x"], jax.Array)
+        rest = list(it)
+        assert [float(b["x"][0]) for b in rest] == [1.0, 2.0, 3.0, 4.0, 5.0]
+
+    def test_device_iterator_with_sharding(self):
+        import jax
+
+        from ray_tpu.parallel.mesh import MeshSpec, batch_sharding, make_mesh
+
+        mesh = make_mesh(MeshSpec.auto(len(jax.devices())))
+        sharding = batch_sharding(mesh)
+        src = ({"x": np.ones((8, 4), dtype=np.float32) * i} for i in range(3))
+        out = list(DeviceBatchIterator(src, sharding=sharding))
+        assert len(out) == 3
+        assert out[1]["x"].sharding == sharding
+
+    def test_device_iterator_mixed_rank_columns(self):
+        # 1-D labels next to 2-D tokens through ONE rank-2 batch sharding:
+        # the spec truncates per column (leading axes shard, rest
+        # replicate) instead of raising a rank mismatch.
+        import jax
+
+        from ray_tpu.parallel.mesh import MeshSpec, batch_sharding, make_mesh
+
+        mesh = make_mesh(MeshSpec.auto(len(jax.devices())))
+        sharding = batch_sharding(mesh)
+        src = [{"tokens": np.ones((8, 4), dtype=np.int32),
+                "label": np.arange(8, dtype=np.int64)}]
+        (out,) = list(DeviceBatchIterator(src, sharding=sharding))
+        assert out["tokens"].sharding == sharding
+        assert out["label"].shape == (8,)
+        assert np.asarray(out["label"]).tolist() == list(range(8))
+
+    def test_ingest_device_path(self, ray_start_regular):
+        import jax
+
+        ds = data.range(64, parallelism=8).map_batches(
+            lambda b: {"x": b["id"].astype(np.float32)})
+        ing = StreamingIngest(ds, window_blocks=2, seed=4)
+        shard = ing.make_shard()
+        total = 0.0
+        for batch in shard.iter_batches(batch_size=8):
+            total += float(np.sum(np.asarray(batch["x"])))
+        assert total == float(sum(range(64)))
+        # Device route (next epoch): jax arrays, totals unchanged.
+        total_dev = 0.0
+        for batch in shard.iter_batches(
+                batch_size=8, device_sharding=jax.devices()[0]):
+            assert isinstance(batch["x"], jax.Array)
+            total_dev += float(jax.numpy.sum(batch["x"]))
+        assert total_dev == total
+
+
+# --------------------------------------------------------------------------
+# offset-sharded readers
+# --------------------------------------------------------------------------
+def _write_tfrecords(path, n=120):
+    from ray_tpu.data.tfrecords import row_to_example, write_records
+
+    # Varying record sizes so byte-range boundaries land mid-record.
+    recs = [row_to_example({"i": i, "pad": b"x" * (17 * (i % 13))})
+            for i in range(n)]
+    write_records(path, recs)
+    return recs
+
+
+class TestOffsetShardedReaders:
+    def test_tfrecord_ranges_disjoint_and_complete(self, tmp_path):
+        from ray_tpu.data.tfrecords import read_records, read_records_range
+
+        path = str(tmp_path / "a.tfrecords")
+        _write_tfrecords(path, n=120)
+        whole = list(read_records(path))
+        size = os.path.getsize(path)
+        for shards in (2, 4, 7):
+            bounds = [size * i // shards for i in range(shards + 1)]
+            parts = [list(read_records_range(path, lo, hi))
+                     for lo, hi in zip(bounds, bounds[1:])]
+            flat = [r for p in parts for r in p]
+            assert flat == whole, f"{shards}-way split lost/dup records"
+
+    def test_tfrecord_range_arbitrary_offsets(self, tmp_path):
+        from ray_tpu.data.tfrecords import read_records, read_records_range
+
+        path = str(tmp_path / "b.tfrecords")
+        _write_tfrecords(path, n=40)
+        whole = list(read_records(path))
+        size = os.path.getsize(path)
+        # Any split point — including mid-record — partitions exactly.
+        for cut in (1, 13, size // 3, size // 2, size - 5, size):
+            left = list(read_records_range(path, 0, cut))
+            right = list(read_records_range(path, cut, size))
+            assert left + right == whole, f"cut at {cut} broke the partition"
+
+    def test_tfrecord_range_empty_cases(self, tmp_path):
+        from ray_tpu.data.tfrecords import read_records_range, write_records
+
+        path = str(tmp_path / "empty.tfrecords")
+        write_records(path, [])
+        assert list(read_records_range(path, 0, 10)) == []
+        path2 = str(tmp_path / "c.tfrecords")
+        _write_tfrecords(path2, n=3)
+        size = os.path.getsize(path2)
+        assert list(read_records_range(path2, size, size + 10)) == []
+
+    def test_read_tfrecords_shards_per_file(self, ray_start_regular, tmp_path):
+        path = str(tmp_path / "d.tfrecords")
+        _write_tfrecords(path, n=100)
+        ds = data.read_tfrecords(path, shards_per_file=4)
+        assert len(ds._op.read_tasks) == 4
+        rows = sorted(r["i"] for r in ds.iter_rows())
+        assert rows == list(range(100))
+
+    def test_parquet_row_group_ranges(self, ray_start_regular, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        path = str(tmp_path / "e.parquet")
+        pq.write_table(pa.table({"i": np.arange(1000)}), path,
+                       row_group_size=100)
+        ds = data.read_parquet(path, shards_per_file=5)
+        assert len(ds._op.read_tasks) == 5
+        rows = sorted(r["i"] for r in ds.iter_rows())
+        assert rows == list(range(1000))
+        # More shards than row groups: clamped, still exact.
+        ds2 = data.read_parquet(path, shards_per_file=64)
+        assert len(ds2._op.read_tasks) == 10
+        assert sorted(r["i"] for r in ds2.iter_rows()) == list(range(1000))
+
+    def test_sharded_file_through_ingest(self, ray_start_regular, tmp_path):
+        """One big file + shards_per_file: the single-file dataset still
+        fans out across ingest claims."""
+        path = str(tmp_path / "f.tfrecords")
+        _write_tfrecords(path, n=200)
+        ds = data.read_tfrecords(path, shards_per_file=8)
+        ing = StreamingIngest(ds, window_blocks=4, seed=6)
+        assert ing.num_shards() == 8
+        rows = [int(v) for b in ing.make_shard().iter_batches(batch_size=16)
+                for v in np.asarray(b["i"]).tolist()]
+        assert sorted(rows) == list(range(200))
+
+
+# --------------------------------------------------------------------------
+# _expand_paths determinism (regression)
+# --------------------------------------------------------------------------
+def test_expand_paths_sorted_and_deduped(tmp_path, monkeypatch):
+    from ray_tpu.data import _expand_paths
+
+    names = ["b.parquet", "a.parquet", "c.parquet"]
+    for n in names:
+        (tmp_path / n).write_bytes(b"")
+    import glob as glob_mod
+
+    real_glob = glob_mod.glob
+
+    def scrambled(pattern, **kw):
+        return list(reversed(sorted(real_glob(pattern, **kw))))
+
+    monkeypatch.setattr("ray_tpu.data._glob.glob", scrambled)
+    out = _expand_paths(str(tmp_path), ".parquet")
+    assert out == [str(tmp_path / n) for n in sorted(names)], (
+        "directory expansion must not depend on glob order")
+    # Overlapping dir + glob + explicit file: one entry per file, sorted.
+    out = _expand_paths(
+        [str(tmp_path), str(tmp_path / "*.parquet"),
+         str(tmp_path / "a.parquet")], ".parquet")
+    assert out == [str(tmp_path / n) for n in sorted(names)]
+
+
+# --------------------------------------------------------------------------
+# chaos: injected fetch failures
+# --------------------------------------------------------------------------
+def test_chaos_fetch_failures_retry_no_torn_batch(ray_start_regular):
+    retries_before = ingest_metrics.FETCH_RETRIES.get()
+    starved_before = ingest_metrics.STARVED_SECONDS.get()
+    _set_chaos("data_ingest_fetch=0.5:3")
+    try:
+        ds = data.range(96, parallelism=12).map_batches(
+            lambda b: {"x": b["id"].astype(np.float64)})
+        ing = StreamingIngest(ds, window_blocks=4, seed=9,
+                              prefetch_batches=2)
+        total = 0.0
+        count = 0
+        for batch in ing.make_shard().iter_batches(batch_size=8):
+            total += float(np.sum(batch["x"]))
+            count += len(batch["x"])
+    finally:
+        _set_chaos("")
+    # Injected failures were absorbed by bounded retries: every row arrived
+    # exactly once, no torn/partial batch surfaced.
+    assert count == 96
+    assert total == float(sum(range(96)))
+    assert ingest_metrics.FETCH_RETRIES.get() - retries_before >= 1
+    assert ingest_metrics.STARVED_SECONDS.get() >= starved_before
+    audit = ing.audit(0)
+    assert audit["double_trained"] == [] and audit["untrained"] == []
+
+
+# --------------------------------------------------------------------------
+# elastic: shrink mid-epoch / grow at epoch boundary
+# --------------------------------------------------------------------------
+def _stream_loop(config):
+    """Lockstep data-parallel loop over the streaming shard: every step the
+    group allreduces [rows, sum]; an epoch ends when the GLOBAL row count
+    hits zero, so workers never diverge at shard exhaustion."""
+    import jax.numpy as jnp
+
+    from ray_tpu import collective, train
+
+    ctx = train.get_context()
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        t = ckpt.to_pytree()
+        w, step = float(t["w"]), int(t["step"])
+    else:
+        w, step = 0.0, -1
+    shard = train.get_dataset_shard("train")
+    for _epoch in range(config.get("epochs", 1)):
+        it = iter(shard.iter_batches(batch_size=config.get("batch", 20)))
+        while True:
+            batch = next(it, None)
+            n = 0 if batch is None else len(batch["x"])
+            contrib = 0.0 if batch is None else float(np.sum(batch["x"]))
+            vec = np.asarray(collective.allreduce(
+                jnp.asarray([float(n), contrib]),
+                group_name=ctx.collective_group))
+            if vec[0] == 0:
+                break
+            w += float(vec[1])
+            step += 1
+            train.report(
+                {"step": step, "w": w, "world": ctx.world_size},
+                checkpoint={"w": jnp.asarray(np.float64(w)),
+                            "step": jnp.asarray(np.int64(step))})
+            time.sleep(config.get("sleep", 0.05))
+
+
+def _streaming_trainer(tmp_path, ds, *, epochs=1, num_workers=3,
+                       sleep=0.25, name="stream-elastic"):
+    return JaxTrainer(
+        _stream_loop,
+        train_loop_config={"epochs": epochs, "batch": 20, "sleep": sleep},
+        scaling_config=ScalingConfig(
+            num_workers=num_workers, worker_mode="threads",
+            elastic=ElasticConfig(min_workers=1, grow_check_period_s=0.3)),
+        datasets={"train": ds},
+        # Shard == block == batch (range parallelism 20-row blocks, batch
+        # 20, window 1): a claim resolves within a single step, so the
+        # dataset-sum invariant below is EXACT even across shrink/grow.
+        dataset_config=DatasetConfig(shuffle_window_blocks=1,
+                                     shuffle_seed=12),
+        run_config=RunConfig(
+            name=name, storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(async_save=True,
+                                               replica_memory_steps=2),
+            failure_config=FailureConfig(max_failures=3)))
+
+
+def _fit_in_thread(trainer):
+    box = {}
+
+    def run():
+        box["result"] = trainer.fit()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, box
+
+
+@pytest.fixture
+def elastic_cluster():
+    """0-CPU head + three 1-CPU worker nodes (same shape as the elastic
+    training suite): killing a node genuinely drops worker capacity."""
+    ray_tpu.shutdown()
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 0})
+    nodes = [cluster.add_node(num_cpus=1) for _ in range(3)]
+    yield cluster, nodes
+    ray_tpu.shutdown()
+    _set_chaos("")
+
+
+def _audit_clean(trainer, epochs):
+    ing = trainer.streaming_ingests["train"]
+    for epoch in range(epochs):
+        audit = ing.audit(epoch)
+        assert audit["double_trained"] == [], (epoch, audit)
+        assert audit["untrained"] == [], (epoch, audit)
+
+
+def test_streaming_shrink_mid_epoch_exactly_once(elastic_cluster, tmp_path):
+    """Kill a worker node mid-epoch: survivors drain the dead worker's
+    unfinished shard claims exactly once — the final sum equals the dataset
+    sum and the shard ledger shows zero double / zero dropped."""
+    cluster, nodes = elastic_cluster
+    n = 480
+    ds = data.range(n, parallelism=n // 20).map_batches(
+        lambda b: {"x": b["id"].astype(np.float64) + 1.0})
+    trainer = _streaming_trainer(tmp_path, ds)
+    t, box = _fit_in_thread(trainer)
+    time.sleep(1.5)
+    assert simulate_preemption(str(nodes[0])) is not None
+    t.join(timeout=120)
+    assert not t.is_alive(), "fit() hung after preemption"
+    r = box["result"]
+    assert r.error is None, r.error
+    shrinks = [e for e in r.elastic_events if e["type"] == "shrink"]
+    assert shrinks and shrinks[0]["from_world"] == 3
+    _audit_clean(trainer, epochs=1)
+    assert r.metrics["w"] == pytest.approx(float(sum(range(1, n + 1))))
+    assert r.metrics["world"] == 2
+
+
+def test_streaming_grow_epoch_boundary_resplits(elastic_cluster, tmp_path):
+    """Capacity returns mid-run: the trainer grows back at a checkpoint
+    boundary and later epochs re-split over the larger world — every epoch
+    still sums to the dataset exactly once."""
+    cluster, nodes = elastic_cluster
+    n = 480
+    epochs = 2
+    ds = data.range(n, parallelism=n // 20).map_batches(
+        lambda b: {"x": b["id"].astype(np.float64) + 1.0})
+    trainer = _streaming_trainer(tmp_path, ds, epochs=epochs)
+    t, box = _fit_in_thread(trainer)
+    time.sleep(1.5)
+    assert simulate_preemption(str(nodes[0])) is not None
+    time.sleep(1.5)
+    cluster.add_node(num_cpus=1)
+    t.join(timeout=120)
+    assert not t.is_alive(), "fit() hung across shrink+grow"
+    r = box["result"]
+    assert r.error is None, r.error
+    types = [e["type"] for e in r.elastic_events]
+    assert "shrink" in types
+    _audit_clean(trainer, epochs=epochs)
+    assert r.metrics["w"] == pytest.approx(
+        epochs * float(sum(range(1, n + 1))))
+    if "grow" in types:
+        assert r.metrics["world"] == 3
+
+
+def test_streaming_default_trainer_path(ray_start_regular):
+    """DatasetConfig defaults: datasets= flow through StreamingIngest (not
+    streaming_split) and get_dataset_shard returns an IngestShard."""
+    from ray_tpu import train
+    from ray_tpu.data.ingest import IngestShard
+
+    ds = data.range(48, parallelism=6).map_batches(
+        lambda b: {"x": b["id"].astype(np.float64)})
+    seen = {}
+
+    def loop(config):
+        shard = train.get_dataset_shard("train")
+        seen["type"] = type(shard).__name__
+        seen["dcfg"] = train.get_dataset_config().streaming
+        total = sum(float(np.sum(b["x"]))
+                    for b in shard.iter_batches(batch_size=8))
+        train.report({"total": total})
+
+    trainer = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=1),
+                         datasets={"train": ds})
+    result = trainer.fit()
+    assert result.error is None
+    assert seen["type"] == IngestShard.__name__
+    assert seen["dcfg"] is True
+    assert result.metrics["total"] == float(sum(range(48)))
+    assert trainer.streaming_ingests["train"].exhausted()
+
+
+def test_streaming_false_keeps_legacy_split(ray_start_regular):
+    from ray_tpu import train
+    from ray_tpu.data.dataset import DataIterator
+
+    ds = data.range(48, parallelism=6)
+    seen = {}
+
+    def loop(config):
+        seen["type"] = type(train.get_dataset_shard("train")).__name__
+        count = sum(len(b["id"])
+                    for b in train.get_dataset_shard("train").iter_batches(
+                        batch_size=8))
+        train.report({"count": count})
+
+    result = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1),
+        datasets={"train": ds},
+        dataset_config=DatasetConfig(streaming=False)).fit()
+    assert result.error is None
+    assert seen["type"] == DataIterator.__name__
+    assert result.metrics["count"] == 48
