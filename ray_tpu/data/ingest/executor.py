@@ -175,9 +175,13 @@ def fetch_block(ref, retries: int = FETCH_RETRIES,
             ingest_metrics.FETCH_RETRIES.inc()
             continue
         acc = BlockAccessor(block)
-        ingest_metrics.ROWS.inc(acc.num_rows())
+        nrows = acc.num_rows()
+        if nrows:  # Counter.inc rejects 0 — empty blocks are legal here
+            ingest_metrics.ROWS.inc(nrows)
         try:
-            ingest_metrics.BYTES.inc(acc.size_bytes())
+            nbytes = acc.size_bytes()
+            if nbytes:
+                ingest_metrics.BYTES.inc(nbytes)
         except Exception:
             pass
         return block

@@ -54,9 +54,43 @@ class _GaugeCounter:
             value = self._value
         self._gauge.set(value)
 
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
     def peak(self) -> int:
         with self._lock:
             return self._peak
+
+
+class _ResidentBytes:
+    """One epoch's share of the shared window counter, releasable.
+
+    Adds flow through to the gauge; ``release()`` atomically zeroes the
+    epoch's balance and returns it to the gauge, so an epoch abandoned
+    mid-stream (elastic stop, IngestAborted, a consumer breaking out of
+    ``iter_batches``) cannot leave its resident blocks counted in
+    WINDOW_BYTES/peak_window_bytes forever.  Called from both the
+    pipeline's thread (the prefetch pump's teardown) and the consumer
+    thread (``_iter_epoch``'s finally) — whichever side runs last
+    releases what the other missed; double release is a no-op.
+    """
+
+    def __init__(self, window: _GaugeCounter):
+        self._window = window
+        self._lock = threading.Lock()
+        self._bytes = 0  # guarded_by: _lock
+
+    def add(self, n: int) -> None:
+        with self._lock:
+            self._bytes += n
+        self._window.add(n)
+
+    def release(self) -> None:
+        with self._lock:
+            n, self._bytes = self._bytes, 0
+        if n:
+            self._window.add(-n)
 
 
 class _EpochState:
@@ -81,30 +115,51 @@ class _ShardTracker:
     earlier and a restore to a committed step could seal rows it never
     trained (silent loss); tag later and a fully-consumed shard would
     requeue on a grow (double-train).  Rows yielded but never followed by
-    a report stay provisional and requeue — the safe direction."""
+    a report stay provisional and requeue — the safe direction.
+
+    Threading: with prefetch on (the default) ``entered()`` and
+    ``shard_produced()`` run on the pump thread while ``block_done()``
+    runs on the consumer thread — the shuffle window can emit a shard's
+    early blocks for consumption while its later blocks are still
+    entering — so the counters are lock-guarded and the consumed
+    transition is decided under the lock (exactly one side observes it).
+    """
 
     def __init__(self, ledger: SampleLedger, session=None):
         self._ledger = ledger
         self._session = session
-        self._blocks: Dict[int, int] = {}   # pos -> blocks not yet consumed
-        self._produced: Dict[int, int] = {}  # pos -> total blocks, when known
+        self._lock = threading.Lock()
+        #: pos -> blocks in flight past entry, not yet consumed
+        self._blocks: Dict[int, int] = {}  # guarded_by: _lock
+        #: pos -> total blocks, once the shard fully produced
+        self._produced: Dict[int, int] = {}  # guarded_by: _lock
 
     def entered(self, pos: int) -> None:
-        self._blocks[pos] = self._blocks.get(pos, 0) + 1
+        with self._lock:
+            self._blocks[pos] = self._blocks.get(pos, 0) + 1
 
     def shard_produced(self, pos: int, n_blocks: int) -> None:
-        self._produced[pos] = n_blocks
-        if self._blocks.get(pos, 0) == 0:
-            self._consumed(pos)
+        with self._lock:
+            self._produced[pos] = n_blocks
+            consumed = self._consumed_locked(pos)
+        if consumed:
+            self._retag(pos)
 
     def block_done(self, pos: int) -> None:
-        self._blocks[pos] -= 1
-        if self._blocks[pos] == 0 and pos in self._produced:
-            self._consumed(pos)
+        with self._lock:
+            self._blocks[pos] -= 1
+            consumed = self._consumed_locked(pos)
+        if consumed:
+            self._retag(pos)
 
-    def _consumed(self, pos: int) -> None:
+    def _consumed_locked(self, pos: int) -> bool:
+        if self._blocks.get(pos, 0) != 0 or pos not in self._produced:
+            return False
         self._blocks.pop(pos, None)
-        self._produced.pop(pos, None)
+        del self._produced[pos]
+        return True
+
+    def _retag(self, pos: int) -> None:
         step = (self._session.current_checkpoint_step()
                 if self._session is not None else None)
         self._ledger.retag((pos,), step)
@@ -193,6 +248,12 @@ class StreamingIngest:
         buffers across all workers — the soak test's RSS-bound proxy."""
         return self._window.peak()
 
+    @property
+    def resident_window_bytes(self) -> int:
+        """Bytes currently counted resident across all epochs/workers;
+        returns to zero once every epoch finishes or is released."""
+        return self._window.value()
+
     def make_shard(self, session=None) -> "IngestShard":
         return IngestShard(self, session)
 
@@ -217,8 +278,17 @@ class StreamingIngest:
     def seal(self, committed_step: int) -> int:
         return sum(st.ledger.seal(committed_step) for st in self._states())
 
-    def seal_all(self) -> int:
-        return sum(st.ledger.seal_all() for st in self._states())
+    def finish(self) -> int:
+        """Clean finish: seal every claim that actually trained (retagged
+        with a real step at the yield of its last batch) and roll back
+        claims still tagged PROVISIONAL_STEP — shards the prefetch pump
+        claimed whose batches the user loop never consumed (e.g. a
+        fixed-steps loop breaking out of ``iter_batches`` mid-epoch) must
+        not audit as trained.  A blanket ``seal_all`` here would report
+        never-trained shards as trained.  Returns how many never-consumed
+        claims were rolled back."""
+        return sum(st.ledger.rollback(PROVISIONAL_STEP - 1)
+                   for st in self._states())
 
     def rollback(self, restore_step: Optional[int]) -> int:
         return sum(st.ledger.rollback(restore_step)
@@ -262,7 +332,7 @@ class StreamingIngest:
         st = self._epoch_state(epoch)
         tracker = _ShardTracker(st.ledger, session)
         fence = session.stop_requested if session is not None else None
-        window = self._window
+        resident = _ResidentBytes(self._window)
 
         def plan_iter():
             while True:
@@ -285,7 +355,7 @@ class StreamingIngest:
                 except Exception:
                     nbytes = 0
                 tracker.entered(pos)
-                window.add(nbytes)
+                resident.add(nbytes)
                 yield pos, block, nbytes
 
         salt = (session.context.world_rank + 1) if session is not None else 0
@@ -296,10 +366,21 @@ class StreamingIngest:
 
         def blocks_out():
             for pos, block, nbytes in shuffled:
-                window.add(-nbytes)
+                resident.add(-nbytes)
                 yield pos, block
 
-        tagged = _rebatch_tracked(blocks_out(), batch_size, batch_format)
+        def released(it):
+            # Runs on the chain's own thread (the prefetch pump when
+            # prefetch is on): whether the pipeline ends normally
+            # (residual already 0), raises, or is closed after an
+            # abandoned epoch, this epoch's residual leaves the gauge.
+            try:
+                yield from it
+            finally:
+                resident.release()
+
+        tagged = released(_rebatch_tracked(blocks_out(), batch_size,
+                                           batch_format))
         depth = (self._prefetch_batches if prefetch_batches is None
                  else prefetch_batches)
         prefetcher = HostPrefetcher(tagged, depth=depth,
@@ -334,6 +415,12 @@ class StreamingIngest:
         finally:
             if isinstance(prefetcher, HostPrefetcher):
                 prefetcher.close()
+            # Consumer-side backstop: without prefetch the chain runs on
+            # THIS thread and is merely suspended here, so its own finally
+            # has not fired; with prefetch the pump's teardown release may
+            # lag — drain what is resident now, the pump releases the rest
+            # at its exit (release() is an atomic drain, never double).
+            resident.release()
 
 
 class IngestShard:

@@ -58,14 +58,19 @@ def parquet_range_tasks(path: str, shards_per_file: int,
     """Read tasks covering ``path``'s row groups in contiguous ranges."""
     import pyarrow.parquet as pq
 
+    def read_all(path=path):
+        return pq.read_table(path, columns=columns)
+
     shards = max(1, int(shards_per_file))
     if shards == 1:
-        def read_all(path=path):
-            return pq.read_table(path, columns=columns)
-
         return [read_all]
     n_groups = pq.ParquetFile(path).metadata.num_row_groups
-    shards = min(shards, max(1, n_groups))
+    if n_groups == 0:
+        # No row groups to range over (empty file): keep the single
+        # read_all task so the file still contributes its (empty) block —
+        # and its schema — instead of silently dropping out of the plan.
+        return [read_all]
+    shards = min(shards, n_groups)
     bounds = [n_groups * i // shards for i in range(shards + 1)]
 
     def make_task(lo: int, hi: int):

@@ -384,7 +384,11 @@ class DataParallelTrainer:
                     for ledger in ledgers.values():
                         ledger.seal_all()  # clean finish: nothing rolls back
                     for ingest in ingests.values():
-                        ingest.seal_all()
+                        # Not seal_all: shard claims the prefetch pump made
+                        # but whose batches the user loop never consumed
+                        # (a fixed-steps loop breaking out of iter_batches)
+                        # roll back so audit() never reports them trained.
+                        ingest.finish()
                     return Result(
                         metrics=outcome["last_metrics"],
                         checkpoint=(manager.latest_checkpoint()

@@ -223,6 +223,94 @@ class TestStreamingIngest:
 
 
 # --------------------------------------------------------------------------
+# cross-thread accounting (review regressions)
+# --------------------------------------------------------------------------
+class TestCrossThreadAccounting:
+    def test_shard_tracker_cross_thread_consistency(self):
+        """entered()/shard_produced() fire on the prefetch pump thread
+        while block_done() fires on the consumer thread; concurrent
+        non-atomic updates must not lose the consumed transition (shard
+        stuck provisional -> double-train on requeue) or fire it early
+        (sealed-but-untrained -> silent loss)."""
+        from ray_tpu.data.ingest.ingest import _ShardTracker
+
+        n_shards, n_blocks = 8, 200
+        led = SampleLedger(list(range(n_shards)))
+        assert led.claim(n_shards, step=PROVISIONAL_STEP) is not None
+        tracker = _ShardTracker(led)
+        sem = threading.Semaphore(0)
+
+        def pump():
+            for pos in range(n_shards):
+                for _ in range(n_blocks):
+                    tracker.entered(pos)
+                    sem.release()
+                # Races against the consumer's block_done(pos) for the
+                # same shard — the review's lost-update interleaving.
+                tracker.shard_produced(pos, n_blocks)
+
+        def consume():
+            for pos in range(n_shards):
+                for _ in range(n_blocks):
+                    sem.acquire()
+                    tracker.block_done(pos)
+
+        threads = [threading.Thread(target=pump),
+                   threading.Thread(target=consume)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        # Every shard consumed exactly once and fully retired.
+        assert led.trained_counts() == {p: 1 for p in range(n_shards)}
+        assert led.double_trained() == [] and led.untrained() == []
+        assert tracker._blocks == {} and tracker._produced == {}
+
+    def test_abandoned_epoch_releases_window_bytes(self, ray_start_regular):
+        """Breaking out of iter_batches mid-epoch (elastic stop, fixed-step
+        loop) must return the epoch's resident blocks to the WINDOW_BYTES
+        accounting instead of inflating it forever."""
+        ds = data.range(256, parallelism=16)
+        ing = StreamingIngest(ds, window_blocks=4, seed=7,
+                              prefetch_batches=2)
+        it = iter(ing.make_shard().iter_batches(batch_size=8))
+        next(it)
+        next(it)
+        assert ing.resident_window_bytes > 0
+        it.close()  # abandon the epoch mid-stream
+        deadline = time.monotonic() + 10
+        while ing.resident_window_bytes and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ing.resident_window_bytes == 0, (
+            "abandoned epoch leaked resident window bytes")
+
+    def test_finish_rolls_back_never_consumed_claims(self, ray_start_regular):
+        """Clean-finish accounting: shards the prefetch pump claimed whose
+        batches the user loop never consumed must not audit as trained."""
+        ds = data.range(64, parallelism=8)
+        ing = StreamingIngest(ds, window_blocks=2, seed=5,
+                              prefetch_batches=2, seal_on_claim=False)
+        it = iter(ing.make_shard().iter_batches(batch_size=8))
+        consumed = []
+        for _ in range(2):  # a fixed-steps loop breaking out mid-epoch
+            consumed.extend(next(it)["id"].tolist())
+        it.close()
+        assert ing.finish() >= 1, (
+            "pump over-claim expected: claims never consumed must roll back")
+        audit = ing.audit(0)
+        assert audit["double_trained"] == []
+        # A shard may audit trained only if EVERY one of its rows was in a
+        # yielded batch (8-row contiguous source shards of range(64)).
+        got = set(consumed)
+        for shard in audit["trained_counts"]:
+            rows = set(range(8 * shard, 8 * shard + 8))
+            assert rows <= got, (
+                f"shard {shard} audited trained but rows {rows - got} "
+                "were never consumed")
+
+
+# --------------------------------------------------------------------------
 # SampleLedger.retag (provisional shard claims)
 # --------------------------------------------------------------------------
 class TestRetag:
@@ -457,6 +545,39 @@ class TestOffsetShardedReaders:
         ds2 = data.read_parquet(path, shards_per_file=64)
         assert len(ds2._op.read_tasks) == 10
         assert sorted(r["i"] for r in ds2.iter_rows()) == list(range(1000))
+
+    def test_parquet_zero_row_groups_not_dropped(self, ray_start_regular,
+                                                 tmp_path):
+        """A parquet file with zero row groups (schema-only) must still
+        yield one read task under shards_per_file > 1 — dropping it would
+        silently lose the file's schema contribution from the plan."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from ray_tpu.data.ingest.readers import parquet_range_tasks
+
+        path = str(tmp_path / "empty.parquet")
+        pq.ParquetWriter(path, pa.schema([("i", pa.int64())])).close()
+        assert pq.ParquetFile(path).metadata.num_row_groups == 0
+        tasks = parquet_range_tasks(path, shards_per_file=4)
+        assert len(tasks) == 1
+        tbl = tasks[0]()
+        assert tbl.num_rows == 0 and tbl.schema.names == ["i"]
+        ds = data.read_parquet(path, shards_per_file=4)
+        assert list(ds.iter_rows()) == []
+        # And the empty block flows through the streaming path: fetch_block
+        # must tolerate 0-row/0-byte blocks (Counter.inc rejects 0).
+        pq.write_table(pa.table({"i": np.arange(50)}),
+                       str(tmp_path / "data.parquet"), row_group_size=10)
+        mixed = data.read_parquet(str(tmp_path), shards_per_file=4)
+        ing = StreamingIngest(mixed, window_blocks=2, seed=8,
+                              prefetch_batches=2)
+        rows = sorted(int(v)
+                      for b in ing.make_shard().iter_batches(batch_size=16)
+                      for v in np.asarray(b["i"]).tolist())
+        assert rows == list(range(50))
+        audit = ing.audit(0)
+        assert audit["double_trained"] == [] and audit["untrained"] == []
 
     def test_sharded_file_through_ingest(self, ray_start_regular, tmp_path):
         """One big file + shards_per_file: the single-file dataset still
