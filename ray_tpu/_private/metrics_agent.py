@@ -99,6 +99,20 @@ class MetricsAgent:
                         self._send(um.registry().prometheus_text().encode(),
                                    "text/plain; version=0.0.4; charset=utf-8")
                         return
+                    if path == "/timeseries":
+                        # Sliding-window rollups (util/metrics_agent.py):
+                        # each scrape samples the registry into the process
+                        # aggregator, so the window fills at scrape cadence.
+                        sample_runtime(agent._runtime)
+                        from ray_tpu.util.metrics_agent import get_aggregator
+
+                        agg = get_aggregator()
+                        agg.sample_registry()
+                        self._send(
+                            agg.openmetrics_text().encode(),
+                            "application/openmetrics-text; version=1.0.0; "
+                            "charset=utf-8")
+                        return
                     if path.startswith("/api"):
                         payload = _api_payload(agent._runtime, path)
                         if payload is None:
@@ -404,6 +418,7 @@ def _api_payload(runtime, path: str):
         "/api/objects": state_api.list_objects,
         "/api/nodes": state_api.list_nodes,
         "/api/placement_groups": state_api.list_placement_groups,
+        "/api/train_runs": state_api.list_train_runs,
     }
     fn = listings.get(path)
     if fn is not None:

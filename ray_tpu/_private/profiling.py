@@ -37,14 +37,23 @@ def _rt_driver_id(rt):
     return rt.job_id
 
 
+#: Span-name prefixes folded into the shared "train" timeline lane: one
+#: Perfetto process row holds training steps, their wait buckets, elastic
+#: recoveries, checkpoint phases and ingest transfers TOGETHER, so a
+#: shrink -> restore -> resume sequence (with its starved steps) reads as
+#: one story instead of thousands of per-trace rows.
+_TRAIN_LANE_PREFIXES = ("train.", "checkpoint.", "data.")
+
+
 def spans_to_chrome_events(spans: List[dict]) -> List[dict]:
     """Fold util.tracing spans into chrome-tracing "X" (complete) events.
 
     Rows group by trace: ``pid`` is the trace id (Perfetto renders one
     process lane per trace — a whole serve request reads top-to-bottom),
     ``tid`` is the span's name so sibling spans of the same kind share a
-    track.  Unfinished spans (end=None) are skipped — an open span has no
-    duration yet."""
+    track.  Training-plane spans (train./checkpoint./data.) instead share
+    the single "train" pid — see _TRAIN_LANE_PREFIXES.  Unfinished spans
+    (end=None) are skipped — an open span has no duration yet."""
     out: List[dict] = []
     for s in spans:
         if s.get("end") is None:
@@ -53,10 +62,15 @@ def spans_to_chrome_events(spans: List[dict]) -> List[dict]:
                 "parent_id": s.get("parent_id"),
                 "status": s.get("status", "OK")}
         args.update(s.get("attributes") or {})
+        name = s.get("name", "")
+        if name.startswith(_TRAIN_LANE_PREFIXES):
+            pid = "train"
+        else:
+            pid = f"trace:{s.get('trace_id', '')[:8]}"
         ev = {
             "ph": "X", "cat": "trace",
-            "name": s.get("name", ""),
-            "pid": f"trace:{s.get('trace_id', '')[:8]}",
+            "name": name,
+            "pid": pid,
             "tid": s.get("name", ""),
             "ts": s["start"] * 1e6,
             "dur": max(0.0, (s["end"] - s["start"]) * 1e6),

@@ -19,6 +19,7 @@ the commit can never include a half-written shard.
 from __future__ import annotations
 
 import logging
+import sys
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -30,6 +31,16 @@ from ray_tpu.checkpoint import metrics as ckpt_metrics
 from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
+
+
+def _profiler_record(bucket: str, start: float, end: float) -> None:
+    """Attribute an interval to the train step profiler when one is active
+    on this thread.  Probed via sys.modules — the checkpoint layer must not
+    import the train package (trainer -> collective import chain), and if
+    the profiler module was never imported, none can be active."""
+    mod = sys.modules.get("ray_tpu.train.profiler")
+    if mod is not None:
+        mod.record(bucket, start, end)
 
 
 def _invoke(coordinator, method: str, *args):
@@ -87,12 +98,16 @@ class ShardWriter:
         """Snapshot now, persist in the background; blocks only for the
         device->host copy."""
         t0 = time.monotonic()
+        w0 = time.time()
         with tracing.span("checkpoint.save",
                           attributes={"step": step, "shard": self.shard_id,
                                       "phase": "snapshot"}):
             host_tree = snapshot_to_host(tree)
         block = time.monotonic() - t0
         ckpt_metrics.SAVE_BLOCK_SECONDS.observe(block, tags={"mode": "async"})
+        # The snapshot is the only save work blocking the training step —
+        # attribute exactly it to the step profiler's ckpt_block bucket.
+        _profiler_record("ckpt_block", w0, w0 + block)
         future = self._exec.submit(self._persist, step, host_tree)
         return SaveHandle(future, step, block)
 
@@ -124,7 +139,7 @@ class ShardWriter:
                               self.world_size, self.epoch)
                 manifest = layout.write_shard(tmp, self.shard_id, doc,
                                               skeleton, kind, arrays, step)
-                ckpt_metrics.BYTES_WRITTEN.inc(max(1, manifest["bytes"]))
+                ckpt_metrics.BYTES_WRITTEN.inc(manifest["bytes"])
                 self._put_replica(step, doc, skeleton, kind, arrays)
                 _invoke(self.coordinator, "shard_complete", step,
                         self.shard_id, manifest, self.epoch)

@@ -20,10 +20,23 @@ GroupManager picks the tier automatically.
 
 from __future__ import annotations
 
+import sys
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
+
+
+def _profiler_record(bucket: str, start: float, end: float) -> None:
+    """Attribute an interval to the train step profiler when one is active
+    on this thread (each rank's contribute runs on its worker thread).
+    Probed via sys.modules — the collective layer must not import the train
+    package (the trainer imports collective, not the reverse), and if the
+    profiler module was never imported, none can be active."""
+    mod = sys.modules.get("ray_tpu.train.profiler")
+    if mod is not None:
+        mod.record(bucket, start, end)
 
 
 class ReduceOp:
@@ -52,6 +65,18 @@ class _Rendezvous:
 
     def contribute(self, rank: int, value: Any, run_fn, participants=None,
                    on_timeout=None) -> Any:
+        # Contribute-to-result wall time is this rank's collective-sync
+        # cost: waiting for stragglers plus (on the last rank) the compiled
+        # op itself — the step profiler's "collective" bucket.
+        w0 = time.time()
+        try:
+            return self._contribute(rank, value, run_fn, participants,
+                                    on_timeout)
+        finally:
+            _profiler_record("collective", w0, time.time())
+
+    def _contribute(self, rank: int, value: Any, run_fn, participants=None,
+                    on_timeout=None) -> Any:
         members = participants if participants is not None else list(range(self.world_size))
         with self.lock:
             if rank in self.slots:

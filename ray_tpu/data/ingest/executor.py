@@ -175,13 +175,9 @@ def fetch_block(ref, retries: int = FETCH_RETRIES,
             ingest_metrics.FETCH_RETRIES.inc()
             continue
         acc = BlockAccessor(block)
-        nrows = acc.num_rows()
-        if nrows:  # Counter.inc rejects 0 — empty blocks are legal here
-            ingest_metrics.ROWS.inc(nrows)
+        ingest_metrics.ROWS.inc(acc.num_rows())  # inc(0) is a no-op
         try:
-            nbytes = acc.size_bytes()
-            if nbytes:
-                ingest_metrics.BYTES.inc(nbytes)
+            ingest_metrics.BYTES.inc(acc.size_bytes())
         except Exception:
             pass
         return block

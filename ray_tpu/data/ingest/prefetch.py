@@ -18,6 +18,7 @@ Two stages, independently optional:
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 import time
 from typing import Any, Dict, Iterable, Iterator, Optional
@@ -28,6 +29,17 @@ from ray_tpu.exceptions import WorkerCrashedError
 from ray_tpu.util import tracing
 
 _END = ("end", None)
+
+
+def _profiler_record(bucket: str, start: float, end: float) -> None:
+    """Attribute an interval to the train step profiler when one is active
+    on this thread (the consumer side of the pipeline IS the train worker
+    thread).  Probed via sys.modules — the data layer must not import the
+    train package (trainer -> collective import chain), and if the
+    profiler module was never imported, none can be active."""
+    mod = sys.modules.get("ray_tpu.train.profiler")
+    if mod is not None:
+        mod.record(bucket, start, end)
 
 
 class HostPrefetcher:
@@ -98,8 +110,10 @@ class HostPrefetcher:
                                 raise IngestAborted(
                                     "session stopped while the prefetch "
                                     "queue was starved")
-                    ingest_metrics.STARVED_SECONDS.inc(
-                        time.monotonic() - t0)
+                    starved = time.monotonic() - t0
+                    ingest_metrics.STARVED_SECONDS.inc(starved)
+                    w1 = time.time()
+                    _profiler_record("data_wait", w1 - starved, w1)
                 ingest_metrics.PREFETCH_OCCUPANCY.set(self._q.qsize())
                 if kind == "end":
                     return
@@ -139,16 +153,20 @@ class DeviceBatchIterator:
     def _transfer(self, batch: Dict[str, Any]) -> Dict[str, Any]:
         from ray_tpu._private import jax_compat
 
-        with tracing.span("data.prefetch"):
-            last: Optional[BaseException] = None
-            for _attempt in range(2):
-                try:
-                    fault_injection.check("data_ingest_prefetch")
-                    return jax_compat.device_put_batch(
-                        batch, sharding=self._sharding)
-                except WorkerCrashedError as e:
-                    last = e
-            raise last  # type: ignore[misc]
+        w0 = time.time()
+        try:
+            with tracing.span("data.prefetch"):
+                last: Optional[BaseException] = None
+                for _attempt in range(2):
+                    try:
+                        fault_injection.check("data_ingest_prefetch")
+                        return jax_compat.device_put_batch(
+                            batch, sharding=self._sharding)
+                    except WorkerCrashedError as e:
+                        last = e
+                raise last  # type: ignore[misc]
+        finally:
+            _profiler_record("h2d", w0, time.time())
 
     def __iter__(self) -> Iterator[Dict[str, Any]]:
         it = iter(self._src)

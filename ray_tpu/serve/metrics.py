@@ -128,6 +128,22 @@ def process_totals() -> Dict[str, Dict[str, float]]:
     return out
 
 
+def request_rate(deployment: str, window_s: float = 60.0,
+                 now: Optional[float] = None) -> float:
+    """Requests/second for a deployment over the trailing window, from the
+    process-wide TimeSeriesAggregator (util/metrics_agent.py) — the signal
+    the utilization-aware autoscaler (ROADMAP item 1) scales on.  The
+    aggregator must be fed on a cadence (the agent's ``/timeseries`` scrape
+    or an explicit ``sample_registry()``); returns 0.0 before any samples
+    land — cold start reads as "no traffic", never an error."""
+    from ray_tpu.util.metrics_agent import get_aggregator
+
+    agg = get_aggregator()
+    agg.sample_registry()
+    return agg.window_rate("serve_requests_total",
+                           {"deployment": deployment}, window_s, now)
+
+
 def rollup(snapshots: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     """p50/p95/p99 + request/error totals from per-pid snapshots — the
     serve.status() / /api/serve latency rollup."""

@@ -10,6 +10,8 @@ from ray_tpu.train.config import (
     ScalingConfig,
 )
 from ray_tpu.train.elastic import ElasticDatasetShard, SampleLedger
+from ray_tpu.train.profiler import StepProfiler, active_profiler
+from ray_tpu.train.profiler import configure as configure_profiler
 from ray_tpu.train.session import (
     get_checkpoint,
     get_context,
@@ -23,7 +25,8 @@ from ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, Result
 __all__ = [
     "Checkpoint", "CheckpointManager", "CheckpointConfig", "DataParallelTrainer",
     "DatasetConfig", "ElasticConfig", "ElasticDatasetShard", "FailureConfig", "JaxTrainer",
-    "Result", "RunConfig", "SampleLedger", "ScalingConfig",
+    "Result", "RunConfig", "SampleLedger", "ScalingConfig", "StepProfiler",
+    "active_profiler", "configure_profiler",
     "get_checkpoint", "get_context", "get_dataset_config",
     "get_dataset_shard", "load_pytree",
     "report", "save_pytree", "TorchTrainer",

@@ -139,3 +139,10 @@ class RunConfig:
     #: Tune/experiment callbacks — logger integrations live here (ref: air
     #: RunConfig.callbacks; `ray_tpu.air.integrations` wandb/mlflow/TBX).
     callbacks: Optional[list] = None
+    #: Step-time attribution (docs/observability.md): every worker gets a
+    #: StepProfiler that splits each step's wall time into data-wait /
+    #: h2d / compute / collective-sync / checkpoint-block buckets, exports
+    #: the ray_tpu_train_* gauges (MFU, tokens/s, step percentiles) and —
+    #: when tracing is on — emits train.* spans into the timeline.  Costs
+    #: a few timestamps per step; set False to strip even that.
+    profile: bool = True

@@ -27,8 +27,11 @@ never double-trained).
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.train import profiler as _profiler
 
 #: Claim tag for work whose final training step is not known at claim time
 #: (streaming ingest claims a whole source shard up front and only learns
@@ -220,10 +223,16 @@ class ElasticDatasetShard:
         if self._session is not None:
             step = self._session.current_checkpoint_step()
             fence = self._session.stop_requested
-        indices = self._ledger.claim(batch_size, step, fence=fence)
-        if indices is None:
-            return None
-        return indices, self._ledger.fetch(indices)
+        # Claim + fetch is the worker's input-pipeline time on the elastic
+        # (non-streaming) path — the step profiler's data_wait bucket.
+        w0 = time.time()
+        try:
+            indices = self._ledger.claim(batch_size, step, fence=fence)
+            if indices is None:
+                return None
+            return indices, self._ledger.fetch(indices)
+        finally:
+            _profiler.record("data_wait", w0, time.time())
 
     def iter_batches(self, batch_size: int):
         while True:

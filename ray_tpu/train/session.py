@@ -12,6 +12,7 @@ import queue
 import threading
 from typing import Any, Dict, Optional
 
+from ray_tpu.train import profiler as _profiler
 from ray_tpu.train.checkpoint import Checkpoint
 
 _local = threading.local()
@@ -56,7 +57,7 @@ class TrainSession:
                  checkpoint_to_restore: Optional[Checkpoint] = None,
                  dataset_shards: Optional[Dict[str, Any]] = None,
                  shard_writer=None, start_step: int = 0,
-                 dataset_config=None):
+                 dataset_config=None, profiler=None):
         self.context = context
         self.results: "queue.Queue" = queue.Queue()
         self.checkpoint_to_restore = checkpoint_to_restore
@@ -79,6 +80,10 @@ class TrainSession:
         #: checkpoint and no error.
         self.async_saves_reported = 0
         self.last_save_handle = None
+        #: ray_tpu.train.profiler.StepProfiler when step profiling is on
+        #: (RunConfig.profile, the default) — activated on the worker
+        #: thread with the session itself; report() is its step boundary.
+        self.profiler = profiler
 
     def current_checkpoint_step(self) -> int:
         """The checkpoint step the NEXT report() will save as — the step
@@ -108,16 +113,22 @@ class TrainSession:
                 checkpoint = Checkpoint.from_pytree(checkpoint)
         self.results.put({"metrics": metrics, "checkpoint": checkpoint,
                           "rank": self.context.world_rank})
+        # report() IS the step boundary: close the profiled step (spans +
+        # live gauges) now that its checkpoint-block time is recorded.
+        if self.profiler is not None:
+            self.profiler.step_boundary()
         if self.stop_requested.is_set():
             raise StopIteration("Training stopped by the controller")
 
 
 def init_session(session: TrainSession) -> None:
     _local.session = session
+    _profiler.activate(getattr(session, "profiler", None))
 
 
 def clear_session() -> None:
     _local.session = None
+    _profiler.activate(None)
 
 
 def get_session() -> Optional[TrainSession]:

@@ -41,10 +41,13 @@ from ray_tpu import collective
 from ray_tpu._private import fault_injection
 from ray_tpu.exceptions import RayTpuError, TaskError
 from ray_tpu.train import metrics as train_metrics
+from ray_tpu.train import run_registry
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import DatasetConfig, RunConfig, ScalingConfig
 from ray_tpu.train.elastic import ElasticDatasetShard, SampleLedger
+from ray_tpu.train.profiler import StepProfiler
 from ray_tpu.train.session import TrainContext, TrainSession, clear_session, init_session
+from ray_tpu.util import tracing
 from ray_tpu.util.placement_group import (
     PlacementGroupSchedulingStrategy,
     placement_group,
@@ -325,6 +328,14 @@ class DataParallelTrainer:
         elastic = scfg.elastic
         cur_world = scfg.num_workers
         elastic_events: List[Dict[str, Any]] = []
+        # State API: the run is visible to list_train_runs() (and the
+        # /api/train_runs route) for its whole lifetime — world size,
+        # committed step and elastic events are kept current below.
+        run_registry.register_run(run_name, world_size=cur_world,
+                                  target_world=cur_world,
+                                  path=experiment_path,
+                                  elastic=elastic is not None)
+        attempt_no = 1
         self._recovery_t0 = None
         self._recovery_event = None
         # Elastic data plane: every sized dataset becomes a shared
@@ -381,6 +392,7 @@ class DataParallelTrainer:
                                             ingests=ingests)
                 history.extend(outcome["history"])
                 if outcome["status"] == "finished":
+                    run_registry.finish_run(run_name, "finished")
                     for ledger in ledgers.values():
                         ledger.seal_all()  # clean finish: nothing rolls back
                     for ingest in ingests.values():
@@ -418,9 +430,12 @@ class DataParallelTrainer:
                              "to_world": new_world, "restore_step": step,
                              "time": time.time()}
                     elastic_events.append(event)
+                    run_registry.record_event(run_name, event)
                     self._recovery_t0 = time.monotonic()
                     self._recovery_event = event
                     cur_world = new_world
+                    attempt_no += 1
+                    run_registry.update_run(run_name, attempts=attempt_no)
                     if step is not None:
                         last_restore_step = step
                     continue
@@ -449,8 +464,7 @@ class DataParallelTrainer:
                     if last_step is not None:
                         lost = max(0, last_step
                                    - (step if step is not None else -1))
-                    if lost:
-                        train_metrics.LOST_STEPS.inc(lost)
+                    train_metrics.LOST_STEPS.inc(lost)  # inc(0) is a no-op
                     if target < cur_world:
                         train_metrics.SHRINK_EVENTS.inc()
                     event = {"type": "shrink" if target < cur_world else "recover",
@@ -458,6 +472,7 @@ class DataParallelTrainer:
                              "restore_step": step, "lost_steps": lost,
                              "requeued_samples": requeued, "time": time.time()}
                     elastic_events.append(event)
+                    run_registry.record_event(run_name, event)
                     self._recovery_t0 = outcome.get("failed_at") or time.monotonic()
                     self._recovery_event = event
                     cur_world = target
@@ -476,6 +491,7 @@ class DataParallelTrainer:
                 # "fatal" = retrying cannot help (e.g. infeasible resources):
                 # return even under max_failures=-1 instead of spinning forever.
                 if exhausted or fatal:
+                    run_registry.finish_run(run_name, "failed")
                     return Result(
                         metrics=outcome["last_metrics"],
                         checkpoint=(manager.latest_checkpoint()
@@ -501,7 +517,14 @@ class DataParallelTrainer:
                     # own epoch 0: ingest epochs must start fresh too.
                     for ingest in ingests.values():
                         ingest.reset()
+                attempt_no += 1
+                run_registry.update_run(run_name, attempts=attempt_no)
         finally:
+            # A raise out of the attempt loop (controller bug, KeyboardInterrupt)
+            # must not leave the registry row "running" forever.
+            row = run_registry.get_run(run_name)
+            if row is not None and row["status"] == "running":
+                run_registry.finish_run(run_name, "failed")
             if coordinator is not None:
                 try:
                     ray_tpu.kill(coordinator)
@@ -725,6 +748,7 @@ class DataParallelTrainer:
         ledgers = ledgers or {}
         ingests = ingests or {}
         train_metrics.WORLD_SIZE.set(world)
+        run_registry.update_run(run_name, world_size=world)
         dataset_shards = self._split_datasets(
             world, exclude=set(ledgers) | set(ingests))
         writers: List = []
@@ -752,7 +776,11 @@ class DataParallelTrainer:
                                    dataset_shards=dataset_shards[rank],
                                    shard_writer=writers[rank] if writers else None,
                                    start_step=start_step,
-                                   dataset_config=self.dataset_config)
+                                   dataset_config=self.dataset_config,
+                                   profiler=(StepProfiler(run_name=run_name,
+                                                          rank=rank)
+                                             if self.run_config.profile
+                                             else None))
             # Elastic datasets are views onto the shared ledger, bound to
             # THIS session so claims carry its next checkpoint step.
             for name, ledger in ledgers.items():
@@ -814,6 +842,8 @@ class DataParallelTrainer:
                     last_seal = now
                     committed = self._committed_step(coordinator)
                     if committed is not None:
+                        run_registry.update_run(
+                            run_name, last_committed_step=committed)
                         for ledger in ledgers.values():
                             ledger.seal(committed)
                         for ingest in ingests.values():
@@ -882,6 +912,8 @@ class DataParallelTrainer:
             if (ledgers or ingests) and coordinator is not None:
                 committed = self._committed_step(coordinator)
                 if committed is not None:
+                    run_registry.update_run(
+                        run_name, last_committed_step=committed)
                     for ledger in ledgers.values():
                         ledger.seal(committed)
                     for ingest in ingests.values():
@@ -1091,6 +1123,15 @@ class DataParallelTrainer:
         if drained and self._recovery_t0 is not None:
             dt = time.monotonic() - self._recovery_t0
             train_metrics.RECOVERY_SECONDS.observe(dt)
+            ev = self._recovery_event or {}
+            # Timeline lane: the whole failure->resumed window as one span,
+            # so a trace shows shrink/grow gaps between train.step rows.
+            now_w = time.time()
+            tracing.record_span("train.elastic", now_w - dt, now_w,
+                                attributes={"type": ev.get("type", ""),
+                                            "from_world": ev.get("from_world"),
+                                            "to_world": ev.get("to_world"),
+                                            "restore_step": ev.get("restore_step")})
             if self._recovery_event is not None:
                 self._recovery_event["recovery_seconds"] = dt
             self._recovery_t0 = None

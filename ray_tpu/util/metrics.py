@@ -83,8 +83,14 @@ class Counter(Metric):
         super().__init__(name, description, tag_keys)
 
     def inc(self, value: float = 1.0, tags: Optional[TagMap] = None) -> None:
-        if value <= 0:
-            raise ValueError("Counter.inc requires value > 0")
+        if value < 0:
+            raise ValueError("Counter.inc requires value >= 0")
+        if value == 0:
+            # No-op, not an error: natural zero increments (an empty block,
+            # a batch of zero retries) shouldn't force callers to guard or
+            # lie with max(1, x).  The series is not created either — a
+            # counter that never counted anything has nothing to export.
+            return
         merged = self._check_tags(tags)
         k = _tag_key(merged)
         with self._lock:

@@ -178,6 +178,33 @@ def list_replicas(filters: Optional[Sequence[Filter]] = None,
     return _apply_filters(rows, filters, limit)
 
 
+# --------------------------------------------------------- train runs
+def list_train_runs(filters: Optional[Sequence[Filter]] = None,
+                    limit: int = 10_000) -> List[dict]:
+    """Train-run rows from the controller's run registry: name, status,
+    live world size vs target, last committed checkpoint step, elastic
+    shrink/grow events (docs/observability.md).  The training counterpart
+    of list_deployments.  Probed via sys.modules — importing the train
+    package here would drag the trainer (and collective) into every state
+    query; if it was never imported, no run can exist.  Works without a
+    runtime: rows live in this process, not in runtime tables."""
+    import sys
+
+    registry = sys.modules.get("ray_tpu.train.run_registry")
+    if registry is None:
+        return []
+    return _apply_filters(registry.list_runs(), filters, limit)
+
+
+def get_train_run(name: str) -> Optional[dict]:
+    import sys
+
+    registry = sys.modules.get("ray_tpu.train.run_registry")
+    if registry is None:
+        return None
+    return registry.get_run(str(name))
+
+
 # --------------------------------------------------------- placement groups
 def list_placement_groups(filters: Optional[Sequence[Filter]] = None,
                           limit: int = 10_000) -> List[dict]:
@@ -200,4 +227,5 @@ __all__ = [
     "list_objects", "summarize_objects",
     "list_nodes", "list_placement_groups",
     "list_deployments", "list_replicas",
+    "list_train_runs", "get_train_run",
 ]

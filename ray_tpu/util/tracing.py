@@ -65,6 +65,13 @@ SPAN_REGISTRY: Dict[str, str] = {
     "checkpoint.restore": "restore_pytree entry",
     "data.ingest": "ingest: one source shard, first pull -> last block out",
     "data.prefetch": "ingest: host->device transfer dispatch, per batch",
+    "train.step": "profiler: one training step, report() to report()",
+    "train.data_wait": "profiler: step blocked on the input pipeline",
+    "train.h2d": "profiler: host->device batch transfer within a step",
+    "train.compute": "profiler: step compute residual (wall - waits)",
+    "train.collective": "profiler: gradient-sync rendezvous within a step",
+    "train.ckpt_block": "profiler: device->host snapshot blocking a step",
+    "train.elastic": "controller: elastic recovery, failure -> resumed",
 }
 
 

@@ -1,0 +1,145 @@
+"""Profiler overhead bench: StepProfiler must be ~free on a real step.
+
+Runs the same jitted GPT-2 train step twice — bare, then with an active
+StepProfiler closing a step window per iteration (spans enabled, i.e. the
+worst configuration) — and compares median step times.  The acceptance
+gate is <= 2% overhead: the profiler is always-on by default
+(RunConfig.profile=True), so it must never show up in the step time it
+measures.  Also checks the attribution invariant on the profiled run:
+every row's buckets sum to its wall exactly.
+
+Writes BENCH_PROFILER.json next to the repo root and exits nonzero when
+the gate fails.
+
+  python scripts/bench_profiler.py                 # tiny config, CPU-ok
+  python scripts/bench_profiler.py --config small --steps 40
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+# NOTE: do NOT use PYTHONPATH for this — setting it breaks the axon TPU
+# plugin's registration on this image.  sys.path works fine.
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "BENCH_PROFILER.json")
+
+
+def _interleaved_times(step, params, opt_state, tokens, targets, n, prof):
+    """Alternate bare and profiled steps so clock drift / thermal ramp /
+    background load lands on both sets equally — a sequential A-then-B
+    layout reads environment drift as profiler overhead."""
+    from ray_tpu.train import profiler as train_profiler
+
+    bare, profiled = [], []
+    for i in range(2 * n):
+        with_prof = i % 2 == 1
+        if with_prof:
+            train_profiler.activate(prof)
+        try:
+            t0 = time.perf_counter()
+            params, opt_state, loss = step(params, opt_state, tokens, targets)
+            float(loss)  # device sync
+            if with_prof:
+                prof.record("data_wait", time.time() - 1e-4, time.time())
+                prof.step_boundary()
+            (profiled if with_prof else bare).append(time.perf_counter() - t0)
+        finally:
+            if with_prof:
+                train_profiler.activate(None)
+    return bare, profiled
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", choices=("tiny", "small"), default="tiny")
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--gate-pct", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.train.profiler import StepProfiler
+    from ray_tpu.util import tracing
+
+    config = (gpt2.GPTConfig.tiny() if args.config == "tiny"
+              else gpt2.GPTConfig.small())
+    B, S = args.batch, config.seq_len
+    opt = gpt2.make_optimizer()
+    params = gpt2.init_params(config, jax.random.key(0))
+    opt_state = opt.init(params)
+    step = jax.jit(gpt2.make_train_step(config, opt), donate_argnums=(0, 1))
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, config.vocab_size, (B, S + 1), dtype=np.int64)
+    t = jnp.asarray(toks, jnp.int32)
+    tokens, targets = t[:, :-1], t[:, 1:]
+
+    # Compile + warm outside the measured window.
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    float(loss)
+
+    prof = StepProfiler(run_name="bench", rank=0,
+                        flops_per_step=gpt2.flops_per_token(config) * B * S,
+                        tokens_per_step=B * S, peak_flops=197e12)
+    tracing.clear_spans()
+    tracing.enable_tracing()  # worst case: span emission on every boundary
+    try:
+        bare, profiled = _interleaved_times(step, params, opt_state,
+                                            tokens, targets, args.steps, prof)
+    finally:
+        tracing.disable_tracing()
+        tracing.clear_spans()
+
+    med_bare = statistics.median(bare)
+    med_prof = statistics.median(profiled)
+    overhead_pct = (med_prof - med_bare) / med_bare * 100.0
+
+    # Attribution invariant: buckets + compute == wall on every row.
+    rows = list(prof.history)
+    max_err = max((abs(sum(r[b] for b in
+                           ("data_wait", "h2d", "collective", "ckpt_block",
+                            "compute")) - r["wall"]) / r["wall"])
+                  for r in rows)
+
+    result = {
+        "bench": "profiler_overhead",
+        "config": args.config,
+        "batch": B,
+        "seq_len": S,
+        "steps": args.steps,
+        "backend": jax.default_backend(),
+        "median_step_ms_bare": round(med_bare * 1e3, 4),
+        "median_step_ms_profiled": round(med_prof * 1e3, 4),
+        "overhead_pct": round(overhead_pct, 3),
+        "gate_pct": args.gate_pct,
+        "bucket_sum_max_rel_err": max_err,
+        "profiled_rows": len(rows),
+        "passed": overhead_pct <= args.gate_pct and max_err < 1e-9,
+    }
+    with open(OUT, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result, indent=2), flush=True)
+    if not result["passed"]:
+        print(f"FAIL: overhead {overhead_pct:.2f}% > gate {args.gate_pct}% "
+              f"or attribution drift {max_err:.2e}", file=sys.stderr)
+        return 1
+    print(f"OK: profiler overhead {overhead_pct:+.2f}% "
+          f"(gate {args.gate_pct}%)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

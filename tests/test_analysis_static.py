@@ -87,16 +87,27 @@ def test_registries_loaded_from_source(analyzer_result):
     assert ctx.span_names | set(ctx.span_prefixes) == set(SPAN_REGISTRY)
 
 
-def test_mfu_probe_scripts_excluded_by_config():
+def test_mfu_probe_consolidated_and_analyzer_clean():
+    """The probe family collapsed into one flag-driven script: the old
+    numbered variants stay gone, the survivor no longer needs a config
+    exclusion, and it scans clean without one."""
+    from ray_tpu.devtools import analysis
     from ray_tpu.devtools.analysis import core
 
-    probes = [f for f in os.listdir(os.path.join(REPO, "scripts"))
-              if f.startswith("mfu_probe")]
-    assert probes, "expected mfu_probe scripts in scripts/"
-    files = list(core.iter_python_files([os.path.join(REPO, "scripts")],
-                                        exclude=_config_excludes()))
-    assert not any(os.path.basename(f).startswith("mfu_probe")
-                   for f in files)
+    scripts = os.path.join(REPO, "scripts")
+    probes = sorted(f for f in os.listdir(scripts)
+                    if f.startswith(("mfu_probe", "mfu_sweep")))
+    assert probes == ["mfu_probe.py"], (
+        f"expected only the consolidated probe, found {probes}")
+    assert not _config_excludes(), (
+        "analysis.cfg excludes should be empty — fix or baseline findings "
+        "instead of excluding files")
+    probe = os.path.join(scripts, "mfu_probe.py")
+    assert probe in set(core.iter_python_files([scripts],
+                                               exclude=_config_excludes()))
+    findings, _ = analysis.run([probe], analysis.make_checkers(), root=REPO)
+    assert not findings, "mfu_probe.py findings:\n" + "\n".join(
+        f.render() for f in findings)
 
 
 def _analyze_main():

@@ -52,7 +52,9 @@ def test_metric_tag_validation():
     with pytest.raises(ValueError):
         c.inc(1, {"unknown": "v"})
     with pytest.raises(ValueError):
-        c.inc(0)
+        c.inc(-1)  # negatives are fatal; inc(0) is a no-op (PR 10)
+    c.inc(0)
+    assert c.get() == 0.0
     c.set_default_tags({"k": "default"})
     c.inc(1)
     assert any(t.get("k") == "default" for _, t, _ in c.samples())
