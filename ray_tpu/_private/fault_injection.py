@@ -68,6 +68,11 @@ FAULT_POINTS: Dict[str, str] = {
     "preempt_node": "trainer controller tick — a whole worker-group node is "
                     "preempted (actors killed + node removed), simulating a "
                     "TPU slice vanishing",
+    # llm inference engine (tests/test_serve_llm.py)
+    "llm_block_alloc": "KV-block pool allocation — the scheduler's "
+                       "preemption/backoff paths absorb the failure",
+    "llm_kv_handoff": "prefill→decode KV-page import on the decode "
+                      "replica — the frontend re-prefills on a survivor",
     # streaming ingest (tests/test_data_ingest.py)
     "data_ingest_fetch": "block materialization in the ingest stream — the "
                          "fetch retries (bounded) before surfacing to the "

@@ -1713,8 +1713,16 @@ class Runtime:
         sem = asyncio.Semaphore(max(1, state.spec.max_concurrency))
 
         async def run_one(spec: TaskSpec):
-            async with sem:
-                await self._execute_actor_task_async(state, spec)
+            try:
+                async with sem:
+                    await self._execute_actor_task_async(state, spec)
+            except asyncio.CancelledError:
+                # Cancelled while still queued on the concurrency
+                # semaphore — the executor never saw this call, so its
+                # refs must be resolved here or the caller hangs.
+                self._fail_task(spec, ActorDiedError(cause=state.death_cause),
+                                retry=False)
+                raise
 
         async def pump():
             while True:
@@ -1736,6 +1744,18 @@ class Runtime:
 
         try:
             loop.run_until_complete(pump())
+            # The actor is dead (pump only returns on the death sentinel):
+            # calls still executing on this loop would otherwise be
+            # abandoned with their refs forever unresolved — every caller
+            # blocked in get()/get_async() on them would hang.  Cancel
+            # them and run the cancellations to completion so each call
+            # fails over to ActorDiedError (see _execute_actor_task_async).
+            pending = [t for t in asyncio.all_tasks(loop) if not t.done()]
+            for task in pending:
+                task.cancel()
+            if pending:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True))
         finally:
             loop.close()
 
@@ -1819,6 +1839,13 @@ class Runtime:
         except _ActorExit:
             self._store_results(spec, None)
             self._kill_actor_state(state, ActorDiedError("exit_actor() was called"), no_restart=True)
+        except asyncio.CancelledError:
+            # The actor died with this call in flight (kill/preemption
+            # cancels the loop's tasks on the way down): resolve the refs
+            # with the death cause — callers classify ActorDiedError as
+            # retryable, a bare TaskError they would surface to the user.
+            self._fail_task(spec, ActorDiedError(cause=state.death_cause),
+                            retry=False)
         except BaseException as e:  # noqa: BLE001
             self._fail_task(spec, TaskError(e, task_repr=spec.name), retry=False)
 

@@ -103,6 +103,9 @@ class ReplicaWrapper:
         #: Why this replica left RUNNING ("unhealthy", "dead") — feeds the
         #: unhealthy gauge while it drains.
         self.unhealthy_reason: Optional[str] = None
+        #: Model ids loaded in this replica's multiplex LRU (pushed by the
+        #: replica on load/eviction) — routers prefer warm replicas.
+        self.multiplexed_model_ids: List[str] = []
         # Health-probe FSM (controller side).  The FIRST probe runs while
         # still STARTING: a replica enters RUNNING (and the routing table)
         # only after initialize + one successful check_health, which is
@@ -420,7 +423,8 @@ class DeploymentState:
     def running_replicas(self) -> List[Dict[str, Any]]:
         return [{"replica_id": r.replica_id, "actor": r.actor,
                  "max_ongoing_requests": self.info.config.max_ongoing_requests,
-                 "max_queued_requests": self.info.config.max_queued_requests}
+                 "max_queued_requests": self.info.config.max_queued_requests,
+                 "multiplexed_model_ids": list(r.multiplexed_model_ids)}
                 for r in self.replicas if r.state == ReplicaState.RUNNING]
 
     @property
@@ -467,6 +471,21 @@ class DeploymentStateManager:
     def delete(self, deployment_id: str) -> None:
         if deployment_id in self.deployments:
             self.deployments[deployment_id].delete()
+
+    def record_multiplexed_model_ids(self, replica_id: str,
+                                     model_ids: List[str]) -> bool:
+        """Stamp a replica's loaded multiplex ids and flag its deployment
+        changed (the next reconcile tick pushes the new replica set to
+        routers).  Replica ids are unique across deployments, so a scan
+        suffices.  Returns False for unknown/departed replicas."""
+        for state in self.deployments.values():
+            for r in state.replicas:
+                if r.replica_id == replica_id:
+                    if r.multiplexed_model_ids != list(model_ids):
+                        r.multiplexed_model_ids = list(model_ids)
+                        state._changed = True
+                    return True
+        return False
 
     def reconcile(self) -> Dict[str, List[Dict[str, Any]]]:
         """Tick all deployments; return {deployment_id: running_replicas}

@@ -85,11 +85,11 @@ class DeploymentResponseGenerator:
         #: though iteration already marked _finished.
         self._server_done = False
 
-    def _finish(self) -> None:
+    def _finish(self, exc: Optional[BaseException] = None) -> None:
         if not self._finished:
             self._finished = True
             if self._on_done is not None:
-                self._on_done()
+                self._on_done(exc)
 
     def _resolve_sid(self) -> str:
         if self._sid is None:
@@ -116,7 +116,7 @@ class DeploymentResponseGenerator:
 
             if isinstance(e, TaskError):
                 self._server_done = True
-            self._finish()
+            self._finish(e)
             raise
         if kind == "done":
             self._server_done = True
@@ -143,7 +143,7 @@ class DeploymentResponseGenerator:
 
             if isinstance(e, TaskError):
                 self._server_done = True
-            self._finish()
+            self._finish(e)
             raise
         if kind == "done":
             self._server_done = True
@@ -191,6 +191,7 @@ class DeploymentHandle:
         self._router = None
         self._router_lock = threading.Lock()
         self._stream = False
+        self._multiplexed_model_id = ""
 
     @property
     def deployment_id(self) -> str:
@@ -209,7 +210,9 @@ class DeploymentHandle:
             return self._router
 
     def options(self, *, method_name: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
         # Materialize the router BEFORE sharing: if the child built it, the
         # parent's _router would stay None and a duplicate Router (extra
         # long-poll + metrics threads, split queue accounting) would follow.
@@ -220,11 +223,19 @@ class DeploymentHandle:
         h._router = self._router
         h._router_lock = self._router_lock
         h._stream = self._stream if stream is None else bool(stream)
+        h._multiplexed_model_id = (self._multiplexed_model_id
+                                   if multiplexed_model_id is None
+                                   else multiplexed_model_id)
         return h
 
     def remote(self, *args, **kwargs):
         router = self._get_router()
         method = self._method_name
+        if self._multiplexed_model_id:
+            # Rides to the router (warm-replica preference) and on to the
+            # replica (request context for @serve.multiplexed loaders).
+            kwargs.setdefault("_serve_multiplexed_model_id",
+                              self._multiplexed_model_id)
         if self._stream:
             # Streaming (ref: handle.options(stream=True) → a generator of
             # results): every item is pulled from the pinned replica.
@@ -240,7 +251,8 @@ class DeploymentHandle:
     def __getstate__(self) -> Dict[str, Any]:
         return {"deployment_name": self.deployment_name,
                 "app_name": self.app_name, "_method_name": self._method_name,
-                "_stream": self._stream}
+                "_stream": self._stream,
+                "_multiplexed_model_id": self._multiplexed_model_id}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.deployment_name = state["deployment_name"]
@@ -250,6 +262,7 @@ class DeploymentHandle:
         self._router = None
         self._router_lock = threading.Lock()
         self._stream = state.get("_stream", False)
+        self._multiplexed_model_id = state.get("_multiplexed_model_id", "")
 
     def __getattr__(self, name: str):
         if name.startswith("_"):

@@ -1,0 +1,36 @@
+"""ray_tpu.serve.llm — the LLM inference engine subsystem.
+
+Composes the serve stack's continuous batching (PR 2), the committed
+checkpoint subsystem (PR 5), and the multiplex layer into a real
+inference engine (see docs/serving.md, "LLM engine"):
+
+* ``blocks``     — paged KV-cache: BlockAllocator / BlockTable
+  (refcounts, prefix-sharing forks, copy-on-write, FIFO determinism).
+* ``scheduler``  — EngineScheduler: headroom-gated prefill admission,
+  lowest-priority preemption with recompute-on-resume.
+* ``model``      — deterministic ToyLM reading context from the paged
+  cache (+ ``reference_generate`` oracle), adapter deltas.
+* ``engine``     — LLMEngine: the ``@serve.continuous_batch`` step.
+* ``handoff``    — prefill→decode KV-page transfer (object store or
+  compiled-DAG channel).
+* ``disagg``     — monolithic + prefill/decode-disaggregated
+  deployments, kill-recovering frontend relay.
+* ``store``      — checkpoint-backed model/adapter weight store.
+"""
+
+from ray_tpu.serve.llm.blocks import BlockAllocator, BlockTable, NoFreeBlocks
+from ray_tpu.serve.llm.engine import LLMEngine, compose_model_key
+from ray_tpu.serve.llm.handoff import (KVHandoffChannel, export_kv,
+                                       get_handoff, import_kv, put_handoff)
+from ray_tpu.serve.llm.model import ToyLM, lm_from_weights
+from ray_tpu.serve.llm.scheduler import EngineScheduler, Sequence
+from ray_tpu.serve.llm.store import (load_model_weights,
+                                     publish_model_weights)
+
+__all__ = [
+    "BlockAllocator", "BlockTable", "NoFreeBlocks", "LLMEngine",
+    "compose_model_key", "KVHandoffChannel", "export_kv", "get_handoff",
+    "import_kv", "put_handoff", "ToyLM", "lm_from_weights",
+    "EngineScheduler", "Sequence", "load_model_weights",
+    "publish_model_weights",
+]

@@ -1,0 +1,264 @@
+"""Paged KV-cache block pool (vLLM PagedAttention shape, host-side).
+
+A :class:`BlockAllocator` owns a preallocated pool of fixed-size KV blocks
+and hands out integer block ids; a :class:`BlockTable` maps one sequence's
+logical token positions onto those blocks.  Blocks are ref-counted so a
+forked sequence shares its prefix with the parent (``fork()``) and only
+materializes a private copy when it writes into a shared block
+(copy-on-write).  Allocation failure raises :class:`NoFreeBlocks` — the
+:class:`~ray_tpu.serve.llm.scheduler.EngineScheduler` turns that into
+preemption of the lowest-priority running sequence (recompute-on-resume).
+
+The pool stores the actual KV entries (one payload per token position) so
+a CPU toy model reads attention context straight out of the paged cache —
+which means block-table bugs corrupt generated tokens instead of hiding
+behind a simulation.  Free-list order is FIFO and deterministic, so tests
+can assert exact allocation/preemption traces.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from ray_tpu._private import fault_injection
+from ray_tpu.serve.llm import metrics as _m
+
+
+class NoFreeBlocks(RuntimeError):
+    """The pool cannot satisfy an allocation (caller should preempt)."""
+
+
+class BlockAllocator:
+    """Fixed pool of KV blocks with refcounting and copy-on-write.
+
+    Thread-safe: the continuous-batch engine steps on an executor thread
+    while handoff import/export may run on another, so every pool mutation
+    takes ``_lock``.  Nothing blocking happens under the lock.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, *,
+                 pool: str = "engine"):
+        if num_blocks <= 0 or block_size <= 0:
+            raise ValueError("num_blocks and block_size must be positive")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.pool = pool
+        self._lock = threading.Lock()
+        #: FIFO free list — deterministic reuse order.  # guarded_by: _lock
+        self._free: deque = deque(range(num_blocks))
+        #: block id -> refcount (>0 iff allocated).  # guarded_by: _lock
+        self._refcount: Dict[int, int] = {}
+        #: block id -> per-position KV payloads (len <= block_size).
+        # guarded_by: _lock
+        self._pages: List[Optional[List[Any]]] = [None] * num_blocks
+        _m.BLOCKS_TOTAL.set(num_blocks, tags={"pool": pool})
+        _m.BLOCKS_IN_USE.set(0, tags={"pool": pool})
+
+    # ------------------------------------------------------------------ pool
+
+    def allocate(self, n: int = 1) -> List[int]:
+        """Take ``n`` blocks (all-or-nothing).  Raises NoFreeBlocks when the
+        pool cannot cover the request — the scheduler's preemption signal."""
+        fault_injection.check("llm_block_alloc")
+        with self._lock:
+            if len(self._free) < n:
+                raise NoFreeBlocks(
+                    f"pool '{self.pool}': need {n} blocks, "
+                    f"{len(self._free)} free of {self.num_blocks}")
+            ids = [self._free.popleft() for _ in range(n)]
+            for b in ids:
+                self._refcount[b] = 1
+                self._pages[b] = []
+            in_use = len(self._refcount)
+        _m.BLOCK_ALLOCS.inc(n, tags={"pool": self.pool})
+        _m.BLOCKS_IN_USE.set(in_use, tags={"pool": self.pool})
+        return ids
+
+    def share(self, block_ids: List[int]) -> None:
+        """Bump refcounts — the caller now also owns these blocks."""
+        with self._lock:
+            for b in block_ids:
+                if self._refcount.get(b, 0) <= 0:
+                    raise ValueError(f"share of unallocated block {b}")
+                self._refcount[b] += 1
+
+    def free(self, block_ids: List[int]) -> None:
+        """Drop one reference per id; blocks return to the pool at zero."""
+        with self._lock:
+            for b in block_ids:
+                rc = self._refcount.get(b, 0)
+                if rc <= 0:
+                    raise ValueError(f"double free of block {b}")
+                if rc == 1:
+                    del self._refcount[b]
+                    self._pages[b] = None
+                    self._free.append(b)
+                else:
+                    self._refcount[b] = rc - 1
+            in_use = len(self._refcount)
+        _m.BLOCKS_IN_USE.set(in_use, tags={"pool": self.pool})
+
+    def refcount(self, block_id: int) -> int:
+        with self._lock:
+            return self._refcount.get(block_id, 0)
+
+    @property
+    def num_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def num_in_use(self) -> int:
+        with self._lock:
+            return len(self._refcount)
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        """Blocks required to hold ``num_tokens`` KV entries."""
+        return max(1, -(-num_tokens // self.block_size))
+
+    # ------------------------------------------------------------ page I/O
+
+    def append_entry(self, block_id: int, entry: Any) -> None:
+        with self._lock:
+            page = self._pages[block_id]
+            if page is None:
+                raise ValueError(f"append to unallocated block {block_id}")
+            if len(page) >= self.block_size:
+                raise ValueError(f"block {block_id} is full")
+            page.append(entry)
+
+    def read_entry(self, block_id: int, offset: int) -> Any:
+        with self._lock:
+            page = self._pages[block_id]
+            if page is None:
+                raise ValueError(f"read of unallocated block {block_id}")
+            return page[offset]
+
+    def page_len(self, block_id: int) -> int:
+        with self._lock:
+            page = self._pages[block_id]
+            return 0 if page is None else len(page)
+
+    def copy_block(self, block_id: int) -> int:
+        """Materialize a private copy of ``block_id`` (copy-on-write): a
+        fresh block with the same payloads; the source loses one ref."""
+        with self._lock:
+            src = self._pages[block_id]
+            if src is None:
+                raise ValueError(f"copy of unallocated block {block_id}")
+            if not self._free:
+                raise NoFreeBlocks(
+                    f"pool '{self.pool}': no free block for COW copy")
+            new_id = self._free.popleft()
+            self._refcount[new_id] = 1
+            self._pages[new_id] = list(src)
+            # Drop the forker's reference to the shared source block.
+            rc = self._refcount[block_id]
+            if rc == 1:
+                del self._refcount[block_id]
+                self._pages[block_id] = None
+                self._free.append(block_id)
+            else:
+                self._refcount[block_id] = rc - 1
+            in_use = len(self._refcount)
+        _m.COW_COPIES.inc(tags={"pool": self.pool})
+        _m.BLOCKS_IN_USE.set(in_use, tags={"pool": self.pool})
+        return new_id
+
+    def export_pages(self, block_ids: List[int]) -> List[List[Any]]:
+        """Snapshot page payloads for a handoff (copies, caller-owned)."""
+        with self._lock:
+            out = []
+            for b in block_ids:
+                page = self._pages[b]
+                if page is None:
+                    raise ValueError(f"export of unallocated block {b}")
+                out.append(list(page))
+            return out
+
+
+class BlockTable:
+    """One sequence's logical view onto the pool: ordered block ids plus
+    the token count.  Append handles block-boundary allocation and COW when
+    the tail block is shared with a forked sibling.
+
+    Not thread-safe — a table belongs to exactly one sequence, mutated
+    only by the engine step that owns it.
+    """
+
+    def __init__(self, allocator: BlockAllocator):
+        self.allocator = allocator
+        self.block_ids: List[int] = []
+        self.num_tokens = 0
+
+    def append(self, entry: Any) -> None:
+        """Append one KV entry, allocating (or COW-copying) as needed.
+        Raises NoFreeBlocks without mutating the table (safe to retry
+        after the scheduler preempts someone)."""
+        alloc = self.allocator
+        if self.num_tokens % alloc.block_size == 0:
+            # Tail block full (or table empty): grow by one block first.
+            self.block_ids.extend(alloc.allocate(1))
+        else:
+            tail = self.block_ids[-1]
+            if alloc.refcount(tail) > 1:
+                # Shared with a fork — write would leak into the sibling.
+                self.block_ids[-1] = alloc.copy_block(tail)
+        alloc.append_entry(self.block_ids[-1], entry)
+        self.num_tokens += 1
+
+    def get(self, index: int) -> Any:
+        if not 0 <= index < self.num_tokens:
+            raise IndexError(index)
+        bs = self.allocator.block_size
+        return self.allocator.read_entry(self.block_ids[index // bs],
+                                         index % bs)
+
+    def entries(self) -> Iterator[Any]:
+        for i in range(self.num_tokens):
+            yield self.get(i)
+
+    def fork(self) -> "BlockTable":
+        """A child table sharing every block (prefix sharing); diverging
+        writes copy-on-write via :meth:`append`."""
+        self.allocator.share(self.block_ids)
+        child = BlockTable(self.allocator)
+        child.block_ids = list(self.block_ids)
+        child.num_tokens = self.num_tokens
+        return child
+
+    def release(self) -> None:
+        """Return every block reference; the table becomes empty."""
+        if self.block_ids:
+            self.allocator.free(self.block_ids)
+        self.block_ids = []
+        self.num_tokens = 0
+
+    def export_pages(self) -> List[List[Any]]:
+        return self.allocator.export_pages(self.block_ids)
+
+    @classmethod
+    def from_pages(cls, allocator: BlockAllocator,
+                   pages: List[List[Any]]) -> "BlockTable":
+        """Rebuild a table from exported pages (decode-side of a KV
+        handoff).  All-or-nothing: frees its partial allocation if the
+        pool runs out midway."""
+        n = sum(len(p) for p in pages)
+        table = cls(allocator)
+        if not n:
+            return table
+        ids = allocator.allocate(len(pages))
+        try:
+            for b, page in zip(ids, pages):
+                if len(page) > allocator.block_size:
+                    raise ValueError("imported page exceeds block_size")
+                for entry in page:
+                    allocator.append_entry(b, entry)
+        except Exception:
+            allocator.free(ids)
+            raise
+        table.block_ids = ids
+        table.num_tokens = n
+        return table

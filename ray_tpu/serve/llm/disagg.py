@@ -1,0 +1,297 @@
+"""Monolithic and prefill/decode-disaggregated LLM deployments.
+
+The DistServe shape on ray_tpu actors: a **prefill pool** absorbs the
+long, bursty prompt work; a **decode pool** runs the steady inter-token
+loop; the KV pages cross between them as a handoff payload over the
+object plane (``serve/llm/handoff.py``).  A thin **frontend** relays the
+stream and owns recovery: if a decode replica dies mid-stream, the
+frontend re-prefills ``prompt + already-emitted`` on a survivor and
+resumes — the deterministic model regenerates the identical suffix, so
+the client stream is never torn or duplicated.
+
+``LLMServer`` is the monolithic baseline (prefill and decode interleaved
+in one continuous-batch engine) — the thing ``bench_serve.py --mode llm``
+compares the disaggregated topology against.
+
+All deployments share the multiplex loader: weights come from committed
+checkpoints (``store.py``) when ``ckpt_root`` is set, else from inline
+``model_specs``; ``model::adapter`` keys land in the same LRU.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import serve
+from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
+                                TaskError, WorkerCrashedError)
+from ray_tpu.serve._sync import run_in_executor
+from ray_tpu.serve.llm import metrics as _m
+from ray_tpu.serve.llm.blocks import BlockAllocator, BlockTable, NoFreeBlocks
+from ray_tpu.serve.llm.engine import LLMEngine, compose_model_key
+from ray_tpu.serve.llm.handoff import export_kv
+from ray_tpu.serve.llm.model import ToyLM, lm_from_weights
+from ray_tpu.util import tracing as _tracing
+
+#: Default inline model table (tests/bench run without a checkpoint root).
+DEFAULT_MODEL_SPECS: Dict[str, Dict[str, Any]] = {
+    "base": {"seed": 1234, "dim": 8},
+}
+
+
+def parse_llm_request(request: Any) -> Dict[str, Any]:
+    """Engine request dict from a handle argument or an HTTP Request
+    (``/?prompt=1,2,3&max_tokens=8&model=base&adapter=poet``)."""
+    if isinstance(request, dict):
+        return request
+    qp = getattr(request, "query_params", None)
+    if qp is not None:
+        out: Dict[str, Any] = {
+            "prompt": [int(t) for t in
+                       str(qp.get("prompt", "")).split(",") if t.strip()],
+            "max_tokens": int(qp.get("max_tokens", 16)),
+            "model": qp.get("model", "base"),
+        }
+        if qp.get("adapter"):
+            out["adapter"] = qp.get("adapter")
+        return out
+    raise TypeError(f"cannot parse LLM request from {type(request).__name__}")
+
+
+class _ModelHostMixin:
+    """Shared multiplex loader: checkpoint-backed weights with LRU
+    eviction through the model's ``close()`` unload hook."""
+
+    def _init_models(self, ckpt_root: Optional[str],
+                     model_specs: Optional[Dict[str, Dict[str, Any]]],
+                     prefill_time_per_token_s: float,
+                     decode_step_time_s: float) -> None:
+        self._ckpt_root = ckpt_root
+        self._specs = dict(DEFAULT_MODEL_SPECS if model_specs is None
+                           else model_specs)
+        self._device_lock = threading.Lock()
+        self._prefill_time_per_token_s = prefill_time_per_token_s
+        self._decode_step_time_s = decode_step_time_s
+
+    @serve.multiplexed(max_num_models_per_replica=4)
+    async def _load_model(self, model_key: str) -> ToyLM:
+        if self._ckpt_root:
+            from ray_tpu.serve.llm.store import load_model_weights
+
+            weights = await run_in_executor(load_model_weights,
+                                            self._ckpt_root, model_key)
+        else:
+            weights = self._specs.get(model_key)
+            if weights is None:
+                raise KeyError(f"unknown model key {model_key!r} (no "
+                               f"checkpoint root and no inline spec)")
+        return lm_from_weights(
+            weights, device_lock=self._device_lock,
+            prefill_time_per_token_s=self._prefill_time_per_token_s,
+            decode_step_time_s=self._decode_step_time_s)
+
+
+@serve.deployment(max_ongoing_requests=64)
+class LLMServer(_ModelHostMixin):
+    """Monolithic engine: prefill and decode interleave in one
+    continuous-batch loop — a long prompt's prefill stalls every other
+    stream's next token (the baseline disaggregation beats)."""
+
+    def __init__(self, ckpt_root: Optional[str] = None,
+                 model_specs: Optional[Dict[str, Any]] = None,
+                 num_blocks: int = 512, block_size: int = 16,
+                 watermark_blocks: int = 0, max_prefill_per_step: int = 1,
+                 prefill_time_per_token_s: float = 0.0,
+                 decode_step_time_s: float = 0.0):
+        self._init_models(ckpt_root, model_specs,
+                          prefill_time_per_token_s, decode_step_time_s)
+        self._engine = LLMEngine(
+            self._load_model, num_blocks=num_blocks, block_size=block_size,
+            watermark_blocks=watermark_blocks,
+            max_prefill_per_step=max_prefill_per_step, pool="engine")
+
+    @serve.continuous_batch(max_batch_size=16)
+    async def __call__(self, slots: List[Any]) -> List[Any]:
+        for s in slots:
+            if not isinstance(s.request, dict):
+                s.request = parse_llm_request(s.request)
+        return await self._engine.step(slots)
+
+
+@serve.deployment(max_ongoing_requests=8)
+class PrefillWorker(_ModelHostMixin):
+    """Prefill-heavy pool: burns the O(prompt) device time, exports the
+    KV pages, frees its local blocks — stateless between requests."""
+
+    def __init__(self, ckpt_root: Optional[str] = None,
+                 model_specs: Optional[Dict[str, Any]] = None,
+                 num_blocks: int = 512, block_size: int = 16,
+                 prefill_time_per_token_s: float = 0.0):
+        self._init_models(ckpt_root, model_specs,
+                          prefill_time_per_token_s, 0.0)
+        self._allocator = BlockAllocator(num_blocks, block_size,
+                                         pool="prefill")
+
+    async def prefill(self, request: Any) -> Dict[str, Any]:
+        req = parse_llm_request(request)
+        key = compose_model_key(req.get("model", "base"),
+                                req.get("adapter"))
+        model = await self._load_model(key)
+        context = [int(t) for t in req["prompt"]] \
+            + [int(t) for t in req.get("resume_generated", ())]
+        tok = None
+        for attempt in range(40):
+            table = BlockTable(self._allocator)
+            try:
+                with _tracing.span("serve.prefill",
+                                   attributes={"model": key,
+                                               "tokens": len(context)}):
+                    tok = await run_in_executor(model.prefill, table,
+                                                context)
+                break
+            except NoFreeBlocks:
+                # Pool exhausted by concurrent prefills: back off until a
+                # peer frees its export (asyncio sleep — the loop serves
+                # other requests meanwhile).
+                table.release()
+                await asyncio.sleep(0.005 * (attempt + 1))
+        if tok is None:
+            raise NoFreeBlocks("prefill pool exhausted after backoff")
+        _m.PREFILL_TOKENS.inc(len(context), tags={"pool": "prefill"})
+        generated = list(req.get("resume_generated", ())) + [tok]
+        payload = export_kv(table, prompt=req["prompt"],
+                            generated=generated,
+                            model=req.get("model", "base"),
+                            adapter=req.get("adapter"),
+                            max_tokens=int(req.get("max_tokens", 16)))
+        table.release()
+        return payload
+
+
+@serve.deployment(max_ongoing_requests=64)
+class DecodeWorker(_ModelHostMixin):
+    """Decode-heavy pool: imports handed-off KV pages and runs the
+    steady-state token loop under continuous batching."""
+
+    def __init__(self, ckpt_root: Optional[str] = None,
+                 model_specs: Optional[Dict[str, Any]] = None,
+                 num_blocks: int = 512, block_size: int = 16,
+                 watermark_blocks: int = 0,
+                 decode_step_time_s: float = 0.0):
+        self._init_models(ckpt_root, model_specs, 0.0, decode_step_time_s)
+        # Admission here is a page import, not a recompute — admit bursts
+        # of re-prefilled sequences in one iteration instead of trickling.
+        self._engine = LLMEngine(
+            self._load_model, num_blocks=num_blocks, block_size=block_size,
+            watermark_blocks=watermark_blocks, max_prefill_per_step=8,
+            pool="decode", decode_only=True)
+
+    @serve.continuous_batch(max_batch_size=16)
+    async def decode(self, slots: List[Any]) -> List[Any]:
+        return await self._engine.step(slots)
+
+
+def _stream_retryable(e: BaseException) -> bool:
+    """Did the decode stream die for a *replica* reason (crash, kill,
+    injected fault) rather than a request error?  Those re-prefill on a
+    survivor; anything else propagates to the client."""
+    if isinstance(e, (ActorDiedError, ActorUnavailableError,
+                      WorkerCrashedError)):
+        return True
+    cause = getattr(e, "cause", None)
+    return isinstance(e, TaskError) and isinstance(
+        cause, (ActorDiedError, ActorUnavailableError, WorkerCrashedError))
+
+
+@serve.deployment(max_ongoing_requests=64)
+class LLMFrontend:
+    """Relay: prefill -> KV handoff -> decode stream, with kill recovery.
+
+    Emits tokens exactly once: ``emitted`` tracks everything already
+    yielded; on a decode-replica death the relay re-prefills
+    ``prompt + emitted`` (deterministic recompute) and the replacement
+    stream continues from the next token — no tears, no duplicates.
+    """
+
+    def __init__(self, prefill: Any, decode: Any, max_restarts: int = 3):
+        self._prefill = prefill
+        self._decode = decode
+        self._max_restarts = max_restarts
+
+    async def __call__(self, request: Any):
+        req = parse_llm_request(request)
+        max_tokens = int(req.get("max_tokens", 16))
+        emitted: List[int] = []
+        restarts = 0
+        while len(emitted) < max_tokens:
+            payload = await self._prefill.options(
+                method_name="prefill").remote(
+                    {**req, "resume_generated": emitted})
+            for tok in payload["generated"][len(emitted):]:
+                emitted.append(tok)
+                yield tok
+            if len(emitted) >= max_tokens:
+                return
+            stream = self._decode.options(
+                stream=True, method_name="decode").remote(
+                    {**req, "handoff": payload})
+            try:
+                async for tok in stream:
+                    emitted.append(tok)
+                    yield tok
+                    if len(emitted) >= max_tokens:
+                        # The budget is known here — close the stream now
+                        # instead of paying one more engine iteration for
+                        # its EOS (the cancel reaps the slot and frees its
+                        # blocks on the decode replica).
+                        stream.cancel(wait=False)
+                        return
+                return
+            except BaseException as e:  # noqa: BLE001 — classify below
+                if not _stream_retryable(e) \
+                        or restarts >= self._max_restarts:
+                    raise
+                restarts += 1
+                # Loop: re-prefill prompt+emitted on a surviving replica.
+
+
+def build_disagg_app(*, ckpt_root: Optional[str] = None,
+                     model_specs: Optional[Dict[str, Any]] = None,
+                     prefill_replicas: int = 1, decode_replicas: int = 1,
+                     frontend_replicas: int = 1,
+                     num_blocks: int = 512, block_size: int = 16,
+                     prefill_time_per_token_s: float = 0.0,
+                     decode_step_time_s: float = 0.0) -> Any:
+    """Bind the prefill pool + decode pool + frontend into one app.
+
+    Frontends are thin relays holding no model state and no simulated
+    device — scale them freely to keep the per-token stream pulls off any
+    single event loop (the worker pools set the real capacity)."""
+    prefill = PrefillWorker.options(
+        num_replicas=prefill_replicas).bind(
+            ckpt_root=ckpt_root, model_specs=model_specs,
+            num_blocks=num_blocks, block_size=block_size,
+            prefill_time_per_token_s=prefill_time_per_token_s)
+    decode = DecodeWorker.options(
+        num_replicas=decode_replicas).bind(
+            ckpt_root=ckpt_root, model_specs=model_specs,
+            num_blocks=num_blocks, block_size=block_size,
+            decode_step_time_s=decode_step_time_s)
+    return LLMFrontend.options(
+        num_replicas=frontend_replicas).bind(prefill, decode)
+
+
+def build_monolithic_app(*, ckpt_root: Optional[str] = None,
+                         model_specs: Optional[Dict[str, Any]] = None,
+                         num_replicas: int = 1, num_blocks: int = 512,
+                         block_size: int = 16,
+                         prefill_time_per_token_s: float = 0.0,
+                         decode_step_time_s: float = 0.0) -> Any:
+    """The continuous-batching baseline on identical model timing."""
+    return LLMServer.options(num_replicas=num_replicas).bind(
+        ckpt_root=ckpt_root, model_specs=model_specs,
+        num_blocks=num_blocks, block_size=block_size,
+        prefill_time_per_token_s=prefill_time_per_token_s,
+        decode_step_time_s=decode_step_time_s)

@@ -1,0 +1,262 @@
+"""The LLM engine step: paged KV + scheduler, plugged into the PR 2
+continuous-batching loop.
+
+:class:`LLMEngine.step` is a ``@serve.continuous_batch``-shaped step
+function (``slots -> emissions``): the ``_Engine`` owns streams and
+iteration cadence, this engine owns memory (block pool), admission
+(prefill only under headroom), preemption, and the model calls.  Each
+:class:`~ray_tpu.serve.continuous.SequenceSlot` carries its
+:class:`~ray_tpu.serve.llm.scheduler.Sequence` in ``slot.state["llm"]`` —
+the state dict the continuous engine hands the step exactly for this.
+
+Requests are dicts::
+
+    {"prompt": [int, ...], "max_tokens": 16,
+     "model": "base", "adapter": None,        # -> multiplex key
+     "priority": 0,
+     "handoff": None}                         # set on the decode pool:
+                                              # imported KV pages replace
+                                              # the prefill recompute
+
+Micro-batches are always single-(model, adapter): decode groups by the
+composed multiplex key and runs one model pass per group, so adapter
+multiplexing composes with continuous batching the same way the batch
+queue keys on the request's model id.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Awaitable, Callable, Dict, List, Optional, Union
+
+from ray_tpu.serve._sync import run_in_executor
+from ray_tpu.serve.llm import metrics as _m
+from ray_tpu.serve.llm.blocks import BlockAllocator, BlockTable, NoFreeBlocks
+from ray_tpu.serve.llm.scheduler import (EngineScheduler, FINISHED, RUNNING,
+                                         Sequence)
+from ray_tpu.serve.llm.model import ToyLM
+from ray_tpu.util import tracing as _tracing
+
+#: get_model(model_key) -> ToyLM, sync or async (the multiplex loader).
+ModelProvider = Callable[[str], Union[ToyLM, Awaitable[ToyLM]]]
+
+
+def compose_model_key(model: str, adapter: Optional[str]) -> str:
+    """The multiplex key a request resolves to: ``model`` or
+    ``model::adapter`` — one key, one set of loaded weights."""
+    return f"{model}::{adapter}" if adapter else model
+
+
+class LLMEngine:
+    """Paged-KV inference engine; one per replica (or per pool role).
+
+    ``decode_only=True`` turns this into the decode side of a
+    disaggregated pair: requests must carry a ``handoff`` payload and
+    admission imports KV pages instead of prefilling.
+    """
+
+    def __init__(self, get_model: ModelProvider, *,
+                 num_blocks: int = 256, block_size: int = 16,
+                 watermark_blocks: int = 0, max_prefill_per_step: int = 1,
+                 max_running: Optional[int] = None,
+                 default_max_tokens: int = 16,
+                 pool: str = "engine", decode_only: bool = False):
+        self._get_model = get_model
+        self.allocator = BlockAllocator(num_blocks, block_size, pool=pool)
+        self.scheduler = EngineScheduler(self.allocator,
+                                         watermark_blocks=watermark_blocks,
+                                         max_running=max_running)
+        self.max_prefill_per_step = max_prefill_per_step
+        self.default_max_tokens = default_max_tokens
+        self.decode_only = decode_only
+        #: id(slot) -> (slot, seq): every stream this engine has seen and
+        #: not yet retired — reaped on cancellation each iteration.
+        self._tracked: Dict[int, Any] = {}
+
+    # --------------------------------------------------------- plumbing
+
+    async def _model(self, model_key: str) -> ToyLM:
+        out = self._get_model(model_key)
+        if inspect.isawaitable(out):
+            out = await out
+        return out
+
+    def _make_sequence(self, request: Any) -> Sequence:
+        if not isinstance(request, dict) or "prompt" not in request:
+            raise TypeError(
+                "LLM engine requests are dicts with a 'prompt' token list")
+        handoff = request.get("handoff")
+        seq = Sequence(
+            [int(t) for t in request["prompt"]],
+            int(request.get("max_tokens", self.default_max_tokens)),
+            priority=int(request.get("priority", 0)),
+            model_key=compose_model_key(request.get("model", "base"),
+                                        request.get("adapter")),
+            handoff=handoff)
+        if handoff is not None:
+            # Decode-side resume: the prefill pool already generated (and
+            # the relay already emitted) these tokens.
+            seq.generated = [int(t) for t in handoff["generated"]]
+            seq.num_emitted = len(seq.generated)
+        elif self.decode_only:
+            raise TypeError("decode-only engine requires a 'handoff' "
+                            "payload on every request")
+        return seq
+
+    # ------------------------------------------------------------- step
+
+    async def step(self, slots: List[Any]) -> List[Any]:
+        """One continuous-batch iteration over the live slots."""
+        self._reap()
+        # Admit brand-new streams into the scheduler's waiting queue.
+        for slot in slots:
+            if "llm" not in slot.state:
+                try:
+                    seq = self._make_sequence(slot.request)
+                except Exception as e:  # noqa: BLE001 — bad request
+                    slot.state["llm"] = e
+                    continue
+                slot.state["llm"] = seq
+                self._tracked[id(slot)] = (slot, seq)
+                self.scheduler.add(seq)
+
+        admitted = self.scheduler.admit(max_new=self.max_prefill_per_step)
+        just_prefilled = set()
+        for seq in admitted:
+            try:
+                if seq.handoff is not None:
+                    # Imported sequences join THIS step's decode groups:
+                    # their pages are ready and their next token needs a
+                    # decode pass, not a recompute — skipping an iteration
+                    # here is pure added time-to-first-decode-token.
+                    self._import_handoff(seq)
+                else:
+                    just_prefilled.add(id(seq))
+                    await self._prefill(seq)
+            except Exception as e:  # noqa: BLE001 — isolate to the stream
+                self.scheduler.finish(seq)
+                seq.error = e
+
+        # Decode every running sequence whose slot is in this iteration
+        # (backpressured slots keep their blocks but are not stepped),
+        # skipping the ones prefill just advanced.
+        present = {id(s.state.get("llm")) for s in slots}
+        steppable = [
+            s for s in self.scheduler.ensure_decode_headroom()
+            if id(s) in present and id(s) not in just_prefilled
+            and not s.finished
+        ]
+        by_model: Dict[str, List[Sequence]] = {}
+        for seq in steppable:
+            by_model.setdefault(seq.model_key, []).append(seq)
+        for model_key, group in by_model.items():
+            model = await self._model(model_key)
+            with _tracing.span("serve.decode",
+                               attributes={"model": model_key,
+                                           "batch": len(group)}):
+                await run_in_executor(self._decode_group, model, group)
+
+        # Release blocks the moment a sequence hits its token budget; the
+        # final token (and EOS) drain from `generated` on later iterations.
+        for seq in list(self.scheduler.running):
+            if seq.finished:
+                self.scheduler.finish(seq)
+
+        return [self._emission(slot) for slot in slots]
+
+    # ----------------------------------------------------------- phases
+
+    async def _prefill(self, seq: Sequence) -> None:
+        """Recompute-capable prefill: KV entries for the whole context
+        (prompt + any pre-preemption generations) plus one new token."""
+        model = await self._model(seq.model_key)
+        context = seq.context()
+        table = BlockTable(self.allocator)
+        with _tracing.span("serve.prefill",
+                           attributes={"model": seq.model_key,
+                                       "tokens": len(context)}):
+            try:
+                tok = await run_in_executor(model.prefill, table, context)
+            except NoFreeBlocks:
+                # Admission raced another consumer of the pool (e.g. a
+                # concurrent handoff import): roll back and requeue.
+                table.release()
+                self.scheduler.preempt_seq(seq)
+                return
+            except Exception:
+                # Any other mid-prefill failure (injected fault, model
+                # error): the table was never attached to the sequence, so
+                # its partial allocation must be returned here.
+                table.release()
+                raise
+        seq.table = table
+        seq.generated.append(tok)
+        _m.PREFILL_TOKENS.inc(len(context),
+                              tags={"pool": self.allocator.pool})
+
+    def _import_handoff(self, seq: Sequence) -> None:
+        """Decode-side admission: rebuild the block table from exported
+        KV pages instead of recomputing the prefill."""
+        from ray_tpu.serve.llm import handoff as _handoff
+
+        seq.table = _handoff.import_kv(seq.handoff, self.allocator)
+        seq.handoff = None
+
+    def _decode_group(self, model: ToyLM, group: List[Sequence]) -> None:
+        """One simulated device pass for a single-(model, adapter) group;
+        runs on an executor thread (the sleep inside decode_burn must not
+        block the replica loop)."""
+        model.decode_burn()
+        n = 0
+        for seq in group:
+            try:
+                seq.generated.append(model.decode_one(seq.table))
+                n += 1
+            except NoFreeBlocks:
+                # Headroom check raced a concurrent pool consumer —
+                # recompute-on-resume rather than wedging the loop.
+                self.scheduler.preempt_seq(seq)
+            except Exception as e:  # noqa: BLE001 — isolate to the stream
+                # (e.g. an injected allocation fault) — the rest of the
+                # group keeps decoding; this stream surfaces the error.
+                self.scheduler.finish(seq)
+                seq.error = e
+        if n:
+            _m.DECODE_TOKENS.inc(n, tags={"pool": self.allocator.pool})
+
+    # -------------------------------------------------------- emissions
+
+    def _emission(self, slot: Any) -> Any:
+        from ray_tpu.serve.continuous import EOS
+
+        seq = slot.state.get("llm")
+        if isinstance(seq, Exception):
+            slot.state.pop("llm", None)
+            return seq
+        if seq is None:
+            return None
+        err = getattr(seq, "error", None)
+        if err is not None:
+            self._untrack(slot, seq)
+            return err
+        tok = seq.pop_emission()
+        if tok is not None:
+            return tok
+        if seq.finished or seq.status == FINISHED:
+            self.scheduler.finish(seq)
+            self._untrack(slot, seq)
+            return EOS
+        return None
+
+    def _untrack(self, slot: Any, seq: Sequence) -> None:
+        self._tracked.pop(id(slot), None)
+
+    def _reap(self) -> None:
+        """Free sequences whose consumer vanished (client disconnect sets
+        ``slot._cancelled``; the continuous loop stops passing the slot,
+        so cleanup has to happen here or the blocks leak)."""
+        dead = [k for k, (slot, _) in self._tracked.items()
+                if getattr(slot, "_cancelled", False)]
+        for k in dead:
+            _, seq = self._tracked.pop(k)
+            self.scheduler.finish(seq)

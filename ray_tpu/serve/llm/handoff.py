@@ -1,0 +1,122 @@
+"""Prefill→decode KV-page handoff (the DistServe seam).
+
+After the prefill pool computes a sequence's KV pages, the pages move to
+a decode replica as a plain payload dict — by default riding the object
+store (actor call return / explicit ``ray_tpu.put`` ref), or through a
+compiled-DAG channel when both ends sit in a compiled graph
+(:class:`KVHandoffChannel`).  The decode engine rebuilds a local
+:class:`~ray_tpu.serve.llm.blocks.BlockTable` from the pages, so long
+prompts burn prefill-pool time while the decode loop's inter-token
+cadence never stalls.
+
+The payload is self-describing — prompt, generated-so-far, model key —
+so a survivor can re-prefill from scratch when a decode replica dies
+mid-stream (kill recovery re-derives the identical suffix from the
+deterministic model).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private import fault_injection
+from ray_tpu.serve.llm import metrics as _m
+from ray_tpu.serve.llm.blocks import BlockAllocator, BlockTable
+from ray_tpu.util import tracing as _tracing
+
+
+def _payload_bytes(pages: List[List[Any]]) -> int:
+    total = 0
+    for page in pages:
+        for entry in page:
+            total += getattr(entry, "nbytes", 0) or np.asarray(entry).nbytes
+    return total
+
+
+def export_kv(table: BlockTable, *, prompt: List[int],
+              generated: List[int], model: str = "base",
+              adapter: Optional[str] = None,
+              max_tokens: int = 16) -> Dict[str, Any]:
+    """Snapshot a prefilled sequence into a handoff payload.  The pages
+    cover the whole context (prompt + generated, including the KV entry
+    of the last generated token), so the decode side resumes with zero
+    recompute."""
+    start = time.time()
+    pages = table.export_pages()
+    payload = {
+        "pages": pages,
+        "prompt": list(prompt),
+        "generated": list(generated),
+        "model": model,
+        "adapter": adapter,
+        "max_tokens": int(max_tokens),
+        "nbytes": _payload_bytes(pages),
+    }
+    _tracing.record_span("serve.kv_handoff", start, time.time(),
+                         attributes={"direction": "export",
+                                     "tokens": table.num_tokens,
+                                     "bytes": payload["nbytes"]})
+    return payload
+
+
+def import_kv(payload: Dict[str, Any],
+              allocator: BlockAllocator) -> BlockTable:
+    """Rebuild a block table from exported pages on the decode side.
+    Consults the ``llm_kv_handoff`` fault point — chaos tests fail the
+    handoff here to force the relay's re-prefill path."""
+    fault_injection.check("llm_kv_handoff")
+    start = time.time()
+    table = BlockTable.from_pages(allocator, payload["pages"])
+    transport = payload.get("transport", "object_store")
+    _m.KV_HANDOFFS.inc(tags={"transport": transport})
+    _m.KV_HANDOFF_BYTES.inc(payload.get("nbytes", 0),
+                            tags={"transport": transport})
+    _tracing.record_span("serve.kv_handoff", start, time.time(),
+                         attributes={"direction": "import",
+                                     "tokens": table.num_tokens,
+                                     "bytes": payload.get("nbytes", 0)})
+    return table
+
+
+def put_handoff(payload: Dict[str, Any]) -> Any:
+    """Pin the payload in the object store and hand around the ref —
+    what the relay does when prefill and decode replicas are separate
+    actors (the payload crosses the object plane once, not per hop)."""
+    return ray_tpu.put(payload)
+
+
+def get_handoff(ref: Any) -> Dict[str, Any]:
+    """Resolve a handoff ref (sync — call from executor threads or sync
+    actor methods, never inline on a replica event loop)."""
+    if isinstance(ref, dict):
+        return ref
+    return ray_tpu.get(ref)
+
+
+class KVHandoffChannel:
+    """KV handoff over a compiled-DAG channel — the zero-router path when
+    prefill and decode nodes live in one compiled graph.  Thin wrapper
+    so both transports share the same metrics/span accounting."""
+
+    def __init__(self, channel: Any):
+        self._channel = channel
+
+    def send(self, payload: Dict[str, Any],
+             timeout: Optional[float] = None) -> None:
+        payload = dict(payload)
+        payload["transport"] = "dag_channel"
+        self._channel.write(payload, timeout=timeout)
+
+    def recv(self, allocator: BlockAllocator,
+             timeout: Optional[float] = None) -> tuple:
+        """Returns ``(payload, table)`` with the pages already imported
+        into the local pool."""
+        payload = self._channel.read(timeout=timeout)
+        return payload, import_kv(payload, allocator)
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,57 @@
+"""Metrics for the LLM inference engine (``ray_tpu.serve.llm``).
+
+One module owns every engine metric so names stay consistent across the
+block allocator, scheduler, disaggregated pools, and the multiplex layer
+(registered in the analyzer's ``METRIC_MODULES`` so the runtime lint sees
+them).  Tags use ``pool`` to distinguish prefill-heavy vs decode-heavy
+replica pools ("engine" for the monolithic engine).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.util import metrics as _metrics
+
+BLOCKS_TOTAL = _metrics.Gauge(
+    "ray_tpu_llm_kv_blocks_total",
+    "Fixed-size KV-cache blocks in the preallocated pool",
+    tag_keys=("pool",))
+BLOCKS_IN_USE = _metrics.Gauge(
+    "ray_tpu_llm_kv_blocks_in_use",
+    "KV-cache blocks currently allocated (refcount > 0)",
+    tag_keys=("pool",))
+BLOCK_ALLOCS = _metrics.Counter(
+    "ray_tpu_llm_block_allocs_total",
+    "KV-cache block allocations served from the pool",
+    tag_keys=("pool",))
+COW_COPIES = _metrics.Counter(
+    "ray_tpu_llm_block_cow_copies_total",
+    "Copy-on-write block materializations (forked sequence diverged)",
+    tag_keys=("pool",))
+PREEMPTIONS = _metrics.Counter(
+    "ray_tpu_llm_preemptions_total",
+    "Sequences preempted under block pressure (recompute-on-resume)",
+    tag_keys=("pool",))
+PREFILL_TOKENS = _metrics.Counter(
+    "ray_tpu_llm_prefill_tokens_total",
+    "Prompt tokens prefilled into the paged KV cache",
+    tag_keys=("pool",))
+DECODE_TOKENS = _metrics.Counter(
+    "ray_tpu_llm_decode_tokens_total",
+    "Tokens emitted by decode iterations",
+    tag_keys=("pool",))
+KV_HANDOFFS = _metrics.Counter(
+    "ray_tpu_llm_kv_handoffs_total",
+    "Prefill→decode KV-page handoffs completed",
+    tag_keys=("transport",))
+KV_HANDOFF_BYTES = _metrics.Counter(
+    "ray_tpu_llm_kv_handoff_bytes_total",
+    "Bytes of KV pages moved prefill→decode",
+    tag_keys=("transport",))
+WAITING_SEQUENCES = _metrics.Gauge(
+    "ray_tpu_llm_waiting_sequences",
+    "Sequences waiting for prefill admission (insufficient block headroom)",
+    tag_keys=("pool",))
+RUNNING_SEQUENCES = _metrics.Gauge(
+    "ray_tpu_llm_running_sequences",
+    "Sequences in the decode batch of the engine scheduler",
+    tag_keys=("pool",))

@@ -1,0 +1,184 @@
+"""Deterministic toy LM that reads attention context out of the paged KV
+cache.
+
+Not a neural net — a hash-mixing recurrence over integer "KV" vectors —
+but it has the property the tests need: the next token is a function of
+*every* cached position, so any block-table bug (wrong block, torn COW,
+stale page after preemption) changes the generated stream instead of
+hiding behind a simulation.  Fixed seed + fixed weights ⇒ byte-identical
+output, which is what makes the monolithic-vs-disaggregated equivalence
+and kill-recovery tests meaningful.
+
+Adapters are additive integer deltas mixed into each KV entry — a
+LoRA-shaped stand-in loaded from committed checkpoints by the multiplex
+layer.  Device time is simulated the way the serve benches do it (a lock
+plus ``time.sleep``): prefill cost scales with prompt length, decode cost
+is per-iteration — exactly the contention DistServe disaggregation
+removes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence as Seq
+
+import numpy as np
+
+from ray_tpu.serve.llm.blocks import BlockTable
+
+# Odd mixing constants (splitmix64-flavored), masked into the positive
+# int64 range — numpy int64 rejects >2**63-1 literals.
+_P1 = np.int64(0x9E3779B97F4A7C15 & 0x7FFFFFFFFFFFFFFF)
+_P2 = np.int64(0xC2B2AE3D27D4EB4F & 0x7FFFFFFFFFFFFFFF)
+_P3 = np.int64(0x165667B19E3779F9)
+_MASK = np.int64(0x7FFFFFFFFFFFFFFF)
+
+
+def _mix(x: np.ndarray) -> np.ndarray:
+    x = (x ^ (x >> np.int64(30))) * _P2
+    x = (x ^ (x >> np.int64(27))) * _P3
+    return (x ^ (x >> np.int64(31))) & _MASK
+
+
+class ToyLM:
+    """Deterministic generator over a paged KV cache.
+
+    ``device_lock``/timing knobs simulate one accelerator shared by every
+    model on a replica (the bench idiom from ``scripts/bench_serve.py``);
+    leave them at zero for pure-logic unit tests.
+    """
+
+    def __init__(self, *, dim: int = 8, vocab_size: int = 50_000,
+                 seed: int = 0, adapter_delta: Optional[Seq[int]] = None,
+                 prefill_time_per_token_s: float = 0.0,
+                 decode_step_time_s: float = 0.0,
+                 device_lock: Optional[threading.Lock] = None):
+        self.dim = dim
+        self.vocab_size = vocab_size
+        self.seed = np.int64(seed)
+        self._lanes = np.arange(dim, dtype=np.int64)
+        if adapter_delta is None:
+            self.adapter_delta = np.zeros(dim, dtype=np.int64)
+        else:
+            self.adapter_delta = np.asarray(adapter_delta,
+                                            dtype=np.int64) % _MASK
+        self.prefill_time_per_token_s = prefill_time_per_token_s
+        self.decode_step_time_s = decode_step_time_s
+        self._device_lock = device_lock
+        self.closed = False
+        self._p3_pows = [1]  # P3^k mod 2**64, grown on demand
+
+    # ------------------------------------------------------------- math
+
+    def kv_entry(self, token: int, position: int) -> np.ndarray:
+        """The cached 'KV' vector for one context token."""
+        base = (np.int64(token) * _P1 + np.int64(position) * _P2
+                + self.seed * _P3 + self._lanes)
+        return (_mix(base) + self.adapter_delta) & _MASK
+
+    def _weights(self, n: int) -> np.ndarray:
+        """Closed-form reduction weights w_i = (i+1)·P3^(n-1-i) mod 2**64
+        (as wrapped int64)."""
+        pows = self._p3_pows
+        p3, m64 = int(_P3), (1 << 64) - 1
+        while len(pows) < n:
+            pows.append((pows[-1] * p3) & m64)
+        w = np.array([((i + 1) * pows[n - 1 - i]) & m64 for i in range(n)],
+                     dtype=np.uint64)
+        return w.astype(np.int64)
+
+    def next_token(self, entries: Seq[np.ndarray]) -> int:
+        """Next token from the full cached context (order-sensitive).
+
+        Defined as the recurrence ``acc = (acc*P3 + e_i*(i+1)) & MASK``
+        over all entries, evaluated in closed form: the per-step mask is
+        mod 2**63, which int64 (mod 2**64) arithmetic is congruent under,
+        so ``acc_n = Σ e_i·(i+1)·P3^(n-1-i)`` with ONE final mask is
+        byte-identical to the Python loop — and O(context) numpy instead
+        of O(context) interpreter steps per decoded token."""
+        if not entries:
+            acc = np.zeros(self.dim, dtype=np.int64)
+        else:
+            stacked = np.stack([np.asarray(e, dtype=np.int64)
+                                for e in entries])
+            w = self._weights(len(entries))
+            acc = stacked * w[:, None]
+            acc = acc.sum(axis=0, dtype=np.int64) & _MASK
+        h = int(_mix(acc).sum() & _MASK)
+        return h % self.vocab_size
+
+    # ------------------------------------------------------- cache steps
+
+    def prefill(self, table: BlockTable, context: List[int]) -> int:
+        """Write KV entries for ``context`` into the (empty) table, then
+        generate — and cache — the first new token.  Simulated device time
+        scales with context length (the long-prompt stall)."""
+        self._burn(self.prefill_time_per_token_s * len(context))
+        for pos, tok in enumerate(context):
+            table.append(self.kv_entry(tok, pos))
+        tok = self.next_token(list(table.entries()))
+        table.append(self.kv_entry(tok, table.num_tokens))
+        return tok
+
+    def decode_one(self, table: BlockTable) -> int:
+        """One decode step: next token from the cached context, its KV
+        entry appended.  Callers batch the per-iteration device burn via
+        :meth:`decode_burn` (one pass per micro-batch, not per sequence)."""
+        tok = self.next_token(list(table.entries()))
+        table.append(self.kv_entry(tok, table.num_tokens))
+        return tok
+
+    def decode_burn(self) -> None:
+        self._burn(self.decode_step_time_s)
+
+    def _burn(self, seconds: float) -> None:
+        if seconds <= 0:
+            return
+        if self._device_lock is not None:
+            with self._device_lock:
+                # Simulated accelerator occupancy (bench idiom): the sleep
+                # IS the modeled device work, serialized by the device
+                # lock on purpose.  # blocking_ok: simulated device time
+                time.sleep(seconds)
+        else:
+            time.sleep(seconds)  # blocking_ok: simulated device time
+
+    def close(self) -> None:
+        """Release simulated device residency — the multiplex wrapper's
+        default unload hook finds and calls this on LRU eviction."""
+        self.closed = True
+
+    # ------------------------------------------------------- reference
+
+    def reference_generate(self, prompt: List[int],
+                           max_new_tokens: int) -> List[int]:
+        """Paging-free oracle: same math over a flat entry list.  The
+        paged engine must reproduce this byte-for-byte."""
+        entries = [self.kv_entry(t, i) for i, t in enumerate(prompt)]
+        out: List[int] = []
+        for _ in range(max_new_tokens):
+            tok = self.next_token(entries)
+            entries.append(self.kv_entry(tok, len(entries)))
+            out.append(tok)
+        return out
+
+
+def lm_from_weights(weights: Dict[str, Any], *,
+                    device_lock: Optional[threading.Lock] = None,
+                    prefill_time_per_token_s: float = 0.0,
+                    decode_step_time_s: float = 0.0) -> ToyLM:
+    """Build a ToyLM from a checkpoint pytree (the restore-for-inference
+    path): ``{"seed": int, "dim": int, "adapter_delta": array | None}``.
+    Arrays may come back as jnp/np from ``restore_pytree`` — normalized
+    here."""
+    delta = weights.get("adapter_delta")
+    if delta is not None:
+        delta = np.asarray(delta, dtype=np.int64)
+    return ToyLM(dim=int(weights.get("dim", 8)),
+                 vocab_size=int(weights.get("vocab_size", 50_000)),
+                 seed=int(weights.get("seed", 0)),
+                 adapter_delta=delta,
+                 device_lock=device_lock,
+                 prefill_time_per_token_s=prefill_time_per_token_s,
+                 decode_step_time_s=decode_step_time_s)

@@ -1,0 +1,182 @@
+"""Engine scheduler: block-aware admission + preemption under pressure.
+
+Sits between the continuous-batch ``_Engine`` (which owns streams) and the
+:class:`~ray_tpu.serve.llm.blocks.BlockAllocator` (which owns memory).
+Admission is FIFO within priority: a waiting sequence is prefilled only
+when the pool has headroom for its whole context plus the configured
+watermark — long prompts wait rather than thrash the decode batch.  When
+decode needs a block the pool cannot supply, the lowest-priority
+latest-arrival running sequence is preempted: its blocks are freed, its
+generated-so-far tokens fold into the recompute context, and it re-enters
+the waiting queue at the front (recompute-on-resume, vLLM's recompute
+preemption mode).  Already-emitted tokens are never re-emitted — the
+model is deterministic, so resume regenerates the identical suffix.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.serve.llm import metrics as _m
+from ray_tpu.serve.llm.blocks import BlockAllocator, BlockTable
+
+_seq_counter = itertools.count()
+
+WAITING = "waiting"
+RUNNING = "running"
+FINISHED = "finished"
+
+
+class Sequence:
+    """One generation request as the engine tracks it (lives in
+    ``SequenceSlot.state`` for streams owned by the continuous engine)."""
+
+    def __init__(self, prompt: List[int], max_new_tokens: int, *,
+                 priority: int = 0, model_key: str = "base",
+                 handoff: Optional[Dict[str, Any]] = None,
+                 seq_id: Optional[str] = None):
+        self.seq_id = seq_id or f"seq-{next(_seq_counter)}"
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.priority = priority
+        self.model_key = model_key
+        #: Exported KV pages + generated prefix from a prefill replica —
+        #: when set, admission imports pages instead of recomputing.
+        self.handoff = handoff
+        self.arrival = next(_seq_counter)
+        self.status = WAITING
+        self.table: Optional[BlockTable] = None
+        self.generated: List[int] = []
+        self.num_emitted = 0
+        self.preemptions = 0
+        #: Set by the engine when prefill/import failed — surfaced as the
+        #: stream's terminal error at the next emission.
+        self.error: Optional[BaseException] = None
+
+    def context(self) -> List[int]:
+        """Tokens whose KV entries the cache must hold before the next
+        decode step — the recompute target after preemption."""
+        return self.prompt + self.generated
+
+    @property
+    def finished(self) -> bool:
+        return len(self.generated) >= self.max_new_tokens
+
+    def pop_emission(self) -> Optional[int]:
+        """Next generated-but-unemitted token (one per engine iteration —
+        the continuous loop emits a single value per slot per step)."""
+        if self.num_emitted < len(self.generated):
+            tok = self.generated[self.num_emitted]
+            self.num_emitted += 1
+            return tok
+        return None
+
+
+class EngineScheduler:
+    """Admission + preemption over one allocator.
+
+    Not thread-safe: the continuous engine calls it from a single step at
+    a time (the allocator underneath is what handoff threads share).
+    """
+
+    def __init__(self, allocator: BlockAllocator, *,
+                 watermark_blocks: int = 0,
+                 max_running: Optional[int] = None):
+        self.allocator = allocator
+        self.watermark_blocks = watermark_blocks
+        self.max_running = max_running
+        self.waiting: List[Sequence] = []
+        self.running: List[Sequence] = []
+
+    # ------------------------------------------------------------ queues
+
+    def add(self, seq: Sequence) -> None:
+        seq.status = WAITING
+        self.waiting.append(seq)
+        self._gauges()
+
+    def admit(self, max_new: Optional[int] = None) -> List[Sequence]:
+        """Move waiting sequences to running while block headroom covers
+        their full context (+1 for the token prefill generates) plus the
+        watermark.  FIFO within descending priority; head-of-line blocks
+        so a long prompt cannot be starved by later short ones."""
+        admitted: List[Sequence] = []
+        self.waiting.sort(key=lambda s: (-s.priority, s.arrival))
+        while self.waiting:
+            if max_new is not None and len(admitted) >= max_new:
+                break
+            if self.max_running is not None \
+                    and len(self.running) >= self.max_running:
+                break
+            head = self.waiting[0]
+            need = self.allocator.blocks_needed(len(head.context()) + 1)
+            if self.allocator.num_free - self.watermark_blocks < need:
+                break
+            self.waiting.pop(0)
+            head.status = RUNNING
+            self.running.append(head)
+            admitted.append(head)
+        self._gauges()
+        return admitted
+
+    def finish(self, seq: Sequence) -> None:
+        """Retire a sequence (done or cancelled) and free its blocks."""
+        if seq.table is not None:
+            seq.table.release()
+            seq.table = None
+        seq.status = FINISHED
+        if seq in self.running:
+            self.running.remove(seq)
+        if seq in self.waiting:
+            self.waiting.remove(seq)
+        self._gauges()
+
+    # -------------------------------------------------------- preemption
+
+    def preempt_one(self, protect: Optional[Sequence] = None
+                    ) -> Optional[Sequence]:
+        """Evict the lowest-priority, latest-arrival running sequence
+        (skipping ``protect``): free its blocks and requeue it at the
+        front of the waiting queue for recompute-on-resume."""
+        candidates = [s for s in self.running if s is not protect]
+        if not candidates:
+            return None
+        victim = min(candidates, key=lambda s: (s.priority, -s.arrival))
+        self.preempt_seq(victim)
+        return victim
+
+    def preempt_seq(self, seq: Sequence) -> None:
+        """Evict a specific running sequence: free its blocks, fold its
+        generations into the recompute context, requeue it at the front."""
+        if seq in self.running:
+            self.running.remove(seq)
+        if seq.table is not None:
+            seq.table.release()
+            seq.table = None
+        seq.status = WAITING
+        seq.preemptions += 1
+        self.waiting.insert(0, seq)
+        _m.PREEMPTIONS.inc(tags={"pool": self.allocator.pool})
+        self._gauges()
+
+    def ensure_decode_headroom(self) -> List[Sequence]:
+        """Make sure every running sequence can append one more KV entry,
+        preempting under pressure.  Returns the sequences that remain
+        steppable this iteration (preempted ones dropped)."""
+        while True:
+            need = sum(
+                1 for s in self.running
+                if s.table is not None
+                and s.table.num_tokens % self.allocator.block_size == 0)
+            if self.allocator.num_free >= need:
+                return list(self.running)
+            if self.preempt_one() is None:
+                # Nothing left to evict; step whoever still fits (their
+                # appends may still raise NoFreeBlocks, handled upstream).
+                return list(self.running)
+
+    def _gauges(self) -> None:
+        tags = {"pool": self.allocator.pool}
+        _m.WAITING_SEQUENCES.set(len(self.waiting), tags=tags)
+        _m.RUNNING_SEQUENCES.set(len(self.running), tags=tags)

@@ -1,0 +1,55 @@
+"""Checkpoint-backed model/adapter weight store — restore-for-inference.
+
+One directory per multiplex key under a shared root, each managed by a
+:class:`~ray_tpu.train.checkpoint.CheckpointManager` (top-K retention, the
+PR 5 committed-checkpoint layout)::
+
+    <root>/base/checkpoint_000000/...
+    <root>/base::poet/checkpoint_000000/...      # adapter keys compose
+
+``publish_model_weights`` is what an offline fine-tune job (or the
+example/tests) calls to make a model servable; ``load_model_weights`` is
+the replica-side loader the ``@serve.multiplexed`` function wraps — it
+only ever sees committed checkpoints, so a torn publish is invisible.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict
+
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+
+_SAFE = re.compile(r"[^A-Za-z0-9_.:\-]")
+
+
+def _key_dir(root: str, key: str) -> str:
+    return os.path.join(root, _SAFE.sub("_", key))
+
+
+def publish_model_weights(root: str, key: str, weights: Dict[str, Any],
+                          *, num_to_keep: int = 2) -> str:
+    """Commit one version of ``key``'s weights; returns the checkpoint
+    path.  Republishing bumps the version and retention prunes old ones."""
+    mdir = _key_dir(root, key)
+    mgr = CheckpointManager(mdir, num_to_keep=num_to_keep)
+    step = len(mgr._checkpoints)
+    ckpt = Checkpoint.from_pytree(
+        weights, os.path.join(mdir, f"checkpoint_{step:06d}"))
+    mgr.register(ckpt, {"step": step})
+    return ckpt.path
+
+
+def load_model_weights(root: str, key: str) -> Dict[str, Any]:
+    """Latest committed weights for a multiplex key (raises KeyError when
+    the key was never published — surfaces as that request's error, not a
+    replica crash)."""
+    mdir = _key_dir(root, key)
+    if not os.path.isdir(mdir):
+        raise KeyError(f"no published weights for model key {key!r} "
+                       f"under {root}")
+    ckpt = CheckpointManager(mdir).latest_checkpoint()
+    if ckpt is None:
+        raise KeyError(f"no committed checkpoint for model key {key!r}")
+    return ckpt.to_pytree()

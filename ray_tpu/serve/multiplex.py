@@ -2,14 +2,22 @@
 
 (ref: python/ray/serve/multiplex.py _ModelMultiplexWrapper — per-replica
 LRU of loaded models keyed by model id, load via the user's @serve.multiplexed
-function, evict least-recently-used above max_num_models_per_replica.
-Loaded ids are recorded in replica metadata; warm-replica routing preference
-is future work — requests currently route queue-aware only.)
+function, evict least-recently-used above max_num_models_per_replica.)
 
-Interplay with @serve.batch: the batching decorator keys its queues by the
-request's multiplexed model id (serve_context.get_multiplexed_model_id()),
-so requests for different models never share a micro-batch — one vectorized
-call always targets a single loaded model.
+Eviction actually releases resources: the evicted model goes through an
+async-aware **unload hook** — the decorator's ``unload=`` callback when
+given, else the model's own ``unload()`` / ``close()`` / sync-context
+``__exit__`` — so device memory held by weights is freed, not left to the
+garbage collector's mercy.  Loaded ids are pushed to replica metadata on
+BOTH load and eviction, and forwarded to the controller so the router's
+pow-2 scheduler can prefer warm replicas (see router.py).
+
+Interplay with @serve.batch and @serve.continuous_batch: the batching
+decorator keys its queues by the request's multiplexed model id
+(serve_context.get_multiplexed_model_id()), and the LLM engine composes
+``model::adapter`` into one key — so requests for different (model,
+adapter) pairs never share a micro-batch; one vectorized call always
+targets a single set of loaded weights.
 """
 
 from __future__ import annotations
@@ -19,15 +27,66 @@ import inspect
 from collections import OrderedDict
 from typing import Any, Callable, Optional
 
+from ray_tpu.util import metrics as _metrics
+
+MODELS_LOADED_GAUGE = _metrics.Gauge(
+    "serve_multiplexed_models_loaded",
+    "Models currently resident in this replica's multiplex LRU",
+    tag_keys=("deployment",))
+MODEL_LOADS = _metrics.Counter(
+    "serve_multiplexed_model_loads_total",
+    "Model loads through @serve.multiplexed (cache misses)",
+    tag_keys=("deployment",))
+MODEL_EVICTIONS = _metrics.Counter(
+    "serve_multiplexed_model_evictions_total",
+    "LRU evictions that ran the unload hook",
+    tag_keys=("deployment",))
+
+
+async def _run_unload(model_id: str, model: Any,
+                      unload_func: Optional[Callable],
+                      self_arg: Any) -> None:
+    """Release an evicted model through the first applicable hook:
+    user callback > model.unload() > model.close() > model.__exit__.
+    Sync or async everywhere; failures are swallowed (eviction must
+    never wedge the loader)."""
+    try:
+        if unload_func is not None:
+            args = (self_arg, model_id, model) if self_arg is not None \
+                else (model_id, model)
+            out = unload_func(*args)
+        elif hasattr(model, "unload"):
+            out = model.unload()
+        elif hasattr(model, "close"):
+            out = model.close()
+        elif hasattr(model, "__exit__"):
+            out = model.__exit__(None, None, None)
+        else:
+            return
+        if inspect.isawaitable(out):
+            await out
+    except Exception:
+        pass
+
 
 class _ModelMultiplexWrapper:
     def __init__(self, model_load_func: Callable, self_arg: Any,
-                 max_num_models_per_replica: int = 3):
+                 max_num_models_per_replica: int = 3,
+                 unload_func: Optional[Callable] = None):
         self._load = model_load_func
+        self._unload = unload_func
         self._self_arg = self_arg
         self._max = max_num_models_per_replica
         self._models: "OrderedDict[str, Any]" = OrderedDict()
         self._lock = asyncio.Lock()
+        self._tags = {"deployment": self._deployment_tag()}
+
+    @staticmethod
+    def _deployment_tag() -> str:
+        from ray_tpu.serve import context as serve_context
+
+        ctx = serve_context.get_internal_replica_context()
+        return ctx.deployment if ctx is not None else ""
 
     async def load_model(self, model_id: str) -> Any:
         if not isinstance(model_id, str) or not model_id:
@@ -36,21 +95,34 @@ class _ModelMultiplexWrapper:
             if model_id in self._models:
                 self._models.move_to_end(model_id)
                 return self._models[model_id]
-            if len(self._models) >= self._max:
+            while len(self._models) >= self._max:
                 evicted_id, evicted = self._models.popitem(last=False)
-                if hasattr(evicted, "__del__"):
-                    try:
-                        evicted.__del__()
-                    except Exception:
-                        pass
+                # Metadata reflects the eviction BEFORE the (possibly
+                # slow) unload runs — the router must stop preferring
+                # this replica for the evicted id immediately.
+                self._push_model_ids()
+                MODEL_EVICTIONS.inc(tags=self._tags)
+                await _run_unload(evicted_id, evicted, self._unload,
+                                  self._self_arg)
             args = (self._self_arg, model_id) if self._self_arg is not None \
                 else (model_id,)
             model = self._load(*args)
             if inspect.isawaitable(model):
                 model = await model
             self._models[model_id] = model
+            MODEL_LOADS.inc(tags=self._tags)
             self._push_model_ids()
             return model
+
+    async def unload_all(self) -> None:
+        """Evict everything (replica shutdown / tests)."""
+        async with self._lock:
+            while self._models:
+                evicted_id, evicted = self._models.popitem(last=False)
+                self._push_model_ids()
+                MODEL_EVICTIONS.inc(tags=self._tags)
+                await _run_unload(evicted_id, evicted, self._unload,
+                                  self._self_arg)
 
     @property
     def loaded_model_ids(self) -> list:
@@ -58,10 +130,11 @@ class _ModelMultiplexWrapper:
         return list(self._models)
 
     def _push_model_ids(self) -> None:
-        """Record loaded ids on the hosting replica's metadata
-        (ref: multiplex.py _push_multiplexed_replica_info — the reference
-        additionally feeds these into router preference; here they surface
-        through ReplicaActor.get_metadata for observability)."""
+        """Record loaded ids on the hosting replica's metadata and notify
+        the controller (ref: multiplex.py _push_multiplexed_replica_info);
+        the controller folds them into the routing table push so routers
+        can prefer warm replicas.  Called on load AND eviction."""
+        MODELS_LOADED_GAUGE.set(len(self._models), tags=self._tags)
         from ray_tpu.serve import context as serve_context
 
         ctx = serve_context.get_internal_replica_context()
@@ -70,8 +143,17 @@ class _ModelMultiplexWrapper:
 
 
 def multiplexed(_func: Optional[Callable] = None, *,
-                max_num_models_per_replica: int = 3):
-    """@serve.multiplexed decorator (ref: serve/api.py multiplexed)."""
+                max_num_models_per_replica: int = 3,
+                unload: Optional[Callable] = None):
+    """@serve.multiplexed decorator (ref: serve/api.py multiplexed).
+
+    Args:
+        max_num_models_per_replica: LRU capacity per replica.
+        unload: optional (sync or async) callback run on eviction —
+            ``unload(model_id, model)`` (methods get ``self`` first).
+            Without it the model's own ``unload()``/``close()``/
+            ``__exit__`` is used when present.
+    """
 
     def decorate(func: Callable):
         if not inspect.iscoroutinefunction(func):
@@ -88,13 +170,15 @@ def multiplexed(_func: Optional[Callable] = None, *,
             wrapper = wrappers.get(key)
             if wrapper is None:
                 wrapper = wrappers[key] = _ModelMultiplexWrapper(
-                    func, self_arg, max_num_models_per_replica)
+                    func, self_arg, max_num_models_per_replica,
+                    unload_func=unload)
             from ray_tpu.serve import context as serve_context
 
             serve_context._set_request_model_id(model_id)
             return await wrapper.load_model(model_id)
 
         wrapped.__name__ = func.__name__
+        wrapped._multiplex_wrappers = wrappers  # introspection / tests
         return wrapped
 
     if _func is not None:

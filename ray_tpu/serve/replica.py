@@ -172,6 +172,7 @@ class ReplicaActor:
         from ray_tpu._private import fault_injection
 
         fault_injection.check("serve_replica_handle")
+        mux_id = kwargs.pop("_serve_multiplexed_model_id", None)
         self._num_ongoing += 1
         t0 = time.time()
         meta = self._method_meta.get(method_name)
@@ -187,6 +188,8 @@ class ReplicaActor:
             serve_context._set_internal_replica_context(
                 deployment=self.deployment_name, replica_id=self.replica_id,
                 replica=self)
+            if mux_id:
+                serve_context._set_request_model_id(mux_id)
             # Nests under the runtime's task-execute span (which carries
             # the submitter's trace context from the TaskSpec), so the
             # replica-side work joins the request's trace.
@@ -235,6 +238,11 @@ class ReplicaActor:
 
     async def start_stream(self, method_name: str, *args, **kwargs) -> str:
         self._set_replica_context()
+        mux_id = kwargs.pop("_serve_multiplexed_model_id", None)
+        if mux_id:
+            from ray_tpu.serve import context as serve_context
+
+            serve_context._set_request_model_id(mux_id)
         it = await self._wrapper.call_streaming(method_name, args, kwargs)
         return self._register_stream(it)
 
@@ -315,7 +323,20 @@ class ReplicaActor:
         }
 
     def record_multiplexed_model_ids(self, model_ids: list) -> None:
+        """Record loaded model ids locally AND forward them to the
+        controller, which folds them into the replica-set long-poll push
+        so routers can prefer warm replicas.  Fire-and-forget: metadata
+        is an optimization, never worth failing a load/evict over."""
         self._multiplexed_model_ids = list(model_ids)
+        try:
+            import ray_tpu
+            from ray_tpu.serve.api import _CONTROLLER_NAME
+
+            controller = ray_tpu.get_actor(_CONTROLLER_NAME)
+            controller.record_multiplexed_model_ids.remote(
+                self.replica_id, list(model_ids))
+        except Exception:
+            pass
 
     async def reconfigure(self, user_config: Any) -> None:
         self._user_config = user_config
@@ -358,6 +379,7 @@ class SyncReplicaActor(ReplicaActor):
         from ray_tpu._private import fault_injection
 
         fault_injection.check("serve_replica_handle")
+        mux_id = kwargs.pop("_serve_multiplexed_model_id", None)
         self._num_ongoing += 1
         t0 = time.time()
         try:
@@ -366,6 +388,8 @@ class SyncReplicaActor(ReplicaActor):
             serve_context._set_internal_replica_context(
                 deployment=self.deployment_name, replica_id=self.replica_id,
                 replica=self)
+            if mux_id:
+                serve_context._set_request_model_id(mux_id)
             with _tracing.span("serve.replica",
                                attributes={"deployment": self.deployment_name,
                                            "replica": self.replica_id,
@@ -387,6 +411,11 @@ class SyncReplicaActor(ReplicaActor):
         import inspect as _inspect
 
         self._set_replica_context()
+        mux_id = kwargs.pop("_serve_multiplexed_model_id", None)
+        if mux_id:
+            from ray_tpu.serve import context as serve_context
+
+            serve_context._set_request_model_id(mux_id)
         result = self._wrapper._target(method_name)(*args, **kwargs)
         if not _inspect.isgenerator(result):
             raise TypeError(

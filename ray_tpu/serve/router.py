@@ -48,11 +48,21 @@ class PowerOfTwoChoicesReplicaScheduler:
     def __init__(self) -> None:
         self._replicas: List[Dict[str, Any]] = []  # guarded_by: _lock
         self._inflight: Dict[str, int] = {}  # guarded_by: _lock
+        #: Replicas this router observed dead (drop_replica) that the
+        #: controller's pushes may still contain while its reconciler
+        #: catches up — re-adding a corpse would let retries burn their
+        #: budget re-picking it.  A tombstone clears once an update
+        #: arrives without the id (the controller converged; replica ids
+        #: are never reused).  guarded_by: _lock
+        self._tombstones: set = set()
         self._lock = threading.Lock()
 
     def update_replicas(self, replicas: List[Dict[str, Any]]) -> None:
         with self._lock:
-            self._replicas = list(replicas)
+            incoming = {r["replica_id"] for r in replicas}
+            self._tombstones &= incoming
+            self._replicas = [r for r in replicas
+                              if r["replica_id"] not in self._tombstones]
             live = {r["replica_id"] for r in self._replicas}
             self._inflight = {rid: n for rid, n in self._inflight.items()
                               if rid in live}
@@ -91,11 +101,36 @@ class PowerOfTwoChoicesReplicaScheduler:
             if replica_id in self._inflight:
                 self._inflight[replica_id] = max(0, self._inflight[replica_id] - 1)
 
-    def choose_replica(self) -> Optional[Dict[str, Any]]:
+    def choose_replica(self, model_id: Optional[str] = None
+                       ) -> Optional[Dict[str, Any]]:
+        """Queue-aware two-choice pick; when the request carries a
+        multiplexed model id, replicas that already have that model
+        loaded ("warm") are preferred — but only while they have a spare
+        slot, so a saturated warm set degrades to the normal queue-aware
+        choice over everyone (a cold replica then loads the model) rather
+        than queueing behind the warm ones (ref: the reference scheduler's
+        multiplexed-model candidate ranking)."""
         with self._lock:
             replicas = list(self._replicas)
             if not replicas:
                 return None
+            if model_id:
+                warm = []
+                for r in replicas:
+                    if model_id not in (r.get("multiplexed_model_ids")
+                                        or ()):
+                        continue
+                    q = self._inflight.get(r["replica_id"], 0)
+                    cap = int(r.get("max_ongoing_requests") or 0)
+                    if cap <= 0 or q < cap:
+                        warm.append(r)
+                if len(warm) == 1:
+                    return warm[0]
+                if warm:
+                    a, b = random.sample(warm, 2)
+                    qa = self._inflight.get(a["replica_id"], 0)
+                    qb = self._inflight.get(b["replica_id"], 0)
+                    return a if qa <= qb else b
             if len(replicas) == 1:
                 return replicas[0]
             a, b = random.sample(replicas, 2)
@@ -111,8 +146,11 @@ class PowerOfTwoChoicesReplicaScheduler:
             return a if qa <= qb else b
 
     def drop_replica(self, replica_id: str) -> bool:
-        """Remove a replica observed dead; True if any remain."""
+        """Remove a replica observed dead; True if any remain.  The drop
+        is sticky (see _tombstones) until the controller stops pushing
+        the replica."""
         with self._lock:
+            self._tombstones.add(replica_id)
             self._replicas = [r for r in self._replicas
                               if r["replica_id"] != replica_id]
             return bool(self._replicas)
@@ -212,18 +250,19 @@ class Router:
             raise BackPressureError(self.deployment_id, inflight, capacity,
                                     max_queued)
 
-    def _dispatch(self, send):
+    def _dispatch(self, send, model_id: Optional[str] = None):
         """Shared choose-replica/retry core (ref: Router.assign_request):
         replicas dead at dispatch (rolling update raced the long-poll) are
         dropped locally and the request re-assigned.  ``send(replica)``
-        performs the actual (non-blocking) submit and returns its result."""
+        performs the actual (non-blocking) submit and returns its result.
+        ``model_id`` biases the pick toward warm multiplexed replicas."""
         from ray_tpu._private import fault_injection
         from ray_tpu.exceptions import ActorDiedError
 
         fault_injection.check("serve_route")
         deadline = time.time() + 30.0
         while True:
-            replica = self._scheduler.choose_replica()
+            replica = self._scheduler.choose_replica(model_id)
             if replica is None:
                 if not self._replicas_populated.wait(
                         timeout=max(0.0, deadline - time.time())):
@@ -269,7 +308,8 @@ class Router:
             trace_ctx = _tracing.active_span()
             _, rid, ref = self._dispatch(
                 lambda r: r["actor"].handle_request.remote(
-                    method_name, *args, **kwargs))
+                    method_name, *args, **kwargs),
+                model_id=kwargs.get("_serve_multiplexed_model_id"))
         # Decrement the local queue estimate when the reply lands — and if
         # the reply is the replica's death, drop it from the local set
         # immediately so retries and later requests can't re-pick the
@@ -314,14 +354,25 @@ class Router:
             trace_ctx = _tracing.active_span()
             replica, rid, sid_ref = self._dispatch(
                 lambda r: r["actor"].start_stream.remote(
-                    method_name, *args, **kwargs))
+                    method_name, *args, **kwargs),
+                model_id=kwargs.get("_serve_multiplexed_model_id"))
         tags = self._metric_tags
         exemplar = serve_metrics.trace_exemplar(trace_ctx)
+        from ray_tpu.exceptions import ActorDiedError
 
-        def done():
+        def done(exc: Optional[BaseException] = None):
             # For streams, "latency" is assign -> stream end (last pull,
             # cancellation, or error) — the whole response window.
             self._scheduler.on_request_done(rid)
+            if isinstance(exc, ActorDiedError) or isinstance(
+                    getattr(exc, "cause", None), ActorDiedError):
+                # The pinned replica died — at the open (start_stream on a
+                # corpse pre-fails the stream-id ref, so _dispatch never
+                # saw it) or mid-stream.  Drop it locally so a consumer's
+                # retry can't re-pick it while the reconciler's long-poll
+                # push is still in flight.
+                if not self._scheduler.drop_replica(rid):
+                    self._replicas_populated.clear()
             serve_metrics.REQUEST_LATENCY.observe(
                 time.time() - t0, tags=tags, exemplar=exemplar)
             serve_metrics.REQUESTS_TOTAL.inc(tags=tags)

@@ -60,6 +60,10 @@ SPAN_REGISTRY: Dict[str, str] = {
     "serve.queue_wait": "batching: enqueue -> batch formation, per request",
     "serve.batch_execute": "batching: vectorized user call, per request",
     "serve.stream_emit": "proxy: one streamed chunk emission",
+    "serve.prefill": "llm: prompt prefill into the paged KV cache",
+    "serve.decode": "llm: one decode micro-batch pass (single model key)",
+    "serve.kv_handoff": "llm: KV-page export/import between prefill and "
+                        "decode pools",
     "checkpoint.save": "writer: shard serialize + persist",
     "checkpoint.commit": "coordinator: commit phase up to atomic rename",
     "checkpoint.restore": "restore_pytree entry",

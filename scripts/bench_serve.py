@@ -10,6 +10,10 @@ Modes:
                   target healthy count + error rate during recovery
   --mode trace    tracing-on vs tracing-off QPS at 32 concurrent clients on
                   the batched unary path (span overhead anchor, target <5%)
+  --mode llm      paged-KV LLM engine: prefill/decode-disaggregated pools vs
+                  the monolithic continuous-batching baseline on a mixed
+                  prompt/generation-length trace (16 closed-loop streams);
+                  appends tokens/s + inter-token p99 to BENCH_LLM.json
 
 The batch mode simulates ONE accelerator per deployment with a lock + sleep:
 forward passes serialize, so unbatched requests pay the full forward each
@@ -533,20 +537,175 @@ def run_chaos_mode(args) -> dict:
     return fields
 
 
+def _llm_trace(n_streams: int, requests_per_stream: int):
+    """Mixed prompt/generation-length request trace, deterministic across
+    runs AND identical between the two topologies: stream i replays the
+    same (prompt, max_tokens) cycle against both."""
+    import random
+
+    rng = random.Random(0)
+    prompt_lens = (16, 32, 64, 128, 256, 512)
+    gen_lens = (8, 16, 24, 32, 40)
+    traces = []
+    for _ in range(n_streams):
+        reqs = []
+        for _ in range(requests_per_stream):
+            plen = rng.choice(prompt_lens)
+            reqs.append({
+                "prompt": [rng.randrange(1000) for _ in range(plen)],
+                "max_tokens": rng.choice(gen_lens),
+            })
+        traces.append(reqs)
+    return traces
+
+
+def _drive_llm_streams(handle, traces):
+    """Closed-loop clients: stream i plays its request trace back-to-back,
+    iterating each token stream through the handle.  Returns
+    (total_tokens, wall_s, inter-token gaps within a request, outputs)."""
+    import threading
+
+    n = len(traces)
+    barrier = threading.Barrier(n + 1)
+    gaps: list = []
+    outputs: list = [None] * n
+    counts: list = [0] * n
+    errors: list = []
+    lock = threading.Lock()
+
+    def client(idx: int):
+        try:
+            local_gaps, outs, total = [], [], 0
+            barrier.wait()
+            for req in traces[idx]:
+                toks = []
+                last = None  # first token is TTFT, not an inter-token gap
+                for tok in handle.options(stream=True).remote(dict(req)):
+                    now = time.perf_counter()
+                    if last is not None:
+                        local_gaps.append(now - last)
+                    last = now
+                    toks.append(tok)
+                assert len(toks) == req["max_tokens"], \
+                    (idx, len(toks), req["max_tokens"])
+                outs.append(toks)
+                total += len(toks)
+            with lock:
+                gaps.extend(local_gaps)
+            outputs[idx], counts[idx] = outs, total
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.perf_counter() - t0
+    assert not any(t.is_alive() for t in threads), "hung LLM stream"
+    assert not errors, errors
+    return sum(counts), wall, gaps, outputs
+
+
+def run_llm_mode(args) -> dict:
+    """LLM engine anchors (ISSUE 11 acceptance: disaggregated pools show
+    >= 1.5x total tokens/s at equal-or-better inter-token p99 vs the
+    monolithic continuous-batching baseline, 16 mixed-length streams).
+
+    Both topologies serve the IDENTICAL trace on identical simulated model
+    timing (prefill cost ∝ prompt length, one decode burn per engine
+    iteration).  The monolithic engine interleaves prefill into its step
+    loop, so every long prompt stalls the whole batch's next token — the
+    DistServe interference the split removes: the decode pool's loop only
+    ever imports pre-computed KV pages (cheap) and decodes."""
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm.disagg import (build_disagg_app,
+                                          build_monolithic_app)
+    from ray_tpu.serve.llm.model import ToyLM
+
+    PREFILL_S_PER_TOKEN = 2.5e-4  # simulated device: prefill cost per token
+    DECODE_STEP_S = 30e-3         # one decode iteration (whole micro-batch)
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start(http_options={"port": 0})
+
+    n_streams = args.llm_streams
+    traces = _llm_trace(n_streams, args.llm_requests_per_stream)
+    specs = {"base": {"seed": 7, "dim": 8}}
+    common = dict(model_specs=specs, num_blocks=512, block_size=16,
+                  prefill_time_per_token_s=PREFILL_S_PER_TOKEN,
+                  decode_step_time_s=DECODE_STEP_S)
+
+    mono = serve.run(build_monolithic_app(**common), name="llm_mono",
+                     route_prefix=None)
+    # Pools sized to phase load, the DistServe prescription: the bursty
+    # O(prompt) prefill work gets 2 devices so queueing doesn't starve the
+    # decode batch, the steady token loop gets 1.  Frontends are
+    # deviceless relays, scaled so stream pulls don't serialize on one
+    # event loop.
+    dis = serve.run(build_disagg_app(prefill_replicas=2,
+                                     frontend_replicas=4, **common),
+                    name="llm_disagg", route_prefix=None)
+    # Warm both paths (model load, stream plumbing) off the clock.
+    warm = {"prompt": [1, 2, 3], "max_tokens": 2}
+    ref = ToyLM(seed=7).reference_generate([1, 2, 3], 2)
+    for h in (mono, dis):
+        assert list(h.options(stream=True).remote(dict(warm))) == ref
+
+    fields = {"llm_streams": n_streams,
+              "llm_requests_per_stream": args.llm_requests_per_stream}
+    outs = {}
+    for key, handle in (("monolithic", mono), ("disagg", dis)):
+        total, wall, gaps, outputs = _drive_llm_streams(handle, traces)
+        outs[key] = outputs
+        fields[f"llm_{key}_tokens_per_s"] = round(total / wall, 1)
+        fields[f"llm_{key}_intertoken_p99_ms"] = round(
+            float(np.percentile(np.asarray(gaps) * 1000, 99)), 3)
+        fields[f"llm_{key}_tokens"] = total
+    # Same engine math on both sides: streams must be byte-identical.
+    assert outs["monolithic"] == outs["disagg"], \
+        "disaggregated outputs diverged from monolithic"
+    fields["llm_disagg_speedup"] = round(
+        fields["llm_disagg_tokens_per_s"]
+        / fields["llm_monolithic_tokens_per_s"], 2)
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+    # Acceptance anchors (ISSUE 11): fail loudly rather than record a
+    # regressed artifact.
+    assert fields["llm_disagg_speedup"] >= 1.5, fields
+    assert fields["llm_disagg_intertoken_p99_ms"] \
+        <= fields["llm_monolithic_intertoken_p99_ms"], fields
+    return fields
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=("latency", "batch", "chaos", "trace"),
+    ap.add_argument("--mode", choices=("latency", "batch", "chaos", "trace",
+                                       "llm"),
                     default="latency")
     ap.add_argument("--requests", type=int, default=300)
     ap.add_argument("--stream-tokens", type=int, default=2000)
     ap.add_argument("--concurrent-streams", type=int, default=8)
     ap.add_argument("--chaos-replicas", type=int, default=3)
     ap.add_argument("--chaos-clients", type=int, default=4)
-    ap.add_argument("--out", default="BENCH_SERVE.json")
+    ap.add_argument("--llm-streams", type=int, default=16)
+    ap.add_argument("--llm-requests-per-stream", type=int, default=6)
+    ap.add_argument("--out", default=None)
     args = ap.parse_args()
+    if args.out is None:
+        args.out = "BENCH_LLM.json" if args.mode == "llm" \
+            else "BENCH_SERVE.json"
 
     modes = {"latency": run_latency_mode, "batch": run_batch_mode,
-             "chaos": run_chaos_mode, "trace": run_trace_mode}
+             "chaos": run_chaos_mode, "trace": run_trace_mode,
+             "llm": run_llm_mode}
     fields = modes[args.mode](args)
     artifact = _merge_artifact(args.out, fields)
     print(json.dumps(artifact))
