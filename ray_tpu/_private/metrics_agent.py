@@ -412,6 +412,16 @@ def _api_payload(runtime, path: str):
         # modules/serve/serve_head.py): controller state joined with the
         # routers' RED metric snapshots, one JSON document.
         return _serve_payload()
+    if path == "/api/serve/slo":
+        # One fresh watchdog evaluation per scrape: burn rates, alert
+        # state and windows for every registered objective.
+        from ray_tpu.serve import slo as _slo
+
+        watchdog = _slo.get_watchdog()
+        return {
+            "objectives_registry": sorted(_slo.SLO_OBJECTIVES),
+            "deployments": watchdog.evaluate(),
+        }
     listings = {
         "/api/tasks": state_api.list_tasks,
         "/api/actors": state_api.list_actors,

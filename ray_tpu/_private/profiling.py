@@ -44,6 +44,12 @@ def _rt_driver_id(rt):
 #: one story instead of thousands of per-trace rows.
 _TRAIN_LANE_PREFIXES = ("train.", "checkpoint.", "data.")
 
+#: Serve health-plane spans folded into one shared "serve" lane the same
+#: way: SLO burn episodes and preemption recomputes from every request
+#: line up on a single row, so a preemption-storm -> SLO-burn -> recovery
+#: episode reads as one story next to the per-trace request lanes.
+_SERVE_LANE_PREFIXES = ("serve.slo", "serve.preempt_recompute")
+
 
 def spans_to_chrome_events(spans: List[dict]) -> List[dict]:
     """Fold util.tracing spans into chrome-tracing "X" (complete) events.
@@ -52,8 +58,10 @@ def spans_to_chrome_events(spans: List[dict]) -> List[dict]:
     process lane per trace — a whole serve request reads top-to-bottom),
     ``tid`` is the span's name so sibling spans of the same kind share a
     track.  Training-plane spans (train./checkpoint./data.) instead share
-    the single "train" pid — see _TRAIN_LANE_PREFIXES.  Unfinished spans
-    (end=None) are skipped — an open span has no duration yet."""
+    the single "train" pid (_TRAIN_LANE_PREFIXES), and serve health-plane
+    spans (SLO burns, preemption recomputes) the single "serve" pid
+    (_SERVE_LANE_PREFIXES).  Unfinished spans (end=None) are skipped — an
+    open span has no duration yet."""
     out: List[dict] = []
     for s in spans:
         if s.get("end") is None:
@@ -65,6 +73,8 @@ def spans_to_chrome_events(spans: List[dict]) -> List[dict]:
         name = s.get("name", "")
         if name.startswith(_TRAIN_LANE_PREFIXES):
             pid = "train"
+        elif name.startswith(_SERVE_LANE_PREFIXES):
+            pid = "serve"
         else:
             pid = f"trace:{s.get('trace_id', '')[:8]}"
         ev = {

@@ -11,7 +11,12 @@ an operator's dashboard:
   somewhere: a dead registry row is a lie about coverage);
 * span names — ``tracing.span("x")`` / ``record_span[_batch]("x")`` must
   name a key of ``tracing.SPAN_REGISTRY``; dynamic f-string names must
-  start with a registered ``...::`` prefix entry;
+  start with a registered prefix entry (``...::`` or trailing-``_``
+  families like ``serve.ttft_``);
+* SLO objectives — ``SLOObjective("x", ...)`` call sites must name a key
+  of ``serve.slo.SLO_OBJECTIVES``, and every registered objective must be
+  wired into the watchdog's evaluation path (an objective nobody can
+  evaluate is a lie about coverage);
 * metric declarations — ``Counter/Gauge/Histogram("name", "help")`` with
   a literal name must be ``ray_tpu_``/``serve_`` prefixed, carry help
   text, and be declared at exactly one source site (the static half of
@@ -27,7 +32,7 @@ from __future__ import annotations
 
 import ast
 import os
-from typing import Dict, Iterator, List, Optional, Set, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
 
 from ray_tpu.devtools.analysis import core
 
@@ -77,6 +82,35 @@ class RegistryConsistencyChecker(core.Checker):
                             for p in _METRIC_EXEMPT) \
             or any(s in module.path for s in _METRIC_EXEMPT)
 
+        # SLO objectives "in use": ctor call sites anywhere, plus the
+        # watchdog's own evaluation wiring in serve/slo.py (dict keys /
+        # comparisons naming an objective beyond its registry declaration
+        # — e.g. _LATENCY_SERIES keys, the "availability" branch).
+        if module.path.endswith("serve/slo.py") and ctx.slo_objectives:
+            used: Set[str] = ctx.scratch.setdefault(
+                "slo_objectives_used", set())
+            decl_counts: Dict[str, int] = {}
+            for node in ast.walk(module.tree):
+                for target in core._assign_names(node):
+                    if isinstance(target, ast.Name) \
+                            and target.id == "SLO_OBJECTIVES":
+                        value = getattr(node, "value", None)
+                        if isinstance(value, ast.Dict):
+                            for k in value.keys:
+                                if isinstance(k, ast.Constant) \
+                                        and isinstance(k.value, str):
+                                    decl_counts[k.value] = \
+                                        decl_counts.get(k.value, 0) + 1
+            totals: Dict[str, int] = {}
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and node.value in ctx.slo_objectives:
+                    totals[node.value] = totals.get(node.value, 0) + 1
+            for name in ctx.slo_objectives:
+                if totals.get(name, 0) > decl_counts.get(name, 0):
+                    used.add(name)
+
         for node in ast.walk(module.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -101,6 +135,32 @@ class RegistryConsistencyChecker(core.Checker):
                                      f"declared in fault_injection."
                                      f"FAULT_POINTS"))
             # --- spans --------------------------------------------------
+            # --- SLO objectives ----------------------------------------
+            ctor_name = None
+            if isinstance(func, ast.Name):
+                ctor_name = func.id
+            elif isinstance(func, ast.Attribute):
+                ctor_name = func.attr
+            if ctor_name == "SLOObjective" \
+                    and ctx.slo_objectives is not None:
+                obj_name = _first_arg_str(node)
+                if obj_name is None:
+                    for kw in node.keywords:
+                        if kw.arg == "name" \
+                                and isinstance(kw.value, ast.Constant) \
+                                and isinstance(kw.value.value, str):
+                            obj_name = kw.value.value
+                if obj_name is not None:
+                    ctx.scratch.setdefault("slo_objectives_used",
+                                           set()).add(obj_name)
+                    if obj_name not in ctx.slo_objectives:
+                        yield core.Finding(
+                            check=self.name, path=module.path,
+                            line=node.lineno, symbol="<slo-objective>",
+                            detail=f"slo:{obj_name}",
+                            message=(f"SLO objective '{obj_name}' is not "
+                                     f"declared in serve.slo."
+                                     f"SLO_OBJECTIVES"))
             span_func = None
             if isinstance(func, ast.Attribute) and func.attr in _SPAN_FUNCS:
                 span_func = func.attr
@@ -130,7 +190,8 @@ class RegistryConsistencyChecker(core.Checker):
                             line=node.lineno, symbol="<span>",
                             detail=f"span:{prefix}",
                             message=(f"dynamic span name f'{prefix}...' "
-                                     f"matches no '::'-prefix entry in "
+                                     f"matches no prefix entry ('::' or "
+                                     f"trailing '_') in "
                                      f"tracing.SPAN_REGISTRY"))
             # --- metric declarations -----------------------------------
             ctor = None
@@ -181,6 +242,15 @@ class RegistryConsistencyChecker(core.Checker):
                     symbol="<span>", detail=f"span-unused:{span}",
                     message=(f"SPAN_REGISTRY entry '{span}' is never opened "
                              f"by any span()/record_span call site"))
+        slo_used = ctx.scratch.get("slo_objectives_used", set())
+        if ctx.slo_objectives:
+            for name in sorted(ctx.slo_objectives - slo_used):
+                yield core.Finding(
+                    check=self.name, path="ray_tpu/serve/slo.py", line=1,
+                    symbol="<slo-objective>", detail=f"slo-unused:{name}",
+                    message=(f"SLO_OBJECTIVES entry '{name}' is neither "
+                             f"constructed at any SLOObjective call site "
+                             f"nor wired into the watchdog evaluation"))
         for mname, sites in sorted(
                 ctx.scratch.get("metric_sites", {}).items()):
             distinct = sorted(set(sites))
@@ -213,6 +283,23 @@ METRIC_MODULES = (
 )
 
 ALLOWED_PREFIXES = ("ray_tpu_", "serve_")
+
+#: Windowed accessor (dotted path under ray_tpu.serve) -> the registry
+#: metric whose series it reads from the TimeSeriesAggregator.  The
+#: runtime lint verifies the accessor exists AND its series matches a
+#: declared metric name, so renaming a metric cannot silently strand an
+#: accessor on a dead series (the SLO watchdog and the ROADMAP item 1
+#: autoscaler consume these).
+ACCESSOR_SERIES = {
+    "metrics.request_rate": "serve_requests_total",
+    "metrics.ttft_p99": "ray_tpu_llm_ttft_seconds",
+    "metrics.inter_token_p99": "ray_tpu_llm_inter_token_seconds",
+    "metrics.kv_utilization": "ray_tpu_llm_kv_blocks_in_use",
+    "metrics.batch_occupancy": "ray_tpu_llm_batch_occupancy",
+    "metrics.goodput_tokens_per_s": "ray_tpu_llm_decode_tokens_total",
+    "metrics.recompute_waste_tokens_per_s":
+        "ray_tpu_llm_recompute_tokens_total",
+}
 
 
 def _import_metric_modules() -> None:
@@ -263,4 +350,24 @@ def collect_runtime_metric_violations() -> List[str]:
             violations.append(
                 f"{name}: declared at {len(sites)} sites: "
                 + ", ".join(sorted(sites)))
+
+    # Windowed-accessor wiring: each ACCESSOR_SERIES entry must resolve to
+    # a callable under ray_tpu.serve and read a series that a declared
+    # metric actually feeds (renames can't strand an accessor silently).
+    from ray_tpu import serve as _serve
+
+    for accessor, series in sorted(ACCESSOR_SERIES.items()):
+        obj: Any = _serve
+        for part in accessor.split("."):
+            obj = getattr(obj, part, None)
+            if obj is None:
+                break
+        if not callable(obj):
+            violations.append(
+                f"serve.{accessor}: accessor registered in ACCESSOR_SERIES "
+                f"does not resolve to a callable")
+        if series not in sites_by_name:
+            violations.append(
+                f"serve.{accessor}: reads series {series!r} which matches "
+                f"no declared in-package metric")
     return violations

@@ -180,6 +180,7 @@ class AnalysisContext:
     fault_points: Optional[Set[str]] = None
     span_names: Optional[Set[str]] = None
     span_prefixes: Optional[Tuple[str, ...]] = None
+    slo_objectives: Optional[Set[str]] = None
     metric_prefixes: Tuple[str, ...] = ("ray_tpu_", "serve_")
     #: set when the scan covers the whole package — enables aggregate
     #: (cross-module) checks like "registered fault point never consulted"
@@ -201,10 +202,12 @@ def _extract_literal_dict_keys(tree: ast.AST, var_name: str) -> Set[str]:
 
 
 def load_registries(ctx: AnalysisContext, package_dir: str) -> None:
-    """Fill ctx's fault-point and span registries from the package sources
-    (AST only — the analyzer never imports the analyzed code)."""
+    """Fill ctx's fault-point, span and SLO-objective registries from the
+    package sources (AST only — the analyzer never imports the analyzed
+    code)."""
     fi = os.path.join(package_dir, "_private", "fault_injection.py")
     tr = os.path.join(package_dir, "util", "tracing.py")
+    sl = os.path.join(package_dir, "serve", "slo.py")
     if ctx.fault_points is None and os.path.exists(fi):
         with open(fi, encoding="utf-8") as f:
             ctx.fault_points = _extract_literal_dict_keys(
@@ -213,9 +216,16 @@ def load_registries(ctx: AnalysisContext, package_dir: str) -> None:
         with open(tr, encoding="utf-8") as f:
             names = _extract_literal_dict_keys(ast.parse(f.read()),
                                                "SPAN_REGISTRY")
+        # Prefix entries end in "::" (task::, submit::) or "_" (dynamic
+        # bucket families like serve.ttft_<bucket>).
         ctx.span_prefixes = tuple(sorted(
-            n for n in names if n.endswith("::")))
-        ctx.span_names = {n for n in names if not n.endswith("::")}
+            n for n in names if n.endswith("::") or n.endswith("_")))
+        ctx.span_names = {n for n in names
+                          if not (n.endswith("::") or n.endswith("_"))}
+    if ctx.slo_objectives is None and os.path.exists(sl):
+        with open(sl, encoding="utf-8") as f:
+            ctx.slo_objectives = _extract_literal_dict_keys(
+                ast.parse(f.read()), "SLO_OBJECTIVES")
 
 
 # ------------------------------------------------------------------ checker

@@ -7,6 +7,7 @@ models: a replica pins its jitted program once and serves concurrent
 requests from one event loop.
 """
 
+from ray_tpu.serve import metrics, slo
 from ray_tpu.serve.api import (Application, Deployment, delete, deployment,
                                get_app_handle, get_deployment_handle,
                                list_deployments, list_replicas, run,
@@ -20,6 +21,7 @@ from ray_tpu.serve.exceptions import BackPressureError
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import multiplexed
 from ray_tpu.serve.proxy import Request
+from ray_tpu.serve.slo import SLOObjective
 
 __all__ = [
     "Application", "Deployment", "deployment", "run", "start", "shutdown",
@@ -28,5 +30,5 @@ __all__ = [
     "AutoscalingConfig", "DeploymentConfig", "GRPCOptions", "HTTPOptions",
     "DeploymentHandle", "DeploymentResponse", "Request", "multiplexed",
     "get_multiplexed_model_id", "batch", "continuous_batch", "EOS",
-    "SequenceSlot", "BackPressureError",
+    "SequenceSlot", "BackPressureError", "SLOObjective", "metrics", "slo",
 ]

@@ -22,12 +22,14 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu import serve
 from ray_tpu.exceptions import (ActorDiedError, ActorUnavailableError,
                                 TaskError, WorkerCrashedError)
 from ray_tpu.serve._sync import run_in_executor
+from ray_tpu.serve.llm import attribution as _attr
 from ray_tpu.serve.llm import metrics as _m
 from ray_tpu.serve.llm.blocks import BlockAllocator, BlockTable, NoFreeBlocks
 from ray_tpu.serve.llm.engine import LLMEngine, compose_model_key
@@ -139,34 +141,54 @@ class PrefillWorker(_ModelHostMixin):
         key = compose_model_key(req.get("model", "base"),
                                 req.get("adapter"))
         model = await self._load_model(key)
-        context = [int(t) for t in req["prompt"]] \
-            + [int(t) for t in req.get("resume_generated", ())]
+        resume = [int(t) for t in req.get("resume_generated", ())]
+        context = [int(t) for t in req["prompt"]] + resume
         tok = None
+        waited = 0.0  # admission-wait: block-headroom backoff, measured
+        prefill_dt = 0.0
         for attempt in range(40):
             table = BlockTable(self._allocator)
+            t0 = time.time()
             try:
                 with _tracing.span("serve.prefill",
                                    attributes={"model": key,
                                                "tokens": len(context)}):
                     tok = await run_in_executor(model.prefill, table,
                                                 context)
+                prefill_dt = time.time() - t0
                 break
             except NoFreeBlocks:
                 # Pool exhausted by concurrent prefills: back off until a
                 # peer frees its export (asyncio sleep — the loop serves
                 # other requests meanwhile).
                 table.release()
+                t1 = time.time()
                 await asyncio.sleep(0.005 * (attempt + 1))
+                waited += (t1 - t0) + (time.time() - t1)
         if tok is None:
             raise NoFreeBlocks("prefill pool exhausted after backoff")
         _m.PREFILL_TOKENS.inc(len(context), tags={"pool": "prefill"})
-        generated = list(req.get("resume_generated", ())) + [tok]
+        if resume and _attr.is_enabled():
+            # Recovery re-prefill: the whole context was computed once
+            # already (on the dead decode replica's behalf) — waste, not
+            # goodput, and its own span in the request's trace.
+            _m.RECOMPUTE_TOKENS.inc(len(context), tags={"pool": "prefill"})
+            _tracing.record_span("serve.preempt_recompute",
+                                 t0, t0 + prefill_dt,
+                                 attributes={"tokens": len(context),
+                                             "pool": "prefill"})
+        generated = resume + [tok]
+        t_exp = time.time()
         payload = export_kv(table, prompt=req["prompt"],
                             generated=generated,
                             model=req.get("model", "base"),
                             adapter=req.get("adapter"),
                             max_tokens=int(req.get("max_tokens", 16)))
         table.release()
+        # Measured buckets ride the payload so the frontend can attribute
+        # the request-level TTFT it alone can measure.
+        payload["attrib"] = {"admission": waited, "prefill": prefill_dt,
+                             "handoff": time.time() - t_exp}
         return payload
 
 
@@ -225,12 +247,29 @@ class LLMFrontend:
         max_tokens = int(req.get("max_tokens", 16))
         emitted: List[int] = []
         restarts = 0
+        attrib = None
+        if _attr.is_enabled():
+            from ray_tpu.serve.batching import _deployment_tag
+
+            # The frontend alone sees the true request wall (relay entry →
+            # first yield), so it owns the request-level TTFT; the worker
+            # pools' measured buckets arrive on the prefill payload and
+            # the RPC/relay overhead lands in the residual.
+            attrib = _attr.RequestAttribution(
+                pool="frontend", deployment=_deployment_tag(),
+                t_submit=time.time(),
+                trace_ctx=_tracing.current_context())
         while len(emitted) < max_tokens:
             payload = await self._prefill.options(
                 method_name="prefill").remote(
                     {**req, "resume_generated": emitted})
+            if attrib is not None:
+                for bucket, dt in (payload.get("attrib") or {}).items():
+                    attrib.accumulate(bucket, dt)
             for tok in payload["generated"][len(emitted):]:
                 emitted.append(tok)
+                if attrib is not None:
+                    attrib.on_emit(time.time())
                 yield tok
             if len(emitted) >= max_tokens:
                 return
@@ -240,6 +279,8 @@ class LLMFrontend:
             try:
                 async for tok in stream:
                     emitted.append(tok)
+                    if attrib is not None:
+                        attrib.on_emit(time.time())
                     yield tok
                     if len(emitted) >= max_tokens:
                         # The budget is known here — close the stream now
@@ -263,23 +304,32 @@ def build_disagg_app(*, ckpt_root: Optional[str] = None,
                      frontend_replicas: int = 1,
                      num_blocks: int = 512, block_size: int = 16,
                      prefill_time_per_token_s: float = 0.0,
-                     decode_step_time_s: float = 0.0) -> Any:
+                     decode_step_time_s: float = 0.0,
+                     deployment_prefix: str = "") -> Any:
     """Bind the prefill pool + decode pool + frontend into one app.
 
     Frontends are thin relays holding no model state and no simulated
     device — scale them freely to keep the per-token stream pulls off any
-    single event loop (the worker pools set the real capacity)."""
+    single event loop (the worker pools set the real capacity).
+
+    ``deployment_prefix`` prepends to each deployment name — the
+    deployment tag on every attribution metric the app emits — so two
+    disagg apps in one process stay distinguishable in the latency
+    time-series and can carry separate SLO objectives."""
     prefill = PrefillWorker.options(
+        name=f"{deployment_prefix}PrefillWorker",
         num_replicas=prefill_replicas).bind(
             ckpt_root=ckpt_root, model_specs=model_specs,
             num_blocks=num_blocks, block_size=block_size,
             prefill_time_per_token_s=prefill_time_per_token_s)
     decode = DecodeWorker.options(
+        name=f"{deployment_prefix}DecodeWorker",
         num_replicas=decode_replicas).bind(
             ckpt_root=ckpt_root, model_specs=model_specs,
             num_blocks=num_blocks, block_size=block_size,
             decode_step_time_s=decode_step_time_s)
     return LLMFrontend.options(
+        name=f"{deployment_prefix}LLMFrontend",
         num_replicas=frontend_replicas).bind(prefill, decode)
 
 

@@ -27,9 +27,11 @@ queue keys on the request's model id.
 from __future__ import annotations
 
 import inspect
+import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Union
 
 from ray_tpu.serve._sync import run_in_executor
+from ray_tpu.serve.llm import attribution as _attr
 from ray_tpu.serve.llm import metrics as _m
 from ray_tpu.serve.llm.blocks import BlockAllocator, BlockTable, NoFreeBlocks
 from ray_tpu.serve.llm.scheduler import (EngineScheduler, FINISHED, RUNNING,
@@ -60,7 +62,8 @@ class LLMEngine:
                  watermark_blocks: int = 0, max_prefill_per_step: int = 1,
                  max_running: Optional[int] = None,
                  default_max_tokens: int = 16,
-                 pool: str = "engine", decode_only: bool = False):
+                 pool: str = "engine", decode_only: bool = False,
+                 batch_capacity: int = 16):
         self._get_model = get_model
         self.allocator = BlockAllocator(num_blocks, block_size, pool=pool)
         self.scheduler = EngineScheduler(self.allocator,
@@ -69,6 +72,12 @@ class LLMEngine:
         self.max_prefill_per_step = max_prefill_per_step
         self.default_max_tokens = default_max_tokens
         self.decode_only = decode_only
+        #: continuous-batch slot capacity (the @serve.continuous_batch
+        #: max_batch_size) — denominator of the occupancy gauge.
+        self.batch_capacity = max(1, int(batch_capacity))
+        #: deployment tag for attribution metrics, resolved lazily (the
+        #: engine may be constructed outside a replica, e.g. unit tests).
+        self._deployment: Optional[str] = None
         #: id(slot) -> (slot, seq): every stream this engine has seen and
         #: not yet retired — reaped on cancellation each iteration.
         self._tracked: Dict[int, Any] = {}
@@ -80,6 +89,13 @@ class LLMEngine:
         if inspect.isawaitable(out):
             out = await out
         return out
+
+    def _deployment_name(self) -> str:
+        if self._deployment is None:
+            from ray_tpu.serve.batching import _deployment_tag
+
+            self._deployment = _deployment_tag()
+        return self._deployment
 
     def _make_sequence(self, request: Any) -> Sequence:
         if not isinstance(request, dict) or "prompt" not in request:
@@ -108,6 +124,10 @@ class LLMEngine:
     async def step(self, slots: List[Any]) -> List[Any]:
         """One continuous-batch iteration over the live slots."""
         self._reap()
+        attributing = _attr.is_enabled()
+        if attributing:
+            _m.BATCH_OCCUPANCY.set(len(slots) / self.batch_capacity,
+                                   tags={"pool": self.allocator.pool})
         # Admit brand-new streams into the scheduler's waiting queue.
         for slot in slots:
             if "llm" not in slot.state:
@@ -119,8 +139,26 @@ class LLMEngine:
                 slot.state["llm"] = seq
                 self._tracked[id(slot)] = (slot, seq)
                 self.scheduler.add(seq)
+                if attributing:
+                    now = time.time()
+                    # Decode-pool sequences resumed from a handoff have
+                    # already emitted tokens upstream: the frontend owns
+                    # the request-level TTFT; this side still feeds
+                    # pool-tagged gaps and buckets.
+                    seq.attrib = _attr.RequestAttribution(
+                        pool=self.allocator.pool,
+                        deployment=self._deployment_name(),
+                        t_submit=getattr(slot, "_enq_t", now),
+                        trace_ctx=getattr(slot, "_trace_ctx", None),
+                        request_level=seq.num_emitted == 0)
+                    seq.attrib.on_added(now)
 
         admitted = self.scheduler.admit(max_new=self.max_prefill_per_step)
+        if admitted:
+            t_admit = time.time()
+            for seq in admitted:
+                if seq.attrib is not None:
+                    seq.attrib.on_admitted(t_admit)
         just_prefilled = set()
         for seq in admitted:
             try:
@@ -172,6 +210,7 @@ class LLMEngine:
         model = await self._model(seq.model_key)
         context = seq.context()
         table = BlockTable(self.allocator)
+        t0 = time.time()
         with _tracing.span("serve.prefill",
                            attributes={"model": seq.model_key,
                                        "tokens": len(context)}):
@@ -193,14 +232,25 @@ class LLMEngine:
         seq.generated.append(tok)
         _m.PREFILL_TOKENS.inc(len(context),
                               tags={"pool": self.allocator.pool})
+        if seq.attrib is not None:
+            now = time.time()
+            if seq.preemptions > 0:
+                # Resume after preemption: the whole context (prompt plus
+                # tokens the request already produced) is recomputed work.
+                seq.attrib.on_recompute(now - t0, len(context), now)
+            else:
+                seq.attrib.on_prefill(now - t0)
 
     def _import_handoff(self, seq: Sequence) -> None:
         """Decode-side admission: rebuild the block table from exported
         KV pages instead of recomputing the prefill."""
         from ray_tpu.serve.llm import handoff as _handoff
 
+        t0 = time.time()
         seq.table = _handoff.import_kv(seq.handoff, self.allocator)
         seq.handoff = None
+        if seq.attrib is not None:
+            seq.attrib.on_handoff(time.time() - t0)
 
     def _decode_group(self, model: ToyLM, group: List[Sequence]) -> None:
         """One simulated device pass for a single-(model, adapter) group;
@@ -241,6 +291,8 @@ class LLMEngine:
             return err
         tok = seq.pop_emission()
         if tok is not None:
+            if seq.attrib is not None:
+                seq.attrib.on_emit(time.time())
             return tok
         if seq.finished or seq.status == FINISHED:
             self.scheduler.finish(seq)

@@ -24,6 +24,7 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu._private import fault_injection
+from ray_tpu.serve import metrics as _serve_metrics
 from ray_tpu.serve.llm import metrics as _m
 from ray_tpu.serve.llm.blocks import BlockAllocator, BlockTable
 from ray_tpu.util import tracing as _tracing
@@ -56,7 +57,12 @@ def export_kv(table: BlockTable, *, prompt: List[int],
         "max_tokens": int(max_tokens),
         "nbytes": _payload_bytes(pages),
     }
-    _tracing.record_span("serve.kv_handoff", start, time.time(),
+    end = time.time()
+    _m.HANDOFF_SECONDS.observe(
+        end - start, tags={"transport": "object_store",
+                           "direction": "export"},
+        exemplar=_serve_metrics.trace_exemplar())
+    _tracing.record_span("serve.kv_handoff", start, end,
                          attributes={"direction": "export",
                                      "tokens": table.num_tokens,
                                      "bytes": payload["nbytes"]})
@@ -75,7 +81,11 @@ def import_kv(payload: Dict[str, Any],
     _m.KV_HANDOFFS.inc(tags={"transport": transport})
     _m.KV_HANDOFF_BYTES.inc(payload.get("nbytes", 0),
                             tags={"transport": transport})
-    _tracing.record_span("serve.kv_handoff", start, time.time(),
+    end = time.time()
+    _m.HANDOFF_SECONDS.observe(
+        end - start, tags={"transport": transport, "direction": "import"},
+        exemplar=_serve_metrics.trace_exemplar())
+    _tracing.record_span("serve.kv_handoff", start, end,
                          attributes={"direction": "import",
                                      "tokens": table.num_tokens,
                                      "bytes": payload.get("nbytes", 0)})

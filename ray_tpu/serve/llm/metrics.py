@@ -55,3 +55,41 @@ RUNNING_SEQUENCES = _metrics.Gauge(
     "ray_tpu_llm_running_sequences",
     "Sequences in the decode batch of the engine scheduler",
     tag_keys=("pool",))
+
+# Latency attribution (PR 12): request-level histograms carry trace-id
+# exemplars (the serve.metrics pattern) so a p99 outlier links straight
+# to its trace.  Boundaries are shared with the serve request histograms
+# so TTFT and full-request latency are comparable bucket-for-bucket.
+from ray_tpu.serve.metrics import LATENCY_BOUNDARIES as _LATENCY_BOUNDARIES
+
+TTFT_SECONDS = _metrics.Histogram(
+    "ray_tpu_llm_ttft_seconds",
+    "Time to first token, request submit to first emission",
+    boundaries=_LATENCY_BOUNDARIES,
+    tag_keys=("deployment", "pool"))
+INTER_TOKEN_SECONDS = _metrics.Histogram(
+    "ray_tpu_llm_inter_token_seconds",
+    "Gap between consecutive token emissions of one request",
+    boundaries=_LATENCY_BOUNDARIES,
+    tag_keys=("deployment", "pool"))
+TTFT_BUCKET_SECONDS = _metrics.Histogram(
+    "ray_tpu_llm_ttft_bucket_seconds",
+    "One named TTFT attribution bucket (queue/admission/prefill/handoff/"
+    "residual); buckets of a request sum to its TTFT",
+    boundaries=_LATENCY_BOUNDARIES,
+    tag_keys=("bucket", "pool"))
+HANDOFF_SECONDS = _metrics.Histogram(
+    "ray_tpu_llm_kv_handoff_seconds",
+    "KV-page export/import latency per handoff",
+    boundaries=_LATENCY_BOUNDARIES,
+    tag_keys=("transport", "direction"))
+RECOMPUTE_TOKENS = _metrics.Counter(
+    "ray_tpu_llm_recompute_tokens_total",
+    "Tokens re-prefilled after preemption (throughput counted twice; the "
+    "waste term in goodput accounting)",
+    tag_keys=("pool",))
+BATCH_OCCUPANCY = _metrics.Gauge(
+    "ray_tpu_llm_batch_occupancy",
+    "Continuous-batch fill fraction per engine step (live slots / batch "
+    "capacity)",
+    tag_keys=("pool",))

@@ -16,6 +16,7 @@ model is deterministic, so resume regenerates the identical suffix.
 from __future__ import annotations
 
 import itertools
+import time
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.serve.llm import metrics as _m
@@ -53,6 +54,9 @@ class Sequence:
         #: Set by the engine when prefill/import failed — surfaced as the
         #: stream's terminal error at the next emission.
         self.error: Optional[BaseException] = None
+        #: Per-request latency attribution (serve/llm/attribution.py);
+        #: stays None when attribution is disabled.
+        self.attrib = None
 
     def context(self) -> List[int]:
         """Tokens whose KV entries the cache must hold before the next
@@ -157,6 +161,8 @@ class EngineScheduler:
         seq.status = WAITING
         seq.preemptions += 1
         self.waiting.insert(0, seq)
+        if seq.attrib is not None:
+            seq.attrib.on_preempted(time.time())
         _m.PREEMPTIONS.inc(tags={"pool": self.allocator.pool})
         self._gauges()
 

@@ -144,6 +144,112 @@ def request_rate(deployment: str, window_s: float = 60.0,
                            {"deployment": deployment}, window_s, now)
 
 
+# ----------------------------------------------------- LLM windowed view
+# Accessors over the attribution layer's raw per-request points
+# (serve/llm/attribution.py feeds the process aggregator directly), shaped
+# for the ROADMAP item 1 autoscaler and the SLO watchdog: exact windowed
+# percentiles, not histogram-bucket estimates.  Deployment tags on LLM
+# series use the bare replica-context name; callers holding a full
+# "app#name" id fall back to the name part automatically.
+
+
+def _dep_tag_candidates(deployment: Optional[str]):
+    if not deployment:
+        return (None,)
+    if "#" in deployment:
+        return ({"deployment": deployment},
+                {"deployment": deployment.split("#", 1)[1]})
+    return ({"deployment": deployment},)
+
+
+def _windowed_percentile(name: str, q: float, deployment: Optional[str],
+                         window_s: float, now: Optional[float]) -> float:
+    from ray_tpu.util.metrics_agent import get_aggregator
+
+    agg = get_aggregator()
+    for tags in _dep_tag_candidates(deployment):
+        vals = sorted(agg.window_values(name, tags, window_s, now))
+        if vals:
+            rank = min(len(vals) - 1,
+                       int(round((q / 100.0) * (len(vals) - 1))))
+            return vals[rank]
+    return 0.0
+
+
+def ttft_p99(deployment: Optional[str] = None, window_s: float = 60.0,
+             now: Optional[float] = None, q: float = 99.0) -> float:
+    """Windowed time-to-first-token percentile (seconds) across every
+    request the attribution layer finalized; 0.0 before any land."""
+    return _windowed_percentile("ray_tpu_llm_ttft_seconds", q, deployment,
+                                window_s, now)
+
+
+def inter_token_p99(deployment: Optional[str] = None,
+                    window_s: float = 60.0, now: Optional[float] = None,
+                    q: float = 99.0) -> float:
+    """Windowed inter-token-gap percentile (seconds)."""
+    return _windowed_percentile("ray_tpu_llm_inter_token_seconds", q,
+                                deployment, window_s, now)
+
+
+def _pool_tags(pool: Optional[str]) -> Optional[Dict[str, str]]:
+    return {"pool": pool} if pool else None
+
+
+def kv_utilization(pool: Optional[str] = None, window_s: float = 60.0,
+                   now: Optional[float] = None) -> float:
+    """Windowed mean KV-block utilization (in-use / total, 0..1) for one
+    pool or (subset rollup) across all pools."""
+    from ray_tpu.util.metrics_agent import get_aggregator
+
+    agg = get_aggregator()
+    agg.sample_registry()
+    tags = _pool_tags(pool)
+    total = agg.window_rate("ray_tpu_llm_kv_blocks_total", tags,
+                            window_s, now)
+    if total <= 0.0:
+        return 0.0
+    in_use = agg.window_rate("ray_tpu_llm_kv_blocks_in_use", tags,
+                             window_s, now)
+    return in_use / total
+
+
+def batch_occupancy(pool: Optional[str] = None, window_s: float = 60.0,
+                    now: Optional[float] = None) -> float:
+    """Windowed mean continuous-batch fill fraction (0..1)."""
+    from ray_tpu.util.metrics_agent import get_aggregator
+
+    agg = get_aggregator()
+    agg.sample_registry()
+    return agg.window_rate("ray_tpu_llm_batch_occupancy", _pool_tags(pool),
+                           window_s, now)
+
+
+def goodput_tokens_per_s(pool: Optional[str] = None,
+                         window_s: float = 60.0,
+                         now: Optional[float] = None) -> float:
+    """Decode tokens actually emitted per second over the window."""
+    from ray_tpu.util.metrics_agent import get_aggregator
+
+    agg = get_aggregator()
+    agg.sample_registry()
+    return agg.window_rate("ray_tpu_llm_decode_tokens_total",
+                           _pool_tags(pool), window_s, now)
+
+
+def recompute_waste_tokens_per_s(pool: Optional[str] = None,
+                                 window_s: float = 60.0,
+                                 now: Optional[float] = None) -> float:
+    """Tokens re-prefilled after preemption/recovery per second — the
+    waste term against :func:`goodput_tokens_per_s`."""
+    from ray_tpu.util.metrics_agent import get_aggregator
+
+    agg = get_aggregator()
+    agg.sample_registry()
+    return agg.window_rate("ray_tpu_llm_recompute_tokens_total",
+                           _pool_tags(pool), window_s, now)
+
+
 def rollup(snapshots: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     """p50/p95/p99 + request/error totals from per-pid snapshots — the
     serve.status() / /api/serve latency rollup."""

@@ -146,6 +146,39 @@ class TimeSeriesAggregator:
         with self._lock:
             return self._series.get(key)
 
+    def _match(self, name: str,
+               tags: Optional[Dict[str, str]]) -> List[_Series]:
+        """Series answering a ``(name, tags)`` query: the exact tag-set
+        when one exists, else every series of that name whose tags are a
+        superset of the query (so ``{"pool": "prefill"}`` rolls up the
+        per-``(pool, deployment)`` LLM gauges, and no tags at all means
+        "all tag-sets" instead of silently missing)."""
+        key = (name, tuple(sorted((tags or {}).items())))
+        with self._lock:
+            exact = self._series.get(key)
+            if exact is not None:
+                return [exact]
+            return [s for (n, _), s in self._series.items()
+                    if n == name and _subset(tags, s.tags)]
+
+    def _rate_locked(self, series: _Series, start: float,
+                     window_s: float) -> float:
+        ts, values = series.window(start)
+        if not ts:
+            return 0.0
+        if series.kind == "counter":
+            total = 0.0
+            for i in range(1, len(ts)):
+                if ts[i] >= start:
+                    total += max(0.0, values[i] - values[i - 1])
+            return total / float(window_s)
+        in_win = [v for t, v in zip(ts, values) if t >= start]
+        if not in_win:
+            return 0.0
+        if series.kind == "gauge":
+            return sum(in_win) / len(in_win)
+        return sum(in_win) / float(window_s)
+
     def window_rate(self, name: str, tags: Optional[Dict[str, str]] = None,
                     window_s: float = 60.0,
                     now: Optional[float] = None) -> float:
@@ -157,62 +190,61 @@ class TimeSeriesAggregator:
         value: sum of in-window points over ``window_s``.
         gauge: the windowed mean (a level has no meaningful rate; the mean
         is what "utilization over the last minute" asks for).
+
+        Queries whose tag-set has no exact series aggregate every series
+        carrying a superset of the tags: counters/values sum (total rate),
+        gauges average (mean level across tag-sets).
         """
-        series = self._get(name, tags)
-        if series is None:
+        matches = self._match(name, tags)
+        if not matches:
             return 0.0
         t1 = time.time() if now is None else float(now)
         start = t1 - float(window_s)
         with self._lock:
-            ts, values = series.window(start)
-            if not ts:
-                return 0.0
-            if series.kind == "counter":
-                total = 0.0
-                for i in range(1, len(ts)):
-                    if ts[i] >= start:
-                        total += max(0.0, values[i] - values[i - 1])
-                return total / float(window_s)
-            in_win = [v for t, v in zip(ts, values) if t >= start]
-            if not in_win:
-                return 0.0
-            if series.kind == "gauge":
-                return sum(in_win) / len(in_win)
-            return sum(in_win) / float(window_s)
+            rates = [self._rate_locked(s, start, window_s) for s in matches]
+        if matches[0].kind == "gauge":
+            return sum(rates) / len(rates)
+        return sum(rates)
 
     def window_sum(self, name: str, tags: Optional[Dict[str, str]] = None,
                    window_s: float = 60.0,
                    now: Optional[float] = None) -> float:
         """Total over the trailing window: counter → increase, value →
-        sum of points, gauge → windowed mean (summing levels is noise)."""
-        series = self._get(name, tags)
-        if series is None:
+        sum of points, gauge → windowed mean (summing levels is noise).
+        Subset-tag queries aggregate like :meth:`window_rate`."""
+        matches = self._match(name, tags)
+        if not matches:
             return 0.0
-        if series.kind in ("counter", "gauge"):
-            rate = self.window_rate(name, tags, window_s, now)
-            return rate * float(window_s) if series.kind == "counter" else rate
+        rate = self.window_rate(name, tags, window_s, now)
+        return rate if matches[0].kind == "gauge" else rate * float(window_s)
+
+    def window_values(self, name: str,
+                      tags: Optional[Dict[str, str]] = None,
+                      window_s: float = 60.0,
+                      now: Optional[float] = None) -> List[float]:
+        """All in-window point values across every matching series (the
+        SLO watchdog's bad-fraction input: each point is one request's
+        latency, so "fraction over threshold" is exact, not bucketed)."""
+        matches = self._match(name, tags)
         t1 = time.time() if now is None else float(now)
         start = t1 - float(window_s)
+        out: List[float] = []
         with self._lock:
-            ts, values = series.window(start)
-            return sum(v for t, v in zip(ts, values) if t >= start)
+            for series in matches:
+                ts, values = series.window(start)
+                out.extend(v for t, v in zip(ts, values) if t >= start)
+        return out
 
     def window_percentile(self, name: str, q: float,
                           tags: Optional[Dict[str, str]] = None,
                           window_s: float = 60.0,
                           now: Optional[float] = None) -> float:
         """q-th percentile (q in [0, 100]) of in-window point values —
-        exact over the retained points, unlike bucketed estimates."""
+        exact over the retained points, unlike bucketed estimates.
+        Subset-tag queries pool points across matching series."""
         if not 0 <= q <= 100:
             raise ValueError(f"q must be in [0, 100], got {q}")
-        series = self._get(name, tags)
-        if series is None:
-            return 0.0
-        t1 = time.time() if now is None else float(now)
-        start = t1 - float(window_s)
-        with self._lock:
-            ts, values = series.window(start)
-            in_win = sorted(v for t, v in zip(ts, values) if t >= start)
+        in_win = sorted(self.window_values(name, tags, window_s, now))
         if not in_win:
             return 0.0
         rank = min(len(in_win) - 1, int(round((q / 100.0) * (len(in_win) - 1))))
@@ -220,6 +252,8 @@ class TimeSeriesAggregator:
 
     def latest(self, name: str,
                tags: Optional[Dict[str, str]] = None) -> Optional[float]:
+        """Most recent point of the EXACT tag-set (no subset rollup — a
+        "latest" across tag-sets has no single meaningful value)."""
         series = self._get(name, tags)
         if series is None or not series.values:
             return None
@@ -313,19 +347,10 @@ class TimeSeriesCollector:
     def window_rate(self, name: str, tags: Optional[Dict[str, str]] = None,
                     window_s: float = 60.0,
                     now: Optional[float] = None) -> float:
-        if tags is not None and "node" in tags:
-            return self._agg.window_rate(name, tags, window_s, now)
-        # Cluster view: aggregate over every source holding this series.
-        with self._agg._lock:
-            matches = [s for (n, _), s in self._agg._series.items()
-                       if n == name and _subset(tags, s.tags)]
-        if not matches:
-            return 0.0
-        rates = [self._agg.window_rate(name, s.tags, window_s, now)
-                 for s in matches]
-        if matches[0].kind == "gauge":
-            return sum(rates) / len(rates)
-        return sum(rates)
+        # Cluster view (no/partial tags, e.g. missing ``node``) falls out
+        # of the aggregator's own subset rollup: per-source series sum
+        # (counter/value kinds) or average (gauges).
+        return self._agg.window_rate(name, tags, window_s, now)
 
     def openmetrics_text(self, windows: Sequence[float] = (60.0,),
                          now: Optional[float] = None) -> str:

@@ -46,11 +46,12 @@ _GOLDEN = 0x9E3779B97F4A7C15
 
 
 #: Canonical registry of span names the framework opens: name -> what the
-#: span covers.  Entries ending in ``::`` are prefixes for dynamic names
-#: (``f"task::{name}"``).  The static analyzer (registry-consistency
-#: checker) enforces that every span()/record_span call site uses a
-#: registered name and that no registered name is dead — dashboards and
-#: trace queries key on these strings, so a typo'd name is an invisible gap.
+#: span covers.  Entries ending in ``::`` or ``_`` are prefixes for
+#: dynamic names (``f"task::{name}"``, ``f"serve.ttft_{bucket}"``).  The
+#: static analyzer (registry-consistency checker) enforces that every
+#: span()/record_span call site uses a registered name and that no
+#: registered name is dead — dashboards and trace queries key on these
+#: strings, so a typo'd name is an invisible gap.
 SPAN_REGISTRY: Dict[str, str] = {
     "submit::": "driver-side task submission (suffix: task name)",
     "task::": "worker-side task execution (suffix: task name)",
@@ -64,6 +65,11 @@ SPAN_REGISTRY: Dict[str, str] = {
     "serve.decode": "llm: one decode micro-batch pass (single model key)",
     "serve.kv_handoff": "llm: KV-page export/import between prefill and "
                         "decode pools",
+    "serve.ttft_": "llm: one TTFT attribution bucket (suffix: queue | "
+                   "admission | prefill | handoff | residual)",
+    "serve.preempt_recompute": "llm: prefill re-run of already-generated "
+                               "tokens after a preemption",
+    "serve.slo_burn": "slo: one deployment's burn episode, alert -> clear",
     "checkpoint.save": "writer: shard serialize + persist",
     "checkpoint.commit": "coordinator: commit phase up to atomic rename",
     "checkpoint.restore": "restore_pytree entry",

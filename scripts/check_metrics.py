@@ -20,6 +20,7 @@ if _REPO_ROOT not in sys.path:  # runnable from any cwd without installing
     sys.path.insert(0, _REPO_ROOT)
 
 from ray_tpu.devtools.analysis.checkers.registry_consistency import (  # noqa: E402,F401
+    ACCESSOR_SERIES,
     ALLOWED_PREFIXES,
     METRIC_MODULES,
     collect_runtime_metric_violations,

@@ -47,6 +47,31 @@ def kill_one_replica():
     return kill_actor_matching("Replica")
 
 
+def kill_llm_decode_replica(app_name: str = "default", index: int = 0):
+    """Kill (no restart) one DecodeWorker replica of a disaggregated LLM
+    app — the canonical preemption-storm trigger for the SLO chaos tests:
+    every stream the replica hosted stalls, re-prefills on a survivor,
+    and surfaces one oversized inter-token gap.  Returns the killed
+    actor id."""
+    import time
+
+    from ray_tpu import serve
+    from ray_tpu._private.runtime import get_runtime
+
+    dh = serve.get_deployment_handle("DecodeWorker", app_name)
+    sch = dh._get_router()._scheduler
+    # A fresh handle's router learns membership from the controller push;
+    # wait for it rather than racing the long-poll.
+    deadline = time.time() + 10
+    while time.time() < deadline and not sch._replicas:
+        time.sleep(0.05)
+    entries = list(sch._replicas)
+    assert entries, f"no decode replicas in app {app_name!r} to kill"
+    actor_id = entries[index % len(entries)]["actor"]._actor_id
+    get_runtime().kill_actor(actor_id, no_restart=True)
+    return actor_id
+
+
 def kill_node(node_id: Optional[str] = None,
               exclude_head: bool = True) -> Optional[str]:
     """Preempt a whole node (all hosted actors killed + node removed from

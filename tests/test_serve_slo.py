@@ -1,0 +1,399 @@
+"""Inference observability plane (ISSUE 12): latency attribution + SLO
+burn-rate watchdog.
+
+Unit layer: ``split_wall``'s exact-sum construction (the buckets sum to
+the measured wall by construction, no epsilon), the
+``RequestAttribution`` lifecycle including the preemption re-arm, the
+retroactive ``serve.ttft_*`` child spans, and the multi-window burn-rate
+state machine driven with deterministic timestamps (fires only when both
+windows burn, clears on fast-window recovery, exports one
+``serve.slo_burn`` episode span).  Integration layer: ``serve.status()``
+carrying the per-deployment ``"slo"`` evaluation and the metrics agent's
+``/api/serve/slo`` route.
+"""
+
+import json
+import random
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve import slo as slo_mod
+from ray_tpu.serve.llm import attribution as attr
+from ray_tpu.serve.slo import SLOObjective, SLOWatchdog
+from ray_tpu.util import tracing
+from ray_tpu.util.metrics_agent import get_aggregator
+
+
+# ----------------------------------------------------------- split_wall
+class TestSplitWall:
+    def test_buckets_sum_to_wall(self):
+        split = attr.split_wall(1.0, {"queue": 0.3, "admission": 0.2,
+                                      "prefill": 0.4, "handoff": 0.05})
+        assert split["residual"] == pytest.approx(0.05)
+        assert sum(split.values()) == pytest.approx(1.0, rel=1e-12)
+
+    def test_overmeasured_buckets_capped_in_order(self):
+        # queue + admission alone exceed the wall: queue keeps its measure,
+        # admission absorbs what's left, everything later (and the
+        # residual) is zero — still summing exactly.
+        split = attr.split_wall(0.5, {"queue": 0.4, "admission": 0.3,
+                                      "prefill": 0.2})
+        assert split == {"queue": 0.4, "admission": pytest.approx(0.1),
+                         "prefill": 0.0, "handoff": 0.0, "residual": 0.0}
+        assert sum(split.values()) == pytest.approx(0.5, rel=1e-12)
+
+    def test_recorded_wall_is_bit_exact_sum_under_random_measures(self):
+        # The construction contract: whatever the measured buckets, the
+        # wall record_ttft reports IS the split's left-to-right sum —
+        # equality is bit-exact, not within an epsilon (raw split_wall
+        # carries a couple ulps of subtraction dust vs the clock delta).
+        rng = random.Random(0)
+        for _ in range(200):
+            wall = rng.uniform(0.0, 2.0)
+            buckets = {b: rng.uniform(-0.1, 1.0)
+                       for b in attr.TTFT_BUCKETS if rng.random() < 0.8}
+            split = attr.split_wall(wall, buckets)
+            assert all(v >= 0.0 for v in split.values())
+            assert sum(split.values()) == pytest.approx(wall, rel=1e-12)
+            rec_wall = attr.record_ttft(wall, buckets,
+                                        deployment="attr-dep-rand",
+                                        pool="mono")
+            assert sum(rec_wall.values()) == attr.recent_ttft()[-1]["wall"]
+
+    def test_negative_wall_clamps_to_zero(self):
+        split = attr.split_wall(-0.5, {"queue": 0.1})
+        assert sum(split.values()) == 0.0
+
+
+# -------------------------------------------------- RequestAttribution
+class TestRequestAttribution:
+    def test_lifecycle_buckets_and_recent_record(self):
+        a = attr.RequestAttribution(pool="mono", deployment="attr-dep-life",
+                                    t_submit=100.0)
+        a.on_added(100.2)
+        a.on_admitted(100.5)
+        a.on_prefill(0.4)
+        a.on_handoff(0.05)
+        a.on_emit(101.0)  # first token: finalizes the TTFT
+        rec = attr.recent_ttft()[-1]
+        assert rec["deployment"] == "attr-dep-life"
+        assert rec["wall"] == pytest.approx(1.0)
+        b = rec["buckets"]
+        assert b["queue"] == pytest.approx(0.2)
+        assert b["admission"] == pytest.approx(0.3)
+        assert b["prefill"] == pytest.approx(0.4)
+        assert b["handoff"] == pytest.approx(0.05)
+        assert sum(b.values()) == rec["wall"]  # construction-verified
+        # Second emission records an inter-token gap, not another TTFT.
+        a.on_emit(101.1)
+        vals = get_aggregator().window_values(
+            "ray_tpu_llm_inter_token_seconds",
+            {"deployment": "attr-dep-life"}, window_s=3600.0)
+        assert len(vals) == 1 and vals[0] == pytest.approx(0.1)
+
+    def test_preemption_rearms_admission_mark(self):
+        a = attr.RequestAttribution(pool="decode", deployment="attr-dep-pre",
+                                    t_submit=10.0)
+        a.on_added(10.1)
+        a.on_admitted(10.2)
+        a.on_preempted(15.0)  # blocks reclaimed mid-decode
+        a.on_admitted(15.5)   # requeued wait is 0.5s, NOT 5.3s
+        assert a.preemptions == 1
+        assert a.buckets["admission"] == pytest.approx(0.1 + 0.5)
+
+    def test_decode_pool_sequence_skips_request_level_ttft(self):
+        before = attr.recent_ttft()
+        a = attr.RequestAttribution(pool="decode", deployment="attr-dep-dec",
+                                    t_submit=50.0, request_level=False)
+        a.on_added(50.1)
+        a.on_emit(50.2)  # resumed sequence's first local emission
+        assert attr.recent_ttft() == before  # frontend owns the TTFT
+        a.on_emit(50.3)
+        vals = get_aggregator().window_values(
+            "ray_tpu_llm_inter_token_seconds",
+            {"deployment": "attr-dep-dec"}, window_s=3600.0)
+        assert len(vals) == 1
+
+    def test_ttft_spans_contiguous_under_parent(self):
+        tracing.clear_spans()
+        tracing.enable_tracing()
+        try:
+            ctx = {"trace_id": "t" * 32, "span_id": "parent-span"}
+            split = attr.record_ttft(
+                1.0, {"queue": 0.2, "admission": 0.3, "prefill": 0.4},
+                deployment="attr-dep-span", pool="mono", trace_ctx=ctx,
+                start=100.0)
+            spans = [s for s in tracing.exported_spans()
+                     if s["name"].startswith("serve.ttft_")]
+            assert [s["name"] for s in spans] == [
+                "serve.ttft_queue", "serve.ttft_admission",
+                "serve.ttft_prefill", "serve.ttft_residual"]
+            # Contiguous: each span starts where the previous ended, the
+            # family covers [start, start + wall] with no gaps.
+            t = 100.0
+            for s in spans:
+                assert s["start"] == pytest.approx(t)
+                assert s["trace_id"] == ctx["trace_id"]
+                assert s["parent_id"] == ctx["span_id"]
+                t = s["end"]
+            assert t == pytest.approx(100.0 + sum(split.values()))
+        finally:
+            tracing.disable_tracing()
+            tracing.clear_spans()
+
+    def test_disabled_layer_emits_nothing(self):
+        before = attr.recent_ttft()
+        attr.set_enabled(False)
+        try:
+            assert not attr.is_enabled()
+            # The engine gates on is_enabled() before creating attributions;
+            # the module-level recorders stay callable either way.
+        finally:
+            attr.set_enabled(True)
+        assert attr.is_enabled()
+        assert attr.recent_ttft() == before
+
+    def test_recompute_counts_waste_and_span(self):
+        tracing.clear_spans()
+        tracing.enable_tracing()
+        try:
+            ctx = {"trace_id": "r" * 32, "span_id": "root"}
+            a = attr.RequestAttribution(pool="decode",
+                                        deployment="attr-dep-rec",
+                                        t_submit=0.0, trace_ctx=ctx)
+            agg = get_aggregator()
+            base = agg.window_sum("ray_tpu_llm_recompute_tokens_total",
+                                  {"pool": "decode"}, window_s=3600.0)
+            a.on_recompute(0.2, tokens=12, now=10.0)
+            assert a.buckets["prefill"] == pytest.approx(0.2)
+            spans = [s for s in tracing.exported_spans()
+                     if s["name"] == "serve.preempt_recompute"]
+            assert len(spans) == 1
+            assert spans[0]["attributes"]["tokens"] == 12
+            assert spans[0]["start"] == pytest.approx(9.8)
+        finally:
+            tracing.disable_tracing()
+            tracing.clear_spans()
+
+
+# ------------------------------------------------------- SLO objectives
+class TestSLOObjective:
+    def test_unknown_objective_rejected(self):
+        with pytest.raises(ValueError, match="unknown SLO objective"):
+            SLOObjective(name="p50_vibes")
+
+    def test_target_must_leave_error_budget(self):
+        with pytest.raises(ValueError, match="target"):
+            SLOObjective(name="ttft_p99_ms", target=1.0)
+        with pytest.raises(ValueError, match="target"):
+            SLOObjective(name="ttft_p99_ms", target=0.0)
+
+    def test_window_ordering_enforced(self):
+        with pytest.raises(ValueError, match="slow_window_s"):
+            SLOObjective(name="availability", fast_window_s=60.0,
+                         slow_window_s=30.0)
+
+    def test_registry_names_construct(self):
+        for name in slo_mod.SLO_OBJECTIVES:
+            SLOObjective(name=name)
+
+
+# -------------------------------------------------------- SLOWatchdog
+def _feed_ttft(dep: str, ts: float, value: float, n: int = 1):
+    agg = get_aggregator()
+    for i in range(n):
+        agg.observe("ray_tpu_llm_ttft_seconds", value,
+                    {"deployment": dep, "pool": "mono"}, kind="value",
+                    ts=ts + i * 0.01)
+
+
+class TestSLOWatchdog:
+    def test_burn_fires_both_windows_then_clears_with_span(self):
+        dep = "slo-dep-burn"
+        wd = SLOWatchdog()
+        wd.set_objectives(dep, [SLOObjective(
+            name="ttft_p99_ms", target=0.9, threshold_ms=100.0,
+            fast_window_s=30.0, slow_window_s=300.0, burn_threshold=2.0)])
+        base = time.time()
+        tracing.clear_spans()
+        tracing.enable_tracing()
+        try:
+            # Healthy traffic: well under the 100ms threshold.
+            _feed_ttft(dep, base - 200.0, 0.02, n=10)
+            out = wd.evaluate(now=base - 190.0)
+            row = out[dep]["objectives"]["ttft_p99_ms"]
+            assert not row["alerting"] and not out[dep]["alerting"]
+            assert row["burn_fast"] == 0.0
+
+            # Preemption storm: every request blows the threshold.  Both
+            # windows burn (fast: all bad; slow: 40 bad / 50 total = 0.8
+            # bad fraction = burn 8 >= 2) -> fires within one fast window.
+            _feed_ttft(dep, base - 100.0, 0.50, n=40)
+            out = wd.evaluate(now=base - 95.0)
+            row = out[dep]["objectives"]["ttft_p99_ms"]
+            assert row["alerting"] and out[dep]["alerting"]
+            assert row["burn_fast"] >= 2.0 and row["burn_slow"] >= 2.0
+            assert row["since"] == pytest.approx(base - 95.0)
+            assert wd.alerting(dep)
+
+            # Recovery: fast window sees only healthy points -> clears
+            # even though the slow window still remembers the storm.
+            _feed_ttft(dep, base - 20.0, 0.02, n=10)
+            out = wd.evaluate(now=base - 10.0)
+            row = out[dep]["objectives"]["ttft_p99_ms"]
+            assert not row["alerting"] and row["since"] is None
+            assert row["burn_slow"] >= 2.0  # the asymmetry under test
+            assert not wd.alerting(dep)
+
+            # The whole episode exported as ONE retroactive span.
+            burns = [s for s in tracing.exported_spans()
+                     if s["name"] == "serve.slo_burn"]
+            assert len(burns) == 1
+            assert burns[0]["status"] == "ERROR: SLOBurn"
+            assert burns[0]["attributes"]["deployment"] == dep
+            assert burns[0]["attributes"]["objective"] == "ttft_p99_ms"
+            assert burns[0]["start"] == pytest.approx(base - 95.0)
+            assert burns[0]["end"] == pytest.approx(base - 10.0)
+        finally:
+            tracing.disable_tracing()
+            tracing.clear_spans()
+
+    def test_slow_window_vetoes_single_blip(self):
+        dep = "slo-dep-blip"
+        wd = SLOWatchdog()
+        wd.set_objectives(dep, [SLOObjective(
+            name="ttft_p99_ms", target=0.9, threshold_ms=100.0,
+            fast_window_s=30.0, slow_window_s=300.0, burn_threshold=2.0)])
+        base = time.time()
+        # Long healthy history, then one bad burst: the fast window burns
+        # but the slow window's bad fraction stays under 2x budget.
+        _feed_ttft(dep, base - 280.0, 0.02, n=95)
+        _feed_ttft(dep, base - 10.0, 0.50, n=5)
+        out = wd.evaluate(now=base - 5.0)
+        row = out[dep]["objectives"]["ttft_p99_ms"]
+        assert row["burn_fast"] >= 2.0
+        assert row["burn_slow"] < 2.0
+        assert not row["alerting"]
+
+    def test_no_traffic_is_budget_neutral(self):
+        dep = "slo-dep-quiet"
+        wd = SLOWatchdog()
+        wd.set_objectives(dep, [SLOObjective(name="ttft_p99_ms"),
+                                SLOObjective(name="availability")])
+        out = wd.evaluate(now=time.time())
+        for row in out[dep]["objectives"].values():
+            assert not row["alerting"]
+            assert row["events_fast"] == 0 and row["burn_fast"] == 0.0
+
+    def test_availability_reads_red_counters(self):
+        dep = "slo-dep-avail"
+        agg = get_aggregator()
+        base = time.time()
+        # Cumulative counters: 100 requests, 30 errors over the window.
+        for i, (total, errs) in enumerate(((0.0, 0.0), (100.0, 30.0))):
+            agg.observe("serve_requests_total", total,
+                        {"deployment": dep}, kind="counter",
+                        ts=base - 20.0 + 10.0 * i)
+            agg.observe("serve_request_errors_total", errs,
+                        {"deployment": dep}, kind="counter",
+                        ts=base - 20.0 + 10.0 * i)
+        wd = SLOWatchdog()
+        wd.set_objectives(dep, [SLOObjective(
+            name="availability", target=0.9, fast_window_s=30.0,
+            slow_window_s=30.0, burn_threshold=2.0)])
+        out = wd.evaluate(now=base - 10.0 + 30.0 - 29.0)  # window covers both
+        row = out[dep]["objectives"]["availability"]
+        assert row["bad_fraction_fast"] == pytest.approx(0.3, abs=0.01)
+        assert row["alerting"]  # burn = 0.3 / 0.1 = 3 >= 2 on both windows
+
+    def test_clear_objectives_drops_state(self):
+        wd = SLOWatchdog()
+        wd.set_objectives("a", [SLOObjective(name="availability")])
+        wd.set_objectives("b", [SLOObjective(name="availability")])
+        assert wd.deployments() == ["a", "b"]
+        wd.clear_objectives("a")
+        assert wd.deployments() == ["b"]
+        wd.clear_objectives()
+        assert not wd.has_objectives()
+
+
+# ------------------------------------------------- serve.status + route
+def test_status_and_slo_route_carry_evaluation():
+    """serve.status() gains an "slo" entry for deployments with
+    objectives, and the metrics agent serves the full watchdog payload at
+    /api/serve/slo (objective registry + per-deployment evaluation)."""
+    slo_mod._reset_watchdog()
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    serve.start(http_options={"port": 0})
+    try:
+        @serve.deployment
+        class Probe:
+            async def __call__(self, x):
+                return x
+
+        handle = serve.run(Probe.bind(), name="sloapp", route_prefix=None)
+        assert handle.remote(7).result(timeout_s=30) == 7
+
+        watchdog = slo_mod.get_watchdog()
+        watchdog.set_objectives("sloapp#Probe", [
+            SLOObjective(name="availability"),
+            SLOObjective(name="ttft_p99_ms", threshold_ms=500.0)])
+
+        st = serve.status()["sloapp#Probe"]
+        assert "slo" in st
+        assert set(st["slo"]["objectives"]) == {"availability",
+                                                "ttft_p99_ms"}
+        assert st["slo"]["alerting"] is False
+
+        from ray_tpu._private.metrics_agent import MetricsAgent
+        from ray_tpu._private.runtime import get_runtime
+
+        agent = MetricsAgent(get_runtime())
+        try:
+            payload = json.load(urllib.request.urlopen(
+                f"http://127.0.0.1:{agent.port}/api/serve/slo", timeout=10))
+            assert payload["objectives_registry"] == sorted(
+                slo_mod.SLO_OBJECTIVES)
+            dep = payload["deployments"]["sloapp#Probe"]
+            assert "availability" in dep["objectives"]
+            assert dep["alerting"] is False
+        finally:
+            agent.stop()
+    finally:
+        serve.shutdown()
+        ray_tpu.shutdown()
+        slo_mod._reset_watchdog()
+
+
+# ------------------------------------------------- timeline lane fusion
+def test_slo_and_recompute_spans_share_serve_lane():
+    """Perfetto fusion: SLO burn episodes and preemption recomputes fold
+    into the single "serve" pid (next to the "train" lane), so a
+    preemption-storm -> burn -> recovery sequence reads as one story."""
+    from ray_tpu._private.profiling import spans_to_chrome_events
+
+    spans = [
+        {"name": "serve.slo_burn", "trace_id": "a" * 32, "span_id": "1",
+         "parent_id": None, "start": 1.0, "end": 2.0,
+         "attributes": {}, "status": "ERROR: SLOBurn"},
+        {"name": "serve.preempt_recompute", "trace_id": "b" * 32,
+         "span_id": "2", "parent_id": None, "start": 1.2, "end": 1.4,
+         "attributes": {}, "status": "OK"},
+        {"name": "serve.ttft_prefill", "trace_id": "c" * 32, "span_id": "3",
+         "parent_id": None, "start": 1.0, "end": 1.1,
+         "attributes": {}, "status": "OK"},
+        {"name": "train.step", "trace_id": "d" * 32, "span_id": "4",
+         "parent_id": None, "start": 1.0, "end": 1.5,
+         "attributes": {}, "status": "OK"},
+    ]
+    events = {e["name"]: e for e in spans_to_chrome_events(spans)}
+    assert events["serve.slo_burn"]["pid"] == "serve"
+    assert events["serve.preempt_recompute"]["pid"] == "serve"
+    assert events["serve.slo_burn"]["cname"] == "terrible"  # ERROR status
+    # Per-request attribution spans stay in their own request trace lane.
+    assert events["serve.ttft_prefill"]["pid"].startswith("trace:")
+    assert events["train.step"]["pid"] == "train"

@@ -255,6 +255,61 @@ class TestTimeSeriesAggregator:
         with pytest.raises(ValueError):
             agg.observe("x", 1.0, kind="bogus")
 
+    # -- ISSUE 12 regression: a subset-tag query used to hit only the
+    # exact (name, tags) key, so per-(deployment, pool) LLM gauges queried
+    # by pool alone returned 0.0 (last-writer-wins on the miss path).
+    def test_subset_tag_query_rolls_up_gauges(self):
+        agg = TimeSeriesAggregator()
+        for i in range(5):
+            ts = 100.0 + i
+            agg.observe("kv_in_use", 10.0, {"pool": "prefill", "node": "a"},
+                        kind="gauge", ts=ts)
+            agg.observe("kv_in_use", 30.0, {"pool": "decode", "node": "a"},
+                        kind="gauge", ts=ts)
+        # Exact-series query is untouched by the rollup path.
+        assert agg.window_rate(
+            "kv_in_use", {"pool": "prefill", "node": "a"},
+            window_s=10.0, now=104.0) == pytest.approx(10.0)
+        # Subset query averages gauge levels across matching tag-sets.
+        assert agg.window_rate("kv_in_use", {"pool": "decode"},
+                               window_s=10.0, now=104.0) == pytest.approx(30.0)
+        assert agg.window_rate("kv_in_use", window_s=10.0,
+                               now=104.0) == pytest.approx(20.0)
+        # Mismatched tag value still matches nothing.
+        assert agg.window_rate("kv_in_use", {"pool": "frontend"},
+                               window_s=10.0, now=104.0) == 0.0
+
+    def test_subset_tag_query_sums_counter_rates(self):
+        agg = TimeSeriesAggregator()
+        for i in range(4):
+            ts = 100.0 + 10.0 * i
+            agg.observe("tok_total", 30.0 * i, {"pool": "p1"},
+                        kind="counter", ts=ts)
+            agg.observe("tok_total", 60.0 * i, {"pool": "p2"},
+                        kind="counter", ts=ts)
+        # p1: 90 tokens / 30 s, p2: 180 / 30 s -> pooled 9/s.
+        assert agg.window_rate("tok_total", window_s=30.0,
+                               now=130.0) == pytest.approx(9.0)
+        assert agg.window_sum("tok_total", window_s=30.0,
+                              now=130.0) == pytest.approx(270.0)
+
+    def test_window_values_and_percentile_pool_across_tag_sets(self):
+        agg = TimeSeriesAggregator()
+        agg.observe("ttft", 0.1, {"deployment": "d", "pool": "p1"},
+                    kind="value", ts=100.0)
+        agg.observe("ttft", 0.3, {"deployment": "d", "pool": "p2"},
+                    kind="value", ts=101.0)
+        agg.observe("ttft", 0.9, {"deployment": "other", "pool": "p1"},
+                    kind="value", ts=102.0)
+        vals = agg.window_values("ttft", {"deployment": "d"},
+                                 window_s=60.0, now=102.0)
+        assert sorted(vals) == [0.1, 0.3]
+        assert agg.window_percentile("ttft", 99, tags={"deployment": "d"},
+                                     window_s=60.0, now=102.0) == 0.3
+        # latest() stays exact-match only: no single meaningful value
+        # exists across tag-sets.
+        assert agg.latest("ttft", {"deployment": "d"}) is None
+
     def test_retention_prunes_but_keeps_baseline(self):
         agg = TimeSeriesAggregator(max_window_s=50.0)
         for i in range(20):
