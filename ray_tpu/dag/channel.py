@@ -37,9 +37,19 @@ class ChannelTimeout(Exception):
 
 
 class Channel:
-    """Bounded SPSC/MPMC in-process channel (ref: intra_process_channel.py)."""
+    """Bounded SPSC/MPMC in-process channel (ref: intra_process_channel.py).
 
-    def __init__(self, maxsize: int = 16, name: str = ""):
+    ``slot_width`` > 0 additionally gives the channel a ring of reusable
+    pre-sized record buffers (plain fixed-width lists): producers
+    ``acquire_slot()``, fill the fields in place, and ``write()`` the slot;
+    consumers hand it back with ``release_slot()`` once the payload is dead.
+    In steady state the ring converges to the channel's high-water mark of
+    in-flight slots and per-send allocation drops to zero —
+    ``slot_allocations`` exposes the grow count so tests can assert the
+    no-alloc property (the role the reference's reusable serialized-buffer
+    pool plays for its shm channels)."""
+
+    def __init__(self, maxsize: int = 16, name: str = "", slot_width: int = 0):
         self.name = name
         self._maxsize = max(1, maxsize)
         self._buf: deque = deque()
@@ -47,6 +57,9 @@ class Channel:
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
         self._closed = False
+        self._slot_width = int(slot_width)
+        self._free_slots: deque = deque()
+        self._slot_allocations = 0
 
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
         with self._not_full:
@@ -68,6 +81,49 @@ class Channel:
             value = self._buf.popleft()
             self._not_full.notify()
             return value
+
+    def read_ready(self, max_n: int, out: Optional[list] = None) -> list:
+        """Drain up to ``max_n`` buffered elements without blocking (never
+        raises on a closed channel — buffered elements stay readable after
+        close, matching read()).  Appends into ``out`` when given so a
+        steady-state consumer can reuse one scratch list."""
+        batch = [] if out is None else out
+        with self._not_empty:
+            n = min(int(max_n), len(self._buf))
+            for _ in range(n):
+                batch.append(self._buf.popleft())
+            if n:
+                self._not_full.notify()
+        return batch
+
+    # ------------------------------------------------------------- slot ring
+    def acquire_slot(self) -> list:
+        """A pre-sized record buffer from the reuse ring (grows on demand;
+        steady state recycles without allocating)."""
+        with self._lock:
+            if self._free_slots:
+                return self._free_slots.popleft()
+            self._slot_allocations += 1
+        return [None] * self._slot_width
+
+    def release_slot(self, slot: list) -> None:
+        """Return a slot to the ring.  Fields are cleared first so pooled
+        slots never pin payloads/futures across requests."""
+        for i in range(len(slot)):
+            slot[i] = None
+        with self._lock:
+            self._free_slots.append(slot)
+
+    @property
+    def slot_allocations(self) -> int:
+        """How many slots were ever allocated (ring growth counter)."""
+        return self._slot_allocations
+
+    @property
+    def closed(self) -> bool:
+        """Dirty read for poll-style consumers; buffered elements remain
+        readable (via read()/read_ready()) even when True."""
+        return self._closed
 
     def close(self) -> None:
         with self._lock:

@@ -272,6 +272,7 @@ METRIC_MODULES = (
     "ray_tpu._private.metrics_agent",
     "ray_tpu.serve.metrics",
     "ray_tpu.serve.router",
+    "ray_tpu.serve.compiled_router",
     "ray_tpu.serve.batching",
     "ray_tpu.serve.continuous",
     "ray_tpu.serve.multiplex",

@@ -41,7 +41,8 @@ class Deployment:
                 health_check_timeout_s: Optional[float] = None,
                 graceful_shutdown_wait_loop_s: Optional[float] = None,
                 graceful_shutdown_timeout_s: Optional[float] = None,
-                max_unavailable: Optional[int] = None) -> "Deployment":
+                max_unavailable: Optional[int] = None,
+                compiled_route: Optional[bool] = None) -> "Deployment":
         import copy
 
         cfg = copy.deepcopy(self.config)
@@ -69,6 +70,8 @@ class Deployment:
             cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
         if max_unavailable is not None:
             cfg.max_unavailable = max_unavailable
+        if compiled_route is not None:
+            cfg.compiled_route = compiled_route
         return Deployment(self.func_or_class, name or self.name, cfg)
 
     def bind(self, *args, **kwargs) -> "Application":
@@ -102,7 +105,8 @@ def deployment(_func_or_class: Optional[Any] = None, *,
                health_check_timeout_s: float = 30.0,
                graceful_shutdown_wait_loop_s: float = 2.0,
                graceful_shutdown_timeout_s: float = 5.0,
-               max_unavailable: int = 0) -> Any:
+               max_unavailable: int = 0,
+               compiled_route: Optional[bool] = None) -> Any:
     """@serve.deployment (ref: serve/api.py:deployment)."""
 
     def decorate(obj):
@@ -121,6 +125,7 @@ def deployment(_func_or_class: Optional[Any] = None, *,
             graceful_shutdown_wait_loop_s=graceful_shutdown_wait_loop_s,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
             max_unavailable=max_unavailable,
+            compiled_route=compiled_route,
             ray_actor_options=dict(ray_actor_options or {}))
         return Deployment(obj, name or obj.__name__, cfg)
 

@@ -219,6 +219,23 @@ def _split_call_args(args: tuple, kwargs: dict,
     return None, args[0]
 
 
+def batch_fusion(fn: Any) -> Optional[Tuple[Callable, Dict[str, Any]]]:
+    """``(inner_func, batch_config)`` when ``fn`` is a ``@serve.batch``
+    wrapper, else None.  The compiled serve route (compiled_router.py) uses
+    this to FUSE the micro-batch queue into its channel loop: having already
+    coalesced a channel drain into one batch, it calls the undecorated inner
+    function directly with the item list — same vectorized call, same
+    per-request error-isolation contract, but no per-request asyncio future
+    or queue hop.  ``functools.wraps`` pins ``__wrapped__`` to the original
+    function, so the pair is always consistent with the wrapper's runtime
+    setters (the config dict is shared, not copied)."""
+    cfg = getattr(fn, "_batch_config", None)
+    inner = getattr(fn, "__wrapped__", None)
+    if cfg is None or inner is None:
+        return None
+    return inner, cfg
+
+
 def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
           batch_wait_timeout_s: float = 0.01, adaptive: bool = True):
     """``@serve.batch`` — coalesce concurrent calls into vectorized ones.

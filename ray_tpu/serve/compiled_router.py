@@ -1,0 +1,820 @@
+"""Compiled steady-state serve route — dispatch lowered onto typed channels.
+
+The dynamic router submits one actor TaskSpec per request; BENCH_DAG shows
+the compiled-channel path runs ~12x the interpreted actor-call path, so once
+a deployment's replica set is STABLE the router lowers its dispatch into a
+compiled graph (ref: the reference's experimental_compile layer — compiled
+DAGs over python/ray/experimental/channel/, the substrate vLLM-style serving
+rides):
+
+- per RUNNING thread-tier replica, a pre-resolved pair of in-process typed
+  channels (``dag/channel.py``) with a ring of reusable pre-sized request
+  slots — no TaskSpec, no ObjectRef, no per-send allocation;
+- a resident per-replica loop thread that drains the request channel,
+  FUSES the ``@serve.batch`` micro-batch queue into the drain (the channel
+  backlog IS the batch; the undecorated inner function is invoked directly
+  via ``batching.batch_fusion``), executes, and writes one batched response
+  message;
+- a per-replica demux thread that fans results back to the callers'
+  futures, keeps the router's queue accounting exact, and exports the
+  router/replica spans with ONE ``record_span_batch`` call per iteration —
+  admission -> batch -> execute -> demux is pure channel traffic.
+
+Degradation is reconciler-driven and safe by construction: any replica
+membership change observed through PR 3's long-poll push tears the graph
+down within that callback (requests still buffered in the channels are
+re-dispatched through the dynamic path — zero caller-visible errors), and
+the route recompiles once the set has been stable for
+``RAY_TPU_SERVE_COMPILED_STABLE_S``.  A replica death is also detected
+locally (the loop polls its actor state between reads), so fallback does
+not wait for the controller's health probe.  ``RAY_TPU_SERVE_COMPILED=0``
+disables compilation process-wide; ``@serve.deployment(compiled_route=
+False)`` disables it per deployment.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.dag.channel import Channel, ChannelClosed, ChannelTimeout
+from ray_tpu.util import metrics as _metrics
+from ray_tpu.util import tracing as _tracing
+
+COMPILED_MODE_GAUGE = _metrics.Gauge(
+    "ray_tpu_serve_compiled_mode",
+    "1 while this router serves the deployment over the compiled channel "
+    "path, 0 while it is on the dynamic fallback",
+    tag_keys=("deployment",))
+RECOMPILES_TOTAL = _metrics.Counter(
+    "ray_tpu_serve_compiled_recompiles_total",
+    "Compiled-route graph builds by this router (the first compile after "
+    "deploy counts as one)",
+    tag_keys=("deployment",))
+FALLBACK_SECONDS = _metrics.Counter(
+    "ray_tpu_serve_compiled_fallback_seconds_total",
+    "Cumulative seconds this router spent on the dynamic path while "
+    "compilation was desired (startup and teardown->recompile windows)",
+    tag_keys=("deployment",))
+
+#: Request-slot layout (one reusable pre-sized list per in-flight request,
+#: pooled by the request channel's slot ring — see Channel.acquire_slot).
+S_METHOD, S_ARGS, S_KWARGS, S_MUX, S_CTX, S_T0, S_RESP, S_OK, S_VALUE = range(9)
+SLOT_WIDTH = 9
+
+#: How long the loop blocks per read — doubles as the replica-death poll
+#: interval, bounding local fallback detection.
+_LOOP_TICK_S = 0.05
+
+#: Shared sentinel context for requests submitted with tracing enabled but
+#: no enclosing span: record_span_batch skips None parents, while an empty
+#: dict yields a fresh root trace (parent.get() finds nothing).  One shared
+#: instance — never mutated — so the hot path allocates nothing.
+_ROOTLESS_CTX: dict = {}
+
+
+def _env_on() -> bool:
+    return os.environ.get("RAY_TPU_SERVE_COMPILED", "1").lower() not in (
+        "0", "false", "no", "off")
+
+
+def _stable_window_s() -> float:
+    try:
+        return float(os.environ.get("RAY_TPU_SERVE_COMPILED_STABLE_S", "0.5"))
+    except ValueError:
+        return 0.5
+
+
+class _NotCompilable(Exception):
+    """This replica set cannot be lowered (process/node tier, no live
+    in-process instance, ...) — stay on the dynamic path."""
+
+
+class CompiledResponse:
+    """Future-like result of a compiled-route dispatch.
+
+    Duck-types DeploymentResponse's consumer surface (``result(timeout_s)``,
+    awaitable) without an ObjectRef: the value crosses one in-process
+    channel, so the future is a raw-lock latch plus waiter callbacks —
+    one lock allocation per request instead of an Event's lock+condition
+    pair, and a lock-free resolve/result fast path (this object is built
+    once per request on the hot path, so its weight shows up directly in
+    dispatch cost).  Error surface matches the dynamic path — user
+    exceptions arrive wrapped in TaskError, and a downstream
+    BackPressureError cause is unwrapped exactly like
+    DeploymentResponse.result does."""
+
+    __slots__ = ("_latch", "_done", "_value", "_exc", "_waiters")
+
+    def __init__(self):
+        latch = threading.Lock()
+        latch.acquire()
+        self._latch = latch
+        self._done = False
+        self._value: Any = None
+        self._exc: Optional[BaseException] = None
+        self._waiters: Optional[list] = None
+
+    def _resolve(self, value: Any, exc: Optional[BaseException]) -> None:
+        # First resolution wins (teardown races re-dispatch): a given
+        # request is only ever owned by ONE resolver — the lane demux OR
+        # the teardown re-dispatcher, never both — so the flag check plus
+        # the latch's own release-once semantics are sufficient.
+        if self._done:
+            return
+        self._value = value
+        self._exc = exc
+        self._done = True
+        try:
+            self._latch.release()
+        except RuntimeError:
+            return  # lost a (theoretically impossible) resolve race
+        w = self._waiters
+        if w:
+            while w:
+                try:
+                    wake = w.pop()
+                except IndexError:
+                    break
+                try:
+                    wake()
+                except Exception:
+                    pass
+
+    def _add_waiter(self, wake) -> bool:
+        if self._done:
+            return False
+        w = self._waiters
+        if w is None:
+            w = self._waiters = []
+        w.append(wake)
+        if self._done:
+            # _resolve may have drained between the append and here; pull
+            # the callback back out — ValueError means it was already
+            # drained (and called), which is equally fine: the caller
+            # treats False as "already resolved" and callbacks are
+            # idempotent.
+            try:
+                w.remove(wake)
+            except ValueError:
+                pass
+            return False
+        return True
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        if not self._done:
+            if not self._latch.acquire(
+                    True, -1 if timeout_s is None else max(0.0, timeout_s)):
+                from ray_tpu.exceptions import GetTimeoutError
+
+                raise GetTimeoutError(
+                    f"compiled serve response not ready within {timeout_s}s")
+            # Cascade the latch so every other thread blocked in result()
+            # wakes too (a raw lock wakes a single acquirer, unlike Event).
+            self._latch.release()
+        exc = self._exc
+        if exc is None:
+            return self._value
+        from ray_tpu.exceptions import TaskError
+        from ray_tpu.serve.exceptions import BackPressureError
+
+        if isinstance(exc, TaskError) and isinstance(
+                getattr(exc, "cause", None), BackPressureError):
+            raise exc.cause from None
+        raise exc
+
+    async def _await_impl(self) -> Any:
+        if not self._done:
+            loop = asyncio.get_running_loop()
+            f = loop.create_future()
+
+            def _complete():
+                if not f.done():
+                    f.set_result(None)
+
+            if self._add_waiter(lambda: loop.call_soon_threadsafe(_complete)):
+                await f
+        return self.result(timeout_s=0)
+
+    def __await__(self):
+        return self._await_impl().__await__()
+
+
+def _redispatch_one(router, rt, method: str, args: tuple, kwargs: dict,
+                    mux: Optional[str], resp: CompiledResponse,
+                    attempt: int) -> None:
+    """Re-assign one torn-down request through the dynamic path, with the
+    same death-retry budget DeploymentResponse gives its callers."""
+    from ray_tpu.exceptions import ActorDiedError
+
+    send_kwargs = kwargs
+    if mux:
+        send_kwargs = dict(kwargs)
+        send_kwargs["_serve_multiplexed_model_id"] = mux
+    try:
+        ref = router.assign_request(method, *args, **send_kwargs)
+    except BaseException as e:  # noqa: BLE001 — surface to the waiting caller
+        resp._resolve(None, e)
+        return
+    fut = rt.as_future(ref)
+
+    def _done(f):
+        exc = f.exception()
+        if isinstance(exc, ActorDiedError) and attempt < 2:
+            timer = threading.Timer(
+                0.2 * (attempt + 1), _redispatch_one,
+                args=(router, rt, method, args, kwargs, mux, resp,
+                      attempt + 1))
+            timer.daemon = True
+            timer.start()
+            return
+        if exc is not None:
+            resp._resolve(None, exc)
+        else:
+            resp._resolve(f.result(), None)
+
+    fut.add_done_callback(_done)
+
+
+def _redispatch_pending(router, pending: List[tuple]) -> None:
+    from ray_tpu._private import runtime as _rt
+
+    rt = _rt.get_runtime()
+    for method, args, kwargs, mux, resp in pending:
+        _redispatch_one(router, rt, method, args, kwargs or {}, mux, resp, 0)
+
+
+class _Lane:
+    """One replica's compiled lane: request/response channel pair plus the
+    resident loop and demux threads.  The loop runs in the driver process
+    directly against the thread-tier replica instance — NOT through the
+    actor mailbox, so control-plane calls (check_health,
+    prepare_for_shutdown) never starve behind the data plane."""
+
+    def __init__(self, graph: "_CompiledGraph", row: Dict[str, Any],
+                 actor_state, instance) -> None:
+        self.graph = graph
+        self.rid: str = row["replica_id"]
+        self.max_ongoing = max(1, int(row.get("max_ongoing_requests") or 1))
+        self.state = actor_state
+        self.replica = instance
+        self.wrapper = instance._wrapper
+        maxsize = max(64, 2 * self.max_ongoing)
+        self.req = Channel(maxsize=maxsize, name=f"serve-req:{self.rid}",
+                           slot_width=SLOT_WIDTH)
+        self.resp = Channel(maxsize=64, name=f"serve-resp:{self.rid}")
+        self._fusion: Dict[str, Any] = {}
+        self._expect: Dict[str, int] = {}  # last executed batch size
+        self._exec_tags: Dict[str, dict] = {}
+        self._route_attrs = {"deployment": graph.deployment_id,
+                             "replica": self.rid}
+        self._task_reprs: Dict[str, str] = {}
+        self._loop_thread = threading.Thread(
+            target=self._run_loop, daemon=True,
+            name=f"serve-compiled-loop-{self.rid}")
+        self._demux_thread = threading.Thread(
+            target=self._run_demux, daemon=True,
+            name=f"serve-compiled-demux-{self.rid}")
+
+    def start(self) -> None:
+        self._loop_thread.start()
+        self._demux_thread.start()
+
+    # ------------------------------------------------------------ resolution
+    def _fusion_for(self, method: str):
+        """(inner, cfg, is_coro) when the routed method is
+        @serve.batch-wrapped (is_coro pre-resolved: iscoroutinefunction is
+        too slow for the per-batch hot path)."""
+        hit = self._fusion.get(method, _Lane)
+        if hit is not _Lane:
+            return hit
+        from ray_tpu.serve.batching import batch_fusion
+
+        if self.wrapper._is_class:
+            fn = getattr(type(self.wrapper._callable), method, None)
+        elif method == "__call__":
+            fn = self.wrapper._callable
+        else:
+            fn = None
+        fusion = batch_fusion(fn) if fn is not None else None
+        if fusion is not None:
+            inner, cfg = fusion
+            fusion = (inner, cfg, inspect.iscoroutinefunction(inner))
+        self._fusion[method] = fusion
+        return fusion
+
+    def _exec_tags_for(self, method: str) -> dict:
+        tags = self._exec_tags.get(method)
+        if tags is None:
+            tags = self._exec_tags[method] = {
+                "deployment": self.replica.deployment_name, "method": method}
+        return tags
+
+    def _task_repr(self, method: str) -> str:
+        r = self._task_reprs.get(method)
+        if r is None:
+            r = self._task_reprs[method] = (
+                f"{type(self.replica).__name__}.handle_request")
+        return r
+
+    # ------------------------------------------------------------- loop side
+    def _run_loop(self) -> None:
+        loop = asyncio.new_event_loop()
+        # This thread IS the lane's event loop owner: user code that calls
+        # get_event_loop() between awaits must see it.
+        asyncio.set_event_loop(loop)
+        scratch: list = []
+        try:
+            while True:
+                if self.state.state != "ALIVE":
+                    break  # replica died: local fallback, no probe wait
+                try:
+                    first = self.req.read(timeout=_LOOP_TICK_S)
+                except ChannelTimeout:
+                    continue
+                except ChannelClosed:
+                    break
+                scratch.clear()
+                scratch.append(first)
+                self._fill_batch(scratch)
+                try:
+                    self._execute_batch(scratch, loop)
+                except ChannelClosed:
+                    break
+        finally:
+            # Close both ends: writers fall back to the dynamic path, the
+            # demux drains every buffered response (reads stay valid on a
+            # closed channel until empty) and then notifies the manager.
+            self.req.close()
+            self.resp.close()
+            loop.close()
+
+    def _fill_batch(self, batch: list) -> None:
+        """Grow the drained batch.  For a batch-fused lead method this IS
+        the micro-batch queue — but smarter than the dynamic _BatchQueue:
+        that queue waits blind (it cannot know whether more requests are
+        coming, so it trades latency via an adaptive timeout), while the
+        compiled loop shares the process with its router and can read the
+        scheduler's live inflight count for this replica.  It waits only
+        while more requests are already in flight toward this lane, bounded
+        by the method's batch_wait_timeout_s — full batches under load,
+        immediate dispatch when the queue is the whole load.  Non-fused
+        lead methods take whatever is already queued, bounded by the
+        replica's concurrency budget."""
+        method = batch[0][S_METHOD]
+        fusion = self._fusion_for(method)
+        if fusion is None:
+            self.req.read_ready(self.max_ongoing - 1, out=batch)
+            return
+        cfg = fusion[1]
+        max_size = int(cfg["max_batch_size"])
+        if len(batch) >= max_size:
+            return
+        deadline = time.monotonic() + float(cfg["batch_wait_timeout_s"])
+        inflight = self.graph.router._scheduler._inflight
+        expect = self._expect.get(method, 0)
+        while True:
+            # Dirty read (dict.get under the GIL): transiently stale is
+            # fine — too-high waits at most batch_wait_timeout_s (the
+            # dynamic path's bound), too-low dispatches a smaller batch.
+            # max() with the last executed batch size bridges the window
+            # where the demux has marked the previous batch done but the
+            # closed-loop callers have not resubmitted yet.
+            target = min(max_size, max(expect, inflight.get(self.rid, 0)))
+            n0 = len(batch)
+            self.req.read_ready(max_size - n0, out=batch)
+            if len(batch) >= max_size:
+                break
+            if len(batch) >= target and len(batch) == n0:
+                break  # nothing queued, nothing expected
+            if self.req.closed:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            if target - len(batch) <= 2:
+                # Down to the last stragglers: a condition-wait wakes
+                # exactly on arrival, avoiding a trailing sleep quantum.
+                try:
+                    batch.append(self.req.read(timeout=remaining))
+                except (ChannelTimeout, ChannelClosed):
+                    break
+                continue
+            # Far from target: plain GIL yield instead of a condition-wait
+            # per item — the stragglers are being written right now by
+            # caller threads, and one short sleep costs less than dozens
+            # of per-item condvar wakeups racing those writers for the
+            # channel lock.
+            time.sleep(0.0001)
+        self._expect[method] = len(batch)
+
+    def _execute_batch(self, batch: list, loop) -> None:
+        if len(batch) == 1:
+            slot = batch[0]
+            self._execute_group(slot[S_METHOD], slot[S_MUX], batch, loop)
+        else:
+            groups: Dict[tuple, list] = {}
+            for slot in batch:
+                groups.setdefault((slot[S_METHOD], slot[S_MUX]),
+                                  []).append(slot)
+            for (method, mux), slots in groups.items():
+                self._execute_group(method, mux, slots, loop)
+        self.resp.write(list(batch))
+
+    def _execute_group(self, method: str, mux: Optional[str], slots: list,
+                       loop) -> None:
+        from ray_tpu._private import fault_injection
+        from ray_tpu.exceptions import TaskError
+        from ray_tpu.serve import context as serve_context
+        from ray_tpu.serve import metrics as serve_metrics
+        from ray_tpu.serve.replica import _invoke_sync_unary, _is_async_callable
+
+        task_repr = self._task_repr(method)
+        if fault_injection.get_injector().enabled:
+            live = []
+            for slot in slots:
+                # Same per-request fault point the dynamic replica entry
+                # checks.
+                try:
+                    fault_injection.check("serve_replica_handle")
+                except Exception as e:  # noqa: BLE001 — injected, per request
+                    slot[S_OK] = False
+                    slot[S_VALUE] = TaskError(e, task_repr=task_repr)
+                    continue
+                live.append(slot)
+            if not live:
+                return
+        else:
+            live = slots
+        replica = self.replica
+        serve_context._set_internal_replica_context(
+            deployment=replica.deployment_name,
+            replica_id=replica.replica_id, replica=replica)
+        if mux:
+            serve_context._set_request_model_id(mux)
+        n = len(live)
+        replica._num_ongoing += n
+        whole_exc: Optional[BaseException] = None
+        results: Any = None
+        t_exec = time.time()
+        try:
+            fusion = self._fusion_for(method)
+            if fusion is not None and all(
+                    len(s[S_ARGS]) == 1 and not s[S_KWARGS] for s in live):
+                inner, _, is_coro = fusion
+                items = [s[S_ARGS][0] for s in live]
+                self_arg = (self.wrapper._callable
+                            if self.wrapper._is_class else None)
+                call_args = (items,) if self_arg is None else (self_arg, items)
+                if is_coro:
+                    results = loop.run_until_complete(inner(*call_args))
+                else:
+                    results = inner(*call_args)
+                if (not isinstance(results, (list, tuple))
+                        or len(results) != n):
+                    got = (f"length {len(results)}"
+                           if isinstance(results, (list, tuple))
+                           else type(results).__name__)
+                    raise TypeError(
+                        f"@serve.batch function "
+                        f"{getattr(inner, '__name__', inner)!r} must return "
+                        f"a list with one result per request (expected "
+                        f"length {n}, got {got})")
+            else:
+                target = self.wrapper._target(method)
+                if _is_async_callable(target):
+                    # Concurrent per-request coroutines on the lane's
+                    # private loop: handlers that delegate into their own
+                    # @serve.batch methods still coalesce (the inner queue
+                    # binds to this loop and sees the whole group at once).
+                    calls = [self.wrapper.call(method, tuple(s[S_ARGS]),
+                                               dict(s[S_KWARGS] or {}))
+                             for s in live]
+
+                    async def _gather():
+                        return await asyncio.gather(*calls,
+                                                    return_exceptions=True)
+
+                    results = loop.run_until_complete(_gather())
+                else:
+                    # Sync handlers run inline — this thread IS the
+                    # replica's dedicated worker, no executor hop.
+                    results = []
+                    for s in live:
+                        try:
+                            results.append(_invoke_sync_unary(
+                                target, tuple(s[S_ARGS]),
+                                dict(s[S_KWARGS] or {})))
+                        except Exception as e:  # noqa: BLE001 — per request
+                            results.append(e)
+        except Exception as e:  # noqa: BLE001 — whole-group failure
+            whole_exc = e
+        exec_end = time.time()
+        replica._num_ongoing -= n
+        replica._num_processed += n
+        tags = self._exec_tags_for(method)
+        first_ctx = next((s[S_CTX] for s in live if s[S_CTX]), None)
+        serve_metrics.EXECUTION.observe(
+            exec_end - t_exec, tags=tags,
+            exemplar=serve_metrics.trace_exemplar(first_ctx))
+        if _tracing.is_tracing_enabled():
+            # One batched export per vectorized call (satellite: tracing
+            # overhead) instead of a span context manager per request.
+            _tracing.record_span_batch(
+                "serve.compiled_batch",
+                [(t_exec, exec_end, s[S_CTX]) for s in live],
+                attributes=dict(tags, replica=self.rid, batch_size=n))
+        if whole_exc is not None:
+            err: Any = whole_exc
+            if not isinstance(err, TaskError):
+                err = TaskError(err, task_repr=task_repr)
+            for s in live:
+                s[S_OK] = False
+                s[S_VALUE] = err
+            return
+        for s, r in zip(live, results):
+            if isinstance(r, Exception):
+                s[S_OK] = False
+                s[S_VALUE] = (r if isinstance(r, TaskError)
+                              else TaskError(r, task_repr=task_repr))
+            else:
+                s[S_OK] = True
+                s[S_VALUE] = r
+
+    # ------------------------------------------------------------ demux side
+    def _run_demux(self) -> None:
+        from ray_tpu.serve import metrics as serve_metrics
+
+        router = self.graph.router
+        scheduler = router._scheduler
+        tags = router._metric_tags
+        while True:
+            try:
+                batch = self.resp.read(timeout=0.5)
+            except ChannelTimeout:
+                continue
+            except ChannelClosed:
+                break
+            now = time.time()
+            # Wake callers first: everything else (latency metrics, span
+            # export, slot recycling) happens while they are already
+            # resubmitting, shortening the closed-loop cycle.
+            errors = 0
+            for slot in batch:
+                if slot[S_OK]:
+                    slot[S_RESP]._resolve(slot[S_VALUE], None)
+                else:
+                    errors += 1
+                    slot[S_RESP]._resolve(None, slot[S_VALUE])
+            # One lock round-trip for the whole batch, not one per slot —
+            # the callers we just woke are hitting the same scheduler lock
+            # to resubmit.
+            scheduler.on_request_done(self.rid, len(batch))
+            spans = [] if _tracing.is_tracing_enabled() else None
+            latencies = []
+            first_ctx = None
+            for slot in batch:
+                t0 = slot[S_T0]
+                ctx = slot[S_CTX]
+                latencies.append(now - t0)
+                if ctx is not None:
+                    if first_ctx is None:
+                        first_ctx = ctx
+                    if spans is not None:
+                        spans.append((t0, now, ctx))
+                self.req.release_slot(slot)
+            serve_metrics.REQUEST_LATENCY.observe_batch(
+                latencies, tags=tags,
+                exemplar=serve_metrics.trace_exemplar(first_ctx))
+            serve_metrics.REQUESTS_TOTAL.inc(len(batch), tags=tags)
+            if errors:
+                serve_metrics.ERRORS_TOTAL.inc(errors, tags=tags)
+            if spans:
+                # Batched route-span export: one emit loop per compiled
+                # iteration instead of a span per request.
+                _tracing.record_span_batch("serve.compiled_route", spans,
+                                           attributes=self._route_attrs)
+        # resp channel closed AND drained: the lane is down (replica death
+        # or teardown) — let the manager fall back / finish the teardown.
+        self.graph._lane_closed(self)
+
+
+class _CompiledGraph:
+    """The compiled route for one (router, replica-set) pair."""
+
+    def __init__(self, router, rows: List[Dict[str, Any]], manager) -> None:
+        from ray_tpu._private import runtime as _rt
+
+        self.router = router
+        self.manager = manager
+        self.deployment_id = router.deployment_id
+        rt = _rt.get_runtime()
+        lanes: Dict[str, _Lane] = {}
+        for row in rows:
+            actor = row.get("actor")
+            if actor is None:
+                raise _NotCompilable(f"replica {row.get('replica_id')} "
+                                     f"carries no actor handle")
+            st = rt.get_actor_state(actor._actor_id)
+            if st is None or st.state != "ALIVE" or st.instance is None:
+                # Process/node-tier replicas (no shared-heap instance) and
+                # corpses cannot be lowered — the route stays dynamic.
+                raise _NotCompilable(
+                    f"replica {row['replica_id']} is not a live thread-tier "
+                    f"actor")
+            if not hasattr(st.instance, "_wrapper"):
+                raise _NotCompilable(
+                    f"replica {row['replica_id']} is not a serve replica")
+            lanes[row["replica_id"]] = _Lane(self, row, st, st.instance)
+        if not lanes:
+            raise _NotCompilable("empty replica set")
+        self._lanes = lanes
+        # Single-replica deployments skip the scheduler pick entirely —
+        # there is exactly one place the request can go.
+        self._single_lane = (next(iter(lanes.values()))
+                             if len(lanes) == 1 else None)
+        self._destroyed = False  # guarded_by: _destroy_lock
+        self._destroy_lock = threading.Lock()
+        for lane in lanes.values():
+            lane.start()
+
+    def submit(self, method: str, args: tuple,
+               kwargs: dict) -> Optional[CompiledResponse]:
+        """Lower one request onto a lane; None means 'use the dynamic path'
+        (teardown race, unknown replica) — never an error."""
+        router = self.router
+        mux = kwargs.get("_serve_multiplexed_model_id")
+        lane = self._single_lane
+        if lane is None:
+            row = router._scheduler.choose_replica(mux or None)
+            if row is None:
+                return None
+            lane = self._lanes.get(row["replica_id"])
+            if lane is None:
+                return None
+        if mux is not None:
+            kwargs = {k: v for k, v in kwargs.items()
+                      if k != "_serve_multiplexed_model_id"}
+        resp = CompiledResponse()
+        slot = lane.req.acquire_slot()
+        slot[S_METHOD] = method
+        slot[S_ARGS] = args
+        slot[S_KWARGS] = kwargs
+        slot[S_MUX] = mux
+        # _ROOTLESS_CTX (not None) when tracing is on but the caller holds
+        # no enclosing span: the demux then still exports a root
+        # serve.compiled_route span for the request, matching the dynamic
+        # path (assign_request opens serve.route unconditionally).
+        slot[S_CTX] = ((_tracing.active_span() or _ROOTLESS_CTX)
+                       if _tracing.is_tracing_enabled() else None)
+        slot[S_T0] = time.time()
+        slot[S_RESP] = resp
+        # Pre-send inflight accounting, mirroring Router._dispatch: the
+        # demux decrements on completion; destroy() undoes it for requests
+        # drained back out of a torn-down channel.
+        router._scheduler.on_request_sent(lane.rid)
+        try:
+            lane.req.write(slot)
+        except ChannelClosed:
+            router._scheduler.on_request_done(lane.rid)
+            lane.req.release_slot(slot)
+            return None
+        return resp
+
+    def _lane_closed(self, lane: _Lane) -> None:
+        self.manager._graph_broken(self, lane.rid)
+
+    def destroy(self) -> None:
+        """Tear the graph down: close the request channels (writers fall
+        back to dynamic dispatch immediately), join the loop threads, then
+        re-dispatch every request still buffered through the dynamic path
+        on a detached thread — callers blocked in result() never see the
+        teardown.  Idempotent; demux threads are NOT joined (they drain the
+        remaining responses and exit on their own)."""
+        with self._destroy_lock:
+            if self._destroyed:
+                return
+            self._destroyed = True
+        for lane in self._lanes.values():
+            lane.req.close()
+        for lane in self._lanes.values():
+            lane._loop_thread.join(timeout=2.0)
+        pending = []
+        for lane in self._lanes.values():
+            for slot in lane.req.read_ready(1 << 30):
+                self.router._scheduler.on_request_done(lane.rid)
+                pending.append((slot[S_METHOD], slot[S_ARGS], slot[S_KWARGS],
+                                slot[S_MUX], slot[S_RESP]))
+        if pending:
+            t = threading.Thread(
+                target=_redispatch_pending, args=(self.router, pending),
+                daemon=True,
+                name=f"serve-compiled-redispatch-{self.deployment_id}")
+            t.start()
+
+
+class CompiledRouteManager:
+    """Per-router compiled-route state machine: dynamic -> (replica set
+    stable for the window) -> compiled -> (any membership change or local
+    death) -> dynamic -> ...  Driven by the router's long-poll callback
+    (teardown) and its metrics tick (recompile check)."""
+
+    def __init__(self, router) -> None:
+        self._router = router
+        self._dep_tags = {"deployment": router.deployment_id}
+        self._lock = threading.RLock()
+        self._graph: Optional[_CompiledGraph] = None
+        self._rows: List[Dict[str, Any]] = []  # guarded_by: _lock
+        self._sig: tuple = ()  # guarded_by: _lock
+        self._uncompilable_sig: Optional[tuple] = None  # guarded_by: _lock
+        self._last_change = time.monotonic()
+        self._fallback_since = time.monotonic()
+        self._config_enabled: Optional[bool] = None
+        self._stopped = False
+        COMPILED_MODE_GAUGE.set(0.0, tags=self._dep_tags)
+
+    @property
+    def graph(self) -> Optional[_CompiledGraph]:
+        return self._graph
+
+    @property
+    def mode(self) -> str:
+        return "compiled" if self._graph is not None else "dynamic"
+
+    def on_replica_set(self, replicas: List[Dict[str, Any]]) -> None:
+        """Long-poll push: any membership change tears the compiled graph
+        down within this callback — fallback inside one reconciler tick."""
+        sig = tuple(sorted(r["replica_id"] for r in replicas))
+        graph = None
+        with self._lock:
+            self._rows = list(replicas)
+            if replicas:
+                self._config_enabled = replicas[0].get("compiled_route")
+            if sig != self._sig:
+                self._sig = sig
+                self._last_change = time.monotonic()
+                self._uncompilable_sig = None
+                graph = self._detach_locked()
+        if graph is not None:
+            graph.destroy()
+
+    def _detach_locked(self) -> Optional[_CompiledGraph]:
+        graph = self._graph
+        if graph is not None:
+            self._graph = None
+            self._fallback_since = time.monotonic()
+            COMPILED_MODE_GAUGE.set(0.0, tags=self._dep_tags)
+        return graph
+
+    def _desired(self) -> bool:
+        if self._config_enabled is False:
+            return False
+        return _env_on()
+
+    def maybe_compile(self) -> None:
+        """Metrics-tick hook: compile when desired, stable, and lowerable."""
+        if self._stopped or self._graph is not None or not self._desired():
+            return
+        with self._lock:
+            if self._graph is not None or self._stopped or not self._rows:
+                return
+            if self._sig and self._sig == self._uncompilable_sig:
+                return
+            if time.monotonic() - self._last_change < _stable_window_s():
+                return
+            try:
+                graph = _CompiledGraph(self._router, self._rows, self)
+            except _NotCompilable:
+                # Sticky until the set changes: retrying an unlowerable set
+                # every tick would spin for nothing.
+                self._uncompilable_sig = self._sig
+                return
+            self._graph = graph
+            RECOMPILES_TOTAL.inc(tags=self._dep_tags)
+            FALLBACK_SECONDS.inc(
+                max(0.0, time.monotonic() - self._fallback_since),
+                tags=self._dep_tags)
+            COMPILED_MODE_GAUGE.set(1.0, tags=self._dep_tags)
+
+    def _graph_broken(self, graph: _CompiledGraph, replica_id: str) -> None:
+        """A lane observed its replica die before any controller push."""
+        with self._lock:
+            if self._graph is graph:
+                self._graph = None
+                self._fallback_since = time.monotonic()
+                # Hold recompilation until the reconciler pushes a fresh
+                # set — rebuilding around the corpse would just fail.
+                self._last_change = time.monotonic()
+                COMPILED_MODE_GAUGE.set(0.0, tags=self._dep_tags)
+        graph.destroy()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stopped = True
+            graph = self._detach_locked()
+        if graph is not None:
+            graph.destroy()

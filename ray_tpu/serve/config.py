@@ -52,6 +52,11 @@ class DeploymentConfig:
     #: During a rolling update, how many replicas below target the healthy
     #: count may drop; 0 = never lose capacity (surge-then-drain).
     max_unavailable: int = 0
+    #: Compiled steady-state route: None (default) lets the router lower
+    #: dispatch onto pre-resolved channels once the replica set is stable;
+    #: False pins the deployment to the dynamic path.  (Process-tier
+    #: replicas are never lowered regardless.)
+    compiled_route: Optional[bool] = None
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
 
 

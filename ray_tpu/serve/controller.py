@@ -35,6 +35,10 @@ class ServeController:
         #: histograms), so rollups keep the latest per pid and sum across
         #: pids — never across routers.
         self._metric_snaps: Dict[str, Dict[int, tuple]] = {}
+        #: dep_id -> router_id -> (compiled: bool, ts).  Routers report
+        #: whether their route is lowered onto the compiled channel path;
+        #: serve.status() surfaces "compiled" when any fresh report says so.
+        self._route_modes: Dict[str, Dict[str, tuple]] = {}
         self._loop_task: Optional[asyncio.Task] = None
         self._shutdown = False
 
@@ -229,17 +233,22 @@ class ServeController:
     def record_handle_metrics(self, deployment_id: str, router_id: str,
                               total_inflight: int,
                               snapshot: Optional[Dict[str, Any]] = None,
-                              pid: Optional[int] = None) -> None:
+                              pid: Optional[int] = None,
+                              compiled: Optional[bool] = None) -> None:
         """Handle-side queue report (ref: autoscaling_state.py
         record_request_metrics_for_handle).  Routers additionally attach a
         cumulative per-process RED snapshot for the status/dashboard
-        rollups; old-style reports without one still feed autoscaling."""
+        rollups, and whether their route is currently compiled; old-style
+        reports without either still feed autoscaling."""
         now = time.time()
         self._handle_metrics.setdefault(deployment_id, {})[router_id] = (
             int(total_inflight), now)
         if snapshot is not None and pid is not None:
             self._metric_snaps.setdefault(deployment_id, {})[int(pid)] = (
                 snapshot, now)
+        if compiled is not None:
+            self._route_modes.setdefault(deployment_id, {})[router_id] = (
+                bool(compiled), now)
 
     def _latency_rollup(self, deployment_id: str) -> Dict[str, Any]:
         from ray_tpu.serve import metrics as serve_metrics
@@ -333,6 +342,13 @@ class ServeController:
                 "backoff_remaining_s": round(
                     max(0.0, state.backoff_until - now), 3),
                 "status": status,
+                # "compiled" when any fresh router report says its dispatch
+                # is lowered onto the channel path; stale reports (>2s) are
+                # ignored so a torn-down router can't pin the mode.
+                "route_mode": ("compiled" if any(
+                    c for c, ts in
+                    self._route_modes.get(dep_id, {}).values()
+                    if now - ts < 2.0) else "dynamic"),
                 # RED rollup from router-pushed snapshots (p50/p95/p99
                 # latency + request/error totals) — serve.status() answers
                 # "where did the latency go" without scraping /metrics.

@@ -424,6 +424,7 @@ class DeploymentState:
         return [{"replica_id": r.replica_id, "actor": r.actor,
                  "max_ongoing_requests": self.info.config.max_ongoing_requests,
                  "max_queued_requests": self.info.config.max_queued_requests,
+                 "compiled_route": self.info.config.compiled_route,
                  "multiplexed_model_ids": list(r.multiplexed_model_ids)}
                 for r in self.replicas if r.state == ReplicaState.RUNNING]
 

@@ -229,7 +229,11 @@ class DeploymentHandle:
         return h
 
     def remote(self, *args, **kwargs):
-        router = self._get_router()
+        # Dirty read first: once built, the router never changes, and the
+        # lock would serialize every caller thread on the hot path.
+        router = self._router
+        if router is None:
+            router = self._get_router()
         method = self._method_name
         if self._multiplexed_model_id:
             # Rides to the router (warm-replica preference) and on to the
@@ -241,6 +245,14 @@ class DeploymentHandle:
             # results): every item is pulled from the pinned replica.
             actor, sid, done = router.assign_stream(method, *args, **kwargs)
             return DeploymentResponseGenerator(actor, sid, done)
+
+        # Compiled steady-state route: when the replica set is stable the
+        # router has lowered dispatch onto pre-resolved channels — no
+        # TaskSpec, no ObjectRef.  None means the route is dynamic (or a
+        # teardown raced us); fall through to the classic path.
+        compiled = router.try_assign_compiled(method, *args, **kwargs)
+        if compiled is not None:
+            return compiled
 
         def assign():
             return router.assign_request(method, *args, **kwargs)

@@ -312,3 +312,67 @@ class LLMEngine:
         for k in dead:
             _, seq = self._tracked.pop(k)
             self.scheduler.finish(seq)
+
+
+class ToyLMShard:
+    """Tensor-parallel shard of a :class:`ToyLM` context reduction.
+
+    Shards the *context* axis: shard ``rank`` of ``tp_degree`` owns the KV
+    entries at positions ``rank, rank + tp, rank + 2·tp, ...`` and computes
+    the weighted partial sum over just those — **unmasked**, so int64
+    wraparound keeps every partial exact mod 2**64.  Summing the partials
+    (``collective_node.allreduce`` over the compiled DAG) and masking once
+    in :meth:`ToyLM.token_from_acc` is congruent to the full-context
+    reduction, so TP output is byte-identical to the single-model oracle.
+
+    Each shard keeps a full token history (the "KV cache" is tiny integer
+    vectors; only the *reduction* is sharded, matching how TP shards the
+    matmul while replicating the residual stream).
+    """
+
+    def __init__(self, rank: int, tp_degree: int, *, dim: int = 8,
+                 vocab_size: int = 50_000, seed: int = 0):
+        if not 0 <= rank < tp_degree:
+            raise ValueError(f"rank {rank} out of range for tp={tp_degree}")
+        self.rank = rank
+        self.tp = tp_degree
+        self.lm = ToyLM(dim=dim, vocab_size=vocab_size, seed=seed)
+        self._entries: List[Any] = []
+
+    def reset(self, prompt: List[int]) -> int:
+        """Load a prompt (replicated on every shard); returns context len."""
+        self._entries = [self.lm.kv_entry(t, i) for i, t in enumerate(prompt)]
+        return len(self._entries)
+
+    def extend(self, token: int) -> int:
+        """Append the token every shard agreed on (post-allreduce)."""
+        self._entries.append(self.lm.kv_entry(int(token), len(self._entries)))
+        return len(self._entries)
+
+    def tp_step(self, prev_token: int) -> Any:
+        """One fused TP decode step, shaped for a compiled-DAG node: absorb
+        the previous step's agreed token (skip when < 0 — the prefill
+        step), then return this shard's unmasked partial."""
+        if int(prev_token) >= 0:
+            self.extend(int(prev_token))
+        return self.partial_acc()
+
+    def partial_acc(self, _tick: Any = None) -> Any:
+        """This shard's unmasked weighted partial over owned positions.
+
+        ``_tick`` is an ignored data dependency so a compiled DAG can
+        re-trigger the computation each decode step."""
+        import numpy as np
+
+        n = len(self._entries)
+        if n == 0:
+            return np.zeros(self.lm.dim, dtype=np.int64)
+        w = self.lm._weights(n)[self.rank::self.tp]
+        owned = self._entries[self.rank::self.tp]
+        if not owned:
+            return np.zeros(self.lm.dim, dtype=np.int64)
+        stacked = np.stack([np.asarray(e, dtype=np.int64) for e in owned])
+        return (stacked * w[:, None]).sum(axis=0, dtype=np.int64)
+
+    def token_from_acc(self, acc: Any) -> int:
+        return self.lm.token_from_acc(acc)

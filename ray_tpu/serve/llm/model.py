@@ -104,7 +104,17 @@ class ToyLM:
                                 for e in entries])
             w = self._weights(len(entries))
             acc = stacked * w[:, None]
-            acc = acc.sum(axis=0, dtype=np.int64) & _MASK
+            acc = acc.sum(axis=0, dtype=np.int64)
+        return self.token_from_acc(acc)
+
+    def token_from_acc(self, acc: np.ndarray) -> int:
+        """Token from the (possibly unmasked) weighted-sum accumulator.
+
+        Accepts wrapped int64 partial sums: summing per-shard partials mod
+        2**64 and masking ONCE here is congruent to the masked full-context
+        sum, which is what lets tensor-parallel shards allreduce raw
+        partials (see :class:`~ray_tpu.serve.llm.engine.ToyLMShard`)."""
+        acc = np.asarray(acc, dtype=np.int64) & _MASK
         h = int(_mix(acc).sum() & _MASK)
         return h % self.vocab_size
 

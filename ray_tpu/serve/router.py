@@ -17,6 +17,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import fault_injection
 from ray_tpu.serve import metrics as serve_metrics
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import tracing as _tracing
@@ -96,10 +97,11 @@ class PowerOfTwoChoicesReplicaScheduler:
         with self._lock:
             self._inflight[replica_id] = self._inflight.get(replica_id, 0) + 1
 
-    def on_request_done(self, replica_id: str) -> None:
+    def on_request_done(self, replica_id: str, n: int = 1) -> None:
         with self._lock:
             if replica_id in self._inflight:
-                self._inflight[replica_id] = max(0, self._inflight[replica_id] - 1)
+                self._inflight[replica_id] = max(
+                    0, self._inflight[replica_id] - n)
 
     def choose_replica(self, model_id: Optional[str] = None
                        ) -> Optional[Dict[str, Any]]:
@@ -179,6 +181,11 @@ class Router:
         #: Deployment-level queue allowance beyond capacity; -1 = unbounded
         #: (the reference's default).  Refreshed with the replica set.
         self._max_queued_requests = -1
+        # Compiled steady-state route (built BEFORE the long-poll client:
+        # its callback feeds the manager the replica set).
+        from ray_tpu.serve.compiled_router import CompiledRouteManager
+
+        self._compiled = CompiledRouteManager(self)
         from ray_tpu.serve.long_poll import LongPollClient
 
         self._long_poll = LongPollClient(
@@ -199,6 +206,10 @@ class Router:
             self._replicas_populated.set()
         else:
             self._replicas_populated.clear()
+        # AFTER the scheduler swap: a membership change tears the compiled
+        # graph down inside this callback (fallback within one tick), and
+        # any request it re-dispatches must see the NEW replica set.
+        self._compiled.on_replica_set(replicas or [])
 
     def _push_metrics_loop(self) -> None:
         """Handle-side queue metric reporting (ref: autoscaling_state.py —
@@ -207,6 +218,7 @@ class Router:
         from ray_tpu.exceptions import ActorDiedError
 
         while not self._stopped.wait(METRICS_PUSH_INTERVAL_S):
+            self._compiled.maybe_compile()
             inflight = self._scheduler.total_inflight()
             INFLIGHT_GAUGE.set(inflight,
                                tags={"deployment": self.deployment_id})
@@ -220,7 +232,8 @@ class Router:
                     self.deployment_id, self.router_id, inflight,
                     snapshot=serve_metrics.deployment_snapshot(
                         self.deployment_id),
-                    pid=os.getpid())
+                    pid=os.getpid(),
+                    compiled=(self._compiled.mode == "compiled"))
             except ActorDiedError:
                 self._stopped.set()  # controller gone: stop reporting
                 return
@@ -256,7 +269,6 @@ class Router:
         dropped locally and the request re-assigned.  ``send(replica)``
         performs the actual (non-blocking) submit and returns its result.
         ``model_id`` biases the pick toward warm multiplexed replicas."""
-        from ray_tpu._private import fault_injection
         from ray_tpu.exceptions import ActorDiedError
 
         fault_injection.check("serve_route")
@@ -292,6 +304,19 @@ class Router:
                 self._scheduler.on_request_done(rid)
                 raise
             return replica, rid, out
+
+    def try_assign_compiled(self, method_name: str, *args, **kwargs):
+        """Compiled fast path for unary requests.  Returns a
+        CompiledResponse when the route is compiled and the request was
+        lowered onto a channel, or None to use the dynamic path.  Capacity
+        shedding and the serve_route fault point fire exactly as on the
+        dynamic path."""
+        graph = self._compiled.graph
+        if graph is None:
+            return None
+        self._check_capacity()
+        fault_injection.check("serve_route")
+        return graph.submit(method_name, args, kwargs)
 
     def assign_request(self, method_name: str, *args, **kwargs):
         """Pick a replica and dispatch; returns the ObjectRef."""
@@ -381,4 +406,5 @@ class Router:
 
     def stop(self) -> None:
         self._stopped.set()
+        self._compiled.stop()
         self._long_poll.stop()

@@ -344,6 +344,105 @@ def run_batch_mode(args) -> dict:
     return fields
 
 
+def _wait_compiled(handle, timeout_s: float = 15.0) -> None:
+    router = handle._get_router()
+    deadline = time.time() + timeout_s
+    while router._compiled.mode != "compiled":
+        assert time.time() < deadline, "serve route never compiled"
+        time.sleep(0.05)
+
+
+def run_compiled_mode(args) -> dict:
+    """Compiled-route A/B (ISSUE 13 acceptance: compiled-path batched unary
+    >= 3x the dynamic path at 32 concurrent clients on the SAME host, and
+    >= 5000 qps absolute).
+
+    Both arms run the identical deployment — @serve.batch fused on
+    __call__, one lock-simulated accelerator, FORWARD_S per micro-batch —
+    differing only in compiled_route.  The dynamic arm re-records the
+    per-TaskSpec baseline; the compiled arm is the headline
+    batch_unary_batched_qps_c32."""
+    import statistics
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    FORWARD_S = 0.005  # one unary forward pass on the simulated device
+    os.environ.setdefault("RAY_TPU_SERVE_COMPILED_STABLE_S", "0.3")
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start(http_options={"port": 0})
+
+    def make_app(compiled: bool):
+        lock = threading.Lock()  # the deployment's single accelerator
+
+        @serve.deployment(max_ongoing_requests=64,
+                          compiled_route=compiled)
+        class Model:
+            @serve.batch(max_batch_size=32, batch_wait_timeout_s=0.01)
+            async def __call__(self, items):
+                with lock:  # ONE shared pass for the whole micro-batch
+                    time.sleep(FORWARD_S)
+                return [x * 2 for x in items]
+
+        return Model.bind()
+
+    fields = {}
+    waves = 5
+    for kind, compiled in (("dynamic", False), ("compiled", True)):
+        h = serve.run(make_app(compiled), name=f"bench_{kind}",
+                      route_prefix=None)
+        h.remote(0).result(timeout_s=60)  # warm
+        if compiled:
+            _wait_compiled(h)
+        _measure_qps(h, 32)  # second warm wave off the clock
+        qps = statistics.median(
+            _measure_qps(h, 32, per_client=20) for _ in range(waves))
+        fields[f"batch_unary_{kind}_route_qps_c32"] = round(qps, 1)
+        serve.delete(f"bench_{kind}")
+    fields["compiled_route_speedup_c32"] = round(
+        fields["batch_unary_compiled_route_qps_c32"]
+        / fields["batch_unary_dynamic_route_qps_c32"], 2)
+    # Headline anchor: the steady-state serve hot path IS the compiled one.
+    fields["batch_unary_batched_qps_c32"] = \
+        fields["batch_unary_compiled_route_qps_c32"]
+
+    # ---- sequential unary round-trip latency through the compiled route
+    @serve.deployment(max_ongoing_requests=8)
+    class Echo:
+        def __call__(self, x):
+            return x * 2
+
+    h = serve.run(Echo.bind(), name="bench_compiled_echo",
+                  route_prefix=None)
+    h.remote(0).result(timeout_s=60)
+    _wait_compiled(h)
+    lat = []
+    for i in range(args.requests):
+        t0 = time.perf_counter()
+        assert h.remote(i).result(timeout_s=30) == i * 2
+        lat.append((time.perf_counter() - t0) * 1000)
+    lat = np.asarray(lat)
+    fields["compiled_unary_p50_ms"] = round(
+        float(np.percentile(lat, 50)), 3)
+    fields["compiled_unary_p99_ms"] = round(
+        float(np.percentile(lat, 99)), 3)
+    fields["compiled_unary_qps"] = round(
+        args.requests / (lat.sum() / 1000), 1)
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+    # Acceptance anchors (ISSUE 13): fail loudly rather than record a
+    # regressed artifact.
+    assert fields["compiled_route_speedup_c32"] >= 3.0, fields
+    assert fields["batch_unary_batched_qps_c32"] >= 5000, fields
+    return fields
+
+
 def run_trace_mode(args) -> dict:
     """Tracing overhead anchors (ISSUE 4 acceptance: end-to-end tracing
     costs < 5% QPS at 32 concurrent clients on the batched unary path).
@@ -358,25 +457,27 @@ def run_trace_mode(args) -> dict:
     from ray_tpu.util import tracing
 
     FORWARD_S = 0.005  # one forward pass on the simulated device
+    os.environ.setdefault("RAY_TPU_SERVE_COMPILED_STABLE_S", "0.3")
 
     ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
     serve.start(http_options={"port": 0})
 
     lock = threading.Lock()  # the deployment's single accelerator
 
+    # The steady-state hot path is the COMPILED route (ISSUE 13), so the
+    # span-overhead anchor measures it: batch fused on __call__, spans
+    # exported per compiled iteration via record_span_batch.
     @serve.deployment(max_ongoing_requests=64)
     class Model:
         @serve.batch(max_batch_size=32, batch_wait_timeout_s=0.01)
-        async def infer(self, items):
+        async def __call__(self, items):
             with lock:
                 time.sleep(FORWARD_S)  # one shared pass per micro-batch
             return [x * 2 for x in items]
 
-        async def __call__(self, x):
-            return await self.infer(x)
-
     handle = serve.run(Model.bind(), name="bench_trace", route_prefix=None)
     handle.remote(0).result(timeout_s=60)  # warm
+    _wait_compiled(handle)
 
     import statistics
 
@@ -738,7 +839,7 @@ def run_llm_mode(args) -> dict:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=("latency", "batch", "chaos", "trace",
-                                       "llm"),
+                                       "compiled", "llm"),
                     default="latency")
     ap.add_argument("--requests", type=int, default=300)
     ap.add_argument("--stream-tokens", type=int, default=2000)
@@ -757,7 +858,7 @@ def main():
 
     modes = {"latency": run_latency_mode, "batch": run_batch_mode,
              "chaos": run_chaos_mode, "trace": run_trace_mode,
-             "llm": run_llm_mode}
+             "compiled": run_compiled_mode, "llm": run_llm_mode}
     fields = modes[args.mode](args)
     artifact = _merge_artifact(args.out, fields)
     print(json.dumps(artifact))

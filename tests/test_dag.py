@@ -36,6 +36,37 @@ def test_channel_roundtrip():
         ch.read()
 
 
+def test_channel_slot_ring_no_alloc_steady_state():
+    # Serve-sized payloads ride a ring of pre-sized reusable slots: after
+    # the ring warms up, acquire/release cycles must not allocate.
+    ch = Channel(maxsize=8, slot_width=4)
+    warm = [ch.acquire_slot() for _ in range(8)]
+    assert all(len(s) == 4 for s in warm)
+    assert ch.slot_allocations == 8
+    for s in warm:
+        s[0] = "payload"
+        ch.release_slot(s)
+    for _ in range(100):  # steady state: pure reuse
+        s = ch.acquire_slot()
+        assert s[0] is None  # release cleared the fields
+        s[0] = "payload"
+        ch.release_slot(s)
+    assert ch.slot_allocations == 8
+
+
+def test_channel_read_ready_drains_nonblocking():
+    ch = Channel(maxsize=4)
+    assert ch.read_ready(8) == []
+    for i in range(4):
+        ch.write(i)
+    out = []
+    assert ch.read_ready(3, out=out) is out
+    assert out == [0, 1, 2]
+    ch.close()
+    assert ch.read_ready(8) == [3]  # buffered items survive close
+    assert ch.read_ready(8) == []  # and a drained closed channel is empty
+
+
 def test_device_channel_places_on_device():
     import jax
 

@@ -139,6 +139,119 @@ def test_no_request_lands_on_removed_replica(serve_chaos):
         assert rid != victim_rid, "request landed on a removed replica"
 
 
+def test_kill_replica_under_compiled_load_zero_errors(serve_chaos,
+                                                      monkeypatch):
+    """Compiled-route fallback seam: kill a replica while clients hammer a
+    COMPILED deployment — teardown -> dynamic fallback -> recompile must be
+    invisible to callers (zero request errors), and serve.status() reports
+    the per-deployment route mode across the transition."""
+    monkeypatch.setenv("RAY_TPU_SERVE_COMPILED_STABLE_S", "0.2")
+
+    @serve.deployment(num_replicas=3, max_ongoing_requests=16,
+                      health_check_period_s=0.2)
+    class Echo:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.002)
+        async def __call__(self, items):
+            return [x * 2 for x in items]
+
+    handle = serve.run(Echo.bind(), name="ckill", route_prefix=None)
+    assert handle.remote(1).result(timeout_s=30) == 2
+    router = handle._get_router()
+    deadline = time.time() + 10
+    while router._compiled.mode != "compiled" and time.time() < deadline:
+        time.sleep(0.05)
+    assert router._compiled.mode == "compiled", "route never compiled"
+
+    stop = threading.Event()
+    stats = {"ok": 0, "err": []}
+    lock = threading.Lock()
+
+    def client():
+        i = 0
+        while not stop.is_set():
+            try:
+                assert handle.remote(i).result(timeout_s=15) == i * 2
+                with lock:
+                    stats["ok"] += 1
+            except Exception as e:  # noqa: BLE001 — recorded for the assert
+                with lock:
+                    stats["err"].append(repr(e))
+            i += 1
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(8)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+
+    _kill_one_replica()
+
+    # The router must fall back (the lane observes the death locally or the
+    # reconciler push tears the graph down) and then recompile once the
+    # controller has converged on a fresh stable set.
+    saw_dynamic = False
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        mode = router._compiled.mode
+        if mode == "dynamic":
+            saw_dynamic = True
+        if saw_dynamic and mode == "compiled":
+            break
+        time.sleep(0.02)
+    time.sleep(0.5)  # keep load on the recompiled graph
+    stop.set()
+    for t in threads:
+        t.join(timeout=20)
+    assert saw_dynamic, "never fell back to the dynamic path"
+    assert router._compiled.mode == "compiled", "never recompiled"
+    # THE acceptance bar: teardown -> fallback -> recompile loses nothing.
+    assert not stats["err"], stats["err"][:5]
+    assert stats["ok"] > 100, stats
+
+    # serve.status() reflects the (re)compiled mode once routers report.
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if serve.status()["ckill#Echo"].get("route_mode") == "compiled":
+            break
+        time.sleep(0.1)
+    assert serve.status()["ckill#Echo"]["route_mode"] == "compiled"
+
+
+@pytest.mark.parametrize("serve_chaos", ["serve_replica_handle=1.0:3"],
+                         indirect=True)
+def test_injected_replica_failures_on_compiled_path(serve_chaos,
+                                                    monkeypatch):
+    """The serve_replica_handle fault point fires per request inside the
+    compiled loop exactly as on the dynamic path: bounded injected failures
+    surface to callers as task errors, then the data plane is clean."""
+    monkeypatch.setenv("RAY_TPU_SERVE_COMPILED_STABLE_S", "0.2")
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=8)
+    class G:
+        def __call__(self, x):
+            return x * 2
+
+    handle = serve.run(G.bind(), name="creplica", route_prefix=None)
+    router = handle._get_router()
+    deadline = time.time() + 10
+    while router._compiled.mode != "compiled" and time.time() < deadline:
+        time.sleep(0.05)
+    assert router._compiled.mode == "compiled"
+
+    failures = 0
+    successes = 0
+    for i in range(12):
+        try:
+            assert handle.remote(i).result(timeout_s=10) == i * 2
+            successes += 1
+        except Exception:  # noqa: BLE001 — injected
+            failures += 1
+    assert 1 <= failures <= 3, (failures, successes)
+    assert successes >= 9
+    assert handle.remote(5).result(timeout_s=10) == 10
+    assert router._compiled.mode == "compiled"  # faults don't tear down
+
+
 def test_crash_looping_init_backs_off(serve_chaos):
     """A deployment whose __init__ always raises must back off
     exponentially instead of hot-looping replacements (restart count stays

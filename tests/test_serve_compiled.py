@@ -1,0 +1,275 @@
+"""Compiled steady-state serve route (ray_tpu/serve/compiled_router.py):
+graph lowering after the stability window, batch fusion, dynamic-path
+parity (results, methods, errors, multiplexing), teardown/fallback on
+membership change, disable knobs, and status reporting."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.exceptions import TaskError
+
+
+@pytest.fixture
+def serve_fast_compile(monkeypatch):
+    # Short stability window so tests compile within ~0.5s of deploy.
+    monkeypatch.setenv("RAY_TPU_SERVE_COMPILED_STABLE_S", "0.2")
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start(http_options={"port": 0})
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _wait_compiled(handle, timeout=8.0):
+    router = handle._get_router()
+    deadline = time.time() + timeout
+    while router._compiled.mode != "compiled":
+        if time.time() > deadline:
+            raise AssertionError("route never compiled")
+        time.sleep(0.02)
+    return router
+
+
+def test_compiles_after_stability_window(serve_fast_compile):
+    @serve.deployment(num_replicas=2, max_ongoing_requests=16)
+    class Echo:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.002)
+        async def __call__(self, items):
+            return [x * 2 for x in items]
+
+    h = serve.run(Echo.bind(), name="app", route_prefix=None)
+    # First request lands before the window: dynamic path.
+    assert h.remote(3).result(timeout_s=10) == 6
+    router = _wait_compiled(h)
+
+    # Steady state: responses come back through the channels, correct and
+    # ordered per caller.
+    from ray_tpu.serve.compiled_router import CompiledResponse
+
+    resp = h.remote(5)
+    assert isinstance(resp, CompiledResponse)
+    assert resp.result(timeout_s=10) == 10
+    resps = [h.remote(i) for i in range(64)]
+    assert [r.result(timeout_s=10) for r in resps] == [
+        i * 2 for i in range(64)]
+    assert router._compiled.mode == "compiled"
+
+
+def test_compiled_methods_and_errors_match_dynamic(serve_fast_compile):
+    @serve.deployment(num_replicas=1, max_ongoing_requests=8)
+    class Svc:
+        def ping(self, x):
+            return ("pong", x)
+
+        async def aping(self, x):
+            return ("apong", x)
+
+        def boom(self, x):
+            raise ValueError(f"boom-{x}")
+
+        def __call__(self, x):
+            return x + 1
+
+    h = serve.run(Svc.bind(), name="app", route_prefix=None)
+    _wait_compiled(h)
+    # Sync and async methods route by attribute exactly like the dynamic
+    # handle surface.
+    assert h.ping.remote(7).result(timeout_s=10) == ("pong", 7)
+    assert h.aping.remote(8).result(timeout_s=10) == ("apong", 8)
+    assert h.remote(1).result(timeout_s=10) == 2
+    # User exceptions arrive wrapped in TaskError with the original as
+    # .cause — the dynamic path's contract.
+    with pytest.raises(TaskError) as ei:
+        h.boom.remote(1).result(timeout_s=10)
+    assert isinstance(ei.value.cause, ValueError)
+    # The replica survives an exception (no teardown, still compiled).
+    assert h.remote(2).result(timeout_s=10) == 3
+
+
+def test_compiled_await_and_composition(serve_fast_compile):
+    @serve.deployment(num_replicas=1)
+    class Inner:
+        def __call__(self, x):
+            return x * 10
+
+    @serve.deployment(num_replicas=1)
+    class Outer:
+        def __init__(self, inner):
+            self.inner = inner
+
+        async def __call__(self, x):
+            return (await self.inner.remote(x)) + 1
+
+    h = serve.run(Outer.bind(Inner.bind()), name="app", route_prefix=None)
+    _wait_compiled(h)
+
+    async def main():
+        return await h.remote(4)
+
+    assert asyncio.run(main()) == 41
+    assert h.remote(5).result(timeout_s=10) == 51
+
+
+def test_membership_change_tears_down_and_recompiles(serve_fast_compile):
+    @serve.deployment(num_replicas=1, max_ongoing_requests=8)
+    class Echo:
+        def __call__(self, x):
+            return x * 2
+
+    h = serve.run(Echo.bind(), name="app", route_prefix=None)
+    router = _wait_compiled(h)
+    mgr = router._compiled
+    old_graph = mgr.graph
+
+    # Scale up: the reconciler's push must tear the graph down within the
+    # long-poll callback, then recompile once the new set is stable.
+    serve.run(Echo.options(num_replicas=3).bind(), name="app",
+              route_prefix=None)
+    deadline = time.time() + 10
+    while mgr.graph is old_graph:
+        assert time.time() < deadline, "graph not torn down on scale-up"
+        assert h.remote(1).result(timeout_s=10) == 2  # no errors meanwhile
+        time.sleep(0.02)
+    _wait_compiled(h)
+    assert mgr.graph is not old_graph
+    assert [h.remote(i).result(timeout_s=10) for i in range(16)] == [
+        i * 2 for i in range(16)]
+
+
+def test_env_kill_switch_disables_compilation(serve_fast_compile,
+                                              monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SERVE_COMPILED", "0")
+
+    @serve.deployment(num_replicas=1)
+    class Echo:
+        def __call__(self, x):
+            return x + 1
+
+    h = serve.run(Echo.bind(), name="app", route_prefix=None)
+    assert h.remote(1).result(timeout_s=10) == 2
+    router = h._get_router()
+    time.sleep(1.0)  # several stability windows + metric ticks
+    assert router._compiled.mode == "dynamic"
+    from ray_tpu.serve.handle import DeploymentResponse
+
+    assert isinstance(h.remote(2), DeploymentResponse)
+
+
+def test_per_deployment_opt_out(serve_fast_compile):
+    @serve.deployment(num_replicas=1, compiled_route=False)
+    class Pinned:
+        def __call__(self, x):
+            return x + 1
+
+    h = serve.run(Pinned.bind(), name="app", route_prefix=None)
+    assert h.remote(1).result(timeout_s=10) == 2
+    time.sleep(1.0)
+    assert h._get_router()._compiled.mode == "dynamic"
+
+
+def test_status_reports_route_mode(serve_fast_compile):
+    @serve.deployment(num_replicas=1)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(Echo.bind(), name="app", route_prefix=None)
+    assert h.remote(1).result(timeout_s=10) == 1
+    _wait_compiled(h)
+    deadline = time.time() + 5
+    while True:  # the router reports its mode on the next metrics push
+        mode = serve.status()["app#Echo"].get("route_mode")
+        if mode == "compiled":
+            break
+        assert time.time() < deadline, f"route_mode stuck at {mode}"
+        time.sleep(0.1)
+
+
+def test_process_tier_replicas_stay_dynamic(serve_fast_compile):
+    @serve.deployment(num_replicas=1,
+                      ray_actor_options={"isolation": "process"})
+    class Iso:
+        def __call__(self, x):
+            return x * 3
+
+    h = serve.run(Iso.bind(), name="app", route_prefix=None)
+    assert h.remote(2).result(timeout_s=30) == 6
+    time.sleep(1.0)
+    # No in-process instance to lower onto — the route must stay dynamic
+    # (and must not spin retrying the same uncompilable set).
+    assert h._get_router()._compiled.mode == "dynamic"
+    assert h.remote(3).result(timeout_s=30) == 9
+
+
+def test_compiled_multiplexed_model_routing(serve_fast_compile):
+    @serve.deployment(num_replicas=2, max_ongoing_requests=8)
+    class MuxSvc:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def load(self, model_id: str):
+            return {"model": model_id}
+
+        async def __call__(self, x):
+            model = await self.load(
+                serve.get_multiplexed_model_id())
+            return (model["model"], x)
+
+    h = serve.run(MuxSvc.bind(), name="app", route_prefix=None)
+    _wait_compiled(h)
+    for i in range(8):
+        mid = f"m{i % 2}"
+        got = h.options(multiplexed_model_id=mid).remote(i).result(
+            timeout_s=10)
+        assert got == (mid, i)
+
+
+def test_backpressure_sheds_on_compiled_path(serve_fast_compile):
+    from ray_tpu.serve.exceptions import BackPressureError
+
+    release = threading.Event()
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=2,
+                      max_queued_requests=0)
+    class Slow:
+        def __call__(self, x):
+            release.wait(10)
+            return x
+
+    h = serve.run(Slow.bind(), name="app", route_prefix=None)
+    _wait_compiled(h)
+    resps = [h.remote(i) for i in range(2)]  # fill capacity
+    time.sleep(0.2)
+    with pytest.raises(BackPressureError):
+        h.remote(99)
+    release.set()
+    for r in resps:
+        r.result(timeout_s=10)
+
+
+def test_compiled_steady_state_no_alloc(serve_fast_compile):
+    @serve.deployment(num_replicas=1, max_ongoing_requests=16)
+    class Echo:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.002)
+        async def __call__(self, items):
+            return [x for x in items]
+
+    h = serve.run(Echo.bind(), name="app", route_prefix=None)
+    router = _wait_compiled(h)
+    graph = router._compiled.graph
+    # Warm the slot ring.
+    resps = [h.remote(i) for i in range(32)]
+    assert [r.result(timeout_s=10) for r in resps] == list(range(32))
+    lanes = list(graph._lanes.values())
+    before = sum(lane.req.slot_allocations for lane in lanes)
+    # Steady state: every send reuses a pooled slot — zero new buffers.
+    for wave in range(4):
+        resps = [h.remote(i) for i in range(32)]
+        assert [r.result(timeout_s=10) for r in resps] == list(range(32))
+    after = sum(lane.req.slot_allocations for lane in lanes)
+    assert after == before, (
+        f"compiled hot path allocated {after - before} new request slots "
+        f"in steady state")

@@ -918,3 +918,55 @@ def test_llm_bench_gate_reduced_scale():
     # run_llm_mode; also asserted there before the artifact is written).
     assert fields["llm_attrib_overhead_pct"] <= 2.0, fields
     assert fields["llm_attrib_tokens_per_s_on"] > 0, fields
+
+
+# ------------------------------------------------ tensor-parallel shards
+def test_tp_shard_math_byte_identical():
+    """ISSUE 13: context-axis TP sharding — per-rank UNMASKED int64
+    partials summed (wraparound ≡ mod 2**64) then masked once in
+    token_from_acc must be congruent to the full-context reduction."""
+    from ray_tpu.serve.llm.engine import ToyLMShard
+
+    lm = ToyLM(seed=13)
+    prompt = [11, 42, 7, 99, 3]
+    for tp in (2, 3):
+        shards = [ToyLMShard(r, tp, seed=13) for r in range(tp)]
+        for s in shards:
+            s.reset(prompt)
+        out = []
+        prev = -1
+        for _ in range(12):
+            partials = [s.tp_step(prev) for s in shards]
+            acc = partials[0]
+            for p in partials[1:]:
+                acc = acc + p  # int64 wraparound sum, as allreduce does
+            toks = {s.token_from_acc(acc) for s in shards}
+            assert len(toks) == 1
+            prev = toks.pop()
+            out.append(prev)
+        assert out == lm.reference_generate(prompt, 12), (tp, out)
+    # empty-prompt edge: first step reduces over zero owned positions
+    shards = [ToyLMShard(r, 2, seed=13) for r in range(2)]
+    for s in shards:
+        s.reset([])
+    tok = shards[0].token_from_acc(shards[0].tp_step(-1)
+                                   + shards[1].tp_step(-1))
+    assert tok == lm.reference_generate([], 1)[0]
+
+
+@pytest.mark.slow
+def test_tp_inference_example():
+    """ISSUE 13 acceptance: examples/serve_tp_inference.py — a 2-rank TP
+    serve/llm deployment over compiled allreduce with DeviceChannel
+    edges — generates byte-identical to the single-replica oracle (the
+    example asserts equality itself; the test gates on its OK line)."""
+    import subprocess
+    import sys
+
+    script = os.path.join(os.path.dirname(__file__), "..", "examples",
+                          "serve_tp_inference.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, script], env=env,
+                          capture_output=True, text=True, timeout=180)
+    assert proc.returncode == 0, proc.stdout + "\n" + proc.stderr
+    assert "OK" in proc.stdout, proc.stdout
