@@ -1740,6 +1740,7 @@ class Runtime:
                     # executing against the (gone) local instance.
                     self._forward_actor_task(state, item)
                     continue
+                # detached_ok: reaped by the all_tasks cancel sweep after pump()
                 loop.create_task(run_one(item))
 
         try:

@@ -203,6 +203,7 @@ def stream_blocks(plans: Iterator[Tuple[Any, LogicalOp]],
         budget = ex.ResourceBudget()
     refs: deque = deque()  # fetched-ahead (key, ref)
     outstanding: dict = {}  # key -> blocks yielded to go (count in refs)
+    totals: dict = {}  # key -> blocks produced so far (monotonic)
     produced: dict = {}  # key -> total blocks produced (shard done)
     started: dict = {}  # key -> first-pull timestamp (span start)
     gen: Optional[Iterator[Any]] = None
@@ -211,6 +212,7 @@ def stream_blocks(plans: Iterator[Tuple[Any, LogicalOp]],
 
     def _shard_done(key) -> None:
         n = produced.pop(key)
+        totals.pop(key, None)
         outstanding.pop(key, None)
         ingest_metrics.SHARDS.inc()
         t0 = started.pop(key, None)
@@ -234,12 +236,17 @@ def stream_blocks(plans: Iterator[Tuple[Any, LogicalOp]],
             try:
                 ref = next(gen)
             except StopIteration:
-                produced[cur_key] = outstanding.get(cur_key, 0)
-                if produced[cur_key] == 0:
-                    _shard_done(cur_key)  # empty shard: done immediately
+                # Record the shard's full block count, not the in-flight
+                # depth — blocks already yielded downstream decremented
+                # ``outstanding``, so it undercounts whenever the shard
+                # outlasts the fetch-ahead window.
+                produced[cur_key] = totals.get(cur_key, 0)
+                if outstanding.get(cur_key, 0) == 0:
+                    _shard_done(cur_key)  # all blocks already yielded
                 gen = None
                 continue
             budget.observe_ref(ref)
+            totals[cur_key] = totals.get(cur_key, 0) + 1
             outstanding[cur_key] = outstanding.get(cur_key, 0) + 1
             refs.append((cur_key, ref))
         if not refs:

@@ -53,6 +53,9 @@ class HostPrefetcher:
 
     def __init__(self, src: Iterable[Any], depth: int = 2,
                  should_stop=None):
+        # ``_q`` and ``_stop`` are the only pump<->consumer channels
+        # (thread-safe by construction); the source iterator itself is
+        # advanced exclusively on the pump thread.
         self._q: "queue.Queue" = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
         self._should_stop = should_stop

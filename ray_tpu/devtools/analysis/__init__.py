@@ -1,15 +1,17 @@
 """Framework-aware static analyzer for ray_tpu (``scripts/analyze.py``).
 
-Pure AST + tokenize — never imports the code it analyzes.  Five
-framework-aware checkers (lock-discipline, atomicity,
-blocking-in-handler, registry-consistency, lockstep-divergence) run over
-the package in tier-1 CI; accepted findings live in
-``analysis_baseline.json`` with one-line justifications.  See
-docs/static-analysis.md for the checker catalog and the ``guarded_by``
-annotation convention.
+Pure AST + tokenize — never imports the code it analyzes.  Eight
+framework-aware checkers run over the package in tier-1 CI: the lexical
+five (lock-discipline, atomicity, blocking-in-handler,
+registry-consistency, lockstep-divergence) plus the flow-sensitive
+exit-path family built on ``cfg.py`` (paired-effect, task-lifecycle,
+thread-ownership).  Accepted findings live in ``analysis_baseline.json``
+with one-line justifications.  See docs/static-analysis.md for the
+checker catalog and the annotation conventions.
 """
 
-from ray_tpu.devtools.analysis import baseline, core
+from ray_tpu.devtools.analysis import baseline, cfg, core
+from ray_tpu.devtools.analysis.cache import run_cached
 from ray_tpu.devtools.analysis.checkers import (
     ALL_CHECKERS,
     CHECKERS_BY_NAME,
@@ -26,5 +28,5 @@ from ray_tpu.devtools.analysis.core import (
 __all__ = [
     "ALL_CHECKERS", "CHECKERS_BY_NAME", "make_checkers",
     "AnalysisContext", "Checker", "Finding", "analyze_source", "run",
-    "baseline", "core",
+    "run_cached", "baseline", "cfg", "core",
 ]

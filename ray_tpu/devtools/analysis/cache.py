@@ -1,0 +1,303 @@
+"""Incremental analysis cache — ``scripts/analyze.py --changed-only``.
+
+Per-module memoisation keyed by (mtime, sha256): an unchanged module's
+findings *and* its cross-module scratch contributions (collect-phase
+``# pairs_with:`` declarations, registry-usage sets) are replayed from
+``.analysis_cache.json`` instead of re-parsed and re-checked, so the
+tier-1 analysis gate stays <10s as the repo grows.  Cross-module
+*aggregate* checks (``Checker.finalize``) always re-run — they are pure
+functions of the merged scratch, which the cache reconstructs exactly.
+
+Invalidation, broadest first:
+
+* analyzer fingerprint — any change to ``devtools/analysis/**`` sources,
+  the enabled-checker list, or the three registry source files
+  (fault_injection / tracing / slo) drops the whole cache;
+* collect fingerprint — when the merged cross-module declarations (e.g.
+  a ``# pairs_with:`` added in one file) differ from what the cached
+  findings were computed under, every module is re-checked: a
+  declaration in file A changes what is a violation in file B;
+* per-file (mtime, sha256) — a matching mtime skips even the read; a
+  changed mtime with an unchanged hash refreshes the mtime only.
+
+The cache file is an implementation detail (gitignored, atomically
+replaced); a corrupt or version-skewed cache silently degrades to a
+full run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import core
+
+CACHE_VERSION = 3
+CACHE_BASENAME = ".analysis_cache.json"
+
+#: registry sources whose content feeds every module's checks
+_REGISTRY_FILES = (
+    os.path.join("_private", "fault_injection.py"),
+    os.path.join("util", "tracing.py"),
+    os.path.join("serve", "slo.py"),
+)
+
+
+# ------------------------------------------------------------------- codec
+# ctx.scratch holds sets and tuples; JSON has neither.  Tag them.
+
+def _encode(obj):
+    if isinstance(obj, (set, frozenset)):
+        return {"__set__": sorted((_encode(v) for v in obj), key=repr)}
+    if isinstance(obj, tuple):
+        return {"__tuple__": [_encode(v) for v in obj]}
+    if isinstance(obj, dict):
+        return {"__dict__": [[_encode(k), _encode(v)]
+                             for k, v in sorted(obj.items(), key=repr)]}
+    if isinstance(obj, list):
+        return [_encode(v) for v in obj]
+    return obj
+
+
+def _decode(obj):
+    if isinstance(obj, dict):
+        if "__set__" in obj:
+            return set(_decode(v) for v in obj["__set__"])
+        if "__tuple__" in obj:
+            return tuple(_decode(v) for v in obj["__tuple__"])
+        if "__dict__" in obj:
+            return {_decode(k): _decode(v) for k, v in obj["__dict__"]}
+    if isinstance(obj, list):
+        return [_decode(v) for v in obj]
+    return obj
+
+
+def _merge_scratch(dst: dict, src: dict) -> None:
+    for key, value in src.items():
+        if key not in dst:
+            dst[key] = value
+            continue
+        cur = dst[key]
+        if isinstance(cur, set) and isinstance(value, (set, frozenset)):
+            cur |= value
+        elif isinstance(cur, dict) and isinstance(value, dict):
+            for k, v in value.items():
+                if k in cur and isinstance(cur[k], list) \
+                        and isinstance(v, list):
+                    cur[k].extend(v)
+                elif k in cur and isinstance(cur[k], (set, frozenset)) \
+                        and isinstance(v, (set, frozenset)):
+                    cur[k] = set(cur[k]) | set(v)
+                else:
+                    cur.setdefault(k, v)
+        elif isinstance(cur, list) and isinstance(value, list):
+            cur.extend(value)
+        # scalars: first writer wins (collect contributions are per-file
+        # disjoint in practice)
+
+
+def _sha(data: str) -> str:
+    return hashlib.sha256(data.encode("utf-8")).hexdigest()
+
+
+def _file_sha(path: str) -> Optional[str]:
+    try:
+        with open(path, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def analyzer_fingerprint(checkers: Sequence[core.Checker],
+                         package_dir: Optional[str]) -> str:
+    """Hash of everything that changes analysis results besides the
+    analyzed files themselves."""
+    h = hashlib.sha256()
+    h.update(str(CACHE_VERSION).encode())
+    h.update(",".join(sorted(c.name for c in checkers)).encode())
+    analysis_dir = os.path.dirname(os.path.abspath(__file__))
+    for dirpath, dirnames, filenames in os.walk(analysis_dir):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                sha = _file_sha(os.path.join(dirpath, fn))
+                h.update(f"{fn}:{sha}".encode())
+    if package_dir:
+        for rel in _REGISTRY_FILES:
+            sha = _file_sha(os.path.join(package_dir, rel))
+            h.update(f"{rel}:{sha}".encode())
+    return h.hexdigest()
+
+
+def _finding_to_dict(f: core.Finding) -> dict:
+    return {"check": f.check, "path": f.path, "line": f.line,
+            "symbol": f.symbol, "message": f.message, "detail": f.detail}
+
+
+def _finding_from_dict(d: dict) -> core.Finding:
+    return core.Finding(check=d["check"], path=d["path"], line=d["line"],
+                        symbol=d["symbol"], message=d["message"],
+                        detail=d["detail"])
+
+
+def _package_dir(files: List[str], root: str) -> Optional[str]:
+    for f in files:
+        if f.replace(os.sep, "/").endswith(
+                "ray_tpu/_private/fault_injection.py"):
+            return os.path.dirname(os.path.dirname(f))
+    candidate = os.path.join(root, "ray_tpu")
+    return candidate if os.path.isdir(candidate) else None
+
+
+def run_cached(paths: Sequence[str], checkers: Sequence[core.Checker],
+               root: Optional[str] = None, exclude: Sequence[str] = (),
+               ctx: Optional[core.AnalysisContext] = None,
+               cache_path: Optional[str] = None
+               ) -> Tuple[List[core.Finding], dict]:
+    """Drop-in for :func:`core.run` with per-module memoisation."""
+    root = root or os.getcwd()
+    cache_path = cache_path or os.path.join(root, CACHE_BASENAME)
+    t0 = time.monotonic()
+    files = list(core.iter_python_files(paths, exclude))
+    package_dir = _package_dir(files, root)
+    fingerprint = analyzer_fingerprint(checkers, package_dir)
+
+    cache: dict = {}
+    try:
+        with open(cache_path, encoding="utf-8") as f:
+            loaded = json.load(f)
+        if loaded.get("version") == CACHE_VERSION \
+                and loaded.get("fingerprint") == fingerprint:
+            cache = loaded
+    except (OSError, ValueError):
+        cache = {}
+    cached_files: Dict[str, dict] = cache.get("files", {})
+
+    ctx = ctx or core.AnalysisContext(root=root)
+    ctx.full_package = any(
+        f.replace(os.sep, "/").endswith("_private/fault_injection.py")
+        for f in files)
+    if package_dir is not None:
+        core.load_registries(ctx, package_dir)
+
+    # ---------------------------------------------- classify changed files
+    entries: Dict[str, dict] = {}   # relpath -> new cache entry
+    changed: Dict[str, core.SourceModule] = {}
+    hits = 0
+    for abspath in files:
+        rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+        try:
+            mtime = os.stat(abspath).st_mtime_ns
+        except OSError:
+            continue
+        old = cached_files.get(rel)
+        if old is not None and old.get("mtime") == mtime:
+            entries[rel] = old
+            hits += 1
+            continue
+        sha = _file_sha(abspath)
+        if sha is None:
+            continue
+        if old is not None and old.get("sha") == sha:
+            old["mtime"] = mtime
+            entries[rel] = old
+            hits += 1
+            continue
+        module = core.parse_module(abspath, root)
+        if module is None:
+            continue
+        changed[rel] = module
+        entries[rel] = {"mtime": mtime, "sha": sha}
+
+    # ------------------------------------------------------ collect phase
+    for rel, module in changed.items():
+        cctx = core.AnalysisContext(
+            root=root, fault_points=ctx.fault_points,
+            span_names=ctx.span_names, span_prefixes=ctx.span_prefixes,
+            slo_objectives=ctx.slo_objectives,
+            metric_prefixes=ctx.metric_prefixes)
+        for checker in checkers:
+            checker.collect(module, cctx)
+        entries[rel]["collect"] = _encode(cctx.scratch)
+    merged_collect: dict = {}
+    for rel in sorted(entries):
+        _merge_scratch(merged_collect, _decode(entries[rel].get("collect",
+                                                                {})))
+    collect_fp = _sha(json.dumps(_encode(merged_collect), sort_keys=True))
+    if cache.get("collect_fingerprint") not in (None, collect_fp):
+        # Cross-module declarations changed: every cached finding may be
+        # stale.  Re-check everything (parses only what wasn't parsed yet).
+        for rel in list(entries):
+            if rel in changed:
+                continue
+            abspath = os.path.join(root, rel.replace("/", os.sep))
+            module = core.parse_module(abspath, root)
+            if module is None:
+                entries.pop(rel)
+                continue
+            changed[rel] = module
+            hits -= 1
+    have_findings = all("findings" in entries[rel] for rel in entries
+                        if rel not in changed)
+    if not have_findings:  # pragma: no cover — defensive vs corrupt cache
+        for rel in list(entries):
+            if rel not in changed and "findings" not in entries[rel]:
+                abspath = os.path.join(root, rel.replace("/", os.sep))
+                module = core.parse_module(abspath, root)
+                if module is not None:
+                    changed[rel] = module
+
+    # -------------------------------------------------------- check phase
+    collect_keys = set(merged_collect)
+    for rel in sorted(changed):
+        module = changed[rel]
+        mctx = core.AnalysisContext(
+            root=root, fault_points=ctx.fault_points,
+            span_names=ctx.span_names, span_prefixes=ctx.span_prefixes,
+            slo_objectives=ctx.slo_objectives,
+            metric_prefixes=ctx.metric_prefixes,
+            full_package=ctx.full_package)
+        mctx.scratch = {k: v for k, v in merged_collect.items()}
+        module_findings: List[core.Finding] = []
+        for checker in checkers:
+            for finding in checker.check_module(module, mctx):
+                if checker.name in module.ignored_checks(finding.line):
+                    continue
+                module_findings.append(finding)
+        entries[rel]["findings"] = [_finding_to_dict(f)
+                                    for f in module_findings]
+        entries[rel]["scratch"] = _encode(
+            {k: v for k, v in mctx.scratch.items()
+             if k not in collect_keys})
+
+    # ------------------------------------------------------ finalize phase
+    findings: List[core.Finding] = []
+    ctx.scratch = dict(merged_collect)
+    for rel in sorted(entries):
+        entry = entries[rel]
+        findings.extend(_finding_from_dict(d)
+                        for d in entry.get("findings", ()))
+        _merge_scratch(ctx.scratch, _decode(entry.get("scratch", {})))
+    if ctx.full_package:
+        for checker in checkers:
+            findings.extend(checker.finalize(ctx))
+
+    # --------------------------------------------------------------- save
+    payload = {"version": CACHE_VERSION, "fingerprint": fingerprint,
+               "collect_fingerprint": collect_fp, "files": entries}
+    try:
+        tmp = cache_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, cache_path)
+    except OSError:  # pragma: no cover — read-only checkout is fine
+        pass
+
+    stats = {"files": len(entries), "seconds": time.monotonic() - t0,
+             "checks": [c.name for c in checkers],
+             "cache_hits": max(hits, 0), "cache_misses": len(changed)}
+    findings.sort(key=lambda f: (f.path, f.line, f.check))
+    return findings, stats

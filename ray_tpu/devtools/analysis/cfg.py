@@ -1,0 +1,360 @@
+"""Flow-sensitive exit-path analysis for the paired-effect family.
+
+Not a literal control-flow graph: an abstract interpreter over the
+statement tree.  A checker classifies interesting call sites as *events*
+(``+1`` forward effect / ``-1`` reversal, keyed by an opaque token such as
+``("acquire_slot", "lane.req")``); :func:`function_exits` then walks every
+explicit control path through the function — branches, loops,
+``try/except/finally``, ``with``, early ``return``/``raise``/``break`` —
+and reports, per exit, how many forward effects are still pending.
+
+Modelling decisions (all favour under-reporting, the analyzer's bias):
+
+* States merge at join points and saturate (pending caps at
+  :data:`MAX_PENDING`, at most :data:`MAX_STATES` abstract states per
+  program point), so path count never explodes.
+* Loops are evaluated twice (zero, one and two iterations are
+  distinguished; more iterations only re-saturate).
+* Events in a ``for`` statement's iterator are charged *per iteration*:
+  ``for slot in chan.read_ready(n): ...`` models the drain idiom where
+  each drained item carries its own obligation.  The zero-iteration path
+  consequently carries no event — a deliberate under-report.
+* A forward event appearing in a ``with`` item is auto-reversed when the
+  block is left *by any path* (context managers run ``__exit__`` on
+  exceptions too).
+* ``finally`` bodies are re-run against every exit that crosses them, so
+  a reversal in ``finally`` covers all paths.
+* Implicit exception edges (any call may raise) are **not** modelled;
+  only explicit ``raise`` statements produce raise exits.  Leaks that
+  need a mid-path exception to manifest are out of scope — use
+  ``try/finally`` and the analyzer will verify it.
+* Nested ``def``/``lambda`` bodies do not execute here and are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Hashable, Iterator, List, Optional, Tuple
+
+#: saturation bound for pending forward effects on one token
+MAX_PENDING = 3
+#: abstract-state cap per program point (overflow keeps the first N)
+MAX_STATES = 24
+
+Token = Hashable
+#: token -> (pending forward effects, reversal seen on a normal path)
+State = Dict[Token, Tuple[int, bool]]
+Events = Dict[int, List[Tuple[Token, int]]]  # id(ast.Call) -> deltas
+
+
+@dataclass(frozen=True)
+class ExitPath:
+    """One (exit site, abstract state) pair."""
+
+    kind: str          # "return" | "raise" | "fallthrough"
+    line: int
+    in_handler: bool   # exit happens inside an except handler
+    state: Tuple[Tuple[Token, Tuple[int, bool]], ...]
+
+    def pending(self, token: Token) -> int:
+        return dict(self.state).get(token, (0, False))[0]
+
+    def saw_normal_reverse(self, token: Token) -> bool:
+        return dict(self.state).get(token, (0, False))[1]
+
+
+@dataclass
+class _BlockResult:
+    normal: List[State]
+    breaks: List[State]
+    continues: List[State]
+    exits: List[ExitPath]
+
+
+def _dedupe(states: List[State]) -> List[State]:
+    seen, out = set(), []
+    for st in states:
+        key = frozenset(st.items())
+        if key not in seen:
+            seen.add(key)
+            out.append(st)
+        if len(out) >= MAX_STATES:
+            break
+    return out
+
+
+class _Interp:
+    def __init__(self, events: Events):
+        self.events = events
+
+    # ------------------------------------------------------------- events
+    def _expr_events(self, nodes) -> List[Tuple[Token, int]]:
+        out: List[Tuple[Token, int]] = []
+        stack = list(nodes)
+        while stack:
+            n = stack.pop(0)
+            if isinstance(n, ast.Lambda):
+                continue  # deferred body: does not run here
+            ev = self.events.get(id(n))
+            if ev:
+                out.extend(ev)
+            stack.extend(ast.iter_child_nodes(n))
+        return out
+
+    def _stmt_header_events(self, stmt) -> List[Tuple[Token, int]]:
+        """Events in the statement's own expressions (child statements are
+        walked recursively by the block walker, not here)."""
+        if isinstance(stmt, (ast.If, ast.While)):
+            nodes = [stmt.test]
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            nodes = []  # iterator events are charged per-iteration
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            nodes = []  # with-item events handled by _walk_with
+        elif isinstance(stmt, ast.Try):
+            nodes = []
+        else:
+            nodes = [c for c in ast.iter_child_nodes(stmt)
+                     if isinstance(c, ast.expr)]
+        return self._expr_events(nodes)
+
+    @staticmethod
+    def _apply(state: State, evs, in_handler: bool) -> State:
+        if not evs:
+            return state
+        st = dict(state)
+        for token, delta in evs:
+            pending, saw = st.get(token, (0, False))
+            if delta > 0:
+                pending = min(pending + delta, MAX_PENDING)
+            else:
+                pending = max(pending + delta, 0)
+                if not in_handler:
+                    saw = True
+            st[token] = (pending, saw)
+        return st
+
+    def _apply_all(self, states, evs, in_handler) -> List[State]:
+        if not evs:
+            return list(states)
+        return _dedupe([self._apply(s, evs, in_handler) for s in states])
+
+    # ------------------------------------------------------------- blocks
+    def walk_block(self, stmts, states, in_handler,
+                   boundaries: Optional[List[State]] = None) -> _BlockResult:
+        """``boundaries`` (when given) collects the abstract states at the
+        *entry* of every statement — the try-body walker uses the union as
+        the except-handler entry states.  Deliberately not the post-state
+        of the raising statement itself: when ``fd = os.open(...)`` raises,
+        the fd never existed, so the handler must not inherit its forward
+        effect (effects buried mid-expression before the raise are missed —
+        the usual under-reporting trade)."""
+        normal = _dedupe(list(states))
+        breaks: List[State] = []
+        continues: List[State] = []
+        exits: List[ExitPath] = []
+        for stmt in stmts:
+            if not normal:
+                break  # unreachable: every path already left the block
+            if boundaries is not None:
+                boundaries.extend(normal)
+            r = self.walk_stmt(stmt, normal, in_handler)
+            normal = _dedupe(r.normal)
+            breaks.extend(r.breaks)
+            continues.extend(r.continues)
+            exits.extend(r.exits)
+        return _BlockResult(normal, _dedupe(breaks), _dedupe(continues),
+                            exits)
+
+    # --------------------------------------------------------- statements
+    def walk_stmt(self, stmt, states, in_handler) -> _BlockResult:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return _BlockResult(list(states), [], [], [])
+        if isinstance(stmt, ast.Return):
+            after = self._apply_all(states, self._stmt_header_events(stmt),
+                                    in_handler)
+            return _BlockResult([], [], [], [
+                ExitPath("return", stmt.lineno, in_handler,
+                         tuple(sorted(st.items(), key=repr)))
+                for st in after])
+        if isinstance(stmt, ast.Raise):
+            after = self._apply_all(states, self._stmt_header_events(stmt),
+                                    in_handler)
+            return _BlockResult([], [], [], [
+                ExitPath("raise", stmt.lineno, in_handler,
+                         tuple(sorted(st.items(), key=repr)))
+                for st in after])
+        if isinstance(stmt, ast.Break):
+            return _BlockResult([], list(states), [], [])
+        if isinstance(stmt, ast.Continue):
+            return _BlockResult([], [], list(states), [])
+        if isinstance(stmt, ast.If):
+            base = self._apply_all(states, self._expr_events([stmt.test]),
+                                   in_handler)
+            rb = self.walk_block(stmt.body, base, in_handler)
+            ro = self.walk_block(stmt.orelse, base, in_handler)
+            return _BlockResult(rb.normal + ro.normal,
+                                rb.breaks + ro.breaks,
+                                rb.continues + ro.continues,
+                                rb.exits + ro.exits)
+        if isinstance(stmt, ast.While):
+            return self._walk_loop(stmt, states, in_handler,
+                                   test_events=self._expr_events([stmt.test]),
+                                   iter_events=[])
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            return self._walk_loop(stmt, states, in_handler, test_events=[],
+                                   iter_events=self._expr_events([stmt.iter]))
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._walk_with(stmt, states, in_handler)
+        if isinstance(stmt, ast.Try):
+            return self._walk_try(stmt, states, in_handler)
+        after = self._apply_all(states, self._stmt_header_events(stmt),
+                                in_handler)
+        return _BlockResult(after, [], [], [])
+
+    def _walk_loop(self, stmt, states, in_handler, test_events,
+                   iter_events) -> _BlockResult:
+        def one_iteration(entry):
+            body_entry = self._apply_all(entry, iter_events, in_handler)
+            return self.walk_block(stmt.body, body_entry, in_handler)
+
+        zero = self._apply_all(states, test_events, in_handler)
+        r1 = one_iteration(zero)
+        again = self._apply_all(r1.normal + r1.continues, test_events,
+                                in_handler)
+        r2 = one_iteration(again)
+        # ``orelse`` runs only when the loop finishes WITHOUT break — break
+        # states jump straight past it.  The distinction matters: the
+        # retry-loop idiom releases in the handler and raises exhaustion
+        # from the else clause, so break-path pending must not bleed in.
+        no_break = _dedupe(zero
+                           + self._apply_all(r1.normal + r1.continues,
+                                             test_events, in_handler)
+                           + self._apply_all(r2.normal + r2.continues,
+                                             test_events, in_handler))
+        broke = r1.breaks + r2.breaks
+        ro = self.walk_block(stmt.orelse, no_break, in_handler) \
+            if stmt.orelse else _BlockResult(no_break, [], [], [])
+        return _BlockResult(_dedupe(ro.normal + broke), ro.breaks,
+                            ro.continues, r1.exits + r2.exits + ro.exits)
+
+    def _walk_with(self, stmt, states, in_handler) -> _BlockResult:
+        item_events = self._expr_events(
+            [i.context_expr for i in stmt.items])
+        base = self._apply_all(states, item_events, in_handler)
+        held = [(token, -1) for token, delta in item_events if delta > 0]
+        r = self.walk_block(stmt.body, base, in_handler)
+        if not held:
+            return r
+        # __exit__ runs on every way out of the block, exceptions included.
+        normal = self._apply_all(r.normal, held, in_handler)
+        breaks = self._apply_all(r.breaks, held, in_handler)
+        continues = self._apply_all(r.continues, held, in_handler)
+        exits = [
+            ExitPath(e.kind, e.line, e.in_handler, tuple(sorted(
+                self._apply(dict(e.state), held, e.in_handler).items(),
+                key=repr)))
+            for e in r.exits]
+        return _BlockResult(normal, breaks, continues, exits)
+
+    def _walk_try(self, stmt, states, in_handler) -> _BlockResult:
+        boundaries: List[State] = []
+        rb = self.walk_block(stmt.body, states, in_handler,
+                             boundaries=boundaries)
+        handler_entry = _dedupe(boundaries)
+        h_normal: List[State] = []
+        h_breaks: List[State] = []
+        h_continues: List[State] = []
+        h_exits: List[ExitPath] = []
+        for handler in stmt.handlers:
+            rh = self.walk_block(handler.body, handler_entry, True)
+            h_normal.extend(rh.normal)
+            h_breaks.extend(rh.breaks)
+            h_continues.extend(rh.continues)
+            h_exits.extend(rh.exits)
+        ro = self.walk_block(stmt.orelse, rb.normal, in_handler) \
+            if stmt.orelse else _BlockResult(rb.normal, [], [], [])
+        normal = ro.normal + h_normal
+        breaks = rb.breaks + ro.breaks + h_breaks
+        continues = rb.continues + ro.continues + h_continues
+        exits = rb.exits + ro.exits + h_exits
+        if not stmt.finalbody:
+            return _BlockResult(normal, breaks, continues, exits)
+
+        extra_exits: List[ExitPath] = []
+
+        def through_finally(sts, handler_flag):
+            rf = self.walk_block(stmt.finalbody, sts, handler_flag)
+            extra_exits.extend(rf.exits)
+            return rf.normal, rf.breaks, rf.continues
+
+        normal, f_breaks, f_continues = through_finally(normal, in_handler)
+        out_breaks, out_continues = list(f_breaks), list(f_continues)
+        for sts, sink in ((breaks, out_breaks), (continues, out_continues)):
+            for st in sts:
+                n, b, c = through_finally([st], in_handler)
+                sink.extend(n)
+                out_breaks.extend(b)
+                out_continues.extend(c)
+        new_exits: List[ExitPath] = []
+        for e in exits:
+            n, b, c = through_finally([dict(e.state)], e.in_handler)
+            out_breaks.extend(b)
+            out_continues.extend(c)
+            for st in n:
+                new_exits.append(ExitPath(
+                    e.kind, e.line, e.in_handler,
+                    tuple(sorted(st.items(), key=repr))))
+        return _BlockResult(_dedupe(normal), _dedupe(out_breaks),
+                            _dedupe(out_continues), new_exits + extra_exits)
+
+
+def function_exits(fn, events: Events) -> List[ExitPath]:
+    """Every explicit exit of ``fn`` (returns, raises, and the final
+    fallthrough) with its abstract pair-effect state."""
+    interp = _Interp(events)
+    r = interp.walk_block(fn.body, [{}], in_handler=False)
+    exits = list(r.exits)
+    end = getattr(fn, "end_lineno", None) or fn.lineno
+    for st in r.normal:
+        exits.append(ExitPath("fallthrough", end, False,
+                              tuple(sorted(st.items(), key=repr))))
+    return exits
+
+
+def iter_functions(tree) -> Iterator[Tuple[str, ast.AST, Optional[str]]]:
+    """Yield ``(symbol, fn_node, class_name)`` for every function in a
+    module — methods as "Class.method", nested defs as "outer.inner"."""
+
+    def walk(body, prefix: str, cls: Optional[str]):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                symbol = f"{prefix}{stmt.name}" if prefix else stmt.name
+                yield symbol, stmt, cls
+                yield from walk(stmt.body, symbol + ".", None)
+            elif isinstance(stmt, ast.ClassDef):
+                yield from walk(stmt.body, stmt.name + ".", stmt.name)
+            else:
+                for attr in ("body", "orelse", "finalbody"):
+                    child = getattr(stmt, attr, None)
+                    if child:
+                        yield from walk(child, prefix, cls)
+                for handler in getattr(stmt, "handlers", ()) or ():
+                    yield from walk(handler.body, prefix, cls)
+
+    yield from walk(tree.body, "", None)
+
+
+def calls_in_function(fn) -> Iterator[ast.Call]:
+    """Every call executed by ``fn`` itself — nested ``def``/``lambda``
+    bodies excluded (they run later, on their own schedule)."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop(0)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))

@@ -16,8 +16,17 @@ from ray_tpu.devtools.analysis.checkers.lock_discipline import (
     LockDisciplineChecker,
 )
 from ray_tpu.devtools.analysis.checkers.lockstep import LockstepChecker
+from ray_tpu.devtools.analysis.checkers.paired_effect import (
+    PairedEffectChecker,
+)
 from ray_tpu.devtools.analysis.checkers.registry_consistency import (
     RegistryConsistencyChecker,
+)
+from ray_tpu.devtools.analysis.checkers.task_lifecycle import (
+    TaskLifecycleChecker,
+)
+from ray_tpu.devtools.analysis.checkers.thread_ownership import (
+    ThreadOwnershipChecker,
 )
 
 ALL_CHECKERS: List[Type[core.Checker]] = [
@@ -26,6 +35,9 @@ ALL_CHECKERS: List[Type[core.Checker]] = [
     BlockingChecker,
     RegistryConsistencyChecker,
     LockstepChecker,
+    PairedEffectChecker,
+    TaskLifecycleChecker,
+    ThreadOwnershipChecker,
 ]
 
 CHECKERS_BY_NAME: Dict[str, Type[core.Checker]] = {
@@ -49,4 +61,5 @@ __all__ = [
     "ALL_CHECKERS", "CHECKERS_BY_NAME", "make_checkers",
     "LockDisciplineChecker", "AtomicityChecker", "BlockingChecker",
     "RegistryConsistencyChecker", "LockstepChecker",
+    "PairedEffectChecker", "TaskLifecycleChecker", "ThreadOwnershipChecker",
 ]

@@ -1,0 +1,192 @@
+"""Paired-effect leak detection (flow-sensitive, cfg.py-based).
+
+The shape behind the worst review bugs of PRs 7/8/11: a *forward* effect
+(slot acquired, inflight counter bumped, blocks allocated, sample
+claimed) executes, and some exit path leaves the function without the
+matching *reversal*.  The checker classifies call sites into
+forward/reverse events per receiver and asks :func:`cfg.function_exits`
+whether any explicit exit still has a pending forward effect.
+
+Two strictness tiers:
+
+* **Built-in pairs** (table below) are heuristics, so they use a lenient
+  rule: a function is only flagged when at least one *normal* exit path
+  (outside any except handler) does perform the reversal — proof the
+  author intends same-function pairing — while another path leaks.
+  Functions that never reverse on a normal path are treated as ownership
+  transfer (``submit()`` hands its slot to the drain loop) and skipped;
+  a reversal only inside an ``except`` handler is undo-on-error, not
+  same-function pairing.
+* **Declared pairs** are contracts and checked strictly on every path:
+  ``# pairs_with: <reverse>`` on a ``def`` line binds every call of that
+  method; on a call line it binds that site only.  For an annotated call
+  assigned to a plain name (``table = BlockTable(alloc)``), the reversal
+  may be a method on the assignment target (``table.release()``).
+
+``finally`` and ``with`` reversal cover all paths (see cfg.py); suppress
+an individual finding with ``# analysis: ignore[paired-effect] reason``
+on the forward-call line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterator, List, Optional, Tuple
+
+from .. import cfg
+from ..core import AnalysisContext, Checker, Finding, SourceModule
+
+#: forward method name -> acceptable reversal names (lenient tier)
+BUILTIN_PAIRS: Dict[str, FrozenSet[str]] = {
+    "acquire_slot": frozenset({"release_slot"}),
+    "on_request_sent": frozenset({"on_request_done"}),
+    "allocate": frozenset({"free"}),
+    "reserve": frozenset({"release"}),
+    "claim": frozenset({"seal", "seal_all", "rollback", "retag"}),
+    "track": frozenset({"untrack"}),
+    "begin": frozenset({"end"}),
+    "open": frozenset({"close"}),
+    # Gauge-style counters: only paired when the same receiver is also
+    # .dec()ed somewhere in the function (Counter.inc is monotonic and
+    # must never be "reversed").
+    "inc": frozenset({"dec"}),
+}
+
+_DECLARED_KEY = "paired-effect:declared"
+
+
+def _call_name_receiver(call: ast.Call) -> Tuple[str, Optional[str]]:
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        return func.attr, ast.unparse(func.value)
+    if isinstance(func, ast.Name):
+        return func.id, None
+    return "", None
+
+
+def _parse_reverses(value: str) -> FrozenSet[str]:
+    return frozenset(n.strip() for n in value.split(",") if n.strip())
+
+
+def _assign_targets(fn) -> Dict[int, str]:
+    """id(call) -> plain-name assignment target, for ``x = Call(...)``."""
+    out: Dict[int, str] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            value = node.value
+            if isinstance(value, ast.Await):
+                value = value.value
+            if isinstance(value, ast.Call):
+                out[id(value)] = node.targets[0].id
+    return out
+
+
+class _Token:
+    __slots__ = ("key", "forward", "match_key", "reverses", "strict", "line")
+
+    def __init__(self, forward: str, match_key: str,
+                 reverses: FrozenSet[str], strict: bool, line: int):
+        self.key = (forward, match_key)
+        self.forward = forward
+        self.match_key = match_key
+        self.reverses = reverses
+        self.strict = strict
+        self.line = line
+
+
+class PairedEffectChecker(Checker):
+    name = "paired-effect"
+    description = ("forward effect (acquire/allocate/claim/...) with no "
+                   "reversal dominating every exit path")
+
+    # ------------------------------------------------------------ collect
+    def collect(self, module: SourceModule, ctx: AnalysisContext) -> None:
+        declared = ctx.scratch.setdefault(_DECLARED_KEY, {})
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                value = module.marker_near(node.lineno, "pairs_with")
+                if value:
+                    declared[node.name] = _parse_reverses(value)
+
+    # ------------------------------------------------------------- checks
+    def check_module(self, module: SourceModule,
+                     ctx: AnalysisContext) -> Iterator[Finding]:
+        declared: Dict[str, FrozenSet[str]] = ctx.scratch.get(
+            _DECLARED_KEY, {})
+        for symbol, fn, _cls in cfg.iter_functions(module.tree):
+            yield from self._check_function(module, symbol, fn, declared)
+
+    def _check_function(self, module: SourceModule, symbol: str, fn,
+                        declared) -> Iterator[Finding]:
+        calls = list(cfg.calls_in_function(fn))
+        if not calls:
+            return
+        targets = None
+        tokens: Dict[Tuple[str, str], _Token] = {}
+        events: cfg.Events = {}
+        # Pass 1: forward effects establish tokens.
+        for call in calls:
+            fname, receiver = _call_name_receiver(call)
+            if not fname:
+                continue
+            # Exact-line only: ``marker_near`` would misread a def-line
+            # marker as a site obligation for the first body statement.
+            site_value = module.marker(call.lineno, "pairs_with")
+            if site_value:
+                reverses, strict = _parse_reverses(site_value), True
+            elif fname in declared:
+                reverses, strict = declared[fname], True
+            elif fname in BUILTIN_PAIRS and receiver is not None:
+                reverses, strict = BUILTIN_PAIRS[fname], False
+                if fname == "inc" and not any(
+                        _call_name_receiver(c) == ("dec", receiver)
+                        for c in calls):
+                    continue
+            else:
+                continue
+            match_key = receiver
+            if match_key is None:
+                if targets is None:
+                    targets = _assign_targets(fn)
+                match_key = targets.get(id(call))
+                if match_key is None:
+                    continue  # no receiver and no named result to pair on
+            token = tokens.get((fname, match_key))
+            if token is None:
+                token = _Token(fname, match_key, reverses, strict,
+                               call.lineno)
+                tokens[token.key] = token
+            else:
+                token.reverses = token.reverses | reverses
+                token.strict = token.strict or strict
+            events.setdefault(id(call), []).append((token.key, +1))
+        if not tokens:
+            return
+        # Pass 2: reversals matched against established tokens.
+        for call in calls:
+            fname, receiver = _call_name_receiver(call)
+            if not fname or receiver is None:
+                continue
+            for token in tokens.values():
+                if fname in token.reverses and receiver == token.match_key:
+                    events.setdefault(id(call), []).append((token.key, -1))
+        exits = cfg.function_exits(fn, events)
+        for token in tokens.values():
+            leaks = [e for e in exits if e.pending(token.key) > 0]
+            if not leaks:
+                continue
+            if not token.strict and not any(
+                    not e.in_handler and e.saw_normal_reverse(token.key)
+                    and e.pending(token.key) == 0 for e in exits):
+                continue  # ownership transfer / undo-on-error idiom
+            worst = min(leaks, key=lambda e: e.line)
+            reverses = "/".join(sorted(token.reverses))
+            yield Finding(
+                check=self.name, path=module.path, line=token.line,
+                symbol=symbol,
+                message=(f"'{token.match_key}.{token.forward}' has no "
+                         f"{reverses} on the {worst.kind} path at line "
+                         f"{worst.line} ({len(leaks)} of {len(exits)} exit "
+                         f"paths leak)"),
+                detail=f"{token.forward}:{token.match_key}")

@@ -1,0 +1,148 @@
+"""Async-task lifecycle: created tasks must be awaited or cancelled.
+
+The PR 11 ``_actor_async_loop`` bug class: ``asyncio.create_task`` /
+``ensure_future`` results that nobody awaits or cancels are abandoned
+when the loop dies — their refs stay forever unresolved and every caller
+blocked on them hangs.  The checker recognises these retention shapes:
+
+* bare ``create_task(...)`` expression — fire-and-forget, flagged unless
+  the line carries ``# detached_ok: <reason>``;
+* ``t = create_task(...)`` — ``t`` must be awaited, ``.cancel()``ed,
+  or handed to ``gather``/``wait``/``wait_for``/``shield``/
+  ``as_completed`` somewhere in the function;
+* ``self._t = create_task(...)`` — same search over the whole class
+  (the canonical "loop task stored on the instance" layout);
+* ``tasks = [ensure_future(...) for ...]`` — the container name is
+  checked instead (the long-poll fan-out shape).
+
+Anything fancier (task stored in a dict, returned to the caller) is
+deliberately not flagged — the checker under-reports rather than guess.
+``# detached_ok:`` requires a reason, same as ``blocking_ok``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .. import cfg
+from ..core import AnalysisContext, Checker, Finding, SourceModule
+
+_CREATORS = frozenset({"create_task", "ensure_future"})
+_CONSUMER_FUNCS = frozenset({
+    "gather", "wait", "wait_for", "shield", "as_completed"})
+_CONSUMER_METHODS = frozenset({"cancel", "add_done_callback", "result"})
+
+
+def _creator_call(node: ast.Call) -> Optional[str]:
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in _CREATORS:
+        return func.attr
+    if isinstance(func, ast.Name) and func.id in _CREATORS:
+        return func.id
+    return None
+
+
+def _consumed(scope: ast.AST, name_text: str) -> bool:
+    """True when ``name_text`` (a task or container of tasks) is awaited,
+    cancelled, or passed to an asyncio consumer anywhere in ``scope``."""
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Await) \
+                and ast.unparse(node.value) == name_text:
+            return True
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _CONSUMER_METHODS \
+                    and ast.unparse(func.value) == name_text:
+                return True
+            fname = func.attr
+        elif isinstance(func, ast.Name):
+            fname = func.id
+        else:
+            continue
+        if fname in _CONSUMER_FUNCS:
+            for arg in node.args:
+                inner = arg.value if isinstance(arg, ast.Starred) else arg
+                if ast.unparse(inner) == name_text:
+                    return True
+                if isinstance(inner, (ast.List, ast.Tuple, ast.Set)) and any(
+                        ast.unparse(e) == name_text for e in inner.elts):
+                    return True
+    return False
+
+
+class TaskLifecycleChecker(Checker):
+    name = "task-lifecycle"
+    description = ("asyncio task created but never awaited/cancelled "
+                   "(fire-and-forget needs # detached_ok: reason)")
+
+    def check_module(self, module: SourceModule,
+                     ctx: AnalysisContext) -> Iterator[Finding]:
+        class_nodes = {n.name: n for n in ast.walk(module.tree)
+                       if isinstance(n, ast.ClassDef)}
+        for symbol, fn, cls in cfg.iter_functions(module.tree):
+            for call in cfg.calls_in_function(fn):
+                kind = _creator_call(call)
+                if kind is None:
+                    continue
+                if module.marker_near(call.lineno, "detached_ok"):
+                    continue
+                coro = ast.unparse(call.args[0])[:60] if call.args else "?"
+                finding = Finding(
+                    check=self.name, path=module.path, line=call.lineno,
+                    symbol=symbol, message="", detail=f"{kind}:{coro}")
+                stmt = self._enclosing_stmt(fn, call)
+                if isinstance(stmt, ast.Expr) and stmt.value is call:
+                    yield self._msg(finding, f"fire-and-forget {kind}() — "
+                                    "retain and await/cancel the task, or "
+                                    "annotate '# detached_ok: reason'")
+                    continue
+                name, scope = self._retention(stmt, call, fn, cls,
+                                              class_nodes)
+                if name is None:
+                    continue  # unrecognised retention: under-report
+                if not _consumed(scope, name):
+                    where = ("anywhere in the class" if scope is not fn
+                             else "in this function")
+                    yield self._msg(finding, f"task '{name}' from {kind}() "
+                                    f"is never awaited or cancelled {where}")
+
+    # ------------------------------------------------------------ helpers
+    @staticmethod
+    def _enclosing_stmt(fn, call) -> Optional[ast.stmt]:
+        found = None
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                continue
+            if isinstance(node, ast.stmt) and any(
+                    sub is call for sub in ast.walk(node)):
+                found = node  # walk is breadth-first: last hit is innermost
+        return found
+
+    @staticmethod
+    def _retention(stmt, call, fn, cls, class_nodes):
+        """(tracked name, search scope) or (None, None)."""
+        if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+            return None, None
+        target = stmt.targets[0]
+        value = stmt.value
+        direct = value is call or (
+            isinstance(value, (ast.ListComp, ast.SetComp))
+            and any(sub is call for sub in ast.walk(value)))
+        if not direct:
+            return None, None
+        if isinstance(target, ast.Name):
+            return target.id, fn
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self" and cls in class_nodes:
+            return f"self.{target.attr}", class_nodes[cls]
+        return None, None
+
+    def _msg(self, finding: Finding, message: str) -> Finding:
+        return Finding(check=finding.check, path=finding.path,
+                       line=finding.line, symbol=finding.symbol,
+                       message=message, detail=finding.detail)

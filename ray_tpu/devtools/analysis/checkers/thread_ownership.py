@@ -1,0 +1,155 @@
+"""Cross-thread ownership: ``# owned_by_thread:`` attribute annotations.
+
+The PR 8-review ``_ShardTracker`` shape: state written by a spawned pump
+thread and read (or worse, mutated) from the consumer thread with no
+lock.  ``# owned_by_thread: <owner>`` on an attribute assignment declares
+which thread owns the attribute:
+
+* When ``<owner>`` names a method of the class, that method must actually
+  be spawned as a thread entry (``threading.Thread(target=self.<owner>)``
+  — detected by ``core.collect_thread_targets``; a stale annotation is
+  itself a finding).  The owner set is the entry method plus the private
+  helpers reachable from it through ``self.*()`` calls; any access to the
+  attribute from outside that set, without a lock held, is flagged.
+* When ``<owner>`` is a free-form label ("worker thread", "event loop"),
+  ownership is enforced externally; the checker only flags accesses from
+  methods this class *does* spawn as thread entries — those provably run
+  on a different thread.
+
+``__init__`` is exempt (construction happens before any thread exists),
+and an access under any held lock (``with self._lock:`` — reuse of the
+locks.py scan) is always allowed.  Fully lock-guarded state should use
+``guarded_by:`` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Set
+
+from ..core import (AnalysisContext, Checker, Finding, SourceModule,
+                    _assign_names, collect_guards, collect_thread_targets)
+from ..locks import iter_function_scans
+
+
+def _owned_attrs(module: SourceModule, cls: ast.ClassDef) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        for target in _assign_names(node):
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                owner = module.marker(node.lineno, "owned_by_thread")
+                if owner:
+                    out[target.attr] = owner
+    return out
+
+
+def _self_call_graph(cls: ast.ClassDef) -> Dict[str, Set[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for fn in cls.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        callees: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self":
+                callees.add(node.func.attr)
+        graph[fn.name] = callees
+    return graph
+
+
+def _owner_closure(entry: str, graph: Dict[str, Set[str]]) -> Set[str]:
+    """Entry method plus private helpers transitively reachable from it —
+    the methods assumed to run on the owner thread."""
+    closure, frontier = {entry}, [entry]
+    while frontier:
+        for callee in graph.get(frontier.pop(), ()):
+            if callee in graph and callee.startswith("_") \
+                    and callee not in closure:
+                closure.add(callee)
+                frontier.append(callee)
+    return closure
+
+
+class ThreadOwnershipChecker(Checker):
+    name = "thread-ownership"
+    description = ("# owned_by_thread: attribute accessed from a method "
+                   "running on a different thread without a lock")
+
+    def check_module(self, module: SourceModule,
+                     ctx: AnalysisContext) -> Iterator[Finding]:
+        spawned = collect_thread_targets(module)
+        owned_by_class: Dict[str, Dict[str, str]] = {}
+        graphs: Dict[str, Dict[str, Set[str]]] = {}
+        for cls in ast.walk(module.tree):
+            if isinstance(cls, ast.ClassDef):
+                owned = _owned_attrs(module, cls)
+                if owned:
+                    owned_by_class[cls.name] = owned
+                    graphs[cls.name] = _self_call_graph(cls)
+        if not owned_by_class:
+            return
+        # Stale annotations: a method-name owner that is never spawned.
+        for cls_name, owned in owned_by_class.items():
+            for attr, owner in owned.items():
+                if owner in graphs[cls_name] \
+                        and owner not in spawned.get(cls_name, ()):
+                    yield Finding(
+                        check=self.name, path=module.path,
+                        line=self._attr_line(module, cls_name, attr),
+                        symbol=cls_name,
+                        message=(f"'{attr}' is owned_by_thread '{owner}' "
+                                 f"but {cls_name} never spawns a thread "
+                                 f"with that target"),
+                        detail=f"{attr}:unspawned:{owner}")
+        guards = collect_guards(module)
+        for scan in iter_function_scans(module.tree,
+                                        guards.requires_lock):
+            parts = scan.symbol.split(".")
+            cls_name = parts[0] if len(parts) > 1 else None
+            if cls_name not in owned_by_class:
+                continue
+            method = parts[1]
+            if method in ("__init__", "__new__", "__del__"):
+                continue
+            owned = owned_by_class[cls_name]
+            graph = graphs[cls_name]
+            entries = spawned.get(cls_name, set())
+            for access in scan.accesses:
+                if access.owner != "self" or access.name not in owned:
+                    continue
+                if access.held:
+                    continue  # a lock serialises the access
+                owner = owned[access.name]
+                if owner in graph:
+                    allowed = _owner_closure(owner, graph)
+                    # An unspawned owner already produced its own finding;
+                    # don't cascade per-access noise on top.
+                    bad = owner in entries and method not in allowed
+                else:
+                    bad = method in entries
+                if bad:
+                    yield Finding(
+                        check=self.name, path=module.path,
+                        line=access.line, symbol=scan.symbol,
+                        message=(f"'{access.name}' is owned by thread "
+                                 f"'{owner}' but is "
+                                 f"{'written' if access.write else 'read'} "
+                                 f"from {scan.symbol} with no lock held"),
+                        detail=f"{access.name}:{method}")
+
+    @staticmethod
+    def _attr_line(module: SourceModule, cls_name: str, attr: str) -> int:
+        for cls in ast.walk(module.tree):
+            if isinstance(cls, ast.ClassDef) and cls.name == cls_name:
+                for node in ast.walk(cls):
+                    for target in _assign_names(node):
+                        if (isinstance(target, ast.Attribute)
+                                and target.attr == attr
+                                and module.marker(node.lineno,
+                                                  "owned_by_thread")):
+                            return node.lineno
+        return 1

@@ -17,6 +17,19 @@ Annotation conventions (see docs/static-analysis.md):
                               names get this implicitly).
   ``# blocking_ok: reason``   suppress a blocking-in-handler finding.
   ``# lockstep_ok: reason``   suppress a collective-divergence finding.
+  ``# pairs_with: name``      on a ``def`` line: every call to this method
+                              must be reversed by ``name`` on the same
+                              receiver before every exit (strict).  On a
+                              call line: that call site carries the same
+                              obligation (the reverse may also match the
+                              call's assignment target).
+  ``# detached_ok: reason``   on an ``asyncio.create_task``/``ensure_future``
+                              line: the task is intentionally unawaited.
+  ``# owned_by_thread: name`` on an attribute assignment: the attribute is
+                              owned by the thread running method ``name``
+                              (or an external thread when ``name`` is not a
+                              method) — cross-thread access without a lock
+                              is flagged.
   ``# analysis: ignore[check-id] reason``
                               suppress any finding on that line.
 
@@ -38,7 +51,8 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
 
 _MARKER_RE = re.compile(
-    r"#\s*(guarded_by|requires_lock|blocking_ok|lockstep_ok)\s*:\s*(\S[^#]*)")
+    r"#\s*(guarded_by|requires_lock|blocking_ok|lockstep_ok"
+    r"|pairs_with|detached_ok|owned_by_thread)\s*:\s*(\S[^#]*)")
 _IGNORE_RE = re.compile(r"#\s*analysis:\s*ignore\[([a-z0-9_,\- ]+)\]")
 
 
@@ -167,6 +181,48 @@ def collect_guards(module: SourceModule) -> GuardMap:
     return guards
 
 
+def _thread_target_name(call: ast.Call) -> Optional[str]:
+    """``self._pump`` -> "_pump" for ``threading.Thread(target=self._pump)``
+    and ``threading.Timer(delay, self._fire)``; None otherwise."""
+    func = call.func
+    name = func.attr if isinstance(func, ast.Attribute) else (
+        func.id if isinstance(func, ast.Name) else None)
+    if name not in ("Thread", "Timer"):
+        return None
+    target: Optional[ast.expr] = None
+    for kw in call.keywords:
+        if kw.arg in ("target", "function"):
+            target = kw.value
+    if target is None and name == "Timer" and len(call.args) >= 2:
+        target = call.args[1]
+    if (isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"):
+        return target.attr
+    return None
+
+
+def collect_thread_targets(module: SourceModule) -> Dict[str, Set[str]]:
+    """class name -> method names spawned as thread entry points anywhere in
+    that class (``threading.Thread(target=self._x)`` / ``Timer(.., self._x)``).
+
+    Methods listed here run on their own thread; the cross-thread-ownership
+    checker treats everything else in the class as "some other thread"."""
+    out: Dict[str, Set[str]] = {}
+    for cls in ast.walk(module.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        entries: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call):
+                target = _thread_target_name(node)
+                if target is not None:
+                    entries.add(target)
+        if entries:
+            out[cls.name] = entries
+    return out
+
+
 # ------------------------------------------------------------------ context
 
 @dataclass
@@ -234,6 +290,12 @@ class Checker:
     name: str = ""
     description: str = ""
 
+    def collect(self, module: SourceModule, ctx: AnalysisContext) -> None:
+        """Pre-pass over every module before any ``check_module`` call —
+        lets cross-module declarations (``# pairs_with:`` on a ``def``)
+        reach call sites in other files.  Contributions go in
+        ``ctx.scratch``; must be deterministic and idempotent per module."""
+
     def check_module(self, module: SourceModule,
                      ctx: AnalysisContext) -> Iterator[Finding]:
         raise NotImplementedError
@@ -293,6 +355,8 @@ def analyze_source(text: str, checkers: Sequence[Checker],
     module = SourceModule(path, path, text)
     out: List[Finding] = []
     for checker in checkers:
+        checker.collect(module, ctx)
+    for checker in checkers:
         for finding in checker.check_module(module, ctx):
             if checker.name in module.ignored_checks(finding.line):
                 continue
@@ -333,13 +397,21 @@ def run(paths: Sequence[str], checkers: Sequence[Checker],
     if package_dir is not None:
         load_registries(ctx, package_dir)
 
+    # Two passes: collect (cross-module declarations such as def-site
+    # ``# pairs_with:``) over every module first, then check.  Modules are
+    # parsed once and kept — the package comfortably fits in memory and the
+    # incremental cache (cache.py) depends on the same structure.
     findings: List[Finding] = []
-    parsed = 0
+    modules: List[SourceModule] = []
     for abspath in files:
         module = parse_module(abspath, root)
-        if module is None:
-            continue
-        parsed += 1
+        if module is not None:
+            modules.append(module)
+    parsed = len(modules)
+    for module in modules:
+        for checker in checkers:
+            checker.collect(module, ctx)
+    for module in modules:
         for checker in checkers:
             for finding in checker.check_module(module, ctx):
                 if checker.name in module.ignored_checks(finding.line):

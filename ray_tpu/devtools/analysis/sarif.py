@@ -1,0 +1,65 @@
+"""SARIF 2.1.0 serialisation of analyzer findings.
+
+``scripts/analyze.py --format sarif`` emits one run with one rule per
+checker, so editors and code-scanning UIs that speak SARIF can ingest
+the analyzer without a custom adapter.  The stable finding key rides in
+``partialFingerprints`` — the same identity the baseline uses."""
+
+from __future__ import annotations
+
+import json
+from typing import List, Sequence
+
+from . import core
+
+SARIF_VERSION = "2.1.0"
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+           "Schemata/sarif-schema-2.1.0.json")
+
+
+def to_sarif(findings: List[core.Finding],
+             checkers: Sequence[core.Checker],
+             baselined_keys: Sequence[str] = ()) -> dict:
+    rules = [{
+        "id": c.name,
+        "shortDescription": {"text": c.description or c.name},
+    } for c in checkers]
+    rule_index = {c.name: i for i, c in enumerate(checkers)}
+    baselined = set(baselined_keys)
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.check,
+            "ruleIndex": rule_index.get(f.check, -1),
+            "level": "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1)},
+                },
+                "logicalLocations": [{"fullyQualifiedName": f.symbol}],
+            }],
+            "partialFingerprints": {"stableKey/v1": f.key},
+            "baselineState": "unchanged" if f.key in baselined else "new",
+        })
+    return {
+        "$schema": _SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "ray_tpu-analysis",
+                "informationUri":
+                    "docs/static-analysis.md",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+
+
+def render_sarif(findings: List[core.Finding],
+                 checkers: Sequence[core.Checker],
+                 baselined_keys: Sequence[str] = ()) -> str:
+    return json.dumps(to_sarif(findings, checkers, baselined_keys),
+                      indent=2, sort_keys=True)

@@ -75,6 +75,7 @@ class _BatchQueue:
         self._loop = asyncio.get_running_loop()
         #: adaptive effective wait; starts at the configured bound
         self.effective_timeout_s = float(cfg["batch_wait_timeout_s"])
+        # detached_ok: consumer loop lives until the replica's event loop dies
         self._task = self._loop.create_task(self._consume_loop())
         self.model_id = model_id
 

@@ -267,12 +267,15 @@ class _Lane:
         self.req = Channel(maxsize=maxsize, name=f"serve-req:{self.rid}",
                            slot_width=SLOT_WIDTH)
         self.resp = Channel(maxsize=64, name=f"serve-resp:{self.rid}")
-        self._fusion: Dict[str, Any] = {}
-        self._expect: Dict[str, int] = {}  # last executed batch size
-        self._exec_tags: Dict[str, dict] = {}
+        # Per-method caches below are touched only from the lane's loop
+        # thread — no locks; the ownership annotations make the analyzer
+        # flag any access that creeps into another thread.
+        self._fusion: Dict[str, Any] = {}  # owned_by_thread: _run_loop
+        self._expect: Dict[str, int] = {}  # owned_by_thread: _run_loop
+        self._exec_tags: Dict[str, dict] = {}  # owned_by_thread: _run_loop
         self._route_attrs = {"deployment": graph.deployment_id,
                              "replica": self.rid}
-        self._task_reprs: Dict[str, str] = {}
+        self._task_reprs: Dict[str, str] = {}  # owned_by_thread: _run_loop
         self._loop_thread = threading.Thread(
             target=self._run_loop, daemon=True,
             name=f"serve-compiled-loop-{self.rid}")
@@ -705,10 +708,14 @@ class _CompiledGraph:
             lane._loop_thread.join(timeout=2.0)
         pending = []
         for lane in self._lanes.values():
-            for slot in lane.req.read_ready(1 << 30):
+            for slot in lane.req.read_ready(1 << 30):  # pairs_with: release_slot
                 self.router._scheduler.on_request_done(lane.rid)
                 pending.append((slot[S_METHOD], slot[S_ARGS], slot[S_KWARGS],
                                 slot[S_MUX], slot[S_RESP]))
+                # A drained slot must go back to the ring like the demux
+                # path does — otherwise every drained request permanently
+                # shrinks the free list and pins its args/response future.
+                lane.req.release_slot(slot)
         if pending:
             t = threading.Thread(
                 target=_redispatch_pending, args=(self.router, pending),

@@ -113,6 +113,7 @@ class _Engine:
                       "method": getattr(step_func, "__name__", "step")}
         self._admit: asyncio.Queue = asyncio.Queue()
         self._loop = asyncio.get_running_loop()
+        # detached_ok: iteration loop lives until the replica's event loop dies
         self._task = self._loop.create_task(self._run())
 
     def submit(self, request: Any) -> SequenceSlot:

@@ -402,3 +402,13 @@ class ServeController:
                     for dep_id, replicas in updates.items()
                 })
             await asyncio.sleep(0.02)
+        # The control loop observes _shutdown only at its next tick; left
+        # alone it would be abandoned mid-sleep when the actor's event loop
+        # dies, with anything awaiting it unresolved.  Cancel and reap it.
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+            self._loop_task = None

@@ -147,7 +147,7 @@ class PrefillWorker(_ModelHostMixin):
         waited = 0.0  # admission-wait: block-headroom backoff, measured
         prefill_dt = 0.0
         for attempt in range(40):
-            table = BlockTable(self._allocator)
+            table = BlockTable(self._allocator)  # pairs_with: release
             t0 = time.time()
             try:
                 with _tracing.span("serve.prefill",
@@ -165,7 +165,7 @@ class PrefillWorker(_ModelHostMixin):
                 t1 = time.time()
                 await asyncio.sleep(0.005 * (attempt + 1))
                 waited += (t1 - t0) + (time.time() - t1)
-        if tok is None:
+        else:  # no break: every attempt released its table and backed off
             raise NoFreeBlocks("prefill pool exhausted after backoff")
         _m.PREFILL_TOKENS.inc(len(context), tags={"pool": "prefill"})
         if resume and _attr.is_enabled():
@@ -179,12 +179,16 @@ class PrefillWorker(_ModelHostMixin):
                                              "pool": "prefill"})
         generated = resume + [tok]
         t_exp = time.time()
-        payload = export_kv(table, prompt=req["prompt"],
-                            generated=generated,
-                            model=req.get("model", "base"),
-                            adapter=req.get("adapter"),
-                            max_tokens=int(req.get("max_tokens", 16)))
-        table.release()
+        try:
+            payload = export_kv(table, prompt=req["prompt"],
+                                generated=generated,
+                                model=req.get("model", "base"),
+                                adapter=req.get("adapter"),
+                                max_tokens=int(req.get("max_tokens", 16)))
+        finally:
+            # Release even when export_kv raises — the prefill pool is
+            # small and a leaked table here starves concurrent prefills.
+            table.release()
         # Measured buckets ride the payload so the frontend can attribute
         # the request-level TTFT it alone can measure.
         payload["attrib"] = {"admission": waited, "prefill": prefill_dt,

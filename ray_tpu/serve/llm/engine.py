@@ -80,7 +80,10 @@ class LLMEngine:
         self._deployment: Optional[str] = None
         #: id(slot) -> (slot, seq): every stream this engine has seen and
         #: not yet retired — reaped on cancellation each iteration.
-        self._tracked: Dict[int, Any] = {}
+        #: Only ``step()`` (the replica's event loop) touches it —
+        #: ``_decode_group`` runs on an executor thread but receives its
+        #: sequences by argument, never through this map.
+        self._tracked: Dict[int, Any] = {}  # owned_by_thread: replica event loop
 
     # --------------------------------------------------------- plumbing
 

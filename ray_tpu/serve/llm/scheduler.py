@@ -152,9 +152,15 @@ class EngineScheduler:
 
     def preempt_seq(self, seq: Sequence) -> None:
         """Evict a specific running sequence: free its blocks, fold its
-        generations into the recompute context, requeue it at the front."""
-        if seq in self.running:
-            self.running.remove(seq)
+        generations into the recompute context, requeue it at the front.
+
+        Idempotent: preempting a sequence that is no longer running
+        (already preempted, finished, or never admitted) is a no-op —
+        otherwise a double preemption would insert the sequence into
+        ``waiting`` twice and it would later be scheduled twice."""
+        if seq not in self.running:
+            return
+        self.running.remove(seq)
         if seq.table is not None:
             seq.table.release()
             seq.table = None

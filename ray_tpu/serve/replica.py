@@ -295,6 +295,7 @@ class ReplicaActor:
                 import asyncio as _aio
 
                 try:
+                    # detached_ok: best-effort generator cleanup, unawaited by design
                     _aio.get_running_loop().create_task(it.aclose())
                 except RuntimeError:  # no running loop (sync tier)
                     _aio.run(it.aclose())

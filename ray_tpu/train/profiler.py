@@ -78,12 +78,16 @@ class StepProfiler:
         #: per-step attribution rows (bounded) — the bench and the state
         #: API read these; each row's buckets sum to its wall.
         self.history: "deque" = deque(maxlen=history_steps)
-        self._step = 0
-        self._step_start: Optional[float] = None
-        self._totals: Dict[str, float] = {b: 0.0 for b in BUCKETS}
-        self._intervals: Dict[str, List[Tuple[float, float]]] = {
+        # Lock-free by thread-local discipline: the profiler is reached
+        # through ``_local`` so every hook site runs on the worker's own
+        # thread — the ownership labels document (and let the analyzer
+        # police) that no spawned thread may touch the step state.
+        self._step = 0  # owned_by_thread: worker thread (thread-local _local)
+        self._step_start: Optional[float] = None  # owned_by_thread: worker thread (thread-local _local)
+        self._totals: Dict[str, float] = {b: 0.0 for b in BUCKETS}  # owned_by_thread: worker thread (thread-local _local)
+        self._intervals: Dict[str, List[Tuple[float, float]]] = {  # owned_by_thread: worker thread (thread-local _local)
             b: [] for b in BUCKETS}
-        self._recent_walls: "deque" = deque(maxlen=_PCTL_WINDOW)
+        self._recent_walls: "deque" = deque(maxlen=_PCTL_WINDOW)  # owned_by_thread: worker thread (thread-local _local)
 
     # ------------------------------------------------------------- config
     def configure(self, *, flops_per_step: Optional[float] = None,

@@ -10,11 +10,20 @@ Usage::
     python scripts/analyze.py --write-baseline ray_tpu/ # snapshot findings
     python scripts/analyze.py --list-checks
     python scripts/analyze.py --only lock-discipline ray_tpu/
+    python scripts/analyze.py --changed-only ray_tpu/  # incremental cache
+    python scripts/analyze.py --fail-on-new ray_tpu/   # pre-commit diff
+    python scripts/analyze.py --format sarif ray_tpu/  # SARIF 2.1.0 to stdout
 
 Exit status: 0 when every finding is baselined (or none), 1 when new
 findings exist, 2 on usage/config errors.  A stale baseline entry (key
 matching nothing) is reported and fails ``--check`` too — the baseline
 must describe reality.
+
+``--changed-only`` memoises per-module results in ``.analysis_cache.json``
+(mtime + sha256 keyed; cross-module aggregate checks always re-run) —
+same findings, incremental cost.  ``--fail-on-new`` is the pre-commit
+shape: implies ``--changed-only``, prints only the delta against the
+baseline ('+' per new finding, '!' per stale entry).
 
 Config (``analysis.cfg`` at the repo root, INI)::
 
@@ -82,6 +91,18 @@ def main(argv=None) -> int:
                          f"repo root)")
     ap.add_argument("--stats", action="store_true",
                     help="print files-scanned / elapsed-time summary")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="incremental mode: reuse cached per-module results "
+                         "for unchanged files (.analysis_cache.json)")
+    ap.add_argument("--cache-file", default=None, metavar="FILE",
+                    help="cache location for --changed-only "
+                         "(default: .analysis_cache.json at the repo root)")
+    ap.add_argument("--fail-on-new", action="store_true",
+                    help="pre-commit mode: print only the delta vs the "
+                         "baseline and fail on new/stale; implies "
+                         "--changed-only")
+    ap.add_argument("--format", choices=("text", "sarif"), default="text",
+                    help="output format (sarif: SARIF 2.1.0 on stdout)")
     args = ap.parse_args(argv)
 
     if args.list_checks:
@@ -100,8 +121,13 @@ def main(argv=None) -> int:
     excludes = _load_config_excludes(config_path)
     checkers = analysis.make_checkers(only=args.only, skip=args.skip)
 
-    findings, stats = analysis.run(paths, checkers, root=_REPO_ROOT,
-                                   exclude=excludes)
+    if args.changed_only or args.fail_on_new:
+        findings, stats = analysis.run_cached(
+            paths, checkers, root=_REPO_ROOT, exclude=excludes,
+            cache_path=args.cache_file)
+    else:
+        findings, stats = analysis.run(paths, checkers, root=_REPO_ROOT,
+                                       exclude=excludes)
 
     baseline_path = args.baseline or os.path.join(_REPO_ROOT,
                                                   DEFAULT_BASELINE)
@@ -120,15 +146,40 @@ def main(argv=None) -> int:
             return 2
     new, baselined, stale = baseline_mod.apply(findings, entries)
 
+    if args.format == "sarif":
+        from ray_tpu.devtools.analysis import sarif as sarif_mod
+        print(sarif_mod.render_sarif(
+            findings, checkers,
+            baselined_keys=[f.key for f in baselined]))
+        return 1 if (new or stale) else 0
+
+    if args.fail_on_new:
+        for f in new:
+            print(f"+ {f.render()}")
+        for e in stale:
+            print(f"! stale baseline entry '{e.key}' matches no finding — "
+                  f"remove it from {baseline_path}")
+        print(f"fail-on-new: {len(new)} new finding(s), {len(stale)} stale "
+              f"baseline entr{'y' if len(stale) == 1 else 'ies'} "
+              f"({stats.get('cache_hits', 0)} cached, "
+              f"{stats.get('cache_misses', 0)} analyzed, "
+              f"{stats['seconds']:.2f}s)")
+        return 1 if (new or stale) else 0
+
     for f in new:
         print(f.render())
     for e in stale:
         print(f"{baseline_path}: stale baseline entry '{e.key}' matches no "
               f"finding — remove it")
     if args.stats or new or stale:
+        cache_note = ""
+        if "cache_hits" in stats:
+            cache_note = (f", {stats['cache_hits']} cached/"
+                          f"{stats['cache_misses']} analyzed")
         print(f"analyze.py: {len(new)} new, {len(baselined)} baselined, "
               f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'} "
-              f"({stats['files']} files, {stats['seconds']:.2f}s)")
+              f"({stats['files']} files, {stats['seconds']:.2f}s"
+              f"{cache_note})")
     return 1 if (new or stale) else 0
 
 

@@ -1,0 +1,152 @@
+"""Invalidation unit tests for the --changed-only analysis cache.
+
+The cache must be invisible except for speed: a warm run replays the
+cold findings exactly; editing a file re-checks it; adding a cross-
+module declaration (``# pairs_with:`` collected in file A, enforced in
+file B) re-checks *everything*; a version/fingerprint skew or corrupt
+cache silently degrades to a full run.
+"""
+
+import json
+import os
+
+from ray_tpu.devtools import analysis
+from ray_tpu.devtools.analysis import cache as cache_mod
+
+CLEAN_A = """\
+class Pool:
+    def claim_x(self):
+        return 1
+
+    def unclaim_x(self):
+        pass
+"""
+
+# Leaks only under a declared claim_x -> unclaim_x contract: claim_x is
+# not a built-in pair name, so without the annotation this is clean.
+USER_B = """\
+class User:
+    def use(self, pool):
+        pool.claim_x()
+        if pool.empty:
+            return None
+        pool.unclaim_x()
+        return 1
+"""
+
+VIOLATION = """\
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # guarded_by: _lock
+
+    def bump(self):
+        self._n += 1
+"""
+
+
+def _write(path, text, bump_mtime=False):
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(text)
+    if bump_mtime:
+        st = os.stat(path)
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+
+
+def _run(root, cache_path):
+    return analysis.run_cached(
+        [str(root)], analysis.make_checkers(), root=str(root),
+        cache_path=str(cache_path))
+
+
+class TestCacheInvalidation:
+    def test_warm_run_identical_and_all_hits(self, tmp_path):
+        _write(tmp_path / "a.py", CLEAN_A)
+        _write(tmp_path / "b.py", VIOLATION)
+        cache = tmp_path / "cache.json"
+        cold, s_cold = _run(tmp_path, cache)
+        warm, s_warm = _run(tmp_path, cache)
+        assert [f.key for f in cold] == [f.key for f in warm]
+        assert len(cold) == 1 and cold[0].check == "lock-discipline"
+        assert s_cold["cache_misses"] == 2
+        assert s_warm["cache_hits"] == 2 and s_warm["cache_misses"] == 0
+
+    def test_edit_recheck_picks_up_new_finding(self, tmp_path):
+        _write(tmp_path / "a.py", CLEAN_A)
+        _write(tmp_path / "b.py", "X = 1\n")
+        cache = tmp_path / "cache.json"
+        cold, _ = _run(tmp_path, cache)
+        assert cold == []
+        _write(tmp_path / "b.py", VIOLATION, bump_mtime=True)
+        warm, stats = _run(tmp_path, cache)
+        assert [f.check for f in warm] == ["lock-discipline"]
+        assert stats["cache_misses"] >= 1
+
+    def test_fix_clears_cached_finding(self, tmp_path):
+        _write(tmp_path / "b.py", VIOLATION)
+        cache = tmp_path / "cache.json"
+        cold, _ = _run(tmp_path, cache)
+        assert len(cold) == 1
+        fixed = VIOLATION.replace("        self._n += 1",
+                                  "        with self._lock:\n"
+                                  "            self._n += 1")
+        _write(tmp_path / "b.py", fixed, bump_mtime=True)
+        warm, _ = _run(tmp_path, cache)
+        assert warm == []
+
+    def test_collect_declaration_invalidates_other_module(self, tmp_path):
+        """A ``# pairs_with:`` added in a.py changes what is a violation
+        in the UNCHANGED b.py — the collect fingerprint must force a full
+        re-check, not just of the edited file."""
+        _write(tmp_path / "a.py", CLEAN_A)
+        _write(tmp_path / "b.py", USER_B)
+        cache = tmp_path / "cache.json"
+        cold, _ = _run(tmp_path, cache)
+        assert cold == []
+        annotated = CLEAN_A.replace(
+            "    def claim_x(self):",
+            "    def claim_x(self):  # pairs_with: unclaim_x")
+        _write(tmp_path / "a.py", annotated, bump_mtime=True)
+        warm, stats = _run(tmp_path, cache)
+        assert [(f.check, f.path.replace(os.sep, "/")) for f in warm] == [
+            ("paired-effect", "b.py")]
+        assert stats["cache_misses"] == 2  # b.py re-checked too
+        # And the new state is itself cacheable.
+        again, s2 = _run(tmp_path, cache)
+        assert [f.key for f in again] == [f.key for f in warm]
+        assert s2["cache_misses"] == 0
+
+    def test_fingerprint_skew_drops_cache(self, tmp_path):
+        _write(tmp_path / "a.py", CLEAN_A)
+        cache = tmp_path / "cache.json"
+        _run(tmp_path, cache)
+        payload = json.loads(cache.read_text())
+        payload["fingerprint"] = "stale-analyzer-build"
+        cache.write_text(json.dumps(payload))
+        _, stats = _run(tmp_path, cache)
+        assert stats["cache_misses"] == 1 and stats["cache_hits"] == 0
+
+    def test_corrupt_cache_degrades_to_full_run(self, tmp_path):
+        _write(tmp_path / "b.py", VIOLATION)
+        cache = tmp_path / "cache.json"
+        cache.write_text("{not json")
+        findings, stats = _run(tmp_path, cache)
+        assert [f.check for f in findings] == ["lock-discipline"]
+        assert stats["cache_misses"] == 1
+
+    def test_mtime_touch_without_content_change_stays_hit(self, tmp_path):
+        _write(tmp_path / "a.py", CLEAN_A)
+        cache = tmp_path / "cache.json"
+        _run(tmp_path, cache)
+        _write(tmp_path / "a.py", CLEAN_A, bump_mtime=True)  # same sha
+        _, stats = _run(tmp_path, cache)
+        assert stats["cache_hits"] == 1 and stats["cache_misses"] == 0
+
+    def test_fingerprint_covers_checker_selection(self):
+        all_fp = cache_mod.analyzer_fingerprint(
+            analysis.make_checkers(), None)
+        some_fp = cache_mod.analyzer_fingerprint(
+            analysis.make_checkers(only=["lock-discipline"]), None)
+        assert all_fp != some_fp

@@ -17,7 +17,10 @@ from ray_tpu.devtools.analysis.checkers import (
     BlockingChecker,
     LockDisciplineChecker,
     LockstepChecker,
+    PairedEffectChecker,
     RegistryConsistencyChecker,
+    TaskLifecycleChecker,
+    ThreadOwnershipChecker,
 )
 
 
@@ -570,3 +573,445 @@ class TestBaseline:
         new, based, stale = baseline.apply([f], entries)
         assert new == [] and based == [f]
         assert [e.key for e in stale] == ["gone:x:y:z"]
+
+
+# --------------------------------------------------------------------------
+# paired-effect (flow-sensitive, cfg.py)
+# --------------------------------------------------------------------------
+
+class TestPairedEffect:
+    def test_builtin_pair_early_return_leak_flagged(self):
+        findings = _run(PairedEffectChecker(), """
+            class C:
+                def handle(self, ch):
+                    slot = ch.acquire_slot()
+                    if self._closed:
+                        return None
+                    ch.release_slot(slot)
+                    return slot
+            """)
+        assert _checks(findings) == [("paired-effect", "acquire_slot:ch")]
+        assert "return path" in findings[0].message
+
+    def test_finally_reversal_covers_all_paths(self):
+        findings = _run(PairedEffectChecker(), """
+            class C:
+                def handle(self, ch):
+                    slot = ch.acquire_slot()
+                    try:
+                        if self._closed:
+                            return None
+                        return self._fill(slot)
+                    finally:
+                        ch.release_slot(slot)
+            """)
+        assert findings == []
+
+    def test_with_statement_reversal_covers_all_paths(self):
+        findings = _run(PairedEffectChecker(), """
+            class C:
+                def scoped(self, pool):
+                    with pool.acquire_slot():
+                        if self._closed:
+                            return None
+                        return 1
+            """)
+        assert findings == []
+
+    def test_ownership_transfer_not_flagged(self):
+        # submit() shape: the slot is handed to the drain loop; the only
+        # release is undo-on-error inside the handler.  The lenient tier
+        # must not demand same-function pairing here.
+        findings = _run(PairedEffectChecker(), """
+            class C:
+                def submit(self, lane):
+                    slot = lane.req.acquire_slot()
+                    slot[0] = "m"
+                    try:
+                        lane.req.write(slot)
+                    except ChannelClosed:
+                        lane.req.release_slot(slot)
+                        return None
+                    return slot
+            """)
+        assert findings == []
+
+    def test_inflight_counter_leak_flagged(self):
+        # The historical router shape: on_request_sent with a handler
+        # return that forgets on_request_done.
+        findings = _run(PairedEffectChecker(), """
+            class C:
+                def dispatch(self, sched, send):
+                    sched.on_request_sent(self.rid)
+                    try:
+                        ref = send()
+                    except RuntimeError:
+                        return None
+                    sched.on_request_done(self.rid)
+                    return ref
+            """)
+        assert _checks(findings) == [
+            ("paired-effect", "on_request_sent:sched")]
+
+    def test_inflight_counter_handler_undo_clean(self):
+        findings = _run(PairedEffectChecker(), """
+            class C:
+                def dispatch(self, sched, send):
+                    sched.on_request_sent(self.rid)
+                    try:
+                        ref = send()
+                    except RuntimeError:
+                        sched.on_request_done(self.rid)
+                        return None
+                    sched.on_request_done(self.rid)
+                    return ref
+            """)
+        assert findings == []
+
+    def test_site_annotation_is_strict(self):
+        # Annotated Name-call paired against the assignment target: the
+        # pre-fix destroy() drain shape (no release at all) must flag even
+        # though no normal-exit anchor exists.
+        findings = _run(PairedEffectChecker(), """
+            class C:
+                def drain(self, ch):
+                    out = []
+                    for slot in ch.read_ready(1 << 30):  # pairs_with: release_slot
+                        out.append(slot[0])
+                    return out
+            """)
+        assert _checks(findings) == [("paired-effect", "read_ready:ch")]
+
+    def test_site_annotation_satisfied_clean(self):
+        findings = _run(PairedEffectChecker(), """
+            class C:
+                def drain(self, ch):
+                    out = []
+                    for slot in ch.read_ready(1 << 30):  # pairs_with: release_slot
+                        out.append(slot[0])
+                        ch.release_slot(slot)
+                    return out
+            """)
+        assert findings == []
+
+    def test_name_call_pairs_against_assign_target(self):
+        findings = _run(PairedEffectChecker(), """
+            class C:
+                def prefill(self, alloc, model, ctx):
+                    table = BlockTable(alloc)  # pairs_with: release
+                    tok = model.prefill(table, ctx)
+                    if tok is None:
+                        raise RuntimeError("no token")
+                    table.release()
+                    return tok
+            """)
+        assert _checks(findings) == [("paired-effect", "BlockTable:table")]
+        assert "raise path" in findings[0].message
+
+    def test_retry_loop_else_raise_clean(self):
+        # for/else: the exhaustion raise runs only on no-break paths,
+        # where every iteration's handler already released.
+        findings = _run(PairedEffectChecker(), """
+            class C:
+                def prefill(self, alloc, model, ctx):
+                    for attempt in range(8):
+                        table = BlockTable(alloc)  # pairs_with: release
+                        try:
+                            tok = model.prefill(table, ctx)
+                            break
+                        except NoFreeBlocks:
+                            table.release()
+                    else:
+                        raise NoFreeBlocks("exhausted")
+                    table.release()
+                    return tok
+            """)
+        assert findings == []
+
+    def test_declared_def_pair_binds_all_calls(self):
+        findings = _run(PairedEffectChecker(), """
+            class Pool:
+                def claim_page(self):  # pairs_with: unclaim_page
+                    return 1
+
+                def unclaim_page(self):
+                    pass
+
+            class User:
+                def use(self, pool):
+                    pool.claim_page()
+                    if pool.empty:
+                        return None
+                    pool.unclaim_page()
+                    return 1
+            """)
+        assert _checks(findings) == [("paired-effect", "claim_page:pool")]
+
+    def test_monotonic_counter_inc_never_paired(self):
+        # Counter.inc with no same-receiver .dec in the function is
+        # monotonic — never treated as a forward effect.
+        findings = _run(PairedEffectChecker(), """
+            class C:
+                def count(self, m):
+                    m.inc(1)
+                    if self.fast:
+                        return 1
+                    return 2
+            """)
+        assert findings == []
+
+    def test_gauge_inc_dec_pair_flagged(self):
+        findings = _run(PairedEffectChecker(), """
+            class C:
+                def track(self, g):
+                    g.inc(1)
+                    if self.skip:
+                        return None
+                    g.dec(1)
+                    return 1
+            """)
+        assert _checks(findings) == [("paired-effect", "inc:g")]
+
+    def test_inline_ignore_suppresses(self):
+        findings = _run(PairedEffectChecker(), """
+            class C:
+                def handle(self, ch):
+                    slot = ch.acquire_slot()  # analysis: ignore[paired-effect] drained by caller
+                    if self._closed:
+                        return None
+                    ch.release_slot(slot)
+                    return slot
+            """)
+        assert findings == []
+
+
+# --------------------------------------------------------------------------
+# task-lifecycle
+# --------------------------------------------------------------------------
+
+class TestTaskLifecycle:
+    def test_fire_and_forget_flagged(self):
+        findings = _run(TaskLifecycleChecker(), """
+            import asyncio
+
+            async def kick(coro):
+                asyncio.create_task(coro())
+            """)
+        assert len(findings) == 1
+        assert findings[0].check == "task-lifecycle"
+        assert "fire-and-forget" in findings[0].message
+
+    def test_detached_ok_escape(self):
+        findings = _run(TaskLifecycleChecker(), """
+            import asyncio
+
+            async def kick(coro):
+                # detached_ok: reaped by the loop's cancel sweep
+                asyncio.create_task(coro())
+            """)
+        assert findings == []
+
+    def test_local_task_never_consumed_flagged(self):
+        findings = _run(TaskLifecycleChecker(), """
+            import asyncio
+
+            async def run(coro):
+                t = asyncio.create_task(coro())
+                return "done"
+            """)
+        assert len(findings) == 1
+        assert "never awaited or cancelled in this function" \
+            in findings[0].message
+
+    def test_local_task_awaited_clean(self):
+        findings = _run(TaskLifecycleChecker(), """
+            import asyncio
+
+            async def run(coro):
+                t = asyncio.create_task(coro())
+                return await t
+            """)
+        assert findings == []
+
+    def test_local_task_cancelled_clean(self):
+        findings = _run(TaskLifecycleChecker(), """
+            import asyncio
+
+            async def run(coro):
+                t = asyncio.create_task(coro())
+                try:
+                    return self.wait()
+                finally:
+                    t.cancel()
+            """)
+        assert findings == []
+
+    def test_abandoned_instance_task_flagged(self):
+        # The controller shape before the fix: the loop task is stored on
+        # self but NO method in the class ever awaits or cancels it.
+        findings = _run(TaskLifecycleChecker(), """
+            import asyncio
+
+            class Controller:
+                async def ensure_loop(self):
+                    self._loop_task = asyncio.create_task(self.loop())
+
+                async def shutdown(self):
+                    self._shutdown = True
+            """)
+        assert len(findings) == 1
+        assert "anywhere in the class" in findings[0].message
+        assert findings[0].detail.startswith("create_task:")
+
+    def test_instance_task_cancelled_elsewhere_clean(self):
+        findings = _run(TaskLifecycleChecker(), """
+            import asyncio
+
+            class Controller:
+                async def ensure_loop(self):
+                    self._loop_task = asyncio.create_task(self.loop())
+
+                async def shutdown(self):
+                    self._loop_task.cancel()
+                    await self._loop_task
+            """)
+        assert findings == []
+
+    def test_fanout_list_gathered_clean(self):
+        findings = _run(TaskLifecycleChecker(), """
+            import asyncio
+
+            async def fan_out(coros):
+                tasks = [asyncio.ensure_future(c) for c in coros]
+                return await asyncio.gather(*tasks)
+            """)
+        assert findings == []
+
+    def test_fanout_list_dropped_flagged(self):
+        findings = _run(TaskLifecycleChecker(), """
+            import asyncio
+
+            async def fan_out(coros):
+                tasks = [asyncio.ensure_future(c) for c in coros]
+                return len(tasks)
+            """)
+        assert len(findings) == 1
+        assert "'tasks'" in findings[0].message
+
+    def test_unrecognised_retention_under_reports(self):
+        findings = _run(TaskLifecycleChecker(), """
+            import asyncio
+
+            async def register(self, key, coro):
+                self._by_key[key] = asyncio.create_task(coro())
+            """)
+        assert findings == []
+
+
+# --------------------------------------------------------------------------
+# thread-ownership
+# --------------------------------------------------------------------------
+
+class TestThreadOwnership:
+    def test_cross_thread_access_flagged(self):
+        # The _ShardTracker window-leak shape: pump-owned state mutated
+        # from the consumer with no lock.
+        findings = _run(ThreadOwnershipChecker(), """
+            import threading
+
+            class Tracker:
+                def __init__(self):
+                    self._buf = []  # owned_by_thread: _pump
+                    self._thread = threading.Thread(target=self._pump)
+
+                def _pump(self):
+                    self._buf.append(1)
+
+                def consume(self):
+                    return self._buf.pop()
+            """)
+        assert _checks(findings) == [("thread-ownership", "_buf:consume")]
+        assert "owned by thread '_pump'" in findings[0].message
+
+    def test_owner_thread_and_helpers_clean(self):
+        findings = _run(ThreadOwnershipChecker(), """
+            import threading
+
+            class Tracker:
+                def __init__(self):
+                    self._buf = []  # owned_by_thread: _pump
+                    self._thread = threading.Thread(target=self._pump)
+
+                def _pump(self):
+                    self._fill()
+
+                def _fill(self):
+                    self._buf.append(1)
+            """)
+        assert findings == []
+
+    def test_lock_held_access_allowed(self):
+        findings = _run(ThreadOwnershipChecker(), """
+            import threading
+
+            class Tracker:
+                def __init__(self):
+                    self._buf = []  # owned_by_thread: _pump
+                    self._lock = threading.Lock()
+                    self._thread = threading.Thread(target=self._pump)
+
+                def _pump(self):
+                    self._buf.append(1)
+
+                def consume(self):
+                    with self._lock:
+                        return self._buf.pop()
+            """)
+        assert findings == []
+
+    def test_stale_annotation_flagged(self):
+        findings = _run(ThreadOwnershipChecker(), """
+            class Tracker:
+                def __init__(self):
+                    self._buf = []  # owned_by_thread: _pump
+
+                def _pump(self):
+                    self._buf.append(1)
+            """)
+        assert _checks(findings) == [
+            ("thread-ownership", "_buf:unspawned:_pump")]
+        assert "never spawns a thread" in findings[0].message
+
+    def test_freeform_owner_flags_spawned_entries_only(self):
+        findings = _run(ThreadOwnershipChecker(), """
+            import threading
+
+            class Profiler:
+                def __init__(self):
+                    self._totals = {}  # owned_by_thread: worker thread
+                    self._thread = threading.Thread(target=self._export)
+
+                def record(self, k, v):
+                    self._totals[k] = v
+
+                def _export(self):
+                    return dict(self._totals)
+            """)
+        # record() runs on the (external) worker thread: fine.  _export
+        # IS spawned by this class, so it provably runs elsewhere.
+        assert _checks(findings) == [("thread-ownership", "_totals:_export")]
+
+    def test_init_exempt(self):
+        findings = _run(ThreadOwnershipChecker(), """
+            import threading
+
+            class Tracker:
+                def __init__(self):
+                    self._buf = []  # owned_by_thread: _pump
+                    self._buf.append(0)
+                    self._thread = threading.Thread(target=self._pump)
+
+                def _pump(self):
+                    self._buf.append(1)
+            """)
+        assert findings == []

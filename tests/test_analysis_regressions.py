@@ -12,9 +12,27 @@ Each test pins a concrete fix from the concurrency audit:
 * ``PowerOfTwoChoicesReplicaScheduler.num_replicas`` reads under the
   lock, and ``load()`` returns (inflight, capacity) as one consistent
   snapshot.
+
+The flow-sensitive exit-path pass (paired-effect, task-lifecycle,
+thread-ownership) added a second batch:
+
+* ``_CompiledGraph.destroy`` returns every drained request slot to the
+  channel's reuse ring (each drained request used to permanently shrink
+  the free list and pin its args/response future).
+* ``EngineScheduler.preempt_seq`` is idempotent — a double preemption
+  used to requeue the sequence twice and later schedule it twice.
+* ``ServeController.graceful_shutdown`` cancels and reaps the control
+  loop task instead of abandoning it mid-sleep.
+* ``stream_blocks`` reports a shard's TRUE block total to
+  ``on_shard_end`` (it used to report the fetch-ahead depth whenever the
+  shard outlasted the buffer window).
+* ``Counter.inc(0)`` stays a silent no-op that creates no series — code
+  like ``fetch_block``'s ``ROWS.inc(acc.num_rows())`` leans on it.
 """
 
+import asyncio
 import threading
+from types import SimpleNamespace
 
 import pytest
 
@@ -169,3 +187,215 @@ class TestReplicaHolderLocking:
         assert holder.held() == [(7, 0), (7, 1)]
         holder.trim([])
         assert holder.held() == []
+
+
+# ============================================== exit-path analyzer batch
+
+
+class TestCompiledDestroySlotRing:
+    """destroy() must release every drained slot back to the reuse ring
+    (the paired-effect checker's acquire_slot/release_slot invariant)."""
+
+    def _graph(self, monkeypatch, redispatched):
+        from ray_tpu.dag.channel import Channel
+        from ray_tpu.serve import compiled_router as cr
+
+        monkeypatch.setattr(
+            cr, "_redispatch_pending",
+            lambda router, pending: redispatched.extend(pending))
+
+        class _Sched:
+            def __init__(self):
+                self.done = []
+
+            def on_request_done(self, rid):
+                self.done.append(rid)
+
+        g = object.__new__(cr._CompiledGraph)
+        g.router = SimpleNamespace(_scheduler=_Sched())
+        g.deployment_id = "dep"
+        g._destroyed = False
+        g._destroy_lock = threading.Lock()
+        lane = SimpleNamespace(
+            rid="r1",
+            req=Channel(maxsize=8, name="t-destroy", slot_width=cr.SLOT_WIDTH),
+            _loop_thread=SimpleNamespace(join=lambda timeout=None: None))
+        g._lanes = {"r1": lane}
+        g._single_lane = lane
+        return g, lane
+
+    def _enqueue(self, cr, lane, method):
+        slot = lane.req.acquire_slot()
+        slot[cr.S_METHOD] = method
+        slot[cr.S_ARGS] = ("a",)
+        slot[cr.S_KWARGS] = {}
+        slot[cr.S_MUX] = None
+        slot[cr.S_RESP] = object()
+        lane.req.write(slot)
+        return slot
+
+    def test_drained_slots_return_to_ring(self, monkeypatch):
+        from ray_tpu.serve import compiled_router as cr
+
+        redispatched = []
+        g, lane = self._graph(monkeypatch, redispatched)
+        s1 = self._enqueue(cr, lane, "m1")
+        s2 = self._enqueue(cr, lane, "m2")
+        g.destroy()
+        # Both buffered requests went to the dynamic re-dispatch...
+        assert [p[0] for p in redispatched] == ["m1", "m2"]
+        assert g.router._scheduler.done == ["r1", "r1"]
+        # ...and both slots are back in the free ring, fields cleared, so
+        # nothing pins the args tuple or the response future.
+        assert len(lane.req._free_slots) == 2
+        assert all(f is None for f in s1) and all(f is None for f in s2)
+
+    def test_destroy_idempotent(self, monkeypatch):
+        from ray_tpu.serve import compiled_router as cr
+
+        redispatched = []
+        g, lane = self._graph(monkeypatch, redispatched)
+        self._enqueue(cr, lane, "m1")
+        g.destroy()
+        g.destroy()  # second call: no double release, no double dispatch
+        assert len(redispatched) == 1
+        assert len(lane.req._free_slots) == 1
+
+
+class TestPreemptIdempotence:
+    def test_double_preempt_requeues_once(self):
+        from ray_tpu.serve.llm.blocks import BlockAllocator, BlockTable
+        from ray_tpu.serve.llm.scheduler import (EngineScheduler, Sequence,
+                                                 WAITING)
+
+        a = BlockAllocator(8, 2, pool="t-idem")
+        sch = EngineScheduler(a)
+        seq = Sequence([0] * 3, 4)
+        sch.add(seq)
+        assert sch.admit(max_new=1) == [seq]
+        table = BlockTable(a)
+        for i in range(4):
+            table.append(i)
+        seq.table = table
+        sch.preempt_seq(seq)
+        assert seq.status == WAITING
+        assert sch.waiting == [seq]
+        assert seq.preemptions == 1
+        assert a.num_in_use == 0
+        # A racing second preemption (e.g. prefill rollback after a decode
+        # headroom eviction already ran) must be a no-op — the old code
+        # inserted the sequence into waiting twice.
+        sch.preempt_seq(seq)
+        assert sch.waiting == [seq]
+        assert seq.preemptions == 1
+
+    def test_preempt_after_finish_is_noop(self):
+        from ray_tpu.serve.llm.blocks import BlockAllocator
+        from ray_tpu.serve.llm.scheduler import EngineScheduler, Sequence
+
+        a = BlockAllocator(8, 2, pool="t-idem2")
+        sch = EngineScheduler(a)
+        seq = Sequence([0], 4)
+        sch.add(seq)
+        assert sch.admit(max_new=1) == [seq]
+        sch.finish(seq)
+        sch.preempt_seq(seq)  # stale eviction of a finished stream
+        assert sch.waiting == []
+        assert seq.preemptions == 0
+
+
+class TestControllerLoopTaskReaped:
+    def test_graceful_shutdown_cancels_control_loop(self):
+        from ray_tpu.serve.controller import ServeController
+
+        async def run():
+            c = ServeController()
+            c._loop_task = asyncio.get_running_loop().create_task(
+                c.run_control_loop())
+            task = c._loop_task
+            await asyncio.sleep(0)  # let the loop reach its first sleep
+            await c.graceful_shutdown()
+            assert c._loop_task is None
+            assert task.done()
+            return task
+
+        task = asyncio.run(run())
+        # The loop observes shutdown via cancellation, not abandonment:
+        # nothing awaiting the task can hang on a dead event loop.
+        assert task.cancelled() or task.exception() is None
+
+
+class TestStreamBlocksShardTotals:
+    def _run_stream(self, monkeypatch, n_blocks, task_cap):
+        from ray_tpu.data import executor as base_ex
+        from ray_tpu.data.ingest import executor as ing
+
+        monkeypatch.setattr(ing, "_exec_subplan",
+                            lambda plan: iter(plan))
+        monkeypatch.setattr(
+            ing, "fetch_block",
+            lambda ref, retries=3, should_stop=None: ref)
+        ends = []
+        budget = base_ex.ResourceBudget(task_cap=task_cap)
+        plans = iter([("shard-0", [f"b{i}" for i in range(n_blocks)])])
+        out = list(ing.stream_blocks(
+            plans, budget=budget,
+            on_shard_end=lambda key, n: ends.append((key, n))))
+        return out, ends
+
+    def test_total_reported_when_shard_outlasts_window(self, monkeypatch):
+        # 5 blocks through a 2-deep fetch-ahead window: the old accounting
+        # reported the in-flight depth at generator exhaustion (1), not 5.
+        out, ends = self._run_stream(monkeypatch, n_blocks=5, task_cap=2)
+        assert [b for _, b in out] == [f"b{i}" for i in range(5)]
+        assert ends == [("shard-0", 5)]
+
+    def test_total_reported_when_window_covers_shard(self, monkeypatch):
+        out, ends = self._run_stream(monkeypatch, n_blocks=2, task_cap=8)
+        assert [b for _, b in out] == ["b0", "b1"]
+        assert ends == [("shard-0", 2)]
+
+    def test_empty_shard_fires_with_zero(self, monkeypatch):
+        out, ends = self._run_stream(monkeypatch, n_blocks=0, task_cap=2)
+        assert out == []
+        assert ends == [("shard-0", 0)]
+
+
+class TestCounterIncZeroContract:
+    """The audit behind ``ROWS.inc(acc.num_rows())  # inc(0) is a no-op``:
+    zero increments must neither raise nor materialize a series, so hot
+    paths can skip the ``if n:`` guard."""
+
+    def test_inc_zero_creates_no_series(self):
+        from ray_tpu.util.metrics import Counter
+
+        c = Counter("t_inc_zero", "t")
+        c.inc(0)
+        c.inc(0.0)
+        assert c.samples() == []
+        assert c.get() == 0.0
+
+    def test_inc_zero_with_tags_creates_no_series(self):
+        from ray_tpu.util.metrics import Counter
+
+        c = Counter("t_inc_zero_tags", "t", tag_keys=("pool",))
+        c.inc(0, tags={"pool": "p"})
+        assert c.samples() == []
+
+    def test_negative_inc_raises(self):
+        from ray_tpu.util.metrics import Counter
+
+        c = Counter("t_inc_neg", "t")
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        assert c.samples() == []
+
+    def test_zero_then_real_increment(self):
+        from ray_tpu.util.metrics import Counter
+
+        c = Counter("t_inc_mixed", "t")
+        c.inc(0)
+        c.inc(3)
+        c.inc(0)
+        assert c.get() == 3.0
+        assert len(c.samples()) == 1

@@ -160,9 +160,70 @@ def test_cli_subprocess_exits_zero():
         f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
 
 
-def test_cli_lists_all_five_checkers():
+def test_cli_lists_all_eight_checkers():
     from ray_tpu.devtools import analysis
 
     assert sorted(c.name for c in analysis.ALL_CHECKERS) == [
         "atomicity", "blocking-in-handler", "lock-discipline",
-        "lockstep-divergence", "registry-consistency"]
+        "lockstep-divergence", "paired-effect", "registry-consistency",
+        "task-lifecycle", "thread-ownership"]
+
+
+def test_warm_cache_run_fast_and_identical(tmp_path):
+    """``--changed-only`` with a warm cache reproduces the cold findings
+    exactly and keeps the tier-1 analysis well under the 10s budget."""
+    import time as _time
+
+    from ray_tpu.devtools import analysis
+
+    cache = str(tmp_path / "cache.json")
+    checkers = analysis.make_checkers()
+    paths = [os.path.join(REPO, "ray_tpu")]
+    cold, stats_cold = analysis.run_cached(
+        paths, checkers, root=REPO, exclude=_config_excludes(),
+        cache_path=cache)
+    t0 = _time.time()
+    warm, stats_warm = analysis.run_cached(
+        paths, analysis.make_checkers(), root=REPO,
+        exclude=_config_excludes(), cache_path=cache)
+    warm_s = _time.time() - t0
+    assert [f.key for f in warm] == [f.key for f in cold]
+    assert stats_warm["cache_misses"] == 0
+    assert stats_warm["cache_hits"] == stats_cold["files"]
+    assert warm_s < 10.0, (
+        f"warm --changed-only run took {warm_s:.1f}s — the incremental "
+        f"path must keep tier-1 analysis under 10s")
+
+
+def test_sarif_output_shape(tmp_path):
+    import json
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0  # guarded_by: _lock\n"
+        "    def bump(self):\n"
+        "        self._n += 1\n")
+    from ray_tpu.devtools import analysis
+    from ray_tpu.devtools.analysis import sarif
+
+    checkers = analysis.make_checkers()
+    findings, _ = analysis.run([str(bad)], checkers, root=str(tmp_path))
+    assert findings
+    doc = json.loads(sarif.render_sarif(findings, checkers,
+                                        baselined_keys=[]))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == {
+        c.name for c in checkers}
+    res = run["results"][0]
+    assert res["ruleId"] == "lock-discipline"
+    assert res["baselineState"] == "new"
+    assert res["partialFingerprints"]["stableKey/v1"] == findings[0].key
+    # Baselined keys surface as 'unchanged', the SARIF triage state.
+    doc2 = json.loads(sarif.render_sarif(
+        findings, checkers, baselined_keys=[findings[0].key]))
+    assert doc2["runs"][0]["results"][0]["baselineState"] == "unchanged"
