@@ -73,6 +73,11 @@ FAULT_POINTS: Dict[str, str] = {
                        "preemption/backoff paths absorb the failure",
     "llm_kv_handoff": "prefill→decode KV-page import on the decode "
                       "replica — the frontend re-prefills on a survivor",
+    # crash forensics (tests/test_forensics.py)
+    "forensics_dump": "flight-recorder postmortem dump entry — the dump "
+                      "fails; every trigger site absorbs it (a forensics "
+                      "failure must never worsen the failure being "
+                      "recorded)",
     # streaming ingest (tests/test_data_ingest.py)
     "data_ingest_fetch": "block materialization in the ingest stream — the "
                          "fetch retries (bounded) before surfacing to the "

@@ -429,10 +429,21 @@ def _api_payload(runtime, path: str):
         "/api/nodes": state_api.list_nodes,
         "/api/placement_groups": state_api.list_placement_groups,
         "/api/train_runs": state_api.list_train_runs,
+        "/api/postmortems": state_api.list_postmortems,
     }
     fn = listings.get(path)
     if fn is not None:
         return fn()
+    if path == "/api/postmortems/bundle":
+        # Full cluster postmortem: every dump merged with the head's
+        # recent time-series window and the run registry.
+        from ray_tpu.util import forensics
+
+        return forensics.build_bundle()
+    if path.startswith("/api/postmortems/"):
+        from ray_tpu.util import forensics
+
+        return forensics.load_postmortem(path[len("/api/postmortems/"):])
     if path == "/api/stacks":
         # On-demand profiling (ref: dashboard reporter profile_manager.py:78
         # py-spy dumps; here sys._current_frames + SIGUSR1 faulthandler).

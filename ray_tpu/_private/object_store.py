@@ -720,10 +720,15 @@ class ObjectStore:
         import gc
 
         with self._lock:
-            for oid, entry in list(self._entries.items()):
+            # Detach the table before touching entries: releasing allocates,
+            # an allocation can trigger GC, and a collected ObjectRef's
+            # __del__ re-enters free() on this same thread (RLock) — which
+            # must see an empty table, not pop out of the dict mid-iteration.
+            entries = self._entries
+            self._entries = {}
+            for oid, entry in entries.items():
                 if entry.shm is not None:
                     self._release_serialized(oid, entry)
-            self._entries.clear()
         gc.collect()
         for shm in self._graveyard:
             try:

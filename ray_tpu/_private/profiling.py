@@ -92,6 +92,51 @@ def spans_to_chrome_events(spans: List[dict]) -> List[dict]:
     return out
 
 
+def postmortem_chrome_events(bundle: Dict[str, Any]) -> List[dict]:
+    """Fold a forensics postmortem bundle (util.forensics.build_bundle)
+    into chrome-tracing events: one Perfetto process lane per dumped
+    process holding the spans its flight-recorder ring captured, with
+    instant markers ("i" events, rendered as flow arrows/flags) at state
+    transitions, dump triggers, and stall/death moments — so "replica
+    died mid-batch" or "worker wedged in a rendezvous" reads directly off
+    the fused timeline."""
+    out: List[dict] = []
+    for dump in bundle.get("dumps", []):
+        pid = f"pid:{dump.get('pid')}"
+        for row in dump.get("ring", []):
+            kind = row.get("kind", "event")
+            name = row.get("name", "")
+            status = row.get("status", "OK")
+            if kind == "span":
+                ev = {
+                    "ph": "X", "cat": "forensics", "name": name,
+                    "pid": pid, "tid": name,
+                    "ts": row.get("start", 0.0) * 1e6,
+                    "dur": max(0.0, (row.get("end", 0.0)
+                                     - row.get("start", 0.0)) * 1e6),
+                    "args": {"status": status, "seq": row.get("seq")},
+                }
+            else:
+                ev = {
+                    "ph": "i", "cat": "forensics", "name": f"{kind}:{name}",
+                    "pid": pid, "tid": kind,
+                    "ts": row.get("start", 0.0) * 1e6, "s": "p",
+                    "args": {"status": status, "seq": row.get("seq")},
+                }
+            if status != "OK" or kind in ("stall", "trigger"):
+                ev["cname"] = "terrible"
+            out.append(ev)
+        # The dump moment itself: the death/breach marker, process-scoped.
+        out.append({
+            "ph": "i", "cat": "forensics",
+            "name": f"dump:{dump.get('reason')}", "pid": pid, "tid": "dump",
+            "ts": (dump.get("ts") or 0.0) * 1e6, "s": "p",
+            "args": {"reason": dump.get("reason"), "id": dump.get("id")},
+            "cname": "terrible",
+        })
+    return out
+
+
 def chrome_trace(events: Optional[List[dict]] = None,
                  include_spans: bool = True) -> List[dict]:
     """Fold the task-event log into chrome-tracing events.

@@ -281,6 +281,19 @@ class Runtime:
 
         fault_injection.reset_injector()
         self._chaos = fault_injection.get_injector().enabled
+        # Black-box bootstrap: the flight recorder's span tap only exists
+        # once the singleton does — building it here (not lazily at the
+        # first dump) is what makes the ring *always-on*: spans emitted
+        # before any failure seam fires must already be in it.  The
+        # watchdog ticker starts here too: its tick is what samples metric
+        # deltas into the ring, so without it a process with tracing off
+        # (the default) would crash with an empty black box.
+        from ray_tpu.util import flight_recorder, watchdog
+
+        flight_recorder.get_recorder()
+        flight_recorder.record_event(
+            "runtime.start", {"pid": os.getpid()}, kind="state")
+        watchdog.get_watchdog().ensure_started()
         self.job_id = JobID.from_random()
         self.worker_id = WorkerID.from_random()
         self.namespace = namespace
@@ -1889,6 +1902,7 @@ class Runtime:
         self._kill_actor_state(state, ActorDiedError("ray_tpu.kill() was called"), no_restart)
 
     def _kill_actor_state(self, state: _ActorState, cause: ActorDiedError, no_restart: bool) -> None:
+        died_terminally = False
         with state.lock:
             spec = state.spec
             can_restart = (not no_restart) and (
@@ -1930,6 +1944,20 @@ class Runtime:
                         del self._named_actors[(spec.namespace, spec.name)]
                 for _ in state.threads:
                     state.mailbox.put(None)
+                died_terminally = True
+        if died_terminally and not self._dispatcher_stop.is_set():
+            # Actor-death sentinel: snapshot the black box while the spans
+            # that explain the death are still in the ring (best-effort,
+            # flood-controlled; skipped during runtime shutdown where mass
+            # actor teardown is expected, not a failure).
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.trigger_dump("actor_death", {
+                "actor_id": str(spec.actor_id),
+                "name": spec.name or "",
+                "class": getattr(spec, "class_name", "") or "",
+                "cause": str(cause),
+            })
 
     def _drain_mailbox(self, state: _ActorState) -> None:
         while True:

@@ -62,6 +62,12 @@ def current_process_stacks() -> Dict[str, List[str]]:
     return out
 
 
+#: Sentinel prefix for pids whose dump never arrived before the deadline —
+#: callers (postmortem bundles, /api/stacks) can tell a missing worker from
+#: a collected dump without parsing prose.
+MISSING_DUMP_PREFIX = "<no dump before deadline"
+
+
 def dump_worker_stacks(pids: List[int], timeout_s: float = 2.0) -> Dict[int, str]:
     """Signal each worker pid; collect its faulthandler dump file.
 
@@ -71,10 +77,16 @@ def dump_worker_stacks(pids: List[int], timeout_s: float = 2.0) -> Dict[int, str
     (A stale same-pid file from an older session could defeat this gate;
     sessions share /tmp dirs rarely enough that we accept the window rather
     than plumb worker start-times through.)
+
+    The driver-side wait is bounded by ``timeout_s`` TOTAL (not per pid):
+    a dead or wedged worker — SIGUSR1 masked, stuck in native code, killed
+    between the signal and the write — is reported in the result under
+    :data:`MISSING_DUMP_PREFIX` instead of blocking the collector, so a
+    postmortem dump of a dying cluster always returns.
     """
     d = dump_dir()
     results: Dict[int, str] = {}
-    marks: Dict[int, float] = {}
+    marks: Dict[int, int] = {}
     for pid in pids:
         path = os.path.join(d, f"{pid}.txt")
         if not os.path.exists(path):
@@ -89,7 +101,7 @@ def dump_worker_stacks(pids: List[int], timeout_s: float = 2.0) -> Dict[int, str
     pending = [p for p in pids if p not in results]
     last_size: Dict[int, int] = {}
     while pending and time.monotonic() < deadline:
-        time.sleep(0.05)
+        time.sleep(min(0.05, timeout_s))
         for pid in list(pending):
             path = os.path.join(d, f"{pid}.txt")
             try:
@@ -97,17 +109,18 @@ def dump_worker_stacks(pids: List[int], timeout_s: float = 2.0) -> Dict[int, str
                 # Collect only once the dump is QUIESCENT (grew past the
                 # mark, then unchanged across a poll) — faulthandler writes
                 # incrementally and a partial read would drop thread stacks.
-                if size > marks[pid] and last_size.get(pid) == size:
+                if size > marks.get(pid, 0) and last_size.get(pid) == size:
                     with open(path) as f:
-                        f.seek(marks[pid])
+                        f.seek(marks.get(pid, 0))
                         results[pid] = f.read()
                     pending.remove(pid)
                 else:
                     last_size[pid] = size
             except OSError:
-                pass
+                pass  # file vanished/unreadable this poll; deadline bounds us
     for pid in pending:
-        results[pid] = "<no dump received (worker busy in native code?)>"
+        results[pid] = (f"{MISSING_DUMP_PREFIX} ({timeout_s:.1f}s): worker "
+                        "dead, signal masked, or busy in native code>")
     return results
 
 

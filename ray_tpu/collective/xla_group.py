@@ -27,6 +27,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ray_tpu.util import watchdog as _watchdog
+
 
 def _profiler_record(bucket: str, start: float, end: float) -> None:
     """Attribute an interval to the train step profiler when one is active
@@ -67,12 +69,17 @@ class _Rendezvous:
                    on_timeout=None) -> Any:
         # Contribute-to-result wall time is this rank's collective-sync
         # cost: waiting for stragglers plus (on the last rank) the compiled
-        # op itself — the step profiler's "collective" bucket.
+        # op itself — the step profiler's "collective" bucket.  The hang
+        # watchdog tracks the same window as a bounded phase: a rank held
+        # inside the rendezvous past the stall threshold is a wedge the
+        # liveness poll cannot see (the thread is alive, just waiting).
         w0 = time.time()
+        _watchdog.phase_enter(f"collective:rank{rank}", "rendezvous")
         try:
             return self._contribute(rank, value, run_fn, participants,
                                     on_timeout)
         finally:
+            _watchdog.phase_exit(f"collective:rank{rank}")
             _profiler_record("collective", w0, time.time())
 
     def _contribute(self, rank: int, value: Any, run_fn, participants=None,

@@ -281,6 +281,8 @@ METRIC_MODULES = (
     "ray_tpu.checkpoint.metrics",
     "ray_tpu.train.metrics",
     "ray_tpu.data.ingest.metrics",
+    "ray_tpu.util.flight_recorder",
+    "ray_tpu.util.watchdog",
 )
 
 ALLOWED_PREFIXES = ("ray_tpu_", "serve_")

@@ -42,8 +42,10 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.dag.channel import Channel, ChannelClosed, ChannelTimeout
+from ray_tpu.util import flight_recorder as _flight_recorder
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import tracing as _tracing
+from ray_tpu.util import watchdog as _watchdog
 
 COMPILED_MODE_GAUGE = _metrics.Gauge(
     "ray_tpu_serve_compiled_mode",
@@ -331,8 +333,13 @@ class _Lane:
         # get_event_loop() between awaits must see it.
         asyncio.set_event_loop(loop)
         scratch: list = []
+        beat_key = f"serve:lane:{self.rid}"
         try:
             while True:
+                # Channel-drain liveness: the hang watchdog flags this
+                # lane if the loop thread wedges inside user code (the
+                # 250 ms actor liveness poll cannot — the thread is alive).
+                _watchdog.beat(beat_key)
                 if self.state.state != "ALIVE":
                     break  # replica died: local fallback, no probe wait
                 try:
@@ -352,6 +359,7 @@ class _Lane:
             # Close both ends: writers fall back to the dynamic path, the
             # demux drains every buffered response (reads stay valid on a
             # closed channel until empty) and then notifies the manager.
+            _watchdog.get_watchdog().forget(beat_key)
             self.req.close()
             self.resp.close()
             loop.close()
@@ -809,6 +817,7 @@ class CompiledRouteManager:
 
     def _graph_broken(self, graph: _CompiledGraph, replica_id: str) -> None:
         """A lane observed its replica die before any controller push."""
+        broke = False
         with self._lock:
             if self._graph is graph:
                 self._graph = None
@@ -817,6 +826,14 @@ class CompiledRouteManager:
                 # set — rebuilding around the corpse would just fail.
                 self._last_change = time.monotonic()
                 COMPILED_MODE_GAUGE.set(0.0, tags=self._dep_tags)
+                broke = True
+        if broke:
+            # Fallback forensics, outside the manager lock: the ring still
+            # holds the dead replica's final compiled-batch spans.
+            _flight_recorder.trigger_dump("compiled_fallback", {
+                "deployment": self._dep_tags["deployment"],
+                "replica": replica_id,
+            })
         graph.destroy()
 
     def stop(self) -> None:

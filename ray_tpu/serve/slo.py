@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu.util import flight_recorder as _flight_recorder
 from ray_tpu.util import tracing as _tracing
 
 #: Canonical objective names — the registry the static analyzer
@@ -166,8 +167,17 @@ class SLOWatchdog:
                     agg, deployment, obj, obj.slow_window_s, t)
                 burn_fast = bad_fast / budget
                 burn_slow = bad_slow / budget
-                alerting = self._update_state(
+                alerting, fired = self._update_state(
                     deployment, obj, burn_fast, burn_slow, t)
+                if fired:
+                    # Breach forensics, outside the watchdog lock: the
+                    # black box still holds the requests that burned the
+                    # budget (best-effort, flood-controlled per reason).
+                    _flight_recorder.trigger_dump("slo_breach", {
+                        "deployment": deployment, "objective": obj.name,
+                        "burn_fast": round(burn_fast, 4),
+                        "burn_slow": round(burn_slow, 4),
+                    })
                 dep_out[obj.name] = {
                     "target": obj.target,
                     "threshold_ms": obj.threshold_ms,
@@ -192,8 +202,12 @@ class SLOWatchdog:
 
     def _update_state(self, deployment: str, obj: SLOObjective,
                       burn_fast: float, burn_slow: float,
-                      now: float) -> bool:
+                      now: float) -> Tuple[bool, bool]:
+        """Returns (alerting, fired): ``fired`` is True only on the
+        not-alerting -> alerting transition, so the caller can trigger the
+        postmortem dump outside this lock."""
         key = (deployment, obj.name)
+        fired = False
         with self._lock:
             state = self._state.setdefault(
                 key, {"alerting": False, "since": None})
@@ -204,6 +218,7 @@ class SLOWatchdog:
                         and burn_slow >= obj.burn_threshold:
                     state["alerting"] = True
                     state["since"] = now
+                    fired = True
             elif burn_fast < obj.burn_threshold:
                 # Fast-window recovery clears (asymmetric reset) and the
                 # whole episode becomes one timeline span.
@@ -217,7 +232,7 @@ class SLOWatchdog:
                                 "burn_fast": round(burn_fast, 4),
                                 "burn_slow": round(burn_slow, 4)},
                     status="ERROR: SLOBurn")
-            return state["alerting"]
+            return state["alerting"], fired
 
     def alerting(self, deployment: str) -> bool:
         """Is any objective of this deployment currently alerting (as of

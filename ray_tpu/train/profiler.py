@@ -40,7 +40,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.train import metrics as train_metrics
-from ray_tpu.util import tracing
+from ray_tpu.util import tracing, watchdog
 
 #: Attribution buckets measured by hooks; ``compute`` is the residual.
 BUCKETS = ("data_wait", "h2d", "collective", "ckpt_block")
@@ -139,6 +139,10 @@ class StepProfiler:
         row = {"step": self._step, "wall": wall, "compute": compute,
                **totals}
         self.history.append(row)
+        # Progress heartbeat: step closure feeds the hang watchdog (stall
+        # = beats stop) and the straggler check (cross-worker dispersion
+        # of these walls).
+        watchdog.beat(f"train:{self.run_name}:{self.rank}", wall=wall)
         self._emit_spans(t0, t1, compute, row)
         self._update_metrics(wall, totals, row)
         self._step += 1

@@ -47,7 +47,7 @@ from ray_tpu.train.config import DatasetConfig, RunConfig, ScalingConfig
 from ray_tpu.train.elastic import ElasticDatasetShard, SampleLedger
 from ray_tpu.train.profiler import StepProfiler
 from ray_tpu.train.session import TrainContext, TrainSession, clear_session, init_session
-from ray_tpu.util import tracing
+from ray_tpu.util import flight_recorder, tracing
 from ray_tpu.util.placement_group import (
     PlacementGroupSchedulingStrategy,
     placement_group,
@@ -473,6 +473,14 @@ class DataParallelTrainer:
                              "requeued_samples": requeued, "time": time.time()}
                     elastic_events.append(event)
                     run_registry.record_event(run_name, event)
+                    # Preemption forensics: snapshot the black box before
+                    # the recovery attempt overwrites the ring — the dump
+                    # carries the failed attempt's final train spans and
+                    # every thread's stack (best-effort, flood-controlled).
+                    flight_recorder.trigger_dump("elastic_preempt", {
+                        "run": run_name, "event": event,
+                        "error": str(last_error) if last_error else "",
+                    })
                     self._recovery_t0 = outcome.get("failed_at") or time.monotonic()
                     self._recovery_event = event
                     cur_world = target

@@ -205,6 +205,25 @@ def get_train_run(name: str) -> Optional[dict]:
     return registry.get_run(str(name))
 
 
+# --------------------------------------------------------- postmortems
+def list_postmortems(filters: Optional[Sequence[Filter]] = None,
+                     limit: int = 10_000) -> List[dict]:
+    """Flight-recorder postmortem dumps in this session (one row per dump:
+    id, pid, trigger reason, timestamp, ring/stall counts) — the index
+    ``scripts/postmortem.py list`` and ``/api/postmortems`` print.  Works
+    without a runtime: rows are files under ``<session>/postmortems``."""
+    from ray_tpu.util import forensics
+
+    return _apply_filters(forensics.list_postmortems(), filters, limit)
+
+
+def get_postmortem(pm_id: str) -> Optional[dict]:
+    """Full dump payload (ring, stacks, heap when traced) for one id."""
+    from ray_tpu.util import forensics
+
+    return forensics.load_postmortem(str(pm_id))
+
+
 # --------------------------------------------------------- placement groups
 def list_placement_groups(filters: Optional[Sequence[Filter]] = None,
                           limit: int = 10_000) -> List[dict]:
@@ -228,4 +247,5 @@ __all__ = [
     "list_nodes", "list_placement_groups",
     "list_deployments", "list_replicas",
     "list_train_runs", "get_train_run",
+    "list_postmortems", "get_postmortem",
 ]

@@ -86,6 +86,10 @@ SPAN_REGISTRY: Dict[str, str] = {
     "train.collective": "profiler: gradient-sync rendezvous within a step",
     "train.ckpt_block": "profiler: device->host snapshot blocking a step",
     "train.elastic": "controller: elastic recovery, failure -> resumed",
+    "train.stall": "watchdog: detected progress stall, last progress -> "
+                   "detection (status ERROR)",
+    "forensics.dump": "flight recorder: one postmortem dump, trigger -> "
+                      "file written",
 }
 
 
@@ -131,7 +135,23 @@ def clear_spans() -> None:
         _buffer.clear()
 
 
+#: Passive span tap (flight recorder): sees every span the exporter sees,
+#: including ones that outlive their tracing session — the recorder is a
+#: black box, not a tracing consumer.  One global load + None check on the
+#: hot path when no tap is installed.
+_tap: Optional[Callable[[dict], None]] = None
+
+
+def set_span_tap(fn: Optional[Callable[[dict], None]]) -> None:
+    """Install (or clear with None) the passive span tap.  The tap must be
+    cheap and must never raise — it runs inline on every span export."""
+    global _tap
+    _tap = fn
+
+
 def _export(span: dict) -> None:
+    if _tap is not None:
+        _tap(span)
     if not _enabled:
         return  # span outlived its tracing session (e.g. a parked long-poll)
     if _exporter is not None:
@@ -276,10 +296,11 @@ def record_span_batch(name: str, intervals, *,
         return
     attrs = attributes if attributes is not None else {}
     emit = _exporter if _exporter is not None else _buffer.append
+    tap = _tap
     for start, end, parent in intervals:
         if parent is None:
             continue
-        emit({
+        s = {
             "name": name,
             "trace_id": parent.get("trace_id") or _new_trace_id(),
             "span_id": _new_id64(),
@@ -288,7 +309,10 @@ def record_span_batch(name: str, intervals, *,
             "end": end,
             "attributes": attrs,
             "status": "OK",
-        })
+        }
+        if tap is not None:
+            tap(s)
+        emit(s)
 
 
 def inject_task_spec(spec) -> None:

@@ -8,8 +8,13 @@ gate is <= 2% overhead: the profiler is always-on by default
 measures.  Also checks the attribution invariant on the profiled run:
 every row's buckets sum to its wall exactly.
 
+A second A/B phase gates the flight recorder the same way: profiled steps
+with the span tap installed (every span also lands in the black-box ring)
+vs. tap removed, gate <= 1% — the recorder is always-on, so its cost must
+stay in the noise even at full span volume.
+
 Writes BENCH_PROFILER.json next to the repo root and exits nonzero when
-the gate fails.
+either gate fails.
 
   python scripts/bench_profiler.py                 # tiny config, CPU-ok
   python scripts/bench_profiler.py --config small --steps 40
@@ -55,7 +60,37 @@ def _interleaved_times(step, params, opt_state, tokens, targets, n, prof):
         finally:
             if with_prof:
                 train_profiler.activate(None)
-    return bare, profiled
+    return bare, profiled, params, opt_state
+
+
+def _recorder_times(step, params, opt_state, tokens, targets, n, prof):
+    """Recorder A/B: every iteration runs profiled with spans on (the
+    recorder's cost is the per-span tap, so spans must flow in BOTH arms);
+    odd iterations have the ring tap installed, even ones don't.  Same
+    interleaving rationale as above."""
+    from ray_tpu.train import profiler as train_profiler
+    from ray_tpu.util import flight_recorder, tracing
+
+    rec = flight_recorder.FlightRecorder()
+    off, on = [], []
+    try:
+        for i in range(2 * n):
+            with_rec = i % 2 == 1
+            tracing.set_span_tap(rec.tap_span if with_rec else None)
+            train_profiler.activate(prof)
+            try:
+                t0 = time.perf_counter()
+                params, opt_state, loss = step(params, opt_state, tokens,
+                                               targets)
+                float(loss)  # device sync
+                prof.record("data_wait", time.time() - 1e-4, time.time())
+                prof.step_boundary()
+                (on if with_rec else off).append(time.perf_counter() - t0)
+            finally:
+                train_profiler.activate(None)
+    finally:
+        tracing.set_span_tap(None)
+    return off, on, rec.events_recorded()
 
 
 def main(argv=None) -> int:
@@ -64,6 +99,7 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--gate-pct", type=float, default=2.0)
+    ap.add_argument("--recorder-gate-pct", type=float, default=1.0)
     args = ap.parse_args(argv)
 
     import jax
@@ -96,8 +132,10 @@ def main(argv=None) -> int:
     tracing.clear_spans()
     tracing.enable_tracing()  # worst case: span emission on every boundary
     try:
-        bare, profiled = _interleaved_times(step, params, opt_state,
-                                            tokens, targets, args.steps, prof)
+        bare, profiled, params, opt_state = _interleaved_times(
+            step, params, opt_state, tokens, targets, args.steps, prof)
+        rec_off, rec_on, ring_events = _recorder_times(
+            step, params, opt_state, tokens, targets, args.steps, prof)
     finally:
         tracing.disable_tracing()
         tracing.clear_spans()
@@ -105,6 +143,9 @@ def main(argv=None) -> int:
     med_bare = statistics.median(bare)
     med_prof = statistics.median(profiled)
     overhead_pct = (med_prof - med_bare) / med_bare * 100.0
+    med_rec_off = statistics.median(rec_off)
+    med_rec_on = statistics.median(rec_on)
+    recorder_overhead_pct = (med_rec_on - med_rec_off) / med_rec_off * 100.0
 
     # Attribution invariant: buckets + compute == wall on every row.
     rows = list(prof.history)
@@ -126,18 +167,28 @@ def main(argv=None) -> int:
         "gate_pct": args.gate_pct,
         "bucket_sum_max_rel_err": max_err,
         "profiled_rows": len(rows),
-        "passed": overhead_pct <= args.gate_pct and max_err < 1e-9,
+        "median_step_ms_recorder_off": round(med_rec_off * 1e3, 4),
+        "median_step_ms_recorder_on": round(med_rec_on * 1e3, 4),
+        "recorder_overhead_pct": round(recorder_overhead_pct, 3),
+        "recorder_gate_pct": args.recorder_gate_pct,
+        "recorder_ring_events": ring_events,
+        "passed": (overhead_pct <= args.gate_pct and max_err < 1e-9
+                   and recorder_overhead_pct <= args.recorder_gate_pct),
     }
     with open(OUT, "w") as f:
         json.dump(result, f, indent=2)
         f.write("\n")
     print(json.dumps(result, indent=2), flush=True)
     if not result["passed"]:
-        print(f"FAIL: overhead {overhead_pct:.2f}% > gate {args.gate_pct}% "
-              f"or attribution drift {max_err:.2e}", file=sys.stderr)
+        print(f"FAIL: overhead {overhead_pct:.2f}% > gate {args.gate_pct}%, "
+              f"recorder overhead {recorder_overhead_pct:.2f}% > gate "
+              f"{args.recorder_gate_pct}%, or attribution drift "
+              f"{max_err:.2e}", file=sys.stderr)
         return 1
     print(f"OK: profiler overhead {overhead_pct:+.2f}% "
-          f"(gate {args.gate_pct}%)", flush=True)
+          f"(gate {args.gate_pct}%), recorder overhead "
+          f"{recorder_overhead_pct:+.2f}% (gate {args.recorder_gate_pct}%)",
+          flush=True)
     return 0
 
 

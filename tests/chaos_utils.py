@@ -82,6 +82,25 @@ def kill_node(node_id: Optional[str] = None,
     return simulate_preemption(node_id, exclude_head=exclude_head)
 
 
+def wait_for_postmortem(reason_substr: str = "",
+                        timeout_s: float = 20.0) -> Optional[dict]:
+    """Poll the session's postmortem index until a dump whose reason
+    contains ``reason_substr`` appears (any dump when empty); returns its
+    index row or None on timeout.  The chaos suites use this to assert a
+    kill/preemption actually tripped the flight recorder."""
+    import time
+
+    from ray_tpu.util import forensics
+
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        for row in forensics.list_postmortems():
+            if reason_substr in str(row.get("reason", "")):
+                return row
+        time.sleep(0.1)
+    return None
+
+
 def pg_worker_nodes(pg) -> List[str]:
     """Non-head node ids hosting the placement group's bundles — the
     candidate victims for a worker-group preemption."""

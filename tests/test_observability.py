@@ -443,16 +443,24 @@ def test_worker_logs_captured_and_tailed(ray_start_regular, capsys):
 
 def test_heap_profiler(ray_start_regular):
     """tracemalloc-based heap profiling (ref: dashboard memray integration)."""
+    import tracemalloc
+
     from ray_tpu._private import heap_profiler
 
-    first = heap_profiler.heap_summary()
-    # Allocate measurably, then snapshot again within the tracing window.
-    hoard = [bytearray(1 << 20) for _ in range(8)]
-    second = heap_profiler.heap_summary(top_n=10)
-    assert second["traced_current_bytes"] > 8 * (1 << 20) * 0.9
-    assert second["top_sites"], "no allocation sites attributed"
-    top = second["top_sites"][0]
-    assert top["size_bytes"] > 0 and "test_observability" in top["site"]
-    text = heap_profiler.format_heap(second)
-    assert "MB current" in text
-    del hoard
+    try:
+        first = heap_profiler.heap_summary()
+        # Allocate measurably, then snapshot again within the tracing window.
+        hoard = [bytearray(1 << 20) for _ in range(8)]
+        second = heap_profiler.heap_summary(top_n=10)
+        assert second["traced_current_bytes"] > 8 * (1 << 20) * 0.9
+        assert second["top_sites"], "no allocation sites attributed"
+        top = second["top_sites"][0]
+        assert top["size_bytes"] > 0 and "test_observability" in top["site"]
+        text = heap_profiler.format_heap(second)
+        assert "MB current" in text
+        del hoard
+    finally:
+        # Close the window: leaving tracemalloc tracing taxes every
+        # allocation in the rest of the suite (and makes postmortem dumps
+        # take full heap snapshots — see flight_recorder's S2 gate).
+        tracemalloc.stop()
