@@ -1,9 +1,11 @@
-"""Tensor-parallel serve/llm inference over a compiled DAG + allreduce.
+"""TP x PP serve/llm inference: compiled DAG inside, compiled pipeline
+outside.
 
-One logical serve deployment spans TWO TPU-pinned rank actors: each rank
-holds a :class:`~ray_tpu.serve.llm.engine.ToyLMShard` — a context-axis
-shard of the ToyLM reduction (rank r owns positions ``r, r+tp, ...``).
-Every decode step is one compiled-DAG tick::
+Tensor parallelism (inner): one logical serve deployment spans TWO
+TPU-pinned rank actors, each holding a
+:class:`~ray_tpu.serve.llm.engine.ToyLMShard` — a context-axis shard of
+the ToyLM reduction (rank r owns positions ``r, r+tp, ...``).  Every
+decode step is one compiled-DAG tick::
 
     prev_token -> rank_i.tp_step -> allreduce(sum) -> rank_i.token_from_acc
 
@@ -13,7 +15,15 @@ device-to-device copy, the role NCCL p2p plays in the reference's TP
 serving substrate (ref: compiled_dag_node.py + torch_tensor_nccl_channel).
 Partials are UNMASKED int64 (wraparound keeps them exact mod 2**64), so
 allreduce-sum + one final mask is congruent to the full-context
-reduction: the output is byte-identical to the single-replica oracle
+reduction.
+
+Pipeline parallelism (outer): three deployments — prefill (request
+prep/validation), decode (the TP group above), postprocess (detok/
+packaging) — chained by ``serve.pipeline``.  Once every stage's replica
+set is stable, a request crosses the whole prefill -> decode ->
+postprocess chain as typed-channel traffic (stage i's demux forwards
+straight into stage i+1's compiled lanes), never touching the dynamic
+dispatch path.  Output stays byte-identical to the single-replica oracle
 (``ToyLM.reference_generate``) — the acceptance gate.
 
 Run: python examples/serve_tp_inference.py
@@ -23,6 +33,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -101,27 +112,74 @@ def main() -> None:
                 out.append(prev)
             return out
 
+        def generate(self, req):
+            """Pipeline-stage entry: one positional record in, tokens out."""
+            return self.__call__(req["prompt"], req["max_new_tokens"])
+
         def shutdown_tp(self) -> None:
             self._dag.teardown()
 
-    handle = serve.run(TPGenerator.bind(TP, SEED), name="tp_llm",
-                       route_prefix=None)
-    try:
-        out = handle.remote(PROMPT, MAX_NEW_TOKENS).result(timeout_s=60)
+    # ------------------------------------------------ PP stages around TP
+    @serve.deployment
+    class Prefill:
+        """Request prep: validate/normalize the prompt before it reaches
+        the TP decode group (the tokenizer stage in a real stack)."""
 
+        def __call__(self, req):
+            prompt = [int(t) for t in req["prompt"]]
+            if not prompt:
+                raise ValueError("empty prompt")
+            return {"prompt": prompt,
+                    "max_new_tokens": int(req["max_new_tokens"])}
+
+    @serve.deployment
+    class Postprocess:
+        """Detok/packaging: wrap the raw token ids into the reply record
+        (the detokenizer stage in a real stack)."""
+
+        def __call__(self, tokens):
+            return {"tokens": list(tokens), "n": len(tokens)}
+
+    pre_h = serve.run(Prefill.bind(), name="tp_pre", route_prefix=None)
+    gen_h = serve.run(TPGenerator.bind(TP, SEED), name="tp_llm",
+                      route_prefix=None)
+    post_h = serve.run(Postprocess.bind(), name="tp_post", route_prefix=None)
+    pipe = serve.pipeline(pre_h, gen_h, post_h,
+                          methods=["__call__", "generate", "__call__"],
+                          name="tp_pp")
+    try:
         from ray_tpu.serve.llm.model import ToyLM
 
         oracle = ToyLM(seed=SEED).reference_generate(list(PROMPT),
                                                      MAX_NEW_TOKENS)
+
+        # Direct TP call through the decode stage's own handle.
+        out = gen_h.remote(PROMPT, MAX_NEW_TOKENS).result(timeout_s=60)
         assert out == oracle, (
             f"TP output diverged from oracle:\n  tp    ={out}\n"
             f"  oracle={oracle}")
         print(f"TP={TP} generated {len(out)} tokens byte-identical to the "
               f"single-replica oracle: {out[:5]}...")
+
+        # Full TP x PP traversal: prefill -> TP decode -> postprocess.
+        req = {"prompt": PROMPT, "max_new_tokens": MAX_NEW_TOKENS}
+        reply = pipe.remote(req).result(timeout_s=60)
+        assert reply["tokens"] == oracle, (
+            f"TP x PP output diverged from oracle:\n  pp    ="
+            f"{reply['tokens']}\n  oracle={oracle}")
+        # Give the routes a beat to lower, then traverse compiled.
+        deadline = time.time() + 5.0
+        while pipe.mode != "compiled" and time.time() < deadline:
+            time.sleep(0.05)
+        reply = pipe.remote(req).result(timeout_s=60)
+        assert reply["tokens"] == oracle
+        print(f"TP={TP} x PP=3 pipeline ({pipe.mode}) generated "
+              f"{reply['n']} tokens byte-identical to the oracle")
         print("OK")
     finally:
+        pipe.stop()
         try:
-            handle.shutdown_tp.remote().result(timeout_s=10)
+            gen_h.shutdown_tp.remote().result(timeout_s=10)
         except Exception:
             pass
         serve.shutdown()

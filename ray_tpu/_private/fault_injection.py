@@ -73,6 +73,10 @@ FAULT_POINTS: Dict[str, str] = {
                        "preemption/backoff paths absorb the failure",
     "llm_kv_handoff": "prefill→decode KV-page import on the decode "
                       "replica — the frontend re-prefills on a survivor",
+    "llm_spec_verify": "speculative-decode verify pass — draft KV pages "
+                       "roll back and the stream degrades to plain "
+                       "decoding for the step (no torn or duplicated "
+                       "tokens)",
     # crash forensics (tests/test_forensics.py)
     "forensics_dump": "flight-recorder postmortem dump entry — the dump "
                       "fails; every trigger site absorbs it (a forensics "

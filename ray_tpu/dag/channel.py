@@ -145,13 +145,16 @@ class DeviceChannel(Channel):
     transfer manager and rides ICI between chips, no NCCL analogue needed).
     """
 
-    def __init__(self, device=None, maxsize: int = 16, name: str = ""):
+    def __init__(self, device=None, maxsize: int = 16, name: str = "",
+                 payload_index: Optional[int] = None):
         super().__init__(maxsize=maxsize, name=name)
         self._device = device
+        #: Record-style edges (serve pipeline: ``(payload, future, ctx)``)
+        #: set this so only the payload field crosses devices — moving the
+        #: whole record would tree_map over futures/contexts for nothing.
+        self._payload_index = payload_index
 
-    def _transform(self, value: Any) -> Any:
-        if self._device is None:
-            return value
+    def _move(self, value: Any) -> Any:
         import jax
 
         def move(leaf):
@@ -160,6 +163,15 @@ class DeviceChannel(Channel):
             return leaf
 
         return jax.tree_util.tree_map(move, value)
+
+    def _transform(self, value: Any) -> Any:
+        if self._device is None:
+            return value
+        if self._payload_index is None:
+            return self._move(value)
+        record = list(value)
+        record[self._payload_index] = self._move(record[self._payload_index])
+        return record
 
 
 #: Process-wide arena clients keyed by path: channels that cross processes

@@ -302,6 +302,7 @@ ACCESSOR_SERIES = {
     "metrics.goodput_tokens_per_s": "ray_tpu_llm_decode_tokens_total",
     "metrics.recompute_waste_tokens_per_s":
         "ray_tpu_llm_recompute_tokens_total",
+    "metrics.acceptance_rate": "ray_tpu_llm_spec_accepted_tokens_total",
 }
 
 

@@ -10,13 +10,14 @@ requests from one event loop.
 from ray_tpu.serve import metrics, slo
 from ray_tpu.serve.api import (Application, Deployment, delete, deployment,
                                get_app_handle, get_deployment_handle,
-                               list_deployments, list_replicas, run,
-                               shutdown, start, status)
+                               list_deployments, list_replicas, pipeline,
+                               run, shutdown, start, status)
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import (AutoscalingConfig, DeploymentConfig,
                                   GRPCOptions, HTTPOptions)
 from ray_tpu.serve.context import get_multiplexed_model_id
-from ray_tpu.serve.continuous import EOS, SequenceSlot, continuous_batch
+from ray_tpu.serve.continuous import (EOS, Emissions, SequenceSlot,
+                                      continuous_batch)
 from ray_tpu.serve.exceptions import BackPressureError
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
 from ray_tpu.serve.multiplex import multiplexed
@@ -26,9 +27,10 @@ from ray_tpu.serve.slo import SLOObjective
 __all__ = [
     "Application", "Deployment", "deployment", "run", "start", "shutdown",
     "delete", "status", "get_app_handle", "get_deployment_handle",
-    "list_deployments", "list_replicas",
+    "list_deployments", "list_replicas", "pipeline",
     "AutoscalingConfig", "DeploymentConfig", "GRPCOptions", "HTTPOptions",
     "DeploymentHandle", "DeploymentResponse", "Request", "multiplexed",
     "get_multiplexed_model_id", "batch", "continuous_batch", "EOS",
+    "Emissions",
     "SequenceSlot", "BackPressureError", "SLOObjective", "metrics", "slo",
 ]

@@ -264,6 +264,33 @@ def get_deployment_handle(deployment_name: str,
     return DeploymentHandle(deployment_name, app_name)
 
 
+def pipeline(*stages, methods: Optional[list] = None,
+             devices: Optional[list] = None, name: str = "pipeline"):
+    """Chain deployments into a multi-stage compiled serve graph
+    (:class:`~ray_tpu.serve.compiled_router.ServePipeline`).
+
+    ``pipeline(prefill, decode, postprocess).remote(x)`` submits ``x`` to
+    the first stage and returns a future that resolves with the LAST
+    stage's result; once every stage's replica set is stable and compiled,
+    the request traverses the whole chain as typed-channel traffic —
+    stage i's demux forwards straight into stage i+1's lanes over a
+    ``DeviceChannel`` edge, no TaskSpec or ObjectRef between stages.
+
+    Stages are deployment names (looked up via ``get_deployment_handle``),
+    handles, or method-bound handles (``handle.method``); ``methods``
+    overrides the called method per stage, ``devices`` (one per edge)
+    places each inter-stage payload on the consumer's device at forward
+    time.  Any stage membership change degrades that hop to dynamic
+    dispatch with zero caller-visible errors and re-lowers when the stage
+    recompiles."""
+    from ray_tpu.serve.compiled_router import ServePipeline
+
+    handles = [get_deployment_handle(s) if isinstance(s, str) else s
+               for s in stages]
+    return ServePipeline(handles, methods=methods, devices=devices,
+                         name=name)
+
+
 def status() -> Dict[str, Any]:
     """Per-deployment status INCLUDING the RED latency rollup: replica
     counts/health plus requests/errors and p50/p95/p99/mean end-to-end

@@ -20,6 +20,20 @@ rides):
   router/replica spans with ONE ``record_span_batch`` call per iteration —
   admission -> batch -> execute -> demux is pure channel traffic.
 
+Two extensions ride the same substrate:
+
+- PROCESS-tier replica lanes: a replica with ``isolation='process'`` has no
+  shared-heap instance, so its lane is a pair of shm channels over the
+  native plasma arena (picklable by path) and the resident loop runs INSIDE
+  the replica's worker process against the replica instance — shipped via
+  the process pool's ``actor_exec``, exactly how compiled DAGs host their
+  worker-side loops (``dag/compiled_dag.py``).
+- multi-stage pipelines (:class:`ServePipeline`): stage i's demux forwards
+  each result over a typed ``DeviceChannel`` edge straight into stage
+  i+1's request channels, so a prefill→decode→postprocess request
+  traverses the whole chain as channel traffic — no TaskSpec, no
+  ObjectRef, no dynamic dispatch between stages.
+
 Degradation is reconciler-driven and safe by construction: any replica
 membership change observed through PR 3's long-poll push tears the graph
 down within that callback (requests still buffered in the channels are
@@ -27,9 +41,11 @@ re-dispatched through the dynamic path — zero caller-visible errors), and
 the route recompiles once the set has been stable for
 ``RAY_TPU_SERVE_COMPILED_STABLE_S``.  A replica death is also detected
 locally (the loop polls its actor state between reads), so fallback does
-not wait for the controller's health probe.  ``RAY_TPU_SERVE_COMPILED=0``
-disables compilation process-wide; ``@serve.deployment(compiled_route=
-False)`` disables it per deployment.
+not wait for the controller's health probe.  Pipelines subscribe to their
+stages' teardowns: any stage change closes the inter-stage edges and each
+hop independently degrades to the dynamic path.  ``RAY_TPU_SERVE_COMPILED
+=0`` disables compilation process-wide; ``@serve.deployment(compiled_route
+=False)`` disables it per deployment.
 """
 
 from __future__ import annotations
@@ -41,7 +57,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu.dag.channel import Channel, ChannelClosed, ChannelTimeout
+from ray_tpu.dag.channel import (Channel, ChannelClosed, ChannelTimeout,
+                                 DeviceChannel)
 from ray_tpu.util import flight_recorder as _flight_recorder
 from ray_tpu.util import metrics as _metrics
 from ray_tpu.util import tracing as _tracing
@@ -62,11 +79,19 @@ FALLBACK_SECONDS = _metrics.Counter(
     "Cumulative seconds this router spent on the dynamic path while "
     "compilation was desired (startup and teardown->recompile windows)",
     tag_keys=("deployment",))
+PIPELINE_FORWARDS = _metrics.Counter(
+    "ray_tpu_serve_pipeline_forwards_total",
+    "Stage-to-stage forwards executed by multi-stage serve pipelines (a "
+    "request crossing one inter-stage edge counts once)",
+    tag_keys=("pipeline",))
 
 #: Request-slot layout (one reusable pre-sized list per in-flight request,
 #: pooled by the request channel's slot ring — see Channel.acquire_slot).
-S_METHOD, S_ARGS, S_KWARGS, S_MUX, S_CTX, S_T0, S_RESP, S_OK, S_VALUE = range(9)
-SLOT_WIDTH = 9
+#: S_NEXT carries a pipeline continuation (_StageCont) or None: the demux
+#: forwards the result to the next stage instead of resolving the caller.
+(S_METHOD, S_ARGS, S_KWARGS, S_MUX, S_CTX, S_T0, S_RESP, S_OK, S_VALUE,
+ S_NEXT) = range(10)
+SLOT_WIDTH = 10
 
 #: How long the loop blocks per read — doubles as the replica-death poll
 #: interval, bounding local fallback detection.
@@ -208,9 +233,11 @@ class CompiledResponse:
 
 def _redispatch_one(router, rt, method: str, args: tuple, kwargs: dict,
                     mux: Optional[str], resp: CompiledResponse,
-                    attempt: int) -> None:
+                    attempt: int, cont=None) -> None:
     """Re-assign one torn-down request through the dynamic path, with the
-    same death-retry budget DeploymentResponse gives its callers."""
+    same death-retry budget DeploymentResponse gives its callers.  A
+    pipeline continuation (``cont``) keeps flowing: the dynamic result
+    feeds the next stage instead of resolving the caller."""
     from ray_tpu.exceptions import ActorDiedError
 
     send_kwargs = kwargs
@@ -230,12 +257,17 @@ def _redispatch_one(router, rt, method: str, args: tuple, kwargs: dict,
             timer = threading.Timer(
                 0.2 * (attempt + 1), _redispatch_one,
                 args=(router, rt, method, args, kwargs, mux, resp,
-                      attempt + 1))
+                      attempt + 1, cont))
             timer.daemon = True
             timer.start()
             return
         if exc is not None:
             resp._resolve(None, exc)
+        elif cont is not None:
+            try:
+                cont.feed(f.result(), resp, None)
+            except Exception as e:  # noqa: BLE001 — caller must not hang
+                resp._resolve(None, e)
         else:
             resp._resolve(f.result(), None)
 
@@ -246,8 +278,9 @@ def _redispatch_pending(router, pending: List[tuple]) -> None:
     from ray_tpu._private import runtime as _rt
 
     rt = _rt.get_runtime()
-    for method, args, kwargs, mux, resp in pending:
-        _redispatch_one(router, rt, method, args, kwargs or {}, mux, resp, 0)
+    for method, args, kwargs, mux, resp, cont in pending:
+        _redispatch_one(router, rt, method, args, kwargs or {}, mux, resp, 0,
+                        cont)
 
 
 class _Lane:
@@ -288,6 +321,58 @@ class _Lane:
     def start(self) -> None:
         self._loop_thread.start()
         self._demux_thread.start()
+
+    # ------------------------------------------------------------ submission
+    def submit(self, method: str, args: tuple, kwargs: dict,
+               mux: Optional[str], resp: CompiledResponse, cont) -> bool:
+        """Lower one request onto this lane's request channel; False means
+        'use the dynamic path' (teardown raced us) — never an error."""
+        scheduler = self.graph.router._scheduler
+        slot = self.req.acquire_slot()
+        slot[S_METHOD] = method
+        slot[S_ARGS] = args
+        slot[S_KWARGS] = kwargs
+        slot[S_MUX] = mux
+        # _ROOTLESS_CTX (not None) when tracing is on but the caller holds
+        # no enclosing span: the demux then still exports a root
+        # serve.compiled_route span for the request, matching the dynamic
+        # path (assign_request opens serve.route unconditionally).
+        slot[S_CTX] = ((_tracing.active_span() or _ROOTLESS_CTX)
+                       if _tracing.is_tracing_enabled() else None)
+        slot[S_T0] = time.time()
+        slot[S_RESP] = resp
+        slot[S_NEXT] = cont
+        # Pre-send inflight accounting, mirroring Router._dispatch: the
+        # demux decrements on completion; destroy() undoes it for requests
+        # drained back out of a torn-down channel.
+        scheduler.on_request_sent(self.rid)
+        try:
+            self.req.write(slot)
+        except ChannelClosed:
+            scheduler.on_request_done(self.rid)
+            self.req.release_slot(slot)
+            return False
+        return True
+
+    # ------------------------------------------------------------- teardown
+    def close_req(self) -> None:
+        self.req.close()
+
+    def join_loop(self, timeout: float) -> None:
+        self._loop_thread.join(timeout=timeout)
+
+    def drain_pending(self, out: List[tuple]) -> None:
+        """Pull never-executed requests back out of the closed request
+        channel for dynamic re-dispatch."""
+        scheduler = self.graph.router._scheduler
+        for slot in self.req.read_ready(1 << 30):  # pairs_with: release_slot
+            scheduler.on_request_done(self.rid)
+            out.append((slot[S_METHOD], slot[S_ARGS], slot[S_KWARGS],
+                        slot[S_MUX], slot[S_RESP], slot[S_NEXT]))
+            # A drained slot must go back to the ring like the demux
+            # path does — otherwise every drained request permanently
+            # shrinks the free list and pins its args/response future.
+            self.req.release_slot(slot)
 
     # ------------------------------------------------------------ resolution
     def _fusion_for(self, method: str):
@@ -577,7 +662,19 @@ class _Lane:
             errors = 0
             for slot in batch:
                 if slot[S_OK]:
-                    slot[S_RESP]._resolve(slot[S_VALUE], None)
+                    cont = slot[S_NEXT]
+                    if cont is not None:
+                        # Pipeline hop: the value flows to the next stage
+                        # (typed edge -> its compiled lanes) instead of
+                        # resolving the caller — the caller's future rides
+                        # along and resolves at the LAST stage.
+                        try:
+                            cont.feed(slot[S_VALUE], slot[S_RESP],
+                                      slot[S_CTX])
+                        except Exception as e:  # noqa: BLE001 — never hang
+                            slot[S_RESP]._resolve(None, e)
+                    else:
+                        slot[S_RESP]._resolve(slot[S_VALUE], None)
                 else:
                     errors += 1
                     slot[S_RESP]._resolve(None, slot[S_VALUE])
@@ -614,6 +711,245 @@ class _Lane:
         self.graph._lane_closed(self)
 
 
+def _process_lane_loop(instance, req, resp) -> None:
+    """Resident loop for a PROCESS-tier replica lane, running inside the
+    replica's worker process (shipped via the process pool's ``actor_exec``
+    like compiled-DAG worker loops).  Drains request records from the shm
+    channel, executes them against the replica instance's normal
+    ``handle_request`` entry — fault points, metrics, and replica context
+    behave exactly like the dynamic path — and writes one batched response
+    message per drain.  Exits when the driver closes the request channel
+    (buffered records are executed first: reads stay valid on a closed shm
+    channel until empty)."""
+    from ray_tpu.exceptions import TaskError
+
+    task_repr = f"{type(instance).__name__}.handle_request"
+    while True:
+        try:
+            first = req.read(timeout=0.25)
+        except ChannelTimeout:
+            continue
+        except Exception:  # noqa: BLE001 — ChannelClosed or a dead arena
+            break
+        batch = [first]
+        # Opportunistic micro-batch: whatever the driver already sealed
+        # rides along in one execute/reply cycle (one shm write back).
+        while len(batch) < 32:
+            try:
+                batch.append(req.read(timeout=0.001))
+            except Exception:  # noqa: BLE001 — empty, closed, or torn down
+                break
+        out = []
+        for seq, method, args, kwargs, mux in batch:
+            kw = dict(kwargs or {})
+            if mux:
+                kw["_serve_multiplexed_model_id"] = mux
+            try:
+                out.append((seq, True,
+                            instance.handle_request(method, *args, **kw)))
+            except BaseException as e:  # noqa: BLE001 — per-request error
+                err = e if isinstance(e, TaskError) else TaskError(
+                    e, task_repr=task_repr)
+                out.append((seq, False, err))
+        try:
+            resp.write(out, timeout=30.0)
+        except Exception:  # noqa: BLE001 — reader gone: nothing to flush to
+            break
+    try:
+        resp.close()
+    except Exception:
+        pass
+
+
+class _ProcessLane:
+    """One PROCESS-tier replica's compiled lane.
+
+    The replica has no shared-heap instance (``isolation='process'``), so
+    the request/response pair are :class:`SharedMemoryChannel`\\ s over the
+    native plasma arena (picklable by path) and the resident loop runs
+    inside the replica's worker process (see :func:`_process_lane_loop`).
+    The driver side keeps a seq -> waiter map; a demux thread drains the
+    response channel, resolves futures, and keeps the router's queue
+    accounting exact.  The host thread blocks in the worker's ``actor_exec``
+    round-trip for the lane's lifetime — the worker runs it on its own
+    bounded thread pool, so control-plane calls (check_health,
+    prepare_for_shutdown) never starve behind the data plane."""
+
+    def __init__(self, graph: "_CompiledGraph", row: Dict[str, Any],
+                 actor_state) -> None:
+        import uuid
+
+        from ray_tpu._private.runtime import get_runtime
+        from ray_tpu.dag.channel import SharedMemoryChannel, seed_arena_client
+
+        rt = get_runtime()
+        arena_path = rt.store.arena_path
+        if arena_path is None:
+            raise _NotCompilable(
+                "process-tier lanes need the native plasma arena "
+                "(store has none)")
+        seed_arena_client(arena_path, rt.store.plasma)
+        self.graph = graph
+        self.rid: str = row["replica_id"]
+        self.max_ongoing = max(1, int(row.get("max_ongoing_requests") or 1))
+        self.state = actor_state
+        self._worker = actor_state.proc_worker
+        ns = uuid.uuid4().hex[:12]  # arena keys must not collide across
+        self.req = SharedMemoryChannel(  # compile/teardown cycles
+            arena=rt.store.plasma, arena_path=arena_path,
+            name=f"serve-preq:{self.rid}:{ns}",
+            maxsize=max(64, 2 * self.max_ongoing))
+        self.resp = SharedMemoryChannel(
+            arena=rt.store.plasma, arena_path=arena_path,
+            name=f"serve-presp:{self.rid}:{ns}", maxsize=64)
+        #: seq -> (method, args, kwargs, mux, resp, cont, t0, ctx).  The
+        #: demux and the teardown re-dispatcher both claim entries via
+        #: atomic dict pops, so exactly one resolver owns each request.
+        self._pending: Dict[int, tuple] = {}
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._route_attrs = {"deployment": graph.deployment_id,
+                             "replica": self.rid}
+        self._host_thread = threading.Thread(
+            target=self._run_host, daemon=True,
+            name=f"serve-compiled-ploop-{self.rid}")
+        self._demux_thread = threading.Thread(
+            target=self._run_demux, daemon=True,
+            name=f"serve-compiled-pdemux-{self.rid}")
+
+    def start(self) -> None:
+        self._host_thread.start()
+        self._demux_thread.start()
+
+    # ------------------------------------------------------------ submission
+    def submit(self, method: str, args: tuple, kwargs: dict,
+               mux: Optional[str], resp: CompiledResponse, cont) -> bool:
+        with self._seq_lock:
+            seq = self._seq
+            self._seq += 1
+        ctx = ((_tracing.active_span() or _ROOTLESS_CTX)
+               if _tracing.is_tracing_enabled() else None)
+        scheduler = self.graph.router._scheduler
+        self._pending[seq] = (method, args, kwargs, mux, resp, cont,
+                              time.time(), ctx)
+        scheduler.on_request_sent(self.rid)
+        try:
+            self.req.write((seq, method, args, kwargs, mux), timeout=5.0)
+        except Exception:  # noqa: BLE001 — closed, full past the timeout,
+            # or an unpicklable payload: undo and let the dynamic path
+            # carry the request (it ships args through the same pickler,
+            # but failing over keeps this path's contract error-free).
+            self._pending.pop(seq, None)
+            scheduler.on_request_done(self.rid)
+            return False
+        return True
+
+    # ------------------------------------------------------------- loop host
+    def _run_host(self) -> None:
+        """Hosts the worker-side resident loop request (mirrors
+        CompiledDAG._proc_loop_runner); returns when the loop exits on the
+        teardown close — or on worker death, where closing both channels
+        unblocks the demux so local fallback does not wait for the
+        controller's health probe."""
+        from ray_tpu._private import serialization
+
+        try:
+            self._worker.actor_exec(
+                serialization.dumps(_process_lane_loop),
+                (self.req, self.resp), {})
+        except Exception:
+            pass
+        finally:
+            self.req.close()
+            self.resp.close()
+
+    # ------------------------------------------------------------- teardown
+    def close_req(self) -> None:
+        self.req.close()
+
+    def join_loop(self, timeout: float) -> None:
+        self._host_thread.join(timeout=timeout)
+
+    def drain_pending(self, out: List[tuple]) -> None:
+        """Collect unresolved requests for dynamic re-dispatch.  The worker
+        loop executes everything already buffered before exiting, so give
+        the demux a short window to resolve those normally; what remains
+        afterwards was lost with the worker (at-least-once on this edge,
+        matching the dynamic path's death retry)."""
+        deadline = time.monotonic() + 2.0
+        while (self._pending and self._demux_thread.is_alive()
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        scheduler = self.graph.router._scheduler
+        while True:
+            try:
+                _, entry = self._pending.popitem()
+            except KeyError:
+                break
+            method, args, kwargs, mux, resp, cont, _, _ = entry
+            scheduler.on_request_done(self.rid)
+            out.append((method, args, kwargs, mux, resp, cont))
+
+    # ------------------------------------------------------------ demux side
+    def _run_demux(self) -> None:
+        from ray_tpu.serve import metrics as serve_metrics
+
+        router = self.graph.router
+        scheduler = router._scheduler
+        tags = router._metric_tags
+        while True:
+            try:
+                batch = self.resp.read(timeout=0.5)
+            except ChannelTimeout:
+                if self.state.state != "ALIVE":
+                    break  # replica died: local fallback, no probe wait
+                continue
+            except Exception:  # noqa: BLE001 — closed or arena torn down
+                break
+            now = time.time()
+            errors = 0
+            done = 0
+            spans = [] if _tracing.is_tracing_enabled() else None
+            latencies = []
+            first_ctx = None
+            for seq, ok, value in batch:
+                entry = self._pending.pop(seq, None)
+                if entry is None:
+                    continue  # the teardown re-dispatcher claimed it
+                method, args, kwargs, mux, resp, cont, t0, ctx = entry
+                done += 1
+                if ok:
+                    if cont is not None:
+                        try:
+                            cont.feed(value, resp, ctx)
+                        except Exception as e:  # noqa: BLE001 — never hang
+                            resp._resolve(None, e)
+                    else:
+                        resp._resolve(value, None)
+                else:
+                    errors += 1
+                    resp._resolve(None, value)
+                latencies.append(now - t0)
+                if ctx is not None:
+                    if first_ctx is None:
+                        first_ctx = ctx
+                    if spans is not None:
+                        spans.append((t0, now, ctx))
+            if not done:
+                continue
+            scheduler.on_request_done(self.rid, done)
+            serve_metrics.REQUEST_LATENCY.observe_batch(
+                latencies, tags=tags,
+                exemplar=serve_metrics.trace_exemplar(first_ctx))
+            serve_metrics.REQUESTS_TOTAL.inc(done, tags=tags)
+            if errors:
+                serve_metrics.ERRORS_TOTAL.inc(errors, tags=tags)
+            if spans:
+                _tracing.record_span_batch("serve.compiled_route", spans,
+                                           attributes=self._route_attrs)
+        self.graph._lane_closed(self)
+
+
 class _CompiledGraph:
     """The compiled route for one (router, replica-set) pair."""
 
@@ -624,23 +960,32 @@ class _CompiledGraph:
         self.manager = manager
         self.deployment_id = router.deployment_id
         rt = _rt.get_runtime()
-        lanes: Dict[str, _Lane] = {}
+        lanes: Dict[str, Any] = {}
         for row in rows:
             actor = row.get("actor")
             if actor is None:
                 raise _NotCompilable(f"replica {row.get('replica_id')} "
                                      f"carries no actor handle")
             st = rt.get_actor_state(actor._actor_id)
-            if st is None or st.state != "ALIVE" or st.instance is None:
-                # Process/node-tier replicas (no shared-heap instance) and
-                # corpses cannot be lowered — the route stays dynamic.
+            if st is None or st.state != "ALIVE":
                 raise _NotCompilable(
-                    f"replica {row['replica_id']} is not a live thread-tier "
-                    f"actor")
-            if not hasattr(st.instance, "_wrapper"):
+                    f"replica {row['replica_id']} is not a live actor")
+            if st.instance is not None:
+                # Thread tier: the replica instance shares our heap — the
+                # lane executes it directly on a resident driver thread.
+                if not hasattr(st.instance, "_wrapper"):
+                    raise _NotCompilable(
+                        f"replica {row['replica_id']} is not a serve replica")
+                lanes[row["replica_id"]] = _Lane(self, row, st, st.instance)
+            elif getattr(st, "proc_worker", None) is not None:
+                # Process tier: shm channels + a worker-resident loop.
+                lanes[row["replica_id"]] = _ProcessLane(self, row, st)
+            else:
+                # Node-tier (remote) replicas cannot be lowered — the
+                # route stays dynamic.
                 raise _NotCompilable(
-                    f"replica {row['replica_id']} is not a serve replica")
-            lanes[row["replica_id"]] = _Lane(self, row, st, st.instance)
+                    f"replica {row['replica_id']} has no local execution "
+                    f"surface (node tier)")
         if not lanes:
             raise _NotCompilable("empty replica set")
         self._lanes = lanes
@@ -653,50 +998,40 @@ class _CompiledGraph:
         for lane in lanes.values():
             lane.start()
 
-    def submit(self, method: str, args: tuple,
-               kwargs: dict) -> Optional[CompiledResponse]:
-        """Lower one request onto a lane; None means 'use the dynamic path'
-        (teardown race, unknown replica) — never an error."""
+    def _submit_core(self, method: str, args: tuple, kwargs: dict,
+                     resp: CompiledResponse, cont) -> bool:
         router = self.router
         mux = kwargs.get("_serve_multiplexed_model_id")
         lane = self._single_lane
         if lane is None:
             row = router._scheduler.choose_replica(mux or None)
             if row is None:
-                return None
+                return False
             lane = self._lanes.get(row["replica_id"])
             if lane is None:
-                return None
+                return False
         if mux is not None:
             kwargs = {k: v for k, v in kwargs.items()
                       if k != "_serve_multiplexed_model_id"}
-        resp = CompiledResponse()
-        slot = lane.req.acquire_slot()
-        slot[S_METHOD] = method
-        slot[S_ARGS] = args
-        slot[S_KWARGS] = kwargs
-        slot[S_MUX] = mux
-        # _ROOTLESS_CTX (not None) when tracing is on but the caller holds
-        # no enclosing span: the demux then still exports a root
-        # serve.compiled_route span for the request, matching the dynamic
-        # path (assign_request opens serve.route unconditionally).
-        slot[S_CTX] = ((_tracing.active_span() or _ROOTLESS_CTX)
-                       if _tracing.is_tracing_enabled() else None)
-        slot[S_T0] = time.time()
-        slot[S_RESP] = resp
-        # Pre-send inflight accounting, mirroring Router._dispatch: the
-        # demux decrements on completion; destroy() undoes it for requests
-        # drained back out of a torn-down channel.
-        router._scheduler.on_request_sent(lane.rid)
-        try:
-            lane.req.write(slot)
-        except ChannelClosed:
-            router._scheduler.on_request_done(lane.rid)
-            lane.req.release_slot(slot)
-            return None
-        return resp
+        return lane.submit(method, args, kwargs, mux, resp, cont)
 
-    def _lane_closed(self, lane: _Lane) -> None:
+    def submit(self, method: str, args: tuple,
+               kwargs: dict) -> Optional[CompiledResponse]:
+        """Lower one request onto a lane; None means 'use the dynamic path'
+        (teardown race, unknown replica) — never an error."""
+        resp = CompiledResponse()
+        if self._submit_core(method, args, kwargs, resp, None):
+            return resp
+        return None
+
+    def submit_forward(self, method: str, args: tuple, kwargs: dict,
+                       resp: CompiledResponse, cont) -> bool:
+        """Pipeline-hop entry: lower a mid-pipeline request that already
+        carries its caller's future (and possibly a further continuation);
+        False means 'this hop must go dynamic' — never an error."""
+        return self._submit_core(method, args, kwargs, resp, cont)
+
+    def _lane_closed(self, lane) -> None:
         self.manager._graph_broken(self, lane.rid)
 
     def destroy(self) -> None:
@@ -711,19 +1046,12 @@ class _CompiledGraph:
                 return
             self._destroyed = True
         for lane in self._lanes.values():
-            lane.req.close()
+            lane.close_req()
         for lane in self._lanes.values():
-            lane._loop_thread.join(timeout=2.0)
-        pending = []
+            lane.join_loop(2.0)
+        pending: List[tuple] = []
         for lane in self._lanes.values():
-            for slot in lane.req.read_ready(1 << 30):  # pairs_with: release_slot
-                self.router._scheduler.on_request_done(lane.rid)
-                pending.append((slot[S_METHOD], slot[S_ARGS], slot[S_KWARGS],
-                                slot[S_MUX], slot[S_RESP]))
-                # A drained slot must go back to the ring like the demux
-                # path does — otherwise every drained request permanently
-                # shrinks the free list and pins its args/response future.
-                lane.req.release_slot(slot)
+            lane.drain_pending(pending)
         if pending:
             t = threading.Thread(
                 target=_redispatch_pending, args=(self.router, pending),
@@ -750,7 +1078,33 @@ class CompiledRouteManager:
         self._fallback_since = time.monotonic()
         self._config_enabled: Optional[bool] = None
         self._stopped = False
+        #: Pipelines subscribed to this stage's teardowns.  # guarded_by: _lock
+        self._listeners: List[Any] = []
         COMPILED_MODE_GAUGE.set(0.0, tags=self._dep_tags)
+
+    def add_teardown_listener(self, fn) -> None:
+        """Register a callback fired whenever this route's compiled graph
+        is detached (membership change, local death, stop) — pipelines use
+        it to close their inter-stage edges so every hop degrades to the
+        dynamic path together."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def remove_teardown_listener(self, fn) -> None:
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
+
+    def _notify_teardown(self) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — teardown must not fail
+                pass
 
     @property
     def graph(self) -> Optional[_CompiledGraph]:
@@ -775,6 +1129,7 @@ class CompiledRouteManager:
                 self._uncompilable_sig = None
                 graph = self._detach_locked()
         if graph is not None:
+            self._notify_teardown()
             graph.destroy()
 
     def _detach_locked(self) -> Optional[_CompiledGraph]:
@@ -834,6 +1189,7 @@ class CompiledRouteManager:
                 "deployment": self._dep_tags["deployment"],
                 "replica": replica_id,
             })
+            self._notify_teardown()
         graph.destroy()
 
     def stop(self) -> None:
@@ -841,4 +1197,226 @@ class CompiledRouteManager:
             self._stopped = True
             graph = self._detach_locked()
         if graph is not None:
+            self._notify_teardown()
             graph.destroy()
+
+
+class _StageCont:
+    """Continuation carried in a slot's S_NEXT: 'when this stage's result
+    is ready, feed it into pipeline stage ``index``' — the demux (or the
+    dynamic-fallback callback) invokes it instead of resolving the
+    caller."""
+
+    __slots__ = ("pipeline", "index")
+
+    def __init__(self, pipeline: "ServePipeline", index: int) -> None:
+        self.pipeline = pipeline
+        self.index = index
+
+    def feed(self, value: Any, resp: CompiledResponse, ctx) -> None:
+        self.pipeline._feed(self.index, value, resp, ctx)
+
+
+class _PipelineEdge:
+    """One inter-stage hop: a typed :class:`DeviceChannel` plus a feeder
+    thread that submits arrivals into the downstream stage.  With a device
+    assigned, the payload lands on the consumer stage's device at write
+    time (``payload_index=0`` — the rider future/ctx fields stay on host).
+    On close the feeder drains every buffered record (reads stay valid on
+    a closed channel until empty) through ``_submit_stage``, whose dynamic
+    fallback guarantees no request is dropped."""
+
+    def __init__(self, pipeline: "ServePipeline", index: int,
+                 device) -> None:
+        self.pipeline = pipeline
+        self.index = index  # downstream stage this edge feeds
+        self.chan = DeviceChannel(
+            device=device, maxsize=64,
+            name=f"serve-pipe:{pipeline.name}:{index}", payload_index=0)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"serve-pipe-feed-{pipeline.name}-{index}")
+        self._thread.start()
+
+    def write(self, record: tuple) -> bool:
+        """False means 'edge unusable — take the direct path'."""
+        try:
+            self.chan.write(record, timeout=5.0)
+        except (ChannelClosed, ChannelTimeout):
+            return False
+        return True
+
+    def _run(self) -> None:
+        while True:
+            try:
+                value, resp, ctx = self.chan.read(timeout=0.5)
+            except ChannelTimeout:
+                continue
+            except ChannelClosed:
+                break  # closed AND drained
+            try:
+                self.pipeline._submit_stage(self.index, (value,), {}, resp)
+            except Exception as e:  # noqa: BLE001 — caller must not hang
+                resp._resolve(None, e)
+
+    def close(self) -> None:
+        self.chan.close()
+
+    def join(self, timeout: float) -> None:
+        self._thread.join(timeout=timeout)
+
+
+class ServePipeline:
+    """A multi-stage compiled serve graph: deployment handles chained so a
+    request traverses stage 0 -> 1 -> ... -> n-1 entirely as channel
+    traffic.  Stage i's demux forwards each result through a typed
+    DeviceChannel edge straight into stage i+1's compiled lanes (S_NEXT
+    continuation); the caller's CompiledResponse rides the whole chain and
+    resolves with the LAST stage's result.
+
+    Degradation is per hop and reconciler-driven: each stage's
+    CompiledRouteManager notifies the pipeline on teardown, the edges
+    close, and every hop independently falls back to dynamic dispatch
+    (``router.assign_request``) until the stage recompiles — callers see
+    results, never errors, through a membership change.  Backpressure is
+    natural: inter-stage writes block on a full edge, and the chain is
+    acyclic, so there is no deadlock.
+
+    Built via :func:`ray_tpu.serve.pipeline`."""
+
+    def __init__(self, handles: List[Any],
+                 methods: Optional[List[str]] = None,
+                 devices: Optional[List[Any]] = None,
+                 name: str = "pipeline") -> None:
+        if len(handles) < 2:
+            raise ValueError("a serve pipeline needs at least two stages")
+        if methods is not None and len(methods) != len(handles):
+            raise ValueError("methods must match stages 1:1")
+        if devices is not None and len(devices) != len(handles) - 1:
+            raise ValueError("devices must match inter-stage edges 1:1 "
+                             "(one fewer than stages)")
+        self.name = name
+        self._handles = list(handles)
+        self._routers = [h._get_router() for h in handles]
+        self._methods = (list(methods) if methods is not None else
+                         [getattr(h, "_method_name", None) or "__call__"
+                          for h in handles])
+        self._devices = list(devices) if devices is not None else (
+            [None] * (len(handles) - 1))
+        self._fwd_tags = {"pipeline": name}
+        self._lock = threading.Lock()
+        #: _edges[i] feeds stage i (index 0 unused); None = direct/dynamic.
+        self._edges: List[Optional[_PipelineEdge]] = [None] * len(handles)
+        #: _conts[i] = what stage i's demux does with its result; the last
+        #: stage has no continuation — its demux resolves the caller.
+        self._conts: List[Optional[_StageCont]] = (
+            [_StageCont(self, i + 1) for i in range(len(handles) - 1)]
+            + [None])
+        self._edges_built = False  # guarded_by: _lock
+        #: Unsynchronized fast-path mirror of _edges_built: a stale read
+        #: only costs taking the lock (or retrying the build on the next
+        #: remote()), never a wrong edge.
+        self._edges_ready = False
+        self._stopped = False
+        self._teardown_cbs = []
+        for router in self._routers:
+            cb = self._on_stage_teardown  # one shared bound method is fine
+            router._compiled.add_teardown_listener(cb)
+            self._teardown_cbs.append((router, cb))
+
+    # ---------------------------------------------------------------- public
+    @property
+    def mode(self) -> str:
+        """'compiled' when every stage currently runs its compiled route."""
+        return ("compiled" if all(r._compiled.graph is not None
+                                  for r in self._routers) else "dynamic")
+
+    def remote(self, *args, **kwargs) -> CompiledResponse:
+        """Submit one request to stage 0; the returned future resolves
+        with the LAST stage's result."""
+        if self._stopped:
+            raise RuntimeError(f"pipeline {self.name!r} is stopped")
+        self._maybe_build_edges()
+        resp = CompiledResponse()
+        self._submit_stage(0, args, kwargs, resp)
+        return resp
+
+    def stop(self) -> None:
+        """Close the edges and unsubscribe from the stages (the stages'
+        own routes keep running — they belong to serve, not to us)."""
+        self._stopped = True
+        for router, cb in self._teardown_cbs:
+            router._compiled.remove_teardown_listener(cb)
+        self._teardown_cbs = []
+        self._close_edges()
+
+    # ------------------------------------------------------------- internals
+    def _maybe_build_edges(self) -> None:
+        """Lazily (re)build the inter-stage edges once every stage is on
+        its compiled route.  Cheap dirty check outside the lock — the hot
+        path after build is one boolean read."""
+        if self._edges_ready or self._stopped:
+            return
+        if any(r._compiled.graph is None for r in self._routers):
+            return  # some stage still dynamic: hops stay direct
+        with self._lock:
+            if self._edges_built or self._stopped:
+                return
+            for i in range(1, len(self._handles)):
+                if self._edges[i] is None:
+                    self._edges[i] = _PipelineEdge(self, i,
+                                                   self._devices[i - 1])
+            self._edges_built = True
+            self._edges_ready = True
+        # A teardown may have raced the build: re-check and unwind so a
+        # stale edge never outlives its stage's compiled route.
+        if any(r._compiled.graph is None for r in self._routers):
+            self._close_edges()
+
+    def _on_stage_teardown(self) -> None:
+        self._close_edges()
+
+    def _close_edges(self) -> None:
+        with self._lock:
+            edges = [e for e in self._edges if e is not None]
+            self._edges = [None] * len(self._handles)
+            self._edges_built = False
+            self._edges_ready = False
+        for e in edges:  # pairs_with: _PipelineEdge.__init__
+            e.close()  # feeder drains buffered records, then exits
+
+    def _feed(self, index: int, value: Any, resp: CompiledResponse,
+              ctx) -> None:
+        """Forward a stage result into stage ``index`` (called from the
+        upstream demux/fallback with the result in hand)."""
+        PIPELINE_FORWARDS.inc(tags=self._fwd_tags)
+        edge = self._edges[index]
+        if edge is not None and edge.write((value, resp, ctx)):
+            return
+        self._submit_stage(index, (value,), {}, resp)
+
+    def _submit_stage(self, index: int, args: tuple, kwargs: dict,
+                      resp: CompiledResponse) -> None:
+        """Lower one request into stage ``index``'s compiled lanes, or
+        fall back to the dynamic path for this hop.  Either way the
+        request keeps flowing — errors land in ``resp``, never raise."""
+        cont = self._conts[index]
+        router = self._routers[index]
+        graph = router._compiled.graph
+        if graph is not None:
+            try:
+                if graph.submit_forward(self._methods[index], args,
+                                        kwargs, resp, cont):
+                    return
+            except Exception as e:  # noqa: BLE001 — caller must not hang
+                resp._resolve(None, e)
+                return
+        from ray_tpu._private import runtime as _rt
+
+        try:
+            rt = _rt.get_runtime()
+        except Exception as e:  # noqa: BLE001 — shutdown race
+            resp._resolve(None, e)
+            return
+        _redispatch_one(router, rt, self._methods[index], args, kwargs,
+                        None, resp, 0, cont)

@@ -16,6 +16,11 @@ iteration:
 - any value       -> emitted as the next item on that stream
 - ``None``        -> no emission this iteration (e.g. chunked prefill)
 - ``serve.EOS``   -> the sequence is finished; its stream ends
+- ``Emissions``   -> several items emitted in one iteration (speculative
+                     decoding banks k+1 tokens per verify pass; draining
+                     them one iteration apiece would re-serialize the win
+                     behind every other stream's device burn), optionally
+                     ending the stream in the same step (``eos=True``)
 - an ``Exception``-> that stream errors; the others continue (per-request
                      error isolation)
 
@@ -65,6 +70,25 @@ class _EOSType:
 
 
 EOS = _EOSType()
+
+
+class Emissions:
+    """Multi-item emission for one sequence in one iteration.
+
+    A step that produced several tokens for a stream (speculative decoding
+    accepts up to k+1 per verify pass) returns ``Emissions(tokens)`` and
+    every token lands on the stream THIS iteration — consumers see them
+    back-to-back instead of one per device burn.  ``eos=True`` retires the
+    sequence right after the last item (no extra drain iteration)."""
+
+    __slots__ = ("items", "eos")
+
+    def __init__(self, items: List[Any], eos: bool = False):
+        self.items = items
+        self.eos = eos
+
+    def __repr__(self) -> str:
+        return f"serve.Emissions({len(self.items)} items, eos={self.eos})"
 
 
 class SequenceSlot:
@@ -209,6 +233,11 @@ class _Engine:
                     slot._live = False
                 elif out is EOS:
                     self._retire(slot, "done", None)
+                elif isinstance(out, Emissions):
+                    for v in out.items:
+                        slot._out.put_nowait(("item", v))
+                    if out.eos:
+                        self._retire(slot, "done", None)
                 elif isinstance(out, Exception):
                     self._retire(slot, "err", out)
                 elif out is not None:

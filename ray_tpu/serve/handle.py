@@ -68,16 +68,25 @@ class DeploymentResponse:
 class DeploymentResponseGenerator:
     """Streaming result of handle.options(stream=True).remote(...)
     (ref: handle.py DeploymentResponseGenerator): iterate sync or async;
-    every item is one pull from the replica that opened the stream.  The
-    stream id is an ObjectRef resolved lazily at the first pull, so
-    creating the generator never blocks (safe inside async replicas)."""
+    each pull drains everything the pinned replica's generator can yield
+    without suspending (one RPC per burst, not per item), buffered locally
+    between pulls.  The stream id is an ObjectRef resolved lazily at the
+    first pull, so creating the generator never blocks (safe inside async
+    replicas)."""
 
     def __init__(self, replica_actor, stream_id_ref, on_done=None):
+        from collections import deque
+
         self._actor = replica_actor
         self._sid_ref = stream_id_ref
         self._sid: Optional[str] = None
         self._on_done = on_done
         self._finished = False
+        #: Locally-buffered burst from a batched pull: the replica ships
+        #: every item its generator can yield without suspending in ONE
+        #: actor round-trip (("items", [..]) / ("items_done", [..])), and
+        #: iteration drains this buffer before the next RPC.
+        self._buf = deque()
         #: The REPLICA ended the stream (done marker, or an exception the
         #: replica raised — it reaps its slot on those).  A local abort
         #: (pull timeout, task cancellation, consumer bailing) leaves the
@@ -104,6 +113,8 @@ class DeploymentResponseGenerator:
     def __next__(self):
         import ray_tpu
 
+        if self._buf:
+            return self._buf.popleft()
         if self._finished:
             raise StopIteration
         try:
@@ -118,11 +129,7 @@ class DeploymentResponseGenerator:
                 self._server_done = True
             self._finish(e)
             raise
-        if kind == "done":
-            self._server_done = True
-            self._finish()
-            raise StopIteration
-        return value
+        return self._accept(kind, value, StopIteration)
 
     def __aiter__(self):
         return self
@@ -130,6 +137,8 @@ class DeploymentResponseGenerator:
     async def __anext__(self):
         from ray_tpu._private import runtime as _rt
 
+        if self._buf:
+            return self._buf.popleft()
         if self._finished:
             raise StopAsyncIteration
         try:
@@ -145,11 +154,25 @@ class DeploymentResponseGenerator:
                 self._server_done = True
             self._finish(e)
             raise
+        return self._accept(kind, value, StopAsyncIteration)
+
+    def _accept(self, kind: str, value: Any, stop: type):
+        """Fold one pull reply into iteration state and return the next
+        item (or raise ``stop``)."""
         if kind == "done":
             self._server_done = True
             self._finish()
-            raise StopAsyncIteration
-        return value
+            raise stop
+        if kind == "item":
+            return value
+        # "items" / "items_done": a replica-side burst in one round-trip.
+        self._buf.extend(value)
+        if kind == "items_done":
+            # Stream ended server-side with this burst; iteration keeps
+            # draining the local buffer, then stops without another RPC.
+            self._server_done = True
+            self._finish()
+        return self._buf.popleft()
 
     def cancel(self, wait: bool = True) -> None:
         """Release the replica-side iterator.  Fires whenever the REPLICA

@@ -141,6 +141,20 @@ class BlockAllocator:
             page = self._pages[block_id]
             return 0 if page is None else len(page)
 
+    def trim_page(self, block_id: int, length: int) -> None:
+        """Drop entries beyond ``length`` from one block's page in place —
+        the boundary-block half of a speculative-draft rollback.  Caller
+        must hold the only reference (the table COW-copies first)."""
+        with self._lock:
+            page = self._pages[block_id]
+            if page is None:
+                raise ValueError(f"trim of unallocated block {block_id}")
+            if not 0 <= length <= len(page):
+                raise ValueError(
+                    f"trim of block {block_id} to {length} entries "
+                    f"(page holds {len(page)})")
+            del page[length:]
+
     def copy_block(self, block_id: int) -> int:
         """Materialize a private copy of ``block_id`` (copy-on-write): a
         fresh block with the same payloads; the source loses one ref."""
@@ -228,6 +242,37 @@ class BlockTable:
         child.block_ids = list(self.block_ids)
         child.num_tokens = self.num_tokens
         return child
+
+    def truncate(self, num_tokens: int) -> None:
+        """Roll the table back to its first ``num_tokens`` entries — the
+        reversal of speculative-draft appends (rejected or over-budget
+        draft KV pages must not outlive the verify step).  Whole tail
+        blocks return to the pool; a partially-kept boundary block is
+        trimmed in place, COW-copying first when a forked sibling still
+        shares it (the sibling's view of the dropped entries survives).
+
+        Raises nothing on the no-op case (``num_tokens == self.num_tokens``)
+        so exit paths can call it unconditionally."""
+        if not 0 <= num_tokens <= self.num_tokens:
+            raise ValueError(
+                f"truncate to {num_tokens} of {self.num_tokens} tokens")
+        if num_tokens == self.num_tokens:
+            return
+        alloc = self.allocator
+        keep_blocks = alloc.blocks_needed(num_tokens) if num_tokens else 0
+        tail = self.block_ids[keep_blocks:]
+        if tail:
+            alloc.free(tail)
+        self.block_ids = self.block_ids[:keep_blocks]
+        boundary = num_tokens % alloc.block_size
+        if boundary:
+            b = self.block_ids[-1]
+            if alloc.refcount(b) > 1:
+                # Shared with a fork — trimming in place would tear the
+                # sibling's entries out from under it.
+                self.block_ids[-1] = b = alloc.copy_block(b)
+            alloc.trim_page(b, boundary)
+        self.num_tokens = num_tokens
 
     def release(self) -> None:
         """Return every block reference; the table becomes empty."""

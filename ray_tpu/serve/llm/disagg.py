@@ -34,7 +34,7 @@ from ray_tpu.serve.llm import metrics as _m
 from ray_tpu.serve.llm.blocks import BlockAllocator, BlockTable, NoFreeBlocks
 from ray_tpu.serve.llm.engine import LLMEngine, compose_model_key
 from ray_tpu.serve.llm.handoff import export_kv
-from ray_tpu.serve.llm.model import ToyLM, lm_from_weights
+from ray_tpu.serve.llm.model import DraftLM, ToyLM, lm_from_weights
 from ray_tpu.util import tracing as _tracing
 
 #: Default inline model table (tests/bench run without a checkpoint root).
@@ -69,13 +69,18 @@ class _ModelHostMixin:
     def _init_models(self, ckpt_root: Optional[str],
                      model_specs: Optional[Dict[str, Dict[str, Any]]],
                      prefill_time_per_token_s: float,
-                     decode_step_time_s: float) -> None:
+                     decode_step_time_s: float, *,
+                     draft_agreement: float = 1.0,
+                     draft_step_time_s: float = 0.0) -> None:
         self._ckpt_root = ckpt_root
         self._specs = dict(DEFAULT_MODEL_SPECS if model_specs is None
                            else model_specs)
         self._device_lock = threading.Lock()
         self._prefill_time_per_token_s = prefill_time_per_token_s
         self._decode_step_time_s = decode_step_time_s
+        self._draft_agreement = float(draft_agreement)
+        self._draft_step_time_s = float(draft_step_time_s)
+        self._drafts: Dict[str, DraftLM] = {}
 
     @serve.multiplexed(max_num_models_per_replica=4)
     async def _load_model(self, model_key: str) -> ToyLM:
@@ -94,6 +99,18 @@ class _ModelHostMixin:
             prefill_time_per_token_s=self._prefill_time_per_token_s,
             decode_step_time_s=self._decode_step_time_s)
 
+    async def _load_draft(self, model_key: str) -> DraftLM:
+        """Draft model paired with the multiplexed target — rebuilt when
+        the LRU reloads the target so the pair never skews."""
+        target = await self._load_model(model_key)
+        draft = self._drafts.get(model_key)
+        if draft is None or draft.target is not target:
+            draft = self._drafts[model_key] = DraftLM(
+                target, agreement=self._draft_agreement,
+                draft_step_time_s=self._draft_step_time_s,
+                device_lock=self._device_lock)
+        return draft
+
 
 @serve.deployment(max_ongoing_requests=64)
 class LLMServer(_ModelHostMixin):
@@ -106,13 +123,18 @@ class LLMServer(_ModelHostMixin):
                  num_blocks: int = 512, block_size: int = 16,
                  watermark_blocks: int = 0, max_prefill_per_step: int = 1,
                  prefill_time_per_token_s: float = 0.0,
-                 decode_step_time_s: float = 0.0):
+                 decode_step_time_s: float = 0.0,
+                 spec_k: int = 0, draft_agreement: float = 1.0,
+                 draft_step_time_s: float = 0.0):
         self._init_models(ckpt_root, model_specs,
-                          prefill_time_per_token_s, decode_step_time_s)
+                          prefill_time_per_token_s, decode_step_time_s,
+                          draft_agreement=draft_agreement,
+                          draft_step_time_s=draft_step_time_s)
         self._engine = LLMEngine(
             self._load_model, num_blocks=num_blocks, block_size=block_size,
             watermark_blocks=watermark_blocks,
-            max_prefill_per_step=max_prefill_per_step, pool="engine")
+            max_prefill_per_step=max_prefill_per_step, pool="engine",
+            spec_k=spec_k, get_draft_model=self._load_draft)
 
     @serve.continuous_batch(max_batch_size=16)
     async def __call__(self, slots: List[Any]) -> List[Any]:
@@ -205,14 +227,19 @@ class DecodeWorker(_ModelHostMixin):
                  model_specs: Optional[Dict[str, Any]] = None,
                  num_blocks: int = 512, block_size: int = 16,
                  watermark_blocks: int = 0,
-                 decode_step_time_s: float = 0.0):
-        self._init_models(ckpt_root, model_specs, 0.0, decode_step_time_s)
+                 decode_step_time_s: float = 0.0,
+                 spec_k: int = 0, draft_agreement: float = 1.0,
+                 draft_step_time_s: float = 0.0):
+        self._init_models(ckpt_root, model_specs, 0.0, decode_step_time_s,
+                          draft_agreement=draft_agreement,
+                          draft_step_time_s=draft_step_time_s)
         # Admission here is a page import, not a recompute — admit bursts
         # of re-prefilled sequences in one iteration instead of trickling.
         self._engine = LLMEngine(
             self._load_model, num_blocks=num_blocks, block_size=block_size,
             watermark_blocks=watermark_blocks, max_prefill_per_step=8,
-            pool="decode", decode_only=True)
+            pool="decode", decode_only=True,
+            spec_k=spec_k, get_draft_model=self._load_draft)
 
     @serve.continuous_batch(max_batch_size=16)
     async def decode(self, slots: List[Any]) -> List[Any]:
@@ -309,6 +336,8 @@ def build_disagg_app(*, ckpt_root: Optional[str] = None,
                      num_blocks: int = 512, block_size: int = 16,
                      prefill_time_per_token_s: float = 0.0,
                      decode_step_time_s: float = 0.0,
+                     spec_k: int = 0, draft_agreement: float = 1.0,
+                     draft_step_time_s: float = 0.0,
                      deployment_prefix: str = "") -> Any:
     """Bind the prefill pool + decode pool + frontend into one app.
 
@@ -331,7 +360,9 @@ def build_disagg_app(*, ckpt_root: Optional[str] = None,
         num_replicas=decode_replicas).bind(
             ckpt_root=ckpt_root, model_specs=model_specs,
             num_blocks=num_blocks, block_size=block_size,
-            decode_step_time_s=decode_step_time_s)
+            decode_step_time_s=decode_step_time_s,
+            spec_k=spec_k, draft_agreement=draft_agreement,
+            draft_step_time_s=draft_step_time_s)
     return LLMFrontend.options(
         name=f"{deployment_prefix}LLMFrontend",
         num_replicas=frontend_replicas).bind(prefill, decode)
@@ -342,10 +373,14 @@ def build_monolithic_app(*, ckpt_root: Optional[str] = None,
                          num_replicas: int = 1, num_blocks: int = 512,
                          block_size: int = 16,
                          prefill_time_per_token_s: float = 0.0,
-                         decode_step_time_s: float = 0.0) -> Any:
+                         decode_step_time_s: float = 0.0,
+                         spec_k: int = 0, draft_agreement: float = 1.0,
+                         draft_step_time_s: float = 0.0) -> Any:
     """The continuous-batching baseline on identical model timing."""
     return LLMServer.options(num_replicas=num_replicas).bind(
         ckpt_root=ckpt_root, model_specs=model_specs,
         num_blocks=num_blocks, block_size=block_size,
         prefill_time_per_token_s=prefill_time_per_token_s,
-        decode_step_time_s=decode_step_time_s)
+        decode_step_time_s=decode_step_time_s,
+        spec_k=spec_k, draft_agreement=draft_agreement,
+        draft_step_time_s=draft_step_time_s)

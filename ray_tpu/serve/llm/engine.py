@@ -30,17 +30,21 @@ import inspect
 import time
 from typing import Any, Awaitable, Callable, Dict, List, Optional, Union
 
+from ray_tpu._private import fault_injection
 from ray_tpu.serve._sync import run_in_executor
 from ray_tpu.serve.llm import attribution as _attr
 from ray_tpu.serve.llm import metrics as _m
 from ray_tpu.serve.llm.blocks import BlockAllocator, BlockTable, NoFreeBlocks
 from ray_tpu.serve.llm.scheduler import (EngineScheduler, FINISHED, RUNNING,
                                          Sequence)
-from ray_tpu.serve.llm.model import ToyLM
+from ray_tpu.serve.llm.model import DraftLM, ToyLM
 from ray_tpu.util import tracing as _tracing
 
 #: get_model(model_key) -> ToyLM, sync or async (the multiplex loader).
 ModelProvider = Callable[[str], Union[ToyLM, Awaitable[ToyLM]]]
+
+#: get_draft(model_key) -> DraftLM paired with that target, sync or async.
+DraftProvider = Callable[[str], Union[DraftLM, Awaitable[DraftLM]]]
 
 
 def compose_model_key(model: str, adapter: Optional[str]) -> str:
@@ -63,8 +67,15 @@ class LLMEngine:
                  max_running: Optional[int] = None,
                  default_max_tokens: int = 16,
                  pool: str = "engine", decode_only: bool = False,
-                 batch_capacity: int = 16):
+                 batch_capacity: int = 16,
+                 spec_k: int = 0,
+                 get_draft_model: Optional[DraftProvider] = None):
         self._get_model = get_model
+        #: Speculative decoding: propose up to ``spec_k`` draft tokens per
+        #: stream per step and verify them in one batched target pass.
+        #: 0 (or no draft provider) = plain one-token decode.
+        self.spec_k = max(0, int(spec_k))
+        self._get_draft = get_draft_model
         self.allocator = BlockAllocator(num_blocks, block_size, pool=pool)
         self.scheduler = EngineScheduler(self.allocator,
                                          watermark_blocks=watermark_blocks,
@@ -93,6 +104,15 @@ class LLMEngine:
             out = await out
         return out
 
+    def _spec_enabled(self) -> bool:
+        return self.spec_k > 0 and self._get_draft is not None
+
+    async def _draft(self, model_key: str) -> DraftLM:
+        out = self._get_draft(model_key)
+        if inspect.isawaitable(out):
+            out = await out
+        return out
+
     def _deployment_name(self) -> str:
         if self._deployment is None:
             from ray_tpu.serve.batching import _deployment_tag
@@ -105,13 +125,15 @@ class LLMEngine:
             raise TypeError(
                 "LLM engine requests are dicts with a 'prompt' token list")
         handoff = request.get("handoff")
+        stop = request.get("stop_token")
         seq = Sequence(
             [int(t) for t in request["prompt"]],
             int(request.get("max_tokens", self.default_max_tokens)),
             priority=int(request.get("priority", 0)),
             model_key=compose_model_key(request.get("model", "base"),
                                         request.get("adapter")),
-            handoff=handoff)
+            handoff=handoff,
+            stop_token=None if stop is None else int(stop))
         if handoff is not None:
             # Decode-side resume: the prefill pool already generated (and
             # the relay already emitted) these tokens.
@@ -182,8 +204,10 @@ class LLMEngine:
         # (backpressured slots keep their blocks but are not stepped),
         # skipping the ones prefill just advanced.
         present = {id(s.state.get("llm")) for s in slots}
+        spec = self._spec_enabled()
+        tokens_per_step = self.spec_k + 1 if spec else 1
         steppable = [
-            s for s in self.scheduler.ensure_decode_headroom()
+            s for s in self.scheduler.ensure_decode_headroom(tokens_per_step)
             if id(s) in present and id(s) not in just_prefilled
             and not s.finished
         ]
@@ -194,8 +218,14 @@ class LLMEngine:
             model = await self._model(model_key)
             with _tracing.span("serve.decode",
                                attributes={"model": model_key,
-                                           "batch": len(group)}):
-                await run_in_executor(self._decode_group, model, group)
+                                           "batch": len(group),
+                                           "spec": spec}):
+                if spec:
+                    draft = await self._draft(model_key)
+                    await run_in_executor(self._spec_decode_group, model,
+                                          draft, group)
+                else:
+                    await run_in_executor(self._decode_group, model, group)
 
         # Release blocks the moment a sequence hits its token budget; the
         # final token (and EOS) drain from `generated` on later iterations.
@@ -233,6 +263,8 @@ class LLMEngine:
                 raise
         seq.table = table
         seq.generated.append(tok)
+        if seq.stop_token is not None and tok == seq.stop_token:
+            seq.stopped = True
         _m.PREFILL_TOKENS.inc(len(context),
                               tags={"pool": self.allocator.pool})
         if seq.attrib is not None:
@@ -263,7 +295,10 @@ class LLMEngine:
         n = 0
         for seq in group:
             try:
-                seq.generated.append(model.decode_one(seq.table))
+                tok = model.decode_one(seq.table)
+                seq.generated.append(tok)
+                if seq.stop_token is not None and tok == seq.stop_token:
+                    seq.stopped = True
                 n += 1
             except NoFreeBlocks:
                 # Headroom check raced a concurrent pool consumer —
@@ -277,10 +312,111 @@ class LLMEngine:
         if n:
             _m.DECODE_TOKENS.inc(n, tags={"pool": self.allocator.pool})
 
+    def _spec_decode_group(self, model: ToyLM, draft: DraftLM,
+                           group: List[Sequence]) -> None:
+        """One speculative step for a single-(model, adapter) group, on an
+        executor thread: k sequential draft micro-steps plus ONE batched
+        target verify pass — the single verify burn amortized over up to
+        ``k + 1`` accepted tokens per stream is the tokens/s win."""
+        draft.propose_burn(self.spec_k)
+        model.decode_burn()
+        ptags = {"pool": self.allocator.pool}
+        proposed = accepted = banked = 0
+        for seq in group:
+            try:
+                p, a, b = self._spec_decode_one(model, draft, seq)
+                proposed += p
+                accepted += a
+                banked += b
+            except Exception as e:  # noqa: BLE001 — isolate to the stream
+                self.scheduler.finish(seq)
+                seq.error = e
+        if proposed:
+            _m.SPEC_PROPOSED_TOKENS.inc(proposed, tags=ptags)
+            _m.SPEC_VERIFY_STEPS.inc(len(group), tags=ptags)
+        if accepted:
+            _m.SPEC_ACCEPTED_TOKENS.inc(accepted, tags=ptags)
+        if banked:
+            _m.DECODE_TOKENS.inc(banked, tags=ptags)
+
+    def _spec_decode_one(self, model: ToyLM, draft: DraftLM,
+                         seq: Sequence) -> "tuple[int, int, int]":
+        """Propose/verify/rollback for one sequence; returns ``(proposed,
+        accepted, banked)`` token counts.
+
+        The invariant every exit path restores: ``seq.table`` holds KV
+        entries for exactly ``prompt + generated`` — draft pages beyond
+        the accepted prefix are provisional and must be truncated away,
+        or a preemption-recompute later would rebuild a different (and
+        then token-divergent) context.
+        """
+        table = seq.table
+        base = table.num_tokens
+        room = seq.max_new_tokens - len(seq.generated)
+        k = min(self.spec_k, max(1, room))
+        ctx_entries = list(table.entries())
+        proposal = draft.propose(ctx_entries, k)
+        ptags = {"pool": self.allocator.pool}
+        try:
+            # Provisional draft-KV pages (the verify pass writes KV for
+            # every draft position, accepted or not).
+            for i, tok in enumerate(proposal):  # pairs_with: truncate
+                table.append(model.kv_entry(tok, base + i))
+            fault_injection.check("llm_spec_verify")
+            n_acc, bonus = model.verify_tokens(ctx_entries, proposal)
+        except NoFreeBlocks:
+            # Preempt-mid-draft: every provisional page goes back before
+            # the scheduler releases the table (refcounts stay exact).
+            appended = table.num_tokens - base
+            if appended:
+                _m.SPEC_ROLLBACK_TOKENS.inc(appended, tags=ptags)
+            table.truncate(base)
+            self.scheduler.preempt_seq(seq)
+            return len(proposal), 0, 0
+        except Exception:
+            # Verify-step failure (e.g. the llm_spec_verify chaos point):
+            # roll back every draft page and degrade to one plain decode
+            # step — the stream sees neither torn nor duplicated tokens.
+            table.truncate(base)
+            _m.SPEC_FALLBACKS.inc(tags=ptags)
+            try:
+                tok = model.decode_one(table)
+            except NoFreeBlocks:
+                self.scheduler.preempt_seq(seq)
+                return len(proposal), 0, 0
+            seq.generated.append(tok)
+            if seq.stop_token is not None and tok == seq.stop_token:
+                seq.stopped = True
+            return len(proposal), 0, 1
+        # Greedy-spec acceptance: the accepted prefix plus the target's
+        # bonus token, clamped to the remaining budget and cut at the stop
+        # token — exactly the target-only continuation.
+        new_toks = (proposal[:n_acc] + [bonus])[:room]
+        if seq.stop_token is not None and seq.stop_token in new_toks:
+            new_toks = new_toks[:new_toks.index(seq.stop_token) + 1]
+        keep_acc = min(n_acc, len(new_toks))
+        rolled = table.num_tokens - (base + keep_acc)
+        table.truncate(base + keep_acc)
+        if len(new_toks) > keep_acc:
+            try:
+                table.append(model.kv_entry(new_toks[-1], base + keep_acc))
+            except NoFreeBlocks:
+                # Bank the accepted prefix only; the bonus re-derives as
+                # next step's first verify position.
+                new_toks = new_toks[:keep_acc]
+        if rolled:
+            _m.SPEC_ROLLBACK_TOKENS.inc(rolled, tags=ptags)
+        seq.generated.extend(new_toks)
+        if seq.stop_token is not None and seq.stop_token in new_toks:
+            seq.stopped = True
+        seq.spec_proposed += len(proposal)
+        seq.spec_accepted += n_acc
+        return len(proposal), n_acc, len(new_toks)
+
     # -------------------------------------------------------- emissions
 
     def _emission(self, slot: Any) -> Any:
-        from ray_tpu.serve.continuous import EOS
+        from ray_tpu.serve.continuous import EOS, Emissions
 
         seq = slot.state.get("llm")
         if isinstance(seq, Exception):
@@ -292,11 +428,26 @@ class LLMEngine:
         if err is not None:
             self._untrack(slot, seq)
             return err
-        tok = seq.pop_emission()
-        if tok is not None:
+        # Drain EVERY banked token this iteration.  Speculative decoding
+        # accepts up to k+1 tokens per verify pass; emitting one per step
+        # would re-serialize them behind every other stream's device burn
+        # and erase the tokens/s win at the stream surface.
+        toks = seq.pop_emissions()
+        if toks:
             if seq.attrib is not None:
-                seq.attrib.on_emit(time.time())
-            return tok
+                now = time.time()
+                for _ in toks:
+                    seq.attrib.on_emit(now)
+            done = seq.finished or seq.status == FINISHED
+            if done:
+                # All tokens are out and the budget/stop hit: retire in the
+                # same iteration instead of burning one more drain step.
+                self.scheduler.finish(seq)
+                self._untrack(slot, seq)
+                return Emissions(toks, eos=True)
+            if len(toks) == 1:
+                return toks[0]
+            return Emissions(toks)
         if seq.finished or seq.status == FINISHED:
             self.scheduler.finish(seq)
             self._untrack(slot, seq)

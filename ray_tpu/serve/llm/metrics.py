@@ -93,3 +93,27 @@ BATCH_OCCUPANCY = _metrics.Gauge(
     "Continuous-batch fill fraction per engine step (live slots / batch "
     "capacity)",
     tag_keys=("pool",))
+
+# Speculative decoding (PR 16): the accepted/proposed pair feeds the
+# serve.metrics.acceptance_rate() windowed accessor; rollbacks and
+# fallbacks are the safety-valve counters the chaos suite asserts on.
+SPEC_PROPOSED_TOKENS = _metrics.Counter(
+    "ray_tpu_llm_spec_proposed_tokens_total",
+    "Draft tokens proposed to the speculative verify pass",
+    tag_keys=("pool",))
+SPEC_ACCEPTED_TOKENS = _metrics.Counter(
+    "ray_tpu_llm_spec_accepted_tokens_total",
+    "Draft tokens the target verification accepted",
+    tag_keys=("pool",))
+SPEC_VERIFY_STEPS = _metrics.Counter(
+    "ray_tpu_llm_spec_verify_steps_total",
+    "Batched speculative verify passes executed",
+    tag_keys=("pool",))
+SPEC_ROLLBACK_TOKENS = _metrics.Counter(
+    "ray_tpu_llm_spec_rollback_tokens_total",
+    "Rejected or over-budget draft KV entries truncated from block tables",
+    tag_keys=("pool",))
+SPEC_FALLBACKS = _metrics.Counter(
+    "ray_tpu_llm_spec_fallbacks_total",
+    "Verify-step failures degraded to a plain one-token decode",
+    tag_keys=("pool",))

@@ -159,6 +159,33 @@ class ToyLM:
         default unload hook finds and calls this on LRU eviction."""
         self.closed = True
 
+    # ---------------------------------------------------- spec decoding
+
+    def verify_tokens(self, context_entries: Seq[np.ndarray],
+                      draft: List[int]) -> "tuple[int, int]":
+        """One batched speculative-verify pass (Leviathan et al. 2023,
+        greedy case): score every draft position against the target's own
+        next token given the same prefix.  Returns ``(n_accepted, bonus)``
+        where the accepted prefix is the longest run of draft tokens equal
+        to the target's, and ``bonus`` is the target's token at the first
+        mismatch — or one position past a fully-accepted run.  Accepted
+        prefix + bonus is exactly what target-only decoding would have
+        produced, which is what keeps spec decode byte-identical to
+        :meth:`reference_generate`.
+
+        Pure math — the caller burns ONE :meth:`decode_burn` for the whole
+        batched pass (that single burn amortized over up to ``k+1`` tokens
+        is the speedup)."""
+        entries = list(context_entries)
+        n_accepted = 0
+        for d in draft:
+            tok = self.next_token(entries)
+            if tok != int(d):
+                return n_accepted, tok
+            entries.append(self.kv_entry(tok, len(entries)))
+            n_accepted += 1
+        return n_accepted, self.next_token(entries)
+
     # ------------------------------------------------------- reference
 
     def reference_generate(self, prompt: List[int],
@@ -172,6 +199,81 @@ class ToyLM:
             entries.append(self.kv_entry(tok, len(entries)))
             out.append(tok)
         return out
+
+
+class DraftLM:
+    """Draft proposer for speculative decoding over a :class:`ToyLM`.
+
+    A real draft model is a smaller network that agrees with the target
+    some fraction of the time.  The toy stand-in makes that fraction a
+    *knob*: each proposed position passes a deterministic hash gate — with
+    probability ``agreement`` (per position, fixed by the gate seed) the
+    draft emits the target's own next token, otherwise a guaranteed-wrong
+    one.  ``agreement=1.0`` is a perfect draft (every run fully accepted),
+    ``agreement=0.0`` an adversarial draft (every proposal rejected at
+    position 0); both determinize the acceptance trace so tests can assert
+    exact accept/rollback behavior.
+
+    Cost model: one simulated device burn of ``draft_step_time_s`` per
+    proposed token (sequential micro-steps, batched across the group by
+    the engine) — much smaller than the target's ``decode_step_time_s``,
+    which is what speculative decoding trades against.
+    """
+
+    def __init__(self, target: ToyLM, *, agreement: float = 1.0,
+                 gate_seed: int = 1, draft_step_time_s: float = 0.0,
+                 device_lock: Optional[threading.Lock] = None):
+        if not 0.0 <= agreement <= 1.0:
+            raise ValueError(f"agreement must be in [0, 1], got {agreement}")
+        self.target = target
+        self.agreement = float(agreement)
+        self.gate_seed = int(gate_seed)
+        self.draft_step_time_s = float(draft_step_time_s)
+        self._device_lock = device_lock
+
+    def _gate(self, position: int) -> "tuple[bool, int]":
+        """Deterministic per-position agreement gate: (agrees, mix) where
+        ``mix`` perturbs the token on disagreement."""
+        m64 = (1 << 64) - 1
+        h = (self.gate_seed * int(_P1) + (position + 1) * int(_P2)) & m64
+        h ^= h >> 29
+        h = (h * int(_P3)) & m64
+        h ^= h >> 32
+        agrees = (h % (1 << 24)) / float(1 << 24) < self.agreement
+        return agrees, h
+
+    def propose(self, context_entries: Seq[np.ndarray],
+                k: int) -> List[int]:
+        """Propose ``k`` tokens autoregressively from the given context.
+        The draft shares the target's KV representation (only the
+        *reduction* quality differs in real systems); wrong proposals are
+        still self-consistent — the draft conditions on its own output."""
+        entries = list(context_entries)
+        out: List[int] = []
+        for _ in range(k):
+            true_tok = self.target.next_token(entries)
+            agrees, mix = self._gate(len(entries))
+            if agrees:
+                tok = true_tok
+            else:
+                # Offset in [1, vocab-1]: never congruent to the true token.
+                vocab = self.target.vocab_size
+                tok = (true_tok + 1 + mix % (vocab - 1)) % vocab
+            out.append(tok)
+            entries.append(self.target.kv_entry(tok, len(entries)))
+        return out
+
+    def propose_burn(self, k: int) -> None:
+        """Simulated device time for ``k`` sequential draft micro-steps
+        (batched across the whole decode group, like ``decode_burn``)."""
+        seconds = self.draft_step_time_s * max(0, k)
+        if seconds <= 0:
+            return
+        if self._device_lock is not None:
+            with self._device_lock:
+                time.sleep(seconds)  # blocking_ok: simulated device time
+        else:
+            time.sleep(seconds)  # blocking_ok: simulated device time
 
 
 def lm_from_weights(weights: Dict[str, Any], *,

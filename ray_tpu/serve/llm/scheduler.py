@@ -36,12 +36,23 @@ class Sequence:
     def __init__(self, prompt: List[int], max_new_tokens: int, *,
                  priority: int = 0, model_key: str = "base",
                  handoff: Optional[Dict[str, Any]] = None,
-                 seq_id: Optional[str] = None):
+                 seq_id: Optional[str] = None,
+                 stop_token: Optional[int] = None):
         self.seq_id = seq_id or f"seq-{next(_seq_counter)}"
         self.prompt = list(prompt)
         self.max_new_tokens = int(max_new_tokens)
         self.priority = priority
         self.model_key = model_key
+        #: Optional EOS token: generation ends the step this token is
+        #: produced (it is still emitted), even under speculative decoding
+        #: where it may land mid-way through an accepted draft run.
+        self.stop_token = None if stop_token is None else int(stop_token)
+        self.stopped = False
+        #: Speculative-decoding per-stream tallies (draft tokens proposed /
+        #: accepted by verification) — the per-stream acceptance view the
+        #: windowed accessor aggregates across streams.
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         #: Exported KV pages + generated prefix from a prefill replica —
         #: when set, admission imports pages instead of recomputing.
         self.handoff = handoff
@@ -65,7 +76,7 @@ class Sequence:
 
     @property
     def finished(self) -> bool:
-        return len(self.generated) >= self.max_new_tokens
+        return self.stopped or len(self.generated) >= self.max_new_tokens
 
     def pop_emission(self) -> Optional[int]:
         """Next generated-but-unemitted token (one per engine iteration —
@@ -75,6 +86,14 @@ class Sequence:
             self.num_emitted += 1
             return tok
         return None
+
+    def pop_emissions(self) -> List[int]:
+        """Every generated-but-unemitted token, drained at once — the
+        speculative engine banks up to k+1 tokens per verify pass, and the
+        stream must see them this iteration, not one per device burn."""
+        toks = self.generated[self.num_emitted:]
+        self.num_emitted = len(self.generated)
+        return toks
 
 
 class EngineScheduler:
@@ -172,15 +191,21 @@ class EngineScheduler:
         _m.PREEMPTIONS.inc(tags={"pool": self.allocator.pool})
         self._gauges()
 
-    def ensure_decode_headroom(self) -> List[Sequence]:
-        """Make sure every running sequence can append one more KV entry,
-        preempting under pressure.  Returns the sequences that remain
-        steppable this iteration (preempted ones dropped)."""
+    def ensure_decode_headroom(self,
+                               tokens_per_step: int = 1) -> List[Sequence]:
+        """Make sure every running sequence can append up to
+        ``tokens_per_step`` more KV entries this iteration (1 for plain
+        decode; ``k + 1`` under speculative decoding — k draft entries
+        plus the bonus token), preempting under pressure.  Returns the
+        sequences that remain steppable (preempted ones dropped)."""
+        grow = max(1, int(tokens_per_step))
         while True:
-            need = sum(
-                1 for s in self.running
-                if s.table is not None
-                and s.table.num_tokens % self.allocator.block_size == 0)
+            need = 0
+            for s in self.running:
+                if s.table is None:
+                    continue
+                need += max(0, self.allocator.blocks_needed(
+                    s.table.num_tokens + grow) - len(s.table.block_ids))
             if self.allocator.num_free >= need:
                 return list(self.running)
             if self.preempt_one() is None:

@@ -237,6 +237,27 @@ def goodput_tokens_per_s(pool: Optional[str] = None,
                            _pool_tags(pool), window_s, now)
 
 
+def acceptance_rate(pool: Optional[str] = None, window_s: float = 60.0,
+                    now: Optional[float] = None) -> float:
+    """Windowed speculative-decoding acceptance: draft tokens the target
+    verification accepted over draft tokens proposed, 0..1 across every
+    stream in the pool (per-stream tallies live on ``Sequence.spec_*``).
+    Returns 0.0 when spec decode is off or no proposals landed in the
+    window — cold start reads as "no speculation", never an error."""
+    from ray_tpu.util.metrics_agent import get_aggregator
+
+    agg = get_aggregator()
+    agg.sample_registry()
+    tags = _pool_tags(pool)
+    proposed = agg.window_rate("ray_tpu_llm_spec_proposed_tokens_total",
+                               tags, window_s, now)
+    if proposed <= 0.0:
+        return 0.0
+    accepted = agg.window_rate("ray_tpu_llm_spec_accepted_tokens_total",
+                               tags, window_s, now)
+    return min(1.0, accepted / proposed)
+
+
 def recompute_waste_tokens_per_s(pool: Optional[str] = None,
                                  window_s: float = 60.0,
                                  now: Optional[float] = None) -> float:

@@ -37,6 +37,15 @@ def _invoke_sync_unary(target: Any, args: tuple, kwargs: dict) -> Any:
     return result
 
 
+def _swallow_task_result(task: "asyncio.Task") -> None:
+    """Consume a finished task's outcome without surfacing it anywhere."""
+    try:
+        if not task.cancelled():
+            task.exception()
+    except Exception:
+        pass
+
+
 def _next_or_done(it: Any) -> Any:
     try:
         return next(it)
@@ -214,6 +223,11 @@ class ReplicaActor:
     #: kill -9'd remote driver would otherwise pin _num_ongoing forever).
     STREAM_IDLE_TIMEOUT_S = 300.0
 
+    #: Max items shipped per pull: bounds the reply size when a producer
+    #: banked a burst (speculative decoding's k+1 tokens per verify, a
+    #: relay holding a batched upstream pull).
+    STREAM_BATCH_MAX = 128
+
     def _set_replica_context(self) -> None:
         from ray_tpu.serve import context as serve_context
 
@@ -226,14 +240,15 @@ class ReplicaActor:
 
         self._reap_idle_streams()
         sid = _uuid.uuid4().hex[:16]
-        self._streams[sid] = [it, time.time()]
+        # [iterator, last-pull time, parked __anext__ task (async tier)]
+        self._streams[sid] = [it, time.time(), None]
         self._num_ongoing += 1
         return sid
 
     def _reap_idle_streams(self) -> None:
         now = time.time()
-        for sid, (it, last) in list(self._streams.items()):
-            if now - last > self.STREAM_IDLE_TIMEOUT_S:
+        for sid, entry in list(self._streams.items()):
+            if now - entry[1] > self.STREAM_IDLE_TIMEOUT_S:
                 self._end_stream(sid)
 
     async def start_stream(self, method_name: str, *args, **kwargs) -> str:
@@ -247,8 +262,14 @@ class ReplicaActor:
         return self._register_stream(it)
 
     async def next_stream(self, stream_id: str):
-        """("item", value) or ("done", None); exceptions propagate and end
-        the stream.  The replica context is (re)set per pull — the
+        """("item", value), ("items", [..]), ("items_done", [..]) or
+        ("done", None); exceptions propagate and end the stream.  One pull
+        blocks for the first item, then drains whatever the generator can
+        yield WITHOUT suspending — a burst already buffered replica-side
+        (speculative decoding bank, a relay holding a batched upstream
+        pull) ships in one actor round-trip instead of one RPC per item.
+        ("items_done", [..]) delivers a final burst and ends the stream in
+        the same reply.  The replica context is (re)set per pull — the
         generator BODY executes during pulls, in a different task than
         start_stream's."""
         entry = self._streams.get(stream_id)
@@ -259,11 +280,37 @@ class ReplicaActor:
         self._set_replica_context()
         try:
             if hasattr(it, "__anext__"):
+                task, entry[2] = entry[2], None
+                if task is None:
+                    task = asyncio.ensure_future(it.__anext__())
                 try:
-                    return ("item", await it.__anext__())
+                    first = await task
                 except StopAsyncIteration:
                     self._end_stream(stream_id)
                     return ("done", None)
+                items = [first]
+                while len(items) < self.STREAM_BATCH_MAX:
+                    nxt = asyncio.ensure_future(it.__anext__())
+                    ready, _ = await asyncio.wait({nxt}, timeout=0)
+                    if not ready:
+                        # The generator suspended: park the in-flight
+                        # __anext__ for the next pull — cancelling it here
+                        # would throw into the generator body mid-await.
+                        entry[2] = nxt
+                        break
+                    try:
+                        items.append(nxt.result())
+                    except StopAsyncIteration:
+                        self._end_stream(stream_id)
+                        return ("items_done", items)
+                    except Exception:
+                        # Ship what we have; the parked completed task
+                        # re-raises on the next pull and ends the stream.
+                        entry[2] = nxt
+                        break
+                if len(items) == 1:
+                    return ("item", first)
+                return ("items", items)
             # Sync iterator: its body executes during next() — pull on the
             # executor so a blocking generator cannot stall the loop's
             # other streams/requests.  Pulls are sequential per stream, so
@@ -286,6 +333,17 @@ class ReplicaActor:
         if entry is None:
             return
         it = entry[0]
+        pending = entry[2] if len(entry) > 2 else None
+        if pending is not None:
+            # A parked __anext__ survives the stream: cancel it if still in
+            # flight (the cancel unwinds the generator before the aclose
+            # below), and retrieve its result quietly so a stashed error
+            # never logs as an un-retrieved task exception after the client
+            # walked away.
+            entry[2] = None
+            if not pending.done():
+                pending.cancel()
+            pending.add_done_callback(_swallow_task_result)
         self._num_ongoing -= 1
         self._num_processed += 1
         if hasattr(it, "aclose"):

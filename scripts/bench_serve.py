@@ -10,11 +10,21 @@ Modes:
                   target healthy count + error rate during recovery
   --mode trace    tracing-on vs tracing-off QPS at 32 concurrent clients on
                   the batched unary path (span overhead anchor, target <5%)
-  --mode llm      paged-KV LLM engine: prefill/decode-disaggregated pools vs
-                  the monolithic continuous-batching baseline on a mixed
-                  prompt/generation-length trace (16 closed-loop streams);
-                  appends tokens/s + inter-token p99 plus the latency-
-                  attribution on/off overhead ratio to BENCH_LLM.json
+  --mode pipeline multi-stage compiled serve graph: 3-stage pipeline
+                  traversal p50/p99 (compiled channel hops vs the dynamic
+                  handle chain) + a membership-change segment under load
+                  that must complete with zero caller-visible errors
+  --mode llm      paged-KV LLM engine: prefill/decode-disaggregated pools
+                  vs the monolithic continuous-batching baseline, AND a
+                  speculative-decoding arm (draft k=4, agreement 0.9 on the
+                  disagg decode pool) vs its non-spec twin, on a mixed
+                  prompt/generation-length trace (16 closed-loop streams,
+                  seeded RNG so every run replays the identical trace);
+                  tokens/s and speedups are medians over --llm-median-rounds
+                  paired rounds (variance bounds recorded as *_min/*_max);
+                  appends tokens/s + inter-token p99 + spec acceptance plus
+                  the latency-attribution on/off overhead ratio to
+                  BENCH_LLM.json
 
 The batch mode simulates ONE accelerator per deployment with a lock + sleep:
 forward passes serialize, so unbatched requests pay the full forward each
@@ -443,6 +453,139 @@ def run_compiled_mode(args) -> dict:
     return fields
 
 
+def run_pipeline_mode(args) -> dict:
+    """Multi-stage compiled serve graph anchors (ISSUE 16): a 3-stage
+    prefill -> decode -> postprocess chain over serve.pipeline.
+
+    Records sequential p50/p99 for the full compiled traversal (every hop
+    is channel traffic: stage demux -> typed edge -> next stage's lanes)
+    against the handle-chained dynamic equivalent (one router dispatch +
+    ObjectRef per hop), then a membership-change segment: clients hammer
+    the pipeline while the middle stage scales — the teardown must degrade
+    every in-flight hop to the dynamic path with ZERO caller-visible
+    errors, and the chain must re-lower afterwards."""
+    import statistics
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    os.environ.setdefault("RAY_TPU_SERVE_COMPILED_STABLE_S", "0.3")
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start(http_options={"port": 0})
+
+    @serve.deployment(max_ongoing_requests=16)
+    class Prefill:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=16)
+    class Decode:
+        def __call__(self, x):
+            return x * 10
+
+    @serve.deployment(max_ongoing_requests=16)
+    class Post:
+        def __call__(self, x):
+            return x - 3
+
+    h1 = serve.run(Prefill.bind(), name="pipe_pre", route_prefix=None)
+    h2 = serve.run(Decode.bind(), name="pipe_dec", route_prefix=None)
+    h3 = serve.run(Post.bind(), name="pipe_post", route_prefix=None)
+    pipe = serve.pipeline(h1, h2, h3, name="bench")
+
+    def oracle(x):
+        return (x + 1) * 10 - 3
+
+    # Warm + wait for every stage to lower.
+    assert pipe.remote(1).result(timeout_s=60) == oracle(1)
+    for h in (h1, h2, h3):
+        _wait_compiled(h)
+    assert pipe.mode == "compiled"
+
+    # ---- sequential traversal latency: compiled pipeline vs dynamic chain
+    def measure(fn) -> list:
+        lat = []
+        for i in range(args.requests):
+            t0 = time.perf_counter()
+            assert fn(i) == oracle(i)
+            lat.append((time.perf_counter() - t0) * 1000)
+        return lat
+
+    def via_pipeline(i):
+        return pipe.remote(i).result(timeout_s=30)
+
+    def via_dynamic_chain(i):
+        a = h1._get_router().assign_request("__call__", i)
+        b = h2._get_router().assign_request(
+            "__call__", ray_tpu.get(a, timeout=30))
+        c = h3._get_router().assign_request(
+            "__call__", ray_tpu.get(b, timeout=30))
+        return ray_tpu.get(c, timeout=30)
+
+    measure(via_pipeline)  # warm wave off the clock
+    lat_c = np.asarray(measure(via_pipeline))
+    lat_d = np.asarray(measure(via_dynamic_chain))
+    fields = {
+        "pipeline_stages": 3,
+        "pipeline_compiled_p50_ms": round(float(np.percentile(lat_c, 50)), 3),
+        "pipeline_compiled_p99_ms": round(float(np.percentile(lat_c, 99)), 3),
+        "pipeline_dynamic_p50_ms": round(float(np.percentile(lat_d, 50)), 3),
+        "pipeline_dynamic_p99_ms": round(float(np.percentile(lat_d, 99)), 3),
+    }
+    fields["pipeline_p50_speedup"] = round(
+        fields["pipeline_dynamic_p50_ms"]
+        / fields["pipeline_compiled_p50_ms"], 2)
+
+    # ---- membership change under load: zero caller-visible errors
+    errors: list = []
+    ok = [0]
+    stop = threading.Event()
+
+    def pound(tid):
+        i = tid * 1000000
+        while not stop.is_set():
+            try:
+                assert pipe.remote(i).result(timeout_s=30) == oracle(i)
+                ok[0] += 1
+            except Exception as e:  # noqa: BLE001 — recorded, gates below
+                errors.append(repr(e))
+                return
+            i += 1
+
+    threads = [threading.Thread(target=pound, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)
+    serve.run(Decode.options(num_replicas=3).bind(), name="pipe_dec",
+              route_prefix=None)  # membership change on the middle stage
+    time.sleep(1.2)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    deadline = time.time() + 15
+    while pipe.mode != "compiled" and time.time() < deadline:
+        time.sleep(0.05)
+    fields["pipeline_membership_requests"] = ok[0]
+    fields["pipeline_membership_errors"] = len(errors)
+    fields["pipeline_mode_after_change"] = pipe.mode
+
+    pipe.stop()
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+    # Acceptance anchors (ISSUE 16): fail loudly rather than record a
+    # regressed artifact.
+    assert fields["pipeline_membership_errors"] == 0, errors[:3]
+    assert fields["pipeline_membership_requests"] > 50, fields
+    assert fields["pipeline_mode_after_change"] == "compiled", fields
+    assert fields["pipeline_p50_speedup"] > 1.0, fields
+    return fields
+
+
 def run_trace_mode(args) -> dict:
     """Tracing overhead anchors (ISSUE 4 acceptance: end-to-end tracing
     costs < 5% QPS at 32 concurrent clients on the batched unary path).
@@ -714,14 +857,22 @@ def _drive_llm_streams(handle, traces):
 def run_llm_mode(args) -> dict:
     """LLM engine anchors (ISSUE 11 acceptance: disaggregated pools show
     >= 1.5x total tokens/s at equal-or-better inter-token p99 vs the
-    monolithic continuous-batching baseline, 16 mixed-length streams).
+    monolithic continuous-batching baseline, 16 mixed-length streams;
+    ISSUE 16: speculative decoding >= 1.5x plain decoding at acceptance
+    >= 0.6, byte-identical output, equal token counts).
 
-    Both topologies serve the IDENTICAL trace on identical simulated model
+    All arms serve the IDENTICAL seeded trace on identical simulated model
     timing (prefill cost ∝ prompt length, one decode burn per engine
     iteration).  The monolithic engine interleaves prefill into its step
     loop, so every long prompt stalls the whole batch's next token — the
     DistServe interference the split removes: the decode pool's loop only
-    ever imports pre-computed KV pages (cheap) and decodes."""
+    ever imports pre-computed KV pages (cheap) and decodes.  The spec arm
+    drafts k tokens per stream and verifies them in ONE target burn, so
+    each burn banks ~(k+1)*acceptance tokens instead of one.  Headline
+    numbers are medians over paired rounds; per-round ratio min/max land
+    in the artifact as the variance bound."""
+    import statistics as _stats
+
     import numpy as np
 
     import ray_tpu
@@ -732,11 +883,21 @@ def run_llm_mode(args) -> dict:
 
     PREFILL_S_PER_TOKEN = 2.5e-4  # simulated device: prefill cost per token
     DECODE_STEP_S = 30e-3         # one decode iteration (whole micro-batch)
+    SPEC_K = 4                    # draft tokens proposed per verify step
+    SPEC_AGREEMENT = 0.9          # per-position draft/target agreement
+    # A draft micro-step at a tenth of the target step: k sequential draft
+    # steps + one verify burn against (k+1-ish) tokens banked.
+    DRAFT_STEP_S = DECODE_STEP_S / 10
 
     ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
     serve.start(http_options={"port": 0})
 
     n_streams = args.llm_streams
+    # The trace RNG is seeded (random.Random(0) in _llm_trace), so every
+    # run of this mode measures the IDENTICAL request sequence — run-to-run
+    # drift is scheduler noise, not workload variation.  Median-of-N rounds
+    # (default 3) bounds that noise: see PERF.md "Variance bounds".
+    rounds = max(1, getattr(args, "llm_median_rounds", 3))
     traces = _llm_trace(n_streams, args.llm_requests_per_stream)
     specs = {"base": {"seed": 7, "dim": 8}}
     common = dict(model_specs=specs, num_blocks=512, block_size=16,
@@ -746,35 +907,94 @@ def run_llm_mode(args) -> dict:
     mono = serve.run(build_monolithic_app(**common), name="llm_mono",
                      route_prefix=None)
     # Pools sized to phase load, the DistServe prescription: the bursty
-    # O(prompt) prefill work gets 2 devices so queueing doesn't starve the
-    # decode batch, the steady token loop gets 1.  Frontends are
-    # deviceless relays, scaled so stream pulls don't serialize on one
-    # event loop.
-    dis = serve.run(build_disagg_app(prefill_replicas=2,
+    # O(prompt) prefill work gets 4 devices so queueing doesn't starve the
+    # decode batch, the steady token loop gets 1.  (4, not 2: the spec arm
+    # below shares this sizing, and its decode loop banks ~(k+1)*acceptance
+    # tokens per burn — requests finish several times faster, so closed-
+    # loop clients re-submit several times as often and prefill demand per
+    # unit time scales with the decode speedup.)  Frontends are deviceless
+    # relays, scaled so stream pulls don't serialize on one event loop.
+    dis = serve.run(build_disagg_app(prefill_replicas=4,
                                      frontend_replicas=4, **common),
                     name="llm_disagg", route_prefix=None)
-    # Warm both paths (model load, stream plumbing) off the clock.
+    # Speculative arm: the disagg topology with drafting on the decode
+    # pool — SPEC_K tokens proposed per stream per iteration, verified in
+    # one batched target pass; greedy acceptance keeps output
+    # byte-identical while each verify burn banks several tokens.  It
+    # rides the disaggregated substrate (its non-spec twin is the arm
+    # above) because the monolithic loop's serialized prefill re-binds
+    # the moment decode gets faster: spec makes requests finish ~4x
+    # sooner, the closed-loop streams re-submit in sync, and every
+    # iteration stalls on an O(prompt) prefill — exactly the
+    # interference disaggregation removes, so the decode-loop win is
+    # only measurable on the split topology.
+    spec_h = serve.run(
+        build_disagg_app(prefill_replicas=4, frontend_replicas=4,
+                         spec_k=SPEC_K, draft_agreement=SPEC_AGREEMENT,
+                         draft_step_time_s=DRAFT_STEP_S, **common),
+        name="llm_spec", route_prefix=None)
+    # Warm all paths (model load, stream plumbing) off the clock.
     warm = {"prompt": [1, 2, 3], "max_tokens": 2}
     ref = ToyLM(seed=7).reference_generate([1, 2, 3], 2)
-    for h in (mono, dis):
+    for h in (mono, dis, spec_h):
         assert list(h.options(stream=True).remote(dict(warm))) == ref
+    # Counter-rate queries need registry samples on BOTH sides of the
+    # increments (window_rate sums deltas between consecutive samples):
+    # land the baseline now, the acceptance_rate() call at the end lands
+    # the closing sample, and the delta spans exactly the measured rounds.
+    from ray_tpu.util.metrics_agent import get_aggregator
+
+    get_aggregator().sample_registry()
 
     fields = {"llm_streams": n_streams,
-              "llm_requests_per_stream": args.llm_requests_per_stream}
+              "llm_requests_per_stream": args.llm_requests_per_stream,
+              "llm_median_rounds": rounds}
+    arms = (("monolithic", mono), ("disagg", dis), ("spec", spec_h))
+    tps = {key: [] for key, _ in arms}
+    p99s = {key: [] for key, _ in arms}
     outs = {}
-    for key, handle in (("monolithic", mono), ("disagg", dis)):
-        total, wall, gaps, outputs = _drive_llm_streams(handle, traces)
-        outs[key] = outputs
-        fields[f"llm_{key}_tokens_per_s"] = round(total / wall, 1)
+    for r in range(rounds):
+        for key, handle in arms:
+            total, wall, gaps, outputs = _drive_llm_streams(handle, traces)
+            if r == 0:
+                outs[key] = outputs
+                fields[f"llm_{key}_tokens"] = total
+            else:
+                # Deterministic engine + seeded trace: every round must
+                # re-produce the identical streams.
+                assert outputs == outs[key], f"{key} outputs drifted"
+            tps[key].append(total / wall)
+            p99s[key].append(float(
+                np.percentile(np.asarray(gaps) * 1000, 99)))
+    for key, _ in arms:
+        fields[f"llm_{key}_tokens_per_s"] = round(_stats.median(tps[key]), 1)
         fields[f"llm_{key}_intertoken_p99_ms"] = round(
-            float(np.percentile(np.asarray(gaps) * 1000, 99)), 3)
-        fields[f"llm_{key}_tokens"] = total
-    # Same engine math on both sides: streams must be byte-identical.
+            _stats.median(p99s[key]), 3)
+    # Same engine math on every arm: streams must be byte-identical.
     assert outs["monolithic"] == outs["disagg"], \
         "disaggregated outputs diverged from monolithic"
-    fields["llm_disagg_speedup"] = round(
-        fields["llm_disagg_tokens_per_s"]
-        / fields["llm_monolithic_tokens_per_s"], 2)
+    assert outs["spec"] == outs["monolithic"], \
+        "speculative outputs diverged from plain decoding"
+    # Per-round PAIRED ratios, then the median: adjacent arms share one
+    # noise window, so the ratio cancels drift a cross-round mean would
+    # absorb; min/max bound the spread the artifact was drawn from.
+    dis_ratios = [d / m for d, m in zip(tps["disagg"], tps["monolithic"])]
+    # Spec vs its non-spec twin (the disagg arm): same topology, same
+    # trace, the ONLY delta is drafting on the decode pool.
+    spec_ratios = [s / d for s, d in zip(tps["spec"], tps["disagg"])]
+    fields["llm_disagg_speedup"] = round(_stats.median(dis_ratios), 2)
+    fields["llm_disagg_speedup_min"] = round(min(dis_ratios), 2)
+    fields["llm_disagg_speedup_max"] = round(max(dis_ratios), 2)
+    fields["llm_spec_speedup"] = round(_stats.median(spec_ratios), 2)
+    fields["llm_spec_speedup_min"] = round(min(spec_ratios), 2)
+    fields["llm_spec_speedup_max"] = round(max(spec_ratios), 2)
+    fields["llm_spec_k"] = SPEC_K
+    fields["llm_spec_draft_agreement"] = SPEC_AGREEMENT
+    # Windowed acceptance through the serve.metrics accessor (the PR 12
+    # plane the per-stream spec_* tallies feed) — spec decode only pays
+    # when the draft is usually right.
+    fields["llm_spec_acceptance"] = round(
+        serve.metrics.acceptance_rate(window_s=3600.0), 3)
 
     # ---- attribution overhead A/B (ISSUE 12 acceptance: per-token latency
     # attribution + spans cost <= 2% tokens/s).  Same interleaved-wave
@@ -828,6 +1048,13 @@ def run_llm_mode(args) -> dict:
     assert fields["llm_disagg_speedup"] >= 1.5, fields
     assert fields["llm_disagg_intertoken_p99_ms"] \
         <= fields["llm_monolithic_intertoken_p99_ms"], fields
+    # ISSUE 16: speculative decoding >= 1.5x plain decoding tokens/s at
+    # acceptance >= 0.6, equal token counts, byte-identical output (the
+    # identity is asserted against `outs` above, before timing fields).
+    assert fields["llm_spec_speedup"] >= 1.5, fields
+    assert fields["llm_spec_acceptance"] >= 0.6, fields
+    assert fields["llm_spec_tokens"] == fields["llm_monolithic_tokens"], \
+        fields
     # ISSUE 12: attribution must stay in the noise floor — the engine's
     # 30ms simulated decode step dominates wall time, so a reading past
     # 2% means the bookkeeping itself got expensive.
@@ -839,7 +1066,7 @@ def run_llm_mode(args) -> dict:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=("latency", "batch", "chaos", "trace",
-                                       "compiled", "llm"),
+                                       "compiled", "pipeline", "llm"),
                     default="latency")
     ap.add_argument("--requests", type=int, default=300)
     ap.add_argument("--stream-tokens", type=int, default=2000)
@@ -850,6 +1077,9 @@ def main():
     ap.add_argument("--llm-requests-per-stream", type=int, default=6)
     ap.add_argument("--llm-ab-rounds", type=int, default=5,
                     help="off/on wave pairs for the attribution-overhead A/B")
+    ap.add_argument("--llm-median-rounds", type=int, default=3,
+                    help="paired measurement rounds per llm-mode arm; "
+                         "reported tokens/s and speedups are the medians")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
     if args.out is None:
@@ -858,7 +1088,8 @@ def main():
 
     modes = {"latency": run_latency_mode, "batch": run_batch_mode,
              "chaos": run_chaos_mode, "trace": run_trace_mode,
-             "compiled": run_compiled_mode, "llm": run_llm_mode}
+             "compiled": run_compiled_mode, "pipeline": run_pipeline_mode,
+             "llm": run_llm_mode}
     fields = modes[args.mode](args)
     artifact = _merge_artifact(args.out, fields)
     print(json.dumps(artifact))

@@ -216,10 +216,17 @@ class TestCompiledDestroySlotRing:
         g.deployment_id = "dep"
         g._destroyed = False
         g._destroy_lock = threading.Lock()
+        # Fake lane exposing the destroy-facing interface; drain_pending is
+        # the REAL _Lane implementation (the slot-ring invariant under test)
+        # driven against this namespace.
         lane = SimpleNamespace(
             rid="r1",
+            graph=g,
             req=Channel(maxsize=8, name="t-destroy", slot_width=cr.SLOT_WIDTH),
-            _loop_thread=SimpleNamespace(join=lambda timeout=None: None))
+            join_loop=lambda timeout: None)
+        lane.close_req = lane.req.close
+        lane.drain_pending = (
+            lambda out: cr._Lane.drain_pending(lane, out))
         g._lanes = {"r1": lane}
         g._single_lane = lane
         return g, lane

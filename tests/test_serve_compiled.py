@@ -190,20 +190,36 @@ def test_status_reports_route_mode(serve_fast_compile):
         time.sleep(0.1)
 
 
-def test_process_tier_replicas_stay_dynamic(serve_fast_compile):
-    @serve.deployment(num_replicas=1,
+def test_process_tier_replicas_compile(serve_fast_compile):
+    @serve.deployment(num_replicas=1, max_ongoing_requests=8,
                       ray_actor_options={"isolation": "process"})
     class Iso:
         def __call__(self, x):
             return x * 3
 
+        def boom(self, x):
+            raise ValueError(f"boom-{x}")
+
     h = serve.run(Iso.bind(), name="app", route_prefix=None)
     assert h.remote(2).result(timeout_s=30) == 6
-    time.sleep(1.0)
-    # No in-process instance to lower onto — the route must stay dynamic
-    # (and must not spin retrying the same uncompilable set).
-    assert h._get_router()._compiled.mode == "dynamic"
-    assert h.remote(3).result(timeout_s=30) == 9
+    # Process-tier replicas lower onto shm-channel lanes with the resident
+    # loop shipped into the worker — the route compiles like thread tier.
+    _wait_compiled(h)
+    from ray_tpu.serve.compiled_router import CompiledResponse
+
+    resp = h.remote(5)
+    assert isinstance(resp, CompiledResponse)
+    assert resp.result(timeout_s=30) == 15
+    resps = [h.remote(i) for i in range(32)]
+    assert [r.result(timeout_s=30) for r in resps] == [
+        i * 3 for i in range(32)]
+    # Errors arrive wrapped in TaskError exactly like the dynamic path,
+    # and the lane survives them.
+    with pytest.raises(TaskError) as ei:
+        h.boom.remote(1).result(timeout_s=30)
+    assert isinstance(ei.value.cause, ValueError)
+    assert h.remote(7).result(timeout_s=30) == 21
+    assert h._get_router()._compiled.mode == "compiled"
 
 
 def test_compiled_multiplexed_model_routing(serve_fast_compile):
