@@ -77,6 +77,9 @@ FAULT_POINTS: Dict[str, str] = {
                        "roll back and the stream degrades to plain "
                        "decoding for the step (no torn or duplicated "
                        "tokens)",
+    "llm_kv_promote": "host/object-tier KV-page promotion back into the "
+                      "device pool — the tier entry is restored and the "
+                      "caller falls back to a byte-identical re-prefill",
     # crash forensics (tests/test_forensics.py)
     "forensics_dump": "flight-recorder postmortem dump entry — the dump "
                       "fails; every trigger site absorbs it (a forensics "

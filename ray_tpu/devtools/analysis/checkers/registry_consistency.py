@@ -303,6 +303,7 @@ ACCESSOR_SERIES = {
     "metrics.recompute_waste_tokens_per_s":
         "ray_tpu_llm_recompute_tokens_total",
     "metrics.acceptance_rate": "ray_tpu_llm_spec_accepted_tokens_total",
+    "metrics.prefix_hit_rate": "ray_tpu_llm_prefix_hit_tokens_total",
 }
 
 

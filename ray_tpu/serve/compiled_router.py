@@ -1004,7 +1004,12 @@ class _CompiledGraph:
         mux = kwargs.get("_serve_multiplexed_model_id")
         lane = self._single_lane
         if lane is None:
-            row = router._scheduler.choose_replica(mux or None)
+            # Prefix-aware choice survives lowering: the same scheduler
+            # pick (warm + longest-cached-prefix) runs here, then maps to
+            # the chosen replica's resident lane — a directory update
+            # swaps the scheduler mirror without touching the graph.
+            row = router._scheduler.choose_replica(
+                mux or None, prefix_hashes=router._prefix_hint(args, kwargs))
             if row is None:
                 return False
             lane = self._lanes.get(row["replica_id"])

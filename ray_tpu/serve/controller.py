@@ -24,6 +24,13 @@ class ServeController:
     def __init__(self) -> None:
         self._manager = DeploymentStateManager()
         self._long_poll = LongPollHost()
+        from ray_tpu.serve.llm.prefix_dir import PrefixDirectory
+
+        #: deployment -> replica -> held prefix-chain hashes, pushed on
+        #: the dedicated ``prefix_dir::<dep>`` long-poll key (NEVER the
+        #: replicas:: key — a block commit must not look like a membership
+        #: change or it would tear down compiled route graphs).
+        self._prefix_dir = PrefixDirectory()
         self._apps: Dict[str, Dict[str, Any]] = {}  # app -> {route_prefix, deployments, ingress}
         self._replica_sets: Dict[str, List[Dict[str, Any]]] = {}
         self._autoscale_state: Dict[str, Dict[str, float]] = {}
@@ -211,10 +218,20 @@ class ServeController:
                 updates = self._manager.reconcile()
                 if updates:
                     self._replica_sets.update(updates)
-                    self._long_poll.notify_changed({
+                    payload = {
                         f"replicas::{dep_id}": replicas
                         for dep_id, replicas in updates.items()
-                    })
+                    }
+                    # Dead replicas' directory entries drop in the SAME
+                    # push as the membership change — a router that saw
+                    # the death can never still route on the dead
+                    # replica's cached prefixes.
+                    for dep_id, replicas in updates.items():
+                        live = {r["replica_id"] for r in replicas}
+                        if self._prefix_dir.retain(dep_id, live):
+                            payload[f"prefix_dir::{dep_id}"] = \
+                                self._prefix_dir.snapshot(dep_id)
+                    self._long_poll.notify_changed(payload)
                 await self._autoscale_tick()
             except Exception:
                 import traceback
@@ -229,6 +246,20 @@ class ServeController:
         deployment changed so the next control-loop tick pushes a fresh
         replica set — routers then prefer warm replicas for those ids."""
         self._manager.record_multiplexed_model_ids(replica_id, model_ids)
+
+    def record_prefix_blocks(self, replica_id: str, added: List[str],
+                             removed: List[str], block_size: int) -> None:
+        """A replica's prefix cache committed/evicted blocks.  Fold the
+        delta into the head-side directory and push the fresh snapshot on
+        its own long-poll key — routers mirror it for longest-prefix
+        routing; compiled route graphs never notice."""
+        dep_id = self._manager.find_replica_deployment(replica_id)
+        if dep_id is None:
+            return  # departed replica — reconcile already dropped it
+        if self._prefix_dir.update(dep_id, replica_id, added, removed,
+                                   block_size):
+            self._long_poll.notify_changed({
+                f"prefix_dir::{dep_id}": self._prefix_dir.snapshot(dep_id)})
 
     def record_handle_metrics(self, deployment_id: str, router_id: str,
                               total_inflight: int,

@@ -488,6 +488,15 @@ class DeploymentStateManager:
                     return True
         return False
 
+    def find_replica_deployment(self, replica_id: str) -> Optional[str]:
+        """Deployment id owning ``replica_id`` (replica ids are unique
+        across deployments), or None for unknown/departed replicas."""
+        for dep_id, state in self.deployments.items():
+            for r in state.replicas:
+                if r.replica_id == replica_id:
+                    return dep_id
+        return None
+
     def reconcile(self) -> Dict[str, List[Dict[str, Any]]]:
         """Tick all deployments; return {deployment_id: running_replicas}
         for those whose replica membership changed."""

@@ -234,6 +234,27 @@ class BlockTable:
         for i in range(self.num_tokens):
             yield self.get(i)
 
+    def extend_shared(self, block_ids: List[int]) -> None:
+        """Adopt already-shared full blocks at the table's tail (the
+        prefix-cache hit path: the caller has bumped refcounts via
+        ``allocator.share`` before handing ids over).  Only legal on a
+        block boundary, and every adopted page must be full — a partial
+        page would misalign every later position's KV entry."""
+        if not block_ids:
+            return
+        alloc = self.allocator
+        if self.num_tokens % alloc.block_size != 0:
+            raise ValueError(
+                "extend_shared off a block boundary "
+                f"({self.num_tokens} tokens, block_size {alloc.block_size})")
+        for b in block_ids:
+            if alloc.page_len(b) != alloc.block_size:
+                raise ValueError(
+                    f"extend_shared with partial block {b} "
+                    f"({alloc.page_len(b)}/{alloc.block_size} entries)")
+        self.block_ids.extend(block_ids)
+        self.num_tokens += len(block_ids) * alloc.block_size
+
     def fork(self) -> "BlockTable":
         """A child table sharing every block (prefix sharing); diverging
         writes copy-on-write via :meth:`append`."""
@@ -296,9 +317,17 @@ class BlockTable:
             return table
         ids = allocator.allocate(len(pages))
         try:
-            for b, page in zip(ids, pages):
+            for i, (b, page) in enumerate(zip(ids, pages)):
                 if len(page) > allocator.block_size:
                     raise ValueError("imported page exceeds block_size")
+                if i < len(pages) - 1 and len(page) != allocator.block_size:
+                    # A short page anywhere but the tail would shift every
+                    # later position's entry — a silent stream corruption
+                    # once tiering re-imports pages it exported itself.
+                    raise ValueError(
+                        f"imported page {i} misaligned: {len(page)} entries "
+                        f"in a non-tail block (block_size "
+                        f"{allocator.block_size})")
                 for entry in page:
                     allocator.append_entry(b, entry)
         except Exception:

@@ -125,7 +125,10 @@ class LLMServer(_ModelHostMixin):
                  prefill_time_per_token_s: float = 0.0,
                  decode_step_time_s: float = 0.0,
                  spec_k: int = 0, draft_agreement: float = 1.0,
-                 draft_step_time_s: float = 0.0):
+                 draft_step_time_s: float = 0.0,
+                 prefix_cache: bool = True,
+                 prefix_cache_blocks: Optional[int] = None,
+                 tier_host_pages: int = 0, tier_object_pages: int = 0):
         self._init_models(ckpt_root, model_specs,
                           prefill_time_per_token_s, decode_step_time_s,
                           draft_agreement=draft_agreement,
@@ -134,7 +137,11 @@ class LLMServer(_ModelHostMixin):
             self._load_model, num_blocks=num_blocks, block_size=block_size,
             watermark_blocks=watermark_blocks,
             max_prefill_per_step=max_prefill_per_step, pool="engine",
-            spec_k=spec_k, get_draft_model=self._load_draft)
+            spec_k=spec_k, get_draft_model=self._load_draft,
+            enable_prefix_cache=prefix_cache,
+            prefix_cache_blocks=prefix_cache_blocks,
+            tier_host_pages=tier_host_pages,
+            tier_object_pages=tier_object_pages)
 
     @serve.continuous_batch(max_batch_size=16)
     async def __call__(self, slots: List[Any]) -> List[Any]:
@@ -152,11 +159,19 @@ class PrefillWorker(_ModelHostMixin):
     def __init__(self, ckpt_root: Optional[str] = None,
                  model_specs: Optional[Dict[str, Any]] = None,
                  num_blocks: int = 512, block_size: int = 16,
-                 prefill_time_per_token_s: float = 0.0):
+                 prefill_time_per_token_s: float = 0.0,
+                 prefix_cache: bool = True,
+                 prefix_cache_blocks: Optional[int] = None):
         self._init_models(ckpt_root, model_specs,
                           prefill_time_per_token_s, 0.0)
         self._allocator = BlockAllocator(num_blocks, block_size,
                                          pool="prefill")
+        self._prefix_cache = None
+        if prefix_cache:
+            from ray_tpu.serve.llm.prefix_dir import ReplicaPrefixCache
+
+            self._prefix_cache = ReplicaPrefixCache(
+                self._allocator, max_blocks=prefix_cache_blocks)
 
     async def prefill(self, request: Any) -> Dict[str, Any]:
         req = parse_llm_request(request)
@@ -168,6 +183,7 @@ class PrefillWorker(_ModelHostMixin):
         tok = None
         waited = 0.0  # admission-wait: block-headroom backoff, measured
         prefill_dt = 0.0
+        ncached = 0
         for attempt in range(40):
             table = BlockTable(self._allocator)  # pairs_with: release
             t0 = time.time()
@@ -175,21 +191,35 @@ class PrefillWorker(_ModelHostMixin):
                 with _tracing.span("serve.prefill",
                                    attributes={"model": key,
                                                "tokens": len(context)}):
-                    tok = await run_in_executor(model.prefill, table,
-                                                context)
+                    ncached = 0
+                    if self._prefix_cache is not None:
+                        ncached = self._prefix_cache.acquire_into(
+                            table, context, key)
+                    if ncached:
+                        tok = await run_in_executor(model.prefill_cached,
+                                                    table, context, ncached)
+                    else:
+                        tok = await run_in_executor(model.prefill, table,
+                                                    context)
                 prefill_dt = time.time() - t0
                 break
             except NoFreeBlocks:
                 # Pool exhausted by concurrent prefills: back off until a
                 # peer frees its export (asyncio sleep — the loop serves
-                # other requests meanwhile).
+                # other requests meanwhile), first reclaiming cold
+                # prefix-cache blocks so cached-but-idle pages never
+                # starve live prefills.
                 table.release()
+                if self._prefix_cache is not None:
+                    self._prefix_cache.evict_for(
+                        self._allocator.blocks_needed(len(context) + 1))
                 t1 = time.time()
                 await asyncio.sleep(0.005 * (attempt + 1))
                 waited += (t1 - t0) + (time.time() - t1)
         else:  # no break: every attempt released its table and backed off
             raise NoFreeBlocks("prefill pool exhausted after backoff")
-        _m.PREFILL_TOKENS.inc(len(context), tags={"pool": "prefill"})
+        _m.PREFILL_TOKENS.inc(len(context) - ncached,
+                              tags={"pool": "prefill"})
         if resume and _attr.is_enabled():
             # Recovery re-prefill: the whole context was computed once
             # already (on the dead decode replica's behalf) — waste, not
@@ -200,6 +230,11 @@ class PrefillWorker(_ModelHostMixin):
                                  attributes={"tokens": len(context),
                                              "pool": "prefill"})
         generated = resume + [tok]
+        if self._prefix_cache is not None:
+            # Commit the prompt blocks while the table still owns them —
+            # the cache takes its own references, so they stay resident
+            # after the post-export release below.
+            self._prefix_cache.commit(table, req["prompt"], key)
         t_exp = time.time()
         try:
             payload = export_kv(table, prompt=req["prompt"],
@@ -229,7 +264,8 @@ class DecodeWorker(_ModelHostMixin):
                  watermark_blocks: int = 0,
                  decode_step_time_s: float = 0.0,
                  spec_k: int = 0, draft_agreement: float = 1.0,
-                 draft_step_time_s: float = 0.0):
+                 draft_step_time_s: float = 0.0,
+                 tier_host_pages: int = 0, tier_object_pages: int = 0):
         self._init_models(ckpt_root, model_specs, 0.0, decode_step_time_s,
                           draft_agreement=draft_agreement,
                           draft_step_time_s=draft_step_time_s)
@@ -239,7 +275,9 @@ class DecodeWorker(_ModelHostMixin):
             self._load_model, num_blocks=num_blocks, block_size=block_size,
             watermark_blocks=watermark_blocks, max_prefill_per_step=8,
             pool="decode", decode_only=True,
-            spec_k=spec_k, get_draft_model=self._load_draft)
+            spec_k=spec_k, get_draft_model=self._load_draft,
+            tier_host_pages=tier_host_pages,
+            tier_object_pages=tier_object_pages)
 
     @serve.continuous_batch(max_batch_size=16)
     async def decode(self, slots: List[Any]) -> List[Any]:
@@ -338,6 +376,8 @@ def build_disagg_app(*, ckpt_root: Optional[str] = None,
                      decode_step_time_s: float = 0.0,
                      spec_k: int = 0, draft_agreement: float = 1.0,
                      draft_step_time_s: float = 0.0,
+                     prefix_cache: bool = True,
+                     tier_host_pages: int = 0, tier_object_pages: int = 0,
                      deployment_prefix: str = "") -> Any:
     """Bind the prefill pool + decode pool + frontend into one app.
 
@@ -354,7 +394,8 @@ def build_disagg_app(*, ckpt_root: Optional[str] = None,
         num_replicas=prefill_replicas).bind(
             ckpt_root=ckpt_root, model_specs=model_specs,
             num_blocks=num_blocks, block_size=block_size,
-            prefill_time_per_token_s=prefill_time_per_token_s)
+            prefill_time_per_token_s=prefill_time_per_token_s,
+            prefix_cache=prefix_cache)
     decode = DecodeWorker.options(
         name=f"{deployment_prefix}DecodeWorker",
         num_replicas=decode_replicas).bind(
@@ -362,7 +403,9 @@ def build_disagg_app(*, ckpt_root: Optional[str] = None,
             num_blocks=num_blocks, block_size=block_size,
             decode_step_time_s=decode_step_time_s,
             spec_k=spec_k, draft_agreement=draft_agreement,
-            draft_step_time_s=draft_step_time_s)
+            draft_step_time_s=draft_step_time_s,
+            tier_host_pages=tier_host_pages,
+            tier_object_pages=tier_object_pages)
     return LLMFrontend.options(
         name=f"{deployment_prefix}LLMFrontend",
         num_replicas=frontend_replicas).bind(prefill, decode)
@@ -375,7 +418,10 @@ def build_monolithic_app(*, ckpt_root: Optional[str] = None,
                          prefill_time_per_token_s: float = 0.0,
                          decode_step_time_s: float = 0.0,
                          spec_k: int = 0, draft_agreement: float = 1.0,
-                         draft_step_time_s: float = 0.0) -> Any:
+                         draft_step_time_s: float = 0.0,
+                         prefix_cache: bool = True,
+                         tier_host_pages: int = 0,
+                         tier_object_pages: int = 0) -> Any:
     """The continuous-batching baseline on identical model timing."""
     return LLMServer.options(num_replicas=num_replicas).bind(
         ckpt_root=ckpt_root, model_specs=model_specs,
@@ -383,4 +429,7 @@ def build_monolithic_app(*, ckpt_root: Optional[str] = None,
         prefill_time_per_token_s=prefill_time_per_token_s,
         decode_step_time_s=decode_step_time_s,
         spec_k=spec_k, draft_agreement=draft_agreement,
-        draft_step_time_s=draft_step_time_s)
+        draft_step_time_s=draft_step_time_s,
+        prefix_cache=prefix_cache,
+        tier_host_pages=tier_host_pages,
+        tier_object_pages=tier_object_pages)

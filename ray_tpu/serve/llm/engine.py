@@ -69,7 +69,12 @@ class LLMEngine:
                  pool: str = "engine", decode_only: bool = False,
                  batch_capacity: int = 16,
                  spec_k: int = 0,
-                 get_draft_model: Optional[DraftProvider] = None):
+                 get_draft_model: Optional[DraftProvider] = None,
+                 enable_prefix_cache: bool = False,
+                 prefix_cache_blocks: Optional[int] = None,
+                 tier_host_pages: int = 0,
+                 tier_object_pages: int = 0,
+                 tier_host_idle_ticks: Optional[int] = None):
         self._get_model = get_model
         #: Speculative decoding: propose up to ``spec_k`` draft tokens per
         #: stream per step and verify them in one batched target pass.
@@ -77,9 +82,32 @@ class LLMEngine:
         self.spec_k = max(0, int(spec_k))
         self._get_draft = get_draft_model
         self.allocator = BlockAllocator(num_blocks, block_size, pool=pool)
-        self.scheduler = EngineScheduler(self.allocator,
-                                         watermark_blocks=watermark_blocks,
-                                         max_running=max_running)
+        #: Cold KV tiers (host / object store); None when both budgets are
+        #: zero — demotion then degrades to plain recompute-on-resume.
+        self.tiers = None
+        if tier_host_pages > 0 or tier_object_pages > 0:
+            from ray_tpu.serve.llm.tiering import KVTierManager
+
+            self.tiers = KVTierManager(pool=pool,
+                                       host_pages=tier_host_pages,
+                                       object_pages=tier_object_pages,
+                                       host_idle_ticks=tier_host_idle_ticks)
+        #: Replica prefix cache over committed prompt blocks; opt-in so
+        #: block-accounting unit tests keep their exact pool arithmetic.
+        self.prefix_cache = None
+        if enable_prefix_cache and not decode_only:
+            from ray_tpu.serve.llm.prefix_dir import ReplicaPrefixCache
+
+            self.prefix_cache = ReplicaPrefixCache(
+                self.allocator, max_blocks=prefix_cache_blocks,
+                tiers=self.tiers)
+        self.scheduler = EngineScheduler(
+            self.allocator,
+            watermark_blocks=watermark_blocks,
+            max_running=max_running,
+            demote_cb=self._demote_seq if self.tiers is not None else None,
+            reclaim_cb=(self._reclaim_blocks
+                        if self.prefix_cache is not None else None))
         self.max_prefill_per_step = max_prefill_per_step
         self.default_max_tokens = default_max_tokens
         self.decode_only = decode_only
@@ -149,6 +177,12 @@ class LLMEngine:
     async def step(self, slots: List[Any]) -> List[Any]:
         """One continuous-batch iteration over the live slots."""
         self._reap()
+        # Iteration boundary: advance the prefix-cache / tier LRU clocks
+        # (the scheduler's cadence IS the coldness clock — no wall time).
+        if self.prefix_cache is not None:
+            self.prefix_cache.tick()
+        if self.tiers is not None:
+            self.tiers.tick()
         attributing = _attr.is_enabled()
         if attributing:
             _m.BATCH_OCCUPANCY.set(len(slots) / self.batch_capacity,
@@ -239,7 +273,16 @@ class LLMEngine:
 
     async def _prefill(self, seq: Sequence) -> None:
         """Recompute-capable prefill: KV entries for the whole context
-        (prompt + any pre-preemption generations) plus one new token."""
+        (prompt + any pre-preemption generations) plus one new token.
+
+        Two elision paths run first when configured: a preempted-and-
+        demoted sequence promotes its own pages back from a cold tier
+        (skipping the recompute entirely), and a fresh sequence adopts
+        cached prefix blocks so only the suffix prefills.  Both fall back
+        to the plain full prefill on any failure — the deterministic model
+        makes every path byte-identical."""
+        if seq.kv_demoted and await self._resume_promoted(seq):
+            return
         model = await self._model(seq.model_key)
         context = seq.context()
         table = BlockTable(self.allocator)
@@ -248,7 +291,15 @@ class LLMEngine:
                            attributes={"model": seq.model_key,
                                        "tokens": len(context)}):
             try:
-                tok = await run_in_executor(model.prefill, table, context)
+                ncached = 0
+                if self.prefix_cache is not None:
+                    ncached = self.prefix_cache.acquire_into(
+                        table, context, seq.model_key)
+                if ncached:
+                    tok = await run_in_executor(
+                        model.prefill_cached, table, context, ncached)
+                else:
+                    tok = await run_in_executor(model.prefill, table, context)
             except NoFreeBlocks:
                 # Admission raced another consumer of the pool (e.g. a
                 # concurrent handoff import): roll back and requeue.
@@ -265,16 +316,81 @@ class LLMEngine:
         seq.generated.append(tok)
         if seq.stop_token is not None and tok == seq.stop_token:
             seq.stopped = True
-        _m.PREFILL_TOKENS.inc(len(context),
+        _m.PREFILL_TOKENS.inc(len(context) - ncached,
                               tags={"pool": self.allocator.pool})
+        if self.prefix_cache is not None:
+            self.prefix_cache.commit(table, seq.prompt, seq.model_key)
         if seq.attrib is not None:
             now = time.time()
             if seq.preemptions > 0:
                 # Resume after preemption: the whole context (prompt plus
                 # tokens the request already produced) is recomputed work.
-                seq.attrib.on_recompute(now - t0, len(context), now)
+                seq.attrib.on_recompute(now - t0, len(context) - ncached,
+                                        now)
             else:
                 seq.attrib.on_prefill(now - t0)
+
+    async def _resume_promoted(self, seq: Sequence) -> bool:
+        """Try resuming a preempted sequence from demoted pages: promote,
+        rebuild the table, one decode step for the next token.  Returns
+        False (flag cleared) when the pages are gone or promotion fails —
+        the caller re-prefills, byte-identically."""
+        seq.kv_demoted = False
+        key = ("seq", seq.seq_id)
+        t0 = time.time()
+        try:
+            pages = self.tiers.promote_pages(key)
+        except Exception:  # noqa: BLE001 — incl. the llm_kv_promote fault
+            return False
+        if pages is None:
+            return False
+        model = await self._model(seq.model_key)
+        try:
+            table = BlockTable.from_pages(self.allocator, pages)
+        except NoFreeBlocks:
+            # No device room after all — park the pages back in the tier
+            # (best-effort) and requeue for another admission pass.
+            seq.kv_demoted = self.tiers.demote(key, pages)
+            self.scheduler.preempt_seq(seq)
+            return True
+        try:
+            tok = await run_in_executor(model.decode_one, table)
+        except NoFreeBlocks:
+            table.release()
+            self.scheduler.preempt_seq(seq)
+            return True
+        except Exception:
+            table.release()
+            raise
+        seq.table = table
+        seq.generated.append(tok)
+        if seq.stop_token is not None and tok == seq.stop_token:
+            seq.stopped = True
+        if seq.attrib is not None:
+            # Promoted pages are a page import, not recomputed FLOPs —
+            # attribution lands in the handoff bucket, and the recompute
+            # counter stays untouched (that is the whole point).
+            seq.attrib.on_handoff(time.time() - t0)
+        return True
+
+    def _demote_seq(self, seq: Sequence) -> bool:
+        """Scheduler demote hook: snapshot the victim's pages into a cold
+        tier before its device blocks are released."""
+        if seq.table is None or self.tiers is None:
+            return False
+        try:
+            pages = seq.table.export_pages()
+        except Exception:  # noqa: BLE001 — racing release; plain recompute
+            return False
+        return self.tiers.demote(("seq", seq.seq_id), pages)
+
+    def _reclaim_blocks(self, num_blocks: int) -> int:
+        """Scheduler reclaim hook: evict cold prefix-cache blocks (they
+        demote when a tier has room) so admission headroom counts
+        demotable bytes, not just the raw free list."""
+        if self.prefix_cache is None:
+            return 0
+        return self.prefix_cache.evict_for(num_blocks)
 
     def _import_handoff(self, seq: Sequence) -> None:
         """Decode-side admission: rebuild the block table from exported
@@ -466,6 +582,11 @@ class LLMEngine:
         for k in dead:
             _, seq = self._tracked.pop(k)
             self.scheduler.finish(seq)
+            if seq.kv_demoted and self.tiers is not None:
+                # A demoted-then-cancelled sequence will never promote —
+                # drop its tier entry instead of waiting out the LRU.
+                self.tiers.discard(("seq", seq.seq_id))
+                seq.kv_demoted = False
 
 
 class ToyLMShard:

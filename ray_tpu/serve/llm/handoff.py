@@ -31,10 +31,22 @@ from ray_tpu.util import tracing as _tracing
 
 
 def _payload_bytes(pages: List[List[Any]]) -> int:
+    """Best-effort payload size for the bytes counters.  An entry with a
+    real ``nbytes`` attribute is trusted as-is (including legitimate 0 —
+    the old ``or``-fallback re-counted those through ``np.asarray``), and
+    an entry numpy cannot size counts as 0: accounting must never fail an
+    export whose pages were already copied (tiering reuses this on every
+    demotion, where a raise here would discard the pages)."""
     total = 0
     for page in pages:
         for entry in page:
-            total += getattr(entry, "nbytes", 0) or np.asarray(entry).nbytes
+            nbytes = getattr(entry, "nbytes", None)
+            if nbytes is None:
+                try:
+                    nbytes = np.asarray(entry).nbytes
+                except Exception:
+                    nbytes = 0
+            total += int(nbytes)
     return total
 
 

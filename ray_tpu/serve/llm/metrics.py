@@ -117,3 +117,35 @@ SPEC_FALLBACKS = _metrics.Counter(
     "ray_tpu_llm_spec_fallbacks_total",
     "Verify-step failures degraded to a plain one-token decode",
     tag_keys=("pool",))
+
+# Cluster prefix cache + KV tiering (PR 17): the lookup/hit pair feeds
+# serve.metrics.prefix_hit_rate(); demoted/promoted and the occupancy
+# gauge track pages moving between the device, host, and object tiers.
+PREFIX_LOOKUP_TOKENS = _metrics.Counter(
+    "ray_tpu_llm_prefix_lookup_tokens_total",
+    "Full-block prompt tokens checked against the replica prefix cache",
+    tag_keys=("pool",))
+PREFIX_HIT_TOKENS = _metrics.Counter(
+    "ray_tpu_llm_prefix_hit_tokens_total",
+    "Prompt tokens served from cached prefix blocks instead of prefill",
+    tag_keys=("pool",))
+PREFIX_MISS_TOKENS = _metrics.Counter(
+    "ray_tpu_llm_prefix_miss_tokens_total",
+    "Full-block prompt tokens that missed the prefix cache",
+    tag_keys=("pool",))
+PREFIX_CACHE_BLOCKS = _metrics.Gauge(
+    "ray_tpu_llm_prefix_cache_blocks",
+    "Committed device blocks pinned by the replica prefix cache",
+    tag_keys=("pool",))
+KV_DEMOTED_PAGES = _metrics.Counter(
+    "ray_tpu_llm_kv_demoted_pages_total",
+    "KV pages demoted out of the device pool into a colder tier",
+    tag_keys=("pool", "tier"))
+KV_PROMOTED_PAGES = _metrics.Counter(
+    "ray_tpu_llm_kv_promoted_pages_total",
+    "KV pages promoted from a cold tier back into the device pool",
+    tag_keys=("pool", "tier"))
+TIER_PAGES = _metrics.Gauge(
+    "ray_tpu_llm_kv_tier_pages",
+    "KV pages currently resident in one cold tier (host or object store)",
+    tag_keys=("pool", "tier"))

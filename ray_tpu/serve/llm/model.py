@@ -131,6 +131,22 @@ class ToyLM:
         table.append(self.kv_entry(tok, table.num_tokens))
         return tok
 
+    def prefill_cached(self, table: BlockTable, context: List[int],
+                       cached_tokens: int) -> int:
+        """Prefill with the first ``cached_tokens`` positions already
+        resident in ``table`` (shared prefix-cache blocks or promoted
+        pages).  Only the suffix burns device time and writes entries —
+        the elided prefix is the whole win; the emitted token is still a
+        function of every cached position, so a stale or torn shared
+        block changes the stream (oracle-checked)."""
+        suffix = context[cached_tokens:]
+        self._burn(self.prefill_time_per_token_s * len(suffix))
+        for off, tok in enumerate(suffix):
+            table.append(self.kv_entry(tok, cached_tokens + off))
+        tok = self.next_token(list(table.entries()))
+        table.append(self.kv_entry(tok, table.num_tokens))
+        return tok
+
     def decode_one(self, table: BlockTable) -> int:
         """One decode step: next token from the cached context, its KV
         entry appended.  Callers batch the per-iteration device burn via

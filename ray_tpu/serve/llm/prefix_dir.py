@@ -1,0 +1,430 @@
+"""Cluster-wide prefix directory over token blocks (RadixAttention shape).
+
+Three pieces, one hash space:
+
+* :func:`chain_hashes` — the canonical hash chain over *full* token
+  blocks: ``h_i = H(h_{i-1}, block_i_tokens)`` seeded by the multiplex
+  model key.  A hash names the whole prefix up to and including its
+  block, so "replica R holds ``h_i``" means R can serve the first
+  ``(i+1)·block_size`` tokens of any prompt with that prefix from cache.
+  Hashes are content-addressed: a COW fork that diverged inside a block
+  produces a different block hash, so a child can never match its
+  parent's diverged pages.
+* :class:`ReplicaPrefixCache` — the replica-side cache: committed prompt
+  blocks stay resident in the device pool under a cache-owned reference,
+  matched by chain walk on later prefills, LRU-evicted (leaf-first, so a
+  chain never loses an interior link) under a block budget, optionally
+  demoting evicted pages into a :class:`~ray_tpu.serve.llm.tiering.\
+KVTierManager` host/object tier instead of discarding them.  Commits and
+  evictions are reported to the controller (fire-and-forget, mirroring
+  the multiplexed-model-id push) so the head-side directory stays fresh.
+* :class:`PrefixDirectory` — the controller-side directory: replica id →
+  held hashes per deployment, snapshotted onto the ``prefix_dir::<dep>``
+  long-poll key.  Routers mirror the snapshot and send each request to
+  the replica holding its longest cached prefix (see ``serve/router.py``).
+  The key is separate from ``replicas::<dep>`` on purpose: a directory
+  update must never look like a membership change to the compiled-route
+  manager, or every block commit would tear the compiled graph down.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.serve.llm import metrics as _m
+
+#: hex chars per chain hash (blake2b-8: collision-safe for cache keys and
+#: cheap to ship over the long-poll plane as plain strings).
+_DIGEST_SIZE = 8
+
+
+def chain_hashes(tokens: List[int], block_size: int, *,
+                 model_key: str = "base") -> List[str]:
+    """Hash chain over the FULL blocks of ``tokens``: one hex digest per
+    complete block, each folding in its predecessor — position and
+    content sensitive, deterministic across processes (the router and
+    every replica must agree).  The trailing partial block is never
+    hashed: only full, immutable blocks are cacheable."""
+    if block_size <= 0:
+        raise ValueError("block_size must be positive")
+    n_full = len(tokens) // block_size
+    out: List[str] = []
+    prev = hashlib.blake2b(model_key.encode("utf-8"),
+                           digest_size=_DIGEST_SIZE).digest()
+    for i in range(n_full):
+        block = tokens[i * block_size:(i + 1) * block_size]
+        m = hashlib.blake2b(digest_size=_DIGEST_SIZE)
+        m.update(prev)
+        m.update(struct.pack(f"<{len(block)}q", *[int(t) for t in block]))
+        prev = m.digest()
+        out.append(prev.hex())
+    return out
+
+
+def longest_match(hashes: Iterable[str], held: Set[str]) -> int:
+    """Length of the longest chain prefix of ``hashes`` contained in
+    ``held`` (a chain breaks at its first missing link)."""
+    n = 0
+    for h in hashes:
+        if h not in held:
+            break
+        n += 1
+    return n
+
+
+def _default_reporter(added: List[str], removed: List[str],
+                      block_size: int) -> None:
+    """Push commit/evict deltas to the controller through the replica
+    context — the multiplexed-model-ids plumbing, one plane over.  A
+    cache running outside a replica (unit tests, bench harness internals)
+    silently has no directory to feed."""
+    try:
+        from ray_tpu.serve import context as serve_context
+
+        ctx = serve_context.get_internal_replica_context()
+        if ctx is not None and ctx._replica is not None:
+            ctx._replica.record_prefix_blocks(added, removed, block_size)
+    except Exception:
+        pass
+
+
+class _BlockHold:
+    """Ownership token for one device block entering the prefix cache:
+    construction takes a pool reference (``allocator.share``); the caller
+    must either :meth:`register` it into the cache map or :meth:`free`
+    it back — the paired-effect checker enforces the transfer at every
+    construction site."""
+
+    def __init__(self, cache: "ReplicaPrefixCache", block_id: int):
+        self._cache = cache
+        self.block_id = block_id
+        cache.allocator.share([block_id])
+
+    def register(self, h: str, parent: Optional[str], tokens: int) -> None:
+        self._cache._entries[h] = _CacheEntry(self.block_id, parent, tokens,
+                                              self._cache._clock)
+        if parent is not None and parent in self._cache._entries:
+            self._cache._entries[parent].children += 1
+
+    def free(self) -> None:
+        self._cache.allocator.free([self.block_id])
+
+
+class _CacheEntry:
+    __slots__ = ("block_id", "parent", "tokens", "tick", "children")
+
+    def __init__(self, block_id: int, parent: Optional[str], tokens: int,
+                 tick: int):
+        self.block_id = block_id
+        #: chain-parent hash (None for a chain root) — eviction is
+        #: leaf-first so interior links never strand their suffixes.
+        self.parent = parent
+        #: cumulative prefix length this hash names (tokens, not blocks).
+        self.tokens = tokens
+        self.tick = tick
+        self.children = 0
+
+
+class ReplicaPrefixCache:
+    """Replica-side committed-prefix cache over one block allocator.
+
+    Thread-safe: the engine step, the prefill worker's event loop, and a
+    reclaim callback from admission may all touch it; mutations take
+    ``_lock`` and nothing blocking happens under it (the reporter fires
+    outside the lock).
+    """
+
+    def __init__(self, allocator: Any, *, max_blocks: Optional[int] = None,
+                 tiers: Optional[Any] = None,
+                 reporter: Optional[Callable[[List[str], List[str], int],
+                                             None]] = None):
+        self.allocator = allocator
+        #: cache block budget (device blocks pinned by the cache's own
+        #: refs) — default half the pool, so admission always has room.
+        self.max_blocks = (max(1, allocator.num_blocks // 2)
+                           if max_blocks is None else max(0, int(max_blocks)))
+        self._tiers = tiers
+        self._reporter = _default_reporter if reporter is None else reporter
+        self._entries: Dict[str, _CacheEntry] = {}  # guarded_by: _lock
+        self._clock = 0  # guarded_by: _lock
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------------- clock
+
+    def tick(self) -> None:
+        """Advance the LRU clock — called once per engine iteration, so
+        recency is measured in scheduler steps, not wall time."""
+        with self._lock:
+            self._clock += 1
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def held_hashes(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    # ---------------------------------------------------------------- match
+
+    def acquire_into(self, table: Any, context: List[int],
+                     model_key: str) -> int:
+        """Graft the longest cached prefix of ``context`` onto ``table``:
+        device-resident blocks by shared reference (zero copy), then —
+        when the device chain ends but the tier still holds the next
+        links — promoted host/object pages re-imported into fresh blocks.
+        Returns the number of context tokens now covered by the table;
+        the caller prefills only the suffix.
+
+        ``NoFreeBlocks`` from a tier-page re-import propagates (the
+        caller's prefill error path releases the table); a failed promote
+        (e.g. the ``llm_kv_promote`` fault) just ends the match — the
+        suffix re-prefills, byte-identically.
+        """
+        bs = self.allocator.block_size
+        tags = {"pool": self.allocator.pool}
+        n_full = len(context) // bs
+        matched = 0
+        if n_full:
+            hashes = chain_hashes(context, bs, model_key=model_key)
+            device_ids: List[int] = []
+            with self._lock:
+                self._clock += 1
+                i = 0
+                for h in hashes:
+                    ent = self._entries.get(h)
+                    if ent is None:
+                        break
+                    ent.tick = self._clock
+                    device_ids.append(ent.block_id)
+                    i += 1
+                if device_ids:
+                    # The sequence gets its OWN references — still under
+                    # the lock, so an eviction cannot free a matched
+                    # block between the walk and the share.
+                    self.allocator.share(device_ids)
+            if device_ids:
+                try:
+                    table.extend_shared(device_ids)
+                except Exception:
+                    self.allocator.free(device_ids)
+                    raise
+                matched = len(device_ids) * bs
+            # Promote-on-hit: the chain continues in a colder tier —
+            # restore those pages instead of re-prefilling them.
+            if self._tiers is not None:
+                while i < len(hashes):
+                    try:
+                        pages = self._tiers.promote_pages(
+                            ("prefix", hashes[i]))
+                    except Exception as e:
+                        from ray_tpu.serve.llm.blocks import NoFreeBlocks
+
+                        if isinstance(e, NoFreeBlocks):
+                            raise
+                        break  # promote failed: prefill the rest
+                    if pages is None:
+                        break
+                    for page in pages:
+                        for entry in page:
+                            table.append(entry)
+                        matched += len(page)
+                    i += 1
+        _m.PREFIX_LOOKUP_TOKENS.inc(len(context), tags=tags)
+        if matched:
+            _m.PREFIX_HIT_TOKENS.inc(matched, tags=tags)
+        if matched < len(context):
+            _m.PREFIX_MISS_TOKENS.inc(len(context) - matched, tags=tags)
+        return matched
+
+    # --------------------------------------------------------------- commit
+
+    def commit(self, table: Any, prompt: List[int], model_key: str) -> None:
+        """Register the full prompt blocks of a prefilled table: each
+        gains a cache-owned pool reference, so it stays resident after
+        the sequence retires.  Only blocks wholly inside the prompt are
+        committed — generated tokens differ per request and a partial
+        block is still mutable.  Idempotent per hash; over-budget commits
+        evict LRU leaves first (possibly demoting their pages)."""
+        bs = self.allocator.block_size
+        n_full = min(len(prompt) // bs, len(table.block_ids))
+        if n_full <= 0 or self.max_blocks <= 0:
+            return
+        hashes = chain_hashes([int(t) for t in prompt[:n_full * bs]],
+                              bs, model_key=model_key)
+        added: List[str] = []
+        removed: List[str] = []
+        with self._lock:
+            self._clock += 1
+            parent: Optional[str] = None
+            for i in range(n_full):
+                h = hashes[i]
+                ent = self._entries.get(h)
+                if ent is not None:
+                    ent.tick = self._clock
+                    parent = h
+                    continue
+                hold = _BlockHold(self, table.block_ids[i])  # pairs_with: register, free
+                if len(self._entries) >= self.max_blocks \
+                        and not self._evict_lru_locked(removed):
+                    # Budget full of unevictable (interior) entries.
+                    hold.free()
+                    break
+                hold.register(h, parent, (i + 1) * bs)
+                added.append(h)
+                parent = h
+        self._report(added, removed)
+
+    # ------------------------------------------------------------- eviction
+
+    def _evict_lru_locked(self, removed: List[str]) -> bool:
+        """Drop the least-recently-used LEAF entry (lock held).  Its page
+        demotes to the tier manager when one is attached and the cache
+        holds the only device reference; the device block reference is
+        freed either way.  Returns False when nothing is evictable."""
+        leaves = [(ent.tick, h) for h, ent in self._entries.items()
+                  if ent.children == 0]
+        if not leaves:
+            return False
+        _, h = min(leaves)
+        hold = _evicted_hold(self, h)  # pairs_with: free, demote
+        if self._tiers is not None \
+                and self.allocator.refcount(hold.block_id) == 1:
+            hold.demote(self._tiers, ("prefix", h))
+        else:
+            hold.free()
+        removed.append(h)
+        return True
+
+    def evict_for(self, num_blocks: int) -> int:
+        """Reclaim device blocks for admission pressure: evict LRU leaves
+        until ``num_blocks`` blocks actually returned to the pool (cache
+        refs on blocks a running sequence still shares free a ref but no
+        memory — keep going) or nothing evictable remains.  Returns the
+        number of blocks returned to the free list."""
+        freed = 0
+        removed: List[str] = []
+        with self._lock:
+            before = self.allocator.num_free
+            while freed < num_blocks and self._entries:
+                if not self._evict_lru_locked(removed):
+                    break
+                now_free = self.allocator.num_free
+                freed = now_free - before
+        self._report([], removed)
+        return max(0, freed)
+
+    def drop_all(self) -> None:
+        removed: List[str] = []
+        with self._lock:
+            while self._entries:
+                if not self._evict_lru_locked(removed):
+                    break
+        self._report([], removed)
+
+    # ------------------------------------------------------------ reporting
+
+    def _report(self, added: List[str], removed: List[str]) -> None:
+        if not added and not removed:
+            return
+        try:
+            self._reporter(list(added), list(removed),
+                           self.allocator.block_size)
+        except Exception:
+            pass
+        with self._lock:
+            _m.PREFIX_CACHE_BLOCKS.set(len(self._entries),
+                                       tags={"pool": self.allocator.pool})
+
+
+class _EvictedHold:
+    """Ownership token for one cache entry leaving the map: the entry is
+    already unregistered; the caller must :meth:`free` the cache's device
+    reference or :meth:`demote` the page into a tier (which also frees)
+    — checker-enforced at the construction site."""
+
+    def __init__(self, cache: ReplicaPrefixCache, h: str,
+                 ent: _CacheEntry):
+        self._cache = cache
+        self.block_id = ent.block_id
+        self._hash = h
+        self._ent = ent
+
+    def free(self) -> None:
+        self._cache.allocator.free([self.block_id])
+
+    def demote(self, tiers: Any, key: Tuple[str, str]) -> None:
+        try:
+            pages = self._cache.allocator.export_pages([self.block_id])
+            tiers.demote(key, pages)
+        except Exception:
+            pass
+        self._cache.allocator.free([self.block_id])
+
+
+def _evicted_hold(cache: ReplicaPrefixCache, h: str) -> _EvictedHold:
+    """Unregister ``h`` from the cache map (lock held by caller) and
+    return the hold carrying its device reference."""
+    ent = cache._entries.pop(h)
+    if ent.parent is not None:
+        parent = cache._entries.get(ent.parent)
+        if parent is not None:
+            parent.children = max(0, parent.children - 1)
+    return _EvictedHold(cache, h, ent)
+
+
+# --------------------------------------------------------------------------
+# Controller-side directory
+# --------------------------------------------------------------------------
+
+class PrefixDirectory:
+    """Head-side view: deployment → replica → held chain hashes.  Fed by
+    replica reports, trimmed by the reconciler (a dead replica's entries
+    drop the same tick its replica-set shrink is pushed), snapshotted
+    onto the ``prefix_dir::<dep>`` long-poll key."""
+
+    def __init__(self) -> None:
+        self._deps: Dict[str, Dict[str, Set[str]]] = {}
+        self._block_size: Dict[str, int] = {}
+
+    def update(self, dep_id: str, replica_id: str, added: Iterable[str],
+               removed: Iterable[str], block_size: int) -> bool:
+        """Apply one replica report; returns True when the snapshot
+        changed (the caller then pushes it)."""
+        reps = self._deps.setdefault(dep_id, {})
+        held = reps.setdefault(replica_id, set())
+        before = len(held)
+        held.update(added)
+        held.difference_update(removed)
+        changed = len(held) != before or bool(added and removed)
+        if block_size and self._block_size.get(dep_id) != int(block_size):
+            self._block_size[dep_id] = int(block_size)
+            changed = True
+        if not held:
+            reps.pop(replica_id, None)
+        return changed
+
+    def retain(self, dep_id: str, live_replica_ids: Set[str]) -> bool:
+        """Drop directory entries for replicas no longer in the live set.
+        Returns True when anything was dropped — the reconciler includes
+        the shrunk snapshot in the SAME long-poll push as the replica-set
+        change, so a router can never route on a dead replica's prefixes
+        after it saw the death."""
+        reps = self._deps.get(dep_id)
+        if not reps:
+            return False
+        dead = [rid for rid in reps if rid not in live_replica_ids]
+        for rid in dead:
+            del reps[rid]
+        return bool(dead)
+
+    def snapshot(self, dep_id: str) -> Dict[str, Any]:
+        reps = self._deps.get(dep_id, {})
+        return {
+            "block_size": self._block_size.get(dep_id, 0),
+            "replicas": {rid: sorted(held) for rid, held in reps.items()
+                         if held},
+        }

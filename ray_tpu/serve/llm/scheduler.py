@@ -56,6 +56,9 @@ class Sequence:
         #: Exported KV pages + generated prefix from a prefill replica —
         #: when set, admission imports pages instead of recomputing.
         self.handoff = handoff
+        #: Set when preemption demoted this sequence's pages into a cold
+        #: tier — resume promotes them back instead of re-prefilling.
+        self.kv_demoted = False
         self.arrival = next(_seq_counter)
         self.status = WAITING
         self.table: Optional[BlockTable] = None
@@ -105,10 +108,22 @@ class EngineScheduler:
 
     def __init__(self, allocator: BlockAllocator, *,
                  watermark_blocks: int = 0,
-                 max_running: Optional[int] = None):
+                 max_running: Optional[int] = None,
+                 demote_cb: Optional[Any] = None,
+                 reclaim_cb: Optional[Any] = None):
         self.allocator = allocator
         self.watermark_blocks = watermark_blocks
         self.max_running = max_running
+        #: ``demote_cb(seq) -> bool`` — offered a sequence being preempted;
+        #: True means its pages landed in a cold tier (demote-instead-of-
+        #: discard) and resume can promote instead of re-prefilling.
+        self.demote_cb = demote_cb
+        #: ``reclaim_cb(blocks) -> int`` — asked to free device blocks when
+        #: admission headroom falls short (prefix-cache eviction); returns
+        #: blocks actually returned to the pool.  Demotable bytes thereby
+        #: count toward admission headroom, so tiering pressure — not
+        #: allocator exhaustion — is the admission backstop.
+        self.reclaim_cb = reclaim_cb
         self.waiting: List[Sequence] = []
         self.running: List[Sequence] = []
 
@@ -135,7 +150,17 @@ class EngineScheduler:
             head = self.waiting[0]
             need = self.allocator.blocks_needed(len(head.context()) + 1)
             if self.allocator.num_free - self.watermark_blocks < need:
-                break
+                short = need - (self.allocator.num_free
+                                - self.watermark_blocks)
+                freed = 0
+                if self.reclaim_cb is not None:
+                    try:
+                        freed = int(self.reclaim_cb(short))
+                    except Exception:
+                        freed = 0
+                if freed <= 0 or (self.allocator.num_free
+                                  - self.watermark_blocks < need):
+                    break
             self.waiting.pop(0)
             head.status = RUNNING
             self.running.append(head)
@@ -181,6 +206,14 @@ class EngineScheduler:
             return
         self.running.remove(seq)
         if seq.table is not None:
+            if self.demote_cb is not None:
+                # Demote-instead-of-discard: park the pages in a cold tier
+                # (when one has room) so resume promotes rather than
+                # re-prefilling the whole context.
+                try:
+                    seq.kv_demoted = bool(self.demote_cb(seq))
+                except Exception:
+                    seq.kv_demoted = False
             seq.table.release()
             seq.table = None
         seq.status = WAITING

@@ -1,0 +1,244 @@
+"""KV page tiering: device → host → object-store demotion, promote-on-hit.
+
+The device pool (:class:`~ray_tpu.serve.llm.blocks.BlockAllocator`) is the
+hot tier.  When the scheduler preempts a sequence, or the prefix cache
+evicts a cold committed block, the pages need not be discarded — they
+demote into a **host tier** (plain in-process page lists, the "CPU RAM"
+stand-in) and, past its budget, into the **object store** (``ray_tpu.put``
+refs — the same plane ``handoff.py`` ships pages across replicas on).
+Promotion re-imports the pages into fresh device blocks instead of
+re-prefilling, which is pure saved FLOPs: the deterministic model makes a
+restored page byte-identical to a recomputed one.
+
+LRU clocks are driven by the engine's iteration boundaries (``tick()``),
+not wall time, matching the scheduler's notion of "cold".
+
+Ownership discipline: a promotion *takes* the entry out of the tier via a
+:class:`_TierClaim`; every exit path must either ``commit()`` (pages are
+now on device) or ``restore()`` (promotion failed — e.g. the
+``llm_kv_promote`` fault point — put the entry back so a later resume can
+retry).  The paired-effect checker enforces this at the claim sites.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import fault_injection
+from ray_tpu.serve.llm import metrics as _m
+
+#: tier names, hottest-to-coldest below the device pool.
+HOST = "host"
+OBJECT = "object"
+
+Key = Tuple[str, str]
+
+
+class _TierClaim:
+    """Ownership token for one tier entry being promoted: construction
+    removes the entry from its tier; the caller must ``commit()`` (pages
+    landed on device) or ``restore()`` (promotion failed) on every path —
+    checker-enforced at the construction site."""
+
+    def __init__(self, tiers: "KVTierManager", key: Key):
+        self._tiers = tiers
+        self.key = key
+        self.tier, self._entry = tiers._pop(key)
+
+    @property
+    def found(self) -> bool:
+        return self.tier is not None
+
+    def pages(self) -> List[List[Any]]:
+        """Materialize the claimed pages (object-tier entries resolve
+        their ref here — may raise; callers restore on failure)."""
+        if self.tier == OBJECT:
+            import ray_tpu
+
+            return ray_tpu.get(self._entry)
+        return self._entry
+
+    def commit(self) -> None:
+        self._entry = None
+
+    def restore(self) -> None:
+        if self.tier is not None:
+            self._tiers._restore(self.key, self.tier, self._entry)
+
+
+class KVTierManager:
+    """Host + object-store page tiers under one budget pair.
+
+    ``host_pages``/``object_pages`` are page budgets (a page = one block's
+    entry list); 0 disables that tier.  Thread-safe — the engine step,
+    prefix-cache eviction, and admission reclaim may all demote/promote
+    concurrently.
+    """
+
+    def __init__(self, *, pool: str = "engine", host_pages: int = 0,
+                 object_pages: int = 0, host_idle_ticks: Optional[int] = None):
+        self.pool = pool
+        self.host_pages = max(0, int(host_pages))
+        self.object_pages = max(0, int(object_pages))
+        #: host entries idle this many ticks spill to the object tier on
+        #: the next tick (None = only capacity pressure spills).
+        self.host_idle_ticks = host_idle_ticks
+        self._lock = threading.Lock()
+        #: key -> (pages, tick); insertion order is the LRU order.
+        self._host: "OrderedDict[Key, Tuple[List[List[Any]], int]]" = \
+            OrderedDict()  # guarded_by: _lock
+        #: key -> (object ref, num_pages, tick)
+        self._object: "OrderedDict[Key, Tuple[Any, int, int]]" = \
+            OrderedDict()  # guarded_by: _lock
+        self._clock = 0  # guarded_by: _lock
+
+    @property
+    def enabled(self) -> bool:
+        return self.host_pages > 0 or self.object_pages > 0
+
+    def __contains__(self, key: Key) -> bool:
+        with self._lock:
+            return key in self._host or key in self._object
+
+    def occupancy(self) -> Dict[str, int]:
+        with self._lock:
+            return {HOST: sum(len(p) for p, _ in self._host.values()),
+                    OBJECT: sum(n for _, n, _ in self._object.values())}
+
+    # ----------------------------------------------------------------- clock
+
+    def tick(self) -> None:
+        """Advance the LRU clock at an engine iteration boundary; spill
+        host entries idle past ``host_idle_ticks`` down to the object
+        tier (coldness flows downward between iterations, never on the
+        request path)."""
+        with self._lock:
+            self._clock += 1
+            if self.host_idle_ticks is None:
+                return
+            cutoff = self._clock - self.host_idle_ticks
+            stale = [k for k, (_, t) in self._host.items() if t <= cutoff]
+            for k in stale:
+                self._spill_oldest_locked(victim=k)
+        self._gauges()
+
+    # ---------------------------------------------------------------- demote
+
+    def demote(self, key: Key, pages: List[List[Any]]) -> bool:
+        """Accept pages leaving the device tier.  Host-first; host
+        overflow spills its LRU entry toward the object store; returns
+        False when no tier has room (the caller discards — plain
+        recompute-on-resume)."""
+        if not pages:
+            return False
+        n = len(pages)
+        stored = False
+        with self._lock:
+            if self.host_pages > 0 and n <= self.host_pages:
+                self._host[key] = (pages, self._clock)
+                self._host.move_to_end(key)
+                _m.KV_DEMOTED_PAGES.inc(n, tags={"pool": self.pool,
+                                                 "tier": HOST})
+                while self._host_occupancy_locked() > self.host_pages:
+                    if not self._spill_oldest_locked():
+                        break
+                stored = key in self._host or key in self._object
+            elif self.object_pages > 0 and n <= self.object_pages:
+                stored = self._put_object_locked(key, pages)
+        self._gauges()
+        return stored
+
+    def _host_occupancy_locked(self) -> int:
+        return sum(len(p) for p, _ in self._host.values())
+
+    def _spill_oldest_locked(self, victim: Optional[Key] = None) -> bool:
+        """Move one host entry (LRU, or ``victim``) down to the object
+        tier; entries that fit nowhere are dropped (their sequences
+        recompute)."""
+        if not self._host:
+            return False
+        if victim is None:
+            victim = next(iter(self._host))
+        pages, _ = self._host.pop(victim)
+        if self.object_pages > 0 and len(pages) <= self.object_pages:
+            return self._put_object_locked(victim, pages)
+        return True  # dropped — still made room
+
+    def _put_object_locked(self, key: Key, pages: List[List[Any]]) -> bool:
+        try:
+            import ray_tpu
+
+            ref = ray_tpu.put(pages)
+        except Exception:
+            return False  # no runtime (unit tests) — drop instead of wedge
+        self._object[key] = (ref, len(pages), self._clock)
+        self._object.move_to_end(key)
+        _m.KV_DEMOTED_PAGES.inc(len(pages), tags={"pool": self.pool,
+                                                  "tier": OBJECT})
+        while sum(n for _, n, _ in self._object.values()) \
+                > self.object_pages and len(self._object) > 1:
+            self._object.popitem(last=False)
+        return key in self._object
+
+    # --------------------------------------------------------------- promote
+
+    def promote_pages(self, key: Key) -> Optional[List[List[Any]]]:
+        """Take ``key``'s pages back toward the device tier.  Returns
+        None when no tier holds the key.  Consults the ``llm_kv_promote``
+        fault point — chaos kills a promotion here, and the entry is
+        restored so the caller's re-prefill fallback (or a later retry)
+        stays possible."""
+        if key not in self:
+            return None
+        claim = _TierClaim(self, key)  # pairs_with: commit, restore
+        if not claim.found:
+            claim.commit()
+            return None  # raced another promoter
+        try:
+            fault_injection.check("llm_kv_promote")
+            pages = claim.pages()
+        except BaseException:
+            claim.restore()
+            raise
+        claim.commit()
+        _m.KV_PROMOTED_PAGES.inc(len(pages), tags={"pool": self.pool,
+                                                   "tier": claim.tier})
+        self._gauges()
+        return pages
+
+    def discard(self, key: Key) -> None:
+        tier, _ = self._pop(key)
+        if tier is not None:
+            self._gauges()
+
+    # ------------------------------------------------------------- internals
+
+    def _pop(self, key: Key) -> Tuple[Optional[str], Any]:
+        with self._lock:
+            if key in self._host:
+                pages, _ = self._host.pop(key)
+                return HOST, pages
+            if key in self._object:
+                ref, _, _ = self._object.pop(key)
+                return OBJECT, ref
+            return None, None
+
+    def _restore(self, key: Key, tier: str, entry: Any) -> None:
+        with self._lock:
+            if tier == HOST:
+                self._host[key] = (entry, self._clock)
+            else:
+                n = 0
+                try:
+                    n = len(entry)  # a ref has no len; occupancy best-effort
+                except Exception:
+                    pass
+                self._object[key] = (entry, n, self._clock)
+
+    def _gauges(self) -> None:
+        occ = self.occupancy()
+        _m.TIER_PAGES.set(occ[HOST], tags={"pool": self.pool, "tier": HOST})
+        _m.TIER_PAGES.set(occ[OBJECT],
+                          tags={"pool": self.pool, "tier": OBJECT})

@@ -258,6 +258,27 @@ def acceptance_rate(pool: Optional[str] = None, window_s: float = 60.0,
     return min(1.0, accepted / proposed)
 
 
+def prefix_hit_rate(pool: Optional[str] = None, window_s: float = 60.0,
+                    now: Optional[float] = None) -> float:
+    """Windowed prefix-cache hit rate: prompt tokens served from cached
+    blocks (or promoted tier pages) over full-block prompt tokens looked
+    up, 0..1 across the pool's prefills.  Returns 0.0 when the cache is
+    off or no lookups landed in the window — cold start reads as "no
+    reuse", never an error."""
+    from ray_tpu.util.metrics_agent import get_aggregator
+
+    agg = get_aggregator()
+    agg.sample_registry()
+    tags = _pool_tags(pool)
+    lookup = agg.window_rate("ray_tpu_llm_prefix_lookup_tokens_total",
+                             tags, window_s, now)
+    if lookup <= 0.0:
+        return 0.0
+    hit = agg.window_rate("ray_tpu_llm_prefix_hit_tokens_total",
+                          tags, window_s, now)
+    return min(1.0, hit / lookup)
+
+
 def recompute_waste_tokens_per_s(pool: Optional[str] = None,
                                  window_s: float = 60.0,
                                  now: Optional[float] = None) -> float:

@@ -397,6 +397,23 @@ class ReplicaActor:
         except Exception:
             pass
 
+    def record_prefix_blocks(self, added: list, removed: list,
+                             block_size: int) -> None:
+        """Forward a prefix-cache commit/evict delta to the controller's
+        prefix directory (the ``prefix_dir::<dep>`` long-poll key), same
+        fire-and-forget contract as the multiplex ids above: routing on
+        stale prefixes costs a cache miss, never correctness."""
+        try:
+            import ray_tpu
+            from ray_tpu.serve.api import _CONTROLLER_NAME
+
+            controller = ray_tpu.get_actor(_CONTROLLER_NAME)
+            controller.record_prefix_blocks.remote(
+                self.replica_id, list(added), list(removed),
+                int(block_size))
+        except Exception:
+            pass
+
     async def reconfigure(self, user_config: Any) -> None:
         self._user_config = user_config
         await self._wrapper.call_reconfigure(user_config)

@@ -49,6 +49,12 @@ class PowerOfTwoChoicesReplicaScheduler:
     def __init__(self) -> None:
         self._replicas: List[Dict[str, Any]] = []  # guarded_by: _lock
         self._inflight: Dict[str, int] = {}  # guarded_by: _lock
+        #: Mirror of the controller's prefix directory for this deployment
+        #: (replica id -> held prefix-chain hashes), refreshed on the
+        #: ``prefix_dir::<dep>`` long-poll key.  Purely advisory: a stale
+        #: entry costs a cache miss on the replica, never correctness.
+        self._prefix_replicas: Dict[str, frozenset] = {}  # guarded_by: _lock
+        self._prefix_block_size = 0  # guarded_by: _lock
         #: Replicas this router observed dead (drop_replica) that the
         #: controller's pushes may still contain while its reconciler
         #: catches up — re-adding a corpse would let retries burn their
@@ -103,7 +109,48 @@ class PowerOfTwoChoicesReplicaScheduler:
                 self._inflight[replica_id] = max(
                     0, self._inflight[replica_id] - n)
 
-    def choose_replica(self, model_id: Optional[str] = None
+    def update_prefix_dir(self, snapshot: Optional[Dict[str, Any]]) -> None:
+        """Swap in a fresh directory snapshot (``prefix_dir::<dep>``)."""
+        snap = snapshot or {}
+        reps = snap.get("replicas") or {}
+        with self._lock:
+            self._prefix_block_size = int(snap.get("block_size") or 0)
+            self._prefix_replicas = {rid: frozenset(held)
+                                     for rid, held in reps.items()}
+
+    def prefix_block_size(self) -> int:
+        """Block size of the mirrored prefix directory; 0 until the first
+        snapshot lands (hint computation is pointless before that)."""
+        with self._lock:
+            return self._prefix_block_size
+
+    def _best_prefix_locked(self, candidates: List[Dict[str, Any]],
+                            prefix_hashes: List[str]
+                            ) -> Optional[Dict[str, Any]]:
+        """Hit-length-weighted pick: the candidate holding the longest
+        chain prefix of ``prefix_hashes``, queue length breaking ties
+        (then first-in-list, so equal snapshots pick deterministically).
+        None when nobody holds even the first block."""
+        best = None
+        best_key = (0, 0)
+        for r in candidates:
+            held = self._prefix_replicas.get(r["replica_id"])
+            if not held:
+                continue
+            n = 0
+            for h in prefix_hashes:
+                if h not in held:
+                    break
+                n += 1
+            if n == 0:
+                continue
+            key = (n, -self._inflight.get(r["replica_id"], 0))
+            if best is None or key > best_key:
+                best, best_key = r, key
+        return best
+
+    def choose_replica(self, model_id: Optional[str] = None,
+                       prefix_hashes: Optional[List[str]] = None
                        ) -> Optional[Dict[str, Any]]:
         """Queue-aware two-choice pick; when the request carries a
         multiplexed model id, replicas that already have that model
@@ -111,21 +158,33 @@ class PowerOfTwoChoicesReplicaScheduler:
         slot, so a saturated warm set degrades to the normal queue-aware
         choice over everyone (a cold replica then loads the model) rather
         than queueing behind the warm ones (ref: the reference scheduler's
-        multiplexed-model candidate ranking)."""
+        multiplexed-model candidate ranking).
+
+        ``prefix_hashes`` (the request prompt's chain hashes) layers
+        longest-cached-prefix affinity on top: among the eligible
+        candidates — the warm set when one applies, else every replica
+        with a spare slot — the longest hit wins, queue-aware on ties.
+        No hit (or a saturated candidate set) degrades to the plain
+        warm/two-choice path above."""
         with self._lock:
             replicas = list(self._replicas)
             if not replicas:
                 return None
+            spare = []
+            for r in replicas:
+                q = self._inflight.get(r["replica_id"], 0)
+                cap = int(r.get("max_ongoing_requests") or 0)
+                if cap <= 0 or q < cap:
+                    spare.append(r)
             if model_id:
-                warm = []
-                for r in replicas:
-                    if model_id not in (r.get("multiplexed_model_ids")
-                                        or ()):
-                        continue
-                    q = self._inflight.get(r["replica_id"], 0)
-                    cap = int(r.get("max_ongoing_requests") or 0)
-                    if cap <= 0 or q < cap:
-                        warm.append(r)
+                warm = [r for r in spare
+                        if model_id in (r.get("multiplexed_model_ids")
+                                        or ())]
+                if prefix_hashes and self._prefix_replicas:
+                    best = self._best_prefix_locked(warm if warm else spare,
+                                                    prefix_hashes)
+                    if best is not None:
+                        return best
                 if len(warm) == 1:
                     return warm[0]
                 if warm:
@@ -133,6 +192,10 @@ class PowerOfTwoChoicesReplicaScheduler:
                     qa = self._inflight.get(a["replica_id"], 0)
                     qb = self._inflight.get(b["replica_id"], 0)
                     return a if qa <= qb else b
+            elif prefix_hashes and self._prefix_replicas:
+                best = self._best_prefix_locked(spare, prefix_hashes)
+                if best is not None:
+                    return best
             if len(replicas) == 1:
                 return replicas[0]
             a, b = random.sample(replicas, 2)
@@ -190,7 +253,8 @@ class Router:
 
         self._long_poll = LongPollClient(
             controller_handle,
-            {f"replicas::{deployment_id}": self._update_replicas},
+            {f"replicas::{deployment_id}": self._update_replicas,
+             f"prefix_dir::{deployment_id}": self._update_prefix_dir},
         )
         self._stopped = threading.Event()
         self._metrics_thread = threading.Thread(
@@ -210,6 +274,40 @@ class Router:
         # graph down inside this callback (fallback within one tick), and
         # any request it re-dispatches must see the NEW replica set.
         self._compiled.on_replica_set(replicas or [])
+
+    def _update_prefix_dir(self, snapshot: Any) -> None:
+        """Directory snapshot push (``prefix_dir::<dep>``): swap the
+        scheduler's mirror and NOTHING else — the compiled route manager
+        must never see a directory update, or every replica block commit
+        would park the router in dynamic fallback."""
+        self._scheduler.update_prefix_dir(snapshot or {})
+
+    def _prefix_hint(self, args: tuple, kwargs: dict
+                     ) -> Optional[List[str]]:
+        """Chain hashes of the request's prompt, for longest-prefix
+        routing — None when the directory is empty, the request carries
+        no prompt, or the prompt is shorter than one block.  Best-effort
+        by design: a hint failure must never fail the request."""
+        bs = self._scheduler.prefix_block_size()
+        if bs <= 0:
+            return None
+        try:
+            for a in args:
+                if isinstance(a, dict) and "prompt" in a:
+                    prompt = a.get("prompt")
+                    if not isinstance(prompt, (list, tuple)) \
+                            or len(prompt) < bs:
+                        return None
+                    from ray_tpu.serve.llm.prefix_dir import chain_hashes
+
+                    model = a.get("model", "base")
+                    adapter = a.get("adapter")
+                    key = f"{model}::{adapter}" if adapter else str(model)
+                    return chain_hashes([int(t) for t in prompt], bs,
+                                        model_key=key)
+        except Exception:
+            return None
+        return None
 
     def _push_metrics_loop(self) -> None:
         """Handle-side queue metric reporting (ref: autoscaling_state.py —
@@ -263,18 +361,21 @@ class Router:
             raise BackPressureError(self.deployment_id, inflight, capacity,
                                     max_queued)
 
-    def _dispatch(self, send, model_id: Optional[str] = None):
+    def _dispatch(self, send, model_id: Optional[str] = None,
+                  prefix_hashes: Optional[List[str]] = None):
         """Shared choose-replica/retry core (ref: Router.assign_request):
         replicas dead at dispatch (rolling update raced the long-poll) are
         dropped locally and the request re-assigned.  ``send(replica)``
         performs the actual (non-blocking) submit and returns its result.
-        ``model_id`` biases the pick toward warm multiplexed replicas."""
+        ``model_id`` biases the pick toward warm multiplexed replicas;
+        ``prefix_hashes`` toward the longest cached prompt prefix."""
         from ray_tpu.exceptions import ActorDiedError
 
         fault_injection.check("serve_route")
         deadline = time.time() + 30.0
         while True:
-            replica = self._scheduler.choose_replica(model_id)
+            replica = self._scheduler.choose_replica(
+                model_id, prefix_hashes=prefix_hashes)
             if replica is None:
                 if not self._replicas_populated.wait(
                         timeout=max(0.0, deadline - time.time())):
@@ -334,7 +435,8 @@ class Router:
             _, rid, ref = self._dispatch(
                 lambda r: r["actor"].handle_request.remote(
                     method_name, *args, **kwargs),
-                model_id=kwargs.get("_serve_multiplexed_model_id"))
+                model_id=kwargs.get("_serve_multiplexed_model_id"),
+                prefix_hashes=self._prefix_hint(args, kwargs))
         # Decrement the local queue estimate when the reply lands — and if
         # the reply is the replica's death, drop it from the local set
         # immediately so retries and later requests can't re-pick the
@@ -380,7 +482,8 @@ class Router:
             replica, rid, sid_ref = self._dispatch(
                 lambda r: r["actor"].start_stream.remote(
                     method_name, *args, **kwargs),
-                model_id=kwargs.get("_serve_multiplexed_model_id"))
+                model_id=kwargs.get("_serve_multiplexed_model_id"),
+                prefix_hashes=self._prefix_hint(args, kwargs))
         tags = self._metric_tags
         exemplar = serve_metrics.trace_exemplar(trace_ctx)
         from ray_tpu.exceptions import ActorDiedError

@@ -24,7 +24,13 @@ Modes:
                   paired rounds (variance bounds recorded as *_min/*_max);
                   appends tokens/s + inter-token p99 + spec acceptance plus
                   the latency-attribution on/off overhead ratio to
-                  BENCH_LLM.json
+                  BENCH_LLM.json.  With --trace prefix-heavy the mode
+                  instead replays a zipfian shared-system-prompt trace
+                  (ISSUE 17) against two 2-replica monolithic arms —
+                  prefix cache + directory routing ON vs OFF — and
+                  records TTFT p99, prefill-tokens-avoided, hit rate and
+                  the compiled-route-residency gate, plus a mixed-trace
+                  regression guard for the cache-off-equivalent workload
 
 The batch mode simulates ONE accelerator per deployment with a lock + sleep:
 forward passes serialize, so unbatched requests pay the full forward each
@@ -782,13 +788,13 @@ def run_chaos_mode(args) -> dict:
     return fields
 
 
-def _llm_trace(n_streams: int, requests_per_stream: int):
+def _llm_trace(n_streams: int, requests_per_stream: int, seed: int = 0):
     """Mixed prompt/generation-length request trace, deterministic across
     runs AND identical between the two topologies: stream i replays the
     same (prompt, max_tokens) cycle against both."""
     import random
 
-    rng = random.Random(0)
+    rng = random.Random(seed)
     prompt_lens = (16, 32, 64, 128, 256, 512)
     gen_lens = (8, 16, 24, 32, 40)
     traces = []
@@ -852,6 +858,277 @@ def _drive_llm_streams(handle, traces):
     assert not any(t.is_alive() for t in threads), "hung LLM stream"
     assert not errors, errors
     return sum(counts), wall, gaps, outputs
+
+
+def _llm_prefix_trace(n_streams: int, requests_per_stream: int,
+                      block_size: int):
+    """Prefix-heavy request trace: every request opens with one of a
+    small set of shared "system prompts" (block-aligned so the whole
+    prefix is cacheable), chosen zipfian — a few prompts dominate, the
+    tail stays cold — followed by a short unique suffix.  Seeded, so
+    every arm and every round replays the identical stream; returns
+    (traces, prefix_tokens_per_round): the latter is the total
+    shared-prefix token count one full playback carries (the
+    denominator of the prefill-FLOPs-avoided gate)."""
+    import random
+
+    rng = random.Random(17)
+    n_prefixes, prefix_blocks = 6, 10
+    prefix_len = prefix_blocks * block_size
+    prefixes = [[rng.randrange(1000) for _ in range(prefix_len)]
+                for _ in range(n_prefixes)]
+    weights = [1.0 / (i + 1) ** 1.2 for i in range(n_prefixes)]
+    traces, prefix_tokens = [], 0
+    for _ in range(n_streams):
+        reqs = []
+        for _ in range(requests_per_stream):
+            (prefix,) = rng.choices(prefixes, weights=weights)
+            tail = [rng.randrange(1000)
+                    for _ in range(rng.randrange(4, 13))]
+            reqs.append({"prompt": prefix + tail, "max_tokens": 4})
+            prefix_tokens += prefix_len
+        traces.append(reqs)
+    return traces, prefix_tokens
+
+
+def _drive_prefix_streams(handle, traces, oracle):
+    """Closed-loop clients over a prefix trace, recording per-request
+    TTFT (submit -> first token) and checking every stream against its
+    ``reference_generate`` oracle; returns (ttfts_s, wall_s, tokens)."""
+    import threading
+
+    n = len(traces)
+    barrier = threading.Barrier(n + 1)
+    ttfts: list = []
+    counts: list = [0] * n
+    errors: list = []
+    lock = threading.Lock()
+
+    def client(idx: int):
+        try:
+            local_ttfts, total = [], 0
+            barrier.wait()
+            for req in traces[idx]:
+                t0 = time.perf_counter()
+                toks, first = [], None
+                for tok in handle.options(stream=True).remote(dict(req)):
+                    if first is None:
+                        first = time.perf_counter() - t0
+                    toks.append(tok)
+                key = (tuple(req["prompt"]), req["max_tokens"])
+                assert toks == oracle[key], \
+                    f"stream {idx} diverged from the oracle"
+                local_ttfts.append(first)
+                total += len(toks)
+            with lock:
+                ttfts.extend(local_ttfts)
+            counts[idx] = total
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.perf_counter() - t0
+    assert not any(t.is_alive() for t in threads), "hung LLM stream"
+    assert not errors, errors
+    return ttfts, wall, sum(counts)
+
+
+def run_llm_prefix_mode(args) -> dict:
+    """Cluster prefix cache + KV tiering anchors (ISSUE 17 acceptance:
+    on the prefix-heavy trace, prefill-tokens-avoided >= 0.5x the shared
+    prefix tokens AND TTFT p99 >= 1.5x better than the directory-disabled
+    twin, byte-identical output every round; the mixed trace must not
+    regress more than ~2%; a directory update must never park the router
+    in the compiled route's dynamic fallback).
+
+    Two 2-replica monolithic arms on identical simulated timing differ
+    ONLY in ``prefix_cache``: the ON arm commits prompt blocks, feeds the
+    head-side directory, and routes each request to the replica holding
+    its longest cached prefix; the OFF arm re-prefills every prompt from
+    scratch.  TTFT is dominated by the O(prompt) prefill burn, so cache
+    hits collapse it to the unique-suffix cost — measured per request,
+    p99 over the round, medians over paired rounds."""
+    import statistics as _stats
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import attribution as _attr
+    from ray_tpu.serve.llm import metrics as _lm
+    from ray_tpu.serve.llm.disagg import build_monolithic_app
+    from ray_tpu.serve.llm.model import ToyLM
+
+    PREFILL_S_PER_TOKEN = 5e-4  # prefill burn dominates TTFT (~80ms/prompt)
+    DECODE_STEP_S = 5e-3
+    BLOCK_SIZE = 16
+    os.environ.setdefault("RAY_TPU_SERVE_COMPILED_STABLE_S", "0.3")
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start(http_options={"port": 0})
+
+    n_streams = args.llm_streams // 2
+    rounds = max(1, getattr(args, "llm_median_rounds", 3))
+    traces, prefix_tokens_per_round = _llm_prefix_trace(
+        n_streams, args.llm_requests_per_stream, BLOCK_SIZE)
+    lm = ToyLM(seed=7)
+    oracle = {}
+    for stream in traces:
+        for req in stream:
+            key = (tuple(req["prompt"]), req["max_tokens"])
+            if key not in oracle:
+                oracle[key] = lm.reference_generate(list(req["prompt"]),
+                                                    req["max_tokens"])
+
+    specs = {"base": {"seed": 7, "dim": 8}}
+    common = dict(model_specs=specs, num_replicas=2, num_blocks=512,
+                  block_size=BLOCK_SIZE,
+                  prefill_time_per_token_s=PREFILL_S_PER_TOKEN,
+                  decode_step_time_s=DECODE_STEP_S)
+    arms = {}
+    for key, cached in (("off", False), ("on", True)):
+        arms[key] = serve.run(
+            build_monolithic_app(prefix_cache=cached,
+                                 tier_host_pages=256 if cached else 0,
+                                 **common),
+            name=f"llm_px{key}", route_prefix=None)
+
+    # Warm both arms off the clock: model load + stream plumbing, and —
+    # on the ON arm — the first playback commits every shared prefix and
+    # pushes the directory to this router.
+    _attr.set_enabled(True)
+    for h in arms.values():
+        _drive_prefix_streams(h, traces, oracle)
+    sch = arms["on"]._get_router()._scheduler
+    deadline = time.time() + 20
+    while time.time() < deadline and (
+            sch.prefix_block_size() != BLOCK_SIZE
+            or not sch._prefix_replicas):
+        time.sleep(0.05)
+    assert sch._prefix_replicas, "prefix directory never reached the router"
+
+    # Compiled-route residency gate: both routers must be ON the compiled
+    # path before measurement, and directory pushes during the rounds
+    # must never tear it down (zero new fallback seconds).
+    for h in arms.values():
+        _wait_compiled(h)
+    from ray_tpu.serve.compiled_router import FALLBACK_SECONDS
+
+    fb_tags = {key: dict(arms[key]._get_router()._compiled._dep_tags)
+               for key in arms}
+    fb_before = {key: FALLBACK_SECONDS.get(tags=fb_tags[key]) or 0.0
+                 for key in arms}
+
+    from ray_tpu.util.metrics_agent import get_aggregator
+
+    get_aggregator().sample_registry()  # baseline for the hit-rate window
+    hit0 = _lm.PREFIX_HIT_TOKENS.get(tags={"pool": "engine"}) or 0.0
+
+    fields = {"llm_prefix_streams": n_streams,
+              "llm_prefix_requests_per_stream": args.llm_requests_per_stream,
+              "llm_prefix_median_rounds": rounds,
+              "llm_prefix_replicas": 2}
+    ttft_p99 = {"on": [], "off": []}
+    prefill_delta = {"on": 0.0, "off": 0.0}
+    ttft_prefill_ms = {"on": [], "off": []}
+    n_requests = sum(len(s) for s in traces)
+    for _ in range(rounds):
+        for key in ("off", "on"):  # paired: both arms share a noise window
+            before = _lm.PREFILL_TOKENS.get(tags={"pool": "engine"}) or 0.0
+            ttfts, _, _ = _drive_prefix_streams(arms[key], traces, oracle)
+            prefill_delta[key] += \
+                (_lm.PREFILL_TOKENS.get(tags={"pool": "engine"}) or 0.0) \
+                - before
+            ttft_p99[key].append(
+                float(np.percentile(np.asarray(ttfts) * 1000, 99)))
+            # The newest attribution records are this drive's requests:
+            # the prefill bucket is where the cache win must show up.
+            recent = _attr.recent_ttft()[-n_requests:]
+            if recent:
+                ttft_prefill_ms[key].append(
+                    1000 * sum(r["buckets"].get("prefill", 0.0)
+                               for r in recent) / len(recent))
+
+    for key in ("off", "on"):
+        fields[f"llm_prefix_ttft_p99_ms_{key}"] = round(
+            _stats.median(ttft_p99[key]), 3)
+        fields[f"llm_prefix_prefill_tokens_{key}"] = int(prefill_delta[key])
+        if ttft_prefill_ms[key]:
+            fields[f"llm_prefix_ttft_prefill_ms_{key}"] = round(
+                _stats.median(ttft_prefill_ms[key]), 3)
+    ratios = [off / on for off, on in zip(ttft_p99["off"], ttft_p99["on"])]
+    fields["llm_prefix_ttft_speedup"] = round(_stats.median(ratios), 2)
+    fields["llm_prefix_ttft_speedup_min"] = round(min(ratios), 2)
+    fields["llm_prefix_ttft_speedup_max"] = round(max(ratios), 2)
+
+    # Prefill FLOPs avoided: the identical trace costs the OFF arm its
+    # full context per request; the ON arm's delta is what the cache and
+    # tiers could not cover.
+    avoided = int(prefill_delta["off"] - prefill_delta["on"])
+    measured_prefix_tokens = prefix_tokens_per_round * rounds
+    fields["llm_prefix_prefill_tokens_avoided"] = avoided
+    fields["llm_prefix_shared_prefix_tokens"] = measured_prefix_tokens
+    fields["llm_prefix_hit_tokens"] = int(
+        (_lm.PREFIX_HIT_TOKENS.get(tags={"pool": "engine"}) or 0.0) - hit0)
+    fields["llm_prefix_hit_rate"] = round(
+        serve.metrics.prefix_hit_rate(pool="engine", window_s=3600.0), 3)
+
+    # Residency gate readings.
+    fb_delta = max(
+        (FALLBACK_SECONDS.get(tags=fb_tags[key]) or 0.0) - fb_before[key]
+        for key in arms)
+    fields["llm_prefix_compiled_fallback_delta_s"] = round(fb_delta, 3)
+    fields["llm_prefix_route_mode_on"] = \
+        arms["on"]._get_router()._compiled.mode
+
+    # ---- mixed-trace regression guard: the SAME cache-on topology must
+    # not tax workloads with no prefix reuse (hashing, commits and
+    # directory pushes ride every prefill either way).  Every round draws
+    # FRESH prompts — replaying one seeded trace would hand the cache arm
+    # a cross-round prefix hit and measure reuse again instead of the
+    # no-reuse overhead — while within a round both arms share the trace
+    # (and its noise window).  Paired rounds, median ratio.
+    tps = {"on": [], "off": []}
+    for h in arms.values():  # warm the mixed shape off the clock
+        _drive_llm_streams(h, _llm_trace(max(4, n_streams), 2, seed=999))
+    for r in range(rounds):
+        mixed = _llm_trace(max(4, n_streams), 2, seed=1000 + r)
+        for key in ("off", "on"):
+            total, wall, _, _ = _drive_llm_streams(arms[key], mixed)
+            tps[key].append(total / wall)
+    mixed_ratio = _stats.median(
+        on / off for on, off in zip(tps["on"], tps["off"]))
+    fields["llm_prefix_mixed_tokens_per_s_on"] = round(
+        _stats.median(tps["on"]), 1)
+    fields["llm_prefix_mixed_tokens_per_s_off"] = round(
+        _stats.median(tps["off"]), 1)
+    fields["llm_prefix_mixed_regression_ratio"] = round(mixed_ratio, 3)
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+    # Acceptance anchors (ISSUE 17): fail loudly rather than record a
+    # regressed artifact.
+    assert avoided >= 0.5 * measured_prefix_tokens, fields
+    assert fields["llm_prefix_ttft_speedup"] >= 1.5, fields
+    assert fields["llm_prefix_compiled_fallback_delta_s"] == 0.0, fields
+    assert fields["llm_prefix_route_mode_on"] == "compiled", fields
+    # Target <= 2% mixed-trace regression; the hard gate sits below the
+    # paired-median noise floor of a shared host (see run_trace_mode).
+    print(f"llm prefix mixed-trace ratio {mixed_ratio:.3f} "
+          f"(target >= 0.98, hard gate >= 0.94)")
+    assert mixed_ratio >= 0.94, fields
+    if ttft_prefill_ms["on"] and ttft_prefill_ms["off"]:
+        # Attribution must place the win where it happened: prefill.
+        assert _stats.median(ttft_prefill_ms["on"]) \
+            < _stats.median(ttft_prefill_ms["off"]), fields
+    return fields
 
 
 def run_llm_mode(args) -> dict:
@@ -1075,6 +1352,11 @@ def main():
     ap.add_argument("--chaos-clients", type=int, default=4)
     ap.add_argument("--llm-streams", type=int, default=16)
     ap.add_argument("--llm-requests-per-stream", type=int, default=6)
+    ap.add_argument("--trace", choices=("mixed", "prefix-heavy"),
+                    default="mixed",
+                    help="llm-mode workload: the mixed prompt/gen-length "
+                         "trace (default) or the zipfian shared-prefix "
+                         "trace for the cluster prefix cache (ISSUE 17)")
     ap.add_argument("--llm-ab-rounds", type=int, default=5,
                     help="off/on wave pairs for the attribution-overhead A/B")
     ap.add_argument("--llm-median-rounds", type=int, default=3,
@@ -1090,6 +1372,8 @@ def main():
              "chaos": run_chaos_mode, "trace": run_trace_mode,
              "compiled": run_compiled_mode, "pipeline": run_pipeline_mode,
              "llm": run_llm_mode}
+    if args.mode == "llm" and args.trace == "prefix-heavy":
+        modes["llm"] = run_llm_prefix_mode
     fields = modes[args.mode](args)
     artifact = _merge_artifact(args.out, fields)
     print(json.dumps(artifact))

@@ -1,0 +1,681 @@
+"""Cluster prefix cache + KV tiering (ISSUE 17): hash-chain directory,
+replica-side committed-prefix cache, device→host→object page tiers with
+promote-on-hit, and prefix-aware routing.
+
+Layering mirrors the subsystem: pure-logic tests on the hash chain and
+the cache's insert/match/evict determinism (including COW-fork
+divergence), tier-manager demote/promote/spill unit tests with the
+``llm_kv_promote`` chaos point, router-scheduler prefix-affinity picks,
+then asyncio engine runs against the ``reference_generate`` oracle —
+every hit, partial hit, promoted page, and failed promotion must leave
+the token stream byte-identical — and finally serve-level tests that the
+head-side directory feeds routing and dies with its replica."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.llm.blocks import BlockAllocator, BlockTable, NoFreeBlocks
+from ray_tpu.serve.llm.engine import LLMEngine
+from ray_tpu.serve.llm.model import ToyLM
+from ray_tpu.serve.llm.prefix_dir import (PrefixDirectory,
+                                          ReplicaPrefixCache, chain_hashes,
+                                          longest_match)
+from ray_tpu.serve.llm.tiering import HOST, OBJECT, KVTierManager
+
+
+def _chaos(spec):
+    """Point the process-wide injector at a local fault spec."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu._private.fault_injection import reset_injector
+
+    GLOBAL_CONFIG.testing_rpc_failure = spec
+    reset_injector()
+
+
+@pytest.fixture
+def chaos_spec():
+    yield _chaos
+    _chaos("")
+
+
+@pytest.fixture
+def serve_px():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start(http_options={"port": 0})
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+class _FakeSlot:
+    def __init__(self, request):
+        self.request = request
+        self.state = {}
+        self._cancelled = False
+
+
+def _run_engine(engine, slots, max_steps=600):
+    """Drive engine.step the way the continuous loop does; returns
+    per-slot emission lists (same shape as tests/test_serve_llm.py)."""
+    from ray_tpu.serve.continuous import EOS, Emissions
+
+    out = {id(s): [] for s in slots}
+
+    async def drive():
+        live = list(slots)
+        for _ in range(max_steps):
+            if not live:
+                return
+            emissions = await engine.step(live)
+            nxt = []
+            for slot, em in zip(live, emissions):
+                if em is EOS:
+                    continue
+                if isinstance(em, Emissions):
+                    out[id(slot)].extend(em.items)
+                    if em.eos:
+                        continue
+                elif isinstance(em, Exception):
+                    out[id(slot)].append(em)
+                    continue
+                elif em is not None:
+                    out[id(slot)].append(em)
+                nxt.append(slot)
+            live = nxt
+        raise AssertionError("engine never retired all slots")
+
+    asyncio.run(drive())
+    return [out[id(s)] for s in slots]
+
+
+# ====================================================== hash chain (no ray)
+
+
+class TestChainHashes:
+    def test_deterministic_over_full_blocks_only(self):
+        toks = list(range(10))
+        a = chain_hashes(toks, 4)
+        b = chain_hashes(toks, 4)
+        assert a == b
+        assert len(a) == 2  # 10 tokens / block 4 -> trailing partial unhashed
+        # The chain is prefix-stable: extending the prompt never rewrites
+        # earlier links (the property routing and caching both lean on).
+        assert chain_hashes(toks + [99] * 4, 4)[:2] == a
+
+    def test_position_and_content_sensitive(self):
+        base = chain_hashes([1, 2, 3, 4, 5, 6, 7, 8], 4)
+        swapped = chain_hashes([2, 1, 3, 4, 5, 6, 7, 8], 4)
+        assert base[0] != swapped[0]
+        # A change in block 1 folds into h1 but leaves h0 alone...
+        late = chain_hashes([1, 2, 3, 4, 5, 6, 7, 99], 4)
+        assert late[0] == base[0] and late[1] != base[1]
+        # ...while a change in block 0 poisons the whole chain.
+        assert swapped[1] != base[1]
+
+    def test_model_key_partitions_the_hash_space(self):
+        toks = [5] * 8
+        assert chain_hashes(toks, 4, model_key="base") \
+            != chain_hashes(toks, 4, model_key="base::poet")
+
+    def test_longest_match_breaks_at_first_gap(self):
+        h = chain_hashes(list(range(16)), 4)
+        assert longest_match(h, set(h)) == 4
+        assert longest_match(h, {h[0], h[1], h[3]}) == 2  # h[2] missing
+        assert longest_match(h, set()) == 0
+
+    def test_bad_block_size_rejected(self):
+        with pytest.raises(ValueError):
+            chain_hashes([1, 2], 0)
+
+
+# ============================================== replica cache (no ray)
+
+
+def _prefilled(alloc, model, tokens):
+    table = BlockTable(alloc)
+    for pos, t in enumerate(tokens):
+        table.append(model.kv_entry(t, pos))
+    return table
+
+
+class TestReplicaPrefixCache:
+    def test_commit_then_acquire_round_trip(self):
+        model = ToyLM(seed=7)
+        alloc = BlockAllocator(16, 4, pool="t-px-rt")
+        cache = ReplicaPrefixCache(alloc, reporter=lambda *a: None)
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+        table = _prefilled(alloc, model, prompt)
+        cache.commit(table, prompt, "base")
+        table.release()
+        assert len(cache) == 2  # only the 2 full blocks committed
+        assert alloc.num_in_use == 2  # cache refs keep them resident
+
+        fresh = BlockTable(alloc)
+        got = cache.acquire_into(fresh, prompt, "base")
+        assert got == 8
+        # The grafted entries are byte-identical to a recompute.
+        for pos in range(got):
+            assert np.array_equal(fresh.get(pos),
+                                  model.kv_entry(prompt[pos], pos))
+        fresh.release()
+        assert alloc.num_in_use == 2  # cache refs survive the release
+
+    def test_commit_is_idempotent(self):
+        model = ToyLM(seed=7)
+        alloc = BlockAllocator(16, 4, pool="t-px-idem")
+        cache = ReplicaPrefixCache(alloc, reporter=lambda *a: None)
+        prompt = list(range(8))
+        table = _prefilled(alloc, model, prompt)
+        cache.commit(table, prompt, "base")
+        before = alloc.num_in_use
+        cache.commit(table, prompt, "base")  # same hashes: no new refs
+        assert alloc.num_in_use == before
+        assert len(cache) == 2
+        table.release()
+
+    def test_lru_evicts_leaf_first_deterministically(self):
+        model = ToyLM(seed=7)
+        alloc = BlockAllocator(32, 4, pool="t-px-lru")
+        cache = ReplicaPrefixCache(alloc, max_blocks=3,
+                                   reporter=lambda *a: None)
+        chain = list(range(12))  # blocks A -> B -> C
+        t = _prefilled(alloc, model, chain)
+        cache.commit(t, chain, "base")
+        t.release()
+        ha, hb, hc = chain_hashes(chain, 4)
+        # Touch the A->B prefix so C is the coldest entry.
+        probe = BlockTable(alloc)
+        assert cache.acquire_into(probe, chain[:8], "base") == 8
+        probe.release()
+        # A fresh 1-block prompt must evict exactly C: B and A are
+        # interior links (children > 0) and never evictable before it.
+        other = [77, 78, 79, 80]
+        t2 = _prefilled(alloc, model, other)
+        cache.commit(t2, other, "base")
+        t2.release()
+        (hd,) = chain_hashes(other, 4)
+        assert set(cache.held_hashes()) == {ha, hb, hd}
+
+    def test_cow_fork_divergence_never_matches_parent(self):
+        model = ToyLM(seed=7)
+        alloc = BlockAllocator(32, 4, pool="t-px-fork")
+        cache = ReplicaPrefixCache(alloc, reporter=lambda *a: None)
+        parent = [1, 2, 3, 4, 5, 6, 7, 8]
+        child = [1, 2, 3, 4, 5, 6, 99, 8]  # diverges inside block 1
+        pt = _prefilled(alloc, model, parent)
+        ct = pt.fork()
+        ct.truncate(6)
+        for pos, tok in enumerate(child[6:], start=6):
+            ct.append(model.kv_entry(tok, pos))  # COW-copies block 1
+        cache.commit(pt, parent, "base")
+        cache.commit(ct, child, "base")
+        ph, ch = chain_hashes(parent, 4), chain_hashes(child, 4)
+        assert ph[0] == ch[0] and ph[1] != ch[1]
+        pt.release()
+        ct.release()
+        # Each lineage matches its OWN diverged block, full length.
+        for ctx, oracle in ((parent, parent), (child, child)):
+            probe = BlockTable(alloc)
+            assert cache.acquire_into(probe, ctx, "base") == 8
+            for pos in range(8):
+                assert np.array_equal(probe.get(pos),
+                                      model.kv_entry(oracle[pos], pos))
+            probe.release()
+
+    def test_evict_for_frees_real_blocks(self):
+        model = ToyLM(seed=7)
+        alloc = BlockAllocator(8, 4, pool="t-px-evf")
+        cache = ReplicaPrefixCache(alloc, max_blocks=8,
+                                   reporter=lambda *a: None)
+        prompt = list(range(12))
+        t = _prefilled(alloc, model, prompt)
+        cache.commit(t, prompt, "base")
+        t.release()
+        free_before = alloc.num_free
+        assert cache.evict_for(2) == 2
+        assert alloc.num_free == free_before + 2
+
+    def test_evict_for_counts_only_returned_blocks(self):
+        """Cache refs on blocks a live sequence still shares free a
+        reference but no memory — evict_for must keep going and report
+        what actually came back to the pool."""
+        model = ToyLM(seed=7)
+        alloc = BlockAllocator(8, 4, pool="t-px-evs")
+        cache = ReplicaPrefixCache(alloc, max_blocks=8,
+                                   reporter=lambda *a: None)
+        prompt = list(range(8))
+        t = _prefilled(alloc, model, prompt)
+        cache.commit(t, prompt, "base")  # table still holds its refs
+        assert cache.evict_for(1) == 0
+        assert len(cache) == 0  # it tried everything it had
+        assert alloc.num_in_use == 2  # the sequence's blocks survive
+        t.release()
+        assert alloc.num_in_use == 0
+
+    def test_reporter_sees_commit_and_evict_deltas(self):
+        model = ToyLM(seed=7)
+        alloc = BlockAllocator(16, 4, pool="t-px-rep")
+        events = []
+        cache = ReplicaPrefixCache(
+            alloc, max_blocks=8,
+            reporter=lambda a, r, bs: events.append((a, r, bs)))
+        prompt = list(range(8))
+        t = _prefilled(alloc, model, prompt)
+        cache.commit(t, prompt, "base")
+        t.release()
+        cache.drop_all()
+        hashes = chain_hashes(prompt, 4)
+        assert events[0] == (hashes, [], 4)
+        assert events[1][0] == [] and sorted(events[1][1]) == sorted(hashes)
+
+
+# ================================================== KV tiering (no ray)
+
+
+class TestKVTiering:
+    def test_demote_promote_round_trip_host(self):
+        tiers = KVTierManager(pool="t-tier-rt", host_pages=8)
+        pages = [[("kv", 1), ("kv", 2)], [("kv", 3)]]
+        assert tiers.demote(("seq", "s1"), pages)
+        assert ("seq", "s1") in tiers
+        assert tiers.occupancy()[HOST] == 2
+        assert tiers.promote_pages(("seq", "s1")) == pages
+        # The claim committed: a second promotion finds nothing.
+        assert tiers.promote_pages(("seq", "s1")) is None
+        assert tiers.occupancy()[HOST] == 0
+
+    def test_host_budget_spills_lru(self):
+        # No object tier and no runtime: the spilled LRU entry drops.
+        tiers = KVTierManager(pool="t-tier-sp", host_pages=2)
+        tiers.demote(("prefix", "a"), [[1]])
+        tiers.demote(("prefix", "b"), [[2]])
+        tiers.demote(("prefix", "c"), [[3]])
+        assert ("prefix", "a") not in tiers
+        assert ("prefix", "b") in tiers and ("prefix", "c") in tiers
+        assert tiers.occupancy()[HOST] == 2
+
+    def test_idle_entries_spill_on_tick(self):
+        tiers = KVTierManager(pool="t-tier-idle", host_pages=8,
+                              host_idle_ticks=2)
+        tiers.demote(("prefix", "cold"), [[1]])
+        tiers.tick()
+        tiers.demote(("prefix", "warm"), [[2]])
+        tiers.tick()  # "cold" now idle past the budget: spills (and,
+        assert ("prefix", "cold") not in tiers  # with no object tier, drops)
+        assert ("prefix", "warm") in tiers
+
+    def test_oversize_or_disabled_demote_rejected(self):
+        off = KVTierManager(pool="t-tier-off")
+        assert not off.enabled
+        assert off.demote(("seq", "x"), [[1]]) is False
+        small = KVTierManager(pool="t-tier-small", host_pages=1)
+        assert small.demote(("seq", "big"), [[1], [2]]) is False
+        assert small.demote(("seq", "none"), []) is False
+
+    def test_promote_fault_restores_entry_for_retry(self, chaos_spec):
+        chaos_spec("llm_kv_promote=1.0:1")
+        from ray_tpu._private.fault_injection import InjectedFailure
+
+        tiers = KVTierManager(pool="t-tier-chaos", host_pages=4)
+        pages = [[("kv", 0, 0)]]
+        tiers.demote(("prefix", "h"), pages)
+        with pytest.raises(InjectedFailure):
+            tiers.promote_pages(("prefix", "h"))
+        # The claim restored the entry: once the fault budget is spent,
+        # the retry gets the identical pages back.
+        assert ("prefix", "h") in tiers
+        assert tiers.promote_pages(("prefix", "h")) == pages
+
+    def test_object_tier_round_trip(self, serve_px):
+        tiers = KVTierManager(pool="t-tier-obj", host_pages=1,
+                              object_pages=8)
+        tiers.demote(("prefix", "a"), [["pa"]])
+        tiers.demote(("prefix", "b"), [["pb"]])  # spills "a" downward
+        assert tiers.occupancy() == {HOST: 1, OBJECT: 1}
+        assert ("prefix", "a") in tiers
+        assert tiers.promote_pages(("prefix", "a")) == [["pa"]]
+        assert tiers.promote_pages(("prefix", "b")) == [["pb"]]
+
+
+# ======================================== controller directory (no ray)
+
+
+class TestPrefixDirectory:
+    def test_update_snapshot_retain(self):
+        d = PrefixDirectory()
+        assert d.update("dep", "r1", ["h1", "h2"], [], 4) is True
+        assert d.update("dep", "r2", ["h2"], [], 4) is True
+        snap = d.snapshot("dep")
+        assert snap["block_size"] == 4
+        assert snap["replicas"] == {"r1": ["h1", "h2"], "r2": ["h2"]}
+        # Removal shrinks; removing everything drops the replica row.
+        assert d.update("dep", "r1", [], ["h1"], 4) is True
+        assert d.update("dep", "r1", [], ["h2"], 4) is True
+        assert "r1" not in d.snapshot("dep")["replicas"]
+        # A dead replica's entries drop in retain (the reconciler path).
+        assert d.retain("dep", {"r1"}) is True  # r2 not live anymore
+        assert d.snapshot("dep")["replicas"] == {}
+        assert d.retain("dep", {"r1"}) is False  # nothing left to drop
+
+    def test_noop_update_reports_unchanged(self):
+        d = PrefixDirectory()
+        d.update("dep", "r1", ["h1"], [], 4)
+        assert d.update("dep", "r1", ["h1"], [], 4) is False
+        assert d.update("dep", "r1", [], ["nope"], 4) is False
+
+    def test_block_size_change_marks_changed(self):
+        d = PrefixDirectory()
+        d.update("dep", "r1", ["h1"], [], 4)
+        assert d.update("dep", "r1", [], [], 8) is True
+        assert d.snapshot("dep")["block_size"] == 8
+
+
+# =========================================== prefix routing (no ray)
+
+
+def _row(rid, cap=4, models=()):
+    return {"replica_id": rid, "actor": None, "max_ongoing_requests": cap,
+            "multiplexed_model_ids": list(models)}
+
+
+class TestPrefixRouting:
+    def _sched(self, rows, snapshot):
+        from ray_tpu.serve.router import PowerOfTwoChoicesReplicaScheduler
+
+        sch = PowerOfTwoChoicesReplicaScheduler()
+        sch.update_replicas(rows)
+        sch.update_prefix_dir(snapshot)
+        return sch
+
+    def test_longest_cached_prefix_wins(self):
+        h = chain_hashes(list(range(12)), 4)
+        sch = self._sched(
+            [_row("r-short"), _row("r-long")],
+            {"block_size": 4, "replicas": {"r-short": [h[0]],
+                                           "r-long": [h[0], h[1]]}})
+        for _ in range(20):
+            assert sch.choose_replica(
+                prefix_hashes=h)["replica_id"] == "r-long"
+        assert sch.prefix_block_size() == 4
+
+    def test_equal_hits_tie_break_on_queue_then_order(self):
+        h = chain_hashes(list(range(8)), 4)
+        snap = {"block_size": 4,
+                "replicas": {"r-a": list(h), "r-b": list(h)}}
+        sch = self._sched([_row("r-a"), _row("r-b")], snap)
+        # Equal queues: first-in-list wins, deterministically.
+        for _ in range(10):
+            assert sch.choose_replica(
+                prefix_hashes=h)["replica_id"] == "r-a"
+        sch.on_request_sent("r-a")
+        for _ in range(10):
+            assert sch.choose_replica(
+                prefix_hashes=h)["replica_id"] == "r-b"
+
+    def test_saturated_holder_degrades_to_spare_set(self):
+        h = chain_hashes(list(range(8)), 4)
+        sch = self._sched(
+            [_row("r-hot", cap=1), _row("r-cold", cap=4)],
+            {"block_size": 4, "replicas": {"r-hot": list(h)}})
+        assert sch.choose_replica(
+            prefix_hashes=h)["replica_id"] == "r-hot"
+        sch.on_request_sent("r-hot")  # at capacity: out of the spare set
+        picks = {sch.choose_replica(prefix_hashes=h)["replica_id"]
+                 for _ in range(20)}
+        assert "r-cold" in picks  # queue-aware fallback reaches it
+        sch.on_request_done("r-hot")
+        assert sch.choose_replica(
+            prefix_hashes=h)["replica_id"] == "r-hot"
+
+    def test_prefix_layers_inside_the_warm_set(self):
+        """Multiplex warmth still partitions first: a prefix held by a
+        COLD replica must not pull a warm-model request onto it (loading
+        weights costs far more than a prefix re-prefill)."""
+        h = chain_hashes(list(range(8)), 4)
+        sch = self._sched(
+            [_row("r-warm1", models=["m1"]), _row("r-warm2", models=["m1"]),
+             _row("r-cold")],
+            {"block_size": 4,
+             "replicas": {"r-cold": list(h), "r-warm2": [h[0]]}})
+        for _ in range(20):
+            assert sch.choose_replica(
+                "m1", prefix_hashes=h)["replica_id"] == "r-warm2"
+
+    def test_no_directory_degrades_to_two_choice(self):
+        sch = self._sched([_row("r-1"), _row("r-2")], {})
+        h = chain_hashes(list(range(8)), 4)
+        assert sch.prefix_block_size() == 0
+        for _ in range(10):
+            pick = sch.choose_replica(prefix_hashes=h)
+            assert pick["replica_id"] in {"r-1", "r-2"}
+
+
+# ============================== engine oracle runs (asyncio, no ray)
+
+
+class TestEnginePrefixOracle:
+    def test_repeat_prompt_hits_cache_and_stays_oracle(self):
+        from ray_tpu.serve.llm import metrics as lm
+
+        model = ToyLM(seed=11)
+        engine = LLMEngine(lambda k: model, num_blocks=64, block_size=4,
+                           pool="t-px-eng1", enable_prefix_cache=True)
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        ref = model.reference_generate(prompt, 10)
+        (first,) = _run_engine(
+            engine, [_FakeSlot({"prompt": prompt, "max_tokens": 10})])
+        hit_before = lm.PREFIX_HIT_TOKENS.get(tags={"pool": "t-px-eng1"})
+        (second,) = _run_engine(
+            engine, [_FakeSlot({"prompt": prompt, "max_tokens": 10})])
+        assert first == ref and second == ref
+        assert lm.PREFIX_HIT_TOKENS.get(tags={"pool": "t-px-eng1"}) \
+            == hit_before + 8  # both full prompt blocks served from cache
+        # Only cache-owned refs remain after both streams retire.
+        assert engine.allocator.num_in_use == len(engine.prefix_cache)
+
+    def test_mixed_hit_miss_partial_streams_oracle(self):
+        model = ToyLM(seed=12)
+        engine = LLMEngine(lambda k: model, num_blocks=128, block_size=4,
+                           pool="t-px-eng2", enable_prefix_cache=True)
+        system = [7, 7, 7, 7, 1, 2, 3, 4]  # shared 2-block preamble
+        prompts = [
+            system + [10, 11],            # partial hit past the preamble
+            system,                       # exact full-block hit
+            [9, 9, 9],                    # pure miss, sub-block prompt
+            system + [10, 11, 12, 13],    # longer partial, shares 2 blocks
+            [5, 6],                       # pure miss again
+        ]
+        for _ in range(2):  # second round replays against a warm cache
+            slots = [_FakeSlot({"prompt": p, "max_tokens": 9})
+                     for p in prompts]
+            outs = _run_engine(engine, slots)
+            for p, toks in zip(prompts, outs):
+                assert toks == model.reference_generate(p, 9)
+
+    def test_spec_decode_with_prefix_cache_oracle(self):
+        from ray_tpu.serve.llm.model import DraftLM
+
+        model = ToyLM(seed=13)
+        draft = DraftLM(model, agreement=0.7)
+        engine = LLMEngine(lambda k: model, num_blocks=64, block_size=4,
+                           pool="t-px-spec", spec_k=3,
+                           get_draft_model=lambda k: draft,
+                           enable_prefix_cache=True)
+        prompt = [2, 7, 1, 8, 2, 8, 1, 8]
+        ref = model.reference_generate(prompt, 12)
+        for _ in range(2):  # round 2 prefills from cache, then drafts
+            (toks,) = _run_engine(
+                engine, [_FakeSlot({"prompt": prompt, "max_tokens": 12})])
+            assert toks == ref
+
+    def test_preempt_demotes_then_promotes_byte_identical(self):
+        from ray_tpu.serve.llm import metrics as lm
+
+        model = ToyLM(seed=9)
+        tags = {"pool": "t-px-tier"}
+        demoted0 = lm.KV_DEMOTED_PAGES.get(tags={**tags, "tier": HOST})
+        promoted0 = lm.KV_PROMOTED_PAGES.get(tags={**tags, "tier": HOST})
+        engine = LLMEngine(lambda k: model, num_blocks=8, block_size=2,
+                           pool="t-px-tier", tier_host_pages=32)
+        prompts = [[i, i + 1, i + 2, i + 3, i + 4, i + 5] for i in range(3)]
+        slots = [_FakeSlot({"prompt": p, "max_tokens": 8}) for p in prompts]
+        outs = _run_engine(engine, slots)
+        for p, toks in zip(prompts, outs):
+            assert toks == model.reference_generate(p, 8)
+        assert sum(s.state["llm"].preemptions for s in slots) >= 1
+        assert lm.KV_DEMOTED_PAGES.get(tags={**tags, "tier": HOST}) \
+            > demoted0
+        assert lm.KV_PROMOTED_PAGES.get(tags={**tags, "tier": HOST}) \
+            > promoted0
+        assert engine.allocator.num_in_use == 0
+
+    def test_promote_fault_falls_back_to_reprefill(self, chaos_spec):
+        """Chaos kills promotions mid-flight: every resume degrades to
+        the recompute path and the streams stay byte-identical."""
+        chaos_spec("llm_kv_promote=1.0:8")
+        model = ToyLM(seed=9)
+        engine = LLMEngine(lambda k: model, num_blocks=8, block_size=2,
+                           pool="t-px-chaos", tier_host_pages=32)
+        prompts = [[i, i + 1, i + 2, i + 3, i + 4, i + 5] for i in range(3)]
+        slots = [_FakeSlot({"prompt": p, "max_tokens": 8}) for p in prompts]
+        outs = _run_engine(engine, slots)
+        for p, toks in zip(prompts, outs):
+            assert toks == model.reference_generate(p, 8)
+        assert engine.allocator.num_in_use == 0
+
+    def test_prefix_hit_rate_accessor(self):
+        from ray_tpu.util.metrics_agent import get_aggregator
+
+        model = ToyLM(seed=14)
+        engine = LLMEngine(lambda k: model, num_blocks=64, block_size=4,
+                           pool="t-px-rate", enable_prefix_cache=True)
+        prompt = [6, 1, 8, 0, 3, 3, 9, 8]
+        for _ in range(2):  # miss round, then the first hit round
+            _run_engine(engine,
+                        [_FakeSlot({"prompt": prompt, "max_tokens": 6})])
+        get_aggregator().sample_registry()  # baseline point for the window
+        _run_engine(engine,
+                    [_FakeSlot({"prompt": prompt, "max_tokens": 6})])
+        # The windowed delta is one pure-hit round: 8 of 8 tokens cached.
+        rate = serve.metrics.prefix_hit_rate(pool="t-px-rate")
+        assert rate == pytest.approx(1.0)
+        assert serve.metrics.prefix_hit_rate(pool="t-px-never") == 0.0
+
+
+# ============================================ serve-level (ray + serve)
+
+
+class TestServePrefixDirectory:
+    def test_monolithic_prefix_cache_feeds_directory(self, serve_px):
+        from ray_tpu.serve.llm.disagg import build_monolithic_app
+
+        specs = {"base": {"seed": 21, "dim": 8}}
+        handle = serve.run(
+            build_monolithic_app(model_specs=specs, num_blocks=64,
+                                 block_size=4, prefix_cache=True),
+            name="pxmono", route_prefix=None)
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        ref = ToyLM(seed=21).reference_generate(prompt, 8)
+        for _ in range(3):
+            toks = list(handle.options(stream=True).remote(
+                {"prompt": prompt, "max_tokens": 8}))
+            assert toks == ref
+        # The committed blocks round-trip replica -> controller ->
+        # this router's prefix_dir:: long-poll key.
+        sch = handle._get_router()._scheduler
+        hashes = chain_hashes(prompt, 4, model_key="base")
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if sch.prefix_block_size() == 4 and any(
+                    hashes[0] in held
+                    for held in sch._prefix_replicas.values()):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail("prefix directory never reached the router")
+        # And the hint path routes on it without breaking correctness.
+        assert list(handle.options(stream=True).remote(
+            {"prompt": prompt, "max_tokens": 8})) == ref
+
+    def test_dead_replica_directory_entries_drop_with_replica_set(
+            self, serve_px):
+        """A router that saw a replica die must not still be routing on
+        its cached prefixes — the reconciler ships the shrunk directory
+        in the same long-poll push as the membership change."""
+
+        @serve.deployment(num_replicas=2, health_check_period_s=0.2)
+        class Holder:
+            def __call__(self):
+                from ray_tpu.serve.context import \
+                    get_internal_replica_context
+
+                ctx = get_internal_replica_context()
+                ctx._replica.record_prefix_blocks(["h-live"], [], 4)
+                return ctx.replica_id
+
+        handle = serve.run(Holder.bind(), name="pxdrop", route_prefix=None)
+        sch = handle._get_router()._scheduler
+        seen = set()
+        deadline = time.time() + 20
+        while time.time() < deadline and len(seen) < 2:
+            seen.add(handle.remote().result(timeout_s=30))
+            time.sleep(0.02)
+        assert len(seen) == 2, "requests never spread over both replicas"
+        deadline = time.time() + 15
+        while time.time() < deadline \
+                and set(sch._prefix_replicas) != seen:
+            time.sleep(0.05)
+        assert set(sch._prefix_replicas) == seen
+
+        victim = next(iter(sch._replicas))
+        victim_rid = victim["replica_id"]
+        from ray_tpu._private.runtime import get_runtime
+
+        get_runtime().kill_actor(victim["actor"]._actor_id,
+                                 no_restart=True)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if victim_rid not in {r["replica_id"] for r in sch._replicas} \
+                    and victim_rid not in sch._prefix_replicas:
+                break
+            time.sleep(0.05)
+        assert victim_rid not in {r["replica_id"] for r in sch._replicas}
+        assert victim_rid not in sch._prefix_replicas, \
+            "directory still advertises a dead replica's prefixes"
+
+
+# ================================== handoff accounting regressions (no ray)
+
+
+class TestHandoffAccounting:
+    def test_payload_bytes_trusts_zero_nbytes_and_odd_entries(self):
+        import numpy as np
+
+        from ray_tpu.serve.llm.handoff import _payload_bytes
+
+        arr = np.zeros(4, dtype=np.int64)
+        empty = np.zeros(0, dtype=np.int64)  # real nbytes == 0: trusted
+        assert _payload_bytes([[arr, empty]]) == arr.nbytes
+
+        class Opaque:  # numpy can't size it: counts 0, never raises
+            def __array__(self):
+                raise TypeError("not arrayable")
+
+        assert _payload_bytes([[Opaque(), arr]]) == arr.nbytes
+        assert _payload_bytes([[3], [(1, 2)]]) > 0  # asarray fallback
+
+    def test_from_pages_rejects_misaligned_interior_page(self):
+        alloc = BlockAllocator(8, 4, pool="t-px-align")
+        free_before = alloc.num_free
+        with pytest.raises(ValueError, match="misaligned"):
+            BlockTable.from_pages(alloc, [["a", "b"], ["c", "d", "e", "f"]])
+        assert alloc.num_free == free_before  # all-or-nothing held
+        # A short TAIL page is the legal partial-block case.
+        t = BlockTable.from_pages(alloc, [["a", "b", "c", "d"], ["e"]])
+        assert t.num_tokens == 5
+        t.release()
