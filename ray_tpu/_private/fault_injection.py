@@ -55,6 +55,10 @@ FAULT_POINTS: Dict[str, str] = {
     "serve_replica_handle": "replica request entry (unary handle_request)",
     "serve_health_probe": "replica check_health (drives UNHEALTHY recovery)",
     "serve_long_poll": "controller listen_for_change (client must retry)",
+    "serve_autoscale": "autoscaler apply site (controller _autoscale_tick, "
+                       "before set_target_num) — an injected scale-decision "
+                       "failure leaves the target unchanged; no replica is "
+                       "started or drained",
     # checkpoint subsystem (tests/test_checkpoint_chaos.py)
     "ckpt_shard_write": "shard persist in the writer thread — kills a save "
                         "mid-flight; the pending step aborts",

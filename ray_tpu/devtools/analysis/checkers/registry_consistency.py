@@ -277,6 +277,7 @@ METRIC_MODULES = (
     "ray_tpu.serve.continuous",
     "ray_tpu.serve.multiplex",
     "ray_tpu.serve.llm.metrics",
+    "ray_tpu.serve.autoscaling",
     "ray_tpu.serve.deployment_state",
     "ray_tpu.checkpoint.metrics",
     "ray_tpu.train.metrics",

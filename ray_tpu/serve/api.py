@@ -46,7 +46,13 @@ class Deployment:
         import copy
 
         cfg = copy.deepcopy(self.config)
-        if num_replicas is not None and num_replicas != "auto":
+        if num_replicas == "auto":
+            # "auto" means autoscaled: wire a default AutoscalingConfig when
+            # the caller did not pass one, instead of silently keeping the
+            # static num_replicas.
+            if autoscaling_config is None and cfg.autoscaling_config is None:
+                cfg.autoscaling_config = AutoscalingConfig.default()
+        elif num_replicas is not None:
             cfg.num_replicas = num_replicas
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
@@ -114,6 +120,8 @@ def deployment(_func_or_class: Optional[Any] = None, *,
             asc = AutoscalingConfig(**autoscaling_config)
         else:
             asc = autoscaling_config
+        if num_replicas == "auto" and asc is None:
+            asc = AutoscalingConfig.default()
         cfg = DeploymentConfig(
             num_replicas=(num_replicas if isinstance(num_replicas, int) else 1),
             max_ongoing_requests=max_ongoing_requests,

@@ -13,6 +13,8 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu._private import fault_injection
+from ray_tpu.serve import autoscaling as _autoscaling
 from ray_tpu.serve.config import DeploymentConfig
 from ray_tpu.serve.deployment_state import DeploymentInfo, DeploymentStateManager
 from ray_tpu.serve.long_poll import LongPollHost
@@ -31,12 +33,20 @@ class ServeController:
         #: replicas:: key — a block commit must not look like a membership
         #: change or it would tear down compiled route graphs).
         self._prefix_dir = PrefixDirectory()
+        # Scale-down victim selection prefers the prefix-coldest replica
+        # (least directory weight) so cached prefixes survive the shrink.
+        self._manager.prefix_weigher = self._prefix_dir.replica_weight
         self._apps: Dict[str, Dict[str, Any]] = {}  # app -> {route_prefix, deployments, ingress}
         self._replica_sets: Dict[str, List[Dict[str, Any]]] = {}
-        self._autoscale_state: Dict[str, Dict[str, float]] = {}
+        #: dep_id -> DeploymentAutoscaler (policy + hysteresis state).
+        self._autoscalers: Dict[str, _autoscaling.DeploymentAutoscaler] = {}
         #: dep_id -> router_id -> (total_inflight, ts); handle-reported
         #: (ref: autoscaling_state.py — queue metrics come from handles)
         self._handle_metrics: Dict[str, Dict[str, tuple]] = {}
+        #: dep_id -> router_id -> (queued_with_no_replica, ts) — requests
+        #: parked in router dispatch loops because the replica set is empty;
+        #: the zero->one wake signal for scale-to-zero deployments.
+        self._queued_metrics: Dict[str, Dict[str, tuple]] = {}
         #: dep_id -> pid -> (RED snapshot, ts).  Snapshots are CUMULATIVE
         #: per process (routers in one process share the process-global
         #: histograms), so rollups keep the latest per pid and sum across
@@ -252,10 +262,16 @@ class ServeController:
         """A replica's prefix cache committed/evicted blocks.  Fold the
         delta into the head-side directory and push the fresh snapshot on
         its own long-poll key — routers mirror it for longest-prefix
-        routing; compiled route graphs never notice."""
-        dep_id = self._manager.find_replica_deployment(replica_id)
+        routing; compiled route graphs never notice.
+
+        RUNNING replicas only: directory entries drop the tick a replica
+        enters DRAINING (it left running_replicas(), so retain() pruned
+        it), and a late commit report from the draining replica must not
+        resurrect them as stale routing hints."""
+        dep_id = self._manager.find_replica_deployment(replica_id,
+                                                       running_only=True)
         if dep_id is None:
-            return  # departed replica — reconcile already dropped it
+            return  # departed/draining replica — not a routing target
         if self._prefix_dir.update(dep_id, replica_id, added, removed,
                                    block_size):
             self._long_poll.notify_changed({
@@ -265,15 +281,21 @@ class ServeController:
                               total_inflight: int,
                               snapshot: Optional[Dict[str, Any]] = None,
                               pid: Optional[int] = None,
-                              compiled: Optional[bool] = None) -> None:
+                              compiled: Optional[bool] = None,
+                              queued: Optional[int] = None) -> None:
         """Handle-side queue report (ref: autoscaling_state.py
         record_request_metrics_for_handle).  Routers additionally attach a
         cumulative per-process RED snapshot for the status/dashboard
-        rollups, and whether their route is currently compiled; old-style
-        reports without either still feed autoscaling."""
+        rollups, whether their route is currently compiled, and how many
+        requests are parked waiting for a non-empty replica set (the
+        wake-from-zero signal); old-style reports without these still feed
+        autoscaling."""
         now = time.time()
         self._handle_metrics.setdefault(deployment_id, {})[router_id] = (
             int(total_inflight), now)
+        if queued is not None:
+            self._queued_metrics.setdefault(deployment_id, {})[router_id] = (
+                int(queued), now)
         if snapshot is not None and pid is not None:
             self._metric_snaps.setdefault(deployment_id, {})[int(pid)] = (
                 snapshot, now)
@@ -289,47 +311,90 @@ class ServeController:
         return serve_metrics.rollup(snaps)
 
     async def _autoscale_tick(self) -> None:
-        """Queue-based autoscaling off handle-reported metrics (ref:
-        autoscaling_state.py — average ongoing requests per RUNNING replica
-        vs target_ongoing_requests, with up/downscale delays)."""
+        """SLO-driven autoscaling: feed each deployment's policy layer
+        (serve/autoscaling.py — queue depth, target-qps, and burn-rate
+        policies composed by max, with hysteresis/cooldowns/crash-loop
+        interlock) one sensing snapshot and apply the decision.
+
+        The ``serve_autoscale`` fault point is consulted BEFORE
+        set_target_num: an injected scale-decision failure leaves the
+        target — and therefore the replica FSM — untouched."""
+        from ray_tpu.serve import metrics as serve_metrics
+        from ray_tpu.serve import slo as serve_slo
+
         now = time.time()
-        for dep_id, state in self._manager.deployments.items():
+        slo_payload = None
+        watchdog = serve_slo.get_watchdog()
+        for dep_id, state in list(self._manager.deployments.items()):
             cfg = state.info.config.autoscaling_config
-            if cfg is None:
+            if cfg is None or state.deleting:
+                self._autoscalers.pop(dep_id, None)
                 continue
-            st = self._autoscale_state.setdefault(
-                dep_id, {"last_check": 0.0, "above_since": -1.0,
-                         "below_since": -1.0})
-            if now - st["last_check"] < cfg.metrics_interval_s:
+            scaler = self._autoscalers.get(dep_id)
+            if scaler is None or scaler.config is not cfg:
+                scaler = self._autoscalers[dep_id] = \
+                    _autoscaling.DeploymentAutoscaler(dep_id, cfg)
+            if now - scaler.last_check < cfg.metrics_interval_s:
                 continue
-            st["last_check"] = now
-            num_running = state.num_running()
-            if num_running == 0:
+            scaler.last_check = now
+            fresh = [n for n, ts in
+                     self._handle_metrics.get(dep_id, {}).values()
+                     if now - ts < 2.0]
+            queued = sum(q for q, ts in
+                         self._queued_metrics.get(dep_id, {}).values()
+                         if now - ts < 2.0)
+            burn_alerting, burn_quiet = False, True
+            if cfg.use_slo_burn and watchdog.has_objectives():
+                if slo_payload is None:  # one evaluate() per tick, shared
+                    slo_payload = watchdog.evaluate(now=now)
+                burn_alerting, burn_quiet = self._burn_state(
+                    slo_payload, dep_id)
+            rate = 0.0
+            if cfg.target_qps_per_replica:
+                rate = serve_metrics.request_rate(
+                    dep_id, window_s=cfg.qps_window_s, now=now)
+            inputs = _autoscaling.PolicyInputs(
+                now=now,
+                num_running=state.num_running(),
+                target_num=state.target_num,
+                total_inflight=sum(fresh),
+                queued_requests=queued,
+                request_rate=rate,
+                batch_occupancy=serve_metrics.batch_occupancy(
+                    window_s=cfg.qps_window_s, now=now)
+                if cfg.target_qps_per_replica else 0.0,
+                burn_alerting=burn_alerting,
+                burn_quiet=burn_quiet,
+                in_backoff=now < state.backoff_until)
+            decision = scaler.decide(inputs)
+            if not decision.changed or decision.target == state.target_num:
                 continue
-            reports = self._handle_metrics.get(dep_id, {})
-            fresh = [n for n, ts in reports.values() if now - ts < 2.0]
-            if not fresh:
+            try:
+                fault_injection.check("serve_autoscale")
+            except Exception:
+                _autoscaling.record_rejected(dep_id)
                 continue
-            avg = sum(fresh) / num_running
-            target = state.target_num
-            if avg > cfg.target_ongoing_requests and target < cfg.max_replicas:
-                if st["above_since"] < 0:
-                    st["above_since"] = now
-                if now - st["above_since"] >= cfg.upscale_delay_s:
-                    desired = max(target + 1, int(
-                        num_running * avg / cfg.target_ongoing_requests))
-                    state.set_target_num(min(desired, cfg.max_replicas))
-                    st["above_since"] = -1.0
-            else:
-                st["above_since"] = -1.0
-            if avg < cfg.target_ongoing_requests / 2 and target > cfg.min_replicas:
-                if st["below_since"] < 0:
-                    st["below_since"] = now
-                if now - st["below_since"] >= cfg.downscale_delay_s:
-                    state.set_target_num(max(target - 1, cfg.min_replicas))
-                    st["below_since"] = -1.0
-            else:
-                st["below_since"] = -1.0
+            old = state.target_num
+            state.set_target_num(decision.target)
+            _autoscaling.record_applied(dep_id, old, decision.target,
+                                        decision.reason)
+
+    @staticmethod
+    def _burn_state(slo_payload: Dict[str, Any],
+                    dep_id: str) -> Tuple[bool, bool]:
+        """(alerting, all-windows-quiet) for one deployment from a shared
+        watchdog evaluation (objectives may key the full "app#name" id or
+        the bare deployment name)."""
+        for key in (dep_id, dep_id.partition("#")[2]):
+            dep_slo = slo_payload.get(key)
+            if not dep_slo:
+                continue
+            quiet = all(
+                o.get("burn_fast", 0.0) < o.get("burn_threshold", 1.0)
+                and o.get("burn_slow", 0.0) < o.get("burn_threshold", 1.0)
+                for o in dep_slo.get("objectives", {}).values())
+            return bool(dep_slo.get("alerting")), quiet
+        return False, True
 
     # --------------------------------------------------------------- queries
     async def listen_for_change(self, keys_to_snapshot_ids: Dict[str, int],
@@ -385,7 +450,40 @@ class ServeController:
                 # "where did the latency go" without scraping /metrics.
                 **self._latency_rollup(dep_id),
             }
+            cfg = state.info.config.autoscaling_config
+            if cfg is not None:
+                scaler = self._autoscalers.get(dep_id)
+                out[dep_id]["autoscale"] = {
+                    "min_replicas": cfg.min_replicas,
+                    "max_replicas": cfg.max_replicas,
+                    "warm_pool_size": cfg.warm_pool_size,
+                    "warm_replicas": state.num_warm(),
+                    "cold_starts": state.num_cold_starts,
+                    "warm_promotions": state.num_warm_promotions,
+                    "queued_requests": sum(
+                        q for q, ts in
+                        self._queued_metrics.get(dep_id, {}).values()
+                        if now - ts < 2.0),
+                    "last_decision_reason": (scaler.last_reason
+                                             if scaler else None),
+                    "last_change_at": (scaler.last_change_at
+                                       if scaler else None),
+                }
         return out
+
+    async def set_target_num(self, deployment_id: str, n: int) -> bool:
+        """Operator/test override of one deployment's replica target (the
+        same actuator the autoscaler uses; the policy layer may move it
+        again on its next evaluation)."""
+        await self._ensure_loop()
+        state = self._manager.deployments.get(deployment_id)
+        if state is None:
+            return False
+        old = state.target_num
+        state.set_target_num(n)
+        if n != old:
+            _autoscaling.record_applied(deployment_id, old, n, "manual")
+        return True
 
     async def list_deployments(self) -> List[Dict[str, Any]]:
         """Deployment rows joining controller state with live RED rollups

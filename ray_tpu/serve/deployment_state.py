@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.exceptions import ActorDiedError
+from ray_tpu.serve import autoscaling as _autoscaling
 from ray_tpu.serve.config import DeploymentConfig
 from ray_tpu.serve.replica import ReplicaActor
 from ray_tpu.util import metrics as _metrics
@@ -88,16 +89,23 @@ class ReplicaState:
     DRAINING = "DRAINING"
     #: Back-compat alias (pre-health-check FSM called draining "stopping").
     STOPPING = DRAINING
+    #: Pre-started (initialized, health-checked, weights pre-loaded) but
+    #: held OUTSIDE the serving set; scale-up promotes WARM -> RUNNING
+    #: instead of paying a cold start.
+    WARM = "WARM"
 
 
 class ReplicaWrapper:
     """One replica actor + its FSM state (ref: deployment_state.py
     DeploymentReplica)."""
 
-    def __init__(self, info: DeploymentInfo):
+    def __init__(self, info: DeploymentInfo, warm: bool = False):
         self.replica_id = f"{info.name}#{uuid.uuid4().hex[:6]}"
         self.version = info.version()
         self.state = ReplicaState.STARTING
+        #: Warm-pool member: starts/health-checks like any replica but is
+        #: excluded from live/routing until promoted by a scale-up.
+        self.warm = warm
         self.started_at = time.time()
         self.stopping_since: Optional[float] = None
         #: Why this replica left RUNNING ("unhealthy", "dead") — feeds the
@@ -251,10 +259,19 @@ class DeploymentState:
 
     def __init__(self, info: DeploymentInfo):
         self.info = info
-        self.target_num = (info.config.autoscaling_config.initial_replicas
-                           or info.config.autoscaling_config.min_replicas
-                           if info.config.autoscaling_config
-                           else info.config.num_replicas)
+        autoscaling = info.config.autoscaling_config
+        if autoscaling is not None:
+            # initial_replicas wins when set (0 is a valid choice: start
+            # asleep, wake on first queued request).  Otherwise seed at
+            # max(min_replicas, 1) so min_replicas=0 does NOT mean "deploy
+            # zero replicas and wait" — the deployment starts serving and
+            # idles down to zero later.
+            if autoscaling.initial_replicas is not None:
+                self.target_num = autoscaling.initial_replicas
+            else:
+                self.target_num = max(autoscaling.min_replicas, 1)
+        else:
+            self.target_num = info.config.num_replicas
         self.replicas: List[ReplicaWrapper] = []
         self.deleting = False
         self._changed = True
@@ -262,6 +279,11 @@ class DeploymentState:
         self.consecutive_start_failures = 0
         self.backoff_until = 0.0
         self.num_restarts = 0  # mirror of the counter, for status()
+        self.num_cold_starts = 0
+        self.num_warm_promotions = 0
+        #: Optional replica_id -> prefix-directory weight, wired by the
+        #: controller; scale-down drains the prefix-coldest replica first.
+        self.prefix_weight = None
 
     # ------------------------------------------------------------- targets
     def set_target(self, info: DeploymentInfo) -> None:
@@ -319,9 +341,10 @@ class DeploymentState:
         target_version = self.info.version()
 
         # STARTING → RUNNING / failed (failed starts feed the crash-loop
-        # backoff so a bad __init__ can't hot-loop replacements).
+        # backoff so a bad __init__ can't hot-loop replacements).  Warm-pool
+        # members ready up separately (STARTING → WARM, below).
         for r in list(self.replicas):
-            if r.state == ReplicaState.STARTING:
+            if r.state == ReplicaState.STARTING and not r.warm:
                 ready = r.check_ready()
                 if ready is True:
                     r.state = ReplicaState.RUNNING
@@ -367,8 +390,10 @@ class DeploymentState:
             if r.state == ReplicaState.DRAINING and r.check_stopped():
                 self.replicas.remove(r)
 
-        live = [r for r in self.replicas
-                if r.state in (ReplicaState.STARTING, ReplicaState.RUNNING)]
+        self._reconcile_warm_pool(now, config, target_version)
+
+        live = [r for r in self.replicas if not r.warm
+                and r.state in (ReplicaState.STARTING, ReplicaState.RUNNING)]
 
         # Rolling update: drain outdated replicas once a same-or-newer
         # replacement is RUNNING and has passed its FIRST health check, and
@@ -406,18 +431,88 @@ class DeploymentState:
 
         # Scale up/down to target (auto-recovery lands here: a removed
         # dead/unhealthy replica leaves live < target), gated by the
-        # crash-loop backoff.
+        # crash-loop backoff.  Scale-up drains the warm pool first — a
+        # promotion is a state flip, not an actor start, so a wake from
+        # zero costs one reconcile tick instead of a checkpoint load.
         if len(live) < self.target_num:
-            if self._can_start(now):
-                for _ in range(self.target_num - len(live)):
+            deficit = self.target_num - len(live)
+            for r in self.replicas:
+                if deficit <= 0:
+                    break
+                if r.warm and r.state in (ReplicaState.WARM,
+                                          ReplicaState.STARTING):
+                    r.warm = False
+                    if r.state == ReplicaState.WARM:
+                        r.state = ReplicaState.RUNNING
+                        changed = True
+                    self.num_warm_promotions += 1
+                    _autoscaling.WARM_PROMOTIONS.inc(
+                        tags={"deployment": self.info.id})
+                    deficit -= 1
+            if deficit > 0 and self._can_start(now):
+                for _ in range(deficit):
                     self._start_replica()
+                    if self.info.config.autoscaling_config is not None:
+                        self.num_cold_starts += 1
+                        _autoscaling.COLD_STARTS.inc(
+                            tags={"deployment": self.info.id})
         elif len(live) > self.target_num:
-            # Prefer draining replicas that are still starting.
-            victims = sorted(live, key=lambda r: r.state == ReplicaState.RUNNING)
+            # Prefer draining replicas that are still starting (they cost
+            # no capacity); among RUNNING ones, drain the replica holding
+            # the least prefix-directory weight so the cluster's cached
+            # prefixes survive the shrink (docs/serving.md).
+            weigh = self.prefix_weight or (lambda _rid: 0)
+            victims = sorted(
+                live, key=lambda r: (r.state == ReplicaState.RUNNING,
+                                     weigh(r.replica_id)))
             for r in victims[: len(live) - self.target_num]:
                 r.begin_drain()
                 changed = True
         return changed
+
+    def _reconcile_warm_pool(self, now: float, config: DeploymentConfig,
+                             target_version: str) -> None:
+        """Keep ``warm_pool_size`` replicas pre-started outside the serving
+        set: ready them up (STARTING → WARM, then fire the multiplex
+        prewarm), health-probe them so corpses leave the pool, drain
+        outdated or excess members, and start replacements."""
+        autoscaling = config.autoscaling_config
+        warm_target = autoscaling.warm_pool_size if autoscaling else 0
+        if self.deleting:
+            warm_target = 0
+        for r in list(self.replicas):
+            if not r.warm:
+                continue
+            if r.state == ReplicaState.STARTING:
+                ready = r.check_ready()
+                if ready is True:
+                    r.state = ReplicaState.WARM
+                    if autoscaling and autoscaling.prewarm_model_ids:
+                        try:
+                            r.actor.prewarm.remote(
+                                list(autoscaling.prewarm_model_ids))
+                        except Exception:
+                            pass
+                elif ready is False:
+                    self.replicas.remove(r)
+                    r.hard_kill()
+                    self._record_failure(now)
+            elif r.state == ReplicaState.WARM:
+                if r.version != target_version:
+                    r.warm = False
+                    r.begin_drain()
+                elif r.probe_health(now, config) is not None:
+                    # A warm corpse never served traffic: replace quietly.
+                    r.hard_kill()
+                    self.replicas.remove(r)
+        warm = [r for r in self.replicas if r.warm]
+        if len(warm) > warm_target:
+            for r in warm[warm_target:]:
+                r.warm = False
+                r.begin_drain()
+        elif len(warm) < warm_target and self._can_start(now):
+            for _ in range(warm_target - len(warm)):
+                self.replicas.append(ReplicaWrapper(self.info, warm=True))
 
     # -------------------------------------------------------------- queries
     def running_replicas(self) -> List[Dict[str, Any]]:
@@ -435,6 +530,9 @@ class DeploymentState:
     def num_running(self) -> int:
         return sum(1 for r in self.replicas if r.state == ReplicaState.RUNNING)
 
+    def num_warm(self) -> int:
+        return sum(1 for r in self.replicas if r.warm)
+
     def num_unhealthy(self) -> int:
         return sum(1 for r in self.replicas if r.unhealthy_reason is not None)
 
@@ -448,6 +546,7 @@ class DeploymentState:
             "app": self.info.app_name,
             "deployment_id": self.info.id,
             "state": r.state,
+            "warm": r.warm,
             "version": r.version,
             "uptime_s": round(now - r.started_at, 3),
             "unhealthy_reason": r.unhealthy_reason,
@@ -460,14 +559,20 @@ class DeploymentStateManager:
 
     def __init__(self) -> None:
         self.deployments: Dict[str, DeploymentState] = {}
+        #: Optional (deployment_id, replica_id) -> prefix-directory weight,
+        #: set by the controller; feeds scale-down victim selection.
+        self.prefix_weigher = None
 
     def deploy(self, info: DeploymentInfo) -> None:
         state = self.deployments.get(info.id)
         if state is None:
-            self.deployments[info.id] = DeploymentState(info)
+            state = self.deployments[info.id] = DeploymentState(info)
         else:
             state.deleting = False
             state.set_target(info)
+        if self.prefix_weigher is not None:
+            weigher, dep_id = self.prefix_weigher, info.id
+            state.prefix_weight = lambda rid: weigher(dep_id, rid)
 
     def delete(self, deployment_id: str) -> None:
         if deployment_id in self.deployments:
@@ -488,12 +593,20 @@ class DeploymentStateManager:
                     return True
         return False
 
-    def find_replica_deployment(self, replica_id: str) -> Optional[str]:
+    def find_replica_deployment(self, replica_id: str, *,
+                                running_only: bool = False) -> Optional[str]:
         """Deployment id owning ``replica_id`` (replica ids are unique
-        across deployments), or None for unknown/departed replicas."""
+        across deployments), or None for unknown/departed replicas.
+
+        ``running_only=True`` additionally returns None for replicas that
+        have left routing (DRAINING/UNHEALTHY/WARM) — callers maintaining
+        routing hints use this so a draining replica's late reports cannot
+        resurrect directory entries dropped at DRAINING."""
         for dep_id, state in self.deployments.items():
             for r in state.replicas:
                 if r.replica_id == replica_id:
+                    if running_only and r.state != ReplicaState.RUNNING:
+                        return None
                     return dep_id
         return None
 
@@ -512,9 +625,13 @@ class DeploymentStateManager:
         # deployment's series doesn't report its stale last value forever.
         HEALTHY_GAUGE.clear()
         UNHEALTHY_GAUGE.clear()
+        _autoscaling.WARM_POOL_SIZE.clear()
         for dep_id, state in self.deployments.items():
             HEALTHY_GAUGE.set(state.num_running(),
                               tags={"deployment": dep_id})
             UNHEALTHY_GAUGE.set(state.num_unhealthy(),
                                 tags={"deployment": dep_id})
+            if state.info.config.autoscaling_config is not None:
+                _autoscaling.WARM_POOL_SIZE.set(
+                    state.num_warm(), tags={"deployment": dep_id})
         return updates

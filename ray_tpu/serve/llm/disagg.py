@@ -128,7 +128,8 @@ class LLMServer(_ModelHostMixin):
                  draft_step_time_s: float = 0.0,
                  prefix_cache: bool = True,
                  prefix_cache_blocks: Optional[int] = None,
-                 tier_host_pages: int = 0, tier_object_pages: int = 0):
+                 tier_host_pages: int = 0, tier_object_pages: int = 0,
+                 tier_shared: bool = False):
         self._init_models(ckpt_root, model_specs,
                           prefill_time_per_token_s, decode_step_time_s,
                           draft_agreement=draft_agreement,
@@ -141,7 +142,8 @@ class LLMServer(_ModelHostMixin):
             enable_prefix_cache=prefix_cache,
             prefix_cache_blocks=prefix_cache_blocks,
             tier_host_pages=tier_host_pages,
-            tier_object_pages=tier_object_pages)
+            tier_object_pages=tier_object_pages,
+            tier_shared=tier_shared)
 
     @serve.continuous_batch(max_batch_size=16)
     async def __call__(self, slots: List[Any]) -> List[Any]:
@@ -149,6 +151,12 @@ class LLMServer(_ModelHostMixin):
             if not isinstance(s.request, dict):
                 s.request = parse_llm_request(s.request)
         return await self._engine.step(slots)
+
+    def on_drain(self) -> None:
+        """Scale-down drain hook (see ReplicaActor.prepare_for_shutdown):
+        demote the cached KV pages into the host/object tiers so the
+        cluster's prefix-hit win survives this replica's exit."""
+        self._engine.drain()
 
 
 @serve.deployment(max_ongoing_requests=8)
@@ -421,9 +429,21 @@ def build_monolithic_app(*, ckpt_root: Optional[str] = None,
                          draft_step_time_s: float = 0.0,
                          prefix_cache: bool = True,
                          tier_host_pages: int = 0,
-                         tier_object_pages: int = 0) -> Any:
-    """The continuous-batching baseline on identical model timing."""
-    return LLMServer.options(num_replicas=num_replicas).bind(
+                         tier_object_pages: int = 0,
+                         tier_shared: bool = False,
+                         autoscaling_config: Optional[Any] = None,
+                         compiled_route: Optional[bool] = None) -> Any:
+    """The continuous-batching baseline on identical model timing.
+
+    ``autoscaling_config`` hands replica-count control to the SLO-driven
+    autoscaler (serve/autoscaling.py); pair it with ``tier_shared=True``
+    so the prefix-hit win survives scale-down via shared tiers."""
+    options: Dict[str, Any] = {"num_replicas": num_replicas}
+    if autoscaling_config is not None:
+        options["autoscaling_config"] = autoscaling_config
+    if compiled_route is not None:
+        options["compiled_route"] = compiled_route
+    return LLMServer.options(**options).bind(
         ckpt_root=ckpt_root, model_specs=model_specs,
         num_blocks=num_blocks, block_size=block_size,
         prefill_time_per_token_s=prefill_time_per_token_s,
@@ -432,4 +452,5 @@ def build_monolithic_app(*, ckpt_root: Optional[str] = None,
         draft_step_time_s=draft_step_time_s,
         prefix_cache=prefix_cache,
         tier_host_pages=tier_host_pages,
-        tier_object_pages=tier_object_pages)
+        tier_object_pages=tier_object_pages,
+        tier_shared=tier_shared)

@@ -74,7 +74,8 @@ class LLMEngine:
                  prefix_cache_blocks: Optional[int] = None,
                  tier_host_pages: int = 0,
                  tier_object_pages: int = 0,
-                 tier_host_idle_ticks: Optional[int] = None):
+                 tier_host_idle_ticks: Optional[int] = None,
+                 tier_shared: bool = False):
         self._get_model = get_model
         #: Speculative decoding: propose up to ``spec_k`` draft tokens per
         #: stream per step and verify them in one batched target pass.
@@ -86,12 +87,23 @@ class LLMEngine:
         #: zero — demotion then degrades to plain recompute-on-resume.
         self.tiers = None
         if tier_host_pages > 0 or tier_object_pages > 0:
-            from ray_tpu.serve.llm.tiering import KVTierManager
+            if tier_shared:
+                # One tier index per pool name, shared across the replicas
+                # in this process: pages a draining replica demotes stay
+                # promotable by survivors (content-addressed prefix keys).
+                from ray_tpu.serve.llm.tiering import shared_tiers
 
-            self.tiers = KVTierManager(pool=pool,
-                                       host_pages=tier_host_pages,
-                                       object_pages=tier_object_pages,
-                                       host_idle_ticks=tier_host_idle_ticks)
+                self.tiers = shared_tiers(
+                    pool, host_pages=tier_host_pages,
+                    object_pages=tier_object_pages,
+                    host_idle_ticks=tier_host_idle_ticks)
+            else:
+                from ray_tpu.serve.llm.tiering import KVTierManager
+
+                self.tiers = KVTierManager(
+                    pool=pool, host_pages=tier_host_pages,
+                    object_pages=tier_object_pages,
+                    host_idle_ticks=tier_host_idle_ticks)
         #: Replica prefix cache over committed prompt blocks; opt-in so
         #: block-accounting unit tests keep their exact pool arithmetic.
         self.prefix_cache = None
@@ -391,6 +403,16 @@ class LLMEngine:
         if self.prefix_cache is None:
             return 0
         return self.prefix_cache.evict_for(num_blocks)
+
+    def drain(self) -> None:
+        """State-preserving drain (scale-down): push every committed
+        prefix-cache block out of the device pool.  With tiers attached
+        the eviction path demotes the pages to host/object tiers — under
+        ``tier_shared`` (or via the object plane) surviving replicas
+        promote them back on their next prefix hit instead of
+        re-prefilling.  Without tiers this is a plain cache drop."""
+        if self.prefix_cache is not None:
+            self.prefix_cache.drop_all()
 
     def _import_handoff(self, seq: Sequence) -> None:
         """Decode-side admission: rebuild the block table from exported

@@ -421,6 +421,16 @@ class PrefixDirectory:
             del reps[rid]
         return bool(dead)
 
+    def replica_weight(self, dep_id: str, replica_id: str) -> int:
+        """Held-hash count for one replica — the scale-down victim
+        selector prefers the replica with the LEAST directory weight so
+        the shrink discards the fewest cached prefixes (the victim then
+        demotes what it does hold into tiers on drain)."""
+        reps = self._deps.get(dep_id)
+        if not reps:
+            return 0
+        return len(reps.get(replica_id, ()))
+
     def snapshot(self, dep_id: str) -> Dict[str, Any]:
         reps = self._deps.get(dep_id, {})
         return {

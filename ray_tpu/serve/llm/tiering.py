@@ -242,3 +242,45 @@ class KVTierManager:
         _m.TIER_PAGES.set(occ[HOST], tags={"pool": self.pool, "tier": HOST})
         _m.TIER_PAGES.set(occ[OBJECT],
                           tags={"pool": self.pool, "tier": OBJECT})
+
+
+# ------------------------------------------------------------- shared tiers
+#: pool name -> process-shared manager.  guarded_by: _SHARED_LOCK
+_SHARED: Dict[str, KVTierManager] = {}
+_SHARED_LOCK = threading.Lock()
+
+
+def shared_tiers(pool: str = "engine", *, host_pages: int = 0,
+                 object_pages: int = 0,
+                 host_idle_ticks: Optional[int] = None) -> KVTierManager:
+    """Process-shared tier manager, one per pool name.
+
+    Thread-tier replicas of one deployment share the driver process, so a
+    shared manager gives them one host/object index: pages a DRAINING
+    replica demotes on scale-down stay promotable by the survivors (prefix
+    chain hashes are content-addressed, so the keys match across replicas).
+    Budgets grow to the max any caller requested — a late replica must
+    never shrink the pool under the others.
+
+    Process-tier replicas each see their own copy of this module; for them
+    the host tier is per-replica but the OBJECT tier still lands in the
+    shared object plane, so cross-replica survival degrades gracefully
+    rather than breaking.
+    """
+    with _SHARED_LOCK:
+        mgr = _SHARED.get(pool)
+        if mgr is None:
+            mgr = _SHARED[pool] = KVTierManager(
+                pool=pool, host_pages=host_pages, object_pages=object_pages,
+                host_idle_ticks=host_idle_ticks)
+        else:
+            mgr.host_pages = max(mgr.host_pages, max(0, int(host_pages)))
+            mgr.object_pages = max(mgr.object_pages,
+                                   max(0, int(object_pages)))
+        return mgr
+
+
+def reset_shared_tiers() -> None:
+    """Drop all shared tier managers (tests / serve shutdown)."""
+    with _SHARED_LOCK:
+        _SHARED.clear()

@@ -132,6 +132,54 @@ class UserCallableWrapper:
             if inspect.isawaitable(out):
                 await out
 
+    async def call_drain(self) -> None:
+        """User-overridable drain hook: a deployment class may define
+        on_drain() (sync or async), run once when the replica enters
+        DRAINING, after in-flight requests finish and before teardown —
+        the LLM server demotes its cached KV pages to host/object tiers
+        here so a scale-down preserves the cluster's prefix-hit win.
+        Best-effort: a failing hook must never wedge the drain."""
+        if not self._is_class or not hasattr(self._callable, "on_drain"):
+            return
+        fn = self._callable.on_drain
+        try:
+            if not _is_async_callable(fn):
+                await run_in_executor(fn, executor=self._executor())
+                return
+            out = fn()
+            if inspect.isawaitable(out):
+                await out
+        except Exception:
+            pass
+
+    async def call_prewarm(self, model_ids: list) -> int:
+        """Pre-load multiplexed model ids through every @serve.multiplexed
+        loader on the callable (warm-pool pre-start: promotion then skips
+        the checkpoint load).  Returns the number of successful loads;
+        failures are swallowed — prewarm is an optimization."""
+        if not self._is_class or not model_ids:
+            return 0
+        loaders = []
+        seen = set()
+        for klass in type(self._callable).__mro__:
+            for name, fn in vars(klass).items():
+                if name in seen:
+                    continue
+                seen.add(name)
+                if callable(fn) and hasattr(fn, "_multiplex_wrappers"):
+                    loaders.append(fn)
+        loaded = 0
+        for fn in loaders:
+            for model_id in model_ids:
+                try:
+                    out = fn(self._callable, model_id)
+                    if inspect.isawaitable(out):
+                        await out
+                    loaded += 1
+                except Exception:
+                    pass
+        return loaded
+
     async def call_health_check(self) -> None:
         """User-overridable probe: a deployment class may define
         check_health() (sync or async); raising marks the probe failed
@@ -425,14 +473,24 @@ class ReplicaActor:
         await self._wrapper.call_health_check()
         return True
 
+    async def prewarm(self, model_ids: list) -> int:
+        """Warm-pool pre-start: load the given multiplexed model ids now so
+        a later promotion into the serving set costs a state flip, not a
+        checkpoint load."""
+        self._set_replica_context()
+        return await self._wrapper.call_prewarm(list(model_ids or []))
+
     async def prepare_for_shutdown(self, wait_loop_s: float = 5.0) -> None:
         """Drain: in-flight requests AND streams (both count in
-        _num_ongoing) get wait_loop_s to finish; the controller hard-kills
-        at graceful_shutdown_timeout_s regardless (ref: replica graceful
-        shutdown loop)."""
+        _num_ongoing) get wait_loop_s to finish, then the user callable's
+        on_drain() hook runs (KV demotion to tiers for the LLM server);
+        the controller hard-kills at graceful_shutdown_timeout_s regardless
+        (ref: replica graceful shutdown loop)."""
         deadline = time.time() + wait_loop_s
         while self._num_ongoing > 0 and time.time() < deadline:
             await asyncio.sleep(0.02)
+        self._set_replica_context()
+        await self._wrapper.call_drain()
 
 
 class SyncReplicaActor(ReplicaActor):
@@ -527,7 +585,13 @@ class SyncReplicaActor(ReplicaActor):
         asyncio.run(self._wrapper.call_health_check())
         return True
 
+    def prewarm(self, model_ids: list) -> int:
+        self._set_replica_context()
+        return asyncio.run(self._wrapper.call_prewarm(list(model_ids or [])))
+
     def prepare_for_shutdown(self, wait_loop_s: float = 5.0) -> None:
         deadline = time.time() + wait_loop_s
         while self._num_ongoing > 0 and time.time() < deadline:
             time.sleep(0.02)
+        self._set_replica_context()
+        asyncio.run(self._wrapper.call_drain())

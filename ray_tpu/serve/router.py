@@ -244,6 +244,12 @@ class Router:
         #: Deployment-level queue allowance beyond capacity; -1 = unbounded
         #: (the reference's default).  Refreshed with the replica set.
         self._max_queued_requests = -1
+        #: Requests parked in _dispatch because the replica set is empty
+        #: (scale-to-zero wake window).  Reported to the controller as the
+        #: wake signal; bounded by max_queued_requests (see
+        #: _check_capacity).  guarded_by: _wake_lock
+        self._wake_waiting = 0
+        self._wake_lock = threading.Lock()
         # Compiled steady-state route (built BEFORE the long-poll client:
         # its callback feeds the manager the replica set).
         from ray_tpu.serve.compiled_router import CompiledRouteManager
@@ -326,12 +332,15 @@ class Router:
                 # controller keeps the LATEST snapshot per (deployment, pid)
                 # and sums across pids — summing per-router would double
                 # count.
+                with self._wake_lock:
+                    queued = self._wake_waiting
                 self._controller.record_handle_metrics.remote(
                     self.deployment_id, self.router_id, inflight,
                     snapshot=serve_metrics.deployment_snapshot(
                         self.deployment_id),
                     pid=os.getpid(),
-                    compiled=(self._compiled.mode == "compiled"))
+                    compiled=(self._compiled.mode == "compiled"),
+                    queued=queued)
             except ActorDiedError:
                 self._stopped.set()  # controller gone: stop reporting
                 return
@@ -353,7 +362,19 @@ class Router:
             return
         inflight, capacity = self._scheduler.load()
         if capacity <= 0:
-            return  # no replicas yet: the startup wait path handles this
+            # No replicas (startup, or scale-to-zero wake window): requests
+            # queue in _dispatch rather than 503 — but boundedly.  Beyond
+            # max_queued waiters the rest shed with BackPressureError (the
+            # proxy maps it to 503 + Retry-After).
+            with self._wake_lock:
+                waiting = self._wake_waiting
+            if waiting >= max_queued:
+                from ray_tpu.serve.exceptions import BackPressureError
+
+                SHED_COUNTER.inc(tags={"deployment": self.deployment_id})
+                raise BackPressureError(self.deployment_id, waiting, 0,
+                                        max_queued)
+            return
         if inflight >= capacity + max_queued:
             from ray_tpu.serve.exceptions import BackPressureError
 
@@ -377,8 +398,19 @@ class Router:
             replica = self._scheduler.choose_replica(
                 model_id, prefix_hashes=prefix_hashes)
             if replica is None:
-                if not self._replicas_populated.wait(
-                        timeout=max(0.0, deadline - time.time())):
+                # Queue (don't fail) while the replica set is empty: for a
+                # scaled-to-zero deployment this parked request IS the wake
+                # signal — the metrics loop reports the waiter count and
+                # the controller scales 0 -> warm-pool promotion.
+                with self._wake_lock:
+                    self._wake_waiting += 1
+                try:
+                    populated = self._replicas_populated.wait(
+                        timeout=max(0.0, deadline - time.time()))
+                finally:
+                    with self._wake_lock:
+                        self._wake_waiting -= 1
+                if not populated:
                     raise TimeoutError(
                         f"No running replicas for {self.deployment_id} after 30s")
                 continue

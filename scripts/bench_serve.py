@@ -31,6 +31,13 @@ Modes:
                   records TTFT p99, prefill-tokens-avoided, hit rate and
                   the compiled-route-residency gate, plus a mixed-trace
                   regression guard for the cache-off-equivalent workload
+  --mode autoscale SLO-driven autoscaling (ISSUE 18): replay one open-loop
+                  sinusoid + burst + idle + wake trace against static-min,
+                  static-max and autoscaled (min=0, warm pool, compiled
+                  route) arms; gates SLO-violation seconds vs static-min,
+                  wasted replica-seconds vs static-max, zero-error
+                  warm-pool wake-from-zero, and compiled-route residency
+                  at trace end
 
 The batch mode simulates ONE accelerator per deployment with a lock + sleep:
 forward passes serialize, so unbatched requests pay the full forward each
@@ -788,6 +795,228 @@ def run_chaos_mode(args) -> dict:
     return fields
 
 
+def run_autoscale_mode(args) -> dict:
+    """SLO-driven autoscaling anchors (ISSUE 18): replay one open-loop
+    trace — sinusoidal ramp, burst, idle tail, wake burst — against three
+    arms of the SAME deployment:
+
+      autoscale    min=0..max=4 with a warm pool and compiled_route=True
+      static_min   num_replicas=1 (the violation baseline)
+      static_max   num_replicas=4 (the waste baseline)
+
+    Gates: the autoscale arm's SLO-violation seconds stay <= 0.25x the
+    static-min arm's, its wasted replica-seconds stay <= 0.5x the
+    static-max arm's, the wake after the idle tail is a warm-pool
+    promotion with zero caller-visible errors, and the route is back on
+    the compiled path at trace end with bounded fallback seconds."""
+    import math
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    SERVICE_S = 0.15
+    SLO_S = 0.75
+    MAX_REPLICAS = 4
+    CAP_RPS = 1 / SERVICE_S  # replicas execute serially: one call at a time
+    TRACE_S = 18.0
+
+    def rate_at(t: float) -> float:
+        """Requests/s at trace offset t: sinusoid (5..21, starting at the
+        trough) for 8s, a 24 rps burst, a dead-idle tail long past
+        scale_to_zero_idle_s, then a wake burst against whatever the idle
+        tail left provisioned."""
+        if t < 8.0:
+            return 13.0 - 8.0 * math.cos(2 * math.pi * t / 8.0)
+        if t < 11.0:
+            return 24.0
+        if t < 15.0:
+            return 0.0
+        if t < TRACE_S:
+            return 12.0
+        return 0.0
+
+    def needed_at(t: float) -> int:
+        return min(MAX_REPLICAS, math.ceil(rate_at(t) / CAP_RPS))
+
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start(http_options={"port": 0})
+
+    def drive(handle, dep: str):
+        """Open-loop trace replay: a carry-accumulator scheduler submits
+        arrivals on the trace clock regardless of completions; latency is
+        measured from the SCHEDULED arrival, so a backlogged arm keeps
+        paying for its queue.  Samples provisioned (RUNNING) replicas on
+        a side thread for the waste integral."""
+        results = []
+        rlock = threading.Lock()
+        prov_samples = []
+        stop = threading.Event()
+        pool = ThreadPoolExecutor(max_workers=64)
+        t0 = time.perf_counter()
+
+        def sampler():
+            while not stop.wait(0.1):
+                try:
+                    prov = serve.status()[dep]["running_replicas"]
+                except Exception:
+                    continue
+                prov_samples.append((time.perf_counter() - t0, prov))
+
+        def one(arrival: float):
+            t_sched = t0 + arrival
+            try:
+                ok = handle.remote(1).result(timeout_s=60) == 1
+            except Exception:  # noqa: BLE001
+                ok = False
+            lat = time.perf_counter() - t_sched
+            with rlock:
+                results.append((arrival, lat, ok))
+
+        sampler_t = threading.Thread(target=sampler, daemon=True)
+        sampler_t.start()
+        carry, t, step = 0.0, 0.0, 0.02
+        while t < TRACE_S:
+            now = time.perf_counter() - t0
+            if t > now:
+                time.sleep(t - now)
+            carry += rate_at(t) * step
+            n = int(carry)
+            carry -= n
+            for _ in range(n):
+                pool.submit(one, t)
+            t += step
+        pool.shutdown(wait=True)
+        stop.set()
+        sampler_t.join(timeout=5)
+        return results, prov_samples
+
+    def analyze(results, prov_samples):
+        """(slo_violation_seconds, wasted_replica_seconds, errors): a
+        trace second violates when >10% of its arrivals missed the SLO
+        (or errored); waste integrates provisioned-over-needed across the
+        trace window only (the drain after t=TRACE_S is nobody's fault)."""
+        buckets = {}
+        errors = 0
+        for arrival, lat, ok in results:
+            b = buckets.setdefault(int(arrival), [0, 0])
+            b[0] += 1
+            if not ok:
+                errors += 1
+            if not ok or lat > SLO_S:
+                b[1] += 1
+        viol = sum(1 for n, v in buckets.values() if v > 0.1 * n)
+        waste = 0.0
+        for t, prov in prov_samples:
+            if t < TRACE_S:
+                waste += max(0.0, prov - needed_at(t)) * 0.1
+        return viol, waste, errors
+
+    arms = {}
+    asc = AutoscalingConfig(
+        min_replicas=0, max_replicas=MAX_REPLICAS, initial_replicas=1,
+        target_ongoing_requests=1.0, metrics_interval_s=0.1,
+        upscale_delay_s=0.1, upscale_cooldown_s=0.2,
+        downscale_delay_s=0.5, downscale_cooldown_s=0.5,
+        scale_to_zero_idle_s=1.5, warm_pool_size=1, use_slo_burn=False)
+
+    for key, options in (
+            ("static_min", {"num_replicas": 1}),
+            ("static_max", {"num_replicas": MAX_REPLICAS}),
+            ("autoscale", {"autoscaling_config": asc,
+                           "compiled_route": True})):
+
+        @serve.deployment(**options)
+        class Sine:
+            def __call__(self, x):
+                time.sleep(SERVICE_S)
+                return x
+
+        print(f"[autoscale] arm={key} deploying", file=sys.stderr)
+        handle = serve.run(Sine.bind(), name=f"bench_as_{key}",
+                           route_prefix=None)
+        dep = f"bench_as_{key}#Sine"
+        assert handle.remote(1).result(timeout_s=60) == 1
+        deadline = time.time() + 30  # static arms: full capacity up front
+        want = options.get("num_replicas", 1)
+        while time.time() < deadline and \
+                serve.status()[dep]["running_replicas"] < want:
+            time.sleep(0.05)
+
+        if key == "autoscale":
+            from ray_tpu.serve.compiled_router import FALLBACK_SECONDS
+
+            fb_tags = dict(handle._get_router()._compiled._dep_tags)
+            fb_before = FALLBACK_SECONDS.get(tags=fb_tags) or 0.0
+
+        print(f"[autoscale] arm={key} driving trace", file=sys.stderr)
+        results, prov = drive(handle, dep)
+        viol, waste, errors = analyze(results, prov)
+        arms[key] = {"viol": viol, "waste": waste, "errors": errors,
+                     "requests": len(results)}
+        print(f"[autoscale] arm={key} done: {arms[key]}", file=sys.stderr)
+
+        if key == "autoscale":
+            # Wake accounting: the idle tail scaled to zero, so the wake
+            # burst must have been served by a warm-pool promotion, not a
+            # cold start, and with zero caller-visible errors.
+            auto = serve.status()[dep]["autoscale"]
+            arms[key]["warm_promotions"] = auto["warm_promotions"]
+            arms[key]["cold_starts"] = auto["cold_starts"]
+            # Compiled residency at trace end: keep a trickle of traffic
+            # so the router keeps reporting while the replica set settles,
+            # then require the compiled path (bounded fallback en route).
+            deadline = time.time() + 30
+            compiled = False
+            while time.time() < deadline:
+                handle.remote(1).result(timeout_s=30)
+                if handle._get_router()._compiled.mode == "compiled":
+                    compiled = True
+                    break
+                time.sleep(0.1)
+            arms[key]["compiled_at_end"] = compiled
+            arms[key]["route_mode"] = serve.status()[dep]["route_mode"]
+            arms[key]["fallback_s"] = round(
+                (FALLBACK_SECONDS.get(tags=fb_tags) or 0.0) - fb_before, 3)
+
+    a, smin, smax = arms["autoscale"], arms["static_min"], arms["static_max"]
+    fields = {
+        "autoscale_trace_s": TRACE_S,
+        "autoscale_slo_s": SLO_S,
+        "autoscale_slo_violation_s": a["viol"],
+        "autoscale_wasted_replica_s": round(a["waste"], 2),
+        "autoscale_errors": a["errors"],
+        "autoscale_requests": a["requests"],
+        "autoscale_warm_promotions": a["warm_promotions"],
+        "autoscale_cold_starts": a["cold_starts"],
+        "autoscale_route_mode_at_end": a["route_mode"],
+        "autoscale_fallback_s": a["fallback_s"],
+        "staticmin_slo_violation_s": smin["viol"],
+        "staticmin_wasted_replica_s": round(smin["waste"], 2),
+        "staticmax_slo_violation_s": smax["viol"],
+        "staticmax_wasted_replica_s": round(smax["waste"], 2),
+    }
+
+    # Gates (ISSUE 18 acceptance).
+    assert a["errors"] == 0, \
+        f"autoscale arm surfaced {a['errors']} caller-visible errors"
+    assert a["viol"] <= 0.25 * smin["viol"], \
+        f"SLO-violation seconds {a['viol']} vs static-min {smin['viol']}"
+    assert a["waste"] <= 0.5 * smax["waste"], \
+        f"wasted replica-seconds {a['waste']:.1f} vs " \
+        f"static-max {smax['waste']:.1f}"
+    assert a["compiled_at_end"], "route never re-compiled after the trace"
+    assert a["fallback_s"] < TRACE_S, f"unbounded fallback: {a}"
+    assert a["warm_promotions"] >= 1, \
+        f"wake-from-zero was not served from the warm pool: {a}"
+
+    serve.shutdown()
+    ray_tpu.shutdown()
+    return fields
+
+
 def _llm_trace(n_streams: int, requests_per_stream: int, seed: int = 0):
     """Mixed prompt/generation-length request trace, deterministic across
     runs AND identical between the two topologies: stream i replays the
@@ -1343,7 +1572,8 @@ def run_llm_mode(args) -> dict:
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=("latency", "batch", "chaos", "trace",
-                                       "compiled", "pipeline", "llm"),
+                                       "compiled", "pipeline", "llm",
+                                       "autoscale"),
                     default="latency")
     ap.add_argument("--requests", type=int, default=300)
     ap.add_argument("--stream-tokens", type=int, default=2000)
@@ -1371,7 +1601,7 @@ def main():
     modes = {"latency": run_latency_mode, "batch": run_batch_mode,
              "chaos": run_chaos_mode, "trace": run_trace_mode,
              "compiled": run_compiled_mode, "pipeline": run_pipeline_mode,
-             "llm": run_llm_mode}
+             "llm": run_llm_mode, "autoscale": run_autoscale_mode}
     if args.mode == "llm" and args.trace == "prefix-heavy":
         modes["llm"] = run_llm_prefix_mode
     fields = modes[args.mode](args)

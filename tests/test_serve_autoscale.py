@@ -1,0 +1,598 @@
+"""SLO-driven serve autoscaling (ISSUE 18): policy hysteresis/cooldowns
+and the crash-loop interlock under a deterministic clock, burn-rate
+overriding the throughput policies, scale-to-zero with warm-pool wake,
+prefix-coldest victim selection, KV demotion-on-drain, and the
+prefix-hit-preservation acceptance gate across a live shrink.
+
+Layering mirrors the subsystem: pure-logic tests drive
+``DeploymentAutoscaler`` with explicit ``PolicyInputs.now`` values (no
+sleeps, no ray), reconciler tests drive ``DeploymentState`` with fake
+replica wrappers, and the integration tests run a real serve instance
+with sub-second autoscaler intervals."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.autoscaling import DeploymentAutoscaler, PolicyInputs
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
+
+
+# ==================================================== policy (no ray)
+
+
+def _cfg(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 8)
+    kw.setdefault("target_ongoing_requests", 2.0)
+    return AutoscalingConfig(**kw)
+
+
+def _inp(now, running, target, **kw):
+    return PolicyInputs(now=now, num_running=running, target_num=target,
+                        **kw)
+
+
+class TestPolicyClock:
+    """decide() keyed entirely on PolicyInputs.now — every transition is
+    asserted at an exact simulated time."""
+
+    def test_upscale_waits_hysteresis_delay(self):
+        sc = DeploymentAutoscaler("a#D", _cfg(upscale_delay_s=3.0,
+                                              upscale_cooldown_s=0.0))
+        # Load wants 4 replicas (8 inflight / target 2) against target 1.
+        d = sc.decide(_inp(100.0, 1, 1, total_inflight=8))
+        assert not d.changed and d.reason == "pending_up:queue_depth"
+        d = sc.decide(_inp(102.9, 1, 1, total_inflight=8))
+        assert not d.changed
+        d = sc.decide(_inp(103.0, 1, 1, total_inflight=8))
+        assert d.changed and d.target == 4 and d.reason == "queue_depth"
+
+    def test_upscale_hysteresis_resets_when_load_drops(self):
+        sc = DeploymentAutoscaler("a#D", _cfg(upscale_delay_s=3.0))
+        sc.decide(_inp(100.0, 1, 1, total_inflight=8))
+        # Load falls back under target: the above-threshold timer resets,
+        # so re-appearing load must wait the full delay again.
+        sc.decide(_inp(101.0, 1, 1, total_inflight=1))
+        d = sc.decide(_inp(103.5, 1, 1, total_inflight=8))
+        assert not d.changed and d.reason.startswith("pending_up")
+        d = sc.decide(_inp(106.5, 1, 1, total_inflight=8))
+        assert d.changed and d.target == 4
+
+    def test_upscale_cooldown_spaces_consecutive_ups(self):
+        sc = DeploymentAutoscaler("a#D", _cfg(upscale_delay_s=0.0,
+                                              upscale_cooldown_s=5.0))
+        d = sc.decide(_inp(100.0, 1, 1, total_inflight=4))
+        assert d.changed and d.target == 2
+        # More load immediately: delay is satisfied but the cooldown
+        # spaces the second step.
+        d = sc.decide(_inp(101.0, 2, 2, total_inflight=12))
+        assert not d.changed
+        d = sc.decide(_inp(105.0, 2, 2, total_inflight=12))
+        assert d.changed and d.target == 6
+
+    def test_downscale_needs_delay_and_cooldown_and_steps_by_one(self):
+        sc = DeploymentAutoscaler("a#D", _cfg(
+            upscale_delay_s=0.0, upscale_cooldown_s=0.0,
+            downscale_delay_s=10.0, downscale_cooldown_s=20.0))
+        d = sc.decide(_inp(100.0, 4, 4, total_inflight=1))
+        assert not d.changed and d.reason == "pending_down"
+        d = sc.decide(_inp(109.9, 4, 4, total_inflight=1))
+        assert not d.changed
+        d = sc.decide(_inp(110.0, 4, 4, total_inflight=1))
+        # One replica per decision, never a mass shrink (state migration
+        # — prefix demotion on drain — happens one victim at a time).
+        assert d.changed and d.target == 3 and d.reason == "scale_down"
+        # The next step waits for BOTH the below-target delay (restarted
+        # at 121) and the down cooldown (from the 110 step).
+        d = sc.decide(_inp(121.0, 3, 3, total_inflight=1))
+        assert not d.changed and d.reason == "pending_down"
+        d = sc.decide(_inp(129.0, 3, 3, total_inflight=1))
+        assert not d.changed
+        d = sc.decide(_inp(135.0, 3, 3, total_inflight=1))
+        assert d.changed and d.target == 2
+
+    def test_crash_loop_interlock_freezes_target(self):
+        sc = DeploymentAutoscaler("a#D", _cfg(upscale_delay_s=0.0,
+                                              upscale_cooldown_s=0.0))
+        d = sc.decide(_inp(100.0, 1, 1, total_inflight=20, in_backoff=True))
+        assert not d.changed and d.reason == "crash_loop_backoff"
+        # The backoff tick also reset the hysteresis timers: nothing
+        # "queued up" fires the instant the backoff lifts without load.
+        d = sc.decide(_inp(101.0, 1, 1, total_inflight=0))
+        assert not d.changed and d.reason == "steady"
+        # With the interlock lifted and load present, scaling resumes.
+        d = sc.decide(_inp(102.0, 1, 1, total_inflight=20))
+        assert d.changed and d.target == 8  # capped at max_replicas
+
+    def test_burn_overrides_qps_and_bypasses_upscale_delay(self):
+        """Composition is by max: the SLO-burn policy outbids the
+        throughput policies AND skips the hysteresis delay — an alerting
+        burn is already user-visible damage."""
+        sc = DeploymentAutoscaler("a#D", _cfg(
+            upscale_delay_s=30.0, upscale_cooldown_s=0.0,
+            target_qps_per_replica=10.0, burn_upscale_factor=2.0))
+        # qps alone wants 3 (22 qps / 10 per replica) and must wait out
+        # the 30s delay ...
+        d = sc.decide(_inp(100.0, 2, 2, request_rate=22.0))
+        assert not d.changed and d.reason == "pending_up:target_qps"
+        # ... burn alerting wants max(3, 2*2)=4 and fires immediately.
+        d = sc.decide(_inp(100.5, 2, 2, request_rate=22.0,
+                           burn_alerting=True, burn_quiet=False))
+        assert d.changed and d.target == 4 and d.reason == "slo_burn"
+
+    def test_occupancy_saturation_forces_extra_replica(self):
+        sc = DeploymentAutoscaler("a#D", _cfg(
+            upscale_delay_s=0.0, target_qps_per_replica=100.0))
+        # Rate alone is satisfied, but the continuous batches are full —
+        # the qps policy still asks for num_running + 1.
+        d = sc.decide(_inp(100.0, 3, 3, request_rate=5.0,
+                           batch_occupancy=0.97))
+        assert d.changed and d.target == 4 and d.reason == "target_qps"
+
+    def test_downscale_held_until_all_burn_windows_quiet(self):
+        sc = DeploymentAutoscaler("a#D", _cfg(
+            downscale_delay_s=0.0, downscale_cooldown_s=0.0))
+        # Idle by the throughput policies, but a slow window still burns.
+        d = sc.decide(_inp(100.0, 4, 4, total_inflight=1,
+                           burn_alerting=False, burn_quiet=False))
+        assert not d.changed and d.reason == "hold_burn_not_quiet"
+        d = sc.decide(_inp(101.0, 4, 4, total_inflight=1, burn_quiet=True))
+        assert d.changed and d.target == 3
+
+    def test_scale_to_zero_then_wake_round_trip(self):
+        cfg = _cfg(min_replicas=0, max_replicas=4, scale_to_zero_idle_s=60.0,
+                   downscale_delay_s=0.0, downscale_cooldown_s=0.0,
+                   upscale_delay_s=5.0, upscale_cooldown_s=5.0)
+        sc = DeploymentAutoscaler("a#D", cfg)
+        # Busy at t=100 — the idle clock only starts once traffic stops.
+        d = sc.decide(_inp(100.0, 1, 1, total_inflight=1))
+        assert not d.changed
+        d = sc.decide(_inp(110.0, 1, 1))
+        assert not d.changed  # idle 0s of 60
+        d = sc.decide(_inp(169.9, 1, 1))
+        assert not d.changed
+        d = sc.decide(_inp(170.0, 1, 1))
+        assert d.changed and d.target == 0 and d.reason == "scale_to_zero"
+        # Quiet at zero: stays at zero.
+        d = sc.decide(_inp(200.0, 0, 0))
+        assert not d.changed
+        # First queued request wakes IMMEDIATELY — no hysteresis delay,
+        # no upscale cooldown (the parked request is already waiting).
+        d = sc.decide(_inp(200.1, 0, 0, queued_requests=1))
+        assert d.changed and d.target == 1 and d.reason == "wake_from_zero"
+
+    def test_scale_to_zero_blocked_while_burn_not_quiet(self):
+        cfg = _cfg(min_replicas=0, max_replicas=4, scale_to_zero_idle_s=1.0,
+                   downscale_delay_s=0.0, downscale_cooldown_s=0.0)
+        sc = DeploymentAutoscaler("a#D", cfg)
+        sc.decide(_inp(100.0, 1, 1))
+        d = sc.decide(_inp(105.0, 1, 1, burn_quiet=False,
+                           burn_alerting=False))
+        assert not d.changed
+        d = sc.decide(_inp(106.0, 1, 1, burn_quiet=True))
+        assert d.changed and d.target == 0
+
+    def test_floor_is_min_replicas_when_positive(self):
+        sc = DeploymentAutoscaler("a#D", _cfg(
+            min_replicas=2, downscale_delay_s=0.0, downscale_cooldown_s=0.0))
+        d = sc.decide(_inp(100.0, 3, 3))
+        assert d.changed and d.target == 2 and d.reason == "scale_down"
+        # At the floor with min_replicas > 0 the desired count clamps to
+        # min, so idling there reads steady — never a zero target.
+        d = sc.decide(_inp(200.0, 2, 2))
+        assert not d.changed and d.reason == "steady"
+
+    def test_at_floor_holds_one_replica_when_min_zero(self):
+        """min_replicas=0 idling at one replica is 'at the floor', not a
+        scale-down: only the scale-to-zero path (after the idle window)
+        may drop the last replica."""
+        sc = DeploymentAutoscaler("a#D", _cfg(
+            min_replicas=0, max_replicas=4, downscale_delay_s=0.0,
+            downscale_cooldown_s=0.0, scale_to_zero_idle_s=300.0))
+        d = sc.decide(_inp(100.0, 1, 1))
+        assert not d.changed and d.reason == "at_floor"
+
+
+class TestConfigValidation:
+    """AutoscalingConfig rejects the silent-footgun shapes (satellite:
+    min_replicas=0 must be a feature, not a deploy-zero-and-hang bug)."""
+
+    def test_rejects_bad_shapes(self):
+        with pytest.raises(ValueError):
+            AutoscalingConfig(min_replicas=-1)
+        with pytest.raises(ValueError):
+            AutoscalingConfig(min_replicas=4, max_replicas=2)
+        with pytest.raises(ValueError):
+            AutoscalingConfig(min_replicas=0, max_replicas=0)
+        with pytest.raises(ValueError):
+            AutoscalingConfig(min_replicas=1, max_replicas=4,
+                              initial_replicas=5)
+        with pytest.raises(ValueError):
+            AutoscalingConfig(target_ongoing_requests=0)
+        with pytest.raises(ValueError):
+            AutoscalingConfig(target_qps_per_replica=-1.0)
+        with pytest.raises(ValueError):
+            AutoscalingConfig(warm_pool_size=-1)
+        with pytest.raises(ValueError):
+            AutoscalingConfig(burn_upscale_factor=0.5)
+        with pytest.raises(ValueError):
+            AutoscalingConfig(upscale_cooldown_s=-1.0)
+
+    def test_min_zero_seeds_one_replica_not_zero(self):
+        """min_replicas=0 without initial_replicas seeds the deployment at
+        ONE replica (serve first, idle down later); initial_replicas=0 is
+        the explicit start-asleep opt-in."""
+        from ray_tpu.serve.deployment_state import (DeploymentInfo,
+                                                    DeploymentState)
+
+        def f():
+            return None
+
+        asc = AutoscalingConfig(min_replicas=0, max_replicas=4)
+        info = DeploymentInfo(name="D", app_name="a", deployment_def=f,
+                              config=DeploymentConfig(autoscaling_config=asc))
+        assert DeploymentState(info).target_num == 1
+
+        asleep = AutoscalingConfig(min_replicas=0, max_replicas=4,
+                                   initial_replicas=0)
+        info2 = DeploymentInfo(
+            name="D", app_name="a", deployment_def=f,
+            config=DeploymentConfig(autoscaling_config=asleep))
+        assert DeploymentState(info2).target_num == 0
+
+    def test_num_replicas_auto_wires_default_config(self):
+        @serve.deployment(num_replicas="auto")
+        class Auto:
+            def __call__(self):
+                return 1
+
+        asc = Auto.config.autoscaling_config
+        assert asc is not None
+        assert (asc.min_replicas, asc.max_replicas) == (1, 8)
+        # options() path too, and an explicit config is never clobbered.
+        custom = AutoscalingConfig(min_replicas=2, max_replicas=3)
+
+        @serve.deployment
+        class Plain:
+            def __call__(self):
+                return 1
+
+        assert Plain.options(num_replicas="auto") \
+            .config.autoscaling_config is not None
+        assert Plain.options(num_replicas="auto",
+                             autoscaling_config=custom) \
+            .config.autoscaling_config is custom
+
+
+# ========================================= reconciler (fake replicas)
+
+
+class _FakeReplica:
+    """Stands in for ReplicaWrapper in pure-logic reconcile tests: no
+    actor, health probes always pass, draining completes instantly."""
+
+    def __init__(self, replica_id, version, state="RUNNING", warm=False):
+        self.replica_id = replica_id
+        self.version = version
+        self.state = state
+        self.warm = warm
+        self.unhealthy_reason = None
+        self.multiplexed_model_ids = []
+        self.actor = None
+        self.drained = False
+
+    def probe_health(self, now, config):
+        return None
+
+    def check_ready(self):
+        return None  # still starting — reconcile tests drive state directly
+
+    def begin_drain(self, reason=None):
+        self.state = "DRAINING"
+        self.drained = True
+
+    def check_stopped(self):
+        return True
+
+    def hard_kill(self):
+        pass
+
+
+def _fake_state(asc, n_running=0):
+    from ray_tpu.serve.deployment_state import DeploymentInfo, DeploymentState
+
+    def f():
+        return None
+
+    info = DeploymentInfo(name="D", app_name="a", deployment_def=f,
+                          config=DeploymentConfig(autoscaling_config=asc))
+    ds = DeploymentState(info)
+    # No real actors in these tests: an infinite backoff keeps reconcile
+    # from constructing ReplicaWrappers (promotion is not gated by it).
+    ds.backoff_until = float("inf")
+    v = info.version()
+    ds.replicas = [_FakeReplica(f"D#r{i}", v) for i in range(n_running)]
+    return ds
+
+
+def test_scale_down_victim_is_prefix_coldest():
+    """The reconciler drains the replica holding the LEAST prefix
+    directory weight, so a shrink discards the fewest cached prefixes."""
+    asc = AutoscalingConfig(min_replicas=1, max_replicas=4)
+    ds = _fake_state(asc, n_running=3)
+    weights = {"D#r0": 50, "D#r1": 2, "D#r2": 17}
+    ds.prefix_weight = weights.get
+    ds.target_num = 2
+    ds.reconcile()
+    drained = [r.replica_id for r in ds.replicas if r.drained]
+    assert drained == ["D#r1"]
+
+    # Tie-break stays stable and a STARTING replica (costs no capacity)
+    # outranks any RUNNING one regardless of weight.
+    ds2 = _fake_state(asc, n_running=3)
+    ds2.replicas[2].state = "STARTING"
+    ds2.prefix_weight = {"D#r0": 0, "D#r1": 0, "D#r2": 99}.get
+    ds2.target_num = 2
+    ds2.reconcile()
+    assert [r.replica_id for r in ds2.replicas if r.drained] == ["D#r2"]
+
+
+def test_scale_up_promotes_warm_replica_before_cold_start():
+    asc = AutoscalingConfig(min_replicas=0, max_replicas=4, warm_pool_size=1)
+    ds = _fake_state(asc, n_running=1)
+    v = ds.info.version()
+    ds.replicas.append(_FakeReplica("D#warm", v, state="WARM", warm=True))
+    ds.target_num = 2
+    changed = ds.reconcile()
+    assert changed
+    warm = ds.replicas[-1]
+    assert warm.state == "RUNNING" and not warm.warm
+    assert ds.num_warm_promotions == 1 and ds.num_cold_starts == 0
+    assert len(ds.replicas) == 2  # promoted in place, nothing started
+
+
+def test_outdated_warm_replica_drains_not_promotes():
+    """A warm replica from an older code version must never be promoted
+    into the serving set — the pool drains it and (backoff permitting)
+    restarts at the new version."""
+    asc = AutoscalingConfig(min_replicas=1, max_replicas=4, warm_pool_size=1)
+    ds = _fake_state(asc, n_running=1)
+    stale = _FakeReplica("D#old", "stale-version", state="WARM", warm=True)
+    ds.replicas.append(stale)
+    ds.reconcile()
+    assert stale.drained and not stale.warm
+
+
+def test_directory_entries_drop_at_draining_no_resurrection():
+    """Satellite regression: prefix hints drop the tick a replica enters
+    DRAINING, and a late commit report from the draining replica cannot
+    resurrect them (find_replica_deployment(running_only=True) -> None)."""
+    from ray_tpu.serve.deployment_state import DeploymentStateManager
+    from ray_tpu.serve.llm.prefix_dir import PrefixDirectory
+
+    asc = AutoscalingConfig(min_replicas=1, max_replicas=4)
+    ds = _fake_state(asc, n_running=2)
+    mgr = DeploymentStateManager()
+    mgr.deployments["a#D"] = ds
+
+    pdir = PrefixDirectory()
+    pdir.update("a#D", "D#r0", ["h0", "h1"], [], 16)
+    pdir.update("a#D", "D#r1", ["h2"], [], 16)
+    assert pdir.replica_weight("a#D", "D#r0") == 2
+
+    ds.prefix_weight = lambda rid: pdir.replica_weight("a#D", rid)
+    ds.target_num = 1
+    ds.reconcile()
+    victim = next(r for r in ds.replicas if r.drained)
+    assert victim.replica_id == "D#r1"  # coldest (1 hash vs 2)
+
+    # The same membership push prunes the directory ...
+    live = {r["replica_id"] for r in ds.running_replicas()}
+    assert pdir.retain("a#D", live)
+    assert pdir.replica_weight("a#D", "D#r1") == 0
+    # ... and the draining replica's late report is not a routing target:
+    # the controller resolves it running_only and refuses the update.
+    assert mgr.find_replica_deployment("D#r1", running_only=True) is None
+    assert mgr.find_replica_deployment("D#r1") == "a#D"
+    snap = pdir.snapshot("a#D")
+    assert "D#r1" not in snap["replicas"]
+
+
+# ===================================== KV demotion on drain (no ray)
+
+
+def test_drain_demotes_prefix_pages_and_survivor_promotes():
+    """State-preserving scale-down at the cache layer: drop_all() on the
+    victim demotes its committed pages into the shared tier (observed via
+    the ray_tpu_llm_kv_demoted_pages_total delta), and a survivor's
+    acquire_into() promotes them back instead of re-prefilling."""
+    from ray_tpu.serve.llm import metrics as _lm
+    from ray_tpu.serve.llm.blocks import BlockAllocator, BlockTable
+    from ray_tpu.serve.llm.prefix_dir import ReplicaPrefixCache
+    from ray_tpu.serve.llm.tiering import KVTierManager
+
+    pool = "drain-unit"
+    tiers = KVTierManager(pool=pool, host_pages=64)
+    victim_alloc = BlockAllocator(8, 4, pool=pool)
+    victim = ReplicaPrefixCache(victim_alloc, tiers=tiers,
+                                reporter=lambda a, r, b: None)
+    prompt = list(range(12))  # 3 full blocks of 4
+    table = BlockTable(victim_alloc)
+    for t in prompt:
+        table.append(("kv", t))
+    victim.commit(table, prompt, "base")
+    table.release()  # sequence retires; the cache holds the only refs
+    assert len(victim) == 3
+
+    tags = {"pool": pool, "tier": "host"}
+    before = _lm.KV_DEMOTED_PAGES.get(tags=tags)
+    victim.drop_all()  # what LLMServer.on_drain() runs via engine.drain()
+    assert _lm.KV_DEMOTED_PAGES.get(tags=tags) - before == 3
+    assert len(victim) == 0
+    assert victim_alloc.num_free == victim_alloc.num_blocks
+
+    survivor_alloc = BlockAllocator(8, 4, pool=pool)
+    survivor = ReplicaPrefixCache(survivor_alloc, tiers=tiers,
+                                  reporter=lambda a, r, b: None)
+    fresh = BlockTable(survivor_alloc)
+    matched = survivor.acquire_into(fresh, prompt, "base")
+    assert matched == 12  # full prompt served from promoted tier pages
+    assert [fresh.get(i) for i in range(12)] == \
+        [("kv", t) for t in prompt]
+
+
+# ============================================= integration (live serve)
+
+
+@pytest.fixture
+def serve_instance():
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    serve.start(http_options={"port": 0})
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+    from ray_tpu.serve.llm.tiering import reset_shared_tiers
+
+    reset_shared_tiers()
+
+
+def _wait(pred, timeout_s, msg):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(msg)
+
+
+def test_scale_to_zero_idle_then_warm_wake(serve_instance):
+    """Acceptance round trip: an idle min_replicas=0 deployment drops to
+    zero, the next request queues (never errors) and is answered by a
+    warm-pool promotion — bounded far under a cold start + init."""
+    asc = AutoscalingConfig(
+        min_replicas=0, max_replicas=2, metrics_interval_s=0.05,
+        upscale_delay_s=0.0, upscale_cooldown_s=0.0,
+        downscale_delay_s=0.0, downscale_cooldown_s=0.0,
+        scale_to_zero_idle_s=1.0, warm_pool_size=1, use_slo_burn=False)
+
+    @serve.deployment(autoscaling_config=asc,
+                      graceful_shutdown_wait_loop_s=0.5,
+                      graceful_shutdown_timeout_s=2.0)
+    class Sleepy:
+        def __call__(self, x):
+            return f"ok:{x}"
+
+    handle = serve.run(Sleepy.bind(), name="sleepy", route_prefix=None)
+    dep = "sleepy#Sleepy"
+    assert handle.remote("warm").result(timeout_s=30) == "ok:warm"
+
+    def st():
+        return serve.status()[dep]
+
+    # Idle → zero RUNNING replicas, warm pool intact.
+    _wait(lambda: st()["running_replicas"] == 0, 30,
+          "never scaled to zero while idle")
+    _wait(lambda: st()["autoscale"]["warm_replicas"] == 1, 30,
+          "warm pool not maintained at zero")
+    assert st()["autoscale"]["last_decision_reason"] in (
+        "scale_to_zero", "steady", "pending_down")
+
+    # Wake: the request parks at the router (no 503), the queued count
+    # wakes the controller, and the warm replica is promoted — a state
+    # flip plus one long-poll push, so seconds, not a cold start.
+    t0 = time.time()
+    assert handle.remote("wake").result(timeout_s=30) == "ok:wake"
+    wake_latency = time.time() - t0
+    assert wake_latency < 10.0, f"wake took {wake_latency:.1f}s"
+
+    row = st()
+    assert row["running_replicas"] >= 1
+    assert row["autoscale"]["warm_promotions"] >= 1
+    # The wake was served by promotion: the only cold start on record is
+    # the initial deploy (and the warm pool refill is not a cold start).
+    assert row["autoscale"]["cold_starts"] <= 1
+
+
+def test_prefix_hit_rate_survives_shrink_via_shared_tiers(serve_instance):
+    """Acceptance gate: post-shrink prefix hit rate stays within 10% of
+    pre-shrink — the victim demotes its cached KV pages into the shared
+    tier on drain and the survivor promotes them on the next replay."""
+    from ray_tpu.serve.llm import metrics as _lm
+    from ray_tpu.serve.llm.disagg import build_monolithic_app
+    from ray_tpu.serve.api import _get_controller
+
+    app = build_monolithic_app(
+        model_specs={"base": {"seed": 7, "dim": 8}},
+        num_replicas=2, num_blocks=256, block_size=4,
+        tier_host_pages=256, tier_shared=True)
+    handle = serve.run(app, name="shrink", route_prefix=None)
+    dep = "shrink#LLMServer"
+
+    prompts = [[p * 17 + i for i in range(16)] for p in range(1, 7)]
+
+    def replay():
+        tags = {"pool": "engine"}
+        hit0 = _lm.PREFIX_HIT_TOKENS.get(tags=tags)
+        look0 = _lm.PREFIX_LOOKUP_TOKENS.get(tags=tags)
+        for p in prompts:
+            out = list(handle.options(stream=True).remote(
+                {"prompt": list(p), "max_tokens": 4}))
+            assert len(out) == 4
+        look = _lm.PREFIX_LOOKUP_TOKENS.get(tags=tags) - look0
+        hit = _lm.PREFIX_HIT_TOKENS.get(tags=tags) - hit0
+        return hit / look if look else 0.0
+
+    replay()  # cold pass commits every prompt's blocks somewhere
+    pre = replay()
+    assert pre > 0.5, f"warm replay should mostly hit, got {pre:.2f}"
+
+    controller = _get_controller()
+    assert ray_tpu.get(controller.set_target_num.remote(dep, 1))
+    _wait(lambda: serve.status()[dep]["running_replicas"] == 1, 30,
+          "never shrank to one replica")
+
+    post = replay()
+    assert post >= 0.9 * pre, (
+        f"prefix hit rate collapsed across shrink: {pre:.2f} -> {post:.2f}")
+
+
+def test_autoscale_status_and_flight_recorder_rows(serve_instance):
+    """Every applied target change lands a serve.autoscale flight-recorder
+    row, and serve.status() carries the autoscale block."""
+    from ray_tpu.util import flight_recorder
+
+    asc = AutoscalingConfig(
+        min_replicas=1, max_replicas=3, metrics_interval_s=0.05,
+        upscale_delay_s=0.0, upscale_cooldown_s=0.0,
+        target_ongoing_requests=1.0, use_slo_burn=False)
+
+    @serve.deployment(autoscaling_config=asc)
+    class Busy:
+        def __call__(self, x):
+            time.sleep(0.3)
+            return x
+
+    handle = serve.run(Busy.bind(), name="busy", route_prefix=None)
+    dep = "busy#Busy"
+    assert handle.remote(0).result(timeout_s=30) == 0
+
+    futs = [handle.remote(i) for i in range(12)]
+    _wait(lambda: serve.status()[dep]["target_num_replicas"] > 1, 30,
+          "load never moved the target")
+    for f in futs:
+        f.result(timeout_s=30)
+
+    rows = [e for e in flight_recorder.get_recorder().snapshot()
+            if e.get("name") == "serve.autoscale"
+            and e.get("detail", {}).get("deployment") == dep]
+    assert rows, "no flight-recorder row for the applied scale-up"
+    up = rows[0]["detail"]
+    assert up["to"] > up["from"] and up["reason"] == "queue_depth"
+
+    auto = serve.status()[dep]["autoscale"]
+    assert auto["min_replicas"] == 1 and auto["max_replicas"] == 3
+    assert auto["last_decision_reason"] is not None
+    assert auto["last_change_at"] is not None

@@ -374,6 +374,129 @@ def test_injected_long_poll_failures_tolerated(serve_chaos):
         assert handle.remote(i).result(timeout_s=15) == i - 1
 
 
+@pytest.mark.parametrize("serve_chaos", ["serve_autoscale=1.0:4"],
+                         indirect=True)
+def test_injected_autoscale_failures_leave_target_unchanged(serve_chaos):
+    """serve_autoscale chaos: an injected scale-decision failure must
+    leave target_num exactly where it was — no replica started, none
+    stranded in DRAINING — and scaling resumes once the budget drains."""
+    from ray_tpu.serve.autoscaling import DECISIONS
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    asc = AutoscalingConfig(
+        min_replicas=1, max_replicas=3, metrics_interval_s=0.05,
+        upscale_delay_s=0.0, upscale_cooldown_s=0.0,
+        target_ongoing_requests=1.0, use_slo_burn=False)
+
+    @serve.deployment(autoscaling_config=asc)
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.3)
+            return x
+
+    handle = serve.run(Slow.bind(), name="aschaos", route_prefix=None)
+    dep = "aschaos#Slow"
+    rej_tags = {"deployment": dep, "reason": "fault_injected"}
+    assert handle.remote(0).result(timeout_s=30) == 0
+
+    futs = [handle.remote(i) for i in range(24)]
+    # While the injection budget lasts, every applied change is rejected:
+    # the target must not move.  (Re-read the counter after the status
+    # sample so a budget-exhausting tick between the reads can't turn a
+    # legitimate post-budget scale-up into a false failure.)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        rejected_before = DECISIONS.get(tags=rej_tags)
+        target = serve.status()[dep]["target_num_replicas"]
+        if DECISIONS.get(tags=rej_tags) < 4 and rejected_before == \
+                DECISIONS.get(tags=rej_tags):
+            assert target == 1, (
+                f"target moved to {target} while decisions were injected")
+        if DECISIONS.get(tags=rej_tags) >= 4:
+            break
+        time.sleep(0.02)
+    assert DECISIONS.get(tags=rej_tags) >= 4, "fault point never consulted"
+
+    # Budget exhausted: the very next decision applies and the deployment
+    # converges; no replica is left stranded in DRAINING.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if serve.status()[dep]["target_num_replicas"] > 1:
+            break
+        time.sleep(0.05)
+    assert serve.status()[dep]["target_num_replicas"] > 1
+    for f in futs:
+        f.result(timeout_s=30)  # zero caller-visible errors throughout
+    rows = [r for r in serve.list_replicas()
+            if r["deployment_id"] == dep and r["state"] == "DRAINING"]
+    assert not rows, f"replicas stranded in DRAINING: {rows}"
+
+
+def test_replica_kill_mid_scale_up_converges_without_double_start(
+        serve_chaos):
+    """Kill a replica while a scale-up is in flight: the reconciler must
+    converge to exactly target_num replicas — the death is absorbed by
+    the same deficit accounting, never double-started past the target."""
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    asc = AutoscalingConfig(
+        min_replicas=1, max_replicas=3, metrics_interval_s=0.05,
+        upscale_delay_s=0.0, upscale_cooldown_s=0.0,
+        target_ongoing_requests=1.0, use_slo_burn=False)
+
+    @serve.deployment(autoscaling_config=asc, health_check_period_s=0.1)
+    class Busy:
+        def __call__(self, x):
+            time.sleep(0.2)
+            return x
+
+    handle = serve.run(Busy.bind(), name="killscale", route_prefix=None)
+    dep = "killscale#Busy"
+    assert handle.remote(0).result(timeout_s=30) == 0
+
+    stop = threading.Event()
+
+    def client():
+        i = 0
+        while not stop.is_set():
+            try:
+                handle.remote(i).result(timeout_s=15)
+            except Exception:
+                pass
+            i += 1
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(6)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if serve.status()[dep]["target_num_replicas"] == 3:
+                break
+            time.sleep(0.05)
+        assert serve.status()[dep]["target_num_replicas"] == 3
+        _kill_one_replica()  # mid-scale-up: some replicas still STARTING
+
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if serve.status()[dep]["running_replicas"] == 3:
+                break
+            time.sleep(0.1)
+        assert serve.status()[dep]["running_replicas"] == 3
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+    # Converged means CONVERGED: give the reconciler a few more ticks and
+    # assert no surplus replica ever materialized past the target.
+    time.sleep(0.5)
+    rows = [r for r in serve.list_replicas() if r["deployment_id"] == dep]
+    assert len(rows) == 3, f"double-started past target: {rows}"
+    assert all(r["state"] == "RUNNING" for r in rows), rows
+
+
 # ------------------------------------------------------- reduced-scale bench
 @pytest.mark.slow
 def test_chaos_bench_reduced_scale():
