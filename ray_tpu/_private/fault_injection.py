@@ -95,6 +95,12 @@ FAULT_POINTS: Dict[str, str] = {
                          "training loop",
     "data_ingest_prefetch": "host->device batch transfer dispatch — retried "
                             "once before surfacing",
+    # device telemetry (tests/test_device_telemetry.py)
+    "device_telemetry_snapshot": "device-telemetry snapshot assembly — every "
+                                 "embedding site (forensics bundle, "
+                                 "serve.status, run registry) absorbs a "
+                                 "telemetry failure rather than worsening "
+                                 "the event being observed",
 }
 
 

@@ -50,6 +50,12 @@ _TRAIN_LANE_PREFIXES = ("train.", "checkpoint.", "data.")
 #: episode reads as one story next to the per-trace request lanes.
 _SERVE_LANE_PREFIXES = ("serve.slo", "serve.preempt_recompute")
 
+#: Device-plane spans (XLA compiles, host<->device transfers, compute
+#: burns from util.device_telemetry) folded into one shared "device" lane:
+#: a recompile storm, the transfers feeding it, and the burns it starves
+#: line up on a single row under the train/serve stories.
+_DEVICE_LANE_PREFIXES = ("xla.", "device.")
+
 
 def spans_to_chrome_events(spans: List[dict]) -> List[dict]:
     """Fold util.tracing spans into chrome-tracing "X" (complete) events.
@@ -58,10 +64,11 @@ def spans_to_chrome_events(spans: List[dict]) -> List[dict]:
     process lane per trace — a whole serve request reads top-to-bottom),
     ``tid`` is the span's name so sibling spans of the same kind share a
     track.  Training-plane spans (train./checkpoint./data.) instead share
-    the single "train" pid (_TRAIN_LANE_PREFIXES), and serve health-plane
+    the single "train" pid (_TRAIN_LANE_PREFIXES), serve health-plane
     spans (SLO burns, preemption recomputes) the single "serve" pid
-    (_SERVE_LANE_PREFIXES).  Unfinished spans (end=None) are skipped — an
-    open span has no duration yet."""
+    (_SERVE_LANE_PREFIXES), and device-plane spans (xla./device.) the
+    single "device" pid (_DEVICE_LANE_PREFIXES).  Unfinished spans
+    (end=None) are skipped — an open span has no duration yet."""
     out: List[dict] = []
     for s in spans:
         if s.get("end") is None:
@@ -75,6 +82,8 @@ def spans_to_chrome_events(spans: List[dict]) -> List[dict]:
             pid = "train"
         elif name.startswith(_SERVE_LANE_PREFIXES):
             pid = "serve"
+        elif name.startswith(_DEVICE_LANE_PREFIXES):
+            pid = "device"
         else:
             pid = f"trace:{s.get('trace_id', '')[:8]}"
         ev = {

@@ -23,6 +23,7 @@ transport:
 from __future__ import annotations
 
 import pickle
+import sys
 import threading
 from collections import deque
 from typing import Any, Optional
@@ -157,12 +158,25 @@ class DeviceChannel(Channel):
     def _move(self, value: Any) -> Any:
         import jax
 
+        moved_bytes = 0
+
         def move(leaf):
+            nonlocal moved_bytes
             if isinstance(leaf, jax.Array):
+                moved_bytes += int(getattr(leaf, "nbytes", 0))
                 return jax.device_put(leaf, self._device)
             return leaf
 
-        return jax.tree_util.tree_map(move, value)
+        out = jax.tree_util.tree_map(move, value)
+        if moved_bytes:
+            # Device-telemetry plane iff loaded (cross-layer probe idiom):
+            # the write is a placement transfer onto the consumer's device
+            # and the bytes sit in the channel buffer until read.
+            dt = sys.modules.get("ray_tpu.util.device_telemetry")
+            if dt is not None:
+                dt.record_transfer("h2d", moved_bytes, src="dag_channel")
+                dt.pool_add("dag_channel", moved_bytes)
+        return out
 
     def _transform(self, value: Any) -> Any:
         if self._device is None:
@@ -172,6 +186,38 @@ class DeviceChannel(Channel):
         record = list(value)
         record[self._payload_index] = self._move(record[self._payload_index])
         return record
+
+    # ------------------------------------------------------- buffer ledger
+    def read(self, timeout: Optional[float] = None) -> Any:
+        value = super().read(timeout=timeout)
+        self._ledger_release(value)
+        return value
+
+    def read_ready(self, max_n: int, out: Optional[list] = None) -> list:
+        start = 0 if out is None else len(out)
+        batch = super().read_ready(max_n, out)
+        for value in batch[start:]:
+            self._ledger_release(value)
+        return batch
+
+    def _ledger_release(self, value: Any) -> None:
+        """Consumed elements leave the buffer — release their on-device
+        array bytes from the ``dag_channel`` pool (same jax.Array-only
+        sizing as the write side so the pair balances)."""
+        if self._device is None:
+            return
+        dt = sys.modules.get("ray_tpu.util.device_telemetry")
+        if dt is None:
+            return
+        if self._payload_index is not None:
+            value = value[self._payload_index]
+        import jax
+
+        nbytes = sum(int(getattr(leaf, "nbytes", 0))
+                     for leaf in jax.tree_util.tree_leaves(value)
+                     if isinstance(leaf, jax.Array))
+        if nbytes:
+            dt.pool_sub("dag_channel", nbytes)
 
 
 #: Process-wide arena clients keyed by path: channels that cross processes

@@ -164,7 +164,8 @@ class DeviceBatchIterator:
                     try:
                         fault_injection.check("data_ingest_prefetch")
                         return jax_compat.device_put_batch(
-                            batch, sharding=self._sharding)
+                            batch, sharding=self._sharding,
+                            transfer_src="ingest_prefetch")
                     except WorkerCrashedError as e:
                         last = e
                 raise last  # type: ignore[misc]

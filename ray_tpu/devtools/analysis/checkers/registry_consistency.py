@@ -284,6 +284,7 @@ METRIC_MODULES = (
     "ray_tpu.data.ingest.metrics",
     "ray_tpu.util.flight_recorder",
     "ray_tpu.util.watchdog",
+    "ray_tpu.util.device_telemetry",
 )
 
 ALLOWED_PREFIXES = ("ray_tpu_", "serve_")
@@ -305,6 +306,7 @@ ACCESSOR_SERIES = {
         "ray_tpu_llm_recompute_tokens_total",
     "metrics.acceptance_rate": "ray_tpu_llm_spec_accepted_tokens_total",
     "metrics.prefix_hit_rate": "ray_tpu_llm_prefix_hit_tokens_total",
+    "device.transfer_bw": "ray_tpu_device_transfer_bytes_total",
 }
 
 

@@ -8,6 +8,7 @@ requests from one event loop.
 """
 
 from ray_tpu.serve import metrics, slo
+from ray_tpu.util import device_telemetry as device
 from ray_tpu.serve.api import (Application, Deployment, delete, deployment,
                                get_app_handle, get_deployment_handle,
                                list_deployments, list_replicas, pipeline,
@@ -33,4 +34,5 @@ __all__ = [
     "get_multiplexed_model_id", "batch", "continuous_batch", "EOS",
     "Emissions",
     "SequenceSlot", "BackPressureError", "SLOObjective", "metrics", "slo",
+    "device",
 ]

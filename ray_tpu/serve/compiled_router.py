@@ -72,8 +72,10 @@ COMPILED_MODE_GAUGE = _metrics.Gauge(
 RECOMPILES_TOTAL = _metrics.Counter(
     "ray_tpu_serve_compiled_recompiles_total",
     "Compiled-route graph builds by this router (the first compile after "
-    "deploy counts as one)",
-    tag_keys=("deployment",))
+    "deploy counts as one), by the membership-change reason that forced "
+    "the rebuild (deploy / replica_death / drain / rolling_update / "
+    "autoscale)",
+    tag_keys=("deployment", "reason"))
 FALLBACK_SECONDS = _metrics.Counter(
     "ray_tpu_serve_compiled_fallback_seconds_total",
     "Cumulative seconds this router spent on the dynamic path while "
@@ -1082,6 +1084,11 @@ class CompiledRouteManager:
         self._last_change = time.monotonic()
         self._fallback_since = time.monotonic()
         self._config_enabled: Optional[bool] = None
+        #: Why the NEXT compile will happen: the reason of the membership
+        #: change that tore the last graph down (stamped by the reconciler
+        #: onto replica rows), or "replica_death" for a locally-observed
+        #: corpse.  "deploy" covers the first compile.  # guarded_by: _lock
+        self._rebuild_reason = "deploy"
         self._stopped = False
         #: Pipelines subscribed to this stage's teardowns.  # guarded_by: _lock
         self._listeners: List[Any] = []
@@ -1132,6 +1139,9 @@ class CompiledRouteManager:
                 self._sig = sig
                 self._last_change = time.monotonic()
                 self._uncompilable_sig = None
+                if replicas:
+                    self._rebuild_reason = (
+                        replicas[0].get("change_reason") or "deploy")
                 graph = self._detach_locked()
         if graph is not None:
             self._notify_teardown()
@@ -1169,7 +1179,8 @@ class CompiledRouteManager:
                 self._uncompilable_sig = self._sig
                 return
             self._graph = graph
-            RECOMPILES_TOTAL.inc(tags=self._dep_tags)
+            RECOMPILES_TOTAL.inc(tags={**self._dep_tags,
+                                       "reason": self._rebuild_reason})
             FALLBACK_SECONDS.inc(
                 max(0.0, time.monotonic() - self._fallback_since),
                 tags=self._dep_tags)
@@ -1185,6 +1196,7 @@ class CompiledRouteManager:
                 # Hold recompilation until the reconciler pushes a fresh
                 # set — rebuilding around the corpse would just fail.
                 self._last_change = time.monotonic()
+                self._rebuild_reason = "replica_death"
                 COMPILED_MODE_GAUGE.set(0.0, tags=self._dep_tags)
                 broke = True
         if broke:
@@ -1193,6 +1205,7 @@ class CompiledRouteManager:
             _flight_recorder.trigger_dump("compiled_fallback", {
                 "deployment": self._dep_tags["deployment"],
                 "replica": replica_id,
+                "reason": "replica_death",
             })
             self._notify_teardown()
         graph.destroy()

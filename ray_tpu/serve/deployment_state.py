@@ -284,6 +284,14 @@ class DeploymentState:
         #: Optional replica_id -> prefix-directory weight, wired by the
         #: controller; scale-down drains the prefix-coldest replica first.
         self.prefix_weight = None
+        #: Why the running set last changed (deploy / replica_death /
+        #: drain / rolling_update / autoscale) — stamped onto the rows
+        #: routers receive, so a compiled-route rebuild is attributable.
+        self.change_reason = "deploy"
+        #: Where the current target_num came from: "config" (deploy /
+        #: set_target) or "autoscale" (set_target_num) — decides whether a
+        #: scale-down drain reads as autoscale or plain drain.
+        self._target_source = "config"
 
     # ------------------------------------------------------------- targets
     def set_target(self, info: DeploymentInfo) -> None:
@@ -296,6 +304,7 @@ class DeploymentState:
         else:
             self.target_num = info.config.num_replicas
         self.info = info
+        self._target_source = "config"
         if info.version() != old_version:
             self._changed = True
             # New code/config gets a fresh chance immediately: the backoff
@@ -308,6 +317,8 @@ class DeploymentState:
         if n != self.target_num:
             self.target_num = n
             self._changed = True
+            self._target_source = "autoscale"
+            self.change_reason = "autoscale"
 
     def delete(self) -> None:
         self.deleting = True
@@ -367,6 +378,7 @@ class DeploymentState:
             if verdict is not None:
                 r.state = ReplicaState.UNHEALTHY
                 r.unhealthy_reason = verdict
+                self.change_reason = "replica_death"
                 if not r.passed_first_health:
                     # Crashed before ever probing healthy: treat like a
                     # failed start so an init-OK-then-instant-crash loop
@@ -426,6 +438,7 @@ class DeploymentState:
                         continue  # would violate the availability floor
                     victim.begin_drain()
                     changed = True
+                    self.change_reason = "rolling_update"
                     break  # one per tick, as before
             return True  # keep reconciling until the update converges
 
@@ -468,6 +481,9 @@ class DeploymentState:
             for r in victims[: len(live) - self.target_num]:
                 r.begin_drain()
                 changed = True
+            self.change_reason = ("autoscale"
+                                  if self._target_source == "autoscale"
+                                  else "drain")
         return changed
 
     def _reconcile_warm_pool(self, now: float, config: DeploymentConfig,
@@ -520,6 +536,7 @@ class DeploymentState:
                  "max_ongoing_requests": self.info.config.max_ongoing_requests,
                  "max_queued_requests": self.info.config.max_queued_requests,
                  "compiled_route": self.info.config.compiled_route,
+                 "change_reason": self.change_reason,
                  "multiplexed_model_ids": list(r.multiplexed_model_ids)}
                 for r in self.replicas if r.state == ReplicaState.RUNNING]
 

@@ -18,12 +18,25 @@ can assert exact allocation/preemption traces.
 
 from __future__ import annotations
 
+import sys
 import threading
 from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 from ray_tpu._private import fault_injection
 from ray_tpu.serve.llm import metrics as _m
+
+
+def _ledger_pool(payload: Any, *, sign: int) -> None:
+    """Adjust the device-telemetry ``kv_blocks`` pool iff the plane is
+    loaded (cross-layer probe idiom — this layer must not import it).
+    ``sign > 0`` means the payload entered the pool, ``< 0`` it left."""
+    dt = sys.modules.get("ray_tpu.util.device_telemetry")
+    if dt is None:
+        return
+    nbytes = dt.tree_nbytes(payload)
+    if nbytes:
+        (dt.pool_add if sign > 0 else dt.pool_sub)("kv_blocks", nbytes)
 
 
 class NoFreeBlocks(RuntimeError):
@@ -86,6 +99,7 @@ class BlockAllocator:
 
     def free(self, block_ids: List[int]) -> None:
         """Drop one reference per id; blocks return to the pool at zero."""
+        dropped: List[List[Any]] = []
         with self._lock:
             for b in block_ids:
                 rc = self._refcount.get(b, 0)
@@ -93,12 +107,16 @@ class BlockAllocator:
                     raise ValueError(f"double free of block {b}")
                 if rc == 1:
                     del self._refcount[b]
+                    page = self._pages[b]
+                    if page:
+                        dropped.append(page)
                     self._pages[b] = None
                     self._free.append(b)
                 else:
                     self._refcount[b] = rc - 1
             in_use = len(self._refcount)
         _m.BLOCKS_IN_USE.set(in_use, tags={"pool": self.pool})
+        _ledger_pool(dropped, sign=-1)
 
     def refcount(self, block_id: int) -> int:
         with self._lock:
@@ -128,6 +146,7 @@ class BlockAllocator:
             if len(page) >= self.block_size:
                 raise ValueError(f"block {block_id} is full")
             page.append(entry)
+        _ledger_pool(entry, sign=1)
 
     def read_entry(self, block_id: int, offset: int) -> Any:
         with self._lock:
@@ -153,7 +172,9 @@ class BlockAllocator:
                 raise ValueError(
                     f"trim of block {block_id} to {length} entries "
                     f"(page holds {len(page)})")
+            dropped = page[length:]
             del page[length:]
+        _ledger_pool(dropped, sign=-1)
 
     def copy_block(self, block_id: int) -> int:
         """Materialize a private copy of ``block_id`` (copy-on-write): a
@@ -167,11 +188,14 @@ class BlockAllocator:
                     f"pool '{self.pool}': no free block for COW copy")
             new_id = self._free.popleft()
             self._refcount[new_id] = 1
-            self._pages[new_id] = list(src)
+            copied = list(src)
+            self._pages[new_id] = copied
             # Drop the forker's reference to the shared source block.
             rc = self._refcount[block_id]
+            dropped_src: Optional[List[Any]] = None
             if rc == 1:
                 del self._refcount[block_id]
+                dropped_src = src
                 self._pages[block_id] = None
                 self._free.append(block_id)
             else:
@@ -179,6 +203,9 @@ class BlockAllocator:
             in_use = len(self._refcount)
         _m.COW_COPIES.inc(tags={"pool": self.pool})
         _m.BLOCKS_IN_USE.set(in_use, tags={"pool": self.pool})
+        _ledger_pool(copied, sign=1)
+        if dropped_src is not None:
+            _ledger_pool(dropped_src, sign=-1)
         return new_id
 
     def export_pages(self, block_ids: List[int]) -> List[List[Any]]:

@@ -17,6 +17,7 @@ deterministic model).
 
 from __future__ import annotations
 
+import sys
 import time
 from typing import Any, Dict, List, Optional
 
@@ -50,6 +51,17 @@ def _payload_bytes(pages: List[List[Any]]) -> int:
     return total
 
 
+def _ledger_transfer(direction: str, nbytes: int,
+                     start: float, end: float) -> None:
+    """Feed the device-telemetry transfer ledger iff the plane is loaded
+    (cross-layer probe idiom): an export is a device->host move of the
+    pages, an import the reverse."""
+    dt = sys.modules.get("ray_tpu.util.device_telemetry")
+    if dt is not None:
+        dt.record_transfer(direction, nbytes, src="kv_handoff",
+                           start=start, end=end)
+
+
 def export_kv(table: BlockTable, *, prompt: List[int],
               generated: List[int], model: str = "base",
               adapter: Optional[str] = None,
@@ -78,6 +90,7 @@ def export_kv(table: BlockTable, *, prompt: List[int],
                          attributes={"direction": "export",
                                      "tokens": table.num_tokens,
                                      "bytes": payload["nbytes"]})
+    _ledger_transfer("d2h", payload["nbytes"], start, end)
     return payload
 
 
@@ -101,6 +114,7 @@ def import_kv(payload: Dict[str, Any],
                          attributes={"direction": "import",
                                      "tokens": table.num_tokens,
                                      "bytes": payload.get("nbytes", 0)})
+    _ledger_transfer("h2d", payload.get("nbytes", 0), start, end)
     return table
 
 

@@ -22,12 +22,19 @@ retry).  The paired-effect checker enforces this at the claim sites.
 
 from __future__ import annotations
 
+import sys
 import threading
 from collections import OrderedDict
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import fault_injection
 from ray_tpu.serve.llm import metrics as _m
+
+
+def _telemetry():
+    """Device-telemetry plane iff loaded (cross-layer probe idiom) — a
+    demotion is a device->host transfer, a promotion the reverse."""
+    return sys.modules.get("ray_tpu.util.device_telemetry")
 
 #: tier names, hottest-to-coldest below the device pool.
 HOST = "host"
@@ -148,6 +155,11 @@ class KVTierManager:
             elif self.object_pages > 0 and n <= self.object_pages:
                 stored = self._put_object_locked(key, pages)
         self._gauges()
+        if stored:
+            dt = _telemetry()
+            if dt is not None:
+                dt.record_transfer("d2h", dt.tree_nbytes(pages),
+                                   src="kv_tier")
         return stored
 
     def _host_occupancy_locked(self) -> int:
@@ -206,6 +218,9 @@ class KVTierManager:
         _m.KV_PROMOTED_PAGES.inc(len(pages), tags={"pool": self.pool,
                                                    "tier": claim.tier})
         self._gauges()
+        dt = _telemetry()
+        if dt is not None:
+            dt.record_transfer("h2d", dt.tree_nbytes(pages), src="kv_tier")
         return pages
 
     def discard(self, key: Key) -> None:

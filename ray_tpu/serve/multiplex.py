@@ -24,10 +24,18 @@ from __future__ import annotations
 
 import asyncio
 import inspect
+import sys
 from collections import OrderedDict
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional
 
 from ray_tpu.util import metrics as _metrics
+
+
+def _telemetry():
+    """Device-telemetry plane iff loaded (cross-layer probe idiom) —
+    resident multiplexed weights are accounted in its ``mux_weights``
+    pool, released on eviction."""
+    return sys.modules.get("ray_tpu.util.device_telemetry")
 
 MODELS_LOADED_GAUGE = _metrics.Gauge(
     "serve_multiplexed_models_loaded",
@@ -78,6 +86,10 @@ class _ModelMultiplexWrapper:
         self._self_arg = self_arg
         self._max = max_num_models_per_replica
         self._models: "OrderedDict[str, Any]" = OrderedDict()
+        #: model id -> bytes charged to the mux_weights pool at load time
+        #: (evictions release exactly what the load charged, even if the
+        #: model object mutated while resident).
+        self._model_bytes: Dict[str, int] = {}
         self._lock = asyncio.Lock()
         self._tags = {"deployment": self._deployment_tag()}
 
@@ -102,6 +114,7 @@ class _ModelMultiplexWrapper:
                 # this replica for the evicted id immediately.
                 self._push_model_ids()
                 MODEL_EVICTIONS.inc(tags=self._tags)
+                self._ledger_evicted(evicted_id)
                 await _run_unload(evicted_id, evicted, self._unload,
                                   self._self_arg)
             args = (self._self_arg, model_id) if self._self_arg is not None \
@@ -111,6 +124,7 @@ class _ModelMultiplexWrapper:
                 model = await model
             self._models[model_id] = model
             MODEL_LOADS.inc(tags=self._tags)
+            self._ledger_loaded(model_id, model)
             self._push_model_ids()
             return model
 
@@ -121,8 +135,25 @@ class _ModelMultiplexWrapper:
                 evicted_id, evicted = self._models.popitem(last=False)
                 self._push_model_ids()
                 MODEL_EVICTIONS.inc(tags=self._tags)
+                self._ledger_evicted(evicted_id)
                 await _run_unload(evicted_id, evicted, self._unload,
                                   self._self_arg)
+
+    def _ledger_loaded(self, model_id: str, model: Any) -> None:
+        dt = _telemetry()
+        if dt is None:
+            return
+        nbytes = dt.tree_nbytes(model)
+        if nbytes:
+            self._model_bytes[model_id] = nbytes
+            dt.pool_add("mux_weights", nbytes)
+
+    def _ledger_evicted(self, model_id: str) -> None:
+        nbytes = self._model_bytes.pop(model_id, 0)
+        if nbytes:
+            dt = _telemetry()
+            if dt is not None:
+                dt.pool_sub("mux_weights", nbytes)
 
     @property
     def loaded_model_ids(self) -> list:

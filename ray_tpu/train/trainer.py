@@ -528,6 +528,17 @@ class DataParallelTrainer:
                 attempt_no += 1
                 run_registry.update_run(run_name, attempts=attempt_no)
         finally:
+            # Device-telemetry rollup for the run row (compile history,
+            # pool high-water, transfer tail) — best-effort, the registry
+            # write must never mask the real exit path.
+            try:
+                from ray_tpu.util import device_telemetry
+
+                run_registry.update_run(
+                    run_name,
+                    device_telemetry=device_telemetry.snapshot())
+            except Exception:
+                pass
             # A raise out of the attempt loop (controller bug, KeyboardInterrupt)
             # must not leave the registry row "running" forever.
             row = run_registry.get_run(run_name)

@@ -102,6 +102,16 @@ def build_bundle(*, window_s: float = 300.0,
     # as util.state.list_train_runs).
     reg = sys.modules.get("ray_tpu.train.run_registry")
     bundle["train_runs"] = reg.list_runs() if reg is not None else []
+    # Device telemetry snapshot (compile registry tail, pool high-water,
+    # transfer window) next to the ring/stacks/heap sections.  Absorbed:
+    # a telemetry failure (incl. the device_telemetry_snapshot chaos
+    # point) must never cost the bundle its other sections.
+    try:
+        from ray_tpu.util import device_telemetry
+
+        bundle["device_telemetry"] = device_telemetry.snapshot(now=t)
+    except Exception:
+        bundle["device_telemetry"] = None
     return bundle
 
 

@@ -90,6 +90,14 @@ SPAN_REGISTRY: Dict[str, str] = {
                    "detection (status ERROR)",
     "forensics.dump": "flight recorder: one postmortem dump, trigger -> "
                       "file written",
+    "xla.compile": "device telemetry: one trace/lower/compile through the "
+                   "instrumented-jit tap (attrs: label, trigger)",
+    "xla.compile_storm": "device telemetry: recompile storm episode, first "
+                         "windowed recompile -> detection (status ERROR)",
+    "device.transfer": "device telemetry: one timed host<->device "
+                       "transfer (attrs: direction, src, bytes)",
+    "device.burn": "device telemetry: one device compute burn (a jitted "
+                   "step / decode execution) in the Perfetto device lane",
 }
 
 

@@ -28,6 +28,7 @@ with ``RAY_TPU_HANG_WATCHDOG=0``; ``tick()`` still works for tests.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 from collections import deque
@@ -178,6 +179,15 @@ class HangWatchdog:
         rec = flight_recorder.get_recorder()
         if rec is not None:
             rec.sample_metric_deltas(now=t)
+        # Drive the recompile-storm detector on the same cadence (probed,
+        # not imported — a process that never loaded the device-telemetry
+        # plane pays one dict miss per tick).
+        telemetry = sys.modules.get("ray_tpu.util.device_telemetry")
+        if telemetry is not None:
+            try:
+                telemetry.storm_tick(now=t)
+            except Exception:
+                pass  # detection is best-effort, same as the loop's ticks
         return new_stalls
 
     def _report_stall(self, stall: dict) -> None:

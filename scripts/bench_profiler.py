@@ -13,8 +13,15 @@ with the span tap installed (every span also lands in the black-box ring)
 vs. tap removed, gate <= 1% — the recorder is always-on, so its cost must
 stay in the noise even at full span volume.
 
+A third A/B phase gates the device-telemetry plane: steps dispatched
+through the instrumented-jit compile tap (per-call abstract-signature
+computation against a warm compile cache) plus one transfer-ledger write
+per step, vs. the bare jitted step.  Gate <= 1% — the tap wraps every
+step function, so its steady-state (zero-compile) cost must stay in the
+noise.
+
 Writes BENCH_PROFILER.json next to the repo root and exits nonzero when
-either gate fails.
+any gate fails.
 
   python scripts/bench_profiler.py                 # tiny config, CPU-ok
   python scripts/bench_profiler.py --config small --steps 40
@@ -93,6 +100,35 @@ def _recorder_times(step, params, opt_state, tokens, targets, n, prof):
     return off, on, rec.events_recorded()
 
 
+def _telemetry_times(step_tel, params, opt_state, tokens, targets, n):
+    """Device-telemetry A/B: both arms execute the SAME compiled
+    executable (two independent XLA compilations of one function can
+    differ by more than the gate, which would read as tap overhead);
+    odd iterations go through the instrumented-jit wrapper on top of it
+    (abstract-signature computation + compile-cache hit — zero compiles
+    in steady state) and ledger one transfer, even iterations call the
+    executable directly.  Same interleaving rationale as above."""
+    from ray_tpu.util import device_telemetry
+
+    # The warmup call left exactly one signature in the wrapper's cache.
+    (compiled,) = step_tel._cache.values()
+    bare, telem = [], []
+    nbytes = int(tokens.size) * 4
+    for i in range(2 * n):
+        with_tel = i % 2 == 1
+        t0 = time.perf_counter()
+        if with_tel:
+            params, opt_state, loss = step_tel(params, opt_state, tokens,
+                                               targets)
+            device_telemetry.record_transfer("h2d", nbytes, src="bench")
+        else:
+            params, opt_state, loss = compiled(params, opt_state, tokens,
+                                               targets)
+        float(loss)  # device sync
+        (telem if with_tel else bare).append(time.perf_counter() - t0)
+    return bare, telem, params, opt_state
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--config", choices=("tiny", "small"), default="tiny")
@@ -100,14 +136,16 @@ def main(argv=None) -> int:
     ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--gate-pct", type=float, default=2.0)
     ap.add_argument("--recorder-gate-pct", type=float, default=1.0)
+    ap.add_argument("--telemetry-gate-pct", type=float, default=1.0)
     args = ap.parse_args(argv)
 
     import jax
     import jax.numpy as jnp
 
+    from ray_tpu._private import jax_compat
     from ray_tpu.models import gpt2
     from ray_tpu.train.profiler import StepProfiler
-    from ray_tpu.util import tracing
+    from ray_tpu.util import device_telemetry, tracing
 
     config = (gpt2.GPTConfig.tiny() if args.config == "tiny"
               else gpt2.GPTConfig.small())
@@ -115,15 +153,19 @@ def main(argv=None) -> int:
     opt = gpt2.make_optimizer()
     params = gpt2.init_params(config, jax.random.key(0))
     opt_state = opt.init(params)
-    step = jax.jit(gpt2.make_train_step(config, opt), donate_argnums=(0, 1))
+    fn = gpt2.make_train_step(config, opt)
+    step = jax.jit(fn, donate_argnums=(0, 1))
+    step_tel = jax_compat.instrumented_jit(fn, label="bench_step",
+                                           donate_argnums=(0, 1))
     rng = np.random.default_rng(0)
     toks = rng.integers(0, config.vocab_size, (B, S + 1), dtype=np.int64)
     t = jnp.asarray(toks, jnp.int32)
     tokens, targets = t[:, :-1], t[:, 1:]
 
-    # Compile + warm outside the measured window.
+    # Compile + warm both dispatch paths outside the measured window.
     for _ in range(3):
         params, opt_state, loss = step(params, opt_state, tokens, targets)
+    params, opt_state, loss = step_tel(params, opt_state, tokens, targets)
     float(loss)
 
     prof = StepProfiler(run_name="bench", rank=0,
@@ -134,11 +176,16 @@ def main(argv=None) -> int:
     try:
         bare, profiled, params, opt_state = _interleaved_times(
             step, params, opt_state, tokens, targets, args.steps, prof)
+        # Telemetry phase runs before the recorder phase: the recorder
+        # loop donates params/opt_state without returning them.
+        tel_off, tel_on, params, opt_state = _telemetry_times(
+            step_tel, params, opt_state, tokens, targets, args.steps)
         rec_off, rec_on, ring_events = _recorder_times(
             step, params, opt_state, tokens, targets, args.steps, prof)
     finally:
         tracing.disable_tracing()
         tracing.clear_spans()
+        device_telemetry.reset()
 
     med_bare = statistics.median(bare)
     med_prof = statistics.median(profiled)
@@ -146,6 +193,10 @@ def main(argv=None) -> int:
     med_rec_off = statistics.median(rec_off)
     med_rec_on = statistics.median(rec_on)
     recorder_overhead_pct = (med_rec_on - med_rec_off) / med_rec_off * 100.0
+    med_tel_off = statistics.median(tel_off)
+    med_tel_on = statistics.median(tel_on)
+    device_telemetry_overhead_pct = \
+        (med_tel_on - med_tel_off) / med_tel_off * 100.0
 
     # Attribution invariant: buckets + compute == wall on every row.
     rows = list(prof.history)
@@ -172,8 +223,15 @@ def main(argv=None) -> int:
         "recorder_overhead_pct": round(recorder_overhead_pct, 3),
         "recorder_gate_pct": args.recorder_gate_pct,
         "recorder_ring_events": ring_events,
+        "median_step_ms_telemetry_off": round(med_tel_off * 1e3, 4),
+        "median_step_ms_telemetry_on": round(med_tel_on * 1e3, 4),
+        "device_telemetry_overhead_pct": round(
+            device_telemetry_overhead_pct, 3),
+        "device_telemetry_gate_pct": args.telemetry_gate_pct,
         "passed": (overhead_pct <= args.gate_pct and max_err < 1e-9
-                   and recorder_overhead_pct <= args.recorder_gate_pct),
+                   and recorder_overhead_pct <= args.recorder_gate_pct
+                   and device_telemetry_overhead_pct
+                   <= args.telemetry_gate_pct),
     }
     with open(OUT, "w") as f:
         json.dump(result, f, indent=2)
@@ -182,12 +240,16 @@ def main(argv=None) -> int:
     if not result["passed"]:
         print(f"FAIL: overhead {overhead_pct:.2f}% > gate {args.gate_pct}%, "
               f"recorder overhead {recorder_overhead_pct:.2f}% > gate "
-              f"{args.recorder_gate_pct}%, or attribution drift "
+              f"{args.recorder_gate_pct}%, telemetry overhead "
+              f"{device_telemetry_overhead_pct:.2f}% > gate "
+              f"{args.telemetry_gate_pct}%, or attribution drift "
               f"{max_err:.2e}", file=sys.stderr)
         return 1
     print(f"OK: profiler overhead {overhead_pct:+.2f}% "
           f"(gate {args.gate_pct}%), recorder overhead "
-          f"{recorder_overhead_pct:+.2f}% (gate {args.recorder_gate_pct}%)",
+          f"{recorder_overhead_pct:+.2f}% (gate {args.recorder_gate_pct}%), "
+          f"telemetry overhead {device_telemetry_overhead_pct:+.2f}% "
+          f"(gate {args.telemetry_gate_pct}%)",
           flush=True)
     return 0
 

@@ -65,12 +65,19 @@ def _build_config(args):
 # --------------------------------------------------------------------- step
 def run_step_mode(args) -> None:
     """Profiler-driven: the numbers here are the ones a Trainer run
-    exports live as ray_tpu_train_* gauges — same code path."""
+    exports live as ray_tpu_train_* gauges — same code path.  The step
+    is dispatched through the instrumented-jit compile tap, so the run
+    also exercises the device-telemetry plane: exactly one first-compile
+    should land in ``device_telemetry.compile_records()`` and each
+    profiled step is marked as a ``device.burn`` interval (visible on
+    the Perfetto "device" lane when tracing is enabled)."""
     import jax
     import jax.numpy as jnp
 
+    from ray_tpu._private import jax_compat
     from ray_tpu.models import gpt2
     from ray_tpu.train import profiler as train_profiler
+    from ray_tpu.util import device_telemetry
 
     config = _build_config(args)
     devices = jax.devices()
@@ -82,7 +89,9 @@ def run_step_mode(args) -> None:
     opt = gpt2.make_optimizer(learning_rate=3e-4)
     params = gpt2.init_params(config, jax.random.key(0))
     opt_state = opt.init(params)
-    step = jax.jit(gpt2.make_train_step(config, opt), donate_argnums=(0, 1))
+    step = jax_compat.instrumented_jit(gpt2.make_train_step(config, opt),
+                                       label="train_step",
+                                       donate_argnums=(0, 1))
 
     rng = np.random.default_rng(0)
     toks = rng.integers(0, config.vocab_size, (B, S + 1), dtype=np.int64)
@@ -103,7 +112,9 @@ def run_step_mode(args) -> None:
             w0 = time.time()
             params, opt_state, loss = step(params, opt_state, tokens, targets)
             float(loss)  # device sync = the step's true end
-            del w0  # batch stays device-resident: no h2d to attribute
+            # Batch stays device-resident (no h2d to attribute); the
+            # whole interval is device burn.
+            device_telemetry.record_burn("train_step", w0, time.time())
             prof.step_boundary()
     finally:
         train_profiler.activate(None)
@@ -129,6 +140,10 @@ def run_step_mode(args) -> None:
                                   "ckpt_block", "compute"))
     print(f"    {'sum':10s} {total*1e3:8.2f} ms  "
           f"(wall {last['wall']*1e3:.2f} ms)", flush=True)
+    compiles = device_telemetry.compile_records("train_step")
+    print(f"  xla compiles: {len(compiles)} "
+          f"({', '.join(c['trigger'] for c in compiles) or 'none'})",
+          flush=True)
     print(f"  final loss {float(loss):.3f}", flush=True)
 
 

@@ -84,6 +84,13 @@ def _cmd_bundle(perfetto: str | None) -> int:
         print(f"wrote {len(events)} timeline events from "
               f"{len(bundle['dumps'])} dumps to {perfetto} "
               f"(open at ui.perfetto.dev)")
+        dt = bundle.get("device_telemetry") or {}
+        if dt:
+            totals = (dt.get("compiles") or {}).get("totals", {})
+            pools = sorted(dt.get("pools") or {})
+            print(f"device telemetry: {totals.get('compiles', 0)} compiles, "
+                  f"{totals.get('storms', 0)} storm(s), pools: "
+                  f"{', '.join(pools) if pools else 'none'}")
     else:
         json.dump(bundle, sys.stdout, indent=2, default=str)
         print()
