@@ -101,6 +101,10 @@ FAULT_POINTS: Dict[str, str] = {
                                  "serve.status, run registry) absorbs a "
                                  "telemetry failure rather than worsening "
                                  "the event being observed",
+    # cluster autoscaler (tests/test_cluster_autoscaler.py)
+    "cluster_autoscale": "cluster-autoscaler actuation (target change or "
+                         "quarantine) — consulted BEFORE acting; an "
+                         "injected failure leaves the cluster untouched",
 }
 
 

@@ -1957,6 +1957,9 @@ class Runtime:
                 "name": spec.name or "",
                 "class": getattr(spec, "class_name", "") or "",
                 "cause": str(cause),
+                # Node attribution: the cluster autoscaler's health gate
+                # keys postmortems on the node that produced them.
+                "node": str(state.node_id) if state.node_id else "",
             })
 
     def _drain_mailbox(self, state: _ActorState) -> None:

@@ -60,6 +60,11 @@ class Node:
         self.available: Resources = dict(resources)
         self.labels = labels or {}
         self.alive = True
+        #: Quarantine/scale-down drain: an alive node that accepts NO new
+        #: placements (tasks, actors, PG bundles) while existing leases
+        #: finish — set via ClusterScheduler.set_node_draining by the
+        #: cluster autoscaler's postmortem health gate.
+        self.draining = False
         self.start_time = time.time()
         #: Last time a lease touched this node (autoscaler idle detection).
         self.last_busy = time.time()
@@ -76,10 +81,16 @@ class Node:
         return {
             "NodeID": self.id,
             "Alive": self.alive,
+            "Draining": self.draining,
             "Resources": dict(self.total),
             "Available": dict(self.available),
             "Labels": dict(self.labels),
         }
+
+    @property
+    def schedulable(self) -> bool:
+        """Placement eligibility: alive and not draining."""
+        return self.alive and not self.draining
 
 
 class SchedulingStrategy:
@@ -192,6 +203,22 @@ class ClusterScheduler:
             node = self._nodes.pop(node_id, None)
             if node:
                 node.alive = False
+
+    def set_node_draining(self, node_id, draining: bool = True) -> bool:
+        """Mark a node draining (no NEW placements; existing leases run to
+        completion) — the cluster autoscaler's quarantine/drain primitive.
+        Accepts a NodeID or its string form; returns False for an unknown
+        node (already removed — the drain raced a termination, fine)."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                wanted = str(node_id)
+                node = next((n for nid, n in self._nodes.items()
+                             if str(nid) == wanted), None)
+            if node is None:
+                return False
+            node.draining = bool(draining)
+        return True
 
     def nodes(self) -> List[Node]:
         with self._lock:
@@ -321,7 +348,8 @@ class ClusterScheduler:
                 return False
             bundles = pg.bundles if strategy.bundle_index < 0 else [pg.bundles[strategy.bundle_index]]
             return any(res_fits(b.resources, request) for b in bundles)
-        if any(res_fits(n.total, request) for n in self._nodes.values() if n.alive):
+        if any(res_fits(n.total, request)
+               for n in self._nodes.values() if n.schedulable):
             return True
         # A node the autoscaler could launch also counts as feasible.
         return self.autoscaling_enabled and any(
@@ -339,13 +367,15 @@ class ClusterScheduler:
             res_sub(bundle.available, request)
             return bundle.node_id
 
-        feasible = [n for n in self._nodes.values() if n.alive and res_fits(n.available, request)]
+        feasible = [n for n in self._nodes.values()
+                    if n.schedulable and res_fits(n.available, request)]
         if not feasible:
             return None
 
         if isinstance(strategy, NodeAffinitySchedulingStrategy):
             node = self._nodes.get(strategy.node_id)
-            if node is not None and node.alive and res_fits(node.available, request):
+            if node is not None and node.schedulable \
+                    and res_fits(node.available, request):
                 res_sub(node.available, request)
                 return node.id
             if not strategy.soft:
@@ -423,7 +453,7 @@ class ClusterScheduler:
         return True
 
     def _plan_bundles_locked(self, pg: PlacementGroupState):
-        nodes = [n for n in self._nodes.values() if n.alive]
+        nodes = [n for n in self._nodes.values() if n.schedulable]
         if not nodes:
             return None
         scratch = {n.id: dict(n.available) for n in nodes}

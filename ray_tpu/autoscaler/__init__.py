@@ -21,11 +21,21 @@ from ray_tpu.autoscaler.node_provider import (
     SubprocessNodeProvider,
     TPUPodProvider,
 )
+from ray_tpu.autoscaler.policy import (
+    ClusterAutoscaler,
+    ClusterPolicyConfig,
+    QuarantineTracker,
+)
+from ray_tpu.autoscaler.signals import (
+    ClusterSignals,
+    SignalCollector,
+)
 
 __all__ = [
     "Autoscaler", "AutoscalerConfig", "Monitor", "NodeTypeConfig",
     "NodeProvider", "FakeNodeProvider", "SubprocessNodeProvider",
     "TPUPodProvider", "Instance", "InstanceManager", "InstanceState",
     "InstanceStorage", "capacity_available", "simulate_preemption",
-    "worker_capacity",
+    "worker_capacity", "ClusterAutoscaler", "ClusterPolicyConfig",
+    "QuarantineTracker", "ClusterSignals", "SignalCollector",
 ]

@@ -44,6 +44,11 @@ class NodeTypeConfig:
     min_workers: int = 0
     max_workers: int = 10
     labels: Dict[str, str] = field(default_factory=dict)
+    #: Cheap/interruptible capacity (the policy layer routes train-driven
+    #: signals here and serve-driven signals to protected types; the
+    #: elastic controller (PR 6) already survives losing these nodes).
+    #: Stamped onto launched nodes as a ``preemptible`` label.
+    preemptible: bool = False
 
 
 @dataclass
@@ -80,6 +85,12 @@ class Autoscaler:
                 GLOBAL_CONFIG.session_dir,
                 f"autoscaler-{config.cluster_name}-instances.json")
         self.im = InstanceManager(InstanceStorage(storage_path))
+        #: Per-type node-count targets set by the policy layer
+        #: (policy.ClusterAutoscaler).  A type with a target launches up
+        #: to it and releases *idle* nodes above it without waiting for
+        #: idle_timeout_s (the policy's hysteresis already provided the
+        #: delay); a type without one keeps the pure demand/idle behavior.
+        self.target_counts: Dict[str, int] = {}
         #: Serializes update()/_launch: the stale-REQUESTED sweep assumes
         #: no create_node is in flight, which only holds when reconcile
         #: passes (Monitor thread + any direct caller) are mutually
@@ -166,6 +177,24 @@ class Autoscaler:
                 if pid:
                     launched.append(pid)
 
+        # 2b. Policy targets: launch up to each type's target count
+        # (bounded by max_workers and the cluster-wide cap like any other
+        # launch; static demand below remains the floor on top).
+        counts = self.im.active_counts()
+        for type_name, target in self.target_counts.items():
+            cfg = self.config.node_types.get(type_name)
+            if cfg is None:
+                continue
+            want = min(target, cfg.max_workers) - counts.get(type_name, 0)
+            for _ in range(want):
+                if self._at_total_cap() or \
+                        len(launched) >= self.config.max_launches_per_round:
+                    break
+                pid = self._launch(type_name)
+                if pid:
+                    launched.append(pid)
+                    counts[type_name] = counts.get(type_name, 0) + 1
+
         # 3. Unmet demand -> more nodes (simple first-fit-decreasing binpack
         # onto hypothetical new nodes, the v2 scheduler.py role).
         demand = list(self.scheduler.pending_demand())
@@ -198,9 +227,19 @@ class Autoscaler:
                 continue
             busy = any(node.available.get(k, 0.0) < v
                        for k, v in node.total.items())
-            if not busy and now - node.last_busy > self.config.idle_timeout_s:
-                self.im.transition(inst, InstanceState.TERMINATING,
-                                   f"idle > {self.config.idle_timeout_s}s")
+            if busy:
+                continue
+            # A policy target below the active count releases idle nodes
+            # immediately — the policy's hysteresis already waited — but
+            # NEVER a busy one: scale-down drains by attrition, not kill.
+            target = self.target_counts.get(inst.node_type)
+            over_target = (target is not None
+                           and counts.get(inst.node_type, 0) > target)
+            if over_target or now - node.last_busy > self.config.idle_timeout_s:
+                self.im.transition(
+                    inst, InstanceState.TERMINATING,
+                    "over policy target" if over_target
+                    else f"idle > {self.config.idle_timeout_s}s")
                 counts[inst.node_type] -= 1
         # TERMINATING instances (this pass's AND earlier stuck ones): call
         # the provider; a failed call stays TERMINATING so the NEXT pass
@@ -233,9 +272,12 @@ class Autoscaler:
     def _launch_locked(self, type_name: str) -> Optional[str]:
         cfg = self.config.node_types[type_name]
         inst = self.im.request(type_name)
+        labels = dict(cfg.labels)
+        if cfg.preemptible:
+            labels["preemptible"] = "true"
         try:
             pid = self.provider.create_node(type_name, dict(cfg.resources),
-                                            dict(cfg.labels))
+                                            labels)
         except Exception as e:  # noqa: BLE001 — tracked per instance
             self.im.transition(inst, InstanceState.ALLOCATION_FAILED,
                                f"create_node: {e!r}")
@@ -307,7 +349,13 @@ class Monitor:
         return self
 
     def _run(self) -> None:
+        from ray_tpu.util import watchdog
+
         while not self._stop.wait(self.interval_s):
+            # Beat BEFORE the pass: a reconcile wedged on a hung provider
+            # goes beat-quiet, which is exactly what the hang watchdog's
+            # flight-recorder dump should catch.
+            watchdog.beat("cluster.monitor")
             try:
                 self.autoscaler.update()
             except Exception:  # reconcile must survive transient errors
@@ -316,7 +364,15 @@ class Monitor:
                 traceback.print_exc()
 
     def stop(self) -> None:
+        """Idempotent shutdown: join the tick thread (no reconcile pass —
+        and therefore no launch — survives the return) and retire the
+        monitor's watchdog source so a stopped monitor is not flagged as
+        a hang."""
+        from ray_tpu.util import watchdog
+
         self._stop.set()
-        self._thread.join(timeout=5)
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+        watchdog.forget("cluster.monitor")
         self.autoscaler.scheduler.autoscaling_enabled = False
         self.autoscaler.scheduler.autoscaler_node_shapes = []

@@ -1,0 +1,59 @@
+"""Cluster-autoscaler metrics.
+
+Declared at import time like the serve/train/ingest metric modules so
+``scripts/check_metrics.py`` lints them; exported on ``/metrics`` through
+the process registry (util/metrics.py).
+
+The anchor set mirrors the reference's autoscaler dashboards: what the
+policy decided and why (decisions by reason), what it actuated (node
+launches/terminations by type), where the cluster sits against its
+targets (target vs active node gauges), and the health gate
+(quarantined nodes, postmortems consumed).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.util.metrics import Counter, Gauge
+
+DECISIONS = Counter(
+    "ray_tpu_cluster_autoscale_decisions_total",
+    "Cluster autoscale decisions applied, held, or rejected, by node type "
+    "and outcome reason",
+    tag_keys=("node_type", "reason"))
+
+SCALE_UP = Counter(
+    "ray_tpu_cluster_autoscale_scale_up_total",
+    "Applied node-count target increases per node type",
+    tag_keys=("node_type",))
+
+SCALE_DOWN = Counter(
+    "ray_tpu_cluster_autoscale_scale_down_total",
+    "Applied node-count target decreases per node type",
+    tag_keys=("node_type",))
+
+TARGET_NODES = Gauge(
+    "ray_tpu_cluster_target_nodes",
+    "Current policy-set node-count target per node type",
+    tag_keys=("node_type",))
+
+ACTIVE_NODES = Gauge(
+    "ray_tpu_cluster_active_nodes",
+    "Active (requested/allocated/running) instances per node type, as "
+    "observed at the last cluster-autoscaler tick",
+    tag_keys=("node_type",))
+
+QUARANTINED_NODES = Gauge(
+    "ray_tpu_cluster_quarantined_nodes",
+    "Nodes currently quarantined by the postmortem health gate (drained, "
+    "excluded from placement, never refilled)")
+
+QUARANTINES = Counter(
+    "ray_tpu_cluster_quarantines_total",
+    "Nodes quarantined after repeated crash/stall postmortems, by the "
+    "postmortem reason that tipped the threshold",
+    tag_keys=("reason",))
+
+POSTMORTEMS_SEEN = Counter(
+    "ray_tpu_cluster_health_postmortems_total",
+    "Crash/stall postmortem rows consumed by the cluster health gate "
+    "(node-attributed rows only)")

@@ -28,6 +28,11 @@ class NodeProvider:
         raise NotImplementedError
 
     def terminate_node(self, provider_node_id: str) -> None:
+        """Terminate one node.  MUST be idempotent: terminating an
+        already-terminated (or never-seen) id is a no-op, never a
+        KeyError — the quarantine path and the reconciler's leaked-node
+        sweep can race to terminate the same node, and the loser of that
+        race must not crash the reconcile pass."""
         raise NotImplementedError
 
     def non_terminated_nodes(self) -> List[str]:

@@ -1,0 +1,353 @@
+"""Signal-driven cluster autoscaling policy (node tier).
+
+The deciding half of the cluster control loop (signals.py senses,
+``Autoscaler`` actuates): :class:`ClusterAutoscaler` wraps the
+reconciler-shaped :class:`~ray_tpu.autoscaler.autoscaler.Autoscaler` and
+composes per-node-type node-count targets from one
+:class:`~ray_tpu.autoscaler.signals.ClusterSignals` snapshot — the PR 18
+replica-tier policy pattern lifted to nodes (ref: the reference's
+monitor.py + resource_demand_scheduler load-metrics path):
+
+- **serve-driven** (non-preemptible "protected" types): windowed request
+  rate vs ``serve_qps_per_node``, router in-flight depth vs
+  ``serve_inflight_per_node``; SLO burn alerting multiplies the target
+  and bypasses the upscale hysteresis delay (never the cooldown).
+- **train-driven** (``preemptible`` types — cheap capacity the elastic
+  controller already survives losing, PR 6): data-starved fraction over
+  threshold adds a node; pending ingest shards vs ``shards_per_node``
+  sizes the reader fleet.
+- **static demand floor**: blocked resource requests keep flowing
+  through the wrapped autoscaler's bin-packing unchanged — the policy
+  only ever raises targets above that floor or releases *idle* capacity
+  back down to it.
+
+Per-type asymmetric hysteresis (``upscale_delay_s`` /
+``downscale_delay_s``) and per-direction cooldowns; scale-down steps one
+node per decision.  All state is keyed on the signal snapshot's ``now``
+so the layer is deterministic under test.
+
+**Postmortem health gate**: node-attributed crash/stall postmortems from
+the forensics stream feed a :class:`QuarantineTracker`; a node that
+produces ``quarantine_postmortems`` of them inside
+``quarantine_window_s`` is quarantined — drained in the scheduler
+(excluded from placement), its instance terminated, and its node type's
+worker caps permanently shrunk by one so the slot is never refilled —
+instead of being relaunched into the same crash loop.
+
+The ``cluster_autoscale`` fault point is consulted BEFORE every
+actuation (target change or quarantine): an injected decision failure
+leaves the cluster untouched.  Every applied change is recorded as
+``ray_tpu_cluster_*`` metrics plus a flight-recorder row, under a
+``cluster.autoscale`` span per tick.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import fault_injection
+from ray_tpu.autoscaler import metrics as _metrics
+from ray_tpu.autoscaler.autoscaler import Autoscaler
+from ray_tpu.autoscaler.signals import (ClusterSignals, SignalCollector)
+from ray_tpu.util import tracing
+
+
+@dataclass
+class ClusterPolicyConfig:
+    """Knobs for the signal-composed policy (per cluster, applied to every
+    node type; signal→type routing comes from ``NodeTypeConfig.preemptible``).
+    A per-node capacity knob left at 0 disables that signal."""
+
+    #: Serve request rate one protected node is expected to absorb.
+    serve_qps_per_node: float = 0.0
+    #: Mean router in-flight depth one protected node is expected to hold.
+    serve_inflight_per_node: float = 0.0
+    #: SLO burn multiplies the protected target by this (and bypasses the
+    #: upscale hysteresis delay, never the cooldown).
+    burn_upscale_factor: float = 1.5
+    #: Data-starved fraction above this adds one preemptible node.
+    starved_fraction_threshold: float = 0.25
+    #: Pending ingest shards one preemptible node is expected to drain.
+    shards_per_node: float = 0.0
+    upscale_delay_s: float = 5.0
+    downscale_delay_s: float = 60.0
+    upscale_cooldown_s: float = 10.0
+    downscale_cooldown_s: float = 60.0
+    #: Health gate: this many node-attributed crash/stall postmortems
+    #: inside the window quarantines the node.
+    quarantine_postmortems: int = 3
+    quarantine_window_s: float = 600.0
+    #: Trailing window the signal collector queries.
+    signal_window_s: float = 60.0
+
+
+@dataclass
+class Decision:
+    node_type: str
+    target: int
+    reason: str
+    changed: bool
+
+
+class _TypeState:
+    """Per-node-type hysteresis/cooldown state (the serve
+    DeploymentAutoscaler state machine, one per node type)."""
+
+    def __init__(self) -> None:
+        self.above_since = -1.0
+        self.below_since = -1.0
+        self.last_up_at = -math.inf
+        self.last_down_at = -math.inf
+
+
+class QuarantineTracker:
+    """Counts node-attributed health postmortems and decides quarantine.
+
+    Dump files are keyed ``{pid}-{reason}.json`` so a crash-looping
+    process OVERWRITES its own dump — a known id reappearing with a newer
+    ``ts`` is a fresh postmortem, which is why events are tracked as
+    (id, ts) pairs rather than ids."""
+
+    def __init__(self, threshold: int = 3, window_s: float = 600.0):
+        self.threshold = max(1, int(threshold))
+        self.window_s = window_s
+        #: node -> [(dump_id, ts)] health events seen (window-pruned).
+        self._events: Dict[str, List[Tuple[str, float]]] = {}
+        self._last_ts: Dict[str, float] = {}  # dump id -> last seen ts
+        self.quarantined: Dict[str, str] = {}  # node -> tipping reason
+
+    def observe(self, postmortems: List[Dict[str, Any]],
+                now: float) -> List[Tuple[str, str]]:
+        """Fold one batch of forensics rows in; returns newly quarantined
+        ``(node, reason)`` pairs."""
+        new: List[Tuple[str, str]] = []
+        for row in postmortems:
+            ts = float(row.get("ts") or 0.0)
+            dump_id = str(row["id"])
+            if self._last_ts.get(dump_id) == ts:
+                continue  # same dump observed again, not a new event
+            self._last_ts[dump_id] = ts
+            node = str(row["node"])
+            _metrics.POSTMORTEMS_SEEN.inc(1)
+            self._events.setdefault(node, []).append((dump_id, ts))
+            if node in self.quarantined:
+                continue
+            events = [e for e in self._events[node]
+                      if now - e[1] <= self.window_s]
+            self._events[node] = events
+            if len(events) >= self.threshold:
+                reason = str(row.get("reason") or "unknown")
+                self.quarantined[node] = reason
+                new.append((node, reason))
+        _metrics.QUARANTINED_NODES.set(len(self.quarantined))
+        return new
+
+
+class ClusterAutoscaler:
+    """Signal-composed node-count targets + postmortem quarantine around a
+    wrapped :class:`Autoscaler`.  ``tick()`` is the whole loop: collect →
+    health gate → per-type decide → fault-gated actuate → reconcile."""
+
+    def __init__(self, autoscaler: Autoscaler,
+                 policy: Optional[ClusterPolicyConfig] = None,
+                 collector: Optional[SignalCollector] = None):
+        self.autoscaler = autoscaler
+        self.policy = policy or ClusterPolicyConfig()
+        self.collector = collector or SignalCollector(
+            scheduler=autoscaler.scheduler,
+            window_s=self.policy.signal_window_s)
+        self.quarantine = QuarantineTracker(
+            self.policy.quarantine_postmortems,
+            self.policy.quarantine_window_s)
+        self._state: Dict[str, _TypeState] = {
+            t: _TypeState() for t in autoscaler.config.node_types}
+        self.last_decisions: List[Decision] = []
+
+    # ------------------------------------------------------------- policies
+    def _signal_desired(self, node_type: str, sig: ClusterSignals,
+                        active: int) -> Tuple[int, str]:
+        """(desired node count, driving reason) for one type from the
+        windowed signals alone — the static-demand floor stays with the
+        wrapped autoscaler's binpack."""
+        cfg = self.autoscaler.config.node_types[node_type]
+        pol = self.policy
+        desired, reason = 0, "steady"
+        if getattr(cfg, "preemptible", False):
+            # Train-driven: cheap capacity for elastic training readers.
+            if pol.shards_per_node > 0 and sig.pending_ingest_shards > 0:
+                d = math.ceil(sig.pending_ingest_shards / pol.shards_per_node)
+                if d > desired:
+                    desired, reason = d, "pending_shards"
+            if sig.train_data_starved_fraction \
+                    >= pol.starved_fraction_threshold:
+                d = active + 1
+                if d > desired:
+                    desired, reason = d, "data_starved"
+        else:
+            # Serve-driven: protected capacity, never preempted for cost.
+            if pol.serve_qps_per_node > 0:
+                d = math.ceil(sig.serve_request_rate / pol.serve_qps_per_node)
+                if d > desired:
+                    desired, reason = d, "request_rate"
+            if pol.serve_inflight_per_node > 0:
+                d = math.ceil(sig.serve_inflight / pol.serve_inflight_per_node)
+                if d > desired:
+                    desired, reason = d, "queue_depth"
+            if sig.slo_burn_alerting:
+                d = max(active + 1,
+                        math.ceil(active * pol.burn_upscale_factor))
+                if d > desired:
+                    desired, reason = d, "slo_burn"
+        desired = min(max(desired, cfg.min_workers), cfg.max_workers)
+        return desired, reason
+
+    def _decide(self, node_type: str, sig: ClusterSignals,
+                active: int, target: int) -> Decision:
+        pol, st, now = self.policy, self._state[node_type], sig.now
+        desired, reason = self._signal_desired(node_type, sig, active)
+        if desired > target:
+            st.below_since = -1.0
+            if st.above_since < 0:
+                st.above_since = now
+            # Burn bypasses the hysteresis delay, never the cooldown.
+            ready = (reason == "slo_burn"
+                     or now - st.above_since >= pol.upscale_delay_s)
+            if ready and now - st.last_up_at >= pol.upscale_cooldown_s:
+                st.above_since = -1.0
+                st.last_up_at = now
+                return Decision(node_type, desired, reason, True)
+            return Decision(node_type, target, f"pending_up:{reason}", False)
+        st.above_since = -1.0
+        if desired < target:
+            if not sig.slo_burn_quiet and not getattr(
+                    self.autoscaler.config.node_types[node_type],
+                    "preemptible", False):
+                # Protected capacity only comes down once every SLO
+                # window of every objective is quiet.
+                st.below_since = -1.0
+                return Decision(node_type, target, "hold_burn_not_quiet",
+                                False)
+            if st.below_since < 0:
+                st.below_since = now
+            # Step down one node per decision: releases are cheap to
+            # repeat, mass shrinks race the elastic controller's redeploy.
+            new = max(target - 1, desired)
+            if now - st.below_since >= pol.downscale_delay_s \
+                    and now - st.last_down_at >= pol.downscale_cooldown_s:
+                st.below_since = -1.0
+                st.last_down_at = now
+                return Decision(node_type, new, "scale_down", True)
+            return Decision(node_type, target, "pending_down", False)
+        st.below_since = -1.0
+        return Decision(node_type, target, "steady", False)
+
+    # ---------------------------------------------------------- quarantine
+    def _quarantine_node(self, node: str, reason: str) -> None:
+        """Drain, terminate, and permanently retire one node's slot."""
+        from ray_tpu.autoscaler.instance_manager import InstanceState
+
+        sched = self.autoscaler.scheduler
+        if hasattr(sched, "set_node_draining"):
+            sched.set_node_draining(node, True)
+        inst = next(
+            (i for i in self.autoscaler.im.instances(
+                InstanceState.RUNNING, InstanceState.ALLOCATED)
+             if str(i.scheduler_node_id) == node), None)
+        if inst is not None:
+            self.autoscaler.im.transition(
+                inst, InstanceState.TERMINATING,
+                f"quarantined: {reason}")
+            cfg = self.autoscaler.config.node_types.get(inst.node_type)
+            if cfg is not None:
+                # Never refilled: the slot leaves the type's caps for
+                # good — relaunching into the same crash loop is the
+                # failure mode this gate exists to break.
+                cfg.max_workers = max(0, cfg.max_workers - 1)
+                cfg.min_workers = min(cfg.min_workers, cfg.max_workers)
+                tc = self.autoscaler.target_counts
+                if inst.node_type in tc:
+                    tc[inst.node_type] = min(tc[inst.node_type],
+                                             cfg.max_workers)
+        _metrics.QUARANTINES.inc(1, tags={"reason": reason})
+        from ray_tpu.util import flight_recorder
+        flight_recorder.record_event(
+            "cluster.quarantine",
+            {"node": node, "reason": reason,
+             "node_type": inst.node_type if inst else None},
+            kind="autoscale")
+
+    # ----------------------------------------------------------------- tick
+    def tick(self, now: Optional[float] = None,
+             signals: Optional[ClusterSignals] = None) -> dict:
+        """One control pass: sense, gate health, decide, actuate,
+        reconcile.  Returns the wrapped autoscaler's reconcile summary
+        plus this layer's decisions."""
+        with tracing.span("cluster.autoscale"):
+            sig = signals if signals is not None \
+                else self.collector.collect(now=now)
+            return self._tick(sig)
+
+    def _tick(self, sig: ClusterSignals) -> dict:
+        decisions: List[Decision] = []
+        quarantined: List[str] = []
+        # 1. Health gate first: a node being quarantined this pass must
+        # not be counted as healthy capacity by the decisions below.
+        for node, reason in self.quarantine.observe(sig.postmortems,
+                                                    sig.now):
+            try:
+                fault_injection.check("cluster_autoscale")
+            except Exception:  # noqa: BLE001 — injected: leave untouched
+                self.quarantine.quarantined.pop(node, None)
+                _metrics.DECISIONS.inc(1, tags={"node_type": "-",
+                                                "reason": "fault_injected"})
+                continue
+            self._quarantine_node(node, reason)
+            quarantined.append(node)
+        # 2. Per-type signal policy.
+        counts = self.autoscaler.im.active_counts()
+        for node_type in self.autoscaler.config.node_types:
+            self._state.setdefault(node_type, _TypeState())
+            active = counts.get(node_type, 0)
+            target = self.autoscaler.target_counts.get(node_type, active)
+            decision = self._decide(node_type, sig, active, target)
+            decisions.append(decision)
+            _metrics.ACTIVE_NODES.set(active, tags={"node_type": node_type})
+            if not decision.changed:
+                _metrics.DECISIONS.inc(1, tags={"node_type": node_type,
+                                                "reason": decision.reason})
+                continue
+            try:
+                fault_injection.check("cluster_autoscale")
+            except Exception:  # noqa: BLE001 — injected: target unchanged
+                _metrics.DECISIONS.inc(1, tags={"node_type": node_type,
+                                                "reason": "fault_injected"})
+                continue
+            self._apply(node_type, target, decision)
+        self.last_decisions = decisions
+        # 3. Reconcile: the wrapped autoscaler launches/terminates toward
+        # the new targets (plus its own static-demand floor) in the same
+        # pass, so a tick is sense->act, not sense->wait-for-monitor.
+        result = self.autoscaler.update()
+        result["decisions"] = [(d.node_type, d.target, d.reason)
+                               for d in decisions if d.changed]
+        result["quarantined"] = quarantined
+        return result
+
+    def _apply(self, node_type: str, old: int, decision: Decision) -> None:
+        self.autoscaler.target_counts[node_type] = decision.target
+        _metrics.DECISIONS.inc(1, tags={"node_type": node_type,
+                                        "reason": decision.reason})
+        if decision.target > old:
+            _metrics.SCALE_UP.inc(1, tags={"node_type": node_type})
+        else:
+            _metrics.SCALE_DOWN.inc(1, tags={"node_type": node_type})
+        _metrics.TARGET_NODES.set(decision.target,
+                                  tags={"node_type": node_type})
+        from ray_tpu.util import flight_recorder
+        flight_recorder.record_event(
+            "cluster.autoscale",
+            {"node_type": node_type, "from": old, "to": decision.target,
+             "reason": decision.reason},
+            kind="autoscale")

@@ -1,0 +1,147 @@
+"""Cluster-signal collection for the node-tier autoscaler.
+
+The sensing half of the cluster control loop (policy.py is the deciding
+half): one :meth:`SignalCollector.collect` call snapshots every windowed
+signal the :class:`~ray_tpu.autoscaler.policy.ClusterAutoscaler` composes
+into node-count targets —
+
+- **serve load**: cluster-wide request rate and mean router in-flight
+  depth from the head :class:`~ray_tpu.util.metrics_agent
+  .TimeSeriesAggregator` (the PR 12 accessors' rollup: subset-tag
+  queries sum counters across deployments and average gauges), plus the
+  SLO burn watchdog's alert state.
+- **train pressure**: the data-starved fraction gauge and the count of
+  unclaimed ingest shards across live streaming-ingest runs.
+- **static demand**: the scheduler's blocked resource requests and
+  pending placement-group bundles — the floor the pre-existing
+  bin-packing autoscaler already serves.
+- **health**: node-attributed crash/stall postmortem rows from the
+  forensics stream, the quarantine gate's input.
+
+Cross-layer reads probe ``sys.modules`` instead of importing (the
+util.state idiom): an autoscaler in a cluster that never imported serve
+or train must not drag those packages in just to read zeros.  All
+queries are keyed on the caller-supplied ``now`` so the layer is
+deterministic under test.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+#: Postmortem reasons that count against a node's health.  Deliberate
+#: dumps (user trigger_dump, SIGUSR1 debugging) must not quarantine the
+#: node they ran on.
+HEALTH_REASONS = ("actor_death", "task_stall", "hang", "stall", "crash",
+                  "worker_death", "node_death")
+
+
+@dataclass
+class ClusterSignals:
+    """One sensing snapshot, all fields explicit so unit tests drive the
+    policy with synthetic inputs (the serve PolicyInputs pattern)."""
+
+    now: float
+    #: Cluster-wide serve request rate (req/s) over the window.
+    serve_request_rate: float = 0.0
+    #: Mean in-flight requests across routers over the window.
+    serve_inflight: float = 0.0
+    #: Any serve deployment's SLO fast-window burn is alerting.
+    slo_burn_alerting: bool = False
+    #: Every window of every objective is under threshold.
+    slo_burn_quiet: bool = True
+    #: Fraction of recent step time the training loop spent data-starved.
+    train_data_starved_fraction: float = 0.0
+    #: Source shards not yet claimed by any reader across live ingests.
+    pending_ingest_shards: int = 0
+    #: Blocked resource requests + pending PG bundles (the binpack floor).
+    static_demand: List[Dict[str, float]] = field(default_factory=list)
+    #: Node-attributed health postmortems: [{"id", "ts", "reason", "node"}].
+    postmortems: List[Dict[str, Any]] = field(default_factory=list)
+
+
+class SignalCollector:
+    """Gathers one :class:`ClusterSignals` snapshot per autoscaler tick."""
+
+    def __init__(self, scheduler=None, window_s: float = 60.0):
+        self.scheduler = scheduler
+        self.window_s = window_s
+
+    # ------------------------------------------------------------ sub-reads
+    def _serve_signals(self, agg, now: float) -> Dict[str, Any]:
+        out = {"rate": agg.window_rate("serve_requests_total", None,
+                                       self.window_s, now),
+               "inflight": agg.window_sum("serve_router_inflight", None,
+                                          self.window_s, now),
+               "alerting": False, "quiet": True}
+        slo = sys.modules.get("ray_tpu.serve.slo")
+        if slo is not None:
+            try:
+                payload = slo.get_watchdog().evaluate(now=now)
+            except Exception:  # noqa: BLE001 — sensing must not kill the tick
+                payload = {}
+            for dep in payload.values():
+                if dep.get("alerting"):
+                    out["alerting"] = True
+                for obj in dep.get("objectives", {}).values():
+                    if obj.get("burn_fast", 0.0) >= obj.get(
+                            "burn_threshold", float("inf")) \
+                            or obj.get("burn_slow", 0.0) >= obj.get(
+                                "burn_threshold", float("inf")) \
+                            or obj.get("alerting"):
+                        out["quiet"] = False
+        return out
+
+    def _train_starved_fraction(self, agg) -> float:
+        if sys.modules.get("ray_tpu.train.metrics") is None:
+            return 0.0
+        return agg.latest("ray_tpu_train_data_starved_fraction", {}) or 0.0
+
+    def _pending_ingest_shards(self) -> int:
+        ingest = sys.modules.get("ray_tpu.data.ingest.ingest")
+        if ingest is None:
+            return 0
+        try:
+            return int(ingest.pending_shards())
+        except Exception:  # noqa: BLE001
+            return 0
+
+    def _postmortems(self) -> List[Dict[str, Any]]:
+        from ray_tpu.util import forensics
+
+        rows = []
+        for row in forensics.list_postmortems():
+            reason = str(row.get("reason") or "")
+            if row.get("node") and any(reason.startswith(r)
+                                       for r in HEALTH_REASONS):
+                rows.append({"id": row["id"], "ts": row.get("ts"),
+                             "reason": reason, "node": str(row["node"])})
+        return rows
+
+    # -------------------------------------------------------------- collect
+    def collect(self, now: Optional[float] = None) -> ClusterSignals:
+        from ray_tpu.util.metrics_agent import get_aggregator
+
+        t = time.time() if now is None else float(now)
+        agg = get_aggregator()
+        agg.sample_registry(ts=t)
+        serve = self._serve_signals(agg, t)
+        demand: List[Dict[str, float]] = []
+        if self.scheduler is not None:
+            demand = [dict(r) for r in self.scheduler.pending_demand()]
+            for bundles in self.scheduler.pending_pg_demand():
+                demand.extend(dict(b) for b in bundles)
+        return ClusterSignals(
+            now=t,
+            serve_request_rate=serve["rate"],
+            serve_inflight=serve["inflight"],
+            slo_burn_alerting=serve["alerting"],
+            slo_burn_quiet=serve["quiet"],
+            train_data_starved_fraction=self._train_starved_fraction(agg),
+            pending_ingest_shards=self._pending_ingest_shards(),
+            static_demand=demand,
+            postmortems=self._postmortems(),
+        )

@@ -93,6 +93,58 @@ def _rewire(source: LogicalOp, rest: Iterable[LogicalOp]) -> LogicalOp:
     return cur
 
 
+def plan_locality(plan: LogicalOp) -> Optional[str]:
+    """Object-plane address where a shard sub-plan's input objects live,
+    for locality-aware claiming: ``""`` means the reading node itself,
+    an address string names the remote node holding the copies, ``None``
+    means no locality information (``Read`` roots — the data is not an
+    object yet — or raw in-memory blocks, or mixed placements).
+
+    Spill-aware: a locally-spilled object still classifies as local
+    (``_remote_owner_addr`` consults the authoritative location table,
+    not residency) — restoring from this node's spill files is cheaper
+    than any network fetch, so spilled shards must not lose their
+    locality preference."""
+    root = plan.chain()[0]
+    if not isinstance(root, InputData):
+        return None
+    try:
+        from ray_tpu._private.runtime import get_runtime
+
+        rt = get_runtime()
+    except Exception:  # noqa: BLE001 — no runtime, no locality
+        return None
+    addrs = set()
+    for b in root.blocks:
+        if getattr(b, "id", None) is None:
+            return None  # raw in-memory block: no placement to honor
+        try:
+            addrs.add(rt._remote_owner_addr(b))
+        except Exception:  # noqa: BLE001
+            return None
+    return addrs.pop() if len(addrs) == 1 else None
+
+
+def block_source(ref) -> str:
+    """Where a block ref's bytes come from at fetch time: ``local``
+    (this node's store), ``spilled`` (local store, restored from this
+    node's spill files — still no network), or ``remote``."""
+    oid = getattr(ref, "id", None)
+    if oid is None:
+        return "local"
+    try:
+        from ray_tpu._private.runtime import get_runtime
+
+        rt = get_runtime()
+        if rt._remote_owner_addr(ref):
+            return "remote"
+        if rt.store.state_of(oid) == "SPILLED":
+            return "spilled"
+    except Exception:  # noqa: BLE001 — classification is best-effort
+        pass
+    return "local"
+
+
 @ray_tpu.remote(num_cpus=0)
 def _fused_shard_task(read_task, transforms):
     block = read_task()
@@ -165,6 +217,7 @@ def fetch_block(ref, retries: int = FETCH_RETRIES,
     nothing is yielded until the whole block materialized."""
     last: Optional[BaseException] = None
     for attempt in range(retries + 1):
+        source = block_source(ref)  # classify BEFORE the get pulls it local
         try:
             fault_injection.check("data_ingest_fetch")
             block = _get_abortable(ref, should_stop)
@@ -177,7 +230,17 @@ def fetch_block(ref, retries: int = FETCH_RETRIES,
         acc = BlockAccessor(block)
         ingest_metrics.ROWS.inc(acc.num_rows())  # inc(0) is a no-op
         try:
-            ingest_metrics.BYTES.inc(acc.size_bytes())
+            nbytes = acc.size_bytes()
+            ingest_metrics.BYTES.inc(nbytes)
+            # Locality accounting: cross-node bytes are what the
+            # locality-aware claimer minimizes; a local spill restore
+            # counts as local traffic (and is tallied as a spill refetch).
+            if source == "remote":
+                ingest_metrics.CROSS_NODE_BYTES.inc(nbytes)
+            else:
+                ingest_metrics.LOCAL_BYTES.inc(nbytes)
+                if source == "spilled":
+                    ingest_metrics.SPILL_REFETCHES.inc()
         except Exception:
             pass
         return block

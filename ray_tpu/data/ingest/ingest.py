@@ -26,6 +26,8 @@ distributes the next epoch over the new world with no repartition step.
 from __future__ import annotations
 
 import threading
+import time
+import weakref
 from collections import deque
 from typing import Any, Dict, Iterator, List, Optional
 
@@ -35,6 +37,26 @@ from ray_tpu.data.ingest import metrics as ingest_metrics
 from ray_tpu.data.ingest.prefetch import DeviceBatchIterator, HostPrefetcher
 from ray_tpu.data.ingest.shuffle import epoch_rng, window_shuffle
 from ray_tpu.train.elastic import PROVISIONAL_STEP, SampleLedger
+from ray_tpu.util import tracing
+
+#: Live StreamingIngest instances (weak — an abandoned ingest must not be
+#: kept alive by the registry).  The cluster autoscaler's signal collector
+#: probes :func:`pending_shards` through sys.modules, so a cluster that
+#: never ingests never imports this module.
+_LIVE_INGESTS: "weakref.WeakSet[StreamingIngest]" = weakref.WeakSet()
+_LIVE_LOCK = threading.Lock()
+
+
+def pending_shards() -> int:
+    """Unclaimed source shards summed across every live ingest's epochs —
+    the autoscaler's train-pressure signal (also exported as the
+    ``ray_tpu_data_ingest_pending_shards`` gauge)."""
+    with _LIVE_LOCK:
+        ingests = list(_LIVE_INGESTS)
+    total = sum(st.ledger.remaining()
+                for ing in ingests for st in ing._states())
+    ingest_metrics.PENDING_SHARDS.set(total)
+    return total
 
 
 class _GaugeCounter:
@@ -237,10 +259,24 @@ class StreamingIngest:
         self._lock = threading.Lock()
         self._epochs: Dict[int, _EpochState] = {}  # guarded_by: _lock
         self._window = _GaugeCounter(ingest_metrics.WINDOW_BYTES)
+        #: plan index -> object locality ("" local / addr / None unknown),
+        #: computed once — input placements don't move under the epoch.
+        self._localities: Optional[List[Optional[str]]] = None
+        with _LIVE_LOCK:
+            _LIVE_INGESTS.add(self)
 
     # ------------------------------------------------------------- shape
     def num_shards(self) -> int:
         return len(self._plans)
+
+    def _plan_localities(self) -> List[Optional[str]]:
+        """Per-plan object locality, computed lazily once (a soft hint:
+        a stale entry costs one remote fetch, never correctness)."""
+        with self._lock:
+            if self._localities is None:
+                self._localities = [ingest_ex.plan_locality(p)
+                                    for p in self._plans]
+            return self._localities
 
     @property
     def peak_window_bytes(self) -> int:
@@ -334,12 +370,38 @@ class StreamingIngest:
         fence = session.stop_requested if session is not None else None
         resident = _ResidentBytes(self._window)
 
+        # Locality-aware claiming: prefer shards whose object copies live
+        # on the reading node ("" = local), so a scale-out does not turn
+        # the data plane into a cross-node fetch storm.  Purely a claim
+        # ORDER preference — every shard is still claimed exactly once.
+        localities = self._plan_localities()
+        has_locality = any(a is not None for a in localities)
+
+        def _prefer_local(pos: int) -> bool:
+            return localities[st.order[pos]] == ""
+
         def plan_iter():
             while True:
-                got = st.ledger.claim(1, step=PROVISIONAL_STEP, fence=fence)
+                t0 = time.time()
+                got = st.ledger.claim(
+                    1, step=PROVISIONAL_STEP, fence=fence,
+                    prefer=_prefer_local if has_locality else None)
                 if got is None:
+                    ingest_metrics.PENDING_SHARDS.set(st.ledger.remaining())
                     return
                 pos = got[0]
+                if not has_locality:
+                    outcome = "blind"
+                else:
+                    outcome = "local" if localities[st.order[pos]] == "" \
+                        else "remote"
+                ingest_metrics.LOCALITY_CLAIMS.inc(
+                    1, tags={"locality": outcome})
+                tracing.record_span(
+                    "data.locality_claim", t0, time.time(),
+                    attributes={"preferred": has_locality,
+                                "local": outcome == "local"})
+                ingest_metrics.PENDING_SHARDS.set(st.ledger.remaining())
                 yield pos, self._plans[st.order[pos]]
 
         should_stop = fence.is_set if fence is not None else None

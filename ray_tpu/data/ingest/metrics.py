@@ -54,3 +54,35 @@ STARVED_SECONDS = Counter(
     "Seconds the training loop spent blocked on an empty prefetch buffer "
     "(step starvation caused by the input pipeline)",
 )
+
+LOCAL_BYTES = Counter(
+    "ray_tpu_data_ingest_local_bytes_total",
+    "Block bytes materialized from this node's own object store "
+    "(including local spill restores) — the locality-aware claimer's win",
+)
+
+CROSS_NODE_BYTES = Counter(
+    "ray_tpu_data_ingest_cross_node_bytes_total",
+    "Block bytes pulled over the object plane from another node — what "
+    "locality-aware shard claiming exists to minimize",
+)
+
+SPILL_REFETCHES = Counter(
+    "ray_tpu_data_ingest_spill_refetch_total",
+    "Blocks restored from this node's local spill files instead of "
+    "refetched over the network (spill-aware refetch)",
+)
+
+LOCALITY_CLAIMS = Counter(
+    "ray_tpu_data_ingest_locality_claims_total",
+    "Shard claims by locality outcome: 'local' when the claimed shard's "
+    "object copies live on the reading node, 'remote' otherwise, 'blind' "
+    "when the plan carries no locality information",
+    tag_keys=("locality",),
+)
+
+PENDING_SHARDS = Gauge(
+    "ray_tpu_data_ingest_pending_shards",
+    "Unclaimed source shards summed across live streaming ingests (a "
+    "cluster-autoscaler train-pressure signal)",
+)

@@ -285,6 +285,7 @@ METRIC_MODULES = (
     "ray_tpu.util.flight_recorder",
     "ray_tpu.util.watchdog",
     "ray_tpu.util.device_telemetry",
+    "ray_tpu.autoscaler.metrics",
 )
 
 ALLOWED_PREFIXES = ("ray_tpu_", "serve_")

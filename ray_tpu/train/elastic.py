@@ -60,7 +60,7 @@ class SampleLedger:
 
     # ------------------------------------------------------------- claims
     def claim(self, n: int, step: Optional[int] = None,
-              fence=None) -> Optional[Tuple[int, ...]]:
+              fence=None, prefer=None) -> Optional[Tuple[int, ...]]:
         """Exclusively claim up to ``n`` sample indices for checkpoint
         step ``step``; None once the queue is empty.
 
@@ -71,14 +71,35 @@ class SampleLedger:
         trained in a discarded lineage.  The fence is checked under the
         ledger lock and the controller always sets it BEFORE rolling
         back, so every interleaving either rejects the claim or lands it
-        in _inflight where the rollback requeues it."""
+        in _inflight where the rollback requeues it.
+
+        ``prefer`` (``idx -> bool``): soft locality preference — indices
+        the predicate accepts are claimed first (in queue order), the
+        rest fill from the queue head as usual.  Purely an ordering hint:
+        exactly-once accounting, rollback and exhaustion are unchanged,
+        and no index is ever skipped (the streaming-ingest locality path,
+        docs/cluster-autoscaling.md)."""
         with self._lock:
             if fence is not None and fence.is_set():
                 return None
             if not self._pending:
                 return None
             take = min(n, len(self._pending))
-            indices = tuple(self._pending.popleft() for _ in range(take))
+            if prefer is not None:
+                chosen: List[int] = []
+                for i in self._pending:
+                    if len(chosen) >= take:
+                        break
+                    if prefer(i):
+                        chosen.append(i)
+                for i in chosen:
+                    self._pending.remove(i)
+                while len(chosen) < take:
+                    chosen.append(self._pending.popleft())
+                indices = tuple(chosen)
+            else:
+                indices = tuple(self._pending.popleft()
+                                for _ in range(take))
             if self.seal_on_claim or step is None:
                 for i in indices:
                     self._trained[i] = self._trained.get(i, 0) + 1

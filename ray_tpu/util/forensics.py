@@ -45,6 +45,7 @@ def list_postmortems() -> List[Dict[str, Any]]:
         except (OSError, ValueError):
             continue  # torn write from a dying process; skip, don't fail
         ring = dump.get("ring", [])
+        extra = dump.get("extra") or {}
         rows.append({
             "id": fn[:-len(".json")],
             "pid": dump.get("pid"),
@@ -53,6 +54,9 @@ def list_postmortems() -> List[Dict[str, Any]]:
             "ring_events": len(ring),
             "stalls": sum(1 for r in ring if r.get("kind") == "stall"),
             "tracing_active": dump.get("tracing_active", False),
+            # Node attribution when the trigger recorded one (actor_death
+            # etc.) — what the cluster autoscaler's quarantine gate keys on.
+            "node": str(extra.get("node") or "") or None,
             "path": path,
         })
     rows.sort(key=lambda r: r.get("ts") or 0.0, reverse=True)

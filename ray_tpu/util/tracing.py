@@ -78,6 +78,8 @@ SPAN_REGISTRY: Dict[str, str] = {
     "checkpoint.commit": "coordinator: commit phase up to atomic rename",
     "checkpoint.restore": "restore_pytree entry",
     "data.ingest": "ingest: one source shard, first pull -> last block out",
+    "data.locality_claim": "ingest: one locality-aware shard claim "
+                           "(attrs: preferred, local)",
     "data.prefetch": "ingest: host->device transfer dispatch, per batch",
     "train.step": "profiler: one training step, report() to report()",
     "train.data_wait": "profiler: step blocked on the input pipeline",
@@ -98,6 +100,8 @@ SPAN_REGISTRY: Dict[str, str] = {
                        "transfer (attrs: direction, src, bytes)",
     "device.burn": "device telemetry: one device compute burn (a jitted "
                    "step / decode execution) in the Perfetto device lane",
+    "cluster.autoscale": "cluster autoscaler: one control tick, signal "
+                         "collection -> reconcile",
 }
 
 

@@ -290,3 +290,9 @@ def phase_enter(source: str, phase: str) -> None:
 
 def phase_exit(source: str) -> None:
     get_watchdog().phase_exit(source)
+
+
+def forget(source: str) -> None:
+    """Hook entry for retirement sites (a stopped autoscaler monitor, a
+    descaled worker): drop the source so it cannot be flagged as a stall."""
+    get_watchdog().forget(source)

@@ -1,0 +1,322 @@
+"""Cluster-autoscaler benchmark artifact (ISSUE 20 acceptance).
+
+A deterministic multi-node simulation replaying a diurnal serve+train
+trace through the REAL control stack — ``ClusterAutoscaler`` policy,
+``Autoscaler`` reconciler, ``InstanceManager`` FSM and the real
+``ClusterScheduler`` (draining included) — against a simulated node
+provider (cloud API = a dict), so the bench measures control behavior,
+not cloud latency.  Writes BENCH_CLUSTER.json:
+
+  * **provisioning**: node-seconds wasted (capacity above need) and
+    SLO-violation seconds (need above capacity) for three arms — static
+    at min_workers, static at max_workers, and autoscaled.  Gates:
+    autoscaled waste <= 0.5x static-max waste; autoscaled violation
+    seconds <= 0.25x static-min.
+  * **quarantine**: a node injected to crash-loop (repeated attributed
+    postmortems) is quarantined within 3 postmortems, drained, and its
+    slot never refilled over the remainder of the run.
+  * **ingest locality**: locality-aware shard claiming
+    (``SampleLedger.claim(prefer=...)``) moves <= 0.5x the cross-node
+    bytes of the locality-blind baseline on the same shard trace.
+  * **chaos**: an injected ``cluster_autoscale`` actuation failure
+    leaves the target unchanged; a node killed mid-scale-up still
+    converges to the target.
+
+Usage: python scripts/bench_cluster.py [--hours 24] [--dt 60]
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from ray_tpu._private import fault_injection
+from ray_tpu._private.scheduling import ClusterScheduler
+from ray_tpu.autoscaler.autoscaler import (Autoscaler, AutoscalerConfig,
+                                           NodeTypeConfig)
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.policy import ClusterAutoscaler, ClusterPolicyConfig
+from ray_tpu.autoscaler.signals import ClusterSignals
+from ray_tpu.train.elastic import SampleLedger
+
+QPS_PER_NODE = 100.0
+SERVE_MIN, SERVE_MAX = 2, 16
+
+
+class SimProvider(NodeProvider):
+    """Instant in-memory 'cloud': nodes are entries in the real scheduler."""
+
+    def __init__(self, scheduler: ClusterScheduler):
+        self.scheduler = scheduler
+        self._nodes = {}
+        self._n = 0
+        self.created = 0
+
+    def create_node(self, node_type, resources, labels):
+        node_id = self.scheduler.add_node(
+            dict(resources), {**labels, "node-type": node_type})
+        self._n += 1
+        self.created += 1
+        pid = f"sim-{self._n}"
+        self._nodes[pid] = node_id
+        return pid
+
+    def terminate_node(self, pid):
+        node_id = self._nodes.pop(pid, None)  # idempotent by contract
+        if node_id is not None:
+            self.scheduler.remove_node(node_id)
+
+    def non_terminated_nodes(self):
+        return list(self._nodes)
+
+    def scheduler_node_id(self, pid):
+        return self._nodes.get(pid)
+
+    def kill(self, pid):
+        """Chaos: the node dies without telling the autoscaler."""
+        self.terminate_node(pid)
+
+
+def _mk_cluster(node_types, policy=None):
+    scheduler = ClusterScheduler()
+    provider = SimProvider(scheduler)
+    storage = tempfile.NamedTemporaryFile(
+        suffix=".json", delete=False).name
+    os.unlink(storage)
+    asc = Autoscaler(
+        AutoscalerConfig(node_types=node_types, idle_timeout_s=1e9,
+                         cluster_name="bench"),
+        provider, scheduler=scheduler, storage_path=storage)
+    ca = ClusterAutoscaler(asc, policy or ClusterPolicyConfig(
+        serve_qps_per_node=QPS_PER_NODE,
+        upscale_delay_s=120.0, upscale_cooldown_s=60.0,
+        downscale_delay_s=600.0, downscale_cooldown_s=300.0))
+    return ca, asc, provider, scheduler
+
+
+def diurnal_rate(t, burst_lo=43200.0, burst_hi=46800.0):
+    """Serve request rate at sim-second t: sinusoid with a midday burst."""
+    rate = 600.0 + 500.0 * math.sin(2 * math.pi * t / 86400.0 - math.pi / 2)
+    if burst_lo <= t < burst_hi:
+        rate += 800.0
+    return max(rate, 50.0)
+
+
+def run_provisioning(hours, dt):
+    horizon = int(hours * 3600)
+    ticks = range(0, horizon, dt)
+    needed = [min(max(math.ceil(diurnal_rate(t) / QPS_PER_NODE), SERVE_MIN),
+                  SERVE_MAX) for t in ticks]
+
+    def waste_and_slo(capacity):
+        waste = sum(max(c - n, 0) * dt for c, n in zip(capacity, needed))
+        slo = sum(dt for c, n in zip(capacity, needed) if c < n)
+        return waste, slo
+
+    ca, asc, provider, _ = _mk_cluster({
+        "serve": NodeTypeConfig(resources={"CPU": 8.0},
+                                min_workers=SERVE_MIN,
+                                max_workers=SERVE_MAX)})
+    autoscaled = []
+    for t in ticks:
+        ca.tick(signals=ClusterSignals(
+            now=float(t), serve_request_rate=diurnal_rate(t)))
+        autoscaled.append(asc.im.active_counts().get("serve", 0))
+    waste_auto, slo_auto = waste_and_slo(autoscaled)
+    waste_max, slo_max = waste_and_slo([SERVE_MAX] * len(needed))
+    waste_min, slo_min = waste_and_slo([SERVE_MIN] * len(needed))
+    return {
+        "cluster_trace_hours": hours,
+        "cluster_tick_s": dt,
+        "cluster_needed_peak": max(needed),
+        "cluster_autoscaled_peak": max(autoscaled),
+        "cluster_node_seconds_wasted_autoscaled": waste_auto,
+        "cluster_node_seconds_wasted_static_max": waste_max,
+        "cluster_node_seconds_wasted_static_min": waste_min,
+        "cluster_slo_violation_s_autoscaled": slo_auto,
+        "cluster_slo_violation_s_static_max": slo_max,
+        "cluster_slo_violation_s_static_min": slo_min,
+        "waste_ratio_max": round(waste_auto / max(waste_max, 1), 4),
+        "waste_ratio_gate": 0.5,
+        "slo_ratio_max": round(slo_auto / max(slo_min, 1), 4),
+        "slo_ratio_gate": 0.25,
+    }
+
+
+def run_quarantine():
+    ca, asc, provider, scheduler = _mk_cluster({
+        "train": NodeTypeConfig(resources={"CPU": 4.0}, min_workers=4,
+                                max_workers=4, preemptible=True)})
+    t = 0.0
+    for _ in range(3):  # launch + promote to RUNNING
+        ca.tick(signals=ClusterSignals(now=t))
+        t += 60.0
+    from ray_tpu.autoscaler.instance_manager import InstanceState
+
+    victim = next(str(i.scheduler_node_id)
+                  for i in asc.im.instances(InstanceState.RUNNING))
+    fed = 0
+    quarantined_at = None
+    # One crash-loop dump id re-dumping with a fresh ts each tick (the
+    # {pid}-{reason}.json overwrite semantics of the flight recorder).
+    for _ in range(6):
+        fed += 1
+        ca.tick(signals=ClusterSignals(now=t, postmortems=[{
+            "id": "4242-actor_death", "ts": t, "reason": "actor_death",
+            "node": victim}]))
+        if victim in ca.quarantine.quarantined and quarantined_at is None:
+            quarantined_at = fed
+        t += 60.0
+    # Remainder of the run: the freed slot must never refill.
+    peak_after = 0
+    for _ in range(20):
+        ca.tick(signals=ClusterSignals(now=t))
+        peak_after = max(peak_after,
+                         asc.im.active_counts().get("train", 0))
+        t += 60.0
+    victim_back = any(str(provider.scheduler_node_id(p)) == victim
+                      for p in provider.non_terminated_nodes())
+    return {
+        "quarantine_postmortems_max": quarantined_at or 99,
+        "quarantine_postmortems_gate": 3,
+        "quarantine_peak_nodes_after": peak_after,
+        "gate_quarantine_never_refilled": peak_after <= 3,
+        "gate_quarantine_node_gone": not victim_back,
+    }
+
+
+def run_ingest_locality(n_shards=240, n_readers=4, shard_mb=8):
+    """Same shard trace, locality-aware vs blind claiming over the real
+    ledger; cross-node bytes = shards a reader pulls from another node."""
+    import random as _random
+
+    home = [i % n_readers for i in range(n_shards)]
+    _random.Random(20).shuffle(home)  # arbitrary placement, fixed seed
+    shard_bytes = shard_mb << 20
+
+    def drain(prefer):
+        ledger = SampleLedger(list(range(n_shards)))
+        cross = 0
+        reader = 0
+        while True:
+            pref = (lambda r: (lambda i: home[i] == r))(reader) \
+                if prefer else None
+            got = ledger.claim(1, prefer=pref)
+            if got is None:
+                return cross
+            if home[got[0]] != reader:
+                cross += shard_bytes
+            reader = (reader + 1) % n_readers
+
+    cross_blind = drain(False)
+    cross_aware = drain(True)
+    return {
+        "ingest_shards": n_shards,
+        "ingest_readers": n_readers,
+        "ingest_cross_node_bytes_blind": cross_blind,
+        "ingest_cross_node_bytes_aware": cross_aware,
+        "ingest_cross_ratio_max": round(
+            cross_aware / max(cross_blind, 1), 4),
+        "ingest_cross_ratio_gate": 0.5,
+    }
+
+
+def run_chaos():
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    # Injected actuation failure: target unchanged, no node launched.
+    ca, asc, provider, _ = _mk_cluster({
+        "serve": NodeTypeConfig(resources={"CPU": 8.0}, min_workers=0,
+                                max_workers=8)})
+    old_spec = GLOBAL_CONFIG.testing_rpc_failure
+    GLOBAL_CONFIG.testing_rpc_failure = "cluster_autoscale=1.0"
+    fault_injection.reset_injector()
+    try:
+        t = 0.0
+        for _ in range(10):  # well past hysteresis + cooldown
+            ca.tick(signals=ClusterSignals(now=t,
+                                           serve_request_rate=800.0))
+            t += 60.0
+        target_unchanged = ("serve" not in asc.target_counts
+                            and provider.created == 0)
+    finally:
+        GLOBAL_CONFIG.testing_rpc_failure = old_spec
+        fault_injection.reset_injector()
+
+    # Node killed mid-scale-up: reconciler replaces it, converges.
+    ca2, asc2, provider2, _ = _mk_cluster({
+        "serve": NodeTypeConfig(resources={"CPU": 8.0}, min_workers=0,
+                                max_workers=8)})
+    t = 0.0
+    for _ in range(4):  # decide + launch toward 6 nodes
+        ca2.tick(signals=ClusterSignals(now=t,
+                                        serve_request_rate=600.0))
+        t += 60.0
+    live = provider2.non_terminated_nodes()
+    assert live, "scale-up never launched"
+    provider2.kill(live[0])  # dies behind the autoscaler's back
+    converged = 0
+    for _ in range(10):
+        ca2.tick(signals=ClusterSignals(now=t,
+                                        serve_request_rate=600.0))
+        converged = asc2.im.active_counts().get("serve", 0)
+        t += 60.0
+    return {
+        "gate_chaos_target_unchanged": bool(target_unchanged),
+        "chaos_killed_mid_scaleup": 1,
+        "chaos_converged_nodes": converged,
+        "gate_chaos_converged": converged == 6,
+    }
+
+
+def _merge_artifact(out_path, fields):
+    artifact = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                artifact = json.load(f)
+        except Exception:
+            artifact = {}
+    artifact.update(fields)
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+    return artifact
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--hours", type=float, default=24.0)
+    parser.add_argument("--dt", type=int, default=60)
+    parser.add_argument("--out", default="BENCH_CLUSTER.json")
+    args = parser.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    fields = {}
+    fields.update(run_provisioning(args.hours, args.dt))
+    fields.update(run_quarantine())
+    fields.update(run_ingest_locality())
+    fields.update(run_chaos())
+
+    # Acceptance gates (ISSUE 20).
+    assert fields["waste_ratio_max"] <= fields["waste_ratio_gate"], fields
+    assert fields["slo_ratio_max"] <= fields["slo_ratio_gate"], fields
+    assert fields["quarantine_postmortems_max"] \
+        <= fields["quarantine_postmortems_gate"], fields
+    assert fields["gate_quarantine_never_refilled"], fields
+    assert fields["gate_quarantine_node_gone"], fields
+    assert fields["ingest_cross_ratio_max"] \
+        <= fields["ingest_cross_ratio_gate"], fields
+    assert fields["gate_chaos_target_unchanged"], fields
+    assert fields["gate_chaos_converged"], fields
+
+    artifact = _merge_artifact(args.out, fields)
+    print(json.dumps(artifact, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""Tier-1 wiring for scripts/check_bench_gates.py (ISSUE 20 satellite).
+
+Every committed BENCH_*.json artifact records both its measured values
+and the gates its bench asserted at run time; this test re-derives
+pass/fail from the artifacts alone, so a hand-edited or stale artifact
+fails CI without re-running the (slow) benches.  Also pins the checker's
+generic rules, which every bench's artifact schema relies on.
+"""
+
+import glob
+import importlib.util
+import json
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_checker():
+    path = os.path.join(ROOT, "scripts", "check_bench_gates.py")
+    spec = importlib.util.spec_from_file_location("check_bench_gates", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+checker = _load_checker()
+
+
+# ----------------------------------------------------- committed artifacts
+def test_every_committed_artifact_holds_its_gates():
+    paths = sorted(glob.glob(os.path.join(ROOT, "BENCH_*.json")))
+    assert paths, "no BENCH_*.json artifacts found at the repo root"
+    failures = {os.path.basename(p): v
+                for p in paths if (v := checker.check_file(p))}
+    assert failures == {}, f"checked-in bench gate violations: {failures}"
+
+
+def test_main_passes_over_the_repo(capsys):
+    assert checker.main([]) == 0
+    assert "all recorded gates hold" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------ rule pinning
+def test_numeric_gate_rule():
+    assert checker.collect_violations({"x_max": 1.0, "x_gate": 2.0}) == []
+    out = checker.collect_violations({"x_max": 3.0, "x_gate": 2.0})
+    assert out and "exceeds gate" in out[0]
+
+
+def test_gate_pct_rule():
+    doc = {"recorder_overhead_pct": 5.0, "recorder_gate_pct": 3.0}
+    assert checker.collect_violations(doc)
+    doc = {"recorder_overhead_pct": 2.0, "recorder_gate_pct": 3.0}
+    assert checker.collect_violations(doc) == []
+
+
+def test_boolean_gates_must_be_true():
+    assert checker.collect_violations({"passed": True, "gate_ok": True}) == []
+    assert checker.collect_violations({"passed": False})
+    assert checker.collect_violations({"gate_never_refilled": False})
+
+
+def test_stranded_gate_is_a_violation():
+    out = checker.collect_violations({"renamed_gate": 1.0})
+    assert out and "no numeric measured sibling" in out[0]
+
+
+def test_rules_apply_recursively():
+    doc = {"suites": [{"inner": {"y_max": 9.0, "y_gate": 1.0}}]}
+    out = checker.collect_violations(doc)
+    assert len(out) == 1 and "suites[0].inner" in out[0]
+
+
+def test_unreadable_artifact_reports(tmp_path):
+    bad = tmp_path / "BENCH_BAD.json"
+    bad.write_text("{not json")
+    out = checker.check_file(str(bad))
+    assert out and "unreadable artifact" in out[0]

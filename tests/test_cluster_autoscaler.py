@@ -1,0 +1,526 @@
+"""Signal-driven cluster autoscaler (ISSUE 20): policy determinism,
+postmortem quarantine, fault-gated actuation, Monitor shutdown, drain
+semantics, provider terminate idempotency and locality-aware claiming.
+
+Policy tests drive ``ClusterAutoscaler.tick(signals=...)`` with synthetic
+:class:`ClusterSignals` snapshots (the layer is keyed entirely on the
+snapshot's ``now``, so no sleeps) against the REAL reconciler +
+scheduler, with only the node provider simulated — the bench_cluster.py
+harness, miniaturized.
+"""
+
+import os
+import tempfile
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import fault_injection
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.scheduling import ClusterScheduler, DefaultStrategy
+from ray_tpu.autoscaler.autoscaler import (Autoscaler, AutoscalerConfig,
+                                           Monitor, NodeTypeConfig)
+from ray_tpu.autoscaler.instance_manager import InstanceState
+from ray_tpu.autoscaler.node_provider import (FakeNodeProvider, NodeProvider,
+                                              SubprocessNodeProvider,
+                                              TPUPodProvider)
+from ray_tpu.autoscaler.policy import (ClusterAutoscaler, ClusterPolicyConfig,
+                                       QuarantineTracker)
+from ray_tpu.autoscaler.signals import ClusterSignals, SignalCollector
+from ray_tpu.train.elastic import SampleLedger
+
+
+@pytest.fixture
+def ray_init():
+    ray_tpu.init(num_cpus=2, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def _wait(pred, timeout=30.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+class SimProvider(NodeProvider):
+    """Instant in-memory cloud over a real scheduler (bench_cluster.py)."""
+
+    def __init__(self, scheduler):
+        self.scheduler = scheduler
+        self._nodes = {}
+        self._n = 0
+        self.created = 0
+
+    def create_node(self, node_type, resources, labels):
+        node_id = self.scheduler.add_node(
+            dict(resources), {**labels, "node-type": node_type})
+        self._n += 1
+        self.created += 1
+        pid = f"sim-{self._n}"
+        self._nodes[pid] = node_id
+        return pid
+
+    def terminate_node(self, pid):
+        node_id = self._nodes.pop(pid, None)  # idempotent by contract
+        if node_id is not None:
+            self.scheduler.remove_node(node_id)
+
+    def non_terminated_nodes(self):
+        return list(self._nodes)
+
+    def scheduler_node_id(self, pid):
+        return self._nodes.get(pid)
+
+    def kill(self, pid):
+        self.terminate_node(pid)
+
+
+def _mk(node_types, policy):
+    scheduler = ClusterScheduler()
+    provider = SimProvider(scheduler)
+    storage = tempfile.NamedTemporaryFile(suffix=".json", delete=False).name
+    os.unlink(storage)
+    asc = Autoscaler(
+        AutoscalerConfig(node_types=node_types, idle_timeout_s=1e9,
+                         cluster_name="test-cluster-policy"),
+        provider, scheduler=scheduler, storage_path=storage)
+    return ClusterAutoscaler(asc, policy), asc, provider, scheduler
+
+
+def _serve_policy(**kw):
+    base = dict(serve_qps_per_node=100.0, upscale_delay_s=5.0,
+                upscale_cooldown_s=10.0, downscale_delay_s=60.0,
+                downscale_cooldown_s=60.0)
+    base.update(kw)
+    return ClusterPolicyConfig(**base)
+
+
+# ---------------------------------------------------------------- policy
+def test_upscale_waits_for_hysteresis_delay():
+    ca, asc, provider, _ = _mk(
+        {"serve": NodeTypeConfig(resources={"CPU": 8.0}, max_workers=10)},
+        _serve_policy())
+    sig = lambda t, r: ClusterSignals(now=float(t), serve_request_rate=r)
+    ca.tick(signals=sig(0, 500.0))  # above target, delay not yet served
+    assert asc.target_counts.get("serve") is None
+    assert provider.created == 0
+    ca.tick(signals=sig(2, 500.0))  # still inside the 5s delay
+    assert provider.created == 0
+    ca.tick(signals=sig(6, 500.0))  # delay served -> actuate
+    assert asc.target_counts["serve"] == 5
+    assert len(provider.non_terminated_nodes()) == 5
+    # Desired is deterministic from the snapshot: ceil(500/100) = 5.
+    assert asc.im.active_counts()["serve"] == 5
+
+
+def test_burn_bypasses_delay_but_not_cooldown():
+    ca, asc, provider, _ = _mk(
+        {"serve": NodeTypeConfig(resources={"CPU": 8.0}, min_workers=2,
+                                 max_workers=10)},
+        _serve_policy())
+    ca.tick(signals=ClusterSignals(now=0.0))  # min_workers floor
+    assert asc.im.active_counts()["serve"] == 2
+    ca.tick(signals=ClusterSignals(now=1.0, slo_burn_alerting=True,
+                                   slo_burn_quiet=False))
+    # Burn skipped the 5s upscale delay: 2 -> max(3, ceil(2*1.5)) = 3.
+    assert asc.target_counts["serve"] == 3
+    ca.tick(signals=ClusterSignals(now=2.0, slo_burn_alerting=True,
+                                   slo_burn_quiet=False))
+    # ...but never the cooldown (10s): target unchanged one tick later.
+    assert asc.target_counts["serve"] == 3
+
+
+def test_scale_down_steps_one_node_per_decision():
+    ca, asc, provider, _ = _mk(
+        {"serve": NodeTypeConfig(resources={"CPU": 8.0}, min_workers=1,
+                                 max_workers=10)},
+        _serve_policy())
+    sig = lambda t, r: ClusterSignals(now=float(t), serve_request_rate=r)
+    ca.tick(signals=sig(0, 500.0))
+    ca.tick(signals=sig(6, 500.0))
+    assert asc.im.active_counts()["serve"] == 5
+    ca.tick(signals=sig(7, 500.0))  # instances reach RUNNING
+    ca.tick(signals=sig(100, 50.0))  # below: starts the downscale clock
+    assert asc.target_counts["serve"] == 5
+    ca.tick(signals=sig(161, 50.0))  # 60s delay served
+    # One step down per decision, and the idle node over target is
+    # released in the SAME pass — no idle_timeout_s wait (1e9 here).
+    assert asc.target_counts["serve"] == 4
+    assert asc.im.active_counts()["serve"] == 4
+    ca.tick(signals=sig(170, 50.0))  # inside downscale cooldown
+    assert asc.target_counts["serve"] == 4
+    ca.tick(signals=sig(231, 50.0))  # fresh 60s delay + cooldown served
+    assert asc.target_counts["serve"] == 3
+
+
+def test_protected_type_holds_scale_down_while_burn_not_quiet():
+    ca, asc, provider, _ = _mk(
+        {"serve": NodeTypeConfig(resources={"CPU": 8.0}, min_workers=1,
+                                 max_workers=10)},
+        _serve_policy())
+    sig = lambda t, r, quiet: ClusterSignals(
+        now=float(t), serve_request_rate=r, slo_burn_quiet=quiet)
+    ca.tick(signals=sig(0, 500.0, True))
+    ca.tick(signals=sig(6, 500.0, True))
+    assert asc.target_counts["serve"] == 5
+    # Load drops but an SLO window is still burning: protected capacity
+    # must not come down, no matter how long the low signal persists.
+    for t in (100, 200, 300, 400):
+        ca.tick(signals=sig(t, 50.0, False))
+    assert asc.target_counts["serve"] == 5
+    assert ca.last_decisions[0].reason == "hold_burn_not_quiet"
+    # Quiet again: the downscale clock starts fresh from here.
+    ca.tick(signals=sig(500, 50.0, True))
+    assert asc.target_counts["serve"] == 5
+    ca.tick(signals=sig(561, 50.0, True))
+    assert asc.target_counts["serve"] == 4
+
+
+def test_train_signals_route_to_preemptible_types_only():
+    ca, asc, provider, _ = _mk(
+        {"serve": NodeTypeConfig(resources={"CPU": 8.0}, max_workers=10),
+         "train": NodeTypeConfig(resources={"CPU": 16.0}, max_workers=10,
+                                 preemptible=True)},
+        _serve_policy(shards_per_node=10.0, upscale_delay_s=0.0))
+    ca.tick(signals=ClusterSignals(now=0.0, pending_ingest_shards=35))
+    # ceil(35/10) = 4 train nodes; the serve type saw nothing.
+    assert asc.target_counts.get("train") == 4
+    assert "serve" not in asc.target_counts
+    counts = asc.im.active_counts()
+    assert counts.get("train") == 4 and "serve" not in counts
+    # And the launched capacity is labeled preemptible for the scheduler.
+    sched_nodes = [provider.scheduler.get_node(provider.scheduler_node_id(p))
+                   for p in provider.non_terminated_nodes()]
+    assert all(n.labels.get("preemptible") == "true" for n in sched_nodes)
+    # Serve rate drives only the protected type.
+    ca.tick(signals=ClusterSignals(now=20.0, serve_request_rate=250.0,
+                                   pending_ingest_shards=35))
+    assert asc.target_counts["serve"] == 3
+    assert asc.target_counts["train"] == 4
+
+
+def test_data_starved_fraction_adds_one_preemptible_node():
+    ca, asc, provider, _ = _mk(
+        {"train": NodeTypeConfig(resources={"CPU": 16.0}, min_workers=2,
+                                 max_workers=10, preemptible=True)},
+        _serve_policy(upscale_delay_s=0.0, upscale_cooldown_s=0.0))
+    ca.tick(signals=ClusterSignals(now=0.0))
+    assert asc.im.active_counts()["train"] == 2
+    ca.tick(signals=ClusterSignals(now=1.0,
+                                   train_data_starved_fraction=0.5))
+    assert asc.target_counts["train"] == 3  # active + 1
+
+
+def test_signal_desired_clamps_to_type_caps():
+    ca, asc, provider, _ = _mk(
+        {"serve": NodeTypeConfig(resources={"CPU": 8.0}, min_workers=2,
+                                 max_workers=4)},
+        _serve_policy(upscale_delay_s=0.0))
+    ca.tick(signals=ClusterSignals(now=0.0, serve_request_rate=5000.0))
+    assert asc.target_counts["serve"] == 4  # ceil(50) clamped to max
+    assert asc.im.active_counts()["serve"] == 4
+
+
+# ------------------------------------------------------------ quarantine
+def test_quarantine_tracker_counts_fresh_ts_not_ids():
+    tr = QuarantineTracker(threshold=3, window_s=600.0)
+    pm = lambda ts: [{"id": "77-actor_death", "ts": ts,
+                      "reason": "actor_death", "node": "n1"}]
+    assert tr.observe(pm(1.0), now=1.0) == []
+    # Same (id, ts) seen again — the dump file unchanged — is NOT a new
+    # postmortem; only a fresh ts (the crash loop overwrote its dump) is.
+    assert tr.observe(pm(1.0), now=2.0) == []
+    assert tr.observe(pm(2.0), now=2.0) == []
+    assert tr.observe(pm(3.0), now=3.0) == [("n1", "actor_death")]
+    # Already quarantined: further postmortems produce no duplicate.
+    assert tr.observe(pm(4.0), now=4.0) == []
+    assert tr.quarantined == {"n1": "actor_death"}
+
+
+def test_quarantine_tracker_window_prunes_old_events():
+    tr = QuarantineTracker(threshold=3, window_s=10.0)
+    rows = [{"id": f"{i}-crash", "ts": float(i), "reason": "crash",
+             "node": "n1"} for i in range(3)]
+    assert tr.observe([rows[0]], now=0.0) == []
+    assert tr.observe([rows[1]], now=1.0) == []
+    # Third event lands after the first fell out of the 10s window.
+    assert tr.observe([rows[2]], now=20.0) == []
+    assert tr.quarantined == {}
+
+
+def test_crash_loop_node_quarantined_within_three_and_never_refilled():
+    ca, asc, provider, scheduler = _mk(
+        {"train": NodeTypeConfig(resources={"CPU": 16.0}, min_workers=3,
+                                 max_workers=3, preemptible=True)},
+        _serve_policy())
+    for t in (0, 1, 2):  # warm up to 3 RUNNING nodes
+        ca.tick(signals=ClusterSignals(now=float(t)))
+    assert asc.im.active_counts()["train"] == 3
+    victim = str(next(iter(asc.im.instances(
+        InstanceState.RUNNING))).scheduler_node_id)
+    pm = lambda t: [{"id": "4242-actor_death", "ts": float(t),
+                     "reason": "actor_death", "node": victim}]
+    ca.tick(signals=ClusterSignals(now=10.0, postmortems=pm(10)))
+    ca.tick(signals=ClusterSignals(now=11.0, postmortems=pm(11)))
+    assert victim not in ca.quarantine.quarantined  # only 2 so far
+    out = ca.tick(signals=ClusterSignals(now=12.0, postmortems=pm(12)))
+    assert out["quarantined"] == [victim]
+    # The slot is retired for good: caps shrunk, node terminated, and the
+    # min_workers floor can never relaunch into the crash loop.
+    assert asc.config.node_types["train"].max_workers == 2
+    assert asc.config.node_types["train"].min_workers == 2
+    for t in range(13, 33):
+        ca.tick(signals=ClusterSignals(now=float(t)))
+    assert asc.im.active_counts()["train"] == 2
+    live = {str(provider.scheduler_node_id(p))
+            for p in provider.non_terminated_nodes()}
+    assert victim not in live
+
+
+def test_quarantine_drains_before_terminating(monkeypatch):
+    """The drain lands in the scheduler before the instance is torn down,
+    so in-flight leases finish but nothing NEW places on the node."""
+    ca, asc, provider, scheduler = _mk(
+        {"train": NodeTypeConfig(resources={"CPU": 16.0}, min_workers=2,
+                                 max_workers=2, preemptible=True)},
+        _serve_policy())
+    ca.tick(signals=ClusterSignals(now=0.0))
+    ca.tick(signals=ClusterSignals(now=1.0))
+    victim = str(next(iter(asc.im.instances(
+        InstanceState.RUNNING))).scheduler_node_id)
+    drained = []
+    orig = scheduler.set_node_draining
+    monkeypatch.setattr(
+        scheduler, "set_node_draining",
+        lambda node, draining=True: drained.append((node, draining))
+        or orig(node, draining))
+    pm = lambda t: [{"id": "1-crash", "ts": float(t), "reason": "crash",
+                     "node": victim}]
+    for t in (10, 11, 12):
+        ca.tick(signals=ClusterSignals(now=float(t), postmortems=pm(t)))
+    assert (victim, True) in drained
+
+
+# ------------------------------------------------------ fault injection
+def test_injected_actuation_failure_leaves_target_unchanged():
+    ca, asc, provider, _ = _mk(
+        {"serve": NodeTypeConfig(resources={"CPU": 8.0}, max_workers=10)},
+        _serve_policy(upscale_delay_s=0.0))
+    old = GLOBAL_CONFIG.testing_rpc_failure
+    GLOBAL_CONFIG.testing_rpc_failure = "cluster_autoscale=1.0"
+    fault_injection.reset_injector()
+    try:
+        for t in range(5):
+            ca.tick(signals=ClusterSignals(now=float(t),
+                                           serve_request_rate=800.0))
+        assert "serve" not in asc.target_counts
+        assert provider.created == 0
+    finally:
+        GLOBAL_CONFIG.testing_rpc_failure = old
+        fault_injection.reset_injector()
+    # Fault cleared: the same signal actuates on the next tick.
+    ca.tick(signals=ClusterSignals(now=10.0, serve_request_rate=800.0))
+    assert asc.target_counts["serve"] == 8
+
+
+def test_injected_quarantine_failure_retries_next_postmortem():
+    ca, asc, provider, _ = _mk(
+        {"train": NodeTypeConfig(resources={"CPU": 16.0}, min_workers=2,
+                                 max_workers=2, preemptible=True)},
+        _serve_policy())
+    ca.tick(signals=ClusterSignals(now=0.0))
+    ca.tick(signals=ClusterSignals(now=1.0))
+    victim = str(next(iter(asc.im.instances(
+        InstanceState.RUNNING))).scheduler_node_id)
+    pm = lambda t: [{"id": "9-hang", "ts": float(t), "reason": "hang",
+                     "node": victim}]
+    ca.tick(signals=ClusterSignals(now=10.0, postmortems=pm(10)))
+    ca.tick(signals=ClusterSignals(now=11.0, postmortems=pm(11)))
+    old = GLOBAL_CONFIG.testing_rpc_failure
+    GLOBAL_CONFIG.testing_rpc_failure = "cluster_autoscale=1.0"
+    fault_injection.reset_injector()
+    try:
+        out = ca.tick(signals=ClusterSignals(now=12.0, postmortems=pm(12)))
+        # Tipping postmortem arrived but actuation was injected to fail:
+        # the node is NOT quarantined and the cluster is untouched.
+        assert out["quarantined"] == []
+        assert victim not in ca.quarantine.quarantined
+        assert asc.config.node_types["train"].max_workers == 2
+    finally:
+        GLOBAL_CONFIG.testing_rpc_failure = old
+        fault_injection.reset_injector()
+    out = ca.tick(signals=ClusterSignals(now=13.0, postmortems=pm(13)))
+    assert out["quarantined"] == [victim]
+
+
+def test_node_killed_mid_scale_up_converges_to_target():
+    ca, asc, provider, _ = _mk(
+        {"serve": NodeTypeConfig(resources={"CPU": 8.0}, max_workers=10)},
+        _serve_policy(upscale_delay_s=0.0, upscale_cooldown_s=0.0))
+    ca.tick(signals=ClusterSignals(now=0.0, serve_request_rate=600.0))
+    assert len(provider.non_terminated_nodes()) == 6
+    provider.kill(provider.non_terminated_nodes()[0])  # behind our back
+    for t in range(1, 6):
+        ca.tick(signals=ClusterSignals(now=float(t),
+                                       serve_request_rate=600.0))
+    # Drift reconcile failed the dead instance; the target relaunched it.
+    assert len(provider.non_terminated_nodes()) == 6
+    assert asc.im.active_counts()["serve"] == 6
+
+
+# ------------------------------------------------------------- monitor
+def test_monitor_stop_joins_thread_and_retires_watchdog_source(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_HANG_WATCHDOG", "0")
+    from ray_tpu.util import watchdog
+
+    watchdog.reset_watchdog()
+    scheduler = ClusterScheduler()
+    provider = SimProvider(scheduler)
+    storage = tempfile.NamedTemporaryFile(suffix=".json", delete=False).name
+    os.unlink(storage)
+    asc = Autoscaler(
+        AutoscalerConfig(
+            node_types={"w": NodeTypeConfig(resources={"CPU": 1.0},
+                                            min_workers=1, max_workers=2)},
+            idle_timeout_s=1e9, cluster_name="test-monitor"),
+        provider, scheduler=scheduler, storage_path=storage)
+    monitor = Monitor(asc, interval_s=0.02).start()
+    _wait(lambda: len(provider.non_terminated_nodes()) == 1,
+          msg="monitor launched min_workers")
+    _wait(lambda: "cluster.monitor" in watchdog.get_watchdog()._sources,
+          msg="monitor heartbeat registered")
+    monitor.stop()
+    # stop() joined the tick thread: no reconcile pass survives the
+    # return, so no launch can land afterwards.
+    assert not monitor._thread.is_alive()
+    assert list(asc.im.instances(InstanceState.REQUESTED)) == []
+    n_before = provider.created
+    time.sleep(0.1)
+    assert provider.created == n_before
+    # The beat source is retired (a stopped monitor is not a hang) and
+    # the scheduler stops advertising autoscalable shapes.
+    assert "cluster.monitor" not in watchdog.get_watchdog()._sources
+    assert scheduler.autoscaling_enabled is False
+    assert scheduler.autoscaler_node_shapes == []
+    monitor.stop()  # idempotent: second stop is a no-op, not an error
+
+
+# ------------------------------------------------------------ draining
+def test_set_node_draining_excludes_node_from_placement():
+    scheduler = ClusterScheduler()
+    nid = scheduler.add_node({"CPU": 4.0})
+    with scheduler._lock:
+        assert scheduler._try_place_locked({"CPU": 1.0},
+                                           DefaultStrategy()) == nid
+        scheduler._nodes[nid].available = dict(
+            scheduler._nodes[nid].total)  # undo the trial placement
+    assert scheduler.set_node_draining(str(nid)) is True
+    node = scheduler.get_node(nid)
+    assert node.alive and not node.schedulable
+    assert node.snapshot()["Draining"] is True
+    with scheduler._lock:
+        assert scheduler._try_place_locked({"CPU": 1.0},
+                                           DefaultStrategy()) is None
+    # Undrain restores eligibility; unknown nodes report False (the
+    # drain raced a termination — fine, nothing to exclude).
+    assert scheduler.set_node_draining(nid, False) is True
+    assert scheduler.get_node(nid).schedulable
+    assert scheduler.set_node_draining("no-such-node") is False
+
+
+# ------------------------------------------------- provider idempotency
+def test_fake_provider_terminate_is_idempotent(ray_init):
+    provider = FakeNodeProvider()
+    pid = provider.create_node("w", {"CPU": 1.0}, {})
+    assert pid in provider.non_terminated_nodes()
+    provider.terminate_node(pid)
+    assert pid not in provider.non_terminated_nodes()
+    provider.terminate_node(pid)  # double-terminate: no-op, no KeyError
+    provider.terminate_node("fake-never-existed")
+
+
+def test_subprocess_provider_terminate_is_idempotent():
+    provider = SubprocessNodeProvider(address="tcp://127.0.0.1:0")
+    provider.terminate_node("proc-99999")  # never seen: no-op
+    provider.terminate_node("proc-99999")
+
+
+def test_tpu_pod_provider_terminate_is_idempotent():
+    provider = TPUPodProvider()
+    provider.terminate_node("fake-never-existed")
+    provider.terminate_node("fake-never-existed")
+
+
+# ------------------------------------------------------------- signals
+def test_collector_keeps_only_node_attributed_health_postmortems(
+        monkeypatch):
+    from ray_tpu.util import forensics
+
+    rows = [
+        {"id": "1-actor_death", "ts": 1.0, "reason": "actor_death",
+         "node": "n1"},                                      # kept
+        {"id": "2-actor_death", "ts": 2.0, "reason": "actor_death",
+         "node": None},                                      # unattributed
+        {"id": "3-manual", "ts": 3.0, "reason": "manual", "node": "n1"},
+        {"id": "4-task_stall", "ts": 4.0, "reason": "task_stall:step",
+         "node": "n2"},                                      # kept (prefix)
+    ]
+    monkeypatch.setattr(forensics, "list_postmortems", lambda: rows)
+    got = SignalCollector()._postmortems()
+    assert [r["id"] for r in got] == ["1-actor_death", "4-task_stall"]
+    assert all(r["node"] for r in got)
+
+
+def test_collector_snapshot_is_keyed_on_supplied_now(monkeypatch):
+    from ray_tpu.util import forensics
+
+    monkeypatch.setattr(forensics, "list_postmortems", lambda: [])
+    scheduler = ClusterScheduler()
+    sig = SignalCollector(scheduler=scheduler).collect(now=12345.0)
+    assert sig.now == 12345.0
+    assert sig.static_demand == []
+    assert sig.postmortems == []
+
+
+# ------------------------------------------------------ ledger locality
+def test_ledger_claim_prefer_orders_without_skipping():
+    ledger = SampleLedger(list(range(10)), seal_on_claim=True)
+    even = lambda i: i % 2 == 0
+    assert ledger.claim(4, prefer=even) == (0, 2, 4, 6)
+    # Preferred indices exhaust mid-claim: the remainder fills from the
+    # queue head in order — nothing is ever skipped.
+    assert ledger.claim(4, prefer=even) == (8, 1, 3, 5)
+    assert ledger.claim(4, prefer=even) == (7, 9)
+    assert ledger.claim(1, prefer=even) is None
+    # Exactly-once accounting is untouched by the ordering hint.
+    assert ledger.double_trained() == []
+    assert ledger.untrained() == []
+
+
+def test_ledger_prefer_claims_roll_back_like_any_other():
+    ledger = SampleLedger(list(range(6)))
+    got = ledger.claim(3, step=5, prefer=lambda i: i >= 3)
+    assert got == (3, 4, 5)
+    assert ledger.rollback(None) == 3  # nothing committed: all requeued
+    # Requeued at the front in original claim order, ahead of 0,1,2.
+    assert ledger.claim(6, step=6) == (3, 4, 5, 0, 1, 2)
+
+
+# ------------------------------------------------------ ingest locality
+def test_plan_locality_and_block_source_degrade_without_runtime():
+    from ray_tpu.data.ingest import executor as ingest_ex
+    from ray_tpu.data.plan import InputData
+
+    # Raw in-memory blocks carry no placement: locality-blind, by design.
+    assert ingest_ex.plan_locality(InputData([[1, 2, 3]])) is None
+
+    class _Ref:
+        id = None
+
+    assert ingest_ex.block_source(_Ref()) == "local"
+    assert ingest_ex.block_source(object()) == "local"
